@@ -1,0 +1,100 @@
+"""paddle_tpu: a TPU-native deep-learning framework.
+
+Capability parity with PaddlePaddle (reference: /root/reference), built
+idiomatically on JAX/XLA/Pallas/pjit.  See SURVEY.md for the layer map this
+package follows.
+"""
+from __future__ import annotations
+
+import os as _os
+
+# x64 must be configured before any jax computation: the reference framework
+# supports float64/int64 tensors as first-class dtypes (python ints create
+# int64 tensors), so we match.
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from .core import dispatch as _dispatch
+from .core import tape as _tape
+from .core.dtype import (  # noqa: F401
+    bfloat16, bool_, complex128, complex64, dtype, float16, float32, float64,
+    int16, int32, int64, int8, uint8,
+)
+from .core.enforce import EnforceError  # noqa: F401
+from .core.place import (  # noqa: F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, Place, TPUPlace, XPUPlace,
+    device_count, get_device, is_compiled_with_cuda, is_compiled_with_distribute,
+    is_compiled_with_rocm, is_compiled_with_xpu, set_device,
+)
+from .core.flags import get_flags, set_flags  # noqa: F401
+from .core.tensor import Parameter, Tensor, to_tensor  # noqa: F401
+
+no_grad = _dispatch.no_grad
+enable_grad = _dispatch.enable_grad
+set_grad_enabled = _dispatch.set_grad_enabled
+is_grad_enabled = _dispatch.is_grad_enabled
+grad = _tape.grad
+
+from . import ops as _ops
+
+_ops.monkey_patch_tensor()
+
+# Public op namespace: paddle_tpu.add / paddle_tpu.reshape / ...
+_g = globals()
+for _name, _fn in _ops.PUBLIC_OPS.items():
+    _g.setdefault(_name, _fn)
+del _g, _name, _fn
+
+from .ops.creation import complex_ as complex  # noqa: F401,E402
+from .ops.math import einsum  # noqa: F401,E402
+from .ops.random import get_rng_state, seed, set_rng_state  # noqa: F401,E402
+
+bool = bool_  # paddle.bool
+
+# Subpackages (imported lazily where heavy).
+from . import amp  # noqa: E402
+from . import autograd  # noqa: E402
+from . import device  # noqa: E402
+from . import distributed  # noqa: E402
+from . import framework  # noqa: E402
+from . import hapi  # noqa: E402
+from . import io  # noqa: E402
+from . import jit  # noqa: E402
+from . import linalg  # noqa: E402
+from . import metric  # noqa: E402
+from . import nn  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import vision  # noqa: E402
+
+from .framework.io import load, save  # noqa: E402
+from .hapi.model import Model, summary  # noqa: E402
+from .nn.layer.layers import Layer  # noqa: E402
+
+DataParallel = distributed.DataParallel
+
+
+def disable_static(place=None):
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is eager-first; use paddle_tpu.jit.to_static for the "
+        "captured/compiled execution path."
+    )
+
+
+def in_dynamic_mode():
+    return True
+
+
+def disable_signal_handler():
+    return None
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    from .hapi.model import flops as _flops
+    return _flops(net, input_size, custom_ops, print_detail)

@@ -1,0 +1,189 @@
+"""AMP: auto_cast + GradScaler + decorate.
+
+Parity with /root/reference/python/paddle/amp/ (auto_cast.py, grad_scaler.py):
+O1 = per-op white/black list casting (enforced inside the dispatcher,
+paddle_tpu/core/amp_state.py); O2 = cast the whole model to fp16/bf16 with
+float32 master weights held by the optimizer.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import amp_state
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "is_bfloat16_supported",
+           "is_float16_supported", "white_list", "black_list"]
+
+
+def white_list():
+    return set(amp_state.WHITE_LIST)
+
+
+def black_list():
+    return set(amp_state.BLACK_LIST)
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    return True  # TPU native dtype
+
+
+class auto_cast:
+    """Context manager enabling autocast (O1/O2).
+
+    On TPU the low-precision dtype defaults to bfloat16 — the MXU-native type —
+    rather than the reference's float16 default.
+    """
+
+    def __init__(self, enable=True, custom_white_list=None, custom_black_list=None,
+                 level="O1", dtype="bfloat16", use_promote=True):
+        self.enable = enable
+        self.level = level
+        self.dtype = convert_dtype(dtype).np_dtype
+        self._custom_white = set(custom_white_list or ())
+        self._custom_black = set(custom_black_list or ())
+
+    def __enter__(self):
+        if self._custom_white:
+            amp_state.WHITE_LIST.update(self._custom_white)
+            amp_state.BLACK_LIST.difference_update(self._custom_white)
+        if self._custom_black:
+            amp_state.BLACK_LIST.update(self._custom_black)
+            amp_state.WHITE_LIST.difference_update(self._custom_black)
+        self._prev = amp_state.enter_autocast(self.enable, self.dtype, self.level)
+        return self
+
+    def __exit__(self, *exc):
+        amp_state.restore(self._prev)
+        return False
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="float16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """O2 decoration: cast model params to low precision; optimizer keeps
+    float32 master weights (reference semantics: optimizer.py master-weight
+    path)."""
+    if level not in ("O1", "O2"):
+        raise ValueError("level must be O1 or O2")
+    dt = convert_dtype(dtype)
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        from ..nn.layer.norm import _BatchNormBase, LayerNorm
+        for m in model_list:
+            for layer in m.sublayers(include_self=True):
+                if isinstance(layer, (_BatchNormBase, LayerNorm)):
+                    continue  # keep norm params fp32 (reference keeps them fp32)
+                for p in layer._parameters.values():
+                    if p is not None and jnp.issubdtype(p._data.dtype, jnp.floating):
+                        if not hasattr(p, "_master_weight"):
+                            p._master_weight = p._data.astype(jnp.float32)
+                        p._data = p._data.astype(dt.np_dtype)
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (parity with
+    /root/reference/python/paddle/amp/grad_scaler.py).
+
+    Note: with bfloat16 on TPU scaling is typically unnecessary (use
+    enable=False); kept for float16 parity.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return Tensor(jnp.asarray(self._scale, jnp.float32))
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        from ..ops.math import scale as _scale
+        return _scale(var, self._scale)
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in (optimizer._parameter_list or []):
+            g = p._grad
+            if g is None:
+                continue
+            arr = g._data.astype(jnp.float32) * inv
+            if not bool(jnp.all(jnp.isfinite(arr))):
+                found = True
+            g._data = arr.astype(g._data.dtype) if g._data.dtype != jnp.float32 else arr
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "incr_count": self._good_steps,
+                "decr_count": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("incr_count", 0)
+        self._bad_steps = state.get("decr_count", 0)

@@ -1,0 +1,21 @@
+"""autograd namespace: backward, PyLayer, no_grad, saved-tensor hooks.
+
+Parity with /root/reference/python/paddle/autograd/.
+"""
+from __future__ import annotations
+
+from ..core.dispatch import enable_grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from ..core.tape import backward as _tape_backward
+from ..core.tape import grad  # noqa: F401
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
+           "is_grad_enabled", "PyLayer", "PyLayerContext"]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    if grad_tensors is not None:
+        gt = list(grad_tensors)
+    else:
+        gt = None
+    _tape_backward(list(tensors), gt, retain_graph=retain_graph)

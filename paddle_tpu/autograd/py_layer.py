@@ -1,0 +1,110 @@
+"""PyLayer: user-defined forward/backward.
+
+Parity with /root/reference/python/paddle/autograd/py_layer.py:282.  The
+custom backward is spliced into the tape as a GradNode whose "vjp" calls the
+user's static backward with a context object.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.tape import GradNode
+from ..core.tensor import Tensor
+
+__all__ = ["PyLayer", "PyLayerContext"]
+
+
+class PyLayerContext:
+    def __init__(self):
+        self.container = ()
+        self._non_differentiable = set()
+        self._materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self.container = tensors
+
+    @property
+    def saved_tensor(self):
+        return self.container
+
+    def saved_tensor_list(self):
+        return list(self.container)
+
+    def mark_non_differentiable(self, *tensors):
+        self._non_differentiable.update(id(t) for t in tensors)
+
+    def set_materialize_grads(self, value):
+        self._materialize_grads = bool(value)
+
+
+class _PyLayerNode(GradNode):
+    """GradNode whose backward calls the user function."""
+
+    __slots__ = ("ctx", "backward_fn", "n_inputs")
+
+    def __init__(self, ctx, backward_fn, mask, parents, out_tensors):
+        super().__init__("pylayer", None, mask, parents, out_tensors)
+        self.ctx = ctx
+        self.backward_fn = backward_fn
+
+    def run_backward(self, cotangents):
+        if not isinstance(cotangents, tuple):
+            cotangents = (cotangents,)
+        grads_in = tuple(
+            Tensor(c) if not isinstance(c, Tensor) else c for c in cotangents)
+        with dispatch.no_grad():
+            out = self.backward_fn(self.ctx, *grads_in)
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        return tuple(
+            (g._data if isinstance(g, Tensor) else g) if g is not None else None
+            for g in out)
+
+    def release(self):
+        self.ctx = None
+        self.parents = None
+        self.released = True
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        requires = [not t.stop_gradient for t in tensor_inputs]
+        grad_on = dispatch.is_grad_enabled() and any(requires)
+
+        with dispatch.no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        multi = isinstance(outputs, (tuple, list))
+        outs = tuple(outputs) if multi else (outputs,)
+        out_tensors = tuple(
+            Tensor(o._data if isinstance(o, Tensor) else o,
+                   stop_gradient=not grad_on)
+            for o in outs)
+
+        if grad_on:
+            mask = tuple(requires)
+            node = _PyLayerNode(ctx, cls.backward, mask, tensor_inputs, out_tensors)
+            for i, t in enumerate(out_tensors):
+                if id(outs[i]) in ctx._non_differentiable:
+                    t.stop_gradient = True
+                    continue
+                t._grad_node = node
+                t._output_index = i
+        return tuple(out_tensors) if multi else out_tensors[0]

@@ -1,0 +1,114 @@
+"""Data types.
+
+TPU-native analog of the reference dtype surface
+(/root/reference/paddle/phi/common/data_type.h): one canonical DataType object
+per dtype, string aliases, and numpy/jax interop.  Unlike the reference we back
+every dtype directly with a jax/numpy dtype object — XLA is the only kernel
+backend so no per-backend dtype tables are needed.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "dtype", "bool_", "uint8", "int8", "int16", "int32", "int64",
+    "float16", "bfloat16", "float32", "float64", "complex64", "complex128",
+    "convert_dtype", "to_jax_dtype", "is_floating_point_dtype", "is_integer_dtype",
+]
+
+
+class dtype:
+    """A framework dtype: thin, interned wrapper over a numpy dtype."""
+
+    _registry: dict[str, "dtype"] = {}
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+        dtype._registry[name] = self
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __eq__(self, other):
+        if isinstance(other, dtype):
+            return self.name == other.name
+        try:
+            return self.np_dtype == np.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.name)
+
+    @property
+    def is_floating_point(self):
+        return self.name in ("float16", "bfloat16", "float32", "float64")
+
+    @property
+    def is_complex(self):
+        return self.name in ("complex64", "complex128")
+
+    @property
+    def is_integer(self):
+        return self.name in ("bool", "uint8", "int8", "int16", "int32", "int64")
+
+    @property
+    def itemsize(self):
+        return self.np_dtype.itemsize
+
+
+bool_ = dtype("bool", np.bool_)
+uint8 = dtype("uint8", np.uint8)
+int8 = dtype("int8", np.int8)
+int16 = dtype("int16", np.int16)
+int32 = dtype("int32", np.int32)
+int64 = dtype("int64", np.int64)
+float16 = dtype("float16", np.float16)
+bfloat16 = dtype("bfloat16", jnp.bfloat16)
+float32 = dtype("float32", np.float32)
+float64 = dtype("float64", np.float64)
+complex64 = dtype("complex64", np.complex64)
+complex128 = dtype("complex128", np.complex128)
+
+_ALIASES = {
+    "bool": bool_,
+    "float": float32,
+    "double": float64,
+    "half": float16,
+    "int": int32,
+    "long": int64,
+    "bfloat": bfloat16,
+}
+
+
+def convert_dtype(d) -> dtype:
+    """Normalize any dtype-like (str, np.dtype, jnp dtype, dtype) to a dtype."""
+    if d is None:
+        return None
+    if isinstance(d, dtype):
+        return d
+    if isinstance(d, str):
+        if d in dtype._registry:
+            return dtype._registry[d]
+        if d in _ALIASES:
+            return _ALIASES[d]
+    npd = np.dtype(d)
+    name = npd.name
+    if name in dtype._registry:
+        return dtype._registry[name]
+    raise TypeError(f"Unsupported dtype: {d!r}")
+
+
+def to_jax_dtype(d):
+    d = convert_dtype(d)
+    return None if d is None else d.np_dtype
+
+
+def is_floating_point_dtype(d) -> bool:
+    return convert_dtype(d).is_floating_point
+
+
+def is_integer_dtype(d) -> bool:
+    return convert_dtype(d).is_integer

@@ -1,0 +1,66 @@
+"""Error/enforce utilities.
+
+Analog of the reference PADDLE_ENFORCE machinery
+(/root/reference/paddle/phi/core/enforce.h): typed framework errors with
+consistent messages.  Stack traces come for free from Python.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "EnforceError", "InvalidArgumentError", "NotFoundError", "OutOfRangeError",
+    "AlreadyExistsError", "PreconditionNotMetError", "UnimplementedError",
+    "UnavailableError", "ExecutionTimeoutError", "enforce", "enforce_eq", "enforce_shape",
+]
+
+
+class EnforceError(RuntimeError):
+    pass
+
+
+class InvalidArgumentError(EnforceError, ValueError):
+    pass
+
+
+class NotFoundError(EnforceError, KeyError):
+    pass
+
+
+class OutOfRangeError(EnforceError, IndexError):
+    pass
+
+
+class AlreadyExistsError(EnforceError):
+    pass
+
+
+class PreconditionNotMetError(EnforceError):
+    pass
+
+
+class UnimplementedError(EnforceError, NotImplementedError):
+    pass
+
+
+class UnavailableError(EnforceError):
+    pass
+
+
+class ExecutionTimeoutError(EnforceError, TimeoutError):
+    pass
+
+
+def enforce(cond, msg: str, exc=InvalidArgumentError):
+    if not cond:
+        raise exc(msg)
+
+
+def enforce_eq(a, b, msg: str = "", exc=InvalidArgumentError):
+    if a != b:
+        raise exc(f"Expected {a!r} == {b!r}. {msg}")
+
+
+def enforce_shape(t, expected_rank=None, msg: str = ""):
+    if expected_rank is not None and len(t.shape) != expected_rank:
+        raise InvalidArgumentError(
+            f"Expected rank-{expected_rank} tensor, got shape {tuple(t.shape)}. {msg}"
+        )

@@ -1,0 +1,95 @@
+"""Runtime flag registry.
+
+TPU-native analog of the reference flag system
+(/root/reference/paddle/common/flags_native.cc, defs /root/reference/paddle/common/flags.cc;
+python surface /root/reference/python/paddle/base/framework.py:132 set_flags/get_flags).
+Flags are typed, documented, env-var overridable (FLAGS_<name>), and
+introspectable.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable
+
+__all__ = ["define_flag", "set_flags", "get_flags", "flag_names"]
+
+_lock = threading.Lock()
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    type: type
+    help: str
+    value: Any
+    on_change: Callable[[Any], None] | None = None
+
+
+_registry: dict[str, _Flag] = {}
+
+
+def _coerce(value, typ):
+    if typ is bool and isinstance(value, str):
+        return value.lower() in ("1", "true", "yes", "on")
+    return typ(value)
+
+
+def define_flag(name: str, default, help: str = "", type_: type | None = None,
+                on_change: Callable[[Any], None] | None = None):
+    typ = type_ or type(default)
+    env = os.environ.get(f"FLAGS_{name}")
+    value = _coerce(env, typ) if env is not None else default
+    with _lock:
+        _registry[name] = _Flag(name, default, typ, help, value, on_change)
+    return value
+
+
+def set_flags(flags: dict):
+    with _lock:
+        for name, value in flags.items():
+            key = name[len("FLAGS_"):] if name.startswith("FLAGS_") else name
+            if key not in _registry:
+                raise ValueError(f"Unknown flag: {name}")
+            f = _registry[key]
+            f.value = _coerce(value, f.type)
+            if f.on_change is not None:
+                f.on_change(f.value)
+
+
+def get_flags(flags=None) -> dict:
+    with _lock:
+        if flags is None:
+            names = list(_registry)
+        elif isinstance(flags, str):
+            names = [flags]
+        else:
+            names = list(flags)
+        out = {}
+        for name in names:
+            key = name[len("FLAGS_"):] if name.startswith("FLAGS_") else name
+            if key not in _registry:
+                raise ValueError(f"Unknown flag: {name}")
+            out[name] = _registry[key].value
+        return out
+
+
+def get_flag(name: str):
+    with _lock:
+        return _registry[name].value
+
+
+def flag_names():
+    with _lock:
+        return sorted(_registry)
+
+
+# Core flags (subset of the reference's 183; grows as subsystems land).
+define_flag("check_nan_inf", False, "Check outputs of every op for NaN/Inf (debug).")
+define_flag("check_nan_inf_level", 0, "0: error on nan/inf; >0 softer reporting levels.")
+define_flag("eager_compile_cache_size", 4096, "Max cached compiled single-op executables.")
+define_flag("benchmark", False, "Synchronize after each op for timing (debug).")
+define_flag("use_pallas_kernels", True, "Use Pallas fused kernels where registered.")
+define_flag("log_compiles", False, "Log XLA compilations of eager ops.")

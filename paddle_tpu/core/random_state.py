@@ -1,0 +1,51 @@
+"""Global RNG state.
+
+Analog of the reference Generator (/root/reference/paddle/phi/core/generator.h)
+— a seeded, splittable stream.  Implemented as a JAX PRNG key chain: every
+consumer calls next_key() which splits off a fresh fold of the root key, so
+eager ops never reuse randomness and seeding is reproducible.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+_lock = threading.Lock()
+_state = {"key": None, "counter": 0, "seed": None}
+
+
+def seed(value: int):
+    with _lock:
+        _state["key"] = jax.random.PRNGKey(int(value) % (2 ** 31))
+        _state["counter"] = 0
+        _state["seed"] = int(value)
+    return value
+
+
+def get_seed():
+    return _state["seed"]
+
+
+def next_key():
+    with _lock:
+        if _state["key"] is None:
+            _state["key"] = jax.random.PRNGKey(np.random.randint(0, 2 ** 31))
+        _state["counter"] += 1
+        return jax.random.fold_in(_state["key"], _state["counter"])
+
+
+def get_rng_state():
+    with _lock:
+        return (None if _state["key"] is None else np.asarray(_state["key"]),
+                _state["counter"], _state["seed"])
+
+
+def set_rng_state(state):
+    import jax.numpy as jnp
+    with _lock:
+        key, counter, sd = state
+        _state["key"] = None if key is None else jnp.asarray(key)
+        _state["counter"] = counter
+        _state["seed"] = sd

@@ -1,0 +1,245 @@
+"""Define-by-run autograd tape and reverse engine.
+
+TPU-native analog of the reference eager autograd engine
+(/root/reference/paddle/fluid/eager/backward.cc — queue-based reverse
+traversal with in-degree counting over GradNodeBase edges,
+/root/reference/paddle/fluid/eager/grad_node_info.h:197).  Nodes here hold a
+compiled-vjp closure instead of generated C++ grad functions; accumulation
+is jnp.add on device.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["GradNode", "backward", "grad"]
+
+
+class GradNode:
+    """One recorded op in the autograd graph."""
+
+    __slots__ = (
+        "op_name", "vjp_fn", "mask", "parents", "out_meta", "_hooks",
+        "released", "__weakref__",
+    )
+
+    def __init__(self, op_name, vjp_fn, mask, parents, out_tensors):
+        self.op_name = op_name
+        self.vjp_fn = vjp_fn
+        self.mask = mask                # which positional inputs are differentiable
+        # Keep refs to differentiable parent tensors (leaf accumulation needs
+        # identity); mirrors GradNodeBase edges + TensorWrapper retention.
+        self.parents = [p if (p is not None and m) else None
+                        for p, m in zip(parents, mask)]
+        self.out_meta = [(tuple(t.shape), t.dtype.np_dtype) for t in out_tensors]
+        self._hooks = []
+        self.released = False
+
+    def release(self):
+        self.vjp_fn = None
+        self.parents = None
+        self.released = True
+
+
+def _zero_cotangent(shape, np_dtype):
+    if np.issubdtype(np_dtype, np.inexact):
+        return jnp.zeros(shape, np_dtype)
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+def _accumulate(a, b):
+    if a is None:
+        return b
+    return jnp.add(a, b)
+
+
+def _is_float0(g):
+    return isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0
+
+
+def _topo_counts(roots: Sequence[GradNode]):
+    """Pending-consumer (in-degree) count per reachable node."""
+    counts: dict[int, int] = collections.defaultdict(int)
+    stack = list(roots)
+    seen = set()
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        for p in node.parents or ():
+            if p is not None and p._grad_node is not None:
+                counts[id(p._grad_node)] += 1
+                stack.append(p._grad_node)
+    return counts
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False,
+             _capture=None, _capture_out=None, _accumulate_leaves=True):
+    """Run reverse accumulation from ``tensors``.
+
+    Mirrors egr::Backward (/root/reference/paddle/fluid/eager/backward.h:26):
+    ready-queue over nodes whose pending consumer count hit zero; per-node
+    cotangent buffers; leaf grads accumulate into ``tensor.grad``.
+
+    _capture/_capture_out implement paddle.grad-style taps: cotangents arriving
+    at captured tensors are recorded (by tensor identity) without requiring
+    them to be leaves.
+    """
+    from .tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    _capture = _capture or {}
+
+    def tap(t, g_arr):
+        if id(t) in _capture:
+            _capture_out[id(t)] = _accumulate(_capture_out.get(id(t)), g_arr)
+
+    # Cotangent buffers per node: list aligned with node outputs.
+    buffers: dict[int, list] = {}
+    root_nodes = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            continue
+        if g is None:
+            if t._data.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {tuple(t.shape)}"
+                )
+            g_arr = jnp.ones(t._data.shape, t._data.dtype)
+        else:
+            g_arr = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        tap(t, g_arr)
+        node = t._grad_node
+        if node is None:
+            if _accumulate_leaves:
+                t._accumulate_grad(g_arr)
+            continue
+        buf = buffers.setdefault(id(node), [None] * len(node.out_meta))
+        buf[t._output_index] = _accumulate(buf[t._output_index], g_arr)
+        root_nodes.append(node)
+
+    if not root_nodes:
+        return
+
+    counts = _topo_counts(root_nodes)
+    processed = set()
+    ready = collections.deque()
+    for n in {id(r): r for r in root_nodes}.values():
+        if counts.get(id(n), 0) == 0:
+            ready.append(n)
+
+    while ready:
+        node = ready.popleft()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+        custom = getattr(node, "run_backward", None)
+        if node.released or (node.vjp_fn is None and custom is None):
+            raise RuntimeError(
+                f"Trying to backward through node '{node.op_name}' a second "
+                "time; set retain_graph=True on the first backward."
+            )
+
+        buf = buffers.pop(id(node), [None] * len(node.out_meta))
+        cts = tuple(
+            b if b is not None else _zero_cotangent(shape, dt)
+            for b, (shape, dt) in zip(buf, node.out_meta)
+        )
+        cotangents = cts if len(cts) > 1 else cts[0]
+
+        if custom is not None:
+            in_grads = custom(cotangents)
+        else:
+            from .dispatch import run_backward_op
+            in_grads = run_backward_op(node.vjp_fn, cotangents)
+
+        for hook in node._hooks:
+            res = hook(in_grads)
+            if res is not None:
+                in_grads = res
+
+        it = iter(in_grads)
+        for p, m in zip(node.parents, node.mask):
+            if not m:
+                continue
+            g = next(it)
+            if p is None:
+                continue
+            # A None/float0 gradient still consumes this edge — the upstream
+            # node's pending count must drop or it never becomes ready.
+            missing = g is None or _is_float0(g)
+            if not missing:
+                # non-leaf tensor hooks fire when the cotangent arrives here
+                # (leaf hooks fire inside _accumulate_grad)
+                if p._backward_hooks and p._grad_node is not None:
+                    from .tensor import Tensor
+                    for hook in p._backward_hooks:
+                        res = hook(Tensor(g))
+                        if res is not None:
+                            g = res._data if isinstance(res, Tensor) else res
+                tap(p, g)
+            if p._grad_node is None:
+                if not missing and _accumulate_leaves and not p.stop_gradient:
+                    p._accumulate_grad(g)
+            else:
+                child = p._grad_node
+                if not missing:
+                    cbuf = buffers.setdefault(id(child), [None] * len(child.out_meta))
+                    idx = p._output_index
+                    cbuf[idx] = _accumulate(cbuf[idx], g)
+                counts[id(child)] -= 1
+                if counts[id(child)] <= 0:
+                    ready.append(child)
+
+        if not retain_graph:
+            node.release()
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """Compute grads of outputs w.r.t. inputs without touching ``.grad``.
+
+    Analog of paddle.grad (/root/reference/python/paddle/base/dygraph/base.py:659).
+    """
+    from .tensor import Tensor
+
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (higher-order eager grad) is not supported yet; "
+            "use the functional jax transforms via paddle_tpu.jit for that."
+        )
+
+    capture = {id(t): t for t in inputs}
+    captured: dict[int, object] = {}
+    backward(outputs, grad_outputs, retain_graph=bool(retain_graph),
+             _capture=capture, _capture_out=captured, _accumulate_leaves=False)
+
+    results = []
+    for t in inputs:
+        g = captured.get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears to not have "
+                    "been used in the graph (set allow_unused=True to allow this)."
+                )
+            results.append(None)
+        else:
+            results.append(Tensor(g, stop_gradient=True))
+    return results

@@ -1,0 +1,327 @@
+"""Eager Tensor.
+
+TPU-native analog of the reference eager Tensor
+(/root/reference/paddle/phi/core/dense_tensor.h:37 holds meta + Allocation;
+python methods bound by /root/reference/paddle/fluid/pybind/eager_method.cc).
+Here the storage is a jax.Array (device-resident, async), the autograd meta is
+(_grad_node, _output_index, stop_gradient, _grad), and the rich op-method
+surface is attached by paddle_tpu.ops.monkey_patch_tensor().
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .dtype import convert_dtype, dtype as _dtype_cls
+from . import place as place_mod
+
+__all__ = ["Tensor", "Parameter", "to_tensor"]
+
+_tensor_counter = [0]
+
+
+class Tensor:
+    __slots__ = (
+        "_data", "stop_gradient", "_grad", "_grad_node", "_output_index",
+        "name", "persistable", "_backward_hooks", "trainable",
+        "_dist_attr", "__weakref__", "__dict__",
+    )
+
+    def __init__(self, data, stop_gradient: bool = True, name: str | None = None):
+        if isinstance(data, Tensor):
+            data = data._data
+        elif not isinstance(data, jax.Array):
+            data = jnp.asarray(data)
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._grad_node = None
+        self._output_index = 0
+        self.persistable = False
+        self.trainable = not stop_gradient
+        self._backward_hooks = []
+        self._dist_attr = None
+        if name is None:
+            _tensor_counter[0] += 1
+            name = f"generated_tensor_{_tensor_counter[0]}"
+        self.name = name
+
+    # ---- metadata ----
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self) -> _dtype_cls:
+        return convert_dtype(self._data.dtype)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def place(self):
+        dev = None
+        try:
+            devs = getattr(self._data, "devices", None)
+            if devs is not None:
+                dev = next(iter(self._data.devices()))
+        except Exception:
+            dev = None
+        if dev is None or dev.platform == "cpu":
+            return place_mod.CPUPlace()
+        return place_mod.TPUPlace(dev.id)
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    def numel(self):
+        return self.size
+
+    def dim(self):
+        return self.ndim
+
+    def rank(self):
+        return self.ndim
+
+    def element_size(self):
+        return self.dtype.itemsize
+
+    # ---- autograd ----
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, value):
+        if value is not None and not isinstance(value, Tensor):
+            value = Tensor(value)
+        self._grad = value
+
+    def _accumulate_grad(self, g_arr):
+        for hook in self._backward_hooks:
+            res = hook(Tensor(g_arr))
+            if res is not None:
+                g_arr = res._data if isinstance(res, Tensor) else res
+        if self._grad is None:
+            self._grad = Tensor(g_arr, stop_gradient=True)
+        else:
+            self._grad._data = jnp.add(self._grad._data, g_arr)
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from .tape import backward as _backward
+        _backward([self], [grad_tensor] if grad_tensor is not None else None,
+                  retain_graph=retain_graph)
+
+    def clear_grad(self, set_to_zero=False):
+        if set_to_zero and self._grad is not None:
+            self._grad._data = jnp.zeros_like(self._grad._data)
+        else:
+            self._grad = None
+
+    clear_gradient = clear_grad
+
+    def register_hook(self, hook):
+        """Hook on this tensor's gradient (fires when grad accumulates here)."""
+        self._backward_hooks.append(hook)
+
+        class _Handle:
+            def remove(handle_self):
+                try:
+                    self._backward_hooks.remove(hook)
+                except ValueError:
+                    pass
+        return _Handle()
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True, name=self.name + "_detached")
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from ..ops.creation import assign
+        return assign(self)
+
+    # ---- conversion / host sync ----
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def astype(self, dt) -> "Tensor":
+        from . import dispatch
+        target = convert_dtype(dt).np_dtype
+
+        return dispatch.apply("cast", _cast_impl, (self,), {"target": str(target)})
+
+    cast = astype
+
+    def to(self, *args, **kwargs):
+        device = kwargs.get("device")
+        dt = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, (str, place_mod.Place)):
+                try:
+                    dt_try = convert_dtype(a) if isinstance(a, str) else None
+                except (TypeError, ValueError):
+                    dt_try = None
+                if dt_try is not None:
+                    dt = a
+                else:
+                    device = a
+            elif isinstance(a, _dtype_cls):
+                dt = a
+        out = self
+        if device is not None:
+            p = device if isinstance(device, place_mod.Place) else place_mod._parse_device(device)
+            arr = jax.device_put(out._data, p.jax_device())
+            t = Tensor(arr, stop_gradient=out.stop_gradient, name=out.name)
+            t._grad_node, t._output_index = out._grad_node, out._output_index
+            out = t
+        if dt is not None:
+            out = out.astype(dt)
+        return out
+
+    def cpu(self):
+        return self.to(device="cpu")
+
+    def cuda(self, device_id=0, blocking=True):
+        return self.to(device=f"tpu:{device_id}")
+
+    def pin_memory(self):
+        return self.cpu()
+
+    # ---- mutation ----
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        arr = jnp.asarray(value, dtype=self._data.dtype)
+        if tuple(arr.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {tuple(arr.shape)} vs {tuple(self._data.shape)}"
+            )
+        self._data = arr
+
+    def copy_(self, other, blocking=True):
+        self.set_value(other)
+        return self
+
+    def _replace_data(self, arr):
+        """In-place storage swap (optimizer updates); no tape interaction."""
+        self._data = arr
+
+    # ---- python protocol ----
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __index__(self):
+        return int(self.numpy())
+
+    def __repr__(self):
+        grad_str = "" if self.stop_gradient else ", stop_gradient=False"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}{grad_str},\n"
+            f"       {np.array2string(self.numpy(), prefix='       ')})"
+        )
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __dlpack__(self, *a, **k):
+        return self._data.__dlpack__(*a, **k)
+
+    # Arithmetic dunders and the op-method surface (reshape/sum/matmul/...)
+    # are attached by paddle_tpu.ops.monkey_patch_tensor(), mirroring how the
+    # reference binds methods in eager_method.cc + python math-op patches.
+
+
+def _cast_impl(x, target):
+    return x.astype(np.dtype(target))
+
+
+class Parameter(Tensor):
+    """Trainable tensor: stop_gradient=False, persistable."""
+
+    def __init__(self, data, name=None, trainable=True):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.trainable = trainable
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor analog: build a device tensor from python/numpy data.
+
+    Matches the reference defaults (python/paddle/tensor/creation.py):
+    python ints -> int64, python floats -> float32, bools -> bool.
+    """
+    if isinstance(data, Tensor):
+        out = data
+        if dtype is not None and out.dtype != convert_dtype(dtype):
+            out = out.astype(dtype)
+        out = Tensor(out._data, stop_gradient=stop_gradient)
+        return out
+
+    jdt = None
+    if dtype is not None:
+        jdt = convert_dtype(dtype).np_dtype
+    else:
+        probe = data
+        while isinstance(probe, (list, tuple)) and len(probe):
+            probe = probe[0]
+        if isinstance(probe, bool):
+            jdt = np.bool_
+        elif isinstance(probe, int):
+            jdt = np.int64
+        elif isinstance(probe, float):
+            jdt = np.float32
+        elif isinstance(probe, complex):
+            jdt = np.complex64
+        # numpy arrays keep their dtype
+
+    if isinstance(data, np.ndarray) and jdt is None:
+        arr = jnp.asarray(data)
+    else:
+        arr = jnp.asarray(np.asarray(data), dtype=jdt)
+
+    if place is not None:
+        p = place if isinstance(place, place_mod.Place) else place_mod._parse_device(place)
+        arr = jax.device_put(arr, p.jax_device())
+    return Tensor(arr, stop_gradient=stop_gradient)

@@ -1,0 +1,182 @@
+"""device namespace.
+
+Parity with /root/reference/python/paddle/device/ — set_device/get_device,
+synchronization, stream no-ops (XLA owns scheduling on TPU), and a cuda
+compatibility shim mapping onto the accelerator.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.place import (  # noqa: F401
+    CPUPlace, CUDAPlace, Place, TPUPlace, XPUPlace, device_count, get_device,
+    get_all_device_type, set_device,
+)
+
+__all__ = ["set_device", "get_device", "get_all_device_type",
+           "get_all_custom_device_type", "get_available_device",
+           "get_available_custom_device", "synchronize", "device_count",
+           "Stream", "Event", "current_stream", "set_stream", "stream_guard",
+           "get_cudnn_version", "is_compiled_with_cinn", "IS_WINDOWS", "cuda"]
+
+IS_WINDOWS = False
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def synchronize(device=None):
+    """Block until all queued device work completes (paddle.device.synchronize)."""
+    try:
+        arr = jax.numpy.zeros(())
+        arr.block_until_ready()
+    except Exception:
+        pass
+
+
+def get_cudnn_version():
+    return None
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+class Event:
+    """Stream event shim: XLA's async dispatch orders work for us; record/query
+    map onto array readiness."""
+
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self._marker = None
+
+    def record(self, stream=None):
+        import jax.numpy as jnp
+        self._marker = jnp.zeros(())
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        if self._marker is not None:
+            self._marker.block_until_ready()
+
+
+class Stream:
+    """Stream shim: TPU execution order is managed by XLA; kept for API parity."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        event.synchronize()
+
+    def wait_stream(self, stream):
+        stream.synchronize()
+
+    def record_event(self, event=None):
+        event = event or Event()
+        event.record(self)
+        return event
+
+    def query(self):
+        return True
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None):
+    return _current_stream
+
+
+def set_stream(stream):
+    global _current_stream
+    prev = _current_stream
+    _current_stream = stream
+    return prev
+
+
+class stream_guard:
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        self._prev = set_stream(self.stream)
+
+    def __exit__(self, *exc):
+        set_stream(self._prev)
+
+
+class _CudaShim:
+    """paddle.device.cuda API mapped onto the TPU runtime."""
+
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def current_stream(device=None):
+        return current_stream()
+
+    @staticmethod
+    def stream_guard(stream):
+        return stream_guard(stream)
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("peak_bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        return _CudaShim.max_memory_allocated(device)
+
+    @staticmethod
+    def memory_allocated(device=None):
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_reserved(device=None):
+        return _CudaShim.memory_allocated(device)
+
+    @staticmethod
+    def empty_cache():
+        return None
+
+    @staticmethod
+    def get_device_properties(device=None):
+        d = jax.devices()[0]
+        class _Props:
+            name = getattr(d, "device_kind", "TPU")
+            total_memory = 0
+        return _Props()
+
+
+cuda = _CudaShim()

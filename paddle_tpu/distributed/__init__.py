@@ -1,0 +1,35 @@
+"""distributed namespace.
+
+Parity target: /root/reference/python/paddle/distributed/ — collectives,
+ProcessGroups, fleet hybrid-parallel, auto-parallel DistTensor API, launch.
+The communication substrate is XLA collectives over ICI/DCN (see SURVEY.md
+§5.8); rendezvous is jax.distributed instead of TCPStore.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = [
+    "init_parallel_env", "get_rank", "get_world_size", "is_initialized",
+    "ParallelEnv", "DataParallel", "all_reduce", "all_gather", "broadcast",
+    "reduce", "scatter", "alltoall", "all_to_all", "send", "recv", "barrier",
+    "ReduceOp", "new_group", "get_group", "spawn", "ProcessMesh",
+    "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer", "Shard",
+    "Replicate", "Partial", "destroy_process_group",
+]
+
+from .collective import (  # noqa: E402,F401
+    ReduceOp, all_gather, all_reduce, all_to_all, alltoall, barrier, broadcast,
+    destroy_process_group, get_group, new_group, recv, reduce, reduce_scatter,
+    scatter, send,
+)
+from .parallel import (  # noqa: E402,F401
+    DataParallel, ParallelEnv, get_rank, get_world_size, init_parallel_env,
+    is_initialized, spawn,
+)
+from .auto_parallel.api import (  # noqa: E402,F401
+    Partial, Replicate, Shard, dtensor_from_fn, reshard, shard_layer,
+    shard_optimizer, shard_tensor, to_static as _ap_to_static,
+)
+from .auto_parallel.process_mesh import ProcessMesh  # noqa: E402,F401
+from . import fleet  # noqa: E402,F401

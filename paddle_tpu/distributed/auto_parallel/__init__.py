@@ -1,0 +1,6 @@
+"""auto_parallel namespace."""
+from .api import (  # noqa: F401
+    DistAttr, Partial, Placement, Replicate, Shard, dtensor_from_fn, reshard,
+    shard_layer, shard_optimizer, shard_tensor, to_static, unshard_dtensor,
+)
+from .process_mesh import ProcessMesh, get_mesh, set_mesh  # noqa: F401

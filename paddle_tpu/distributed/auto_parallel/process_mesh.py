@@ -1,0 +1,105 @@
+"""ProcessMesh: the DistTensor mesh abstraction.
+
+Parity with /root/reference/paddle/phi/core/distributed/auto_parallel/process_mesh.h
+and python/paddle/distributed/auto_parallel/process_mesh.py.  Backed directly
+by jax.sharding.Mesh — placements translate to NamedSharding PartitionSpecs.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["ProcessMesh", "get_mesh", "set_mesh"]
+
+_global_mesh = None
+
+
+class ProcessMesh:
+    def __init__(self, mesh=None, dim_names=None, shape=None, process_ids=None):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+        else:
+            arr = np.asarray(process_ids).reshape(shape)
+        self._mesh_arr = arr
+        self._shape = list(arr.shape)
+        self._process_ids = arr.ravel().tolist()
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._dim_names = list(dim_names)
+        self._jax_mesh = None
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def process_ids(self):
+        return list(self._process_ids)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def mesh(self):
+        return self._mesh_arr
+
+    def get_dim_size(self, dim_name):
+        return self._shape[self._dim_names.index(dim_name)]
+
+    def get_rank_by_dim_and_process_id(self, dim_name, process_id):
+        axis = self._dim_names.index(dim_name)
+        loc = np.argwhere(self._mesh_arr == process_id)
+        return int(loc[0][axis]) if len(loc) else -1
+
+    def jax_mesh(self) -> Mesh:
+        """Materialize as a jax Mesh over the actual local devices."""
+        if self._jax_mesh is None:
+            devs = jax.devices()
+            n = int(np.prod(self._shape))
+            if len(devs) < n:
+                raise RuntimeError(
+                    f"ProcessMesh needs {n} devices but only {len(devs)} "
+                    f"available; for CPU testing set "
+                    f"XLA_FLAGS=--xla_force_host_platform_device_count={n}")
+            chosen = np.asarray([devs[i] for i in self._process_ids]).reshape(self._shape)
+            self._jax_mesh = Mesh(chosen, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._shape == other._shape
+                and self._process_ids == other._process_ids
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((tuple(self._shape), tuple(self._process_ids),
+                     tuple(self._dim_names)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self._shape}, dim_names={self._dim_names}, "
+                f"process_ids={self._process_ids})")
+
+    def __getitem__(self, index):
+        """Sub-mesh along the first axis (reference ProcessMesh slicing)."""
+        sub = self._mesh_arr[index]
+        dim_names = self._dim_names[1:] if sub.ndim < self._mesh_arr.ndim else self._dim_names
+        if sub.ndim == 0:
+            sub = sub.reshape(1)
+            dim_names = [self._dim_names[-1]]
+        return ProcessMesh(sub, dim_names)
+
+
+def get_mesh():
+    return _global_mesh
+
+
+def set_mesh(mesh):
+    global _global_mesh
+    _global_mesh = mesh
+    return mesh

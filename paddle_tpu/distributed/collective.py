@@ -1,0 +1,333 @@
+"""Communication API: groups + collectives.
+
+Parity with /root/reference/python/paddle/distributed/communication/ and the
+ProcessGroup abstraction (/root/reference/paddle/phi/core/distributed/collective/
+process_group.h:48).
+
+TPU-native design (SURVEY.md §5.8): there is no NCCL — collectives are XLA
+ops.  Inside a captured region (shard_map/pjit over a Mesh) these functions
+lower to lax.psum/all_gather/ppermute over the group's mesh axis.  In eager
+single-controller mode, a "group" is a set of devices of the current process
+mesh; eager collectives execute as tiny compiled XLA programs over the
+participating shards (world_size==1 degenerates to identity, matching the
+reference's fast-path).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["ReduceOp", "Group", "new_group", "get_group", "is_available",
+           "all_reduce", "all_gather", "all_gather_object", "broadcast",
+           "reduce", "scatter", "alltoall", "all_to_all", "send", "recv",
+           "barrier", "reduce_scatter", "destroy_process_group", "irecv",
+           "isend", "batch_isend_irecv", "P2POp", "get_backend",
+           "gather", "stream"]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A communication group: an ordered set of global ranks, optionally bound
+    to a mesh axis name (used when lowering collectives under shard_map)."""
+
+    _next_id = 0
+
+    def __init__(self, ranks, axis_name=None, pg_id=None):
+        self.ranks = list(ranks)
+        self.nranks = len(self.ranks)
+        self.axis_name = axis_name
+        if pg_id is None:
+            Group._next_id += 1
+            pg_id = Group._next_id
+        self.id = pg_id
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def rank(self):
+        from .parallel import get_rank
+        return self.get_group_rank(get_rank())
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks}, axis={self.axis_name})"
+
+    process_group = property(lambda self: self)
+
+
+_groups: dict[int, Group] = {}
+_default_group: Group | None = None
+
+
+def _world_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        from .parallel import get_world_size
+        _default_group = Group(list(range(get_world_size())), axis_name=None,
+                               pg_id=0)
+        _groups[0] = _default_group
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
+    if ranks is None:
+        from .parallel import get_world_size
+        ranks = list(range(get_world_size()))
+    g = Group(ranks, axis_name=axis_name)
+    _groups[g.id] = g
+    return g
+
+
+def get_group(gid=0):
+    if gid == 0:
+        return _world_group()
+    return _groups.get(gid)
+
+
+def get_backend(group=None):
+    return "xla"
+
+
+def is_available():
+    return True
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None:
+        _groups.clear()
+        _default_group = None
+    else:
+        _groups.pop(group.id, None)
+
+
+def _in_trace(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _axis(group):
+    g = group or _world_group()
+    return g.axis_name
+
+
+def _maybe_tensor(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _wrap_inplace(tensor, arr):
+    if isinstance(tensor, Tensor):
+        tensor._data = arr
+        return tensor
+    return Tensor(arr)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """AllReduce.  Under shard_map: psum/pmax/... over the group axis.
+    Eager 1-rank: identity."""
+    arr = _maybe_tensor(tensor)
+    axis = _axis(group)
+    if _in_trace(arr) and axis is not None:
+        if op == ReduceOp.SUM:
+            out = jax.lax.psum(arr, axis)
+        elif op == ReduceOp.MAX:
+            out = jax.lax.pmax(arr, axis)
+        elif op == ReduceOp.MIN:
+            out = jax.lax.pmin(arr, axis)
+        elif op == ReduceOp.AVG:
+            out = jax.lax.pmean(arr, axis)
+        else:
+            raise ValueError(f"unsupported op {op} under capture")
+        return _wrap_inplace(tensor, out)
+    g = group or _world_group()
+    if g.nranks <= 1:
+        return tensor
+    raise RuntimeError(
+        "eager cross-device all_reduce requires the tensor to live on a "
+        "sharded mesh; use shard_map/fleet captured mode or a 1-rank group")
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    arr = _maybe_tensor(tensor)
+    ax = _axis(group)
+    if _in_trace(arr) and ax is not None:
+        out = jax.lax.all_gather(arr, ax)
+        if isinstance(tensor_list, list):
+            g = group or _world_group()
+            for i in range(g.nranks):
+                tensor_list.append(Tensor(out[i]))
+            return tensor_list
+        return Tensor(out)
+    g = group or _world_group()
+    if g.nranks <= 1:
+        if isinstance(tensor_list, list):
+            tensor_list.append(tensor)
+            return tensor_list
+        return tensor
+    raise RuntimeError("eager cross-device all_gather requires captured mode")
+
+
+def all_gather_object(object_list, obj, group=None):
+    g = group or _world_group()
+    if g.nranks <= 1:
+        object_list.append(obj)
+        return object_list
+    raise RuntimeError("all_gather_object requires multi-host runtime")
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    g = group or _world_group()
+    arr = _maybe_tensor(tensor)
+    ax = _axis(group)
+    if _in_trace(arr) and ax is not None:
+        # broadcast = select src's shard on every member
+        idx = g.get_group_rank(src)
+        out = jax.lax.all_gather(arr, ax)[idx]
+        return _wrap_inplace(tensor, out)
+    if g.nranks <= 1:
+        return tensor
+    raise RuntimeError("eager cross-device broadcast requires captured mode")
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # single-controller: reduce == all_reduce (every member sees the result;
+    # only dst's value is defined by the reference API)
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = group or _world_group()
+    if g.nranks <= 1:
+        if tensor_list:
+            tensor.set_value(tensor_list[0])
+        return tensor
+    arr = _maybe_tensor(tensor)
+    ax = _axis(group)
+    if _in_trace(arr) and ax is not None and tensor_list is not None:
+        stacked = jnp.stack([_maybe_tensor(t) for t in tensor_list])
+        idx = jax.lax.axis_index(ax)
+        return _wrap_inplace(tensor, stacked[idx])
+    raise RuntimeError("eager cross-device scatter requires captured mode")
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = group or _world_group()
+    ax = _axis(group)
+    arrs = [_maybe_tensor(t) for t in (tensor_list or [])]
+    if arrs and _in_trace(arrs[0]) and ax is not None:
+        stacked = jnp.stack(arrs)
+        summed = jax.lax.psum(stacked, ax)
+        idx = jax.lax.axis_index(ax)
+        return _wrap_inplace(tensor, summed[idx])
+    if g.nranks <= 1:
+        if tensor_list:
+            tensor.set_value(tensor_list[0])
+        return tensor
+    raise RuntimeError("eager cross-device reduce_scatter requires captured mode")
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    g = group or _world_group()
+    ax = _axis(group)
+    arrs = [_maybe_tensor(t) for t in in_tensor_list]
+    if arrs and _in_trace(arrs[0]) and ax is not None:
+        stacked = jnp.stack(arrs)  # [n, ...] destination-major
+        out = jax.lax.all_to_all(stacked, ax, split_axis=0, concat_axis=0,
+                                 tiled=False)
+        for i in range(g.nranks):
+            out_tensor_list.append(Tensor(out[i]))
+        return out_tensor_list
+    if g.nranks <= 1:
+        out_tensor_list.extend(in_tensor_list)
+        return out_tensor_list
+    raise RuntimeError("eager cross-device alltoall requires captured mode")
+
+
+all_to_all = alltoall
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    ax = _axis(group)
+    arr = _maybe_tensor(tensor)
+    if _in_trace(arr) and ax is not None:
+        g = group or _world_group()
+        # point-to-point on TPU = collective_permute on the ring
+        me = jax.lax.axis_index(ax)
+        perm = [(g.get_group_rank(jax.process_index()), g.get_group_rank(dst))]
+        return Tensor(jax.lax.ppermute(arr, ax, perm))
+    g = group or _world_group()
+    if g.nranks <= 1:
+        _p2p_buffer.append(np.asarray(arr))
+        return tensor
+    raise RuntimeError("eager cross-device send requires captured mode")
+
+
+_p2p_buffer: list = []
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    g = group or _world_group()
+    if g.nranks <= 1:
+        if _p2p_buffer:
+            tensor.set_value(_p2p_buffer.pop(0))
+        return tensor
+    raise RuntimeError("eager cross-device recv requires captured mode")
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def isend(tensor, dst, group=None):
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src=None, group=None):
+    return recv(tensor, src or 0, group)
+
+
+def batch_isend_irecv(p2p_op_list):
+    tasks = []
+    for op in p2p_op_list:
+        tasks.append(op.op(op.tensor, op.peer, op.group))
+    return tasks
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    return all_gather(gather_list if gather_list is not None else [], tensor, group)
+
+
+def barrier(group=None):
+    jnp.zeros(()).block_until_ready()
+
+
+class stream:
+    """paddle.distributed.stream namespace shim (sync_op/use_calc_stream knobs
+    are no-ops under XLA's ordered async dispatch)."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    scatter = staticmethod(scatter)
+    alltoall = staticmethod(alltoall)
+    reduce_scatter = staticmethod(reduce_scatter)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

@@ -1,0 +1,17 @@
+"""fleet namespace: hybrid-parallel orchestration.
+
+Parity target: /root/reference/python/paddle/distributed/fleet/ (topology,
+DistributedStrategy, distributed_model, meta_parallel TP/PP/SP layers,
+GroupSharded).  Populated incrementally — see paddle_tpu/distributed/fleet/
+submodules.
+"""
+from .base import DistributedStrategy, Fleet, fleet  # noqa: F401
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+is_first_worker = fleet.is_first_worker

@@ -1,0 +1,93 @@
+"""Fleet singleton + DistributedStrategy.
+
+Parity with /root/reference/python/paddle/distributed/fleet/fleet.py:151 and
+the strategy protobuf (/root/reference/paddle/fluid/framework/
+distributed_strategy.proto) — here a plain attribute bag.
+"""
+from __future__ import annotations
+
+from ..parallel import get_rank, get_world_size, init_parallel_env
+from .topology import CommunicateTopology, HybridCommunicateGroup
+
+__all__ = ["DistributedStrategy", "Fleet", "fleet"]
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+        }
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.find_unused_parameters = False
+        self.fuse_grad_size_in_MB = 32
+        self.last_comm_group_size_MB = 1
+
+
+class Fleet:
+    def __init__(self):
+        self._is_initialized = False
+        self._hcg = None
+        self._strategy = None
+        self._user_defined_optimizer = None
+
+    def init(self, role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+        self._strategy = strategy or DistributedStrategy()
+        init_parallel_env()
+        hc = self._strategy.hybrid_configs
+        topo = CommunicateTopology(
+            hybrid_group_names=["data", "pipe", "sharding", "sep", "model"],
+            dims=[hc.get("dp_degree", 1), hc.get("pp_degree", 1),
+                  hc.get("sharding_degree", 1), hc.get("sep_degree", 1),
+                  hc.get("mp_degree", 1)])
+        self._hcg = HybridCommunicateGroup(topo)
+        self._is_initialized = True
+        return self
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+    def worker_index(self):
+        return get_rank()
+
+    def worker_num(self):
+        return get_world_size()
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    def distributed_model(self, model):
+        from .meta_parallel import wrap_distributed_model
+        return wrap_distributed_model(model, self._hcg, self._strategy)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._user_defined_optimizer = optimizer
+        from .meta_parallel import HybridParallelOptimizer
+        return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
+
+    @property
+    def strategy(self):
+        return self._strategy
+
+    def barrier_worker(self):
+        from ..collective import barrier
+        barrier()
+
+    def stop_worker(self):
+        pass
+
+
+fleet = Fleet()

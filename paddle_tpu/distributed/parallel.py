@@ -1,0 +1,175 @@
+"""Parallel environment + DataParallel.
+
+Parity with /root/reference/python/paddle/distributed/parallel.py
+(init_parallel_env :978, DataParallel :219).
+
+TPU-native: rendezvous is jax.distributed (replacing TCPStore); the "world"
+is the set of JAX processes x their local devices.  In the common
+single-controller case (one process driving all chips) world_size is the
+process count (1) and data parallelism is expressed through sharded meshes,
+matching how the reference's fleet maps onto GSPMD here.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["ParallelEnv", "init_parallel_env", "get_rank", "get_world_size",
+           "is_initialized", "DataParallel", "spawn"]
+
+_initialized = False
+
+
+class ParallelEnv:
+    """Reads the launch env contract (PADDLE_TRAINER_ID & friends), falling
+    back to JAX process topology."""
+
+    def __init__(self):
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID",
+                                        os.environ.get("RANK", jax.process_index())))
+        self._world_size = int(os.environ.get(
+            "PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", jax.process_count())))
+        self._device_id = int(os.environ.get("FLAGS_selected_tpus",
+                                             os.environ.get("LOCAL_RANK", 0)))
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    @property
+    def device_id(self):
+        return self._device_id
+
+    @property
+    def dev_id(self):
+        return self._device_id
+
+    local_rank = rank
+    nranks = world_size
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else [self.current_endpoint]
+
+
+def init_parallel_env():
+    """Bring up the distributed runtime.
+
+    Multi-host: initialize jax.distributed from the launch env (coordinator =
+    rank-0 endpoint) so all hosts join one global XLA world — the analog of
+    ProcessGroupNCCL's TCPStore uid exchange + ncclCommInitRank
+    (/root/reference/paddle/fluid/distributed/collective/process_group_nccl.cc:732).
+    """
+    global _initialized
+    if _initialized:
+        return
+    env = ParallelEnv()
+    if env.world_size > 1 and jax.process_count() == 1:
+        coordinator = os.environ.get("PADDLE_MASTER",
+                                     env.trainer_endpoints[0])
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=env.world_size,
+                process_id=env.rank)
+        except Exception as e:  # already initialized or single-host testing
+            import logging
+            logging.getLogger(__name__).warning(
+                "jax.distributed.initialize failed (%s); continuing "
+                "single-host", e)
+    _initialized = True
+    from .collective import _world_group
+    _world_group()
+    return ParallelEnv()
+
+
+def is_initialized():
+    return _initialized
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.rank
+    return ParallelEnv().rank
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    return ParallelEnv().world_size
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Reference-parity process spawner.  On TPU the single-controller model
+    drives all chips from one process, so spawn simply runs func for the
+    1-process case and defers multi-host to `paddle_tpu.distributed.launch`."""
+    if nprocs in (-1, 0, 1):
+        func(*args)
+        return None
+    raise NotImplementedError(
+        "multi-process spawn: use python -m paddle_tpu.distributed.launch "
+        "(one process per host) — single-controller JAX drives all local "
+        "chips from one process")
+
+
+class DataParallel(Layer):
+    """Eager data-parallel wrapper (reference: parallel.py:219 + EagerReducer).
+
+    Under the single-controller TPU model, cross-chip gradient averaging is
+    performed by the compiled train step over the 'dp' mesh axis; this wrapper
+    exists for API parity and multi-host eager mode, where it registers
+    grad hooks that all-reduce over the world group.
+    """
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.group = group
+        self.find_unused_parameters = find_unused_parameters
+        world = get_world_size(group)
+        if world > 1:
+            from .collective import ReduceOp, all_reduce
+
+            def make_hook(p):
+                def hook(grad):
+                    out = all_reduce(grad, ReduceOp.SUM, self.group)
+                    from ..ops.math import scale
+                    return scale(out, 1.0 / world)
+                return hook
+            for p in layers.parameters():
+                if not p.stop_gradient:
+                    p.register_hook(make_hook(p))
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    def no_sync(self):
+        import contextlib
+        return contextlib.nullcontext()

@@ -1,0 +1,20 @@
+"""framework namespace: save/load, seeds, regularizers, core glue.
+
+Parity with /root/reference/python/paddle/framework/.
+"""
+from ..core.random_state import seed  # noqa: F401
+from .io import load, save  # noqa: F401
+from .regularizer import L1Decay, L2Decay  # noqa: F401
+
+
+def get_default_dtype():
+    from ..core.dtype import float32
+    return float32.name
+
+
+_default_dtype = ["float32"]
+
+
+def set_default_dtype(d):
+    from ..core.dtype import convert_dtype
+    _default_dtype[0] = convert_dtype(d).name

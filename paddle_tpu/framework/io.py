@@ -1,0 +1,85 @@
+"""paddle.save / paddle.load analog.
+
+Parity with /root/reference/python/paddle/framework/io.py:773 (save) /:1020
+(load): pickle-protocol serialization of nested state_dict structures, with
+tensors stored as numpy arrays (portable, dtype-preserving incl bfloat16 via
+ml_dtypes).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+__all__ = ["save", "load"]
+
+_MAGIC = "paddle_tpu.checkpoint.v1"
+
+
+class _TensorPayload:
+    def __init__(self, array_bytes, dtype_name, shape, is_parameter, name,
+                 stop_gradient):
+        self.array_bytes = array_bytes
+        self.dtype_name = dtype_name
+        self.shape = shape
+        self.is_parameter = is_parameter
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        arr = np.asarray(obj._data)
+        return _TensorPayload(arr.tobytes(), obj.dtype.name, tuple(arr.shape),
+                              isinstance(obj, Parameter), obj.name,
+                              obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        packed = [_pack(v) for v in obj]
+        return t(packed) if t in (list, tuple) else packed
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        from ..core.dtype import convert_dtype
+        np_dtype = convert_dtype(obj.dtype_name).np_dtype
+        arr = np.frombuffer(obj.array_bytes, dtype=np_dtype).reshape(obj.shape)
+        if return_numpy:
+            return arr
+        import jax.numpy as jnp
+        jarr = jnp.asarray(arr)
+        if obj.is_parameter:
+            return Parameter(jarr, name=obj.name, trainable=not obj.stop_gradient)
+        t = Tensor(jarr, stop_gradient=obj.stop_gradient, name=obj.name)
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        un = [_unpack(v, return_numpy) for v in obj]
+        return t(un) if t in (list, tuple) else un
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    path = str(path)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    payload = {"magic": _MAGIC, "data": _pack(obj)}
+    with open(path, "wb") as f:
+        pickle.dump(payload, f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(str(path), "rb") as f:
+        payload = pickle.load(f)
+    if isinstance(payload, dict) and payload.get("magic") == _MAGIC:
+        return _unpack(payload["data"], return_numpy)
+    return _unpack(payload, return_numpy)

@@ -1,0 +1,21 @@
+"""Weight-decay regularizers.
+
+Parity with /root/reference/python/paddle/regularizer.py (L1Decay, L2Decay).
+The optimizer consumes `_coeff` when folding decay into the update program.
+"""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+
+class L1Decay(WeightDecayRegularizer):
+    pass
+
+
+class L2Decay(WeightDecayRegularizer):
+    pass

@@ -1,0 +1,3 @@
+"""hapi namespace (paddle.Model and callbacks)."""
+from . import callbacks  # noqa: F401
+from .model import Model, flops, summary  # noqa: F401

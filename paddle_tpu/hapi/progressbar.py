@@ -1,0 +1,43 @@
+"""Console progress bar for Model.fit (parity with
+/root/reference/python/paddle/hapi/progressbar.py)."""
+from __future__ import annotations
+
+import sys
+import time
+
+__all__ = ["ProgressBar"]
+
+
+class ProgressBar:
+    def __init__(self, num=None, width=30, verbose=1, start=True,
+                 file=sys.stdout):
+        self._num = num
+        self._width = width
+        self._verbose = verbose
+        self.file = file
+        self._seen = 0
+        self._start_time = time.time()
+
+    def start(self):
+        self._start_time = time.time()
+
+    def update(self, current_num, values=None):
+        self._seen = current_num
+        if self._verbose == 0:
+            return
+        msg = f"step {current_num}"
+        if self._num:
+            msg += f"/{self._num}"
+        if values:
+            for k, v in values:
+                if isinstance(v, (list, tuple)):
+                    v = v[0] if v else 0.0
+                try:
+                    msg += f" - {k}: {float(v):.4f}"
+                except (TypeError, ValueError):
+                    msg += f" - {k}: {v}"
+        elapsed = time.time() - self._start_time
+        msg += f" - {elapsed:.0f}s"
+        end = "\n" if (self._num and current_num >= self._num) or self._verbose == 2 else "\r"
+        self.file.write(msg + end)
+        self.file.flush()

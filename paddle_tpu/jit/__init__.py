@@ -1,0 +1,158 @@
+"""jit: captured/compiled execution.
+
+The reference's to_static (/root/reference/python/paddle/jit/api.py:197)
+captures python into a static PIR program via SOT bytecode tracing, compiles
+with CINN, and caches on input guards
+(/root/reference/python/paddle/jit/sot/symbolic/compile_cache.py).  On TPU the
+capture mechanism is JAX tracing: run the eager Tensor machinery under
+jax.jit; the guard cache is jit's (shape, dtype) signature cache.  This is
+where TPU perf comes from — the whole forward (or train step) becomes one
+fused XLA program.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.tensor import Parameter, Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["to_static", "save", "load", "ignore_module", "not_to_static",
+           "TracedFunction"]
+
+
+def _tree_to_arrays(obj):
+    if isinstance(obj, Tensor):
+        return obj._data
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_to_arrays(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _tree_to_arrays(v) for k, v in obj.items()}
+    return obj
+
+
+def _tree_to_tensors(obj, stop_gradient=True):
+    if isinstance(obj, jax.Array):
+        return Tensor(obj, stop_gradient=stop_gradient)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_to_tensors(o, stop_gradient) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _tree_to_tensors(v, stop_gradient) for k, v in obj.items()}
+    return obj
+
+
+class TracedFunction:
+    """A function (or Layer.forward) compiled as one XLA program.
+
+    Parameters/buffers are threaded as explicit inputs so the cache stays
+    valid across optimizer updates (reference analog: partial_program's
+    parameter feeding).
+    """
+
+    def __init__(self, fn, layer=None, input_spec=None, full_graph=True):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        self._compiled = None
+
+    def _build(self):
+        layer = self._layer
+        fn = self._fn
+
+        if layer is not None:
+            named_params = dict(layer.named_parameters())
+            named_buffers = dict(layer.named_buffers())
+
+            def pure(param_arrays, buffer_arrays, args, kwargs):
+                # bind arrays into the live layer, run, restore
+                saved_p = {k: p._data for k, p in named_params.items()}
+                saved_b = {k: b._data for k, b in named_buffers.items()}
+                try:
+                    for k, p in named_params.items():
+                        p._data = param_arrays[k]
+                    for k, b in named_buffers.items():
+                        b._data = buffer_arrays[k]
+                    t_args = _tree_to_tensors(args)
+                    t_kwargs = _tree_to_tensors(kwargs)
+                    with dispatch.no_grad():
+                        out = fn(*t_args, **t_kwargs)
+                    return _tree_to_arrays(out)
+                finally:
+                    for k, p in named_params.items():
+                        p._data = saved_p[k]
+                    for k, b in named_buffers.items():
+                        b._data = saved_b[k]
+
+            self._compiled = jax.jit(pure)
+        else:
+            def pure(args, kwargs):
+                t_args = _tree_to_tensors(args)
+                t_kwargs = _tree_to_tensors(kwargs)
+                with dispatch.no_grad():
+                    out = fn(*t_args, **t_kwargs)
+                return _tree_to_arrays(out)
+            self._compiled = jax.jit(pure)
+
+    def __call__(self, *args, **kwargs):
+        if self._compiled is None:
+            self._build()
+        a = _tree_to_arrays(args)
+        k = _tree_to_arrays(kwargs)
+        if self._layer is not None:
+            params = {k2: p._data for k2, p in self._layer.named_parameters()}
+            buffers = {k2: b._data for k2, b in self._layer.named_buffers()}
+            out = self._compiled(params, buffers, a, k)
+        else:
+            out = self._compiled(a, k)
+        return _tree_to_tensors(out)
+
+    @property
+    def forward(self):
+        return self
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """Compile a function or Layer into a cached XLA program."""
+
+    def decorate(obj):
+        if isinstance(obj, Layer):
+            traced = TracedFunction(obj.forward, layer=obj, input_spec=input_spec)
+            obj.forward = traced
+            return obj
+        if callable(obj):
+            layer = getattr(obj, "__self__", None)
+            layer = layer if isinstance(layer, Layer) else None
+            return TracedFunction(obj, layer=layer, input_spec=input_spec)
+        raise TypeError(f"to_static expects a Layer or callable, got {type(obj)}")
+
+    if function is None:
+        return decorate
+    return decorate(function)
+
+
+def not_to_static(fn=None):
+    return fn
+
+
+def ignore_module(modules):
+    return None
+
+
+def save(layer, path, input_spec=None, **configs):
+    """jit.save analog: persist params + (optionally) the traced signature.
+
+    StableHLO program export lands with the inference-deploy milestone; the
+    state_dict payload round-trips through paddle_tpu.load today.
+    """
+    from ..framework.io import save as _save
+    state = layer.state_dict() if isinstance(layer, Layer) else {}
+    _save({"state_dict": state, "class": type(layer).__name__}, path + ".pdparams")
+
+
+def load(path, **configs):
+    from ..framework.io import load as _load
+    return _load(path + ".pdparams")

@@ -1,0 +1,7 @@
+"""paddle.linalg namespace re-export.
+
+Parity with /root/reference/python/paddle/linalg.py.
+"""
+from .ops.linalg import *  # noqa: F401,F403
+from .ops.linalg import __all__  # noqa: F401
+from .ops.math import matmul  # noqa: F401

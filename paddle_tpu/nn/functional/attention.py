@@ -1,0 +1,86 @@
+"""Attention functionals.
+
+Parity with /root/reference/python/paddle/nn/functional/flash_attention.py
+(flash_attention :358, scaled_dot_product_attention :1139).  The default path
+is a jnp composition XLA fuses well; when FLAGS_use_pallas_kernels is on and
+shapes qualify, the Pallas flash kernel (paddle_tpu/ops/pallas/flash_attention.py)
+is used instead — the TPU analog of the reference's FA2 CUDA kernel.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch as D
+from ...core.flags import get_flag
+
+__all__ = ["scaled_dot_product_attention", "flash_attention", "sdp_kernel"]
+
+
+def _sdpa_ref(q, k, v, *rest, causal, dropout_p, scale, has_mask):
+    # q/k/v: [B, S, H, D] (paddle flash-attention layout)
+    mask = rest[0] if has_mask else None
+    qt = jnp.swapaxes(q, 1, 2)  # [B, H, S, D]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * s
+    scores = scores.astype(jnp.float32)
+    if causal:
+        ql, kl = scores.shape[-2], scores.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+        scores = jnp.where(causal_mask, scores, -jnp.inf)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, -jnp.inf)
+        else:
+            scores = scores + mask.astype(scores.dtype)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """Inputs [batch, seq, num_heads, head_dim] like the reference."""
+    use_pallas = get_flag("use_pallas_kernels")
+    if use_pallas and attn_mask is None and dropout_p == 0.0:
+        from ...ops.pallas.flash_attention import flash_attention_fwd
+        if flash_attention_fwd.supports(query.shape, query.dtype.name):
+            return D.apply(
+                "flash_attention", flash_attention_fwd,
+                (query, key, value), {"causal": bool(is_causal)})
+    args = (query, key, value) + ((attn_mask,) if attn_mask is not None else ())
+    return D.apply("sdpa", _sdpa_ref, args,
+                   {"causal": bool(is_causal), "dropout_p": float(dropout_p),
+                    "scale": None, "has_mask": attn_mask is not None})
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    out = scaled_dot_product_attention(query, key, value, None, dropout, causal,
+                                       training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+class sdp_kernel:
+    """Context manager selecting attention backends (API compat)."""
+
+    def __init__(self, enable_flash=True, enable_math=True, enable_mem_efficient=True):
+        self.enable_flash = enable_flash
+
+    def __enter__(self):
+        from ...core.flags import set_flags
+        self._prev = get_flag("use_pallas_kernels")
+        set_flags({"use_pallas_kernels": self.enable_flash})
+        return self
+
+    def __exit__(self, *exc):
+        from ...core.flags import set_flags
+        set_flags({"use_pallas_kernels": self._prev})
+        return False

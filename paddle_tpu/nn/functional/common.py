@@ -1,0 +1,274 @@
+"""Common functionals: linear, dropout, embedding, interpolate, padding, etc.
+
+Parity with /root/reference/python/paddle/nn/functional/{common,input}.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch as D
+from ...core import random_state
+from ...core.tensor import Tensor
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout", "embedding",
+    "one_hot", "label_smooth", "pad", "interpolate", "upsample", "pixel_shuffle",
+    "pixel_unshuffle", "channel_shuffle", "unfold", "fold", "cosine_similarity",
+    "bilinear", "normalize", "zeropad2d",
+]
+
+
+def _linear(x, w, b):
+    y = jnp.matmul(x, w)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b).  Weight layout [in, out] per the reference
+    (/root/reference/python/paddle/nn/layer/common.py Linear)."""
+    if bias is None:
+        return D.apply("linear", lambda a, w: jnp.matmul(a, w), (x, weight))
+    return D.apply("linear", _linear, (x, weight, bias))
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if isinstance(p, Tensor):
+        p = float(p.item())
+    if not training or p == 0.0:
+        if not training and mode == "downscale_in_infer" and p > 0.0:
+            from ...ops.math import scale as _scale
+            return _scale(x, 1.0 - p)
+        return x
+    key = random_state.next_key()
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (None if axis is None else (int(axis),))
+
+    def _dropout(k, a, p, axis, upscale):
+        shape = a.shape if axis is None else tuple(
+            a.shape[i] if i in axis else 1 for i in range(a.ndim))
+        keep = jax.random.bernoulli(k, 1.0 - p, shape)
+        if upscale:
+            return jnp.where(keep, a / (1.0 - p), jnp.zeros((), a.dtype)).astype(a.dtype)
+        return jnp.where(keep, a, jnp.zeros((), a.dtype))
+    return D.apply("dropout", _dropout, (key, x),
+                   {"p": float(p), "axis": ax, "upscale": mode == "upscale_in_train"})
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    if not training or p == 0.0:
+        return x
+    axis = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    if not training or p == 0.0:
+        return x
+    axis = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = random_state.next_key()
+
+    def _ad(k, a, p):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(k, 1.0 - p, a.shape)
+        A = (1.0 - p + p * alpha_p ** 2) ** -0.5
+        B = -A * p * alpha_p
+        return (A * jnp.where(keep, a, jnp.asarray(alpha_p, a.dtype)) + B).astype(a.dtype)
+    return D.apply("alpha_dropout", _ad, (key, x), {"p": float(p)})
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def _emb(ids, w, padding_idx):
+        out = jnp.take(w, ids, axis=0)
+        return out
+    return D.apply("embedding", _emb, (x, weight), {"padding_idx": padding_idx})
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops.manipulation import one_hot as _oh
+    return _oh(x, num_classes)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def _ls(l, epsilon):
+        n = l.shape[-1]
+        return (1.0 - epsilon) * l + epsilon / n
+    if prior_dist is not None:
+        return D.apply("label_smooth_p",
+                       lambda l, pd, epsilon: (1.0 - epsilon) * l + epsilon * pd,
+                       (label, prior_dist), {"epsilon": float(epsilon)})
+    return D.apply("label_smooth", _ls, (label,), {"epsilon": float(epsilon)})
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ...ops.manipulation import pad as _pad
+    return _pad(x, pad, mode, value, data_format)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    nd = x.ndim - 2
+    if data_format.endswith("C"):
+        spatial = tuple(x.shape[1:1 + nd])
+    else:
+        spatial = tuple(x.shape[2:])
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        out_size = tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in size)
+    else:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * nd
+        out_size = tuple(int(s * f) for s, f in zip(spatial, scale_factor))
+
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def _interp(a, out_size, jmode, channels_last):
+        if channels_last:
+            full = (a.shape[0],) + out_size + (a.shape[-1],)
+        else:
+            full = a.shape[:2] + out_size
+        return jax.image.resize(a, full, method=jmode).astype(a.dtype)
+    return D.apply("interpolate", _interp, (x,),
+                   {"out_size": out_size, "jmode": jmode,
+                    "channels_last": data_format.endswith("C")})
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    def _ps(a, r, data_format):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+    return D.apply("pixel_shuffle", _ps, (x,),
+                   {"r": int(upscale_factor), "data_format": data_format})
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    def _pu(a, r, data_format):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h // r, w // r, c * r * r)
+    return D.apply("pixel_unshuffle", _pu, (x,),
+                   {"r": int(downscale_factor), "data_format": data_format})
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def _cs(a, g, data_format):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            return a.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        return a.reshape(n, h, w, g, c // g).transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+    return D.apply("channel_shuffle", _cs, (x,),
+                   {"g": int(groups), "data_format": data_format})
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def tup(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    k, s, d = tup(kernel_sizes), tup(strides), tup(dilations)
+    p = paddings
+    if isinstance(p, int):
+        p = (p, p, p, p)
+    elif len(p) == 2:
+        p = (p[0], p[0], p[1], p[1])
+
+    def _unfold(a, k, s, p, d):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])))
+        out_h = (a.shape[2] - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        out_w = (a.shape[3] - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=k, window_strides=s, padding="VALID", rhs_dilation=d,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return patches.reshape(n, c * k[0] * k[1], out_h * out_w)
+    return D.apply("unfold", _unfold, (x,), {"k": k, "s": s, "p": tuple(p), "d": d})
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def tup(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    out_size, k, s, d = tup(output_sizes), tup(kernel_sizes), tup(strides), tup(dilations)
+    p = paddings
+    if isinstance(p, int):
+        p = (p, p, p, p)
+    elif len(p) == 2:
+        p = (p[0], p[0], p[1], p[1])
+
+    def _fold(a, out_size, k, s, p, d):
+        n, ckk, L = a.shape
+        c = ckk // (k[0] * k[1])
+        h_p = out_size[0] + p[0] + p[1]
+        w_p = out_size[1] + p[2] + p[3]
+        out_h = (h_p - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        out_w = (w_p - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        a = a.reshape(n, c, k[0], k[1], out_h, out_w)
+        out = jnp.zeros((n, c, h_p, w_p), a.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                out = out.at[:, :, i * d[0]:i * d[0] + out_h * s[0]:s[0],
+                             j * d[1]:j * d[1] + out_w * s[1]:s[1]].add(a[:, :, i, j])
+        return out[:, :, p[0]:h_p - p[1], p[2]:w_p - p[3]]
+    return D.apply("fold", _fold, (x,),
+                   {"out_size": out_size, "k": k, "s": s, "p": tuple(p), "d": d})
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def _cs(a, b, axis, eps):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return D.apply("cosine_similarity", _cs, (x1, x2), {"axis": int(axis), "eps": float(eps)})
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def _bl(a, b, w, bias):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bias is not None:
+            out = out + bias
+        return out
+    if bias is None:
+        return D.apply("bilinear", lambda a, b, w: jnp.einsum("bi,oij,bj->bo", a, w, b),
+                       (x1, x2, weight))
+    return D.apply("bilinear", _bl, (x1, x2, weight, bias))
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def _norm(a, p, axis, eps):
+        n = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(n, eps)
+    return D.apply("normalize", _norm, (x,),
+                   {"p": float(p), "axis": int(axis), "eps": float(epsilon)})

@@ -1,0 +1,141 @@
+"""Convolution functionals over lax.conv_general_dilated (MXU-native).
+
+Parity with /root/reference/python/paddle/nn/functional/conv.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch as D
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+           "conv3d_transpose"]
+
+
+def _tup(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return tuple((padding, padding) for _ in range(n))
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return tuple((p, p) for p in padding)
+    if len(padding) == 2 * n:
+        return tuple((padding[2 * i], padding[2 * i + 1]) for i in range(n))
+    # paddle also allows [[0,0],[0,0],[h0,h1],[w0,w1]]
+    flat = [p for p in padding if not (isinstance(p, (list, tuple)) and tuple(p) == (0, 0))]
+    return tuple(tuple(p) for p in flat)
+
+
+def _conv(x, w, b, strides, padding, dilation, groups, nd, channels_last):
+    if channels_last:
+        lhs_spec = "N" + "DHW"[3 - nd:] + "C"
+        out_spec = lhs_spec
+    else:
+        lhs_spec = "NC" + "DHW"[3 - nd:]
+        out_spec = lhs_spec
+    rhs_spec = "OI" + "DHW"[3 - nd:]
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, (lhs_spec, rhs_spec, out_spec))
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=None)
+    if b is not None:
+        shape = [1] * out.ndim
+        shape[out_spec.index("C")] = b.size
+        out = out + b.reshape(shape)
+    return out
+
+
+def _conv_nd(name, nd):
+    def op(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format=None, name=None):
+        df = data_format or ("NCL" if nd == 1 else "NCHW" if nd == 2 else "NCDHW")
+        channels_last = df.endswith("C")
+        s = _tup(stride, nd)
+        d = _tup(dilation, nd)
+        p = _padding(padding, nd)
+        args = (x, weight, bias) if bias is not None else (x, weight)
+        static = {"strides": s, "padding": p, "dilation": d, "groups": int(groups),
+                  "nd": nd, "channels_last": channels_last}
+        if bias is not None:
+            return D.apply(op_name, lambda a, w, b, **kw: _conv(a, w, b, **kw), args, static)
+        return D.apply(op_name, lambda a, w, **kw: _conv(a, w, None, **kw), args, static)
+    op_name = name
+    op.__name__ = name
+    return op
+
+
+conv1d = _conv_nd("conv1d", 1)
+conv2d = _conv_nd("conv2d", 2)
+conv3d = _conv_nd("conv3d", 3)
+
+
+def _conv_transpose(x, w, b, strides, padding, out_padding, dilation, groups, nd,
+                    channels_last, output_size):
+    if channels_last:
+        lhs_spec = "N" + "DHW"[3 - nd:] + "C"
+    else:
+        lhs_spec = "NC" + "DHW"[3 - nd:]
+    # paddle transpose-conv weight layout: [in_c, out_c/groups, *k]
+    rhs_spec = "IO" + "DHW"[3 - nd:]
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, (lhs_spec, rhs_spec, lhs_spec))
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        # convert forward-conv padding semantics to transposed conv
+        k_spatial = [w.shape[i] for i, ch in enumerate(rhs_spec) if ch in "DHW"]
+        pad = tuple(
+            (d_ * (k - 1) - p[0], d_ * (k - 1) - p[1] + op_)
+            for k, p, d_, op_ in zip(k_spatial, padding, dilation, out_padding)
+        )
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1,) * nd, padding=pad, lhs_dilation=strides,
+        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups)
+    if b is not None:
+        shape = [1] * out.ndim
+        shape[lhs_spec.index("C")] = b.size
+        out = out + b.reshape(shape)
+    return out
+
+
+def _conv_transpose_nd(name, nd):
+    def op(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1,
+           dilation=1, data_format=None, output_size=None, name=None):
+        df = data_format or ("NCL" if nd == 1 else "NCHW" if nd == 2 else "NCDHW")
+        channels_last = df.endswith("C")
+        s = _tup(stride, nd)
+        d = _tup(dilation, nd)
+        op_pad = _tup(output_padding, nd)
+        p = _padding(padding, nd)
+        if isinstance(p, str):
+            if p == "SAME":
+                p = tuple((0, 0) for _ in range(nd))
+            else:
+                p = tuple((0, 0) for _ in range(nd))
+        # flip weight group handling: paddle weight is [in, out/groups, *k]
+        static = {"strides": s, "padding": p, "out_padding": op_pad, "dilation": d,
+                  "groups": int(groups), "nd": nd, "channels_last": channels_last,
+                  "output_size": None}
+        args = (x, weight, bias) if bias is not None else (x, weight)
+        if bias is not None:
+            return D.apply(op_name, lambda a, w, b, **kw: _conv_transpose(a, w, b, **kw),
+                           args, static)
+        return D.apply(op_name, lambda a, w, **kw: _conv_transpose(a, w, None, **kw),
+                       args, static)
+    op_name = name
+    op.__name__ = name
+    return op
+
+
+conv1d_transpose = _conv_transpose_nd("conv1d_transpose", 1)
+conv2d_transpose = _conv_transpose_nd("conv2d_transpose", 2)
+conv3d_transpose = _conv_transpose_nd("conv3d_transpose", 3)

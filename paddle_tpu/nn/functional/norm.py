@@ -1,0 +1,217 @@
+"""Normalization functionals.
+
+Parity with /root/reference/python/paddle/nn/functional/norm.py (layer_norm,
+batch_norm, instance_norm, group_norm, local_response_norm) plus rms_norm
+(reference exposes fused_rms_norm in incubate:
+/root/reference/python/paddle/incubate/nn/functional/fused_rms_norm.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch as D
+from ...core.tensor import Tensor
+
+__all__ = ["layer_norm", "batch_norm", "instance_norm", "group_norm",
+           "local_response_norm", "rms_norm"]
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n_axes = len(tuple(normalized_shape))
+
+    def _ln(a, *wb, n_axes, eps, has_w, has_b):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        # reduce in f32 for numeric parity with the reference's fused kernel
+        af = a.astype(jnp.float32) if a.dtype in (jnp.float16, jnp.bfloat16) else a
+        mean = jnp.mean(af, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(af - mean), axis=axes, keepdims=True)
+        out = (af - mean) * jax.lax.rsqrt(var + eps)
+        i = 0
+        if has_w:
+            out = out * wb[i].astype(out.dtype); i += 1
+        if has_b:
+            out = out + wb[i].astype(out.dtype)
+        return out.astype(a.dtype)
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return D.apply("layer_norm", _ln, tuple(args),
+                   {"n_axes": n_axes, "eps": float(epsilon),
+                    "has_w": weight is not None, "has_b": bias is not None})
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    def _rms(a, *w, eps, has_w):
+        af = a.astype(jnp.float32) if a.dtype in (jnp.float16, jnp.bfloat16) else a
+        ms = jnp.mean(jnp.square(af), axis=-1, keepdims=True)
+        out = af * jax.lax.rsqrt(ms + eps)
+        if has_w:
+            out = out * w[0].astype(out.dtype)
+        return out.astype(a.dtype)
+    args = (x, weight) if weight is not None else (x,)
+    return D.apply("rms_norm", _rms, args, {"eps": float(epsilon), "has_w": weight is not None})
+
+
+def _bn_stats_axes(ndim, data_format):
+    ch_axis = 1 if (data_format.startswith("NC") or data_format == "NCHW") else ndim - 1
+    axes = tuple(i for i in range(ndim) if i != ch_axis)
+    return ch_axis, axes
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-05, data_format="NCHW", use_global_stats=None,
+               name=None):
+    ch_axis, axes = _bn_stats_axes(x.ndim, data_format)
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        # compute batch stats (also used to update running buffers eagerly)
+        def _stats(a, axes):
+            m = jnp.mean(a, axis=axes)
+            v = jnp.var(a, axis=axes)
+            return m, v
+        from ...core import dispatch
+        with dispatch.no_grad():
+            bm, bv = D.apply("bn_stats", _stats, (x.detach(),), {"axes": axes})
+        if running_mean is not None:
+            running_mean._data = (momentum * running_mean._data
+                                  + (1.0 - momentum) * bm._data.astype(running_mean._data.dtype))
+            running_var._data = (momentum * running_var._data
+                                 + (1.0 - momentum) * bv._data.astype(running_var._data.dtype))
+
+        def _bn_train(a, *wb, axes, ch_axis, eps, has_w, has_b):
+            m = jnp.mean(a, axis=axes, keepdims=True)
+            v = jnp.var(a, axis=axes, keepdims=True)
+            out = (a - m) * jax.lax.rsqrt(v + eps)
+            shape = [1] * a.ndim
+            shape[ch_axis] = a.shape[ch_axis]
+            i = 0
+            if has_w:
+                out = out * wb[i].reshape(shape); i += 1
+            if has_b:
+                out = out + wb[i].reshape(shape)
+            return out
+        args = [x]
+        if weight is not None:
+            args.append(weight)
+        if bias is not None:
+            args.append(bias)
+        return D.apply("batch_norm_train", _bn_train, tuple(args),
+                       {"axes": axes, "ch_axis": ch_axis, "eps": float(epsilon),
+                        "has_w": weight is not None, "has_b": bias is not None})
+
+    def _bn_eval(a, rm, rv, *wb, ch_axis, eps, has_w, has_b):
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        out = (a - rm.reshape(shape)) * jax.lax.rsqrt(rv.reshape(shape) + eps)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape); i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+    args = [x, running_mean, running_var]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return D.apply("batch_norm_eval", _bn_eval, tuple(args),
+                   {"ch_axis": ch_axis, "eps": float(epsilon),
+                    "has_w": weight is not None, "has_b": bias is not None})
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i not in (0, ch_axis))
+
+    def _in(a, *wb, axes, ch_axis, eps, has_w, has_b):
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) * jax.lax.rsqrt(v + eps)
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape); i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return D.apply("instance_norm", _in, tuple(args),
+                   {"axes": axes, "ch_axis": ch_axis, "eps": float(eps),
+                    "has_w": weight is not None, "has_b": bias is not None})
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    channels_last = data_format.endswith("C") and data_format != "NC"
+
+    def _gn(a, *wb, g, eps, channels_last, has_w, has_b):
+        if channels_last:
+            a_t = jnp.moveaxis(a, -1, 1)
+        else:
+            a_t = a
+        n, c = a_t.shape[0], a_t.shape[1]
+        rest = a_t.shape[2:]
+        grouped = a_t.reshape(n, g, c // g, *rest)
+        axes = tuple(range(2, grouped.ndim))
+        m = jnp.mean(grouped, axis=axes, keepdims=True)
+        v = jnp.var(grouped, axis=axes, keepdims=True)
+        out = ((grouped - m) * jax.lax.rsqrt(v + eps)).reshape(a_t.shape)
+        shape = [1] * a_t.ndim
+        shape[1] = c
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape); i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        if channels_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return D.apply("group_norm", _gn, tuple(args),
+                   {"g": int(num_groups), "eps": float(epsilon),
+                    "channels_last": channels_last,
+                    "has_w": weight is not None, "has_b": bias is not None})
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def _lrn(a, size, alpha, beta, k, channels_last):
+        if channels_last:
+            a_t = jnp.moveaxis(a, -1, 1)
+        else:
+            a_t = a
+        sq = jnp.square(a_t)
+        c = a_t.shape[1]
+        pad_lo = (size - 1) // 2
+        pad_hi = size - 1 - pad_lo
+        padded = jnp.pad(sq, [(0, 0), (pad_lo, pad_hi)] + [(0, 0)] * (a_t.ndim - 2))
+        acc = jnp.zeros_like(a_t)
+        for i in range(size):
+            acc = acc + jax.lax.slice_in_dim(padded, i, i + c, axis=1)
+        div = jnp.power(k + alpha * acc / size, beta)
+        out = a_t / div
+        if channels_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return D.apply("local_response_norm", _lrn, (x,),
+                   {"size": int(size), "alpha": float(alpha), "beta": float(beta),
+                    "k": float(k), "channels_last": data_format.endswith("C")})

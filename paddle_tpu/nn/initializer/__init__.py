@@ -1,0 +1,198 @@
+"""Parameter initializers.
+
+Parity with /root/reference/python/paddle/nn/initializer/ (Constant, Normal,
+TruncatedNormal, Uniform, XavierNormal/Uniform, KaimingNormal/Uniform,
+Assign, Orthogonal, Dirac, calculate_gain).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core import random_state
+from ...core.dtype import convert_dtype
+from .attr import ParamAttr
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "Orthogonal", "Dirac", "calculate_gain", "set_global_initializer",
+    "ParamAttr",
+]
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    global _global_weight_init, _global_bias_init
+    _global_weight_init, _global_bias_init = weight_init, bias_init
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) < 2:
+        fan_in = fan_out = int(np.prod(shape)) if shape else 1
+    else:
+        receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+        # paddle convention for Linear: shape [in, out]
+        fan_in, fan_out = shape[0] * receptive, shape[1] * receptive
+        if len(shape) > 2:
+            # conv kernels: [out_c, in_c, *k]
+            fan_in, fan_out = shape[1] * receptive, shape[0] * receptive
+    return fan_in, fan_out
+
+
+def calculate_gain(nonlinearity, param=None):
+    table = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "conv1d_transpose": 1.0, "conv2d_transpose": 1.0,
+        "conv3d_transpose": 1.0, "tanh": 5.0 / 3, "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4,
+    }
+    if nonlinearity not in table:
+        raise ValueError(f"unsupported nonlinearity: {nonlinearity}")
+    return table[nonlinearity]
+
+
+class Initializer:
+    def __call__(self, shape, dtype="float32"):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype="float32"):
+        return jnp.full(tuple(shape), self.value, convert_dtype(dtype).np_dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype="float32"):
+        k = random_state.next_key()
+        dt = convert_dtype(dtype).np_dtype
+        return self.mean + self.std * jax.random.normal(k, tuple(shape), dt)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0, name=None):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype="float32"):
+        k = random_state.next_key()
+        dt = convert_dtype(dtype).np_dtype
+        lo = (self.a - self.mean) / self.std if self.std else self.a
+        hi = (self.b - self.mean) / self.std if self.std else self.b
+        return self.mean + self.std * jax.random.truncated_normal(k, lo, hi, tuple(shape), dt)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0, name=None):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype="float32"):
+        k = random_state.next_key()
+        dt = convert_dtype(dtype).np_dtype
+        return jax.random.uniform(k, tuple(shape), dt, self.low, self.high)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype="float32"):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        k = random_state.next_key()
+        return std * jax.random.normal(k, tuple(shape), convert_dtype(dtype).np_dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype="float32"):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        k = random_state.next_key()
+        return jax.random.uniform(k, tuple(shape), convert_dtype(dtype).np_dtype,
+                                  -limit, limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu", name=None):
+        self.fan_in, self.slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def __call__(self, shape, dtype="float32"):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.slope)
+        std = gain / math.sqrt(fi)
+        k = random_state.next_key()
+        return std * jax.random.normal(k, tuple(shape), convert_dtype(dtype).np_dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu", name=None):
+        self.fan_in, self.slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def __call__(self, shape, dtype="float32"):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        k = random_state.next_key()
+        return jax.random.uniform(k, tuple(shape), convert_dtype(dtype).np_dtype,
+                                  -limit, limit)
+
+
+class Assign(Initializer):
+    def __init__(self, value, name=None):
+        self.value = value
+
+    def __call__(self, shape, dtype="float32"):
+        from ...core.tensor import Tensor
+        v = self.value
+        if isinstance(v, Tensor):
+            v = np.asarray(v._data)
+        arr = jnp.asarray(np.asarray(v), convert_dtype(dtype).np_dtype)
+        return arr.reshape(tuple(shape))
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0, name=None):
+        self.gain = gain
+
+    def __call__(self, shape, dtype="float32"):
+        k = random_state.next_key()
+        return self.gain * jax.nn.initializers.orthogonal()(
+            k, tuple(shape), convert_dtype(dtype).np_dtype)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1, name=None):
+        self.groups = groups
+
+    def __call__(self, shape, dtype="float32"):
+        shape = tuple(shape)
+        arr = np.zeros(shape, convert_dtype(dtype).np_dtype)
+        out_c, in_c = shape[0], shape[1]
+        mins = min(out_c // self.groups, in_c)
+        centers = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for i in range(mins):
+                idx = (g * (out_c // self.groups) + i, i) + tuple(centers)
+                arr[idx] = 1.0
+        return jnp.asarray(arr)

@@ -1,0 +1,75 @@
+"""Activation layers.  Parity with /root/reference/python/paddle/nn/layer/activation.py."""
+from __future__ import annotations
+
+from .. import functional as F
+from ..initializer import Constant
+from ..initializer.attr import ParamAttr
+from .layers import Layer
+
+__all__ = ["CELU", "ELU", "GELU", "GLU", "Hardshrink", "Hardsigmoid", "Hardswish",
+           "Hardtanh", "LeakyReLU", "LogSigmoid", "LogSoftmax", "Maxout", "Mish",
+           "PReLU", "ReLU", "ReLU6", "RReLU", "SELU", "Sigmoid", "Silu",
+           "Softmax", "Softplus", "Softshrink", "Softsign", "Swish", "Tanh",
+           "Tanhshrink", "ThresholdedReLU"]
+
+
+def _mk(name, fn, params=()):
+    def __init__(self, *args, **kwargs):
+        Layer.__init__(self)
+        self._args = args
+        self._kwargs = {k: v for k, v in kwargs.items() if k != "name"}
+
+    def forward(self, x):
+        return fn(x, *self._args, **self._kwargs)
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+CELU = _mk("CELU", F.celu)
+ELU = _mk("ELU", F.elu)
+GELU = _mk("GELU", F.gelu)
+GLU = _mk("GLU", F.glu)
+Hardshrink = _mk("Hardshrink", F.hardshrink)
+Hardsigmoid = _mk("Hardsigmoid", F.hardsigmoid)
+Hardswish = _mk("Hardswish", F.hardswish)
+Hardtanh = _mk("Hardtanh", F.hardtanh)
+LeakyReLU = _mk("LeakyReLU", F.leaky_relu)
+LogSigmoid = _mk("LogSigmoid", F.log_sigmoid)
+LogSoftmax = _mk("LogSoftmax", F.log_softmax)
+Maxout = _mk("Maxout", F.maxout)
+Mish = _mk("Mish", F.mish)
+ReLU = _mk("ReLU", F.relu)
+ReLU6 = _mk("ReLU6", F.relu6)
+SELU = _mk("SELU", F.selu)
+Sigmoid = _mk("Sigmoid", F.sigmoid)
+Silu = _mk("Silu", F.silu)
+Softmax = _mk("Softmax", F.softmax)
+Softplus = _mk("Softplus", F.softplus)
+Softshrink = _mk("Softshrink", F.softshrink)
+Softsign = _mk("Softsign", F.softsign)
+Swish = _mk("Swish", F.swish)
+Tanh = _mk("Tanh", F.tanh)
+Tanhshrink = _mk("Tanhshrink", F.tanhshrink)
+ThresholdedReLU = _mk("ThresholdedReLU", F.thresholded_relu)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=0.125, upper=0.3333333, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            [num_parameters], attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)

@@ -1,0 +1,221 @@
+"""Normalization layers.
+
+Parity with /root/reference/python/paddle/nn/layer/norm.py (+RMSNorm from
+incubate fused_rms_norm).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from .. import functional as F
+from ..initializer import Constant
+from ..initializer.attr import ParamAttr
+from .layers import Layer
+
+__all__ = ["LayerNorm", "RMSNorm", "BatchNorm", "BatchNorm1D", "BatchNorm2D",
+           "BatchNorm3D", "SyncBatchNorm", "InstanceNorm1D", "InstanceNorm2D",
+           "InstanceNorm3D", "GroupNorm", "LocalResponseNorm", "SpectralNorm"]
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-05, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        self.weight = (None if weight_attr is False else self.create_parameter(
+            self._normalized_shape, attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=Constant(1.0)))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            self._normalized_shape, attr=ParamAttr._to_attr(bias_attr), is_bias=True))
+
+    def forward(self, x):
+        return F.layer_norm(x, self._normalized_shape, self.weight, self.bias,
+                            self._epsilon)
+
+    def extra_repr(self):
+        return f"normalized_shape={self._normalized_shape}, epsilon={self._epsilon}"
+
+
+class RMSNorm(Layer):
+    def __init__(self, hidden_size, epsilon=1e-6, weight_attr=None, name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            [hidden_size], attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=Constant(1.0))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self._epsilon)
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        self.weight = (None if weight_attr is False else self.create_parameter(
+            [num_features], attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=Constant(1.0)))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [num_features], attr=ParamAttr._to_attr(bias_attr), is_bias=True))
+        import jax.numpy as jnp
+        self.register_buffer("_mean", Tensor(jnp.zeros([num_features], jnp.float32)))
+        self.register_buffer("_variance", Tensor(jnp.ones([num_features], jnp.float32)))
+
+    def forward(self, x):
+        return F.batch_norm(x, self._mean, self._variance, self.weight, self.bias,
+                            training=self.training, momentum=self._momentum,
+                            epsilon=self._epsilon, data_format=self._data_format,
+                            use_global_stats=self._use_global_stats)
+
+    def extra_repr(self):
+        return f"num_features={self._num_features}, momentum={self._momentum}"
+
+
+class BatchNorm(_BatchNormBase):
+    pass
+
+
+class BatchNorm1D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05, weight_attr=None,
+                 bias_attr=None, data_format="NCL", use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr, bias_attr,
+                         data_format, use_global_stats)
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05, weight_attr=None,
+                 bias_attr=None, data_format="NCDHW", use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr, bias_attr,
+                         data_format, use_global_stats)
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica BN.  Under pjit/shard_map batch stats are computed over
+    the global batch automatically; eager single-process uses local stats."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        out = layer
+        if isinstance(layer, _BatchNormBase) and not isinstance(layer, SyncBatchNorm):
+            out = SyncBatchNorm(layer._num_features, layer._momentum, layer._epsilon,
+                                data_format=layer._data_format)
+            out.weight = layer.weight
+            out.bias = layer.bias
+            out._mean = layer._mean
+            out._variance = layer._variance
+        for name, sub in list(layer._sub_layers.items()):
+            out._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return out
+
+
+class _InstanceNormBase(Layer):
+    def __init__(self, num_features, epsilon=1e-05, momentum=0.9, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self.weight = (None if weight_attr is False else self.create_parameter(
+            [num_features], attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=Constant(1.0)))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [num_features], attr=ParamAttr._to_attr(bias_attr), is_bias=True))
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.weight, bias=self.bias,
+                               eps=self._epsilon, data_format=self._data_format)
+
+
+class InstanceNorm1D(_InstanceNormBase):
+    def __init__(self, num_features, epsilon=1e-05, momentum=0.9, weight_attr=None,
+                 bias_attr=None, data_format="NCL", name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr, bias_attr,
+                         data_format)
+
+
+class InstanceNorm2D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormBase):
+    def __init__(self, num_features, epsilon=1e-05, momentum=0.9, weight_attr=None,
+                 bias_attr=None, data_format="NCDHW", name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr, bias_attr,
+                         data_format)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-05, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self.weight = (None if weight_attr is False else self.create_parameter(
+            [num_channels], attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=Constant(1.0)))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [num_channels], attr=ParamAttr._to_attr(bias_attr), is_bias=True))
+
+    def forward(self, x):
+        return F.group_norm(x, self._num_groups, self._epsilon, self.weight,
+                            self.bias, self._data_format)
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=0.0001, beta=0.75, k=1.0, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.args = (size, alpha, beta, k, data_format)
+
+    def forward(self, x):
+        return F.local_response_norm(x, *self.args)
+
+
+class SpectralNorm(Layer):
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
+                 dtype="float32"):
+        super().__init__()
+        self._dim = dim
+        self._power_iters = power_iters
+        self._epsilon = epsilon
+        import jax.numpy as jnp
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        from ...core import random_state
+        import jax
+        self.weight_u = Tensor(jax.random.normal(random_state.next_key(), (h,), jnp.float32))
+        self.weight_v = Tensor(jax.random.normal(random_state.next_key(), (w,), jnp.float32))
+
+    def forward(self, weight):
+        from ...ops import manipulation as M
+        from ...ops import math as mm
+        from ...ops.linalg import norm as _vnorm
+        w = weight
+        if self._dim != 0:
+            perm = [self._dim] + [i for i in range(w.ndim) if i != self._dim]
+            w = M.transpose(w, perm)
+        h = w.shape[0]
+        w_mat = M.reshape(w, [h, -1])
+        u, v = self.weight_u, self.weight_v
+        for _ in range(self._power_iters):
+            v_new = mm.matmul(w_mat, u, transpose_x=True)
+            v = v_new / (_vnorm(v_new) + self._epsilon)
+            u_new = mm.matmul(w_mat, v)
+            u = u_new / (_vnorm(u_new) + self._epsilon)
+        self.weight_u._data = u.detach()._data
+        self.weight_v._data = v.detach()._data
+        sigma = mm.matmul(M.reshape(u, [1, -1]), mm.matmul(w_mat, M.reshape(v, [-1, 1])))
+        return weight / M.reshape(sigma, [])

@@ -1,0 +1,126 @@
+"""Operator library: public op namespace + Tensor method binding.
+
+The reference binds ~400 methods onto Tensor from C++
+(/root/reference/paddle/fluid/pybind/eager_method.cc) plus python-side math
+patches; here monkey_patch_tensor() attaches the same surface from the op
+modules.
+"""
+from __future__ import annotations
+
+from . import creation, indexing, linalg, logic, manipulation, math, random
+
+_MODULES = (math, manipulation, logic, linalg, creation, random)
+
+
+def _collect():
+    ns = {}
+    for mod in _MODULES:
+        for name in getattr(mod, "__all__", ()):
+            fn = getattr(mod, name, None)
+            if callable(fn):
+                ns.setdefault(name, fn)
+    return ns
+
+
+PUBLIC_OPS = _collect()
+
+
+def monkey_patch_tensor():
+    from ..core.tensor import Tensor
+
+    # Method surface: every public op whose first arg is a tensor.
+    skip = {"to_tensor", "meshgrid", "zeros", "ones", "full", "empty", "arange",
+            "linspace", "logspace", "eye", "tril_indices", "triu_indices",
+            "rand", "randn", "randint", "randperm", "uniform", "normal",
+            "standard_normal", "gaussian", "seed", "get_rng_state",
+            "set_rng_state", "broadcast_shape", "is_tensor", "assign",
+            "add_n", "einsum", "scatter_nd", "multi_dot", "vstack", "hstack",
+            "dstack", "broadcast_tensors", "complex_", "polar", "log_normal"}
+    for name, fn in PUBLIC_OPS.items():
+        if name in skip or name.startswith("_"):
+            continue
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+
+    # Aliases matching paddle Tensor-method names.
+    alias = {
+        "mod": math.mod, "floor_mod": math.mod, "pow": math.pow,
+        "abs": math.abs, "t": manipulation.transpose,
+        "add": math.add, "subtract": math.subtract, "multiply": math.multiply,
+        "divide": math.divide, "matmul": math.matmul, "dot": math.dot,
+        "unflatten": manipulation.unflatten,
+    }
+    for name, fn in alias.items():
+        setattr(Tensor, name, fn)
+
+    # Arithmetic dunders (and reflected). Matches the reference's
+    # math-op method binding in eager_math_op_patch.
+    def _rbin(fn):
+        def op(self, other):
+            return fn(other, self)
+        return op
+
+    Tensor.__add__ = math.add
+    Tensor.__radd__ = math.add
+    Tensor.__sub__ = math.subtract
+    Tensor.__rsub__ = _rbin(math.subtract)
+    Tensor.__mul__ = math.multiply
+    Tensor.__rmul__ = math.multiply
+    Tensor.__truediv__ = math.divide
+    Tensor.__rtruediv__ = _rbin(math.divide)
+    Tensor.__floordiv__ = math.floor_divide
+    Tensor.__rfloordiv__ = _rbin(math.floor_divide)
+    Tensor.__mod__ = math.mod
+    Tensor.__rmod__ = _rbin(math.mod)
+    Tensor.__pow__ = math.pow
+    Tensor.__rpow__ = _rbin(math.pow)
+    Tensor.__matmul__ = math.matmul
+    Tensor.__rmatmul__ = _rbin(math.matmul)
+    Tensor.__neg__ = math.neg
+    Tensor.__abs__ = math.abs
+    Tensor.__invert__ = logic.bitwise_not
+    Tensor.__and__ = logic.bitwise_and
+    Tensor.__or__ = logic.bitwise_or
+    Tensor.__xor__ = logic.bitwise_xor
+    Tensor.__eq__ = logic.equal
+    Tensor.__ne__ = logic.not_equal
+    Tensor.__lt__ = logic.less_than
+    Tensor.__le__ = logic.less_equal
+    Tensor.__gt__ = logic.greater_than
+    Tensor.__ge__ = logic.greater_equal
+    Tensor.__getitem__ = indexing.getitem
+    Tensor.__setitem__ = indexing.setitem
+
+    # In-place arithmetic: rebind storage (optimizers use _replace_data instead).
+    def _iop(fn):
+        def op(self, other):
+            out = fn(self, other)
+            self._data = out._data
+            self._grad_node = out._grad_node
+            self._output_index = out._output_index
+            return self
+        return op
+
+    Tensor.__iadd__ = _iop(math.add)
+    Tensor.__isub__ = _iop(math.subtract)
+    Tensor.__imul__ = _iop(math.multiply)
+    Tensor.__itruediv__ = _iop(math.divide)
+    Tensor.add_ = _iop(math.add)
+    Tensor.subtract_ = _iop(math.subtract)
+    Tensor.multiply_ = _iop(math.multiply)
+    Tensor.divide_ = _iop(math.divide)
+    Tensor.clip_ = lambda self, min=None, max=None, name=None: _inplace(self, math.clip(self, min, max))
+    Tensor.scale_ = lambda self, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None: \
+        _inplace(self, math.scale(self, scale, bias, bias_after_scale))
+    Tensor.zero_ = lambda self: _inplace(self, creation.zeros_like(self))
+    Tensor.fill_ = lambda self, value: _inplace(self, creation.full_like(self, value))
+    Tensor.exponential_ = random.exponential_
+    Tensor.uniform_ = random.uniform_
+    Tensor.normal_ = random.normal_
+
+
+def _inplace(t, out):
+    t._data = out._data
+    t._grad_node = out._grad_node
+    t._output_index = out._output_index
+    return t

@@ -1,0 +1,198 @@
+"""Tensor creation ops.
+
+Capability parity with /root/reference/python/paddle/tensor/creation.py,
+built directly on jnp; factories are cheap XLA constants so they bypass the
+autograd dispatcher (they never require grad at creation).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch as D
+from ..core.dtype import convert_dtype, to_jax_dtype
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "tril", "triu", "diag", "diagflat", "meshgrid", "assign", "clone",
+    "complex_", "polar", "tril_indices", "triu_indices",
+]
+
+
+def _shape_tuple(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def _dt(dtype, default="float32"):
+    return to_jax_dtype(convert_dtype(dtype if dtype is not None else default))
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape_tuple(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape_tuple(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = "bool"
+        elif isinstance(fill_value, int):
+            dtype = "int64"
+        else:
+            dtype = "float32"
+    return Tensor(jnp.full(_shape_tuple(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape_tuple(shape), _dt(dtype)))
+
+
+def _like_dt(x, dtype):
+    return to_jax_dtype(convert_dtype(dtype)) if dtype is not None else x._data.dtype
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros(x._data.shape, _like_dt(x, dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones(x._data.shape, _like_dt(x, dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(x._data.shape, fill_value, _like_dt(x, dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = val(start), val(end), val(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = ("int64" if all(isinstance(v, (int, np.integer)) for v in (start, end, step))
+                 else "float32")
+    return Tensor(jnp.arange(start, end, step, _dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.linspace(val(start), val(stop), int(val(num)), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.logspace(val(start), val(stop), int(val(num)), base=val(base),
+                               dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows),
+                          int(num_columns) if num_columns is not None else None,
+                          dtype=_dt(dtype)))
+
+
+def _tril(x, diagonal):
+    return jnp.tril(x, k=diagonal)
+
+
+def _triu(x, diagonal):
+    return jnp.triu(x, k=diagonal)
+
+
+def tril(x, diagonal=0, name=None):
+    return D.apply("tril", _tril, (x,), {"diagonal": int(diagonal)})
+
+
+def triu(x, diagonal=0, name=None):
+    return D.apply("triu", _triu, (x,), {"diagonal": int(diagonal)})
+
+
+def _diag(x, offset, padding_value):
+    if x.ndim == 1 and padding_value != 0:
+        d = jnp.diag(x, k=offset)
+        mask = jnp.eye(d.shape[0], d.shape[1], k=offset, dtype=bool)
+        return jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+    return jnp.diag(x, k=offset)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    return D.apply("diag", _diag, (x,), {"offset": int(offset),
+                                         "padding_value": padding_value})
+
+
+def diagflat(x, offset=0, name=None):
+    return D.apply("diagflat", lambda a, offset: jnp.diagflat(a, k=offset),
+                   (x,), {"offset": int(offset)})
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = jnp.meshgrid(*[a._data if isinstance(a, Tensor) else jnp.asarray(a)
+                          for a in args], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def _assign(x):
+    return x + jnp.zeros((), x.dtype) if jnp.issubdtype(x.dtype, jnp.number) else jnp.array(x)
+
+
+def assign(x, output=None):
+    if not isinstance(x, Tensor):
+        x = to_tensor(np.asarray(x))
+    out = D.apply("assign", lambda a: a * 1 if jnp.issubdtype(a.dtype, jnp.number) else jnp.copy(a), (x,))
+    if output is not None:
+        output._data = out._data
+        output._grad_node = out._grad_node
+        output._output_index = out._output_index
+        output.stop_gradient = out.stop_gradient
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+def _complex(real, imag):
+    return jax.lax.complex(real, imag)
+
+
+def complex_(real, imag, name=None):
+    return D.apply("complex", _complex, (real, imag))
+
+
+def polar(abs_t, angle, name=None):
+    return D.apply("polar", lambda a, b: jax.lax.complex(a * jnp.cos(b), a * jnp.sin(b)),
+                   (abs_t, angle))
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype)))

@@ -1,0 +1,117 @@
+"""__getitem__ / __setitem__ support.
+
+Analog of the reference's set_value/slice op family and eager __getitem__
+binding (/root/reference/paddle/fluid/pybind/eager_method.cc,
+python/paddle/base/variable_index.py).  Basic indices (ints/slices) are baked
+into the compiled executable; tensor indices are dynamic inputs; boolean masks
+are resolved to integer indices on host (dynamic output shapes cannot live
+under XLA), matching the reference's GPU sync behavior for bool indexing.
+"""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core import dispatch as D
+from ..core.tensor import Tensor
+
+__all__ = ["getitem", "setitem"]
+
+
+def _normalize_index(x, index):
+    if not isinstance(index, tuple):
+        index = (index,)
+    dynamic = []
+    spec = []
+    for e in index:
+        if isinstance(e, Tensor):
+            if e.dtype.name == "bool":
+                idx = np.nonzero(np.asarray(e._data))
+                for comp in idx:
+                    dynamic.append(jnp.asarray(comp))
+                    spec.append(("T",))
+            elif e.ndim == 0:
+                spec.append(("I", int(e.item())))
+            else:
+                dynamic.append(e)
+                spec.append(("T",))
+        elif isinstance(e, np.ndarray):
+            if e.dtype == np.bool_:
+                for comp in np.nonzero(e):
+                    dynamic.append(jnp.asarray(comp))
+                    spec.append(("T",))
+            else:
+                dynamic.append(jnp.asarray(e))
+                spec.append(("T",))
+        elif isinstance(e, builtins.slice):
+            def iv(v):
+                if v is None:
+                    return None
+                return int(v.item()) if isinstance(v, Tensor) else int(v)
+            spec.append(("S", iv(e.start), iv(e.stop), iv(e.step)))
+        elif e is Ellipsis:
+            spec.append(("E",))
+        elif e is None:
+            spec.append(("N",))
+        elif isinstance(e, bool):
+            spec.append(("B", e))
+        elif isinstance(e, (int, np.integer)):
+            spec.append(("I", int(e)))
+        elif isinstance(e, (list, tuple)):
+            arr = np.asarray(e)
+            if arr.dtype == np.bool_:
+                for comp in np.nonzero(arr):
+                    dynamic.append(jnp.asarray(comp))
+                    spec.append(("T",))
+            else:
+                dynamic.append(jnp.asarray(arr))
+                spec.append(("T",))
+        else:
+            raise TypeError(f"Unsupported index element: {e!r}")
+    return dynamic, tuple(spec)
+
+
+def _rebuild(idx_arrays, spec):
+    out = []
+    it = iter(idx_arrays)
+    for s in spec:
+        kind = s[0]
+        if kind == "T":
+            out.append(next(it))
+        elif kind == "S":
+            out.append(builtins.slice(s[1], s[2], s[3]))
+        elif kind == "E":
+            out.append(Ellipsis)
+        elif kind == "N":
+            out.append(None)
+        elif kind == "B":
+            out.append(s[1])
+        else:
+            out.append(s[1])
+    return tuple(out)
+
+
+def getitem(x, index):
+    dynamic, spec = _normalize_index(x, index)
+
+    def _impl(a, *idx_arrays, spec):
+        return a[_rebuild(idx_arrays, spec)]
+    return D.apply("getitem", _impl, (x, *dynamic), {"spec": spec})
+
+
+def setitem(x, index, value):
+    dynamic, spec = _normalize_index(x, index)
+    if not isinstance(value, Tensor):
+        value = Tensor(jnp.asarray(value, dtype=x._data.dtype))
+
+    def _impl(a, v, *idx_arrays, spec):
+        return a.at[_rebuild(idx_arrays, spec)].set(v.astype(a.dtype))
+    out = D.apply("setitem", _impl, (x, value, *dynamic), {"spec": spec})
+    x._data = out._data
+    x._grad_node = out._grad_node
+    x._output_index = out._output_index
+    if not out.stop_gradient:
+        x.stop_gradient = False
+    return x

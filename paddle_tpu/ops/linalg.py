@@ -1,0 +1,284 @@
+"""Linear-algebra ops.
+
+Parity with /root/reference/python/paddle/tensor/linalg.py (dispatching to
+phi lapack/cusolver kernels); here backed by jnp.linalg / lax.linalg which
+XLA lowers natively.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch as D
+from ..core.tensor import Tensor
+
+__all__ = [
+    "norm", "vector_norm", "matrix_norm", "p_norm", "cholesky", "cholesky_solve",
+    "qr", "svd", "svdvals", "inv", "solve", "lstsq", "lu", "lu_unpack", "eig",
+    "eigh", "eigvals", "eigvalsh", "matrix_power", "matrix_rank", "pinv", "det",
+    "slogdet", "triangular_solve", "cross", "cov", "corrcoef", "householder_product",
+    "matrix_exp", "cdist", "dist", "multi_dot", "tensordot", "pca_lowrank",
+]
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def _norm(a, p, axis, keepdim):
+        if p is None:
+            p = "fro" if (axis is None or isinstance(axis, tuple)) and a.ndim >= 2 else 2
+        if axis is None:
+            if p == "fro":
+                return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(a))))
+            return jnp.sum(jnp.abs(a) ** p) ** (1.0 / p) if p not in (np.inf, -np.inf) else (
+                jnp.max(jnp.abs(a)) if p == np.inf else jnp.min(jnp.abs(a)))
+        if isinstance(axis, tuple):
+            return jnp.linalg.norm(a, ord=p, axis=axis, keepdims=keepdim)
+        if p == "fro":
+            p = 2
+        if p == np.inf:
+            return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == -np.inf:
+            return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=axis, keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+    if isinstance(p, str) and p not in ("fro", "nuc"):
+        raise ValueError(f"unsupported norm order {p}")
+    ax = tuple(int(a) for a in axis) if isinstance(axis, (list, tuple)) else (
+        None if axis is None else int(axis))
+    pv = p if (p is None or isinstance(p, str)) else float(p)
+    return D.apply("p_norm", _norm, (x,), {"p": pv, "axis": ax, "keepdim": bool(keepdim)})
+
+
+p_norm = norm
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p, axis, keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return D.apply("matrix_norm",
+                   lambda a, p, axis, keepdim: jnp.linalg.norm(a, ord=p, axis=axis, keepdims=keepdim),
+                   (x,), {"p": p, "axis": tuple(axis), "keepdim": bool(keepdim)})
+
+
+def dist(x, y, p=2, name=None):
+    from . import math as _m
+    return norm(_m.subtract(x, y), p)
+
+
+def _simple(name, jfn, n_out=1):
+    def op(x, *args, **kwargs):
+        ts = (x,) + tuple(a for a in args if isinstance(a, Tensor))
+        return D.apply(name, jfn, ts)
+    op.__name__ = name
+    return op
+
+
+cholesky_impl = lambda a, upper: jnp.linalg.cholesky(a) if not upper else jnp.swapaxes(jnp.linalg.cholesky(a), -1, -2).conj()
+
+
+def cholesky(x, upper=False, name=None):
+    return D.apply("cholesky", cholesky_impl, (x,), {"upper": bool(upper)})
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def _impl(b, chol, upper):
+        return jax.scipy.linalg.cho_solve((chol, not upper), b)
+    return D.apply("cholesky_solve", _impl, (x, y), {"upper": bool(upper)})
+
+
+def qr(x, mode="reduced", name=None):
+    out = D.apply("qr", lambda a, mode: jnp.linalg.qr(a, mode=mode), (x,), {"mode": mode})
+    return out
+
+
+def svd(x, full_matrices=False, name=None):
+    return D.apply("svd",
+                   lambda a, fm: jnp.linalg.svd(a, full_matrices=fm),
+                   (x,), {"fm": bool(full_matrices)})
+
+
+def svdvals(x, name=None):
+    return D.apply("svdvals", lambda a: jnp.linalg.svd(a, compute_uv=False), (x,))
+
+
+def inv(x, name=None):
+    return D.apply("inv", jnp.linalg.inv, (x,))
+
+
+def solve(x, y, name=None):
+    return D.apply("solve", jnp.linalg.solve, (x, y))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def _impl(a, b, rcond):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    return D.apply("lstsq", _impl, (x, y), {"rcond": rcond})
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def _impl(a):
+        lu_mat, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_mat, (piv + 1).astype(jnp.int32)
+    out = D.apply("lu", _impl, (x,))
+    if get_infos:
+        return out[0], out[1], Tensor(jnp.zeros((), jnp.int32))
+    return out
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    def _impl(lu_mat, piv):
+        n = lu_mat.shape[-2]
+        L = jnp.tril(lu_mat, -1) + jnp.eye(n, lu_mat.shape[-1], dtype=lu_mat.dtype)
+        L = L[..., :, :builtins_min(lu_mat.shape[-2], lu_mat.shape[-1])]
+        U = jnp.triu(lu_mat)[..., :builtins_min(lu_mat.shape[-2], lu_mat.shape[-1]), :]
+        perm = jnp.arange(n)
+        def body(i, p):
+            j = piv[i] - 1
+            pi, pj = p[i], p[j]
+            p = p.at[i].set(pj).at[j].set(pi)
+            return p
+        perm = jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+        P = jnp.eye(n, dtype=lu_mat.dtype)[perm].T
+        return P, L, U
+    return D.apply("lu_unpack", _impl, (x, y))
+
+
+builtins_min = min
+
+
+def eig(x, name=None):
+    # TPU/XLA has no nonsymmetric eig; host fallback (same as reference CPU lapack).
+    a = np.asarray(x._data)
+    w, v = np.linalg.eig(a)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    a = np.asarray(x._data)
+    return Tensor(jnp.asarray(np.linalg.eigvals(a)))
+
+
+def eigh(x, UPLO="L", name=None):
+    return D.apply("eigh", lambda a, lower: tuple(jnp.linalg.eigh(a, symmetrize_input=True)),
+                   (x,), {"lower": UPLO == "L"})
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return D.apply("eigvalsh", lambda a: jnp.linalg.eigvalsh(a), (x,))
+
+
+def matrix_power(x, n, name=None):
+    return D.apply("matrix_power", lambda a, n: jnp.linalg.matrix_power(a, n),
+                   (x,), {"n": int(n)})
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    def _impl(a, tol, hermitian):
+        sv = jnp.abs(jnp.linalg.eigvalsh(a)) if hermitian else jnp.linalg.svd(a, compute_uv=False)
+        t = tol if tol is not None else (
+            jnp.max(sv, axis=-1, keepdims=True) * builtins_max(a.shape[-2], a.shape[-1])
+            * jnp.finfo(a.dtype).eps)
+        return jnp.sum((sv > t).astype(jnp.int64), axis=-1)
+    tv = tol.item() if isinstance(tol, Tensor) else tol
+    return D.apply("matrix_rank", _impl, (x,), {"tol": tv, "hermitian": bool(hermitian)})
+
+
+builtins_max = max
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return D.apply("pinv", lambda a, rcond, hermitian: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian),
+                   (x,), {"rcond": float(rcond) if not isinstance(rcond, Tensor) else rcond.item(),
+                          "hermitian": bool(hermitian)})
+
+
+def det(x, name=None):
+    return D.apply("det", jnp.linalg.det, (x,))
+
+
+def slogdet(x, name=None):
+    def _impl(a):
+        sign, logabs = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logabs])
+    return D.apply("slogdet", _impl, (x,))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def _impl(a, b, upper, transpose, unit):
+        return jax.scipy.linalg.solve_triangular(a, b, trans=1 if transpose else 0,
+                                                 lower=not upper, unit_diagonal=unit)
+    return D.apply("triangular_solve", _impl, (x, y),
+                   {"upper": bool(upper), "transpose": bool(transpose),
+                    "unit": bool(unitriangular)})
+
+
+def cross(x, y, axis=9, name=None):
+    def _impl(a, b, axis):
+        if axis == 9:
+            axis = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=axis)
+    return D.apply("cross", _impl, (x, y), {"axis": int(axis) if axis is not None else 9})
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    def _impl(a, rowvar, ddof):
+        return jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0)
+    return D.apply("cov", _impl, (x,), {"rowvar": bool(rowvar), "ddof": bool(ddof)})
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return D.apply("corrcoef", lambda a, rowvar: jnp.corrcoef(a, rowvar=rowvar),
+                   (x,), {"rowvar": bool(rowvar)})
+
+
+def householder_product(x, tau, name=None):
+    def _impl(a, tau):
+        m, n = a.shape[-2], a.shape[-1]
+        out = jnp.eye(m, dtype=a.dtype)
+        for i in range(n):
+            v = jnp.concatenate([jnp.zeros((i,), a.dtype), jnp.ones((1,), a.dtype), a[i + 1:, i]])
+            H = jnp.eye(m, dtype=a.dtype) - tau[i] * jnp.outer(v, v)
+            out = out @ H
+        return out[:, :n]
+    return D.apply("householder_product", _impl, (x, tau))
+
+
+def matrix_exp(x, name=None):
+    return D.apply("matrix_exp", jax.scipy.linalg.expm, (x,))
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    def _impl(a, b, p):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 0.0)
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+    return D.apply("cdist", _impl, (x, y), {"p": float(p)})
+
+
+def multi_dot(x, name=None):
+    def _impl(*arrs):
+        return jnp.linalg.multi_dot(arrs)
+    return D.apply("multi_dot", _impl, tuple(x))
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, Tensor):
+        axes = axes.tolist()
+    ax = axes if isinstance(axes, int) else tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in axes)
+    return D.apply("tensordot", lambda a, b, axes: jnp.tensordot(a, b, axes=axes),
+                   (x, y), {"axes": ax})
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    def _impl(a, q, center):
+        if center:
+            a = a - jnp.mean(a, axis=-2, keepdims=True)
+        u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+        return u[..., :q], s[..., :q], jnp.swapaxes(vt, -1, -2)[..., :q]
+    qv = q if q is not None else min(x.shape[-2:])
+    return D.apply("pca_lowrank", _impl, (x,), {"q": int(qv), "center": bool(center)})

@@ -1,0 +1,91 @@
+"""Comparison / logical / bitwise ops.
+
+Parity with /root/reference/python/paddle/tensor/logic.py.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import dispatch as D
+from ..core.tensor import Tensor
+
+__all__ = [
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_not", "bitwise_xor", "bitwise_invert",
+    "isclose", "allclose", "equal_all", "is_tensor", "is_empty", "is_complex",
+    "is_floating_point", "is_integer",
+]
+
+
+def _binop(name, jfn):
+    def op(x, y, name=None):
+        return D.apply(op_name, jfn, (x, y))
+    op_name = name
+    op.__name__ = name
+    return op
+
+
+equal = _binop("equal", jnp.equal)
+not_equal = _binop("not_equal", jnp.not_equal)
+less_than = _binop("less_than", jnp.less)
+less_equal = _binop("less_equal", jnp.less_equal)
+greater_than = _binop("greater_than", jnp.greater)
+greater_equal = _binop("greater_equal", jnp.greater_equal)
+logical_and = _binop("logical_and", jnp.logical_and)
+logical_or = _binop("logical_or", jnp.logical_or)
+logical_xor = _binop("logical_xor", jnp.logical_xor)
+bitwise_and = _binop("bitwise_and", jnp.bitwise_and)
+bitwise_or = _binop("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _binop("bitwise_xor", jnp.bitwise_xor)
+
+
+def logical_not(x, name=None):
+    return D.apply("logical_not", jnp.logical_not, (x,))
+
+
+def bitwise_not(x, name=None):
+    return D.apply("bitwise_not", jnp.bitwise_not, (x,))
+
+
+bitwise_invert = bitwise_not
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return D.apply("isclose",
+                   lambda a, b, rtol, atol, equal_nan: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                   (x, y), {"rtol": float(rtol), "atol": float(atol), "equal_nan": bool(equal_nan)})
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return D.apply("allclose",
+                   lambda a, b, rtol, atol, equal_nan: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                   (x, y), {"rtol": float(rtol), "atol": float(atol), "equal_nan": bool(equal_nan)})
+
+
+def equal_all(x, y, name=None):
+    return D.apply("equal_all",
+                   lambda a, b: jnp.asarray(a.shape == b.shape and bool(jnp.all(a == b))
+                                            if a.shape == b.shape else False)
+                   if a.shape != b.shape else jnp.all(a == b),
+                   (x, y))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_complex(x):
+    return x.dtype.is_complex
+
+
+def is_floating_point(x):
+    return x.dtype.is_floating_point
+
+
+def is_integer(x):
+    return x.dtype.is_integer

@@ -1,0 +1,713 @@
+"""Shape / indexing / rearrangement ops.
+
+Capability parity with /root/reference/python/paddle/tensor/manipulation.py
+and search.py; pure-jnp kernels through the eager dispatcher.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch as D
+from ..core.dtype import convert_dtype, to_jax_dtype
+from ..core.tensor import Tensor
+
+__all__ = [
+    "reshape", "reshape_", "transpose", "moveaxis", "swapaxes", "concat",
+    "stack", "vstack", "hstack", "dstack", "split", "vsplit", "hsplit",
+    "dsplit", "tensor_split", "chunk", "squeeze", "squeeze_", "unsqueeze",
+    "unsqueeze_", "flatten", "flip", "fliplr", "flipud", "roll", "tile",
+    "expand", "expand_as", "broadcast_to", "broadcast_tensors", "gather",
+    "gather_nd", "scatter", "scatter_", "scatter_nd", "scatter_nd_add",
+    "index_select", "index_sample", "index_add", "index_put", "index_fill",
+    "masked_select", "masked_fill", "masked_scatter", "take_along_axis",
+    "put_along_axis", "unbind", "repeat_interleave", "unique",
+    "unique_consecutive", "topk", "sort", "argsort", "searchsorted", "where",
+    "nonzero", "one_hot", "unstack", "strided_slice", "slice", "crop",
+    "pad", "shard_index", "rotate90", "as_complex", "as_real", "view",
+    "view_as", "atleast_1d", "atleast_2d", "atleast_3d", "select_scatter",
+    "diagonal_scatter", "flatten_", "tolist", "unflatten", "bucketize",
+]
+
+
+def _t(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _shape_static(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.tolist())
+    out = []
+    for s in shape:
+        out.append(int(s.item()) if isinstance(s, Tensor) else int(s))
+    return tuple(out)
+
+
+def reshape(x, shape, name=None):
+    return D.apply("reshape", lambda a, shape: jnp.reshape(a, shape),
+                   (x,), {"shape": _shape_static(shape)})
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._data, x._grad_node, x._output_index = out._data, out._grad_node, out._output_index
+    return x
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return x.astype(shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def transpose(x, perm=None, name=None):
+    if perm is None:
+        perm = tuple(reversed(range(x.ndim)))
+    return D.apply("transpose", lambda a, perm: jnp.transpose(a, perm),
+                   (x,), {"perm": tuple(int(p) for p in perm)})
+
+
+def moveaxis(x, source, destination, name=None):
+    s = tuple(source) if isinstance(source, (list, tuple)) else (source,)
+    d = tuple(destination) if isinstance(destination, (list, tuple)) else (destination,)
+    return D.apply("moveaxis", lambda a, s, d: jnp.moveaxis(a, s, d),
+                   (x,), {"s": s, "d": d})
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return D.apply("swapaxes", lambda a, i, j: jnp.swapaxes(a, i, j),
+                   (x,), {"i": int(axis1), "j": int(axis2)})
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return D.apply("concat", lambda *arrs, axis: jnp.concatenate(arrs, axis=axis),
+                   tuple(x), {"axis": int(axis)})
+
+
+def stack(x, axis=0, name=None):
+    return D.apply("stack", lambda *arrs, axis: jnp.stack(arrs, axis=axis),
+                   tuple(x), {"axis": int(axis)})
+
+
+def vstack(x, name=None):
+    return D.apply("vstack", lambda *arrs: jnp.vstack(arrs), tuple(x))
+
+
+def hstack(x, name=None):
+    return D.apply("hstack", lambda *arrs: jnp.hstack(arrs), tuple(x))
+
+
+def dstack(x, name=None):
+    return D.apply("dstack", lambda *arrs: jnp.dstack(arrs), tuple(x))
+
+
+def _split_sections(x_shape, num_or_sections, axis):
+    axis = axis % len(x_shape)
+    n = x_shape[axis]
+    if isinstance(num_or_sections, int):
+        assert n % num_or_sections == 0, (
+            f"dim {n} not divisible into {num_or_sections} sections")
+        sizes = [n // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        neg = [i for i, s in enumerate(sizes) if s < 0]
+        if neg:
+            known = builtins_sum(s for s in sizes if s >= 0)
+            sizes[neg[0]] = n - known
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+    return sizes, offsets, axis
+
+
+builtins_sum = sum
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    sizes, offsets, axis = _split_sections(tuple(x.shape), num_or_sections, axis)
+
+    def _split(a, sizes, offsets, axis):
+        return tuple(jax.lax.slice_in_dim(a, o, o + s, axis=axis)
+                     for s, o in zip(sizes, offsets))
+    out = D.apply("split", _split, (x,),
+                  {"sizes": tuple(sizes), "offsets": tuple(offsets), "axis": axis})
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    n = x.shape[axis % x.ndim]
+    if isinstance(num_or_indices, int):
+        base, extra = divmod(n, num_or_indices)
+        sizes = [base + (1 if i < extra else 0) for i in range(num_or_indices)]
+    else:
+        idx = [0] + [int(i) for i in num_or_indices] + [n]
+        sizes = [idx[i + 1] - idx[i] for i in range(len(idx) - 1)]
+    return split(x, sizes, axis)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def squeeze(x, axis=None, name=None):
+    if axis is None:
+        ax = None
+    elif isinstance(axis, (list, tuple)):
+        ax = tuple(int(a) for a in axis if x.shape[int(a)] == 1)
+    else:
+        ax = (int(axis),) if x.shape[int(axis)] == 1 else ()
+        if ax == ():
+            return D.apply("identity", lambda a: a * 1 if jnp.issubdtype(a.dtype, jnp.number) else a, (x,))
+    return D.apply("squeeze", lambda a, axis: jnp.squeeze(a, axis=axis),
+                   (x,), {"axis": ax})
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._data, x._grad_node, x._output_index = out._data, out._grad_node, out._output_index
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    ax = tuple(int(a) for a in axis) if isinstance(axis, (list, tuple)) else (int(axis),)
+    return D.apply("unsqueeze", lambda a, axis: jnp.expand_dims(a, axis=axis),
+                   (x,), {"axis": ax})
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._data, x._grad_node, x._output_index = out._data, out._grad_node, out._output_index
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = x.ndim
+    if nd == 0:
+        return reshape(x, [1])
+    start = start_axis % nd
+    stop = stop_axis % nd
+    shape = tuple(x.shape)
+    new_shape = shape[:start] + (-1,) + shape[stop + 1:]
+    return reshape(x, new_shape)
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    out = flatten(x, start_axis, stop_axis)
+    x._data, x._grad_node, x._output_index = out._data, out._grad_node, out._output_index
+    return x
+
+
+def unflatten(x, axis, shape, name=None):
+    axis = axis % x.ndim
+    cur = tuple(x.shape)
+    return reshape(x, cur[:axis] + tuple(shape) + cur[axis + 1:])
+
+
+def flip(x, axis, name=None):
+    ax = tuple(int(a) for a in axis) if isinstance(axis, (list, tuple)) else (int(axis),)
+    return D.apply("flip", lambda a, axis: jnp.flip(a, axis=axis), (x,), {"axis": ax})
+
+
+def fliplr(x, name=None):
+    return flip(x, 1)
+
+
+def flipud(x, name=None):
+    return flip(x, 0)
+
+
+rotate90 = None  # placeholder; rot90 lives in math
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = tuple(int(s) for s in shifts) if isinstance(shifts, (list, tuple)) else int(shifts)
+    ax = (tuple(int(a) for a in axis) if isinstance(axis, (list, tuple))
+          else (None if axis is None else int(axis)))
+    return D.apply("roll", lambda a, shifts, axis: jnp.roll(a, shifts, axis=axis),
+                   (x,), {"shifts": sh, "axis": ax})
+
+
+def tile(x, repeat_times, name=None):
+    if isinstance(repeat_times, Tensor):
+        repeat_times = repeat_times.tolist()
+    return D.apply("tile", lambda a, reps: jnp.tile(a, reps),
+                   (x,), {"reps": tuple(int(r) for r in repeat_times)})
+
+
+def expand(x, shape, name=None):
+    tgt = _shape_static(shape)
+    cur = tuple(x.shape)
+    full = []
+    pad = len(tgt) - len(cur)
+    for i, s in enumerate(tgt):
+        if s == -1:
+            full.append(cur[i - pad] if i >= pad else 1)
+        else:
+            full.append(s)
+    return D.apply("expand", lambda a, shape: jnp.broadcast_to(a, shape),
+                   (x,), {"shape": tuple(full)})
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    shape = np.broadcast_shapes(*[tuple(t.shape) for t in inputs])
+    return [broadcast_to(t, shape) for t in inputs]
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+
+    def _gather(a, idx, axis):
+        if idx.ndim == 0:
+            idx = idx[None]
+        return jnp.take(a, idx, axis=axis)
+    return D.apply("gather", _gather, (x, index), {"axis": int(axis)})
+
+
+def gather_nd(x, index, name=None):
+    def _gather_nd(a, idx):
+        nd = idx.shape[-1]
+        out = a[tuple(jnp.moveaxis(idx, -1, 0))]
+        return out
+    return D.apply("gather_nd", _gather_nd, (x, index))
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def _scatter(a, idx, upd, overwrite):
+        if idx.ndim == 2 and idx.shape[1] == 1:
+            idx = idx[:, 0]
+        if overwrite:
+            return a.at[idx].set(upd)
+        zeroed = a.at[idx].set(jnp.zeros_like(upd))
+        return zeroed.at[idx].add(upd)
+    return D.apply("scatter", _scatter, (x, index, updates),
+                   {"overwrite": bool(overwrite)})
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._data, x._grad_node, x._output_index = out._data, out._grad_node, out._output_index
+    return x
+
+
+def scatter_nd(index, updates, shape, name=None):
+    def _scatter_nd(idx, upd, shape):
+        zeros = jnp.zeros(shape, upd.dtype)
+        return zeros.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+    return D.apply("scatter_nd", _scatter_nd, (index, updates),
+                   {"shape": _shape_static(shape)})
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def _scatter_nd_add(a, idx, upd):
+        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+    return D.apply("scatter_nd_add", _scatter_nd_add, (x, index, updates))
+
+
+def index_select(x, index, axis=0, name=None):
+    return D.apply("index_select", lambda a, idx, axis: jnp.take(a, idx, axis=axis),
+                   (x, index), {"axis": int(axis)})
+
+
+def index_sample(x, index, name=None):
+    return D.apply("index_sample",
+                   lambda a, idx: jnp.take_along_axis(a, idx, axis=1),
+                   (x, index))
+
+
+def index_add(x, index, axis, value, name=None):
+    def _index_add(a, idx, v, axis):
+        return jnp.apply_along_axis  # placeholder, replaced below
+    def _impl(a, idx, v, axis):
+        a_m = jnp.moveaxis(a, axis, 0)
+        v_m = jnp.moveaxis(v, axis, 0)
+        out = a_m.at[idx].add(v_m)
+        return jnp.moveaxis(out, 0, axis)
+    return D.apply("index_add", _impl, (x, index, value), {"axis": int(axis)})
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idxs = tuple(indices)
+
+    def _index_put(a, v, *idx, accumulate):
+        if accumulate:
+            return a.at[idx].add(v)
+        return a.at[idx].set(v)
+    return D.apply("index_put", _index_put, (x, value) + idxs,
+                   {"accumulate": bool(accumulate)})
+
+
+def index_fill(x, index, axis, value, name=None):
+    def _impl(a, idx, axis, value):
+        a_m = jnp.moveaxis(a, axis, 0)
+        out = a_m.at[idx].set(jnp.asarray(value, a.dtype))
+        return jnp.moveaxis(out, 0, axis)
+    if isinstance(value, Tensor):
+        value = value.item()
+    return D.apply("index_fill", _impl, (x, index), {"axis": int(axis), "value": value})
+
+
+def masked_select(x, mask, name=None):
+    # Dynamic output size: host-sync path (same as reference GPU sync).
+    a, m = np.asarray(_t(x)), np.asarray(_t(mask))
+    return Tensor(jnp.asarray(a[m]))
+
+
+def masked_fill(x, mask, value, name=None):
+    if isinstance(value, Tensor):
+        return D.apply("masked_fill_t",
+                       lambda a, m, v: jnp.where(m, v.astype(a.dtype), a),
+                       (x, mask, value))
+    return D.apply("masked_fill",
+                   lambda a, m, value: jnp.where(m, jnp.asarray(value, a.dtype), a),
+                   (x, mask), {"value": value})
+
+
+def masked_scatter(x, mask, value, name=None):
+    def _ms(a, m, v):
+        flat_m = m.ravel()
+        pos = jnp.cumsum(flat_m.astype(jnp.int32)) - 1
+        gathered = v.ravel()[jnp.clip(pos, 0, v.size - 1)]
+        return jnp.where(flat_m, gathered, a.ravel()).reshape(a.shape)
+    return D.apply("masked_scatter", _ms, (x, mask, value))
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    def _tala(a, idx, axis):
+        return jnp.take_along_axis(a, idx, axis=axis)
+    return D.apply("take_along_axis", _tala, (arr, indices), {"axis": int(axis)})
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True,
+                   broadcast=True, name=None):
+    def _pala(a, idx, v, axis, reduce):
+        if jnp.ndim(v) == 0:
+            v = jnp.broadcast_to(v, idx.shape)
+        v = v.astype(a.dtype)
+        dims = [1] * a.ndim
+        moved = jnp.moveaxis(a, axis, 0)
+        idx_m = jnp.moveaxis(idx, axis, 0)
+        v_m = jnp.moveaxis(jnp.broadcast_to(v, idx.shape), axis, 0)
+        # build full index grids
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in idx_m.shape], indexing="ij")
+        grids[0] = idx_m
+        if reduce == "assign":
+            out = moved.at[tuple(grids)].set(v_m)
+        elif reduce in ("add", "sum"):
+            out = moved.at[tuple(grids)].add(v_m)
+        elif reduce in ("mul", "multiply"):
+            out = moved.at[tuple(grids)].multiply(v_m)
+        elif reduce == "amax":
+            out = moved.at[tuple(grids)].max(v_m)
+        elif reduce == "amin":
+            out = moved.at[tuple(grids)].min(v_m)
+        else:
+            raise ValueError(f"unknown reduce {reduce}")
+        return jnp.moveaxis(out, 0, axis)
+    return D.apply("put_along_axis", _pala, (arr, indices, values),
+                   {"axis": int(axis), "reduce": reduce})
+
+
+def unbind(input, axis=0, name=None):
+    n = input.shape[axis % input.ndim]
+
+    def _unbind(a, axis, n):
+        return tuple(jnp.squeeze(jax.lax.slice_in_dim(a, i, i + 1, axis=axis), axis)
+                     for i in range(n))
+    out = D.apply("unbind", _unbind, (input,), {"axis": int(axis), "n": n})
+    return list(out)
+
+
+unstack = unbind
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        return D.apply("repeat_interleave_t",
+                       lambda a, r, axis, total: jnp.repeat(a, r, axis=axis,
+                                                            total_repeat_length=total),
+                       (x, repeats),
+                       {"axis": None if axis is None else int(axis),
+                        "total": int(np.asarray(repeats._data).sum())})
+    return D.apply("repeat_interleave",
+                   lambda a, repeats, axis: jnp.repeat(a, repeats, axis=axis),
+                   (x,), {"repeats": int(repeats), "axis": None if axis is None else int(axis)})
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # Dynamic output shape: host path.
+    a = np.asarray(_t(x))
+    res = np.unique(a, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    a = np.asarray(_t(x))
+    if axis is None:
+        a = a.ravel()
+        axis = 0
+    mask = np.ones(a.shape[axis], dtype=bool)
+    sl = [slice(None)] * a.ndim
+    if a.shape[axis] > 1:
+        d = np.diff(a, axis=axis)
+        other = tuple(i for i in range(a.ndim) if i != axis)
+        mask[1:] = np.any(d != 0, axis=other) if a.ndim > 1 else (d != 0)
+    sl[axis] = mask
+    out = a[tuple(sl)]
+    outs = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(mask) - 1
+        outs.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.nonzero(mask)[0]
+        counts = np.diff(np.concatenate([idx, [a.shape[axis]]]))
+        outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def _topk(a, k, axis, largest):
+        if largest:
+            vals, idx = jax.lax.top_k(jnp.moveaxis(a, axis, -1), k)
+        else:
+            vals, idx = jax.lax.top_k(-jnp.moveaxis(a, axis, -1), k)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis).astype(jnp.int64)
+    return D.apply("topk", _topk, (x,),
+                   {"k": int(k), "axis": int(axis), "largest": bool(largest)})
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def _sort(a, axis, descending):
+        out = jnp.sort(a, axis=axis, stable=True)
+        return jnp.flip(out, axis=axis) if descending else out
+    return D.apply("sort", _sort, (x,), {"axis": int(axis), "descending": bool(descending)})
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def _argsort(a, axis, descending):
+        out = jnp.argsort(a, axis=axis, stable=True)
+        return (jnp.flip(out, axis=axis) if descending else out).astype(jnp.int64)
+    return D.apply("argsort", _argsort, (x,), {"axis": int(axis), "descending": bool(descending)})
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def _ss(seq, v, right):
+        side = "right" if right else "left"
+        if seq.ndim == 1:
+            return jnp.searchsorted(seq, v, side=side).astype(jnp.int64)
+        return jax.vmap(lambda s, vv: jnp.searchsorted(s, vv, side=side))(
+            seq.reshape(-1, seq.shape[-1]), v.reshape(-1, v.shape[-1])
+        ).reshape(v.shape).astype(jnp.int64)
+    return D.apply("searchsorted", _ss, (sorted_sequence, values), {"right": bool(right)})
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return D.apply("where", lambda c, a, b: jnp.where(c, a, b), (condition, x, y))
+
+
+def nonzero(x, as_tuple=False, name=None):
+    a = np.asarray(_t(x))
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i[:, None].astype(np.int64))) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def one_hot(x, num_classes, name=None):
+    return D.apply("one_hot",
+                   lambda a, n: jax.nn.one_hot(a, n, dtype=jnp.float32),
+                   (x,), {"n": int(num_classes)})
+
+
+def slice(input, axes, starts, ends, name=None):
+    def norm(v):
+        if isinstance(v, Tensor):
+            return [int(i) for i in v.tolist()]
+        return [int(i.item()) if isinstance(i, Tensor) else int(i) for i in v]
+    axes_l, starts_l, ends_l = [int(a) for a in axes], norm(starts), norm(ends)
+
+    def _slice(a, axes, starts, ends):
+        idx = [builtins_slice(None)] * a.ndim
+        for ax, st, en in zip(axes, starts, ends):
+            idx[ax] = builtins_slice(st, en)
+        return a[tuple(idx)]
+    return D.apply("slice", _slice, (input,),
+                   {"axes": tuple(axes_l), "starts": tuple(starts_l), "ends": tuple(ends_l)})
+
+
+import builtins as _builtins
+builtins_slice = _builtins.slice
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def norm(v):
+        return tuple(int(i.item()) if isinstance(i, Tensor) else int(i) for i in v)
+
+    def _ss(a, axes, starts, ends, strides):
+        idx = [builtins_slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = builtins_slice(st, en, sd)
+        return a[tuple(idx)]
+    return D.apply("strided_slice", _ss, (x,),
+                   {"axes": tuple(int(a) for a in axes), "starts": norm(starts),
+                    "ends": norm(ends), "strides": norm(strides)})
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _shape_static(shape)
+    if offsets is None:
+        offsets = [0] * x.ndim
+    offsets = tuple(int(o.item()) if isinstance(o, Tensor) else int(o) for o in offsets)
+    full_shape = tuple(x.shape[i] if s == -1 else s for i, s in enumerate(shape))
+
+    def _crop(a, shape, offsets):
+        return jax.lax.dynamic_slice(a, offsets, shape)
+    return D.apply("crop", _crop, (x,), {"shape": full_shape, "offsets": offsets})
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle conv-style: pad applies to last len(pad)//2 spatial dims,
+        # ordered last-dim-first pairs
+        k = len(pad) // 2
+        width = [(0, 0)] * nd
+        if data_format.endswith("C"):  # NHWC / NLC / NDHWC: spatial dims start at 1
+            spatial = list(range(1, 1 + k))
+        else:  # NCHW / NCL / NCDHW: spatial dims after channel
+            spatial = list(range(nd - k, nd))
+        for i, dim in enumerate(spatial):
+            width[dim] = (pad[2 * i], pad[2 * i + 1])
+
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+
+    def _pad(a, width, jmode, value):
+        if jmode == "constant":
+            return jnp.pad(a, width, mode=jmode, constant_values=value)
+        return jnp.pad(a, width, mode=jmode)
+    return D.apply("pad", _pad, (x,),
+                   {"width": tuple(width), "jmode": jmode, "value": value})
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1, name=None):
+    def _shard(a, index_num, nshards, shard_id, ignore_value):
+        size = (index_num + nshards - 1) // nshards
+        lo, hi = shard_id * size, (shard_id + 1) * size
+        in_range = (a >= lo) & (a < hi)
+        return jnp.where(in_range, a - lo, ignore_value)
+    return D.apply("shard_index", _shard, (input,),
+                   {"index_num": int(index_num), "nshards": int(nshards),
+                    "shard_id": int(shard_id), "ignore_value": int(ignore_value)})
+
+
+def as_complex(x, name=None):
+    return D.apply("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), (x,))
+
+
+def as_real(x, name=None):
+    return D.apply("as_real", lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), (x,))
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [reshape(t, [1]) if t.ndim == 0 else t for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = []
+    for t in inputs:
+        if t.ndim == 0:
+            outs.append(reshape(t, [1, 1]))
+        elif t.ndim == 1:
+            outs.append(reshape(t, [1, -1]))
+        else:
+            outs.append(t)
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = []
+    for t in inputs:
+        t2 = atleast_2d(t)
+        outs.append(unsqueeze(t2, -1) if t2.ndim == 2 else t2)
+    return outs[0] if len(outs) == 1 else outs
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def _impl(a, v, axis, index):
+        moved = jnp.moveaxis(a, axis, 0)
+        out = moved.at[index].set(v.astype(a.dtype))
+        return jnp.moveaxis(out, 0, axis)
+    return D.apply("select_scatter", _impl, (x, values), {"axis": int(axis), "index": int(index)})
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def _impl(a, b, offset, axis1, axis2):
+        n = builtins_min(a.shape[axis1], a.shape[axis2])
+        i = jnp.arange(b.shape[-1])
+        rows = i - builtins_min(offset, 0) * 0 + (0 if offset >= 0 else -offset)
+        cols = i + (offset if offset >= 0 else 0)
+        a_m = jnp.moveaxis(jnp.moveaxis(a, axis1, 0), axis2 if axis2 > axis1 else axis2 + 1, 1)
+        out = a_m.at[rows, cols].set(jnp.moveaxis(b, -1, 0))
+        out = jnp.moveaxis(jnp.moveaxis(out, 1, axis2 if axis2 > axis1 else axis2 + 1), 0, axis1)
+        return out
+    return D.apply("diagonal_scatter", _impl, (x, y),
+                   {"offset": int(offset), "axis1": int(axis1), "axis2": int(axis2)})
+
+
+builtins_min = min
+
+
+def tolist(x):
+    return x.tolist()

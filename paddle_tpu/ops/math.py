@@ -1,0 +1,645 @@
+"""Math / reduction / elementwise ops.
+
+Capability parity with /root/reference/python/paddle/tensor/math.py (and the
+phi kernels those dispatch to); every op is a pure jnp function executed as a
+cached XLA executable via the eager dispatcher.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch as D
+from ..core.dtype import convert_dtype, to_jax_dtype
+from ..core.tensor import Tensor
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
+    "pow", "float_power", "matmul", "dot", "mm", "bmm", "inner", "outer", "kron",
+    "scale", "abs", "neg", "exp", "expm1", "log", "log2", "log10", "log1p",
+    "sqrt", "rsqrt", "square", "sin", "cos", "tan", "asin", "acos", "atan",
+    "sinh", "cosh", "asinh", "acosh", "atanh", "atan2", "tanh", "floor", "ceil",
+    "round", "trunc", "frac", "sign", "sgn", "reciprocal", "clip", "maximum",
+    "minimum", "fmax", "fmin", "sum", "nansum", "mean", "nanmean", "prod",
+    "max", "min", "amax", "amin", "argmax", "argmin", "cumsum", "cumprod",
+    "cummax", "cummin", "logsumexp", "logcumsumexp", "all", "any", "erf",
+    "erfinv", "isnan", "isinf", "isfinite", "nan_to_num", "add_n", "addmm",
+    "lerp", "deg2rad", "rad2deg", "gcd", "lcm", "diff", "angle", "conj",
+    "real", "imag", "trace", "diagonal", "heaviside", "rot90", "histogram",
+    "bincount", "multiply_", "stanh", "logaddexp", "logit", "i0", "i1",
+    "digamma", "lgamma", "gammaln", "hypot", "copysign", "ldexp", "frexp",
+    "count_nonzero", "broadcast_shape", "increment", "einsum", "renorm",
+    "log_normalize", "reduce_as", "isposinf", "isneginf", "isreal", "signbit",
+    "nextafter", "take", "vander", "combinations", "bitwise_left_shift",
+    "bitwise_right_shift", "std", "var", "median", "nanmedian", "quantile",
+    "nanquantile", "mode", "kthvalue", "numel",
+]
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+# ---------------- binary elementwise ----------------
+
+def _binop(name, jfn):
+    def op(x, y, name=None):
+        return D.apply(op_name, jfn, (x, y))
+    op_name = name
+    op.__name__ = name
+    return op
+
+
+add = _binop("add", jnp.add)
+subtract = _binop("subtract", jnp.subtract)
+multiply = _binop("multiply", jnp.multiply)
+divide = _binop("divide", lambda x, y: jnp.true_divide(x, y))
+floor_divide = _binop("floor_divide", jnp.floor_divide)
+remainder = _binop("remainder", jnp.remainder)
+mod = remainder
+maximum = _binop("maximum", jnp.maximum)
+minimum = _binop("minimum", jnp.minimum)
+fmax = _binop("fmax", jnp.fmax)
+fmin = _binop("fmin", jnp.fmin)
+atan2 = _binop("atan2", jnp.arctan2)
+logaddexp = _binop("logaddexp", jnp.logaddexp)
+hypot = _binop("hypot", jnp.hypot)
+copysign = _binop("copysign", jnp.copysign)
+nextafter = _binop("nextafter", jnp.nextafter)
+heaviside = _binop("heaviside", jnp.heaviside)
+gcd = _binop("gcd", jnp.gcd)
+lcm = _binop("lcm", jnp.lcm)
+ldexp = _binop("ldexp", lambda x, y: jnp.ldexp(x, y.astype(jnp.int32)))
+bitwise_left_shift = _binop("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = _binop("bitwise_right_shift", jnp.right_shift)
+
+
+def pow(x, y, name=None):
+    return D.apply("pow", jnp.power, (x, y))
+
+
+def float_power(x, y, name=None):
+    return D.apply("float_power", lambda a, b: jnp.power(a.astype(jnp.float64), b), (x, y))
+
+
+# ---------------- matmul family ----------------
+
+def _matmul(x, y, transpose_x, transpose_y):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return D.apply("matmul", _matmul, (x, y),
+                   {"transpose_x": bool(transpose_x), "transpose_y": bool(transpose_y)})
+
+
+def mm(input, mat2, name=None):
+    return D.apply("matmul", _matmul, (input, mat2),
+                   {"transpose_x": False, "transpose_y": False})
+
+
+bmm = mm
+
+
+def dot(x, y, name=None):
+    return D.apply("dot", lambda a, b: jnp.sum(a * b, axis=-1), (x, y))
+
+
+def inner(x, y, name=None):
+    return D.apply("inner", jnp.inner, (x, y))
+
+
+def outer(x, y, name=None):
+    return D.apply("outer", lambda a, b: jnp.outer(a, b), (x, y))
+
+
+def kron(x, y, name=None):
+    return D.apply("kron", jnp.kron, (x, y))
+
+
+def _addmm(input, x, y, beta, alpha):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return D.apply("addmm", _addmm, (input, x, y), {"beta": float(beta), "alpha": float(alpha)})
+
+
+def einsum(equation, *operands):
+    ops = operands[0] if len(operands) == 1 and isinstance(operands[0], (list, tuple)) else operands
+    return D.apply("einsum", lambda *arrs, equation: jnp.einsum(equation, *arrs),
+                   tuple(ops), {"equation": equation})
+
+
+# ---------------- unary elementwise ----------------
+
+def _unop(name, jfn):
+    def op(x, name=None):
+        return D.apply(op_name, jfn, (x,))
+    op_name = name
+    op.__name__ = name
+    return op
+
+
+abs = _unop("abs", jnp.abs)
+neg = _unop("neg", jnp.negative)
+exp = _unop("exp", jnp.exp)
+expm1 = _unop("expm1", jnp.expm1)
+log = _unop("log", jnp.log)
+log2 = _unop("log2", jnp.log2)
+log10 = _unop("log10", jnp.log10)
+log1p = _unop("log1p", jnp.log1p)
+sqrt = _unop("sqrt", jnp.sqrt)
+rsqrt = _unop("rsqrt", lambda x: jax.lax.rsqrt(x))
+square = _unop("square", jnp.square)
+sin = _unop("sin", jnp.sin)
+cos = _unop("cos", jnp.cos)
+tan = _unop("tan", jnp.tan)
+asin = _unop("asin", jnp.arcsin)
+acos = _unop("acos", jnp.arccos)
+atan = _unop("atan", jnp.arctan)
+sinh = _unop("sinh", jnp.sinh)
+cosh = _unop("cosh", jnp.cosh)
+asinh = _unop("asinh", jnp.arcsinh)
+acosh = _unop("acosh", jnp.arccosh)
+atanh = _unop("atanh", jnp.arctanh)
+tanh = _unop("tanh", jnp.tanh)
+floor = _unop("floor", jnp.floor)
+ceil = _unop("ceil", jnp.ceil)
+round = _unop("round", jnp.round)
+trunc = _unop("trunc", jnp.trunc)
+frac = _unop("frac", lambda x: x - jnp.trunc(x))
+sign = _unop("sign", jnp.sign)
+sgn = sign
+reciprocal = _unop("reciprocal", jnp.reciprocal)
+erf = _unop("erf", jax.scipy.special.erf)
+erfinv = _unop("erfinv", jax.scipy.special.erfinv)
+isnan = _unop("isnan", jnp.isnan)
+isinf = _unop("isinf", jnp.isinf)
+isfinite = _unop("isfinite", jnp.isfinite)
+isposinf = _unop("isposinf", jnp.isposinf)
+isneginf = _unop("isneginf", jnp.isneginf)
+isreal = _unop("isreal", jnp.isreal)
+signbit = _unop("signbit", jnp.signbit)
+deg2rad = _unop("deg2rad", jnp.deg2rad)
+rad2deg = _unop("rad2deg", jnp.rad2deg)
+angle = _unop("angle", jnp.angle)
+conj = _unop("conj", jnp.conj)
+real = _unop("real", jnp.real)
+imag = _unop("imag", jnp.imag)
+i0 = _unop("i0", jnp.i0)
+i1 = _unop("i1", lambda x: jax.scipy.special.i1(x))
+digamma = _unop("digamma", jax.scipy.special.digamma)
+lgamma = _unop("lgamma", jax.scipy.special.gammaln)
+gammaln = lgamma
+logit_ = None
+
+
+def logit(x, eps=None, name=None):
+    def _logit(a, eps):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(a / (1.0 - a))
+    return D.apply("logit", _logit, (x,), {"eps": eps})
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return D.apply("stanh", lambda a, sa, sb: sb * jnp.tanh(sa * a), (x,),
+                   {"sa": float(scale_a), "sb": float(scale_b)})
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def _scale(a, s, b, after):
+        return a * s + b if after else (a + b) * s
+    if isinstance(scale, Tensor):
+        scale = scale.item()
+    out = D.apply("scale", _scale, (x,),
+                  {"s": float(scale), "b": float(bias), "after": bool(bias_after_scale)})
+    return out
+
+
+def clip(x, min=None, max=None, name=None):
+    def _clip(a, mn, mx):
+        return jnp.clip(a, mn, mx)
+    mn = min.item() if isinstance(min, Tensor) else min
+    mx = max.item() if isinstance(max, Tensor) else max
+    return D.apply("clip", _clip, (x,), {"mn": mn, "mx": mx})
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return D.apply("nan_to_num",
+                   lambda a, nan, posinf, neginf: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+                   (x,), {"nan": nan, "posinf": posinf, "neginf": neginf})
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, (int, float)):
+        return D.apply("lerp", lambda a, b, w: a + w * (b - a), (x, y),
+                       {"w": float(weight)})
+    return D.apply("lerp3", lambda a, b, w: a + w * (b - a), (x, y, weight))
+
+
+def increment(x, value=1.0, name=None):
+    out = D.apply("increment", lambda a, v: a + jnp.asarray(v, a.dtype), (x,), {"v": value})
+    x._data = out._data
+    x._grad_node = out._grad_node
+    x._output_index = out._output_index
+    return x
+
+
+def multiply_(x, y, name=None):
+    out = multiply(x, y)
+    x._data = out._data
+    x._grad_node = out._grad_node
+    x._output_index = out._output_index
+    return x
+
+
+# ---------------- reductions ----------------
+
+def _sum_impl(x, axis, keepdim, dtype):
+    dt = np.dtype(dtype) if dtype is not None else None
+    if dt is None and jnp.issubdtype(x.dtype, jnp.bool_):
+        dt = jnp.int64
+    return jnp.sum(x, axis=axis, keepdims=keepdim, dtype=dt)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    dt = str(to_jax_dtype(convert_dtype(dtype))) if dtype is not None else None
+    return D.apply("sum", _sum_impl, (x,),
+                   {"axis": _axis(axis), "keepdim": bool(keepdim), "dtype": dt})
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return D.apply("nansum",
+                   lambda a, axis, keepdim: jnp.nansum(a, axis=axis, keepdims=keepdim),
+                   (x,), {"axis": _axis(axis), "keepdim": bool(keepdim)})
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return D.apply("mean", lambda a, axis, keepdim: jnp.mean(a, axis=axis, keepdims=keepdim),
+                   (x,), {"axis": _axis(axis), "keepdim": bool(keepdim)})
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return D.apply("nanmean", lambda a, axis, keepdim: jnp.nanmean(a, axis=axis, keepdims=keepdim),
+                   (x,), {"axis": _axis(axis), "keepdim": bool(keepdim)})
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return D.apply("prod", lambda a, axis, keepdim: jnp.prod(a, axis=axis, keepdims=keepdim),
+                   (x,), {"axis": _axis(axis), "keepdim": bool(keepdim)})
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return D.apply("max", lambda a, axis, keepdim: jnp.max(a, axis=axis, keepdims=keepdim),
+                   (x,), {"axis": _axis(axis), "keepdim": bool(keepdim)})
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return D.apply("min", lambda a, axis, keepdim: jnp.min(a, axis=axis, keepdims=keepdim),
+                   (x,), {"axis": _axis(axis), "keepdim": bool(keepdim)})
+
+
+amax = max
+amin = min
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return D.apply("argmax",
+                   lambda a, axis, keepdim: jnp.argmax(a, axis=axis, keepdims=keepdim).astype(jnp.int64),
+                   (x,), {"axis": None if axis is None else int(axis), "keepdim": bool(keepdim)})
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return D.apply("argmin",
+                   lambda a, axis, keepdim: jnp.argmin(a, axis=axis, keepdims=keepdim).astype(jnp.int64),
+                   (x,), {"axis": None if axis is None else int(axis), "keepdim": bool(keepdim)})
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return D.apply("all", lambda a, axis, keepdim: jnp.all(a, axis=axis, keepdims=keepdim),
+                   (x,), {"axis": _axis(axis), "keepdim": bool(keepdim)})
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return D.apply("any", lambda a, axis, keepdim: jnp.any(a, axis=axis, keepdims=keepdim),
+                   (x,), {"axis": _axis(axis), "keepdim": bool(keepdim)})
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return D.apply("logsumexp",
+                   lambda a, axis, keepdim: jax.scipy.special.logsumexp(a, axis=axis, keepdims=keepdim),
+                   (x,), {"axis": _axis(axis), "keepdim": bool(keepdim)})
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return D.apply("count_nonzero",
+                   lambda a, axis, keepdim: jnp.count_nonzero(a, axis=axis, keepdims=keepdim).astype(jnp.int64),
+                   (x,), {"axis": _axis(axis), "keepdim": bool(keepdim)})
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return D.apply("std",
+                   lambda a, axis, ddof, keepdim: jnp.std(a, axis=axis, ddof=ddof, keepdims=keepdim),
+                   (x,), {"axis": _axis(axis), "ddof": 1 if unbiased else 0, "keepdim": bool(keepdim)})
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return D.apply("var",
+                   lambda a, axis, ddof, keepdim: jnp.var(a, axis=axis, ddof=ddof, keepdims=keepdim),
+                   (x,), {"axis": _axis(axis), "ddof": 1 if unbiased else 0, "keepdim": bool(keepdim)})
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def _median(a, axis, keepdim, mode):
+        if mode == "avg":
+            return jnp.median(a, axis=axis, keepdims=keepdim)
+        n = a.shape[axis] if axis is not None else a.size
+        k = (n - 1) // 2
+        sorted_a = jnp.sort(a, axis=axis) if axis is not None else jnp.sort(a.ravel())
+        out = jnp.take(sorted_a, jnp.asarray([k]),
+                       axis=axis if axis is not None else 0)
+        if not keepdim or axis is None:
+            out = jnp.squeeze(out, axis=axis if axis is not None else 0)
+        return out
+    return D.apply("median", _median, (x,),
+                   {"axis": None if axis is None else int(axis), "keepdim": bool(keepdim),
+                    "mode": mode})
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return D.apply("nanmedian",
+                   lambda a, axis, keepdim: jnp.nanmedian(a, axis=axis, keepdims=keepdim),
+                   (x,), {"axis": _axis(axis), "keepdim": bool(keepdim)})
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    def _q(a, q, axis, keepdim, interpolation):
+        return jnp.quantile(a, jnp.asarray(q), axis=axis, keepdims=keepdim,
+                            method=interpolation)
+    qq = tuple(q) if isinstance(q, (list, tuple)) else float(q)
+    return D.apply("quantile", _q, (x,),
+                   {"q": qq, "axis": _axis(axis), "keepdim": bool(keepdim),
+                    "interpolation": interpolation})
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    qq = tuple(q) if isinstance(q, (list, tuple)) else float(q)
+    return D.apply("nanquantile",
+                   lambda a, q, axis, keepdim: jnp.nanquantile(a, jnp.asarray(q), axis=axis, keepdims=keepdim),
+                   (x,), {"q": qq, "axis": _axis(axis), "keepdim": bool(keepdim)})
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def _mode(a, axis, keepdim):
+        sorted_a = jnp.sort(a, axis=axis)
+        idx_a = jnp.argsort(a, axis=axis)
+        n = a.shape[axis]
+        ax = axis % a.ndim
+        shape = [n if i == ax else 1 for i in range(a.ndim)]
+        pos = jnp.arange(n).reshape(shape)
+        # run-start positions: first element of each run of equal values
+        first = jnp.take(sorted_a, jnp.asarray([0]), axis=ax)
+        is_start = jnp.concatenate(
+            [jnp.ones_like(first, dtype=bool),
+             jnp.diff(sorted_a, axis=ax) != 0], axis=ax)
+        # segmented run length: position - position of containing run's start + 1
+        last_start = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(is_start, pos, -1), axis=ax)
+        run_len = pos - last_start + 1
+        best = jnp.argmax(run_len, axis=ax, keepdims=True)
+        vals = jnp.take_along_axis(sorted_a, best, axis=ax)
+        idxs = jnp.take_along_axis(idx_a, best, axis=ax)
+        if not keepdim:
+            vals, idxs = vals.squeeze(ax), idxs.squeeze(ax)
+        return vals, idxs.astype(jnp.int64)
+    return D.apply("mode", _mode, (x,), {"axis": int(axis), "keepdim": bool(keepdim)})
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def _kth(a, k, axis, keepdim):
+        sorted_a = jnp.sort(a, axis=axis)
+        idx_a = jnp.argsort(a, axis=axis)
+        sel = jnp.asarray([k - 1])
+        vals = jnp.take(sorted_a, sel, axis=axis)
+        idxs = jnp.take(idx_a, sel, axis=axis)
+        if not keepdim:
+            vals, idxs = vals.squeeze(axis), idxs.squeeze(axis)
+        return vals, idxs.astype(jnp.int64)
+    return D.apply("kthvalue", _kth, (x,), {"k": int(k), "axis": int(axis), "keepdim": bool(keepdim)})
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, jnp.int64))
+
+
+# ---------------- scans ----------------
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def _cumsum(a, axis):
+        if axis is None:
+            return jnp.cumsum(a.ravel())
+        return jnp.cumsum(a, axis=axis)
+    return D.apply("cumsum", _cumsum, (x,), {"axis": None if axis is None else int(axis)})
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    def _cumprod(a, axis):
+        if axis is None:
+            return jnp.cumprod(a.ravel())
+        return jnp.cumprod(a, axis=axis)
+    return D.apply("cumprod", _cumprod, (x,), {"axis": None if dim is None else int(dim)})
+
+
+def _cum_extreme(fn):
+    def impl(a, axis):
+        vals = fn.accumulate(a, axis)
+        return vals
+    return impl
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def _cummax(a, axis):
+        if axis is None:
+            a = a.ravel()
+            axis = 0
+        vals = jax.lax.associative_scan(jnp.maximum, a, axis=axis)
+        n = a.shape[axis]
+        ar = jnp.arange(n).reshape([-1 if i == (axis % a.ndim) else 1 for i in range(a.ndim)])
+        eq = a == vals
+        idx = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, ar, -1), axis=axis)
+        return vals, idx.astype(jnp.int64)
+    return D.apply("cummax", _cummax, (x,), {"axis": None if axis is None else int(axis)})
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def _cummin(a, axis):
+        if axis is None:
+            a = a.ravel()
+            axis = 0
+        vals = jax.lax.associative_scan(jnp.minimum, a, axis=axis)
+        n = a.shape[axis]
+        ar = jnp.arange(n).reshape([-1 if i == (axis % a.ndim) else 1 for i in range(a.ndim)])
+        eq = a == vals
+        idx = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, ar, -1), axis=axis)
+        return vals, idx.astype(jnp.int64)
+    return D.apply("cummin", _cummin, (x,), {"axis": None if axis is None else int(axis)})
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def _lcse(a, axis):
+        if axis is None:
+            a = a.ravel()
+            axis = 0
+        return jax.lax.associative_scan(jnp.logaddexp, a, axis=axis)
+    return D.apply("logcumsumexp", _lcse, (x,), {"axis": None if axis is None else int(axis)})
+
+
+# ---------------- misc ----------------
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    def _add_n(*arrs):
+        out = arrs[0]
+        for a in arrs[1:]:
+            out = out + a
+        return out
+    return D.apply("add_n", _add_n, tuple(inputs))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [x]
+    has_prepend = prepend is not None
+    has_append = append is not None
+    if has_prepend:
+        args.append(prepend)
+    if has_append:
+        args.append(append)
+
+    def _diff(*arrs, n, axis, has_prepend, has_append):
+        a = arrs[0]
+        i = 1
+        pre = app = None
+        if has_prepend:
+            pre = arrs[i]; i += 1
+        if has_append:
+            app = arrs[i]
+        return jnp.diff(a, n=n, axis=axis, prepend=pre, append=app)
+    return D.apply("diff", _diff, tuple(args),
+                   {"n": int(n), "axis": int(axis), "has_prepend": has_prepend,
+                    "has_append": has_append})
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return D.apply("trace",
+                   lambda a, offset, axis1, axis2: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+                   (x,), {"offset": int(offset), "axis1": int(axis1), "axis2": int(axis2)})
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return D.apply("diagonal",
+                   lambda a, offset, axis1, axis2: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+                   (x,), {"offset": int(offset), "axis1": int(axis1), "axis2": int(axis2)})
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return D.apply("rot90", lambda a, k, axes: jnp.rot90(a, k=k, axes=axes),
+                   (x,), {"k": int(k), "axes": tuple(axes)})
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    def _hist(a, bins, mn, mx, density):
+        if mn == 0 and mx == 0:
+            mn, mx = jnp.min(a), jnp.max(a)
+        h, _ = jnp.histogram(a, bins=bins, range=(mn, mx), density=density)
+        return h if density else h.astype(jnp.int64)
+    return D.apply("histogram", _hist, (input,),
+                   {"bins": int(bins), "mn": min, "mx": max, "density": bool(density)})
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    if weights is None:
+        return D.apply("bincount",
+                       lambda a, minlength: jnp.bincount(a, minlength=minlength,
+                                                         length=None).astype(jnp.int64),
+                       (x,), {"minlength": int(minlength)})
+    return D.apply("bincount_w",
+                   lambda a, w, minlength: jnp.bincount(a, weights=w, minlength=minlength),
+                   (x, weights), {"minlength": int(minlength)})
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def _renorm(a, p, axis, max_norm):
+        dims = tuple(i for i in range(a.ndim) if i != axis)
+        norms = jnp.sum(jnp.abs(a) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return a * factor
+    return D.apply("renorm", _renorm, (x,),
+                   {"p": float(p), "axis": int(axis), "max_norm": float(max_norm)})
+
+
+def log_normalize(x, axis=-1, name=None):
+    return D.apply("log_normalize",
+                   lambda a, axis: a - jax.scipy.special.logsumexp(a, axis=axis, keepdims=True),
+                   (x,), {"axis": int(axis)})
+
+
+def reduce_as(x, target, name=None):
+    def _reduce_as(a, tgt):
+        extra = a.ndim - tgt.ndim
+        axes = tuple(range(extra)) + tuple(
+            i + extra for i, s in enumerate(tgt.shape) if s == 1 and a.shape[i + extra] != 1
+        )
+        out = jnp.sum(a, axis=axes, keepdims=False)
+        return out.reshape(tgt.shape)
+    return D.apply("reduce_as", _reduce_as, (x, target))
+
+
+def take(x, index, mode="raise", name=None):
+    def _take(a, idx, mode):
+        flat = a.ravel()
+        n = flat.shape[0]
+        if mode == "wrap":
+            idx = jnp.mod(idx, n)
+        elif mode == "clip":
+            idx = jnp.clip(idx, -n, n - 1)
+        idx = jnp.where(idx < 0, idx + n, idx)
+        return flat[idx]
+    return D.apply("take", _take, (x, index), {"mode": mode})
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return D.apply("vander",
+                   lambda a, n, increasing: jnp.vander(a, N=n, increasing=increasing),
+                   (x,), {"n": None if n is None else int(n), "increasing": bool(increasing)})
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+    n = x.shape[0]
+    idx = (itertools.combinations_with_replacement(range(n), r) if with_replacement
+           else itertools.combinations(range(n), r))
+    idx = np.asarray(list(idx), dtype=np.int64)
+    if idx.size == 0:
+        return Tensor(jnp.zeros((0, r), x._data.dtype))
+    from .manipulation import index_select
+    flat = index_select(x, Tensor(jnp.asarray(idx.ravel())), axis=0)
+    from .manipulation import reshape
+    return reshape(flat, [-1, r])

@@ -1,0 +1,209 @@
+"""Random sampling ops.
+
+Parity with /root/reference/python/paddle/tensor/random.py, built on JAX's
+counter-based PRNG: the global generator hands each op a fresh fold of the
+root key, so results are reproducible under paddle_tpu.seed() and safe under
+async dispatch (no hidden mutable state on device).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch as D
+from ..core import random_state
+from ..core.dtype import convert_dtype, to_jax_dtype
+from ..core.tensor import Tensor
+
+__all__ = [
+    "seed", "get_rng_state", "set_rng_state", "rand", "randn", "randint",
+    "randint_like", "uniform", "normal", "standard_normal", "gaussian",
+    "randperm", "bernoulli", "poisson", "multinomial", "exponential_",
+    "binomial", "standard_gamma", "log_normal", "cauchy_", "geometric_",
+    "uniform_", "normal_",
+]
+
+
+def seed(value):
+    random_state.seed(value)
+    return value
+
+
+def get_rng_state():
+    return random_state.get_rng_state()
+
+
+def set_rng_state(state):
+    random_state.set_rng_state(state)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _dt(dtype, default="float32"):
+    return to_jax_dtype(convert_dtype(dtype if dtype is not None else default))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = random_state.next_key()
+    return D.apply("uniform",
+                   lambda k, shape, dtype, mn, mx: jax.random.uniform(
+                       k, shape, np.dtype(dtype), mn, mx),
+                   (key,), {"shape": _shape(shape), "dtype": str(_dt(dtype)),
+                            "mn": float(min), "mx": float(max)})
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    key = random_state.next_key()
+    return D.apply("standard_normal",
+                   lambda k, shape, dtype: jax.random.normal(k, shape, np.dtype(dtype)),
+                   (key,), {"shape": _shape(shape), "dtype": str(_dt(dtype))})
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        key = random_state.next_key()
+        m = mean if isinstance(mean, Tensor) else jnp.asarray(float(mean))
+        s = std if isinstance(std, Tensor) else jnp.asarray(float(std))
+        return D.apply("normal_t",
+                       lambda k, m, s: m + s * jax.random.normal(
+                           k, jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s)),
+                           jnp.result_type(m, s) if jnp.issubdtype(jnp.result_type(m, s), jnp.floating) else jnp.float32),
+                       (key, m, s))
+    out = standard_normal(shape if shape is not None else [1])
+    from . import math as _m
+    return _m.add(_m.scale(out, float(std)), float(mean))
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    key = random_state.next_key()
+    return D.apply("gaussian",
+                   lambda k, shape, dtype, mean, std: mean + std * jax.random.normal(
+                       k, shape, np.dtype(dtype)),
+                   (key,), {"shape": _shape(shape), "dtype": str(_dt(dtype)),
+                            "mean": float(mean), "std": float(std)})
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    g = gaussian(shape if shape is not None else [1], float(mean), float(std))
+    from . import math as _m
+    return _m.exp(g)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    key = random_state.next_key()
+    return D.apply("randint",
+                   lambda k, shape, dtype, lo, hi: jax.random.randint(
+                       k, shape, lo, hi, np.dtype(dtype)),
+                   (key,), {"shape": _shape(shape), "dtype": str(_dt(dtype, "int64")),
+                            "lo": int(low), "hi": int(high)})
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, x.shape, dtype or x.dtype.name)
+
+
+def randperm(n, dtype="int64", name=None):
+    key = random_state.next_key()
+    return D.apply("randperm",
+                   lambda k, n, dtype: jax.random.permutation(k, n).astype(np.dtype(dtype)),
+                   (key,), {"n": int(n), "dtype": str(_dt(dtype, "int64"))})
+
+
+def bernoulli(x, p=None, name=None):
+    key = random_state.next_key()
+    return D.apply("bernoulli",
+                   lambda k, probs: jax.random.bernoulli(k, probs).astype(probs.dtype),
+                   (key, x))
+
+
+def poisson(x, name=None):
+    key = random_state.next_key()
+    return D.apply("poisson",
+                   lambda k, lam: jax.random.poisson(k, lam).astype(lam.dtype),
+                   (key, x))
+
+
+def binomial(count, prob, name=None):
+    key = random_state.next_key()
+    return D.apply("binomial",
+                   lambda k, n, p: jax.random.binomial(k, n.astype(jnp.float32),
+                                                       p.astype(jnp.float32)).astype(jnp.int64),
+                   (key, count, prob))
+
+
+def standard_gamma(x, name=None):
+    key = random_state.next_key()
+    return D.apply("standard_gamma",
+                   lambda k, alpha: jax.random.gamma(k, alpha),
+                   (key, x))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = random_state.next_key()
+    return D.apply("multinomial",
+                   lambda k, probs, n, replace: jax.random.choice(
+                       k, probs.shape[-1], shape=(probs.shape[0], n) if probs.ndim == 2 else (n,),
+                       replace=replace,
+                       p=None if probs.ndim == 2 else probs / jnp.sum(probs)
+                   ).astype(jnp.int64) if probs.ndim == 1 else
+                   jnp.stack([jax.random.choice(jax.random.fold_in(k, i), probs.shape[-1],
+                                                shape=(n,), replace=replace,
+                                                p=probs[i] / jnp.sum(probs[i])).astype(jnp.int64)
+                              for i in range(probs.shape[0])]),
+                   (key, x), {"n": int(num_samples), "replace": bool(replacement)})
+
+
+def exponential_(x, lam=1.0, name=None):
+    key = random_state.next_key()
+    out = D.apply("exponential",
+                  lambda k, a, lam: jax.random.exponential(k, a.shape, a.dtype) / lam,
+                  (key, x), {"lam": float(lam)})
+    x._data = out._data
+    return x
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    key = random_state.next_key()
+    out = D.apply("cauchy",
+                  lambda k, a, loc, scale: loc + scale * jax.random.cauchy(k, a.shape, a.dtype),
+                  (key, x), {"loc": float(loc), "scale": float(scale)})
+    x._data = out._data
+    return x
+
+
+def geometric_(x, probs, name=None):
+    key = random_state.next_key()
+    out = D.apply("geometric",
+                  lambda k, a, probs: jax.random.geometric(k, probs, a.shape).astype(a.dtype),
+                  (key, x), {"probs": float(probs)})
+    x._data = out._data
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    out = uniform(x.shape, x.dtype, min, max)
+    x._data = out._data
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    out = gaussian(x.shape, mean, std, dtype=x.dtype)
+    x._data = out._data
+    return x

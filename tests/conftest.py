@@ -1,0 +1,20 @@
+"""Test harness config.
+
+Mirrors the reference's GPU-free distributed test strategy (SURVEY.md §4):
+run on a virtual 8-device CPU mesh so sharding/collective code paths execute
+without TPU hardware.  Must run before jax is imported anywhere.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment's sitecustomize pre-registers the axon TPU plugin and pins
+# JAX_PLATFORMS=axon; override through jax.config so tests always run on the
+# virtual 8-device CPU mesh.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,184 @@
+"""Autograd tape: backward, accumulation, hooks, paddle.grad, PyLayer.
+
+Mirrors the reference's numeric-gradient op-test strategy
+(/root/reference/test/legacy_test/op_test.py check_grad): analytic grads vs
+finite differences.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    x = x.astype(np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy(); xp[idx] += eps
+        xm = x.copy(); xm[idx] -= eps
+        g[idx] = (fn(xp) - fn(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 4, 6])
+
+
+def test_chain_backward():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * 3
+    z = y * y
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [36.0])  # d(9x^2)/dx = 18x
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y1 = x * 2
+    y2 = x * 3
+    (y1 + y2).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_multiple_backward_accumulates():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_clear_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+
+def test_matmul_grad_numeric():
+    rng = np.random.RandomState(0)
+    a_np = rng.randn(3, 4).astype(np.float32)
+    b_np = rng.randn(4, 2).astype(np.float32)
+    a = paddle.to_tensor(a_np, stop_gradient=False)
+    b = paddle.to_tensor(b_np, stop_gradient=False)
+    (a @ b).sum().backward()
+    na = numeric_grad(lambda ap: (ap @ b_np.astype(np.float64)).sum(), a_np)
+    nb = numeric_grad(lambda bp: (a_np.astype(np.float64) @ bp).sum(), b_np)
+    np.testing.assert_allclose(a.grad.numpy(), na, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(b.grad.numpy(), nb, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("op,ref", [
+    (lambda x: paddle.exp(x).sum(), lambda x: np.exp(x).sum()),
+    (lambda x: paddle.tanh(x).sum(), lambda x: np.tanh(x).sum()),
+    (lambda x: paddle.nn.functional.sigmoid(x).sum(),
+     lambda x: (1 / (1 + np.exp(-x))).sum()),
+    (lambda x: (x ** 3).mean(), lambda x: (x ** 3).mean()),
+    (lambda x: paddle.nn.functional.softmax(x).max(),
+     None),
+])
+def test_unary_grads_numeric(op, ref):
+    rng = np.random.RandomState(1)
+    x_np = rng.randn(2, 5).astype(np.float32) * 0.5
+    x = paddle.to_tensor(x_np, stop_gradient=False)
+    op(x).backward()
+    if ref is None:
+        return  # smoke only
+    def fwd(xp):
+        t = paddle.to_tensor(xp.astype(np.float32))
+        return float(op(t).numpy())
+    n = numeric_grad(fwd, x_np)
+    np.testing.assert_allclose(x.grad.numpy(), n, rtol=1e-2, atol=1e-2)
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    (g,) = paddle.grad([y.sum()], [x])
+    np.testing.assert_allclose(g.numpy(), [6.0])
+    assert x.grad is None  # paddle.grad must not touch .grad
+
+
+def test_grad_wrt_intermediate():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * 3
+    z = y * y
+    (gy,) = paddle.grad([z.sum()], [y])
+    np.testing.assert_allclose(gy.numpy(), [12.0])
+
+
+def test_register_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+    x.register_hook(lambda g: seen.append(g.numpy().copy()))
+    (x * 2).backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [2.0])
+
+
+def test_hook_modifies_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    x.register_hook(lambda g: g * 10)
+    (x * 2).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+def test_double_backward_raises_without_retain():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2) * 3
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            return grad * 2
+
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_stop_gradient_cuts_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    d = y.detach()
+    z = d * 3
+    assert z.stop_gradient
+
+
+def test_int_inputs_not_differentiated():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    idx = paddle.to_tensor([0, 2])
+    y = paddle.gather(x, idx)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0])

@@ -1,0 +1,62 @@
+"""End-to-end: LeNet on (synthetic) MNIST via paddle.Model — BASELINE config 1.
+
+Mirrors the reference's golden convergence tests (test/book/) — train a few
+iterations and assert the loss drops.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+
+
+def test_lenet_model_fit_converges():
+    paddle.seed(0)
+    train = MNIST(mode="train")
+    train.images = train.images[:512]
+    train.labels = train.labels[:512]
+
+    model = paddle.Model(LeNet())
+    optim = paddle.optimizer.Adam(learning_rate=0.001,
+                                  parameters=model.parameters())
+    model.prepare(optim, nn.CrossEntropyLoss(), Accuracy())
+
+    losses = []
+
+    class Capture(paddle.hapi.callbacks.Callback):
+        def on_train_batch_end(self, step, logs=None):
+            losses.append(logs["loss"][0])
+
+    model.fit(train, epochs=1, batch_size=64, verbose=0, callbacks=[Capture()])
+    assert len(losses) == 8
+    assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
+
+
+def test_lenet_eval_predict():
+    paddle.seed(0)
+    test = MNIST(mode="test")
+    test.images = test.images[:128]
+    test.labels = test.labels[:128]
+    model = paddle.Model(LeNet())
+    optim = paddle.optimizer.SGD(learning_rate=0.01,
+                                 parameters=model.parameters())
+    model.prepare(optim, nn.CrossEntropyLoss(), Accuracy())
+    res = model.evaluate(test, batch_size=64, verbose=0)
+    assert "loss" in res and "acc" in res
+    preds = model.predict(test, batch_size=64, stack_outputs=True)
+    assert preds.shape[0] == 128
+
+
+def test_model_save_load(tmp_path):
+    model = paddle.Model(LeNet())
+    optim = paddle.optimizer.Adam(parameters=model.parameters())
+    model.prepare(optim, nn.CrossEntropyLoss())
+    path = str(tmp_path / "ckpt" / "lenet")
+    model.save(path)
+    w_before = model.network.features[0].weight.numpy().copy()
+    # perturb then reload
+    model.network.features[0].weight.set_value(np.zeros_like(w_before))
+    model.load(path)
+    np.testing.assert_allclose(model.network.features[0].weight.numpy(), w_before)

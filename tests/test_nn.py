@@ -1,0 +1,217 @@
+"""nn.Layer, layers, functional ops."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear():
+    layer = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    y = layer(x)
+    assert y.shape == [2, 3]
+    ref = x.numpy() @ layer.weight.numpy() + layer.bias.numpy()
+    np.testing.assert_allclose(y.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_layer_registry():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    net = Net()
+    params = net.parameters()
+    assert len(params) == 4
+    names = [n for n, _ in net.named_parameters()]
+    assert "fc1.weight" in names and "fc2.bias" in names
+    y = net(paddle.randn([3, 4]))
+    assert y.shape == [3, 2]
+
+
+def test_state_dict_roundtrip():
+    net = nn.Linear(3, 3)
+    sd = net.state_dict()
+    net2 = nn.Linear(3, 3)
+    net2.set_state_dict(sd)
+    np.testing.assert_allclose(net.weight.numpy(), net2.weight.numpy())
+
+
+def test_sequential_and_layerlist():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    y = seq(paddle.randn([2, 4]))
+    assert y.shape == [2, 2]
+    assert len(seq) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll.parameters()) == 6
+
+
+def test_conv2d_shapes():
+    conv = nn.Conv2D(3, 8, 3, stride=1, padding=1)
+    x = paddle.randn([2, 3, 16, 16])
+    y = conv(x)
+    assert y.shape == [2, 8, 16, 16]
+
+
+def test_conv2d_matches_reference():
+    import jax
+    conv = nn.Conv2D(1, 1, 3, padding=0, bias_attr=False)
+    w = np.ones((1, 1, 3, 3), np.float32)
+    conv.weight.set_value(w)
+    x = paddle.ones([1, 1, 5, 5])
+    y = conv(x)
+    np.testing.assert_allclose(y.numpy(), np.full((1, 1, 3, 3), 9.0))
+
+
+def test_pooling():
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    y = F.max_pool2d(x, 2, 2)
+    np.testing.assert_allclose(y.numpy()[0, 0], [[5, 7], [13, 15]])
+    y2 = F.avg_pool2d(x, 2, 2)
+    np.testing.assert_allclose(y2.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+    y3 = F.adaptive_avg_pool2d(x, 1)
+    np.testing.assert_allclose(y3.numpy()[0, 0], [[7.5]])
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(4)
+    x = paddle.randn([8, 4, 5, 5])
+    bn.train()
+    y = bn(x)
+    out = y.numpy()
+    assert abs(out.mean()) < 1e-4
+    assert abs(out.std() - 1.0) < 1e-2
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == [8, 4, 5, 5]
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([2, 4, 8])
+    y = ln(x)
+    out = y.numpy()
+    np.testing.assert_allclose(out.mean(-1), np.zeros((2, 4)), atol=1e-5)
+    np.testing.assert_allclose(out.std(-1), np.ones((2, 4)), atol=1e-2)
+
+
+def test_rmsnorm():
+    rms = nn.RMSNorm(8)
+    x = paddle.randn([2, 8])
+    y = rms(x)
+    ref = x.numpy() / np.sqrt((x.numpy() ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(y.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    ids = paddle.to_tensor([[1, 2], [3, 4]])
+    y = emb(ids)
+    assert y.shape == [2, 2, 4]
+    np.testing.assert_allclose(y.numpy()[0, 0], emb.weight.numpy()[1])
+
+
+def test_dropout_modes():
+    drop = nn.Dropout(0.5)
+    x = paddle.ones([100, 100])
+    drop.train()
+    y = drop(x)
+    frac_zero = float((y.numpy() == 0).mean())
+    assert 0.3 < frac_zero < 0.7
+    drop.eval()
+    y2 = drop(x)
+    np.testing.assert_allclose(y2.numpy(), x.numpy())
+
+
+def test_cross_entropy():
+    logits = paddle.to_tensor([[2.0, 1.0, 0.1], [0.5, 2.5, 0.3]],
+                              stop_gradient=False)
+    labels = paddle.to_tensor([0, 1])
+    loss = F.cross_entropy(logits, labels)
+    p = np.exp(logits.numpy())
+    p = p / p.sum(-1, keepdims=True)
+    ref = -np.log(p[[0, 1], [0, 1]]).mean()
+    np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-5)
+    loss.backward()
+    assert logits.grad is not None
+
+
+def test_cross_entropy_soft_label():
+    logits = paddle.randn([4, 5])
+    soft = paddle.nn.functional.softmax(paddle.randn([4, 5]))
+    loss = F.cross_entropy(logits, soft, soft_label=True)
+    assert loss.shape == []
+
+
+def test_mse_l1():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([2.0, 4.0])
+    np.testing.assert_allclose(F.mse_loss(a, b).numpy(), 2.5)
+    np.testing.assert_allclose(F.l1_loss(a, b).numpy(), 1.5)
+
+
+def test_activations():
+    x = paddle.to_tensor([-1.0, 0.0, 2.0])
+    np.testing.assert_allclose(F.relu(x).numpy(), [0, 0, 2])
+    np.testing.assert_allclose(F.leaky_relu(x, 0.1).numpy(), [-0.1, 0, 2])
+    s = F.softmax(x)
+    np.testing.assert_allclose(s.numpy().sum(), 1.0, rtol=1e-6)
+    g = F.gelu(x)
+    assert g.shape == [3]
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 5, 16])
+    y = mha(x, x, x)
+    assert y.shape == [2, 5, 16]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=4, dim_feedforward=32)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 6, 16])
+    y = enc(x)
+    assert y.shape == [2, 6, 16]
+
+
+def test_sdpa_causal_matches_manual():
+    b, s, h, d = 1, 4, 2, 8
+    q = paddle.randn([b, s, h, d])
+    k = paddle.randn([b, s, h, d])
+    v = paddle.randn([b, s, h, d])
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    # manual reference
+    qn = q.numpy().transpose(0, 2, 1, 3)
+    kn = k.numpy().transpose(0, 2, 1, 3)
+    vn = v.numpy().transpose(0, 2, 1, 3)
+    scores = qn @ kn.transpose(0, 1, 3, 2) / np.sqrt(d)
+    mask = np.tril(np.ones((s, s), bool))
+    scores = np.where(mask, scores, -np.inf)
+    e = np.exp(scores - scores.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = (p @ vn).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_clip_grad_by_global_norm():
+    p = paddle.to_tensor([3.0, 4.0], stop_gradient=False)
+    (p * p).sum().backward()  # grad = [6, 8], norm 10
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    out = clip([(p, p.grad)])
+    np.testing.assert_allclose(out[0][1].numpy(), [0.6, 0.8], rtol=1e-5)
+
+
+def test_grad_flows_through_layers():
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    x = paddle.randn([4, 4])
+    loss = net(x).sum()
+    loss.backward()
+    for p in net.parameters():
+        assert p.grad is not None, p.name

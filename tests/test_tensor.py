@@ -1,0 +1,119 @@
+"""Tensor basics: creation, dtype rules, methods, indexing."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_dtypes():
+    assert paddle.to_tensor(1).dtype == paddle.int64
+    assert paddle.to_tensor(1.0).dtype == paddle.float32
+    assert paddle.to_tensor(True).dtype == paddle.bool
+    assert paddle.to_tensor([1, 2]).dtype == paddle.int64
+    assert paddle.to_tensor(np.zeros((2, 2), np.float64)).dtype == paddle.float64
+
+
+def test_basic_math():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    y = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((x + y).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((x * y).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((y / x).numpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((x - 1).numpy(), [0, 1, 2])
+    np.testing.assert_allclose((2 - x).numpy(), [1, 0, -1])
+    np.testing.assert_allclose((x ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((-x).numpy(), [-1, -2, -3])
+
+
+def test_matmul():
+    a = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    c = a @ b
+    np.testing.assert_allclose(c.numpy(), a.numpy() @ b.numpy())
+    c2 = paddle.matmul(a, b)
+    np.testing.assert_allclose(c2.numpy(), a.numpy() @ b.numpy())
+
+
+def test_shape_props():
+    x = paddle.zeros([2, 3, 4])
+    assert x.shape == [2, 3, 4]
+    assert x.ndim == 3
+    assert x.size == 24
+    assert x.numel() == 24
+    assert len(x) == 2
+    assert x.dtype == paddle.float32
+
+
+def test_methods():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert x.sum().item() == 66.0
+    assert x.mean().item() == 5.5
+    assert x.max().item() == 11.0
+    assert x.reshape([4, 3]).shape == [4, 3]
+    assert x.transpose([1, 0]).shape == [4, 3]
+    assert x.flatten().shape == [12]
+    assert x.unsqueeze(0).shape == [1, 3, 4]
+    assert x.astype("int32").dtype == paddle.int32
+
+
+def test_indexing():
+    x = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(4, 6))
+    np.testing.assert_allclose(x[0].numpy(), np.arange(6))
+    np.testing.assert_allclose(x[1:3, 2].numpy(), [8, 14])
+    np.testing.assert_allclose(x[:, -1].numpy(), [5, 11, 17, 23])
+    idx = paddle.to_tensor([0, 2])
+    np.testing.assert_allclose(x[idx].numpy(), x.numpy()[[0, 2]])
+    mask = x > 20
+    np.testing.assert_allclose(x[mask].numpy(), [21, 22, 23])
+
+
+def test_setitem():
+    x = paddle.zeros([3, 3])
+    x[1] = 5.0
+    np.testing.assert_allclose(x.numpy()[1], [5, 5, 5])
+    x[0, 0] = 7.0
+    assert x.numpy()[0, 0] == 7.0
+
+
+def test_comparison_and_where():
+    x = paddle.to_tensor([1.0, 5.0, 3.0])
+    y = paddle.to_tensor([2.0, 2.0, 2.0])
+    np.testing.assert_array_equal((x > y).numpy(), [False, True, True])
+    z = paddle.where(x > y, x, y)
+    np.testing.assert_allclose(z.numpy(), [2, 5, 3])
+
+
+def test_concat_split():
+    a = paddle.ones([2, 3])
+    b = paddle.zeros([2, 3])
+    c = paddle.concat([a, b], axis=0)
+    assert c.shape == [4, 3]
+    parts = paddle.split(c, 2, axis=0)
+    assert len(parts) == 2
+    np.testing.assert_allclose(parts[0].numpy(), a.numpy())
+
+
+def test_creation_ops():
+    assert paddle.arange(5).dtype == paddle.int64
+    np.testing.assert_allclose(paddle.arange(1, 4).numpy(), [1, 2, 3])
+    assert paddle.full([2, 2], 3).numpy().sum() == 12
+    e = paddle.eye(3)
+    np.testing.assert_allclose(e.numpy(), np.eye(3))
+    t = paddle.tril(paddle.ones([3, 3]))
+    assert t.numpy()[0, 2] == 0
+
+
+def test_clone_detach():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    d = x.detach()
+    assert d.stop_gradient
+    c = x.clone()
+    assert not c.stop_gradient
+
+
+def test_cast_astype_roundtrip():
+    x = paddle.to_tensor([1.5, 2.5])
+    y = x.astype("float64")
+    assert y.dtype == paddle.float64
+    z = y.astype(paddle.bfloat16)
+    assert z.dtype == paddle.bfloat16
