@@ -1,0 +1,91 @@
+"""Benchmark: LLaMA-architecture pretrain step throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+BASELINE.md records that the reference publishes no in-tree numbers
+("published": {} in BASELINE.json), so vs_baseline is reported against the
+previous round's own result when bench_history.json exists, else 1.0.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.parallel import (
+        HybridParallelConfig, build_mesh, build_train_step, init_opt_state,
+        init_params, shard_opt_state, shard_params,
+    )
+
+    on_tpu = jax.default_backend() != "cpu"
+    # ~350M-param LLaMA slice sized for one v5e chip (bf16 params + f32 Adam)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=24,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=2048)
+        batch, seq, steps = 8, 2048, 8
+        dtype = jnp.bfloat16
+    else:  # CPU smoke mode
+        cfg = LlamaConfig.tiny()
+        batch, seq, steps = 2, 128, 2
+        dtype = jnp.float32
+
+    hp = HybridParallelConfig(dp=1, pp=1, tp=1, num_microbatches=1,
+                              remat=True, dtype=dtype)
+    mesh = build_mesh(hp)
+    params = shard_params(init_params(cfg, hp, seed=0), hp, mesh)
+    opt = shard_opt_state(init_opt_state(params), hp, mesh)
+    step = build_train_step(cfg, hp, mesh)
+
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+
+    # warmup (compile)
+    params, opt, loss = step(params, opt, tokens)
+    float(loss)
+
+    # hard host-sync each step: block_until_ready alone does not drain the
+    # remote-execution queue on the tunneled runtime (verified empirically)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt, loss = step(params, opt, tokens)
+        float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+
+    hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "bench_history.json")
+    vs_baseline = 1.0
+    try:
+        with open(hist_path) as f:
+            prev = json.load(f).get("tokens_per_sec")
+            if prev:
+                vs_baseline = tokens_per_sec / prev
+    except (OSError, json.JSONDecodeError):
+        pass
+    try:
+        with open(hist_path, "w") as f:
+            json.dump({"tokens_per_sec": tokens_per_sec,
+                       "loss": float(loss)}, f)
+    except OSError:
+        pass
+
+    print(json.dumps({
+        "metric": "llama-350m pretrain tokens/sec/chip (bf16, remat, fused step)",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(vs_baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -50,6 +50,8 @@ class auto_cast:
         self._custom_black = set(custom_black_list or ())
 
     def __enter__(self):
+        self._saved_white = set(amp_state.WHITE_LIST)
+        self._saved_black = set(amp_state.BLACK_LIST)
         if self._custom_white:
             amp_state.WHITE_LIST.update(self._custom_white)
             amp_state.BLACK_LIST.difference_update(self._custom_white)
@@ -61,6 +63,11 @@ class auto_cast:
 
     def __exit__(self, *exc):
         amp_state.restore(self._prev)
+        # restore global op lists mutated by custom white/black lists
+        amp_state.WHITE_LIST.clear()
+        amp_state.WHITE_LIST.update(self._saved_white)
+        amp_state.BLACK_LIST.clear()
+        amp_state.BLACK_LIST.update(self._saved_black)
         return False
 
 
@@ -115,6 +122,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled = False
 
     def is_enable(self):
         return self._enable
@@ -135,8 +143,9 @@ class GradScaler:
         return _scale(var, self._scale)
 
     def unscale_(self, optimizer):
-        if not self._enable:
+        if not self._enable or self._unscaled:
             return
+        self._unscaled = True
         inv = 1.0 / self._scale
         found = False
         for p in (optimizer._parameter_list or []):
@@ -150,19 +159,24 @@ class GradScaler:
         self._found_inf = found
 
     def step(self, optimizer):
+        """Unscale (if the user hasn't already) and step when grads are
+        finite.  Matches the reference: step() does NOT update() — callers do
+        scaler.step(opt); scaler.update()."""
         if not self._enable:
             optimizer.step()
             return
         self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
-        self.update()
 
     def minimize(self, optimizer, scaled_loss):
         self.step(optimizer)
+        self.update()
 
     def update(self):
+        self._unscaled = False
         if not (self._enable and self._dynamic):
+            self._found_inf = False
             return
         if self._found_inf:
             self._bad_steps += 1

@@ -24,8 +24,9 @@ class PyLayerContext:
     def save_for_backward(self, *tensors):
         self.container = tensors
 
-    @property
     def saved_tensor(self):
+        """Method (not property) for reference-API parity:
+        /root/reference/python/paddle/autograd/py_layer.py:105."""
         return self.container
 
     def saved_tensor_list(self):
@@ -99,7 +100,10 @@ class PyLayer(metaclass=PyLayerMeta):
             for o in outs)
 
         if grad_on:
-            mask = tuple(requires)
+            # Paddle contract: backward returns ONE grad per forward tensor
+            # input (None at stop-gradient positions) — so every tensor input
+            # occupies a tape slot; the engine skips stop_gradient parents.
+            mask = tuple(True for _ in tensor_inputs)
             node = _PyLayerNode(ctx, cls.backward, mask, tensor_inputs, out_tensors)
             for i, t in enumerate(out_tensors):
                 if id(outs[i]) in ctx._non_differentiable:

@@ -111,11 +111,8 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
         if t.stop_gradient:
             continue
         if g is None:
-            if t._data.size != 1:
-                raise RuntimeError(
-                    "grad can be implicitly created only for scalar outputs; "
-                    f"got shape {tuple(t.shape)}"
-                )
+            # reference semantics: initial gradient is ones for ANY shape
+            # (tensor_patch_methods.py backward docstring)
             g_arr = jnp.ones(t._data.shape, t._data.dtype)
         else:
             g_arr = g._data if isinstance(g, Tensor) else jnp.asarray(g)
@@ -174,7 +171,7 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
             if not m:
                 continue
             g = next(it)
-            if p is None:
+            if p is None or p.stop_gradient:
                 continue
             # A None/float0 gradient still consumes this edge — the upstream
             # node's pending count must drop or it never becomes ready.

@@ -1,0 +1,168 @@
+"""LLaMA decoder (eager nn.Layer version).
+
+Capability parity with the reference's LLaMA test model
+(/root/reference/test/auto_parallel/hybrid_strategy/semi_auto_parallel_llama_model.py):
+RMSNorm pre-norm decoder blocks, rotary position embeddings, SwiGLU MLP,
+GQA-capable attention.  The hybrid-parallel SPMD trainer for this
+architecture lives in paddle_tpu/parallel/transformer.py.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from .. import nn
+from ..nn import functional as F
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+
+    @staticmethod
+    def llama_7b():
+        return LlamaConfig()
+
+    @staticmethod
+    def tiny(vocab=256, hidden=64, layers=2, heads=4, ffn=128, seq=128):
+        return LlamaConfig(vocab_size=vocab, hidden_size=hidden,
+                           intermediate_size=ffn, num_hidden_layers=layers,
+                           num_attention_heads=heads, num_key_value_heads=heads,
+                           max_position_embeddings=seq)
+
+
+def apply_rope(q, k, theta=10000.0):
+    """Rotary embeddings on [b, s, h, d] (paddle fused_rotary_position_embedding
+    parity: /root/reference/python/paddle/incubate/nn/functional/fused_rotary_position_embedding.py)."""
+    import jax.numpy as jnp
+
+    from ..core import dispatch as D
+
+    def _rope(q, k, theta):
+        b, s, h, d = q.shape
+        pos = jnp.arange(s, dtype=jnp.float32)
+        inv = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+        freqs = jnp.outer(pos, inv)                       # [s, d/2]
+        cos = jnp.cos(freqs)[None, :, None, :]
+        sin = jnp.sin(freqs)[None, :, None, :]
+
+        def rot(x):
+            x1, x2 = x[..., 0::2], x[..., 1::2]
+            xr1 = x1 * cos - x2 * sin
+            xr2 = x2 * cos + x1 * sin
+            out = jnp.stack([xr1, xr2], axis=-1)
+            return out.reshape(x.shape)
+
+        return rot(q.astype(jnp.float32)).astype(q.dtype), \
+            rot(k.astype(jnp.float32)).astype(k.dtype)
+
+    return D.apply("rope", _rope, (q, k), {"theta": float(theta)})
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        h = config.hidden_size
+        self.head_dim = h // config.num_attention_heads
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.q_proj = nn.Linear(h, self.num_heads * self.head_dim, bias_attr=False)
+        self.k_proj = nn.Linear(h, self.num_kv_heads * self.head_dim, bias_attr=False)
+        self.v_proj = nn.Linear(h, self.num_kv_heads * self.head_dim, bias_attr=False)
+        self.o_proj = nn.Linear(self.num_heads * self.head_dim, h, bias_attr=False)
+
+    def forward(self, x, attn_mask=None):
+        from ..ops.manipulation import reshape, tile
+
+        b, s = x.shape[0], x.shape[1]
+        q = reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
+        k = reshape(self.k_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+        v = reshape(self.v_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+        q, k = apply_rope(q, k, self.config.rope_theta)
+        if self.num_kv_heads != self.num_heads:
+            rep = self.num_heads // self.num_kv_heads
+            from ..ops.manipulation import repeat_interleave
+            k = repeat_interleave(k, rep, axis=2)
+            v = repeat_interleave(v, rep, axis=2)
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                             is_causal=attn_mask is None)
+        out = reshape(out, [b, s, self.num_heads * self.head_dim])
+        return self.o_proj(out)
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, f = config.hidden_size, config.intermediate_size
+        self.gate_proj = nn.Linear(h, f, bias_attr=False)
+        self.up_proj = nn.Linear(h, f, bias_attr=False)
+        self.down_proj = nn.Linear(f, h, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
+                                                   config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x, attn_mask=None):
+        x = x + self.self_attn(self.input_layernorm(x), attn_mask)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, input_ids, attn_mask=None):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x, attn_mask)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.model = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
+
+    def forward(self, input_ids, labels=None):
+        from ..ops.math import matmul
+
+        h = self.model(input_ids)
+        if self.lm_head is None:
+            logits = matmul(h, self.model.embed_tokens.weight, transpose_y=True)
+        else:
+            logits = self.lm_head(h)
+        if labels is not None:
+            loss = F.cross_entropy(logits, labels)
+            return logits, loss
+        return logits

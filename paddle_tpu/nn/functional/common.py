@@ -90,6 +90,11 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     def _emb(ids, w, padding_idx):
         out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            # padding rows contribute no gradient to the table (reference
+            # embedding_grad zeroes the padding_idx row)
+            pad = (ids == padding_idx)[..., None]
+            out = jnp.where(pad, jax.lax.stop_gradient(out), out)
         return out
     return D.apply("embedding", _emb, (x, weight), {"padding_idx": padding_idx})
 

@@ -1,0 +1,416 @@
+"""Hybrid-parallel transformer trainer: DP x PP x TP(+Megatron-SP), manual SPMD.
+
+This is the TPU-native equivalent of the reference Fleet hybrid stack
+(/root/reference/python/paddle/distributed/fleet/meta_parallel/ — TP layers
+mp_layers.py:49/:336/:543, sequence parallel sequence_parallel_utils.py,
+pipeline_parallel.py:684 1F1B) re-designed for XLA:
+
+- one `shard_map` over a Mesh('pp','dp','tp') contains the ENTIRE train step
+  (forward pipeline, loss, backward, grad reductions, optimizer update) — a
+  single compiled program per step, collectives riding ICI;
+- TP: Megatron column/row-parallel matmuls with explicit psum/psum_scatter;
+- SP: activations stay sequence-sharded over the tp axis between blocks
+  (all_gather into TP regions, psum_scatter out — exactly the reference's
+  ScatterOp/AllGatherOp/ReduceScatterOp PyLayers, but fused by XLA);
+- PP: GPipe microbatch schedule as a lax.scan over M+pp-1 ticks with
+  ppermute between stages; jax.grad transposes the loop into the backward
+  pipeline automatically (ppermute^T = reverse ppermute);
+- DP: pmean of grads over the dp axis;
+- remat: each decoder block wrapped in jax.checkpoint.
+
+Vocab-parallel embedding + cross entropy follow the reference's
+VocabParallelEmbedding / ParallelCrossEntropy (mp_layers.py:49, mp_ops.py).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..models.llama import LlamaConfig
+
+__all__ = ["HybridParallelConfig", "init_params", "build_train_step",
+           "build_mesh", "param_specs"]
+
+
+@dataclass(frozen=True)
+class HybridParallelConfig:
+    dp: int = 1
+    pp: int = 1
+    tp: int = 1
+    num_microbatches: int = 1
+    remat: bool = True
+    dtype: Any = jnp.float32          # activation/param dtype (bf16 on TPU)
+    lr: float = 1e-3
+    betas: tuple = (0.9, 0.95)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    grad_clip_norm: float = 1.0
+
+    @property
+    def world(self):
+        return self.dp * self.pp * self.tp
+
+
+def build_mesh(hp: HybridParallelConfig, devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()[:hp.world]
+    if len(devices) < hp.world:
+        raise RuntimeError(f"need {hp.world} devices, have {len(devices)}")
+    arr = np.asarray(devices[:hp.world]).reshape(hp.pp, hp.dp, hp.tp)
+    return Mesh(arr, ("pp", "dp", "tp"))
+
+
+# ---------------------------------------------------------------------------
+# Parameters.  Layer weights are stacked on a leading L axis sharded over pp;
+# TP shardings follow Megatron: qkv/gate/up column (out-dim), o/down row
+# (in-dim), embed/head vocab-dim.
+# ---------------------------------------------------------------------------
+
+def init_params(cfg: LlamaConfig, hp: HybridParallelConfig, seed=0):
+    k = jax.random.PRNGKey(seed)
+    H, F, V, L = (cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size,
+                  cfg.num_hidden_layers)
+    dt = hp.dtype
+
+    def normal(key, shape, scale):
+        return (scale * jax.random.normal(key, shape, jnp.float32)).astype(dt)
+
+    keys = jax.random.split(k, 10)
+    s = 0.02
+    params = {
+        "embed": normal(keys[0], (V, H), s),
+        "norm_f": jnp.ones((H,), dt),
+        "head": normal(keys[1], (H, V), s),
+        "layers": {
+            "ln1": jnp.ones((L, H), dt),
+            "wq": normal(keys[2], (L, H, H), s),
+            "wk": normal(keys[3], (L, H, H), s),
+            "wv": normal(keys[4], (L, H, H), s),
+            "wo": normal(keys[5], (L, H, H), s / math.sqrt(2 * L)),
+            "ln2": jnp.ones((L, H), dt),
+            "w_gate": normal(keys[6], (L, H, F), s),
+            "w_up": normal(keys[7], (L, H, F), s),
+            "w_down": normal(keys[8], (L, F, H), s / math.sqrt(2 * L)),
+        },
+    }
+    return params
+
+
+def param_specs(hp: HybridParallelConfig):
+    """PartitionSpecs for the param pytree over Mesh('pp','dp','tp')."""
+    return {
+        "embed": P("tp", None),            # vocab-parallel
+        "norm_f": P(),
+        "head": P(None, "tp"),             # column-parallel over vocab
+        "layers": {
+            "ln1": P("pp", None),
+            "wq": P("pp", None, "tp"),
+            "wk": P("pp", None, "tp"),
+            "wv": P("pp", None, "tp"),
+            "wo": P("pp", "tp", None),
+            "ln2": P("pp", None),
+            "w_gate": P("pp", None, "tp"),
+            "w_up": P("pp", None, "tp"),
+            "w_down": P("pp", "tp", None),
+        },
+    }
+
+
+def opt_state_specs(hp):
+    ps = param_specs(hp)
+    return {"m": ps, "v": ps, "step": P()}
+
+
+def init_opt_state(params):
+    f32 = lambda t: jnp.zeros_like(t, dtype=jnp.float32)
+    return {"m": jax.tree.map(f32, params),
+            "v": jax.tree.map(f32, params),
+            "step": jnp.zeros((), jnp.int32)}
+
+
+# ---------------------------------------------------------------------------
+# Per-device model code (inside shard_map).  All shapes are LOCAL.
+# ---------------------------------------------------------------------------
+
+def _rope(x, theta):
+    # x: [m, S, h, d]
+    m_, s, h, d = x.shape
+    pos = jnp.arange(s, dtype=jnp.float32)
+    inv = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    freqs = jnp.outer(pos, inv)
+    cos = jnp.cos(freqs)[None, :, None, :]
+    sin = jnp.sin(freqs)[None, :, None, :]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., 0::2], xf[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.reshape(m_, s, h, d).astype(x.dtype)
+
+
+def _rms(x, w, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    out = xf * lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (out * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def _attention(q, k, v):
+    # q/k/v: [m, S, h_loc, d]; causal
+    m_, s, h, d = q.shape
+    qf = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+    kf = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vf = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    scores = jnp.einsum("mhqd,mhkd->mhqk", qf, kf) / math.sqrt(d)
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("mhqk,mhkd->mhqd", probs, vf)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def _make_block(cfg: LlamaConfig, hp: HybridParallelConfig):
+    n_heads_local = cfg.num_attention_heads // hp.tp
+    head_dim = cfg.hidden_size // cfg.num_attention_heads
+
+    def block(x, p):
+        # x: [m, S/tp, H] sequence-sharded (SP region)
+        h = _rms(x, p["ln1"], cfg.rms_norm_eps)
+        h = lax.all_gather(h, "tp", axis=1, tiled=True)      # -> [m, S, H]
+        q = jnp.einsum("msh,hk->msk", h, p["wq"])            # [m, S, H/tp]
+        k = jnp.einsum("msh,hk->msk", h, p["wk"])
+        v = jnp.einsum("msh,hk->msk", h, p["wv"])
+        m_, s = q.shape[0], q.shape[1]
+        q = q.reshape(m_, s, n_heads_local, head_dim)
+        k = k.reshape(m_, s, n_heads_local, head_dim)
+        v = v.reshape(m_, s, n_heads_local, head_dim)
+        q = _rope(q, cfg.rope_theta)
+        k = _rope(k, cfg.rope_theta)
+        att = _attention(q, k, v).reshape(m_, s, n_heads_local * head_dim)
+        o_partial = jnp.einsum("msk,kh->msh", att, p["wo"])  # partial over tp
+        o = lax.psum_scatter(o_partial, "tp", scatter_dimension=1, tiled=True)
+        x = x + o                                            # [m, S/tp, H]
+
+        h2 = _rms(x, p["ln2"], cfg.rms_norm_eps)
+        h2 = lax.all_gather(h2, "tp", axis=1, tiled=True)
+        g = jnp.einsum("msh,hf->msf", h2, p["w_gate"])
+        u = jnp.einsum("msh,hf->msf", h2, p["w_up"])
+        a = jax.nn.silu(g.astype(jnp.float32)).astype(g.dtype) * u
+        d_partial = jnp.einsum("msf,fh->msh", a, p["w_down"])
+        d = lax.psum_scatter(d_partial, "tp", scatter_dimension=1, tiled=True)
+        return x + d
+
+    return block
+
+
+def _vocab_parallel_embed(tokens, embed, cfg, hp):
+    """tokens [m, S] -> sequence-sharded activations [m, S/tp, H].
+    embed is the LOCAL vocab shard [V/tp, H]."""
+    v_local = embed.shape[0]
+    tp_idx = lax.axis_index("tp")
+    lo = tp_idx * v_local
+    local_ids = tokens - lo
+    in_range = (local_ids >= 0) & (local_ids < v_local)
+    safe = jnp.clip(local_ids, 0, v_local - 1)
+    out = jnp.take(embed, safe, axis=0)
+    out = jnp.where(in_range[..., None], out, jnp.zeros((), out.dtype))
+    # psum over tp (complete the lookup) + scatter the seq dim (enter SP region)
+    return lax.psum_scatter(out, "tp", scatter_dimension=1, tiled=True)
+
+
+def _vocab_parallel_xent(h, head, labels, cfg):
+    """h [m, S, H] full-seq; head LOCAL [H, V/tp]; labels [m, S].
+    Stable cross entropy with the vocab dim sharded over tp
+    (reference ParallelCrossEntropy, mp_ops.py)."""
+    logits = jnp.einsum("msh,hv->msv", h.astype(jnp.float32),
+                        head.astype(jnp.float32))
+    v_local = logits.shape[-1]
+    tp_idx = lax.axis_index("tp")
+    lo = tp_idx * v_local
+    local_max = jnp.max(logits, axis=-1)
+    # max-subtraction is a numerical shift only; its gradient cancels exactly,
+    # and pmax has no transpose rule — stop_gradient is mathematically exact.
+    gmax = lax.stop_gradient(lax.pmax(lax.stop_gradient(local_max), "tp"))
+    z = jnp.exp(logits - gmax[..., None])
+    denom = lax.psum(jnp.sum(z, axis=-1), "tp")
+    local_label = labels - lo
+    in_range = (local_label >= 0) & (local_label < v_local)
+    safe = jnp.clip(local_label, 0, v_local - 1)
+    picked = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+    picked = jnp.where(in_range, picked, 0.0)
+    correct = lax.psum(picked, "tp")
+    return jnp.mean(gmax + jnp.log(denom) - correct)
+
+
+def _forward_loss(params, tokens, cfg, hp):
+    """Per-device forward: GPipe pipeline over M microbatches, returns loss.
+    tokens: LOCAL [M, m, S] int32 (already dp-sharded on batch)."""
+    block = _make_block(cfg, hp)
+    if hp.remat:
+        block = jax.checkpoint(block)
+    M = hp.num_microbatches
+    pp = hp.pp
+    stage = lax.axis_index("pp")
+    L_loc = cfg.num_hidden_layers // pp
+    m = tokens.shape[1]
+    s_loc = tokens.shape[2] // hp.tp
+    H = cfg.hidden_size
+
+    def stage_fn(x):
+        def body(x, pl):
+            return block(x, pl), None
+        x, _ = lax.scan(body, x, params["layers"])
+        return x
+
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+    def tick(carry, t):
+        act, acc_loss = carry
+        mb = jnp.clip(t - stage, 0, M - 1)
+        tok_mb = lax.dynamic_index_in_dim(tokens, jnp.clip(t, 0, M - 1), axis=0,
+                                          keepdims=False)
+        fresh = _vocab_parallel_embed(tok_mb, params["embed"], cfg, hp)
+        inp = jnp.where(stage == 0, fresh, act)
+        out = stage_fn(inp)
+
+        # last stage: head + loss for this microbatch (when valid)
+        my_tok = lax.dynamic_index_in_dim(tokens, mb, axis=0, keepdims=False)
+        hN = _rms(out, params["norm_f"], cfg.rms_norm_eps)
+        h_full = lax.all_gather(hN, "tp", axis=1, tiled=True)   # [m, S, H]
+        labels = jnp.concatenate([my_tok[:, 1:], my_tok[:, :1]], axis=1)
+        mb_loss = _vocab_parallel_xent(h_full, params["head"], labels, cfg)
+        valid = ((t - stage) >= 0) & ((t - stage) < M) & (stage == pp - 1)
+        acc_loss = acc_loss + jnp.where(valid, mb_loss, 0.0)
+
+        act_next = lax.ppermute(out, "pp", perm) if pp > 1 else out
+        return (act_next, acc_loss), None
+
+    act0 = jnp.zeros((m, s_loc, H), hp.dtype)
+    loss0 = jnp.zeros((), jnp.float32)
+    # new-style shard_map tracks which mesh axes a value varies over; scan
+    # needs carry-in vma == carry-out vma, so pre-mark the zero carries as
+    # varying over every mesh axis the body's outputs vary over.
+    all_axes = ("pp", "dp", "tp")
+    act0 = lax.pcast(act0, all_axes, to="varying")
+    loss0 = lax.pcast(loss0, all_axes, to="varying")
+    (act, total_loss), _ = lax.scan(tick, (act0, loss0),
+                                    jnp.arange(M + pp - 1))
+    loss = total_loss / M
+    # every stage needs the same loss value out (grads already flow via
+    # ppermute transpose); sum over pp puts the last stage's loss everywhere
+    loss = lax.psum(loss, "pp")
+    return loss
+
+
+def _adamw_update(params, grads, opt_state, hp):
+    b1, b2 = hp.betas
+    step = opt_state["step"] + 1
+    bc1 = 1.0 - b1 ** step.astype(jnp.float32)
+    bc2 = 1.0 - b2 ** step.astype(jnp.float32)
+
+    # Exact global grad-norm clip (matches ClipGradByGlobalNorm across the
+    # hybrid topology, hybrid_parallel_optimizer.py:536 in the reference):
+    # each leaf contributes its LOCAL shard's sumsq psum'd over exactly the
+    # mesh axes it is sharded on, so every device — and every dp/pp/tp
+    # configuration — sees the same global norm.
+    specs = param_specs(hp)
+    flat_gs, _ = jax.tree.flatten(grads)
+    flat_specs, _ = jax.tree.flatten(specs, is_leaf=lambda x: isinstance(x, P))
+    sumsq = jnp.zeros((), jnp.float32)
+    for g, spec in zip(flat_gs, flat_specs):
+        local = jnp.sum(g.astype(jnp.float32) ** 2)
+        axes = tuple(a for a in spec if a is not None)
+        if axes:
+            local = lax.psum(local, axes)
+        sumsq = sumsq + local
+    gnorm = jnp.sqrt(sumsq)
+    scale = jnp.minimum(1.0, hp.grad_clip_norm / (gnorm + 1e-6)) \
+        if hp.grad_clip_norm else 1.0
+
+    def upd(p, g, m, v):
+        gf = g.astype(jnp.float32) * scale
+        m2 = b1 * m + (1 - b1) * gf
+        v2 = b2 * v + (1 - b2) * gf * gf
+        upd_ = (m2 / bc1) / (jnp.sqrt(v2 / bc2) + hp.eps)
+        pf = p.astype(jnp.float32)
+        if hp.weight_decay:
+            pf = pf * (1.0 - hp.lr * hp.weight_decay)
+        return (pf - hp.lr * upd_).astype(p.dtype), m2, v2
+
+    flat_p, treedef = jax.tree.flatten(params)
+    flat_g = jax.tree.leaves(grads)
+    flat_m = jax.tree.leaves(opt_state["m"])
+    flat_v = jax.tree.leaves(opt_state["v"])
+    out = [upd(p, g, m, v) for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v)]
+    new_p = jax.tree.unflatten(treedef, [o[0] for o in out])
+    new_m = jax.tree.unflatten(treedef, [o[1] for o in out])
+    new_v = jax.tree.unflatten(treedef, [o[2] for o in out])
+    return new_p, {"m": new_m, "v": new_v, "step": step}
+
+
+def _reduce_grads(grads, hp):
+    """Cross-axis gradient reductions the manual-SPMD forward leaves pending:
+    - dp: every param is replicated over dp -> pmean
+    - pp: embed/head/norm_f are replicated over pp but only some stages
+      produce nonzero grads -> psum
+    - tp: norm weights (used in the sequence-sharded region) are replicated
+      over tp with partial grads -> psum  (the reference's SP
+      allreduce hooks, sequence_parallel_utils.py:192)
+    """
+    grads = jax.tree.map(lambda g: lax.pmean(g, "dp"), grads)
+    for name in ("embed", "head", "norm_f"):
+        grads[name] = lax.psum(grads[name], "pp")
+    grads["norm_f"] = lax.psum(grads["norm_f"], "tp")
+    grads["layers"]["ln1"] = lax.psum(grads["layers"]["ln1"], "tp")
+    grads["layers"]["ln2"] = lax.psum(grads["layers"]["ln2"], "tp")
+    return grads
+
+
+def build_train_step(cfg: LlamaConfig, hp: HybridParallelConfig, mesh: Mesh):
+    """Returns train_step(params, opt_state, tokens) -> (params, opt_state, loss).
+
+    tokens: GLOBAL [dp * M * m, S] int32.  The whole step is one jitted
+    program; parameter/optimizer buffers are donated.
+    """
+    ps = param_specs(hp)
+    os_specs = {"m": ps, "v": ps, "step": P()}
+
+    def sharded_step(params, opt_state, tokens):
+        # tokens arrive [M*m_local, S]; regroup into microbatches
+        M = hp.num_microbatches
+        mS = tokens.shape
+        tokens = tokens.reshape(M, mS[0] // M, mS[1])
+        loss, grads = jax.value_and_grad(
+            lambda p: _forward_loss(p, tokens, cfg, hp))(params)
+        grads = _reduce_grads(grads, hp)
+        loss = lax.pmean(loss, "dp")
+        new_params, new_opt = _adamw_update(params, grads, opt_state, hp)
+        return new_params, new_opt, loss
+
+    tok_spec = P("dp", None)
+    fn = shard_map(sharded_step, mesh=mesh,
+                   in_specs=(ps, os_specs, tok_spec),
+                   out_specs=(ps, os_specs, P()),
+                   check_vma=False)
+    return jax.jit(fn, donate_argnums=(0, 1))
+
+
+def shard_params(params, hp, mesh):
+    """Place an (unsharded) param pytree onto the mesh per param_specs."""
+    specs = param_specs(hp)
+    return jax.tree.map(
+        lambda t, s: jax.device_put(t, NamedSharding(mesh, s)), params, specs,
+        is_leaf=lambda x: isinstance(x, jnp.ndarray))
+
+
+def shard_opt_state(opt_state, hp, mesh):
+    specs = {"m": param_specs(hp), "v": param_specs(hp), "step": P()}
+    return jax.tree.map(
+        lambda t, s: jax.device_put(t, NamedSharding(mesh, s)),
+        opt_state, specs, is_leaf=lambda x: isinstance(x, jnp.ndarray))

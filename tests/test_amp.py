@@ -1,0 +1,68 @@
+"""AMP: autocast lists, GradScaler protocol."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core import amp_state
+
+
+def test_autocast_o1_dtype():
+    with paddle.amp.auto_cast(level="O1"):
+        a = paddle.randn([8, 8])
+        b = paddle.randn([8, 8])
+        c = paddle.matmul(a, b)
+        s = paddle.nn.functional.softmax(c)
+    assert c.dtype == paddle.bfloat16
+    assert s.dtype == paddle.float32  # black list stays f32
+    d = paddle.matmul(a, b)
+    assert d.dtype == paddle.float32  # autocast off outside
+
+
+def test_autocast_custom_lists_restored():
+    white0 = set(amp_state.WHITE_LIST)
+    black0 = set(amp_state.BLACK_LIST)
+    with paddle.amp.auto_cast(custom_black_list={"matmul"}):
+        a = paddle.randn([4, 4])
+        c = paddle.matmul(a, a)
+        assert c.dtype == paddle.float32
+    assert amp_state.WHITE_LIST == white0
+    assert amp_state.BLACK_LIST == black0
+    with paddle.amp.auto_cast():
+        c2 = paddle.matmul(paddle.randn([4, 4]), paddle.randn([4, 4]))
+    assert c2.dtype == paddle.bfloat16
+
+
+def test_grad_scaler_roundtrip():
+    w = paddle.to_tensor([1.0], stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+    loss = (w * 3.0).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    # user-side unscale then step: must not double-unscale
+    scaler.unscale_(opt)
+    np.testing.assert_allclose(w.grad.numpy(), [3.0], rtol=1e-6)
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(w.numpy(), [1.0 - 0.3], rtol=1e-5)
+
+
+def test_grad_scaler_inf_skips_step():
+    w = paddle.to_tensor([1.0], stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+    (w * 3.0).sum().backward()
+    w.grad.set_value(np.asarray([np.inf], np.float32))
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(w.numpy(), [1.0])  # step skipped
+    assert scaler._scale < 4.0  # scale backed off
+
+
+def test_o2_decorate_keeps_norms_fp32():
+    net = nn.Sequential(nn.Linear(4, 8), nn.LayerNorm(8), nn.Linear(8, 2))
+    net = paddle.amp.decorate(net, level="O2", dtype="bfloat16")
+    assert net[0].weight.dtype == paddle.bfloat16
+    assert net[1].weight.dtype == paddle.float32
+    y = net(paddle.randn([2, 4]).astype("bfloat16"))
+    assert y.shape == [2, 2]

@@ -182,3 +182,58 @@ def test_int_inputs_not_differentiated():
     y = paddle.gather(x, idx)
     y.sum().backward()
     np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0])
+
+
+def test_pylayer_stop_gradient_alignment():
+    """Backward returns one grad per forward tensor input; stop-gradient
+    positions get None and must not shift later grads."""
+    class TwoIn(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x, w):
+            return x * w
+
+        @staticmethod
+        def backward(ctx, g):
+            return None, g * 5.0  # x is stop-gradient, w gets 5*g
+
+    x = paddle.to_tensor([2.0])                       # stop_gradient=True
+    w = paddle.to_tensor([3.0], stop_gradient=False)
+    y = TwoIn.apply(x, w)
+    y.sum().backward()
+    np.testing.assert_allclose(w.grad.numpy(), [5.0])
+    assert x.grad is None
+
+
+def test_pylayer_saved_tensor_is_method():
+    class Square(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, g):
+            (x,) = ctx.saved_tensor()
+            return g * 2.0 * x
+
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    Square.apply(x).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_backward_nonscalar_defaults_to_ones():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+    y = x * 3.0
+    y.backward()  # non-scalar: implicit ones
+    np.testing.assert_allclose(x.grad.numpy(), np.full((2, 2), 3.0))
+
+
+def test_embedding_padding_idx_no_grad():
+    import paddle_tpu.nn as nn
+    emb = nn.Embedding(5, 3, padding_idx=0)
+    ids = paddle.to_tensor([[0, 1], [2, 0]])
+    out = emb(ids)
+    out.sum().backward()
+    g = emb.weight.grad.numpy()
+    np.testing.assert_allclose(g[0], np.zeros(3))   # padding row: zero grad
+    assert np.abs(g[1]).sum() > 0
