@@ -147,16 +147,17 @@ class GradScaler:
             return
         self._unscaled = True
         inv = 1.0 / self._scale
-        found = False
+        # one fused finite-check with a single host sync at the end — per-grad
+        # bool() syncs would stall the TPU dispatch queue once per parameter
+        bad_count = jnp.zeros((), jnp.int32)
         for p in (optimizer._parameter_list or []):
             g = p._grad
             if g is None:
                 continue
             arr = g._data.astype(jnp.float32) * inv
-            if not bool(jnp.all(jnp.isfinite(arr))):
-                found = True
+            bad_count = bad_count + jnp.sum(~jnp.isfinite(arr)).astype(jnp.int32)
             g._data = arr.astype(g._data.dtype) if g._data.dtype != jnp.float32 else arr
-        self._found_inf = found
+        self._found_inf = bool(bad_count > 0)
 
     def step(self, optimizer):
         """Unscale (if the user hasn't already) and step when grads are

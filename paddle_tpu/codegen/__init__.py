@@ -1,0 +1,19 @@
+"""Op schema + code generation pipeline.
+
+The reference declares every operator once in YAML
+(/root/reference/paddle/phi/ops/yaml/ops.yaml, 468 ops) and runs *five*
+generators over it (C++ API, eager ad_func, python-C, PIR dialect, static
+registry — SURVEY.md §2.2).  The TPU-native build keeps the single-schema
+idea but needs only one generator, because the "kernel" is always a pure
+JAX function and autograd/vjp comes from jax.vjp rather than generated
+GradNodes.
+
+Schema file:   paddle_tpu/ops/ops.yaml      (single source of truth)
+Generated:     paddle_tpu/ops/generated/op_registry.py
+               paddle_tpu/ops/generated/tensor_methods.py
+               paddle_tpu/Tensor.pyi        (typing stub, like the
+                                             reference's tools/gen_tensor_stub.py)
+
+Regenerate with:  python -m paddle_tpu.codegen
+"""
+from .schema import OpSpec, ArgSpec, load_schema  # noqa: F401

@@ -1,0 +1,10 @@
+import sys
+
+from .generate import generate_all
+
+if __name__ == "__main__":
+    if "--bootstrap" in sys.argv:
+        from .bootstrap import main as bootstrap_main
+        bootstrap_main()
+    n = generate_all()
+    print(f"generated registry/methods/stub for {n} ops")

@@ -1,0 +1,122 @@
+"""One-time schema bootstrap: introspect the live op surface into ops.yaml.
+
+After bootstrap the YAML is the source of truth — a conformance test
+(tests/test_op_schema.py) fails if the live surface and the schema drift,
+at which point the schema (not this script) is edited.
+"""
+from __future__ import annotations
+
+import inspect
+
+from .schema import ArgSpec, OpSpec, dump_schema
+
+_TENSOR_NAMES = {
+    "x", "y", "input", "label", "weight", "bias", "index", "other", "a", "b",
+    "tensor", "logit", "logits", "target", "grad", "updates", "mask", "query",
+    "key", "value", "indices", "params", "arr", "xs", "ys", "mat", "vec",
+    "condition", "img", "im", "boxes", "scores", "hidden", "src", "tgt",
+    "x1", "x2", "running_mean", "running_var", "mean", "variance",
+}
+_TYPE_BY_NAME = {
+    "dtype": "DType", "axis": "int|list", "dim": "int", "name": "str",
+    "keepdim": "bool", "shape": "IntArray", "num_classes": "int",
+    "seed": "int", "place": "Place",
+}
+
+
+def _infer_type(p: inspect.Parameter, index: int) -> str:
+    n = p.name
+    if n in _TYPE_BY_NAME:
+        return _TYPE_BY_NAME[n]
+    if n in _TENSOR_NAMES:
+        return "Tensor"
+    if p.default is not inspect.Parameter.empty:
+        d = p.default
+        if isinstance(d, bool):
+            return "bool"
+        if isinstance(d, int):
+            return "int"
+        if isinstance(d, float):
+            return "float"
+        if isinstance(d, str):
+            return "str"
+        if isinstance(d, (list, tuple)):
+            return "list"
+        return "any"
+    # positional, no default, not a known scalar name: tensors lead signatures
+    return "Tensor" if index == 0 else "any"
+
+
+def _spec_from_fn(name, fn, module_name, bound_methods) -> OpSpec | None:
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return None
+    args = []
+    for i, p in enumerate(sig.parameters.values()):
+        if p.kind in (inspect.Parameter.VAR_POSITIONAL,
+                      inspect.Parameter.VAR_KEYWORD):
+            args.append(ArgSpec(name=("*" + p.name) if
+                                p.kind == inspect.Parameter.VAR_POSITIONAL
+                                else "**" + p.name, type="any"))
+            continue
+        kw = {"name": p.name, "type": _infer_type(p, i)}
+        if p.default is not inspect.Parameter.empty:
+            kw["default"] = repr(p.default)
+        args.append(ArgSpec(**kw))
+    short = module_name.rsplit(".", 1)[-1]
+    return OpSpec(
+        name=name, module=module_name, args=args,
+        returns="Tensor",
+        tensor_method=(name in bound_methods),
+        differentiable=short not in ("logic", "random", "creation"),
+    )
+
+
+def bootstrap() -> list[OpSpec]:
+    import paddle_tpu  # noqa: F401 — triggers monkey_patch_tensor
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu import ops as ops_pkg
+    from paddle_tpu.ops import (creation, indexing, linalg, logic,
+                                manipulation, math, random)
+    import paddle_tpu.nn.functional as F
+
+    specs: dict[str, OpSpec] = {}
+    modules = [math, manipulation, logic, linalg, creation, random, indexing]
+    for mod in modules:
+        for name in getattr(mod, "__all__", ()):
+            fn = getattr(mod, name, None)
+            if not callable(fn) or name in specs:
+                continue
+            # bound as a Tensor method iff the attribute IS this op function
+            bound = {name} if getattr(Tensor, name, None) is fn else set()
+            s = _spec_from_fn(name, fn, mod.__name__, bound)
+            if s:
+                specs[name] = s
+
+    # nn.functional surface (reference: python/paddle/nn/functional/)
+    import paddle_tpu.nn.functional as fpkg
+    for name in sorted(getattr(fpkg, "__all__", []) or
+                       [n for n in dir(fpkg) if not n.startswith("_")]):
+        fn = getattr(fpkg, name, None)
+        if not callable(fn) or inspect.isclass(fn) or name in specs:
+            continue
+        mod_name = getattr(fn, "__module__", fpkg.__name__)
+        if not mod_name.startswith("paddle_tpu"):
+            continue
+        s = _spec_from_fn(name, fn, mod_name, set())
+        if s:
+            s.differentiable = True
+            specs[name] = s
+
+    return list(specs.values())
+
+
+def main():
+    specs = bootstrap()
+    path = dump_schema(specs)
+    print(f"wrote {len(specs)} op specs -> {path}")
+
+
+if __name__ == "__main__":
+    main()

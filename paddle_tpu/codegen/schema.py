@@ -1,0 +1,139 @@
+"""Op schema model + YAML loader.
+
+One entry per public operator.  YAML format (cf. the reference's
+paddle/phi/ops/yaml/ops.yaml:8-18 `abs` entry — args/output/infer_meta/kernel;
+here infer_meta+kernel collapse into the JAX impl, which is shape-polymorphic
+and jit-compiled per aval):
+
+- op: add
+  module: paddle_tpu.ops.math        # where the impl lives
+  args: [x: Tensor, y: Tensor]       # ordered; Tensor / Scalar / IntArray /
+                                     #   DType / int / float / bool / str /
+                                     #   list / any (+ "= default")
+  returns: Tensor                    # Tensor | Tensor[] | tuple | none
+  tensor_method: true                # bound as a Tensor method
+  aliases: []                        # extra public names for the same impl
+  inplace: add_                      # name of the inplace variant, if any
+  differentiable: true               # has a grad path (via jax.vjp)
+"""
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from pathlib import Path
+
+import yaml
+
+SCHEMA_PATH = Path(__file__).resolve().parent.parent / "ops" / "ops.yaml"
+
+
+_NO_DEFAULT = "__NO_DEFAULT__"
+
+
+@dataclasses.dataclass(frozen=True)
+class ArgSpec:
+    name: str
+    type: str = "any"
+    default: str = _NO_DEFAULT  # repr() of the default, if any
+
+    @property
+    def has_default(self):
+        return self.default != _NO_DEFAULT
+
+    def to_yaml(self):
+        s = f"{self.name}: {self.type}"
+        if self.has_default:
+            s += f" = {self.default}"
+        return s
+
+    @classmethod
+    def from_yaml(cls, s: str) -> "ArgSpec":
+        head, _, default = s.partition("=")
+        default = default.strip()
+        name, _, typ = head.partition(":")
+        kw = {"name": name.strip(), "type": (typ.strip() or "any")}
+        if default:
+            kw["default"] = default
+        return cls(**kw)
+
+
+@dataclasses.dataclass
+class OpSpec:
+    name: str
+    module: str
+    args: list[ArgSpec]
+    returns: str = "Tensor"
+    tensor_method: bool = False
+    aliases: list[str] = dataclasses.field(default_factory=list)
+    inplace: str | None = None
+    differentiable: bool = True
+
+    def resolve(self):
+        """Import and return the implementing callable."""
+        mod = importlib.import_module(self.module)
+        return getattr(mod, self.name)
+
+    def to_yaml_dict(self):
+        d = {"op": self.name, "module": self.module,
+             "args": [a.to_yaml() for a in self.args],
+             "returns": self.returns}
+        if self.tensor_method:
+            d["tensor_method"] = True
+        if self.aliases:
+            d["aliases"] = list(self.aliases)
+        if self.inplace:
+            d["inplace"] = self.inplace
+        if not self.differentiable:
+            d["differentiable"] = False
+        return d
+
+    @classmethod
+    def from_yaml_dict(cls, d: dict) -> "OpSpec":
+        return cls(
+            name=d["op"], module=d["module"],
+            args=[ArgSpec.from_yaml(a) for a in d.get("args", [])],
+            returns=d.get("returns", "Tensor"),
+            tensor_method=bool(d.get("tensor_method", False)),
+            aliases=list(d.get("aliases", [])),
+            inplace=d.get("inplace"),
+            differentiable=bool(d.get("differentiable", True)),
+        )
+
+
+def load_schema(path: Path | None = None) -> list[OpSpec]:
+    path = path or SCHEMA_PATH
+    with open(path) as f:
+        raw = yaml.safe_load(f)
+    return [OpSpec.from_yaml_dict(d) for d in raw]
+
+
+def dump_schema(specs: list[OpSpec], path: Path | None = None):
+    path = path or SCHEMA_PATH
+    specs = sorted(specs, key=lambda s: (s.module, s.name))
+
+    # hand-rolled emitter: stable field order + one compact arg per line
+    lines = ["# Operator schema — single source of truth for the op surface.",
+             "# Regenerate derived code with: python -m paddle_tpu.codegen",
+             "# (format mirrors /root/reference/paddle/phi/ops/yaml/ops.yaml)",
+             ""]
+    for s in specs:
+        lines.append(f"- op: {s.name}")
+        lines.append(f"  module: {s.module}")
+        if s.args:
+            lines.append("  args:")
+            for a in s.args:
+                lines.append(f"    - \"{a.to_yaml()}\"")
+        else:
+            lines.append("  args: []")
+        lines.append(f"  returns: {s.returns}")
+        if s.tensor_method:
+            lines.append("  tensor_method: true")
+        if s.aliases:
+            lines.append(f"  aliases: [{', '.join(s.aliases)}]")
+        if s.inplace:
+            lines.append(f"  inplace: {s.inplace}")
+        if not s.differentiable:
+            lines.append("  differentiable: false")
+        lines.append("")
+    path.write_text("\n".join(lines))
+    return path

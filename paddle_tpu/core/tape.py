@@ -171,11 +171,12 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
             if not m:
                 continue
             g = next(it)
-            if p is None or p.stop_gradient:
+            if p is None:
                 continue
-            # A None/float0 gradient still consumes this edge — the upstream
-            # node's pending count must drop or it never becomes ready.
-            missing = g is None or _is_float0(g)
+            # A None/float0 gradient (or a tensor marked stop_gradient after
+            # recording) still consumes this edge — the upstream node's
+            # pending count must drop or it never becomes ready.
+            missing = g is None or _is_float0(g) or p.stop_gradient
             if not missing:
                 # non-leaf tensor hooks fire when the cotangent arrives here
                 # (leaf hooks fire inside _accumulate_grad)

@@ -28,19 +28,11 @@ PUBLIC_OPS = _collect()
 def monkey_patch_tensor():
     from ..core.tensor import Tensor
 
-    # Method surface: every public op whose first arg is a tensor.
-    skip = {"to_tensor", "meshgrid", "zeros", "ones", "full", "empty", "arange",
-            "linspace", "logspace", "eye", "tril_indices", "triu_indices",
-            "rand", "randn", "randint", "randperm", "uniform", "normal",
-            "standard_normal", "gaussian", "seed", "get_rng_state",
-            "set_rng_state", "broadcast_shape", "is_tensor", "assign",
-            "add_n", "einsum", "scatter_nd", "multi_dot", "vstack", "hstack",
-            "dstack", "broadcast_tensors", "complex_", "polar", "log_normal"}
-    for name, fn in PUBLIC_OPS.items():
-        if name in skip or name.startswith("_"):
-            continue
-        if not hasattr(Tensor, name):
-            setattr(Tensor, name, fn)
+    # Method surface: generated from the op schema (ops.yaml ->
+    # generated/tensor_methods.py), mirroring the reference's build-time
+    # generated eager_method.cc binding.
+    from .generated import bind_tensor_methods
+    bind_tensor_methods(Tensor)
 
     # Aliases matching paddle Tensor-method names.
     alias = {

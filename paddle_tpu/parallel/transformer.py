@@ -148,7 +148,6 @@ def _rope(x, theta):
     freqs = jnp.outer(pos, inv)
     cos = jnp.cos(freqs)[None, :, None, :]
     sin = jnp.sin(freqs)[None, :, None, :]
-    x1, x2 = x[..., 0::2], x[..., 1::2]
     xf = x.astype(jnp.float32)
     x1, x2 = xf[..., 0::2], xf[..., 1::2]
     out = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
@@ -224,10 +223,12 @@ def _vocab_parallel_embed(tokens, embed, cfg, hp):
     return lax.psum_scatter(out, "tp", scatter_dimension=1, tiled=True)
 
 
-def _vocab_parallel_xent(h, head, labels, cfg):
+def _vocab_parallel_xent(h, head, labels, cfg, pos_weight=None):
     """h [m, S, H] full-seq; head LOCAL [H, V/tp]; labels [m, S].
     Stable cross entropy with the vocab dim sharded over tp
-    (reference ParallelCrossEntropy, mp_ops.py)."""
+    (reference ParallelCrossEntropy, mp_ops.py).  pos_weight [S] masks
+    positions out of the mean (e.g. the final position of a shifted
+    next-token objective, which has no valid target)."""
     logits = jnp.einsum("msh,hv->msv", h.astype(jnp.float32),
                         head.astype(jnp.float32))
     v_local = logits.shape[-1]
@@ -245,7 +246,11 @@ def _vocab_parallel_xent(h, head, labels, cfg):
     picked = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
     picked = jnp.where(in_range, picked, 0.0)
     correct = lax.psum(picked, "tp")
-    return jnp.mean(gmax + jnp.log(denom) - correct)
+    per_pos = gmax + jnp.log(denom) - correct          # [m, S]
+    if pos_weight is None:
+        return jnp.mean(per_pos)
+    w = pos_weight[None, :]
+    return jnp.sum(per_pos * w) / jnp.maximum(jnp.sum(w) * per_pos.shape[0], 1.0)
 
 
 def _forward_loss(params, tokens, cfg, hp):
@@ -283,8 +288,12 @@ def _forward_loss(params, tokens, cfg, hp):
         my_tok = lax.dynamic_index_in_dim(tokens, mb, axis=0, keepdims=False)
         hN = _rms(out, params["norm_f"], cfg.rms_norm_eps)
         h_full = lax.all_gather(hN, "tp", axis=1, tiled=True)   # [m, S, H]
+        # next-token shift; final position has no target -> masked from loss
         labels = jnp.concatenate([my_tok[:, 1:], my_tok[:, :1]], axis=1)
-        mb_loss = _vocab_parallel_xent(h_full, params["head"], labels, cfg)
+        pos_w = (jnp.arange(labels.shape[1]) < labels.shape[1] - 1
+                 ).astype(jnp.float32)
+        mb_loss = _vocab_parallel_xent(h_full, params["head"], labels, cfg,
+                                       pos_weight=pos_w)
         valid = ((t - stage) >= 0) & ((t - stage) < M) & (stage == pp - 1)
         acc_loss = acc_loss + jnp.where(valid, mb_loss, 0.0)
 
