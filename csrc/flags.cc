@@ -1,0 +1,144 @@
+// Native flag registry: typed, documented, env-overridable (FLAGS_<name>).
+// Mirrors the reference's gflags-free registry
+// (/root/reference/paddle/common/flags_native.cc:556) — registration,
+// env scan at definition time, string get/set with type coercion.
+#include "include/ptcore.h"
+
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+enum class Kind { Bool = 0, Int64 = 1, Double = 2, String = 3 };
+
+struct Flag {
+  Kind kind;
+  std::string value;
+  std::string default_value;
+  std::string help;
+};
+
+std::mutex g_mu;
+std::map<std::string, Flag> g_flags;
+std::vector<std::string> g_order;
+
+bool coerce(Kind kind, const std::string& in, std::string* out) {
+  switch (kind) {
+    case Kind::Bool: {
+      std::string v;
+      for (char c : in) v += static_cast<char>(std::tolower(c));
+      if (v == "1" || v == "true" || v == "yes" || v == "on") {
+        *out = "1";
+        return true;
+      }
+      if (v == "0" || v == "false" || v == "no" || v == "off" || v.empty()) {
+        *out = "0";
+        return true;
+      }
+      return false;
+    }
+    case Kind::Int64: {
+      char* end = nullptr;
+      errno = 0;
+      long long x = std::strtoll(in.c_str(), &end, 10);
+      if (errno != 0 || end == in.c_str() || *end != '\0') return false;
+      *out = std::to_string(x);
+      return true;
+    }
+    case Kind::Double: {
+      char* end = nullptr;
+      errno = 0;
+      double x = std::strtod(in.c_str(), &end);
+      if (errno != 0 || end == in.c_str() || *end != '\0') return false;
+      *out = std::to_string(x);
+      return true;
+    }
+    case Kind::String:
+      *out = in;
+      return true;
+  }
+  return false;
+}
+
+int copy_out(const std::string& s, char* buf, size_t buflen) {
+  if (buf == nullptr || buflen == 0) return static_cast<int>(s.size());
+  size_t n = s.size() < buflen - 1 ? s.size() : buflen - 1;
+  std::memcpy(buf, s.data(), n);
+  buf[n] = '\0';
+  return static_cast<int>(s.size());
+}
+
+}  // namespace
+
+extern "C" {
+
+int ptcore_flag_define(const char* name, int kind_i, const char* default_value,
+                       const char* help) {
+  if (name == nullptr || kind_i < 0 || kind_i > 3) return PTCORE_ERR_ARG;
+  Kind kind = static_cast<Kind>(kind_i);
+  std::string value;
+  if (!coerce(kind, default_value ? default_value : "", &value))
+    return PTCORE_ERR_TYPE;
+  // env override at definition time, like the reference's
+  // ParseCommandLineFlags + env scan
+  std::string env_name = "FLAGS_" + std::string(name);
+  const char* env = std::getenv(env_name.c_str());
+  if (env != nullptr) {
+    std::string coerced;
+    if (coerce(kind, env, &coerced)) value = coerced;
+  }
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_flags.find(name);
+  if (it == g_flags.end()) {
+    g_order.push_back(name);
+    g_flags[name] = Flag{kind, value, value, help ? help : ""};
+  }
+  return PTCORE_OK;
+}
+
+int ptcore_flag_set(const char* name, const char* value) {
+  if (name == nullptr || value == nullptr) return PTCORE_ERR_ARG;
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_flags.find(name);
+  if (it == g_flags.end()) return PTCORE_ERR_NOTFOUND;
+  std::string coerced;
+  if (!coerce(it->second.kind, value, &coerced)) return PTCORE_ERR_TYPE;
+  it->second.value = coerced;
+  return PTCORE_OK;
+}
+
+int ptcore_flag_get(const char* name, char* buf, size_t buflen) {
+  if (name == nullptr) return PTCORE_ERR_ARG;
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_flags.find(name);
+  if (it == g_flags.end()) return PTCORE_ERR_NOTFOUND;
+  return copy_out(it->second.value, buf, buflen);
+}
+
+int ptcore_flag_count(void) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  return static_cast<int>(g_order.size());
+}
+
+int ptcore_flag_name_at(int index, char* buf, size_t buflen) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (index < 0 || index >= static_cast<int>(g_order.size()))
+    return PTCORE_ERR_ARG;
+  return copy_out(g_order[index], buf, buflen);
+}
+
+int ptcore_flag_help(const char* name, char* buf, size_t buflen) {
+  if (name == nullptr) return PTCORE_ERR_ARG;
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_flags.find(name);
+  if (it == g_flags.end()) return PTCORE_ERR_NOTFOUND;
+  return copy_out(it->second.help, buf, buflen);
+}
+
+const char* ptcore_version(void) { return "0.1.0"; }
+
+}  // extern "C"

@@ -1,0 +1,93 @@
+/* paddle_tpu native runtime core — C ABI.
+ *
+ * Native equivalents of the reference's C++ runtime services (SURVEY.md §2.1):
+ *   - flag registry      (ref: paddle/common/flags_native.cc)
+ *   - TCPStore           (ref: paddle/phi/core/distributed/store/tcp_store.h:121)
+ *   - memory/alloc stats (ref: paddle/phi/core/memory/stats.cc)
+ *   - prefetch ring      (ref: data_feed.cc pipelines / io prefetch)
+ *
+ * Bound to Python via ctypes (no pybind11 in this image).  All functions
+ * return 0 on success or a negative errno-style code; string/bytes outputs
+ * are copied into caller-provided buffers.
+ */
+#ifndef PTCORE_H
+#define PTCORE_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define PTCORE_OK 0
+#define PTCORE_ERR_NOTFOUND -1
+#define PTCORE_ERR_TYPE -2
+#define PTCORE_ERR_TIMEOUT -3
+#define PTCORE_ERR_IO -4
+#define PTCORE_ERR_CLOSED -5
+#define PTCORE_ERR_ARG -6
+#define PTCORE_ERR_AGAIN -7
+
+/* ---------------- flags ---------------- */
+/* kind: 0=bool, 1=int64, 2=double, 3=string */
+int ptcore_flag_define(const char* name, int kind, const char* default_value,
+                       const char* help);
+int ptcore_flag_set(const char* name, const char* value);
+/* writes value as string into buf (nul-terminated); returns length or <0 */
+int ptcore_flag_get(const char* name, char* buf, size_t buflen);
+int ptcore_flag_count(void);
+int ptcore_flag_name_at(int index, char* buf, size_t buflen);
+int ptcore_flag_help(const char* name, char* buf, size_t buflen);
+
+/* ---------------- TCPStore ---------------- */
+/* Master: start a daemon serving the KV space on port (0 = ephemeral).
+ * Returns handle >= 1, or <0.  actual_port receives the bound port. */
+int64_t ptcore_store_master_start(uint16_t port, uint16_t* actual_port);
+int ptcore_store_master_stop(int64_t handle);
+/* Client: connect to host:port, retrying until timeout_ms elapses. */
+int64_t ptcore_store_connect(const char* host, uint16_t port,
+                             int64_t timeout_ms);
+int ptcore_store_close(int64_t handle);
+int ptcore_store_set(int64_t handle, const char* key, const uint8_t* data,
+                     size_t len);
+/* Blocking get: waits until key exists or timeout. Returns value length
+ * (copied into buf up to buflen; if value is larger, returns needed size
+ * and copies nothing when buflen too small — call again). */
+int64_t ptcore_store_get(int64_t handle, const char* key, uint8_t* buf,
+                         size_t buflen, int64_t timeout_ms);
+/* Atomic add; returns new value via *result. Creates key at 0. */
+int ptcore_store_add(int64_t handle, const char* key, int64_t amount,
+                     int64_t* result);
+/* Wait until key exists (no value copy). */
+int ptcore_store_wait(int64_t handle, const char* key, int64_t timeout_ms);
+/* Delete key; returns PTCORE_OK even if missing. */
+int ptcore_store_delete(int64_t handle, const char* key);
+
+/* ---------------- memory / metric stats ---------------- */
+/* Gauges with peak tracking, keyed by (name, device_id). */
+int64_t ptcore_stat_update(const char* name, int dev, int64_t delta);
+int64_t ptcore_stat_current(const char* name, int dev);
+int64_t ptcore_stat_peak(const char* name, int dev);
+int ptcore_stat_reset_peak(const char* name, int dev);
+
+/* ---------------- prefetch ring queue ---------------- */
+/* Bounded MPMC queue of byte buffers (dataloader prefetch pipeline). */
+int64_t ptcore_ring_create(int capacity);
+int ptcore_ring_push(int64_t handle, const uint8_t* data, size_t len,
+                     int64_t timeout_ms);
+/* Returns item length (copied into buf up to buflen; if larger, returns
+ * needed size without consuming when buflen too small). */
+int64_t ptcore_ring_pop(int64_t handle, uint8_t* buf, size_t buflen,
+                        int64_t timeout_ms);
+int ptcore_ring_size(int64_t handle);
+/* close: producers done — pops drain then return PTCORE_ERR_CLOSED. */
+int ptcore_ring_close(int64_t handle);
+int ptcore_ring_destroy(int64_t handle);
+
+const char* ptcore_version(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PTCORE_H */

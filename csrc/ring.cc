@@ -1,0 +1,134 @@
+// Bounded MPMC byte-buffer queue: the dataloader prefetch pipeline's
+// hand-off between worker producers and the device-feed consumer.
+// Native analog of the reference's C++ feed pipelines
+// (/root/reference/paddle/fluid/framework/data_feed.cc channels and the
+// DataLoader prefetch queues behind python/paddle/io/reader.py:262).
+#include "include/ptcore.h"
+
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Ring {
+  explicit Ring(int capacity) : capacity(capacity) {}
+  const int capacity;
+  std::mutex mu;
+  std::condition_variable not_full;
+  std::condition_variable not_empty;
+  std::deque<std::vector<uint8_t>> items;
+  bool closed = false;
+};
+
+// shared_ptr handles: destroy() erases the map entry, but the Ring object
+// outlives any waiter still blocked inside push/pop (they hold a reference),
+// so waking them is safe.
+std::mutex g_mu;
+std::map<int64_t, std::shared_ptr<Ring>> g_rings;
+int64_t g_next = 1;
+
+std::shared_ptr<Ring> find(int64_t h) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_rings.find(h);
+  return it == g_rings.end() ? nullptr : it->second;
+}
+
+std::chrono::milliseconds clamp_timeout(int64_t ms) {
+  return std::chrono::milliseconds(ms < 0 ? 86400000 : ms);
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t ptcore_ring_create(int capacity) {
+  if (capacity <= 0) return PTCORE_ERR_ARG;
+  std::lock_guard<std::mutex> lk(g_mu);
+  int64_t h = g_next++;
+  g_rings[h] = std::make_shared<Ring>(capacity);
+  return h;
+}
+
+int ptcore_ring_push(int64_t handle, const uint8_t* data, size_t len,
+                     int64_t timeout_ms) {
+  std::shared_ptr<Ring> r = find(handle);
+  if (r == nullptr || (data == nullptr && len > 0)) return PTCORE_ERR_ARG;
+  std::unique_lock<std::mutex> lk(r->mu);
+  auto deadline = Clock::now() + clamp_timeout(timeout_ms);
+  while (static_cast<int>(r->items.size()) >= r->capacity && !r->closed) {
+    if (r->not_full.wait_until(lk, deadline) == std::cv_status::timeout &&
+        static_cast<int>(r->items.size()) >= r->capacity)
+      return PTCORE_ERR_TIMEOUT;
+  }
+  if (r->closed) return PTCORE_ERR_CLOSED;
+  r->items.emplace_back(data, data + len);
+  r->not_empty.notify_one();
+  return PTCORE_OK;
+}
+
+int64_t ptcore_ring_pop(int64_t handle, uint8_t* buf, size_t buflen,
+                        int64_t timeout_ms) {
+  std::shared_ptr<Ring> r = find(handle);
+  if (r == nullptr) return PTCORE_ERR_ARG;
+  std::unique_lock<std::mutex> lk(r->mu);
+  auto deadline = Clock::now() + clamp_timeout(timeout_ms);
+  while (r->items.empty()) {
+    if (r->closed) return PTCORE_ERR_CLOSED;
+    if (r->not_empty.wait_until(lk, deadline) == std::cv_status::timeout &&
+        r->items.empty())
+      return PTCORE_ERR_TIMEOUT;
+  }
+  auto& front = r->items.front();
+  int64_t n = static_cast<int64_t>(front.size());
+  if (static_cast<size_t>(n) > buflen)
+    return n;  // tell caller required size; do not consume
+  if (n > 0 && buf != nullptr) std::memcpy(buf, front.data(), front.size());
+  r->items.pop_front();
+  r->not_full.notify_one();
+  return n;
+}
+
+int ptcore_ring_size(int64_t handle) {
+  std::shared_ptr<Ring> r = find(handle);
+  if (r == nullptr) return PTCORE_ERR_ARG;
+  std::lock_guard<std::mutex> lk(r->mu);
+  return static_cast<int>(r->items.size());
+}
+
+int ptcore_ring_close(int64_t handle) {
+  std::shared_ptr<Ring> r = find(handle);
+  if (r == nullptr) return PTCORE_ERR_ARG;
+  std::lock_guard<std::mutex> lk(r->mu);
+  r->closed = true;
+  r->not_empty.notify_all();
+  r->not_full.notify_all();
+  return PTCORE_OK;
+}
+
+int ptcore_ring_destroy(int64_t handle) {
+  std::shared_ptr<Ring> r;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = g_rings.find(handle);
+    if (it == g_rings.end()) return PTCORE_ERR_NOTFOUND;
+    r = it->second;
+    g_rings.erase(it);
+  }
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->closed = true;
+    r->not_empty.notify_all();
+    r->not_full.notify_all();
+  }
+  // freed when the last waiter's reference drops
+  return PTCORE_OK;
+}
+
+}  // extern "C"

@@ -1,0 +1,57 @@
+// Memory / metric stat gauges with peak tracking.
+// Native analog of the reference's memory stats
+// (/root/reference/paddle/phi/core/memory/stats.cc — per-device
+// Allocated/Reserved gauges behind paddle.device.cuda.max_memory_allocated)
+// generalized to named gauges so the profiler and allocator-view share it.
+#include "include/ptcore.h"
+
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+
+struct Gauge {
+  int64_t current = 0;
+  int64_t peak = 0;
+};
+
+std::mutex g_mu;
+std::map<std::pair<std::string, int>, Gauge> g_gauges;
+
+}  // namespace
+
+extern "C" {
+
+int64_t ptcore_stat_update(const char* name, int dev, int64_t delta) {
+  if (name == nullptr) return PTCORE_ERR_ARG;
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto& g = g_gauges[{name, dev}];
+  g.current += delta;
+  if (g.current > g.peak) g.peak = g.current;
+  return g.current;
+}
+
+int64_t ptcore_stat_current(const char* name, int dev) {
+  if (name == nullptr) return PTCORE_ERR_ARG;
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_gauges.find({name, dev});
+  return it == g_gauges.end() ? 0 : it->second.current;
+}
+
+int64_t ptcore_stat_peak(const char* name, int dev) {
+  if (name == nullptr) return PTCORE_ERR_ARG;
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_gauges.find({name, dev});
+  return it == g_gauges.end() ? 0 : it->second.peak;
+}
+
+int ptcore_stat_reset_peak(const char* name, int dev) {
+  if (name == nullptr) return PTCORE_ERR_ARG;
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_gauges.find({name, dev});
+  if (it != g_gauges.end()) it->second.peak = it->second.current;
+  return PTCORE_OK;
+}
+
+}  // extern "C"

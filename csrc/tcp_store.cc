@@ -1,0 +1,434 @@
+// TCPStore: rendezvous key-value store with a master daemon and blocking
+// clients.  Native analog of the reference's store
+// (/root/reference/paddle/phi/core/distributed/store/tcp_store.h:121 —
+// MasterDaemon with set/get/add/wait over a socket protocol), used for
+// multi-host rendezvous and barriers in the launch/control plane (device
+// collectives themselves ride XLA/ICI, not this store).
+//
+// Wire protocol (all little-endian):
+//   request : u8 cmd | u32 key_len | key bytes | i64 arg | u64 payload_len | payload
+//   response: i32 status | u64 payload_len | payload
+// cmd: 1=SET 2=GET(arg=timeout_ms) 3=ADD(arg=amount) 4=WAIT(arg=timeout_ms)
+//      5=DEL
+#include "include/ptcore.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+constexpr uint8_t kCmdSet = 1;
+constexpr uint8_t kCmdGet = 2;
+constexpr uint8_t kCmdAdd = 3;
+constexpr uint8_t kCmdWait = 4;
+constexpr uint8_t kCmdDel = 5;
+
+bool read_full(int fd, void* buf, size_t len) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (len > 0) {
+    ssize_t n = ::recv(fd, p, len, 0);
+    if (n <= 0) {
+      if (n < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t len) {
+  auto* p = static_cast<const uint8_t*>(buf);
+  while (len > 0) {
+    ssize_t n = ::send(fd, p, len, MSG_NOSIGNAL);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Master daemon
+// ---------------------------------------------------------------------------
+
+class Master {
+ public:
+  explicit Master(uint16_t port) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(port);
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+            0 ||
+        ::listen(listen_fd_, 128) < 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return;
+    }
+    socklen_t alen = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port_ = ntohs(addr.sin_port);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+  }
+
+  ~Master() { Stop(); }
+
+  bool ok() const { return listen_fd_ >= 0; }
+  uint16_t port() const { return port_; }
+
+  void Stop() {
+    bool expected = false;
+    if (!stopping_.compare_exchange_strong(expected, true)) return;
+    if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+    if (listen_fd_ >= 0) ::close(listen_fd_);
+    // Serialize with waiters: once mu_ is acquired, any Serve thread that
+    // saw stopping_==false is already registered on cv_, so notify_all
+    // cannot be missed.
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+    }
+    cv_.notify_all();
+    // Unblock Serve threads parked in recv() on their connection fds.
+    {
+      std::lock_guard<std::mutex> lk(workers_mu_);
+      for (int fd : worker_fds_) ::shutdown(fd, SHUT_RDWR);
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    std::vector<std::thread> workers;
+    {
+      std::lock_guard<std::mutex> lk(workers_mu_);
+      workers.swap(workers_);
+    }
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+  }
+
+ private:
+  void AcceptLoop() {
+    while (!stopping_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (stopping_.load()) break;
+        if (errno == EINTR) continue;
+        break;
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(workers_mu_);
+      worker_fds_.insert(fd);
+      workers_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  void Serve(int fd) {
+    while (!stopping_.load()) {
+      uint8_t cmd;
+      uint32_t key_len;
+      int64_t arg;
+      uint64_t payload_len;
+      if (!read_full(fd, &cmd, 1) || !read_full(fd, &key_len, 4)) break;
+      std::string key(key_len, '\0');
+      if (key_len > 0 && !read_full(fd, key.data(), key_len)) break;
+      if (!read_full(fd, &arg, 8) || !read_full(fd, &payload_len, 8)) break;
+      std::vector<uint8_t> payload(payload_len);
+      if (payload_len > 0 && !read_full(fd, payload.data(), payload_len))
+        break;
+
+      int32_t status = PTCORE_OK;
+      std::vector<uint8_t> reply;
+      switch (cmd) {
+        case kCmdSet: {
+          std::lock_guard<std::mutex> lk(mu_);
+          kv_[key] = std::move(payload);
+          cv_.notify_all();
+          break;
+        }
+        case kCmdGet: {
+          std::unique_lock<std::mutex> lk(mu_);
+          if (!WaitForKey(lk, key, arg)) {
+            status = PTCORE_ERR_TIMEOUT;
+          } else {
+            reply = kv_[key];
+          }
+          break;
+        }
+        case kCmdAdd: {
+          std::lock_guard<std::mutex> lk(mu_);
+          int64_t cur = 0;
+          auto it = kv_.find(key);
+          if (it != kv_.end() && it->second.size() == 8)
+            std::memcpy(&cur, it->second.data(), 8);
+          cur += arg;
+          std::vector<uint8_t> v(8);
+          std::memcpy(v.data(), &cur, 8);
+          kv_[key] = std::move(v);
+          reply.resize(8);
+          std::memcpy(reply.data(), &cur, 8);
+          cv_.notify_all();
+          break;
+        }
+        case kCmdWait: {
+          std::unique_lock<std::mutex> lk(mu_);
+          if (!WaitForKey(lk, key, arg)) status = PTCORE_ERR_TIMEOUT;
+          break;
+        }
+        case kCmdDel: {
+          std::lock_guard<std::mutex> lk(mu_);
+          kv_.erase(key);
+          break;
+        }
+        default:
+          status = PTCORE_ERR_ARG;
+      }
+      uint64_t rlen = reply.size();
+      if (!write_full(fd, &status, 4) || !write_full(fd, &rlen, 8) ||
+          (rlen > 0 && !write_full(fd, reply.data(), rlen)))
+        break;
+    }
+    {
+      std::lock_guard<std::mutex> lk(workers_mu_);
+      worker_fds_.erase(fd);
+    }
+    ::close(fd);
+  }
+
+  // mu_ held; releases while waiting
+  bool WaitForKey(std::unique_lock<std::mutex>& lk, const std::string& key,
+                  int64_t timeout_ms) {
+    auto deadline = Clock::now() + std::chrono::milliseconds(
+                                       timeout_ms < 0 ? 86400000 : timeout_ms);
+    while (kv_.find(key) == kv_.end()) {
+      if (stopping_.load()) return false;
+      if (cv_.wait_until(lk, deadline) == std::cv_status::timeout &&
+          kv_.find(key) == kv_.end())
+        return false;
+    }
+    return true;
+  }
+
+  int listen_fd_ = -1;
+  uint16_t port_ = 0;
+  std::atomic<bool> stopping_{false};
+  std::thread accept_thread_;
+  std::mutex workers_mu_;
+  std::vector<std::thread> workers_;
+  std::set<int> worker_fds_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::vector<uint8_t>> kv_;
+};
+
+// ---------------------------------------------------------------------------
+// Client
+// ---------------------------------------------------------------------------
+
+class Client {
+ public:
+  Client(const std::string& host, uint16_t port, int64_t timeout_ms) {
+    auto deadline =
+        Clock::now() + std::chrono::milliseconds(timeout_ms <= 0 ? 1 : timeout_ms);
+    // retry-connect until the master daemon is up (rendezvous race)
+    while (Clock::now() < deadline && fd_ < 0) {
+      fd_ = TryConnect(host, port);
+      if (fd_ < 0)
+        std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+  }
+
+  ~Client() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  bool ok() const { return fd_ >= 0; }
+
+  int Request(uint8_t cmd, const std::string& key, int64_t arg,
+              const uint8_t* payload, size_t payload_len,
+              std::vector<uint8_t>* reply) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (fd_ < 0) return PTCORE_ERR_CLOSED;
+    uint32_t key_len = static_cast<uint32_t>(key.size());
+    uint64_t plen = payload_len;
+    if (!write_full(fd_, &cmd, 1) || !write_full(fd_, &key_len, 4) ||
+        (key_len > 0 && !write_full(fd_, key.data(), key_len)) ||
+        !write_full(fd_, &arg, 8) || !write_full(fd_, &plen, 8) ||
+        (plen > 0 && !write_full(fd_, payload, plen)))
+      return Fail();
+    int32_t status;
+    uint64_t rlen;
+    if (!read_full(fd_, &status, 4) || !read_full(fd_, &rlen, 8))
+      return Fail();
+    std::vector<uint8_t> r(rlen);
+    if (rlen > 0 && !read_full(fd_, r.data(), rlen)) return Fail();
+    if (reply != nullptr) *reply = std::move(r);
+    return status;
+  }
+
+ private:
+  int Fail() {
+    ::close(fd_);
+    fd_ = -1;
+    return PTCORE_ERR_IO;
+  }
+
+  static int TryConnect(const std::string& host, uint16_t port) {
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (::getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints,
+                      &res) != 0)
+      return -1;
+    int fd = -1;
+    for (addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+      fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+      if (fd < 0) continue;
+      if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+      ::close(fd);
+      fd = -1;
+    }
+    ::freeaddrinfo(res);
+    if (fd >= 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    }
+    return fd;
+  }
+
+  int fd_ = -1;
+  std::mutex mu_;
+};
+
+// shared_ptr handles: a concurrent close() erases the map entry but the
+// object stays alive until in-flight Request()s drop their reference.
+std::mutex g_handles_mu;
+std::map<int64_t, std::shared_ptr<Master>> g_masters;
+std::map<int64_t, std::shared_ptr<Client>> g_clients;
+int64_t g_next_handle = 1;
+
+std::shared_ptr<Client> find_client(int64_t h) {
+  std::lock_guard<std::mutex> lk(g_handles_mu);
+  auto it = g_clients.find(h);
+  return it == g_clients.end() ? nullptr : it->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t ptcore_store_master_start(uint16_t port, uint16_t* actual_port) {
+  auto m = std::make_shared<Master>(port);
+  if (!m->ok()) return PTCORE_ERR_IO;
+  if (actual_port != nullptr) *actual_port = m->port();
+  std::lock_guard<std::mutex> lk(g_handles_mu);
+  int64_t h = g_next_handle++;
+  g_masters[h] = std::move(m);
+  return h;
+}
+
+int ptcore_store_master_stop(int64_t handle) {
+  std::shared_ptr<Master> m;
+  {
+    std::lock_guard<std::mutex> lk(g_handles_mu);
+    auto it = g_masters.find(handle);
+    if (it == g_masters.end()) return PTCORE_ERR_NOTFOUND;
+    m = it->second;
+    g_masters.erase(it);
+  }
+  m->Stop();
+  return PTCORE_OK;
+}
+
+int64_t ptcore_store_connect(const char* host, uint16_t port,
+                             int64_t timeout_ms) {
+  if (host == nullptr) return PTCORE_ERR_ARG;
+  auto c = std::make_shared<Client>(host, port, timeout_ms);
+  if (!c->ok()) return PTCORE_ERR_TIMEOUT;
+  std::lock_guard<std::mutex> lk(g_handles_mu);
+  int64_t h = g_next_handle++;
+  g_clients[h] = std::move(c);
+  return h;
+}
+
+int ptcore_store_close(int64_t handle) {
+  std::shared_ptr<Client> c;
+  {
+    std::lock_guard<std::mutex> lk(g_handles_mu);
+    auto it = g_clients.find(handle);
+    if (it == g_clients.end()) return PTCORE_ERR_NOTFOUND;
+    c = it->second;
+    g_clients.erase(it);
+  }
+  // destructor closes the socket once the last in-flight Request releases
+  return PTCORE_OK;
+}
+
+int ptcore_store_set(int64_t handle, const char* key, const uint8_t* data,
+                     size_t len) {
+  std::shared_ptr<Client> c = find_client(handle);
+  if (c == nullptr || key == nullptr) return PTCORE_ERR_ARG;
+  return c->Request(kCmdSet, key, 0, data, len, nullptr);
+}
+
+int64_t ptcore_store_get(int64_t handle, const char* key, uint8_t* buf,
+                         size_t buflen, int64_t timeout_ms) {
+  std::shared_ptr<Client> c = find_client(handle);
+  if (c == nullptr || key == nullptr) return PTCORE_ERR_ARG;
+  std::vector<uint8_t> reply;
+  int status = c->Request(kCmdGet, key, timeout_ms, nullptr, 0, &reply);
+  if (status != PTCORE_OK) return status;
+  if (reply.size() <= buflen && buf != nullptr)
+    std::memcpy(buf, reply.data(), reply.size());
+  return static_cast<int64_t>(reply.size());
+}
+
+int ptcore_store_add(int64_t handle, const char* key, int64_t amount,
+                     int64_t* result) {
+  std::shared_ptr<Client> c = find_client(handle);
+  if (c == nullptr || key == nullptr) return PTCORE_ERR_ARG;
+  std::vector<uint8_t> reply;
+  int status = c->Request(kCmdAdd, key, amount, nullptr, 0, &reply);
+  if (status != PTCORE_OK) return status;
+  if (reply.size() == 8 && result != nullptr)
+    std::memcpy(result, reply.data(), 8);
+  return PTCORE_OK;
+}
+
+int ptcore_store_wait(int64_t handle, const char* key, int64_t timeout_ms) {
+  std::shared_ptr<Client> c = find_client(handle);
+  if (c == nullptr || key == nullptr) return PTCORE_ERR_ARG;
+  return c->Request(kCmdWait, key, timeout_ms, nullptr, 0, nullptr);
+}
+
+int ptcore_store_delete(int64_t handle, const char* key) {
+  std::shared_ptr<Client> c = find_client(handle);
+  if (c == nullptr || key == nullptr) return PTCORE_ERR_ARG;
+  return c->Request(kCmdDel, key, 0, nullptr, 0, nullptr);
+}
+
+}  // extern "C"

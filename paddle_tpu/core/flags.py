@@ -5,6 +5,11 @@ TPU-native analog of the reference flag system
 python surface /root/reference/python/paddle/base/framework.py:132 set_flags/get_flags).
 Flags are typed, documented, env-var overridable (FLAGS_<name>), and
 introspectable.
+
+The authoritative store is the NATIVE registry (csrc/flags.cc) when the
+native core is loaded, mirroring the reference's C++ ownership; this module
+keeps a Python-side cache so the per-op hot path (get_flag in dispatch)
+never crosses the ctypes boundary.
 """
 from __future__ import annotations
 
@@ -16,6 +21,31 @@ from typing import Any, Callable
 __all__ = ["define_flag", "set_flags", "get_flags", "flag_names"]
 
 _lock = threading.Lock()
+
+_NATIVE_KIND = {bool: 0, int: 1, float: 2, str: 3}
+
+
+def _native_lib():
+    """Return the native lib only if ALREADY loaded — never trigger a build
+    from the flag path (module-level define_flag calls run at import time;
+    compiling csrc/ there would block `import paddle_tpu` on fresh trees).
+    Pending definitions are flushed by _sync_native() once something that
+    genuinely needs the native core (store/ring/stats) loads it."""
+    from . import _native
+    return _native.peek()
+
+
+def _sync_native(lib):
+    """Mirror the whole Python registry into a freshly loaded native core."""
+    with _lock:
+        items = list(_registry.values())
+    for f in items:
+        if f.type in _NATIVE_KIND:
+            sval = ("1" if f.value else "0") if f.type is bool \
+                else str(f.value)
+            lib.ptcore_flag_define(f.name.encode(), _NATIVE_KIND[f.type],
+                                   sval.encode(), f.help.encode())
+            lib.ptcore_flag_set(f.name.encode(), sval.encode())
 
 
 @dataclass
@@ -44,10 +74,16 @@ def define_flag(name: str, default, help: str = "", type_: type | None = None,
     value = _coerce(env, typ) if env is not None else default
     with _lock:
         _registry[name] = _Flag(name, default, typ, help, value, on_change)
+    lib = _native_lib()
+    if lib is not None and typ in _NATIVE_KIND:
+        sval = ("1" if value else "0") if typ is bool else str(value)
+        lib.ptcore_flag_define(name.encode(), _NATIVE_KIND[typ],
+                               sval.encode(), help.encode())
     return value
 
 
 def set_flags(flags: dict):
+    lib = _native_lib()
     with _lock:
         for name, value in flags.items():
             key = name[len("FLAGS_"):] if name.startswith("FLAGS_") else name
@@ -55,6 +91,10 @@ def set_flags(flags: dict):
                 raise ValueError(f"Unknown flag: {name}")
             f = _registry[key]
             f.value = _coerce(value, f.type)
+            if lib is not None and f.type in _NATIVE_KIND:
+                sval = ("1" if f.value else "0") if f.type is bool \
+                    else str(f.value)
+                lib.ptcore_flag_set(key.encode(), sval.encode())
             if f.on_change is not None:
                 f.on_change(f.value)
 

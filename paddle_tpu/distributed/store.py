@@ -1,0 +1,51 @@
+"""Rendezvous store (native TCPStore).
+
+TPU-native equivalent of the reference store layer
+(/root/reference/paddle/phi/core/distributed/store/tcp_store.h:121, python
+binding paddle/fluid/pybind/communication.cc:91): a key-value store with a
+master daemon on rank 0 used for control-plane rendezvous (launch
+coordination, barriers, elastic membership).  Device collectives ride XLA
+over ICI/DCN and never touch this store.
+
+Backed by the native C++ core (csrc/tcp_store.cc) via ctypes.
+"""
+from __future__ import annotations
+
+import os
+
+from ..core._native import NativeError, TCPStore  # noqa: F401
+
+__all__ = ["TCPStore", "create_default_store", "barrier_via_store"]
+
+_default_store = None
+
+
+def create_default_store(timeout: float = 90.0):
+    """Build the process-wide store from the launch env contract
+    (MASTER_ADDR/MASTER_PORT + rank), mirroring
+    core.create_or_get_global_tcp_store (parallel.py:1134)."""
+    global _default_store
+    if _default_store is not None:
+        return _default_store
+    host = os.environ.get("MASTER_ADDR", "127.0.0.1")
+    port = int(os.environ.get("MASTER_PORT", "0") or 0)
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    _default_store = TCPStore(host, port, is_master=(rank == 0),
+                              timeout=timeout)
+    return _default_store
+
+
+def barrier_via_store(store: TCPStore, prefix: str, rank: int,
+                      world_size: int, timeout: float = 90.0):
+    """Store-based host barrier: every rank bumps a counter then waits for
+    the release key written when all arrived (reference barrier-over-store
+    pattern in ProcessGroup init).
+
+    Reusable with the same prefix: the shared arrival counter derives a
+    generation number, and each generation gets its own release key.
+    """
+    n = store.add(f"{prefix}/count", 1)
+    gen = (n - 1) // world_size
+    if n == (gen + 1) * world_size:
+        store.set(f"{prefix}/release/{gen}", b"1")
+    store.wait([f"{prefix}/release/{gen}"], timeout=timeout)
