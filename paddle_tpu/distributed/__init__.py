@@ -15,7 +15,7 @@ __all__ = [
     "reduce", "scatter", "alltoall", "all_to_all", "send", "recv", "barrier",
     "ReduceOp", "new_group", "get_group", "spawn", "ProcessMesh",
     "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer", "Shard",
-    "Replicate", "Partial", "destroy_process_group",
+    "Replicate", "Partial", "destroy_process_group", "split",
 ]
 
 from .collective import (  # noqa: E402,F401
@@ -33,3 +33,4 @@ from .auto_parallel.api import (  # noqa: E402,F401
 )
 from .auto_parallel.process_mesh import ProcessMesh  # noqa: E402,F401
 from . import fleet  # noqa: E402,F401
+from .fleet.layers.mpu.mp_ops import split  # noqa: E402,F401

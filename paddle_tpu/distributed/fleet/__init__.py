@@ -7,6 +7,12 @@ submodules.
 """
 from .base import DistributedStrategy, Fleet, fleet  # noqa: F401
 from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from . import layers  # noqa: F401
+from . import utils  # noqa: F401
+from .recompute import recompute, recompute_sequential  # noqa: F401
+from .meta_parallel import (  # noqa: F401
+    HybridParallelOptimizer, PipelineParallel, TensorParallel,
+)
 
 init = fleet.init
 distributed_model = fleet.distributed_model

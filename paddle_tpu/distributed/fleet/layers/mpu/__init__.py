@@ -1,0 +1,11 @@
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .mp_ops import (  # noqa: F401
+    _c_concat, _c_identity, _c_lookup_table, _c_softmax_with_cross_entropy,
+    _c_split, _mp_allreduce, split,
+)
+from .random import (  # noqa: F401
+    RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed,
+)

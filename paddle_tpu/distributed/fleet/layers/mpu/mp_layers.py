@@ -1,0 +1,184 @@
+"""Megatron-style tensor-parallel layers.
+
+Parity with /root/reference/python/paddle/distributed/fleet/layers/mpu/
+mp_layers.py (VocabParallelEmbedding :49, ColumnParallelLinear :336,
+RowParallelLinear :543, ParallelCrossEntropy :744).
+
+TPU-native design: parameters keep their FULL logical shape and carry a
+NamedSharding over the hybrid mesh's "mp" axis (vocab dim for embeddings,
+out-dim for column, in-dim for row).  GSPMD then partitions the matmuls and
+inserts the identity/allreduce/allgather collectives the reference issues
+manually through NCCL — same math, compiler-placed comms on ICI.  With
+mp_degree==1 (or no mesh) every layer degenerates to its serial form, which
+matches the reference's fast path.  state_dicts hold full tensors, so
+checkpoints are rank-count independent (an improvement over per-rank shard
+files; distributed.checkpoint handles re-sharding).
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .....nn import functional as F
+from .....nn.initializer import Constant, XavierNormal
+from .....nn.initializer.attr import ParamAttr
+from .....nn.layer.layers import Layer
+from .mp_ops import _c_softmax_with_cross_entropy
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy"]
+
+
+def _mp_context(mp_group):
+    """Resolve (mesh, mp_axis_name, nranks) for the current fleet topology.
+    Returns (None, None, 1) when TP is degenerate."""
+    from ...base import fleet as _fleet
+    hcg = _fleet._hcg
+    if mp_group is not None and mp_group.nranks <= 1:
+        return None, None, 1
+    if hcg is None:
+        return None, None, 1
+    n = hcg.get_model_parallel_world_size()
+    if n <= 1:
+        return None, None, 1
+    mesh = hcg.get_jax_mesh()
+    if mesh is None:
+        return None, None, n
+    return mesh, "mp", n
+
+
+def _shard_param(param, mesh, spec):
+    if mesh is None or param is None:
+        return param
+    param._data = jax.device_put(param._data, NamedSharding(mesh, spec))
+    return param
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over the TP group
+    (reference mp_layers.py:49)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.mesh, self.mp_axis, self.world_size = _mp_context(mp_group)
+        if num_embeddings % self.world_size != 0:
+            raise ValueError(
+                f"vocab size {num_embeddings} must divide mp degree "
+                f"{self.world_size}")
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.is_mp = self.world_size > 1
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim],
+            attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=XavierNormal())
+        _shard_param(self.weight, self.mesh, P(self.mp_axis, None))
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+    def extra_repr(self):
+        return (f"{self.num_embeddings}, {self.embedding_dim}, "
+                f"mp={self.world_size}")
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with the OUT dim sharded over TP (reference mp_layers.py:336).
+    gather_output=False leaves the activation out-dim mp-sharded for a
+    following RowParallelLinear."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.mesh, self.mp_axis, self.world_size = _mp_context(mp_group)
+        if out_features % self.world_size != 0:
+            raise ValueError(
+                f"out_features {out_features} must divide mp degree "
+                f"{self.world_size}")
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.is_mp = self.world_size > 1
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=XavierNormal())
+        self.bias = (None if has_bias is False else self.create_parameter(
+            [out_features],
+            attr=None if isinstance(has_bias, (bool, type(None)))
+            else ParamAttr._to_attr(has_bias),
+            is_bias=True))
+        _shard_param(self.weight, self.mesh, P(None, self.mp_axis))
+        _shard_param(self.bias, self.mesh, P(self.mp_axis))
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.is_mp and self.mesh is not None:
+            spec = ([None] * (out.ndim - 1)) + (
+                [None] if self.gather_output else [self.mp_axis])
+            out._data = jax.device_put(
+                out._data, NamedSharding(self.mesh, P(*spec)))
+        return out
+
+    def extra_repr(self):
+        return (f"in={self.in_features}, out={self.out_features}, "
+                f"mp={self.world_size}, gather_output={self.gather_output}")
+
+
+class RowParallelLinear(Layer):
+    """Linear with the IN dim sharded over TP (reference mp_layers.py:543);
+    the partial products are summed by the compiler-inserted allreduce that
+    the reference issues as mp_allreduce."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.mesh, self.mp_axis, self.world_size = _mp_context(mp_group)
+        if in_features % self.world_size != 0:
+            raise ValueError(
+                f"in_features {in_features} must divide mp degree "
+                f"{self.world_size}")
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.is_mp = self.world_size > 1
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=XavierNormal())
+        self.bias = (self.create_parameter(
+            [out_features], is_bias=True) if has_bias else None)
+        _shard_param(self.weight, self.mesh, P(self.mp_axis, None))
+        # bias is applied AFTER the reduction -> replicated
+
+    def forward(self, x):
+        if self.is_mp and self.mesh is not None and not self.input_is_parallel:
+            spec = ([None] * (x.ndim - 1)) + [self.mp_axis]
+            x._data = jax.device_put(
+                x._data, NamedSharding(self.mesh, P(*spec)))
+        out = F.linear(x, self.weight, self.bias)
+        if self.is_mp and self.mesh is not None:
+            out._data = jax.device_put(
+                out._data,
+                NamedSharding(self.mesh, P(*([None] * out.ndim))))
+        return out
+
+    def extra_repr(self):
+        return (f"in={self.in_features}, out={self.out_features}, "
+                f"mp={self.world_size}, input_is_parallel="
+                f"{self.input_is_parallel}")
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax cross entropy over class-dim-sharded logits
+    (reference mp_layers.py:744)."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.mp_group = mp_group
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return _c_softmax_with_cross_entropy(
+            input, label, group=self.mp_group, ignore_index=self.ignore_index)

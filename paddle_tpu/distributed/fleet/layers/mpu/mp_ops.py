@@ -1,0 +1,210 @@
+"""Model-parallel communication ops.
+
+Parity with /root/reference/python/paddle/distributed/fleet/layers/mpu/mp_ops.py
+(_c_identity, _c_concat, _c_split, _mp_allreduce, _c_lookup_table,
+_c_softmax_with_cross_entropy, split).
+
+TPU-native semantics: in the single-controller model a "TP-sharded" tensor is
+a jax.Array whose last (or vocab) dim carries a NamedSharding over the mp
+mesh axis; GSPMD materialises the collectives.  Two execution regimes:
+
+- traced (inside shard_map over a mesh that has the group's axis name):
+  emit explicit lax collectives — identical to the reference's NCCL calls
+  but compiled onto ICI;
+- eager: the group degenerates (nranks==1 fast path, matching the reference)
+  or the arrays are mesh-sharded and resharding is a device_put.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .....core.tensor import Tensor
+from .... import collective as C
+
+__all__ = ["_c_identity", "_c_concat", "_c_split", "_mp_allreduce",
+           "_c_lookup_table", "_c_softmax_with_cross_entropy", "split"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _traced(x) -> bool:
+    return isinstance(_arr(x), jax.core.Tracer)
+
+
+def _axis_of(group):
+    g = group or C.get_group(0)
+    return g.axis_name if g is not None else None
+
+
+def _nranks(group):
+    g = group or C.get_group(0)
+    return g.nranks if g is not None else 1
+
+
+def _c_identity(tensor, group=None, skip_c_identity_dynamic=False):
+    """Forward identity; backward allreduce over the mp group (the entry
+    point of a column-parallel region)."""
+    if _nranks(group) <= 1:
+        return tensor
+    axis = _axis_of(group)
+    if _traced(tensor) and axis is not None:
+        arr = _arr(tensor)
+
+        @jax.custom_vjp
+        def ident(x):
+            return x
+
+        def fwd(x):
+            return x, None
+
+        def bwd(_, g):
+            return (lax.psum(g, axis),)
+
+        ident.defvjp(fwd, bwd)
+        out = ident(arr)
+        return Tensor(out) if isinstance(tensor, Tensor) else out
+    return tensor
+
+
+def _mp_allreduce(tensor, op=C.ReduceOp.SUM, group=None, use_calc_stream=True,
+                  use_model_parallel=True):
+    """Forward allreduce; backward identity (the exit of a row-parallel
+    region)."""
+    if _nranks(group) <= 1:
+        return tensor
+    axis = _axis_of(group)
+    if _traced(tensor) and axis is not None:
+        arr = _arr(tensor)
+
+        @jax.custom_vjp
+        def ar(x):
+            return lax.psum(x, axis)
+
+        def fwd(x):
+            return lax.psum(x, axis), None
+
+        def bwd(_, g):
+            return (g,)
+
+        ar.defvjp(fwd, bwd)
+        out = ar(arr)
+        return Tensor(out) if isinstance(tensor, Tensor) else out
+    return C.all_reduce(tensor, op=op, group=group)
+
+
+def _c_concat(tensor, group=None):
+    """All-gather along the LAST dim (column-parallel gather_output)."""
+    n = _nranks(group)
+    if n <= 1:
+        return tensor
+    axis = _axis_of(group)
+    if _traced(tensor) and axis is not None:
+        arr = _arr(tensor)
+        out = lax.all_gather(arr, axis, axis=arr.ndim - 1, tiled=True)
+        return Tensor(out) if isinstance(tensor, Tensor) else out
+    raise RuntimeError("eager cross-device _c_concat requires captured mode")
+
+
+def _c_split(tensor, group=None):
+    """Split along the LAST dim, keep the local rank's slice (inverse of
+    _c_concat)."""
+    n = _nranks(group)
+    if n <= 1:
+        return tensor
+    axis = _axis_of(group)
+    if _traced(tensor) and axis is not None:
+        arr = _arr(tensor)
+        size = arr.shape[-1] // n
+        idx = lax.axis_index(axis)
+        out = lax.dynamic_slice_in_dim(arr, idx * size, size, axis=arr.ndim - 1)
+        return Tensor(out) if isinstance(tensor, Tensor) else out
+    raise RuntimeError("eager cross-device _c_split requires captured mode")
+
+
+def _c_lookup_table(table, index, start_index=0, group=None, name=None):
+    """Vocab-parallel embedding lookup: `table` is the LOCAL vocab shard
+    starting at `start_index`; out-of-range ids contribute zeros and the
+    caller completes the lookup with _mp_allreduce."""
+    t, ids = _arr(table), _arr(index)
+    v_local = t.shape[0]
+    local_ids = ids - start_index
+    in_range = (local_ids >= 0) & (local_ids < v_local)
+    safe = jnp.clip(local_ids, 0, v_local - 1)
+    out = jnp.take(t, safe, axis=0)
+    out = jnp.where(in_range[..., None], out, jnp.zeros((), out.dtype))
+    return Tensor(out) if isinstance(table, Tensor) else out
+
+
+def _c_softmax_with_cross_entropy(logits, label, group=None,
+                                  return_softmax=False, ignore_index=-100):
+    """Cross entropy with the class dim sharded over the mp group.
+
+    Traced: the reference's ParallelCrossEntropy — pmax for the global max,
+    psum for the partition function and the picked logit.  Degenerate:
+    ordinary stable softmax cross entropy.
+    """
+    lg, lb = _arr(logits), _arr(label)
+    squeeze = False
+    if lb.ndim == lg.ndim and lb.shape[-1] == 1:
+        lb = lb[..., 0]
+        squeeze = True
+    n = _nranks(group)
+    axis = _axis_of(group)
+    lf = lg.astype(jnp.float32)
+    if n > 1 and _traced(logits) and axis is not None:
+        v_local = lf.shape[-1]
+        lo = lax.axis_index(axis) * v_local
+        local_max = jnp.max(lf, axis=-1)
+        gmax = lax.stop_gradient(lax.pmax(lax.stop_gradient(local_max), axis))
+        z = jnp.exp(lf - gmax[..., None])
+        denom = lax.psum(jnp.sum(z, axis=-1), axis)
+        local_label = lb - lo
+        in_range = (local_label >= 0) & (local_label < v_local)
+        safe = jnp.clip(local_label, 0, v_local - 1)
+        picked = jnp.take_along_axis(lf, safe[..., None], axis=-1)[..., 0]
+        picked = jnp.where(in_range, picked, 0.0)
+        correct = lax.psum(picked, axis)
+        loss = gmax + jnp.log(denom) - correct
+        softmax = z / denom[..., None]
+    else:
+        gmax = jnp.max(lf, axis=-1, keepdims=True)
+        z = jnp.exp(lf - gmax)
+        denom = jnp.sum(z, axis=-1)
+        picked = jnp.take_along_axis(lf, jnp.clip(lb, 0, lf.shape[-1] - 1)[..., None],
+                                     axis=-1)[..., 0]
+        loss = gmax[..., 0] + jnp.log(denom) - picked
+        softmax = z / denom[..., None]
+    if squeeze:
+        loss = loss[..., None]
+    loss_t = Tensor(loss) if isinstance(logits, Tensor) else loss
+    if return_softmax:
+        sm = Tensor(softmax) if isinstance(logits, Tensor) else softmax
+        return loss_t, sm
+    return loss_t
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """paddle.distributed.split parity
+    (/root/reference/python/paddle/distributed/collective.py split API):
+    build a TP-partitioned linear/embedding layer and apply it."""
+    from .mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                            VocabParallelEmbedding)
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1], weight_attr=weight_attr)
+        return layer(x)
+    if operation == "linear":
+        if axis == 0:
+            layer = RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False,
+                                      input_is_parallel=False)
+        else:
+            layer = ColumnParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        return layer(x)
+    raise ValueError(f"unsupported operation {operation}")

@@ -1,0 +1,84 @@
+"""Tensor-parallel RNG state tracking.
+
+Parity with /root/reference/python/paddle/distributed/fleet/layers/mpu/random.py
+(RNGStatesTracker): some random ops must agree across the TP group (e.g.
+dropout on sequence-parallel activations) while others must differ per rank
+(dropout on TP-sharded activations).  The tracker keeps named seeded streams
+and swaps the global generator while a stream is active.
+
+TPU-native: streams are independent JAX PRNG key chains (core.random_state),
+so "swap the state" is exact and cheap — no device RNG state copies.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from .....core import random_state
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed", "MODEL_PARALLEL_RNG"]
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        outer = random_state.get_rng_state()
+        random_state.seed(seed)
+        self.states_[name] = random_state.get_rng_state()
+        random_state.set_rng_state(outer)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        outer = random_state.get_rng_state()
+        random_state.set_rng_state(self.states_[name])
+        try:
+            yield
+        finally:
+            self.states_[name] = random_state.get_rng_state()
+            random_state.set_rng_state(outer)
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import random as _pyrandom
+
+    from ...base import fleet as _fleet_singleton
+    hcg = _fleet_singleton._hcg
+    rank = hcg.get_model_parallel_rank() if hcg is not None else 0
+    if seed:
+        global_seed = seed
+        local_seed = seed * 1024 + rank * 100
+    else:
+        global_seed = _pyrandom.randint(0, 655350)
+        local_seed = _pyrandom.randint(rank * 10000, (rank + 1) * 10000 - 1)
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add(MODEL_PARALLEL_RNG, local_seed)
+    random_state.seed(global_seed)

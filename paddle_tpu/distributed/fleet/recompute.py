@@ -1,0 +1,115 @@
+"""Recompute (activation checkpointing).
+
+Parity with /root/reference/python/paddle/distributed/fleet/recompute/
+recompute.py (RecomputeFunction :128, recompute :463, recompute_sequential
+:630).
+
+TPU-native notes: inside captured (jit) training the idiomatic form is
+jax.checkpoint — the hybrid trainer (paddle_tpu.parallel.transformer) uses it
+per decoder block.  This module provides the *eager* define-by-run variant:
+forward runs without building a tape, backward re-executes the function
+under grad to rebuild activations, replaying the RNG state so dropout
+patterns match (the reference preserves RNG via the mp RNGStatesTracker).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core import dispatch, random_state
+from ...core.tensor import Tensor
+from ...autograd.py_layer import PyLayer
+
+__all__ = ["recompute", "recompute_sequential", "RecomputeFunction"]
+
+
+class RecomputeFunction(PyLayer):
+    @staticmethod
+    def forward(ctx, run_function, preserve_rng_state, *args):
+        ctx.run_function = run_function
+        ctx.preserve_rng_state = preserve_rng_state
+        ctx.inputs = args
+        if preserve_rng_state:
+            ctx.rng_state = random_state.get_rng_state()
+        with dispatch.no_grad():
+            outputs = run_function(*args)
+        return outputs
+
+    @staticmethod
+    def backward(ctx, *grads):
+        # rebuild a detached copy of the inputs that requires grad where the
+        # originals did
+        detached = []
+        for a in ctx.inputs:
+            if isinstance(a, Tensor):
+                d = a.detach()
+                d.stop_gradient = a.stop_gradient
+                detached.append(d)
+            else:
+                detached.append(a)
+        saved_rng = None
+        if ctx.preserve_rng_state:
+            saved_rng = random_state.get_rng_state()
+            random_state.set_rng_state(ctx.rng_state)
+        try:
+            with dispatch.enable_grad():
+                outputs = ctx.run_function(*detached)
+        finally:
+            if saved_rng is not None:
+                random_state.set_rng_state(saved_rng)
+        outs = outputs if isinstance(outputs, (tuple, list)) else (outputs,)
+        out_tensors = [o for o in outs if isinstance(o, Tensor)
+                       and not o.stop_gradient]
+        grad_list = [Tensor(g) if not isinstance(g, Tensor) else g
+                     for g, o in zip(grads, outs)
+                     if isinstance(o, Tensor) and not o.stop_gradient]
+        from ...core.tape import backward as tape_backward
+        tape_backward(out_tensors, grad_list, retain_graph=False)
+        input_grads = []
+        for a, d in zip(ctx.inputs, detached):
+            if isinstance(a, Tensor):
+                input_grads.append(None if d.grad is None else d.grad)
+            # non-tensors occupy no grad slot
+        return tuple(input_grads)
+
+
+def recompute(function, *args, **kwargs):
+    """Checkpoint `function`: don't store intermediate activations; re-run it
+    in backward."""
+    preserve = kwargs.pop("preserve_rng_state", True)
+    kwargs.pop("use_reentrant", None)
+    if kwargs:
+        raise ValueError(f"unsupported kwargs {list(kwargs)}")
+    if not dispatch.is_grad_enabled():
+        return function(*args)
+    return RecomputeFunction.apply(function, preserve, *args)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Segment a Sequential into `segments` chunks, recompute each
+    (reference recompute_sequential :630).  ctx: {"segments": int,
+    "preserve_rng_state": bool}."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else int(ctx)
+    preserve = (ctx.get("preserve_rng_state", True)
+                if isinstance(ctx, dict) else True)
+    if hasattr(functions, "children"):
+        functions = list(functions.children())
+    functions = list(functions)
+    seg_size = max(1, len(functions) // max(1, segments))
+
+    def make_seg(fs):
+        def run(*inp):
+            out = inp
+            for f in fs:
+                out = f(*out) if isinstance(out, tuple) else f(out)
+                if not isinstance(out, tuple):
+                    out = (out,)
+            return out if len(out) > 1 else out[0]
+        return run
+
+    out = args
+    for i in range(0, len(functions), seg_size):
+        seg = make_seg(functions[i:i + seg_size])
+        out = recompute(seg, *out, preserve_rng_state=preserve, **kwargs)
+        if not isinstance(out, tuple):
+            out = (out,)
+    return out if len(out) > 1 else out[0]

@@ -202,3 +202,33 @@ class HybridCommunicateGroup:
     def get_rank_from_stage(self, stage_id, **kwargs):
         return self._topo.get_rank_from_stage(self.global_rank, pipe=stage_id,
                                               **kwargs)
+
+    def get_jax_mesh(self):
+        """Materialize the hybrid topology as a jax Mesh with axes
+        (pp, sep, sharding, dp, mp) — the TPU-native backing for TP/SP
+        layers.  Returns None when the local device count can't host the
+        topology (then layers degenerate to serial)."""
+        if getattr(self, "_jax_mesh", None) is not None:
+            return self._jax_mesh
+        import jax
+
+        alias = {"pipe": "pp", "sep": "sep", "sharding": "sharding",
+                 "data": "dp", "model": "mp"}
+        present = self._topo.get_hybrid_group_names()
+        # mesh axis order pp > sep > sharding > dp > mp (TP innermost rides
+        # the fastest ICI links), restricted to axes the topology declares
+        order = [n for n in ("pipe", "sep", "sharding", "data", "model")
+                 if n in present]
+        world = self._topo.world_size
+        if len(jax.devices()) < world:
+            return None
+        # rank r's coordinate in the reference topology maps to device r:
+        # permute the row-major rank grid from topology order to mesh order
+        topo_dims = [self._topo.get_dim(n) for n in present]
+        grid = np.arange(world).reshape(topo_dims)
+        perm = [present.index(n) for n in order]
+        rank_grid = np.transpose(grid, perm)
+        from ..auto_parallel.process_mesh import ProcessMesh
+        self._jax_mesh = ProcessMesh(
+            rank_grid, [alias[n] for n in order]).jax_mesh()
+        return self._jax_mesh

@@ -1,0 +1,6 @@
+from . import sequence_parallel_utils  # noqa: F401
+
+
+def recompute(function, *args, **kwargs):
+    from ...fleet.recompute import recompute as _rc
+    return _rc(function, *args, **kwargs)

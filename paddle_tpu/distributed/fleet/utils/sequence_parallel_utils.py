@@ -1,0 +1,265 @@
+"""Megatron sequence parallelism utilities.
+
+Parity with /root/reference/python/paddle/distributed/fleet/utils/
+sequence_parallel_utils.py (ScatterOp/GatherOp/AllGatherOp/ReduceScatterOp
+PyLayers :85-127, mark_as_sequence_parallel_parameter :192,
+ColumnSequenceParallelLinear :257, RowSequenceParallelLinear :429).
+
+TPU-native: between TP blocks activations stay sequence-sharded over the mp
+axis.  Under shard_map tracing the ops are the exact lax collectives (whose
+transposes ARE the reference's hand-written backward pairs: all_gather^T =
+psum_scatter, ppermute^T = reverse ppermute).  In single-controller eager
+mode the ops place a sharding constraint on the seq dim and let GSPMD move
+the data.  mp_degree==1 degenerates to identity.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....autograd.py_layer import PyLayer
+from ....core.tensor import Tensor
+from ....nn import functional as F
+from ....nn.initializer.attr import ParamAttr
+from ....nn.layer.layers import Layer
+from ..layers.mpu.mp_layers import _mp_context, _shard_param
+
+__all__ = ["ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+           "scatter", "all_gather", "reduce_scatter",
+           "mark_as_sequence_parallel_parameter",
+           "is_sequence_parallel_parameter",
+           "register_sequence_parallel_allreduce_hooks",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "create_fused_allreduce_gradient_hooks"]
+
+_SEQ_AXIS = 0  # the reference scatters dim 0 of [s, b, h] activations
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _traced(x):
+    return isinstance(_arr(x), jax.core.Tracer)
+
+
+def _mp_axis_info():
+    mesh, axis, n = _mp_context(None)
+    return mesh, axis, n
+
+
+def scatter(input, group=None, axis=_SEQ_AXIS):
+    """Split the seq dim across the mp group, keep the local slice."""
+    mesh, mp_axis, n = _mp_axis_info()
+    if n <= 1:
+        return input
+    arr = _arr(input)
+    if _traced(input):
+        size = arr.shape[axis] // n
+        idx = lax.axis_index("mp")
+        out = lax.dynamic_slice_in_dim(arr, idx * size, size, axis=axis)
+        return Tensor(out) if isinstance(input, Tensor) else out
+    if mesh is not None:
+        spec = [None] * arr.ndim
+        spec[axis] = "mp"
+        out = jax.device_put(arr, NamedSharding(mesh, P(*spec)))
+        if isinstance(input, Tensor):
+            input._data = out
+            return input
+        return out
+    return input
+
+
+def all_gather(input, group=None, axis=_SEQ_AXIS):
+    """Gather the seq dim from all mp ranks."""
+    mesh, mp_axis, n = _mp_axis_info()
+    if n <= 1:
+        return input
+    arr = _arr(input)
+    if _traced(input):
+        out = lax.all_gather(arr, "mp", axis=axis, tiled=True)
+        return Tensor(out) if isinstance(input, Tensor) else out
+    if mesh is not None:
+        out = jax.device_put(
+            arr, NamedSharding(mesh, P(*([None] * arr.ndim))))
+        if isinstance(input, Tensor):
+            input._data = out
+            return input
+        return out
+    return input
+
+
+def reduce_scatter(input, group=None, axis=_SEQ_AXIS):
+    """Sum partial activations over mp and scatter the seq dim."""
+    mesh, mp_axis, n = _mp_axis_info()
+    if n <= 1:
+        return input
+    arr = _arr(input)
+    if _traced(input):
+        out = lax.psum_scatter(arr, "mp", scatter_dimension=axis, tiled=True)
+        return Tensor(out) if isinstance(input, Tensor) else out
+    # eager/GSPMD: the contraction's psum already happened inside the matmul
+    # (XLA resolves Partial at the use site); only the seq-dim re-sharding
+    # remains, which is exactly scatter's constraint.
+    return scatter(input, group=group, axis=axis)
+
+
+class ScatterOp(PyLayer):
+    """fwd scatter / bwd all_gather (reference :85)."""
+
+    @staticmethod
+    def forward(ctx, input, axis=_SEQ_AXIS):
+        ctx.axis = axis
+        return scatter(input, axis=axis)
+
+    @staticmethod
+    def backward(ctx, grad):
+        return all_gather(grad, axis=ctx.axis)
+
+
+class GatherOp(PyLayer):
+    """fwd all_gather / bwd scatter (reference :104)."""
+
+    @staticmethod
+    def forward(ctx, input, axis=_SEQ_AXIS):
+        ctx.axis = axis
+        return all_gather(input, axis=axis)
+
+    @staticmethod
+    def backward(ctx, grad):
+        return scatter(grad, axis=ctx.axis)
+
+
+class AllGatherOp(PyLayer):
+    """fwd all_gather / bwd reduce_scatter (reference :113)."""
+
+    @staticmethod
+    def forward(ctx, input):
+        return all_gather(input)
+
+    @staticmethod
+    def backward(ctx, grad):
+        return reduce_scatter(grad)
+
+
+class ReduceScatterOp(PyLayer):
+    """fwd reduce_scatter / bwd all_gather (reference :127)."""
+
+    @staticmethod
+    def forward(ctx, input):
+        return reduce_scatter(input)
+
+    @staticmethod
+    def backward(ctx, grad):
+        return all_gather(grad)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    """Parameters used inside the sequence-sharded region (layer norms)
+    produce partial grads that need an mp allreduce (reference :192).
+    Under GSPMD the reduction is compiler-inserted; the mark is kept for
+    API parity and for the explicit-hook path."""
+    parameter.sequence_parallel = True
+
+
+def is_sequence_parallel_parameter(parameter):
+    return getattr(parameter, "sequence_parallel", False)
+
+
+def create_fused_allreduce_gradient_hooks(parameter_list, accumulation_steps):
+    hooks = []
+    for p in parameter_list:
+        if is_sequence_parallel_parameter(p):
+            def hook(grad, _p=p):
+                from ... import collective as C
+                from .. import base as fleet_base
+                hcg = fleet_base.fleet._hcg
+                if hcg is None:
+                    return grad
+                g = hcg.get_model_parallel_group()
+                if g is None or g.nranks <= 1:
+                    return grad
+                return C.all_reduce(grad, group=g)
+            hooks.append(hook)
+    return hooks
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    params = [p for p in model.parameters()
+              if is_sequence_parallel_parameter(p)]
+    for p in params:
+        def hook(grad, _p=p):
+            from ... import collective as C
+            from .. import base as fleet_base
+            hcg = fleet_base.fleet._hcg
+            if hcg is None:
+                return grad
+            g = hcg.get_model_parallel_group()
+            if g is None or g.nranks <= 1:
+                return grad
+            return C.all_reduce(grad, group=g)
+        p.register_hook(hook)
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """ColumnParallelLinear whose input is sequence-sharded: all_gather the
+    seq dim in, compute the column-parallel matmul (reference :257)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        from ..layers.mpu.mp_layers import _mp_context as _ctx
+        self.mesh, self.mp_axis, self.world_size = _ctx(mp_group)
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.is_mp = self.world_size > 1
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=ParamAttr._to_attr(weight_attr))
+        self.bias = (None if has_bias is False else self.create_parameter(
+            [out_features], is_bias=True))
+        _shard_param(self.weight, self.mesh, P(None, self.mp_axis))
+        _shard_param(self.bias, self.mesh, P(self.mp_axis))
+
+    def forward(self, x):
+        if self.is_mp:
+            x = AllGatherOp.apply(x)
+        out = F.linear(x, self.weight, self.bias)
+        if self.is_mp and self.mesh is not None and not self.gather_output:
+            spec = ([None] * (out.ndim - 1)) + [self.mp_axis]
+            out._data = jax.device_put(
+                out._data, NamedSharding(self.mesh, P(*spec)))
+        return out
+
+
+class RowSequenceParallelLinear(Layer):
+    """RowParallelLinear whose output re-enters the sequence-sharded region:
+    partial products are reduce-scattered over the seq dim (reference :429)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        from ..layers.mpu.mp_layers import _mp_context as _ctx
+        self.mesh, self.mp_axis, self.world_size = _ctx(mp_group)
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.is_mp = self.world_size > 1
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=ParamAttr._to_attr(weight_attr))
+        self.bias = (self.create_parameter([out_features], is_bias=True)
+                     if has_bias else None)
+        _shard_param(self.weight, self.mesh, P(self.mp_axis, None))
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, None)
+        if self.is_mp:
+            out = ReduceScatterOp.apply(out)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
