@@ -211,7 +211,12 @@ class Tensor:
     def set_value(self, value):
         if isinstance(value, Tensor):
             value = value._data
-        arr = jnp.asarray(value, dtype=self._data.dtype)
+        if isinstance(value, jax.Array):
+            # copy: the source may later be donated (e.g. by the fused
+            # optimizer step), which would invalidate a shared buffer
+            arr = jnp.array(value, dtype=self._data.dtype, copy=True)
+        else:
+            arr = jnp.asarray(value, dtype=self._data.dtype)
         if tuple(arr.shape) != tuple(self._data.shape):
             raise ValueError(
                 f"set_value shape mismatch: {tuple(arr.shape)} vs {tuple(self._data.shape)}"

@@ -11,8 +11,10 @@ from . import layers  # noqa: F401
 from . import utils  # noqa: F401
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from .meta_parallel import (  # noqa: F401
+    GroupShardedOptimizerStage2, GroupShardedStage2, GroupShardedStage3,
     HybridParallelOptimizer, PipelineParallel, TensorParallel,
 )
+from . import meta_optimizers  # noqa: F401
 
 init = fleet.init
 distributed_model = fleet.distributed_model

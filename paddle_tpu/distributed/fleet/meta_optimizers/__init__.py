@@ -1,0 +1,1 @@
+from .dygraph_sharding_optimizer import DygraphShardingOptimizer  # noqa: F401

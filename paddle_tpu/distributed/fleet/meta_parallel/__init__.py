@@ -1,0 +1,10 @@
+from .wrappers import (  # noqa: F401
+    HybridParallelOptimizer, TensorParallel, wrap_distributed_model,
+)
+from .pipeline_parallel import (  # noqa: F401
+    PipelineParallel, PipelineParallelWithInterleave,
+)
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from .sharding import (  # noqa: F401
+    GroupShardedOptimizerStage2, GroupShardedStage2, GroupShardedStage3,
+)

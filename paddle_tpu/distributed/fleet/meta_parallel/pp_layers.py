@@ -1,0 +1,217 @@
+"""Pipeline model description: LayerDesc / SharedLayerDesc / PipelineLayer.
+
+Parity with /root/reference/python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/pp_layers.py:258 (PipelineLayer): the model is described as a
+flat list of layer descriptors, segmented into `num_stages` contiguous
+stages; shared descriptors (tied embeddings) alias one parameter across
+stages.
+
+TPU-native: every stage's parameters are placed on that stage's device(s)
+(single-controller: jax.device_put onto jax.devices()[stage]); activations
+migrate between stages automatically when the next stage's ops consume them
+— the explicit NCCL p2p of the reference becomes XLA host-driven transfers,
+and in captured mode (paddle_tpu.parallel.transformer) ppermute over the pp
+mesh axis.
+"""
+from __future__ import annotations
+
+import math
+import re
+
+import jax
+
+from ....nn.layer.layers import Layer
+from ....nn.layer.container import LayerList, Sequential
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+def _to_stage_device(x, dev):
+    """Move a microbatch activation to the next stage's device — the XLA
+    analog of the reference's p2p send/recv (pp_utils/p2p_communication.py):
+    forward transfers src->dst, backward returns the cotangent dst->src."""
+    from ....autograd.py_layer import PyLayer
+    from ....core.tensor import Tensor
+
+    if not isinstance(x, Tensor):
+        return x
+    cur = list(x._data.devices())[0]
+    if cur == dev:
+        return x
+
+    class _Transfer(PyLayer):
+        @staticmethod
+        def forward(ctx, t):
+            ctx.src = cur
+            return Tensor(jax.device_put(t._data, dev),
+                          stop_gradient=t.stop_gradient)
+
+        @staticmethod
+        def backward(ctx, g):
+            return Tensor(jax.device_put(g._data, ctx.src))
+
+    return _Transfer.apply(x)
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Segment a layer-descriptor list into pipeline stages
+    (reference pp_layers.py:258)."""
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._topo = topology
+        self._recompute_interval = recompute_interval
+        if num_stages is None and topology is None:
+            from ..base import fleet as _fleet
+            hcg = _fleet._hcg
+            num_stages = (hcg.get_pipe_parallel_world_size()
+                          if hcg is not None else 1)
+        if num_stages is None:
+            num_stages = self._topo.get_dim("pipe")
+        self._num_stages = int(num_stages)
+        self._num_virtual = num_virtual_pipeline_stages or 1
+
+        self._descs = list(layers)
+        self._shared_layers = {}
+        built = []
+        for d in self._descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared_layers:
+                    self._shared_layers[d.layer_name] = d.build_layer()
+                base = self._shared_layers[d.layer_name]
+                if d.forward_func is None:
+                    built.append(base)
+                else:
+                    fwd, shared = d.forward_func, base
+
+                    class _SharedCall(Layer):
+                        def __init__(self):
+                            super().__init__()
+                            self._base = shared
+
+                        def forward(self, *a, **k):
+                            return fwd(self._base, *a, **k)
+                    built.append(_SharedCall())
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer):
+                built.append(d)
+            elif callable(d):
+                built.append(d)
+            else:
+                raise TypeError(f"unsupported layer description {d!r}")
+
+        self._all_layers = built
+        self.segments = self._segment(seg_method)
+        self.run_function = LayerList(
+            [l for l in built if isinstance(l, Layer)])
+        self._place_stages()
+
+    # -- segmentation ----------------------------------------------------
+    def _segment(self, seg_method):
+        n, stages = len(self._all_layers), self._num_stages * self._num_virtual
+        if seg_method == "uniform":
+            bounds = [round(i * n / stages) for i in range(stages + 1)]
+        elif seg_method.startswith("layer:"):
+            pat = seg_method[len("layer:"):]
+            marks = [i for i, l in enumerate(self._all_layers)
+                     if re.search(pat, type(l).__name__)]
+            per = math.ceil(len(marks) / stages) if marks else 1
+            bounds = [0]
+            for s in range(1, stages):
+                idx = s * per
+                bounds.append(marks[idx] if idx < len(marks) else n)
+            bounds.append(n)
+        else:
+            raise ValueError(f"unknown seg_method {seg_method}")
+        return bounds
+
+    def _place_stages(self):
+        """Place each stage's params on its pipeline device (best effort)."""
+        devs = jax.devices()
+        self._stage_devices = None
+        if self._num_stages <= 1 or len(devs) < self._num_stages:
+            return
+        self._stage_devices = devs[:self._num_stages]
+        # params referenced from more than one stage (tied embeddings) must
+        # stay UNcommitted: jax freely migrates uncommitted buffers to
+        # whichever stage device the consuming op runs on, while a committed
+        # buffer would raise an incompatible-devices error on the other stage
+        owner = {}
+        shared = set()
+        for s in range(self._num_stages):
+            for chunk in range(self._num_virtual):
+                for l in self.stage_layers(s, chunk):
+                    if isinstance(l, Layer):
+                        for p in l.parameters():
+                            if owner.setdefault(id(p), s) != s:
+                                shared.add(id(p))
+        for s in range(self._num_stages):
+            dev = devs[s]
+            for chunk in range(self._num_virtual):
+                for l in self.stage_layers(s, chunk):
+                    if isinstance(l, Layer):
+                        for p in l.parameters():
+                            if id(p) not in shared and owner[id(p)] == s:
+                                p._data = jax.device_put(p._data, dev)
+
+    # -- access ----------------------------------------------------------
+    def get_num_stages(self):
+        return self._num_stages
+
+    def stage_layers(self, stage, chunk=0):
+        i = chunk * self._num_stages + stage
+        return self._all_layers[self.segments[i]:self.segments[i + 1]]
+
+    def get_stage_from_index(self, index):
+        for s in range(len(self.segments) - 1):
+            if self.segments[s] <= index < self.segments[s + 1]:
+                return s % self._num_stages
+        return self._num_stages - 1
+
+    def forward_stage(self, x, stage, chunk=0):
+        if self._stage_devices is not None:
+            dev = self._stage_devices[stage]
+            x = (_to_stage_device(x, dev) if not isinstance(x, tuple)
+                 else tuple(_to_stage_device(t, dev) for t in x))
+        for l in self.stage_layers(stage, chunk):
+            if self._recompute_interval > 0 and isinstance(l, Layer):
+                from ..recompute import recompute
+                x = recompute(l, x) if not isinstance(x, tuple) \
+                    else recompute(l, *x)
+            else:
+                x = l(x) if not isinstance(x, tuple) else l(*x)
+        return x
+
+    def forward(self, x):
+        for chunk in range(self._num_virtual):
+            for s in range(self._num_stages):
+                x = self.forward_stage(x, s, chunk)
+        return x

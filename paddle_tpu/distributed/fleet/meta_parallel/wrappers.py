@@ -8,11 +8,11 @@ the pipeline milestone.
 """
 from __future__ import annotations
 
-from ...nn.layer.layers import Layer
-from .topology import ParallelMode
+from ....nn.layer.layers import Layer
+from ..topology import ParallelMode
 
 __all__ = ["wrap_distributed_model", "HybridParallelOptimizer",
-           "TensorParallel", "PipelineParallel"]
+           "TensorParallel"]
 
 
 class TensorParallel(Layer):
@@ -31,21 +31,20 @@ class TensorParallel(Layer):
         return self._layers.set_state_dict(state_dict, *args, **kwargs)
 
 
-class PipelineParallel(TensorParallel):
-    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
-        raise NotImplementedError(
-            "PipelineParallel.train_batch arrives with the PP-schedule "
-            "milestone (shard_map 1F1B over the pp mesh axis)")
-
-
 def wrap_distributed_model(model, hcg, strategy=None):
     if hcg is None:
         return model
+    from .pipeline_parallel import (
+        PipelineParallel, PipelineParallelWithInterleave,
+    )
+    from .pp_layers import PipelineLayer
     mode = hcg.get_parallel_mode()
     if mode == ParallelMode.DATA_PARALLEL and hcg.get_data_parallel_world_size() > 1:
-        from ..parallel import DataParallel
+        from ...parallel import DataParallel
         return DataParallel(model, group=hcg.get_data_parallel_group())
     if mode == ParallelMode.PIPELINE_PARALLEL:
+        if isinstance(model, PipelineLayer) and model._num_virtual > 1:
+            return PipelineParallelWithInterleave(model, hcg, strategy)
         return PipelineParallel(model, hcg, strategy)
     if mode == ParallelMode.TENSOR_PARALLEL:
         return TensorParallel(model, hcg, strategy)

@@ -1,0 +1,58 @@
+"""User-facing GroupSharded (ZeRO) API.
+
+Parity with /root/reference/python/paddle/distributed/sharding/
+group_sharded.py:50 (group_sharded_parallel / save_group_sharded_model).
+
+level: "os" (ZeRO-1, optimizer states), "os_g" (ZeRO-2, + gradients),
+"p_g_os" (ZeRO-3, + parameters).  See meta_parallel.sharding for the
+TPU-native sharding mechanics.
+"""
+from __future__ import annotations
+
+import os
+
+from ..fleet.meta_parallel.sharding import (
+    GroupShardedOptimizerStage2, GroupShardedStage2, GroupShardedStage3,
+)
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError("level must be one of 'os', 'os_g', 'p_g_os'")
+    params = list(model.parameters())
+    if level in ("os", "os_g"):
+        optimizer = GroupShardedOptimizerStage2(
+            params=params, optim=optimizer, group=group, offload=offload)
+        if level == "os_g":
+            model = GroupShardedStage2(
+                model, optimizer, group=group, sync_buffers=sync_buffers,
+                buffer_max_size=buffer_max_size, dp_group=dp_group)
+    else:
+        model = GroupShardedStage3(
+            model, optimizer=optimizer, group=group,
+            sync_buffers=sync_buffers, segment_size=segment_size,
+            offload=offload, sync_comm=sync_comm, dp_group=dp_group,
+            exclude_layer=exclude_layer)
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """Gather full parameters and save model (+optimizer) state
+    (reference group_sharded.py save_group_sharded_model)."""
+    from ...framework import io as fio
+    inner = model
+    while hasattr(inner, "_layers"):
+        if isinstance(inner, GroupShardedStage3):
+            inner.get_all_parameters()
+        inner = inner._layers
+    os.makedirs(output, exist_ok=True)
+    fio.save(inner.state_dict(), os.path.join(output, "model.pdmodel"))
+    if optimizer is not None:
+        opt = optimizer._optim if hasattr(optimizer, "_optim") else optimizer
+        fio.save(opt.state_dict(), os.path.join(output, "model.pdopt"))

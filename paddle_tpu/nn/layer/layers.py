@@ -10,6 +10,7 @@ import collections
 from typing import Callable, Iterator
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ...core.dtype import convert_dtype
@@ -288,7 +289,12 @@ class Layer:
                 raise ValueError(
                     f"shape mismatch for '{key}': loaded {tuple(arr.shape)} vs "
                     f"expected {tuple(target._data.shape)}")
-            target._data = arr.astype(target._data.dtype)
+            # copy (the source may later be donated by a fused optimizer
+            # step) AND re-place onto the target's own device/sharding (the
+            # source may live on another pipeline stage's device)
+            target._data = jax.device_put(
+                jnp.array(arr, dtype=target._data.dtype, copy=True),
+                target._data.sharding)
             matched.add(key)
         missing = [k for k in own if k not in matched]
         return missing, unexpected

@@ -113,6 +113,18 @@ class Optimizer:
             params_grads = self._grad_clip(params_grads)
 
         self._global_step += 1
+        # Pipeline-placed models keep each stage's params on its own device;
+        # one XLA program can't mix committed devices, so run one fused
+        # update per device group (the reference analog: per-stage optimizer
+        # instances in PP training).
+        by_dev = {}
+        for pg in params_grads:
+            key = tuple(sorted(d.id for d in pg[0]._data.devices()))
+            by_dev.setdefault(key, []).append(pg)
+        for group in by_dev.values():
+            self._step_group(group)
+
+    def _step_group(self, params_grads):
         lr = jnp.asarray(self.get_lr(), jnp.float32)
         slot_names = tuple(self._slot_names())
 

@@ -1,0 +1,107 @@
+"""GroupSharded (ZeRO-1/2/3) on the virtual 8-device CPU mesh.
+
+Mirrors the reference tests
+(test/collective/fleet/dygraph_group_sharded_stage*.py): training under each
+sharding level must match unsharded training numerically, and state buffers
+must actually be device-sharded.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import collective as C
+from paddle_tpu.distributed.sharding import (
+    group_sharded_parallel, save_group_sharded_model,
+)
+from paddle_tpu.distributed.fleet.meta_optimizers import (
+    DygraphShardingOptimizer,
+)
+
+
+def _make_model(seed=0):
+    paddle.seed(seed)
+    np.random.seed(seed)
+    m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    return m
+
+
+def _train(model, opt, steps=3, seed=42):
+    rng = np.random.RandomState(seed)
+    losses = []
+    for _ in range(steps):
+        x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+        loss = nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+def _ref_losses(level_seed=0):
+    m = _make_model(level_seed)
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=m.parameters())
+    return _train(m, opt), m
+
+
+@pytest.mark.parametrize("level", ["os", "os_g", "p_g_os"])
+def test_group_sharded_matches_unsharded(level):
+    ref_losses, _ = _ref_losses()
+
+    m = _make_model(0)
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=m.parameters())
+    group = C.new_group(list(range(4)), axis_name="sharding")
+    model, opt, _ = group_sharded_parallel(m, opt, level, group=group)
+    losses = _train(model, opt)
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4, atol=1e-5)
+
+
+def test_stage3_params_actually_sharded():
+    import jax
+
+    m = _make_model(1)
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=m.parameters())
+    group = C.new_group(list(range(4)), axis_name="sharding")
+    model, opt, _ = group_sharded_parallel(m, opt, "p_g_os", group=group)
+    w = m[0].weight._data  # [16, 32]: dim0 divisible by 4
+    shardings = {d.id for d in w.sharding.device_set}
+    assert len(shardings) == 4, "weight should live across the 4-dev group"
+    # addressable shard is 1/4 of the rows
+    shard_shape = w.addressable_shards[0].data.shape
+    assert shard_shape == (4, 32), shard_shape
+
+
+def test_zero1_optimizer_state_sharded():
+    m = _make_model(2)
+    inner = paddle.optimizer.AdamW(learning_rate=0.01,
+                                   parameters=m.parameters())
+    group = C.new_group(list(range(4)), axis_name="sharding")
+    opt = DygraphShardingOptimizer(inner, group=group)
+    # rank partition covers every trainable param exactly once
+    all_assigned = [p for ps in opt.rank2params.values() for p in ps]
+    assert len(all_assigned) == len(list(m.parameters()))
+    losses = _train(m, opt)
+    ref_losses, _ = _ref_losses(2)
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4, atol=1e-5)
+    # moment buffers are sharded over the group for divisible dims
+    st = inner._accumulators[id(m[0].weight)]
+    mom = st["moment1"]
+    assert mom.addressable_shards[0].data.shape[0] == mom.shape[0] // 4
+
+
+def test_save_group_sharded_model(tmp_path):
+    m = _make_model(3)
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=m.parameters())
+    group = C.new_group(list(range(4)), axis_name="sharding")
+    model, opt, _ = group_sharded_parallel(m, opt, "os_g", group=group)
+    _train(model, opt, steps=1)
+    out = str(tmp_path / "ckpt")
+    save_group_sharded_model(model, out, optimizer=opt)
+    state = paddle.load(out + "/model.pdmodel")
+    assert any("weight" in k for k in state)

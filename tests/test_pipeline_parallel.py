@@ -1,0 +1,135 @@
+"""Eager pipeline parallelism: PipelineLayer + 1F1B schedule.
+
+Mirrors the reference tests (test/collective/fleet/
+hybrid_parallel_pp_layer.py / hybrid_parallel_pp_alexnet.py): pipelined
+training with M microbatches must match plain training with M-step gradient
+accumulation.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    LayerDesc, PipelineLayer, PipelineParallel, SharedLayerDesc,
+)
+
+
+def _pp_strategy(pp, acc_steps=4):
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs["pp_degree"] = pp
+    s.pipeline_configs["accumulate_steps"] = acc_steps
+    return s
+
+
+@pytest.fixture()
+def pp2():
+    fleet.fleet.init(is_collective=True, strategy=_pp_strategy(2))
+    yield fleet.fleet
+    fleet.fleet.init(is_collective=True, strategy=fleet.DistributedStrategy())
+
+
+def _descs():
+    return [
+        LayerDesc(nn.Linear, 16, 32),
+        LayerDesc(nn.ReLU),
+        LayerDesc(nn.Linear, 32, 32),
+        LayerDesc(nn.ReLU),
+        LayerDesc(nn.Linear, 32, 8),
+    ]
+
+
+def test_pipeline_layer_segmentation(pp2):
+    model = PipelineLayer(layers=_descs(), num_stages=2,
+                          loss_fn=nn.MSELoss())
+    assert model.get_num_stages() == 2
+    # 5 layers over 2 stages: contiguous cover, no overlap
+    assert model.segments[0] == 0 and model.segments[-1] == 5
+    n0 = len(model.stage_layers(0))
+    n1 = len(model.stage_layers(1))
+    assert n0 + n1 == 5
+
+
+def test_pipeline_matches_grad_accumulation(pp2):
+    acc = 4
+    paddle.seed(0)
+    model = PipelineLayer(layers=_descs(), num_stages=2,
+                          loss_fn=nn.MSELoss())
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    pp_model = fleet.fleet.distributed_model(model)
+    assert isinstance(pp_model, PipelineParallel)
+
+    # clone weights into a serial reference
+    paddle.seed(0)
+    ref = PipelineLayer(layers=_descs(), num_stages=1, loss_fn=nn.MSELoss())
+    ref.set_state_dict(model.state_dict())
+    ref_opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=ref.parameters())
+
+    rng = np.random.RandomState(7)
+    x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+
+    loss = pp_model.train_batch((x, y), opt)
+
+    # reference: grad accumulation over the same microbatches
+    m = 8 // acc
+    losses = []
+    for i in range(acc):
+        xb, yb = x[i * m:(i + 1) * m], y[i * m:(i + 1) * m]
+        lo = nn.functional.mse_loss(ref(xb), yb)
+        (lo * (1.0 / acc)).backward()
+        losses.append(float(lo.numpy()))
+    ref_opt.step()
+    ref_opt.clear_grad()
+
+    np.testing.assert_allclose(loss, np.mean(losses), rtol=1e-5)
+    for (k, a), (k2, b) in zip(sorted(model.state_dict().items()),
+                               sorted(ref.state_dict().items())):
+        np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-5,
+                                   atol=1e-6, err_msg=k)
+
+
+def test_pipeline_eval_batch(pp2):
+    model = PipelineLayer(layers=_descs(), num_stages=2,
+                          loss_fn=nn.MSELoss())
+    pp_model = fleet.fleet.distributed_model(model)
+    rng = np.random.RandomState(3)
+    x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+    loss = pp_model.eval_batch((x, y))
+    assert np.isfinite(loss)
+
+
+def test_shared_layer_desc_ties_weights(pp2):
+    class Emb(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.weight = self.create_parameter([8, 8])
+
+        def forward(self, x):
+            return paddle.matmul(x, self.weight)
+
+    def tied_forward(layer, x):
+        return paddle.matmul(x, paddle.transpose(layer.weight, [1, 0]))
+
+    model = PipelineLayer(layers=[
+        SharedLayerDesc("emb", Emb),
+        LayerDesc(nn.ReLU),
+        SharedLayerDesc("emb", Emb, forward_func=tied_forward),
+    ], num_stages=2)
+    # one shared parameter instance
+    assert len(model._shared_layers) == 1
+    x = paddle.to_tensor(np.eye(8, dtype=np.float32))
+    out = model(x)
+    w = model._shared_layers["emb"].weight.numpy()
+    ref = np.maximum(w, 0) @ w.T
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+def test_seg_method_layer_pattern(pp2):
+    model = PipelineLayer(layers=_descs(), num_stages=2,
+                          seg_method="layer:Linear")
+    assert model.segments[0] == 0 and model.segments[-1] == 5
