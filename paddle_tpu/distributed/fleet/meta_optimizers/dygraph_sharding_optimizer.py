@@ -13,7 +13,8 @@ sharded slots in place during the fused update program).
 """
 from __future__ import annotations
 
-from ..meta_parallel.sharding import _shard0, sharding_mesh_for_group
+from ..meta_parallel.sharding import (
+    _shard_slot_init, sharding_mesh_for_group)
 
 __all__ = ["DygraphShardingOptimizer"]
 
@@ -27,12 +28,7 @@ class DygraphShardingOptimizer:
         self._group = group
         self.mesh, self.nranks = sharding_mesh_for_group(group)
         self._rank2params = self._partition_parameters()
-        orig_init = optimizer._init_slot
-        mesh, n = self.mesh, self.nranks
-
-        def sharded_init(name, p):
-            return _shard0(orig_init(name, p), mesh, n)
-        optimizer._init_slot = sharded_init
+        _shard_slot_init(optimizer, self.mesh, self.nranks)
 
     def _partition_parameters(self):
         """Greedy size-balanced param->rank assignment (reference

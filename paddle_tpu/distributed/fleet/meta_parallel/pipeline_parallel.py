@@ -106,11 +106,16 @@ class PipelineParallel(TensorParallel):
         return loss
 
     def eval_batch(self, data, compute_loss=True):
+        """compute_loss=False returns the per-microbatch forward outputs
+        (logits) instead of a scalar loss, matching the reference
+        pipeline_parallel.py eval_batch contract."""
         self._layers.eval()
         from ....core import dispatch
         M = self._acc_steps
         micro = _split_micro(data, M)
         with dispatch.no_grad():
+            if not compute_loss:
+                return [self._layers.forward(x) for x, _ in micro]
             losses = [float(self._forward_micro(mb).numpy()) for mb in micro]
         return float(np.mean(losses))
 

@@ -58,6 +58,16 @@ def _shard0(arr, mesh, n):
     return jax.device_put(arr, NamedSharding(mesh, spec))
 
 
+def _shard_slot_init(optimizer, mesh, n):
+    """Wrap optimizer._init_slot so every new accumulator slot is created
+    dim0-sharded across the group (the optimizer-state half of ZeRO)."""
+    orig_init = optimizer._init_slot
+
+    def sharded_init(name, p):
+        return _shard0(orig_init(name, p), mesh, n)
+    optimizer._init_slot = sharded_init
+
+
 class GroupShardedOptimizerStage2:
     """Optimizer wrapper that keeps every accumulator slot sharded across the
     group (ZeRO-2's optimizer-state half; reference
@@ -70,12 +80,7 @@ class GroupShardedOptimizerStage2:
         self.mesh, self.nranks = sharding_mesh_for_group(group)
         if self._optim._parameter_list is None:
             self._optim._parameter_list = list(params)
-        orig_init = self._optim._init_slot
-        mesh, n = self.mesh, self.nranks
-
-        def sharded_init(name, p):
-            return _shard0(orig_init(name, p), mesh, n)
-        self._optim._init_slot = sharded_init
+        _shard_slot_init(self._optim, self.mesh, self.nranks)
 
     def __getattr__(self, item):
         return getattr(self._optim, item)
@@ -107,14 +112,12 @@ class GroupShardedStage2(Layer):
         if self.nranks > 1:
             mesh, n = self.mesh, self.nranks
 
-            def make_hook():
-                def hook(grad):
-                    grad._data = _shard0(grad._data, mesh, n)
-                    return grad
-                return hook
+            def hook(grad):
+                grad._data = _shard0(grad._data, mesh, n)
+                return grad
             for p in layer.parameters():
                 if not p.stop_gradient:
-                    p.register_hook(make_hook())
+                    p.register_hook(hook)
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
@@ -151,12 +154,7 @@ class GroupShardedStage3(Layer):
             for p in layer.parameters():
                 p._data = _shard0(p._data, self.mesh, self.nranks)
             if optimizer is not None:
-                orig_init = optimizer._init_slot
-                mesh, n = self.mesh, self.nranks
-
-                def sharded_init(name, prm):
-                    return _shard0(orig_init(name, prm), mesh, n)
-                optimizer._init_slot = sharded_init
+                _shard_slot_init(optimizer, self.mesh, self.nranks)
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)

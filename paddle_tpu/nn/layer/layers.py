@@ -291,10 +291,20 @@ class Layer:
                     f"expected {tuple(target._data.shape)}")
             # copy (the source may later be donated by a fused optimizer
             # step) AND re-place onto the target's own device/sharding (the
-            # source may live on another pipeline stage's device)
-            target._data = jax.device_put(
-                jnp.array(arr, dtype=target._data.dtype, copy=True),
-                target._data.sharding)
+            # source may live on another pipeline stage's device).  An
+            # uncommitted target (e.g. a PipelineLayer tied weight that
+            # _place_stages leaves free to migrate between stage devices)
+            # must stay uncommitted, so don't pin it to its current device.
+            if getattr(target._data, "committed", True):
+                target._data = jax.device_put(
+                    jnp.array(arr, dtype=target._data.dtype, copy=True),
+                    target._data.sharding)
+            else:
+                # host round-trip: the copy must not inherit the SOURCE's
+                # committed device either (e.g. loading a pipeline-staged
+                # state_dict into a fresh single-stage model)
+                target._data = jnp.asarray(
+                    np.asarray(arr), dtype=target._data.dtype)
             matched.add(key)
         missing = [k for k in own if k not in matched]
         return missing, unexpected

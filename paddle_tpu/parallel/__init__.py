@@ -3,3 +3,7 @@ from .transformer import (  # noqa: F401
     HybridParallelConfig, build_mesh, build_train_step, init_opt_state,
     init_params, param_specs, shard_opt_state, shard_params,
 )
+from .ring_attention import (  # noqa: F401
+    ring_attention, ring_self_attention, zigzag_permutation,
+    zigzag_inverse_permutation,
+)

@@ -36,6 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from ..models.llama import LlamaConfig
+from .ring_attention import ring_attention
 
 __all__ = ["HybridParallelConfig", "init_params", "build_train_step",
            "build_mesh", "param_specs"]
@@ -46,6 +47,9 @@ class HybridParallelConfig:
     dp: int = 1
     pp: int = 1
     tp: int = 1
+    cp: int = 1                       # context parallel (ring attention);
+                                      # the reference's "sep" hybrid axis slot
+                                      # (topology.py:199) upgraded to true CP
     num_microbatches: int = 1
     remat: bool = True
     dtype: Any = jnp.float32          # activation/param dtype (bf16 on TPU)
@@ -57,15 +61,17 @@ class HybridParallelConfig:
 
     @property
     def world(self):
-        return self.dp * self.pp * self.tp
+        return self.dp * self.pp * self.tp * self.cp
 
 
 def build_mesh(hp: HybridParallelConfig, devices=None) -> Mesh:
     devices = devices if devices is not None else jax.devices()[:hp.world]
     if len(devices) < hp.world:
         raise RuntimeError(f"need {hp.world} devices, have {len(devices)}")
-    arr = np.asarray(devices[:hp.world]).reshape(hp.pp, hp.dp, hp.tp)
-    return Mesh(arr, ("pp", "dp", "tp"))
+    # axis order pp->dp->cp->tp mirrors the reference topology order
+    # (pp, sharding/dp, sep, mp) so tp rides the innermost (fastest) links.
+    arr = np.asarray(devices[:hp.world]).reshape(hp.pp, hp.dp, hp.cp, hp.tp)
+    return Mesh(arr, ("pp", "dp", "cp", "tp"))
 
 
 # ---------------------------------------------------------------------------
@@ -140,10 +146,11 @@ def init_opt_state(params):
 # Per-device model code (inside shard_map).  All shapes are LOCAL.
 # ---------------------------------------------------------------------------
 
-def _rope(x, theta):
-    # x: [m, S, h, d]
+def _rope(x, theta, pos0=0):
+    # x: [m, S_loc, h, d]; pos0 = global position of the first local token
+    # (nonzero under context parallelism)
     m_, s, h, d = x.shape
-    pos = jnp.arange(s, dtype=jnp.float32)
+    pos = pos0 + jnp.arange(s, dtype=jnp.float32)
     inv = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
     freqs = jnp.outer(pos, inv)
     cos = jnp.cos(freqs)[None, :, None, :]
@@ -179,19 +186,25 @@ def _make_block(cfg: LlamaConfig, hp: HybridParallelConfig):
     head_dim = cfg.hidden_size // cfg.num_attention_heads
 
     def block(x, p):
-        # x: [m, S/tp, H] sequence-sharded (SP region)
+        # x: [m, S_cp/tp, H] sequence-sharded over tp (SP region) of this
+        # cp rank's contiguous sequence slice
+        pos0 = lax.axis_index("cp") * (x.shape[1] * hp.tp)  # S_cp per rank
         h = _rms(x, p["ln1"], cfg.rms_norm_eps)
-        h = lax.all_gather(h, "tp", axis=1, tiled=True)      # -> [m, S, H]
-        q = jnp.einsum("msh,hk->msk", h, p["wq"])            # [m, S, H/tp]
+        h = lax.all_gather(h, "tp", axis=1, tiled=True)      # -> [m, S_cp, H]
+        q = jnp.einsum("msh,hk->msk", h, p["wq"])            # [m, S_cp, H/tp]
         k = jnp.einsum("msh,hk->msk", h, p["wk"])
         v = jnp.einsum("msh,hk->msk", h, p["wv"])
         m_, s = q.shape[0], q.shape[1]
         q = q.reshape(m_, s, n_heads_local, head_dim)
         k = k.reshape(m_, s, n_heads_local, head_dim)
         v = v.reshape(m_, s, n_heads_local, head_dim)
-        q = _rope(q, cfg.rope_theta)
-        k = _rope(k, cfg.rope_theta)
-        att = _attention(q, k, v).reshape(m_, s, n_heads_local * head_dim)
+        q = _rope(q, cfg.rope_theta, pos0)
+        k = _rope(k, cfg.rope_theta, pos0)
+        if hp.cp > 1:
+            att = ring_attention(q, k, v, "cp", causal=True)
+        else:
+            att = _attention(q, k, v)
+        att = att.reshape(m_, s, n_heads_local * head_dim)
         o_partial = jnp.einsum("msk,kh->msh", att, p["wo"])  # partial over tp
         o = lax.psum_scatter(o_partial, "tp", scatter_dimension=1, tiled=True)
         x = x + o                                            # [m, S/tp, H]
@@ -223,7 +236,8 @@ def _vocab_parallel_embed(tokens, embed, cfg, hp):
     return lax.psum_scatter(out, "tp", scatter_dimension=1, tiled=True)
 
 
-def _vocab_parallel_xent(h, head, labels, cfg, pos_weight=None):
+def _vocab_parallel_xent(h, head, labels, cfg, pos_weight=None,
+                         reduction="mean"):
     """h [m, S, H] full-seq; head LOCAL [H, V/tp]; labels [m, S].
     Stable cross entropy with the vocab dim sharded over tp
     (reference ParallelCrossEntropy, mp_ops.py).  pos_weight [S] masks
@@ -248,9 +262,13 @@ def _vocab_parallel_xent(h, head, labels, cfg, pos_weight=None):
     correct = lax.psum(picked, "tp")
     per_pos = gmax + jnp.log(denom) - correct          # [m, S]
     if pos_weight is None:
-        return jnp.mean(per_pos)
+        pos_weight = jnp.ones((per_pos.shape[1],), jnp.float32)
     w = pos_weight[None, :]
-    return jnp.sum(per_pos * w) / jnp.maximum(jnp.sum(w) * per_pos.shape[0], 1.0)
+    wsum = jnp.sum(per_pos * w)
+    wcount = jnp.sum(w) * per_pos.shape[0]
+    if reduction == "sumcount":
+        return wsum, wcount
+    return wsum / jnp.maximum(wcount, 1.0)
 
 
 def _forward_loss(params, tokens, cfg, hp):
@@ -264,7 +282,10 @@ def _forward_loss(params, tokens, cfg, hp):
     stage = lax.axis_index("pp")
     L_loc = cfg.num_hidden_layers // pp
     m = tokens.shape[1]
-    s_loc = tokens.shape[2] // hp.tp
+    S = tokens.shape[2]
+    S_cp = S // hp.cp                 # this cp rank's contiguous seq slice
+    s_loc = S_cp // hp.tp             # further seq-sharded over tp (SP)
+    cp_start = lax.axis_index("cp") * S_cp
     H = cfg.hidden_size
 
     def stage_fn(x):
@@ -280,20 +301,26 @@ def _forward_loss(params, tokens, cfg, hp):
         mb = jnp.clip(t - stage, 0, M - 1)
         tok_mb = lax.dynamic_index_in_dim(tokens, jnp.clip(t, 0, M - 1), axis=0,
                                           keepdims=False)
-        fresh = _vocab_parallel_embed(tok_mb, params["embed"], cfg, hp)
+        # tokens are replicated over cp; each cp rank embeds only its slice
+        tok_cp = lax.dynamic_slice_in_dim(tok_mb, cp_start, S_cp, axis=1)
+        fresh = _vocab_parallel_embed(tok_cp, params["embed"], cfg, hp)
         inp = jnp.where(stage == 0, fresh, act)
         out = stage_fn(inp)
 
         # last stage: head + loss for this microbatch (when valid)
         my_tok = lax.dynamic_index_in_dim(tokens, mb, axis=0, keepdims=False)
         hN = _rms(out, params["norm_f"], cfg.rms_norm_eps)
-        h_full = lax.all_gather(hN, "tp", axis=1, tiled=True)   # [m, S, H]
-        # next-token shift; final position has no target -> masked from loss
-        labels = jnp.concatenate([my_tok[:, 1:], my_tok[:, :1]], axis=1)
-        pos_w = (jnp.arange(labels.shape[1]) < labels.shape[1] - 1
-                 ).astype(jnp.float32)
-        mb_loss = _vocab_parallel_xent(h_full, params["head"], labels, cfg,
-                                       pos_weight=pos_w)
+        h_full = lax.all_gather(hN, "tp", axis=1, tiled=True)  # [m, S_cp, H]
+        # next-token shift; global final position has no target -> masked
+        tok_ext = jnp.concatenate([my_tok, my_tok[:, :1]], axis=1)
+        labels = lax.dynamic_slice_in_dim(tok_ext, cp_start + 1, S_cp, axis=1)
+        pos_w = ((cp_start + jnp.arange(S_cp)) < S - 1).astype(jnp.float32)
+        ws, wc = _vocab_parallel_xent(h_full, params["head"], labels, cfg,
+                                      pos_weight=pos_w, reduction="sumcount")
+        if hp.cp > 1:
+            ws = lax.psum(ws, "cp")
+            wc = lax.psum(wc, "cp")
+        mb_loss = ws / jnp.maximum(wc, 1.0)
         valid = ((t - stage) >= 0) & ((t - stage) < M) & (stage == pp - 1)
         acc_loss = acc_loss + jnp.where(valid, mb_loss, 0.0)
 
@@ -305,7 +332,7 @@ def _forward_loss(params, tokens, cfg, hp):
     # new-style shard_map tracks which mesh axes a value varies over; scan
     # needs carry-in vma == carry-out vma, so pre-mark the zero carries as
     # varying over every mesh axis the body's outputs vary over.
-    all_axes = ("pp", "dp", "tp")
+    all_axes = ("pp", "dp", "cp", "tp")
     act0 = lax.pcast(act0, all_axes, to="varying")
     loss0 = lax.pcast(loss0, all_axes, to="varying")
     (act, total_loss), _ = lax.scan(tick, (act0, loss0),
@@ -373,6 +400,10 @@ def _reduce_grads(grads, hp):
       allreduce hooks, sequence_parallel_utils.py:192)
     """
     grads = jax.tree.map(lambda g: lax.pmean(g, "dp"), grads)
+    if hp.cp > 1:
+        # every param is replicated over cp; each cp rank saw only its
+        # sequence slice -> grads are partial sums over cp
+        grads = jax.tree.map(lambda g: lax.psum(g, "cp"), grads)
     for name in ("embed", "head", "norm_f"):
         grads[name] = lax.psum(grads[name], "pp")
     grads["norm_f"] = lax.psum(grads["norm_f"], "tp")
