@@ -61,6 +61,7 @@ from . import device  # noqa: E402
 from . import distributed  # noqa: E402
 from . import framework  # noqa: E402
 from . import hapi  # noqa: E402
+from . import incubate  # noqa: E402
 from . import io  # noqa: E402
 from . import jit  # noqa: E402
 from . import linalg  # noqa: E402

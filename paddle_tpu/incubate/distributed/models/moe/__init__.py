@@ -1,0 +1,5 @@
+"""Mixture-of-Experts (reference: python/paddle/incubate/distributed/models/moe/)."""
+from .gating import capacity_for, topk_gating  # noqa: F401
+from .moe_layer import (  # noqa: F401
+    GShardGate, MoELayer, NaiveGate, SwitchGate,
+)

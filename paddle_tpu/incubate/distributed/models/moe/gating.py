@@ -1,0 +1,121 @@
+"""Top-k MoE gating math (pure JAX; shared by eager MoELayer, fused_moe and
+the expert-parallel SPMD block).
+
+Capability parity with the reference gates
+(/root/reference/python/paddle/incubate/distributed/models/moe/gate/
+{gshard_gate.py,switch_gate.py} and the capacity kernels
+paddle/phi/kernels/gpu/{number_count,limit_by_capacity}_kernel.cu), built
+the TPU way: capacity assignment via cumsum/one-hot einsum instead of
+scatter kernels, so the whole gate is one fused XLA program with static
+shapes (dispatch/combine are dense [T, E, C] tensors that XLA keeps
+register/HBM-tiled; no dynamic routing).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["topk_gating", "capacity_for", "gate_dispatch", "expert_silu_ffn",
+           "combine_output"]
+
+
+def capacity_for(num_tokens: int, num_experts: int, top_k: int,
+                 capacity_factor: float) -> int:
+    """Per-expert token capacity (reference: cap = factor * T * k / E)."""
+    c = int(capacity_factor * num_tokens * top_k / num_experts)
+    return max(1, c)
+
+
+def _assign_capacity(mask, prev_count=None):
+    """mask: [T, E] 0/1 expert assignment.  Returns the position of each
+    token within its expert's buffer ([T] int32) counting any positions
+    already taken (prev_count: [E])."""
+    pos = jnp.cumsum(mask, axis=0) - 1                    # [T, E]
+    if prev_count is not None:
+        pos = pos + prev_count[None, :]
+    return jnp.sum(pos * mask, axis=1).astype(jnp.int32)  # [T]
+
+
+def topk_gating(logits, top_k: int, capacity: int, use_aux_loss: bool = True):
+    """GShard-style top-k gating with capacity.
+
+    logits: [T, E] float.  Returns (combine [T, E, C], dispatch [T, E, C]
+    bool-as-float, aux_loss scalar).  top_k=1 is the Switch gate, top_k=2
+    the GShard gate.  Tokens overflowing an expert's capacity are dropped
+    for that expert (their combine weight is zero) — same drop semantics
+    as the reference's limit_by_capacity.
+    """
+    T, E = logits.shape
+    C = capacity
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)   # [T, E]
+
+    masks = []       # [T, E] one-hot per choice
+    gate_vals = []   # [T] prob of that choice
+    g = gates
+    for _ in range(top_k):
+        idx = jnp.argmax(g, axis=-1)                              # [T]
+        m = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+        masks.append(m)
+        gate_vals.append(jnp.sum(gates * m, axis=-1))
+        g = g * (1.0 - m)                                         # mask out
+
+    # load-balancing auxiliary loss (GShard eq.4 / Switch eq.4): computed on
+    # the FIRST choice only, before capacity drops
+    if use_aux_loss:
+        me = jnp.mean(gates, axis=0)                              # [E]
+        ce = jnp.mean(masks[0], axis=0)                           # [E]
+        aux_loss = jnp.sum(me * ce) * E
+    else:
+        aux_loss = jnp.zeros((), jnp.float32)
+
+    # capacity positions: choice k's tokens queue up behind choices < k
+    prev = jnp.zeros((E,), jnp.float32)
+    positions, kept_masks = [], []
+    for m in masks:
+        pos = _assign_capacity(m, prev)                           # [T]
+        keep = (pos < C).astype(jnp.float32)
+        kept_masks.append(m * keep[:, None])
+        positions.append(pos)
+        prev = prev + jnp.sum(m, axis=0)
+
+    # renormalize combine weights over the kept choices
+    vals = [v * jnp.sum(km, axis=-1) for v, km in zip(gate_vals, kept_masks)]
+    denom = sum(vals)
+    denom = jnp.where(denom > 0, denom, 1.0)
+
+    combine = jnp.zeros((T, E, C), jnp.float32)
+    dispatch = jnp.zeros((T, E, C), jnp.float32)
+    for v, km, pos in zip(vals, kept_masks, positions):
+        loc = jax.nn.one_hot(jnp.clip(pos, 0, C - 1), C,
+                             dtype=jnp.float32)                   # [T, C]
+        sel = km[:, :, None] * loc[:, None, :]                    # [T, E, C]
+        dispatch = dispatch + sel
+        combine = combine + (v / denom)[:, None, None] * sel
+    return combine, dispatch, aux_loss
+
+
+# -- shared MoE building blocks (used by the eager fused_moe op and the
+#    expert-parallel moe_ffn in paddle_tpu.parallel.moe) --------------------
+
+def gate_dispatch(x2d, gate_weight, top_k, capacity):
+    """Route tokens: x2d [T, H], gate_weight [H, E] ->
+    (combine [T,E,C], expert_in [E,C,H] in x2d's dtype, aux_loss)."""
+    logits = jnp.einsum("th,he->te", x2d.astype(jnp.float32),
+                        gate_weight.astype(jnp.float32))
+    combine, dispatch, aux = topk_gating(logits, top_k, capacity)
+    expert_in = jnp.einsum("tec,th->ech", dispatch,
+                           x2d.astype(jnp.float32)).astype(x2d.dtype)
+    return combine, expert_in, aux
+
+
+def expert_silu_ffn(expert_in, w_in, w_out):
+    """Batched per-expert silu MLP on the MXU: [E,C,H] x [E,H,F] x [E,F,H]."""
+    h = jnp.einsum("ech,ehf->ecf", expert_in, w_in)
+    h = jax.nn.silu(h.astype(jnp.float32)).astype(h.dtype)
+    return jnp.einsum("ecf,efh->ech", h, w_out)
+
+
+def combine_output(combine, expert_out, dtype):
+    """Weighted un-dispatch: [T,E,C] x [E,C,H] -> [T,H]."""
+    return jnp.einsum("tec,ech->th", combine,
+                      expert_out.astype(jnp.float32)).astype(dtype)

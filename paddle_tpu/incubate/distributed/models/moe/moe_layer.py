@@ -1,0 +1,115 @@
+"""Eager Mixture-of-Experts layer with GShard / Switch gating.
+
+Capability parity with the reference MoELayer
+(/root/reference/python/paddle/incubate/distributed/models/moe/moe_layer.py)
+re-designed TPU-first: dispatch/combine are dense one-hot einsums (a single
+fused XLA program on the MXU) instead of the reference's global_scatter /
+global_gather CUDA kernels.  Expert parallelism over a mesh axis lives in
+paddle_tpu.parallel.moe (all_to_all over ICI); this layer is the eager /
+single-device surface.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .....core import dispatch as D
+from .....nn.layer.layers import Layer
+from .....nn.layer.common import Linear
+from .....nn.layer.container import LayerList
+from .....ops import manipulation as _manip
+from .....ops import math as _math
+from .gating import capacity_for, topk_gating
+
+__all__ = ["MoELayer", "GShardGate", "SwitchGate", "NaiveGate"]
+
+
+class NaiveGate(Layer):
+    """Linear router producing per-expert logits, plus top-k capacity
+    assignment (reference naive_gate.py)."""
+
+    top_k = 2
+
+    def __init__(self, d_model, num_experts, top_k=2, capacity_factor=2.0,
+                 use_aux_loss=True):
+        super().__init__()
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.use_aux_loss = use_aux_loss
+        self.proj = Linear(d_model, num_experts, bias_attr=False)
+
+    def forward(self, x):
+        """x: [T, H] -> (combine [T,E,C], dispatch [T,E,C], aux_loss)."""
+        logits = self.proj(x)
+        cap = capacity_for(int(x.shape[0]), self.num_experts, self.top_k,
+                           self.capacity_factor)
+        return D.apply(
+            "moe_gating", topk_gating, (logits,),
+            {"top_k": self.top_k, "capacity": cap,
+             "use_aux_loss": self.use_aux_loss})
+
+
+class GShardGate(NaiveGate):
+    """Top-2 gate with load-balance aux loss (reference gshard_gate.py)."""
+
+    def __init__(self, d_model, num_experts, capacity_factor=2.0):
+        super().__init__(d_model, num_experts, top_k=2,
+                         capacity_factor=capacity_factor)
+
+
+class SwitchGate(NaiveGate):
+    """Top-1 gate (reference switch_gate.py)."""
+
+    def __init__(self, d_model, num_experts, capacity_factor=2.0):
+        super().__init__(d_model, num_experts, top_k=1,
+                         capacity_factor=capacity_factor)
+
+
+class MoELayer(Layer):
+    """Mixture of experts: route each token to its top-k experts, run the
+    expert networks, and combine weighted outputs.
+
+    experts: list/LayerList of expert Layers (each maps [C, H] -> [C, H']).
+    gate: "gshard" | "switch" | a gate Layer instance.
+    After forward, ``self.l_aux`` holds the load-balancing loss — add
+    ``moe.l_aux * alpha`` to the training loss (same contract as the
+    reference MoELayer).
+    """
+
+    def __init__(self, d_model=None, experts=None, gate="gshard",
+                 top_k=None, capacity_factor=2.0, recompute_interval=0,
+                 group=None, **kwargs):
+        super().__init__()
+        if experts is None:
+            raise ValueError("MoELayer requires an experts list")
+        self.experts = (experts if isinstance(experts, LayerList)
+                        else LayerList(list(experts)))
+        self.num_experts = len(self.experts)
+        if isinstance(gate, Layer):
+            self.gate = gate
+        elif gate == "switch":
+            self.gate = SwitchGate(d_model, self.num_experts,
+                                   capacity_factor=capacity_factor)
+        elif gate in ("gshard", "naive"):
+            self.gate = GShardGate(d_model, self.num_experts,
+                                   capacity_factor=capacity_factor)
+        else:
+            raise ValueError(f"unknown gate '{gate}'")
+        if top_k is not None:
+            self.gate.top_k = top_k
+        self.l_aux = None
+
+    def forward(self, x):
+        orig_shape = list(x.shape)
+        d_model = orig_shape[-1]
+        x2 = x.reshape([-1, d_model])                     # [T, H]
+        combine, disp, aux = self.gate(x2)
+        self.l_aux = aux
+        # [T,E,C] x [T,H] -> [E,C,H]: per-expert input buffers
+        expert_in = _math.einsum("tec,th->ech", disp, x2)
+        outs = [self.experts[e](expert_in[e])
+                for e in range(self.num_experts)]
+        stacked = _manip.stack(outs)                      # [E, C, H']
+        y = _math.einsum("tec,ech->th", combine, stacked)
+        out_shape = orig_shape[:-1] + [int(stacked.shape[-1])]
+        return y.reshape(out_shape)

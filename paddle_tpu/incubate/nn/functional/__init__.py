@@ -1,0 +1,19 @@
+"""Fused functional ops (reference: python/paddle/incubate/nn/functional/).
+
+Each op executes as ONE compiled XLA program via the eager dispatch cache;
+on TPU the hot ones additionally route to Pallas kernels (see
+paddle_tpu.ops.pallas).
+"""
+from .fused_moe import fused_moe  # noqa: F401
+from .fused_ops import (  # noqa: F401
+    fused_bias_dropout_residual_layer_norm, fused_dropout_add,
+    fused_layer_norm, fused_linear, fused_matmul_bias, fused_rms_norm,
+    fused_rotary_position_embedding, swiglu,
+)
+
+__all__ = [
+    "fused_moe", "fused_rms_norm", "fused_layer_norm",
+    "fused_rotary_position_embedding", "swiglu", "fused_matmul_bias",
+    "fused_linear", "fused_dropout_add",
+    "fused_bias_dropout_residual_layer_norm",
+]

@@ -1,0 +1,38 @@
+"""fused_moe: whole MoE block (gate -> dispatch -> expert FFN -> combine) as
+one eager op / one compiled XLA program.
+
+Parity with /root/reference/python/paddle/incubate/nn/functional/fused_moe.py
+(which calls the fused_moe_kernel CUDA op); here the fusion is done by XLA
+over the dense-dispatch formulation from
+paddle_tpu.incubate.distributed.models.moe.gating.
+"""
+from __future__ import annotations
+
+from ....core import dispatch as D
+from ...distributed.models.moe.gating import (
+    capacity_for, combine_output, expert_silu_ffn, gate_dispatch)
+
+__all__ = ["fused_moe"]
+
+
+def _fused_moe_impl(x, gate_weight, ffn1_weight, ffn2_weight,
+                    top_k, capacity):
+    x2 = x.reshape(-1, x.shape[-1])
+    combine, expert_in, _ = gate_dispatch(x2, gate_weight, top_k, capacity)
+    expert_out = expert_silu_ffn(expert_in, ffn1_weight, ffn2_weight)
+    y = combine_output(combine, expert_out, x.dtype)
+    return y.reshape(x.shape[:-1] + (ffn2_weight.shape[-1],))
+
+
+def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, top_k=2,
+              capacity_factor=2.0, name=None):
+    """x [B, S, H] or [T, H]; gate_weight [H, E]; ffn1_weight [E, H, F];
+    ffn2_weight [E, F, H].  Returns same leading shape as x."""
+    num_tokens = 1
+    for s in x.shape[:-1]:
+        num_tokens *= int(s)
+    E = int(gate_weight.shape[-1])
+    cap = capacity_for(num_tokens, E, top_k, capacity_factor)
+    return D.apply("fused_moe", _fused_moe_impl,
+                   (x, gate_weight, ffn1_weight, ffn2_weight),
+                   {"top_k": int(top_k), "capacity": cap})

@@ -7,3 +7,4 @@ from .ring_attention import (  # noqa: F401
     ring_attention, ring_self_attention, zigzag_permutation,
     zigzag_inverse_permutation,
 )
+from .moe import init_moe_params, moe_ffn  # noqa: F401

@@ -149,16 +149,22 @@ def zigzag_inverse_permutation(seq_len: int, cp: int):
     return inv
 
 
-def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = "sep",
-                        causal: bool = True):
-    """User-facing wrapper: global [B, S, H, D] arrays, seq sharded over
-    ``axis_name`` of ``mesh``.  Compiles one shard_map'd program.
-
-    Analog slot of paddle.nn.functional.flash_attention for long sequences;
-    the reference has no CP equivalent (SURVEY.md §5.7).
-    """
+@functools.lru_cache(maxsize=64)
+def _ring_self_attention_fn(mesh: Mesh, axis_name: str, causal: bool):
     spec = P(None, axis_name, None, None)
     fn = shard_map(
         functools.partial(ring_attention, axis_name=axis_name, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    return jax.jit(fn)(q, k, v)
+    return jax.jit(fn)
+
+
+def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = "sep",
+                        causal: bool = True):
+    """User-facing wrapper: global [B, S, H, D] arrays, seq sharded over
+    ``axis_name`` of ``mesh``.  The shard_map'd program is built and
+    compiled once per (mesh, axis, causal) and cached.
+
+    Analog slot of paddle.nn.functional.flash_attention for long sequences;
+    the reference has no CP equivalent (SURVEY.md §5.7).
+    """
+    return _ring_self_attention_fn(mesh, axis_name, bool(causal))(q, k, v)

@@ -1,0 +1,155 @@
+"""incubate.nn.functional fused ops: numerics vs reference formulas.
+
+Mirrors the reference's fused-op unit tests (test/legacy_test/
+test_fused_rotary_position_embedding.py, test_rms_norm_op.py, ...): each
+fused op is checked against a NumPy/plain composition, including gradients.
+Pallas TPU kernels are exercised on real TPU runs; on the CPU mesh the ops
+take the XLA-composition path through the same public API.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.incubate.nn.functional as F
+
+
+def _t(a, stop_gradient=True):
+    return paddle.to_tensor(np.asarray(a, np.float32),
+                            stop_gradient=stop_gradient)
+
+
+def test_fused_rms_norm_matches_formula():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 16).astype(np.float32)
+    w = rng.randn(16).astype(np.float32)
+    out, res = F.fused_rms_norm(_t(x), _t(w), epsilon=1e-6)
+    ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * w
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(res.numpy(), x, rtol=1e-6)
+
+
+def test_fused_rms_norm_with_residual_and_bias():
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 16).astype(np.float32)
+    b = rng.randn(16).astype(np.float32)
+    r = rng.randn(4, 16).astype(np.float32)
+    w = np.ones(16, np.float32)
+    out, res = F.fused_rms_norm(_t(x), _t(w), bias=_t(b), residual=_t(r))
+    s = x + b + r
+    np.testing.assert_allclose(res.numpy(), s, rtol=1e-6)
+    ref = s / np.sqrt((s ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_fused_layer_norm_matches_formula():
+    rng = np.random.RandomState(2)
+    x = rng.randn(6, 8).astype(np.float32)
+    w = rng.randn(8).astype(np.float32)
+    b = rng.randn(8).astype(np.float32)
+    out, _ = F.fused_layer_norm(_t(x), _t(w), _t(b), epsilon=1e-5)
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ref = (x - mu) / np.sqrt(var + 1e-5) * w + b
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_rms_norm_gradient():
+    rng = np.random.RandomState(3)
+    x = _t(rng.randn(4, 16), stop_gradient=False)
+    w = _t(rng.randn(16), stop_gradient=False)
+    out, _ = F.fused_rms_norm(x, w)
+    out.sum().backward()
+    assert x.grad is not None and w.grad is not None
+    # numeric check on w: d(sum)/dw_j = sum_i normalized_ij
+    xn = x.numpy()
+    ref_gw = (xn / np.sqrt((xn ** 2).mean(-1, keepdims=True) + 1e-6)).sum(0)
+    np.testing.assert_allclose(w.grad.numpy(), ref_gw, rtol=1e-4, atol=1e-4)
+
+
+def test_rope_neox_rotation():
+    rng = np.random.RandomState(4)
+    B, S, H, D = 2, 8, 2, 8
+    q = rng.randn(B, S, H, D).astype(np.float32)
+    qo, ko, vo = F.fused_rotary_position_embedding(_t(q))
+    assert ko is None and vo is None
+    # manual neox rope
+    pos = np.arange(S, dtype=np.float32)
+    inv = 10000.0 ** (-np.arange(0, D, 2, dtype=np.float32) / D)
+    freqs = np.outer(pos, inv)
+    emb = np.repeat(freqs, 2, axis=-1)
+    cos, sin = np.cos(emb)[None, :, None, :], np.sin(emb)[None, :, None, :]
+    x1, x2 = q[..., 0::2], q[..., 1::2]
+    rot = np.stack([-x2, x1], axis=-1).reshape(q.shape)
+    ref = q * cos + rot * sin
+    np.testing.assert_allclose(qo.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_rope_qk_pair_preserves_dot_products():
+    """RoPE is a rotation: |q| and relative-position dot products are
+    preserved."""
+    rng = np.random.RandomState(5)
+    q = rng.randn(1, 16, 1, 16).astype(np.float32)
+    k = rng.randn(1, 16, 1, 16).astype(np.float32)
+    qo, ko, _ = F.fused_rotary_position_embedding(_t(q), _t(k))
+    np.testing.assert_allclose(np.linalg.norm(qo.numpy(), axis=-1),
+                               np.linalg.norm(q, axis=-1), rtol=1e-4)
+    # same-position dot product unchanged
+    d0 = (q * k).sum(-1)
+    d1 = (qo.numpy() * ko.numpy()).sum(-1)
+    np.testing.assert_allclose(d0, d1, rtol=1e-3, atol=1e-4)
+
+
+def test_swiglu_split_and_two_arg():
+    rng = np.random.RandomState(6)
+    x = rng.randn(4, 8).astype(np.float32)
+    y = rng.randn(4, 8).astype(np.float32)
+    out = F.swiglu(_t(x), _t(y))
+    silu = x * (1.0 / (1.0 + np.exp(-x)))
+    np.testing.assert_allclose(out.numpy(), silu * y, rtol=1e-5, atol=1e-6)
+    both = np.concatenate([x, y], axis=-1)
+    out2 = F.swiglu(_t(both))
+    np.testing.assert_allclose(out2.numpy(), silu * y, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_matmul_bias_and_linear():
+    rng = np.random.RandomState(7)
+    x = rng.randn(4, 8).astype(np.float32)
+    w = rng.randn(8, 16).astype(np.float32)
+    b = rng.randn(16).astype(np.float32)
+    out = F.fused_matmul_bias(_t(x), _t(w), _t(b))
+    np.testing.assert_allclose(out.numpy(), x @ w + b, rtol=1e-5, atol=1e-5)
+    out_t = F.fused_linear(_t(x), _t(w.T), _t(b), transpose_weight=True)
+    np.testing.assert_allclose(out_t.numpy(), x @ w + b, rtol=1e-5, atol=1e-5)
+
+
+def test_fused_dropout_add():
+    paddle.seed(42)
+    rng = np.random.RandomState(8)
+    x = rng.randn(64, 64).astype(np.float32)
+    y = rng.randn(64, 64).astype(np.float32)
+    out = F.fused_dropout_add(_t(x), _t(y), p=0.5, training=True)
+    delta = out.numpy() - y
+    # dropped positions contribute exactly 0; kept are x/0.5
+    dropped = np.isclose(delta, 0.0, atol=1e-6)
+    kept = np.isclose(delta, x * 2.0, rtol=1e-4, atol=1e-5)
+    assert np.all(dropped | kept)
+    frac = dropped.mean()
+    assert 0.35 < frac < 0.65
+    # eval mode: identity + add
+    out_eval = F.fused_dropout_add(_t(x), _t(y), p=0.5, training=False)
+    np.testing.assert_allclose(out_eval.numpy(), x + y, rtol=1e-6)
+
+
+def test_fused_bias_dropout_residual_layer_norm():
+    rng = np.random.RandomState(9)
+    x = rng.randn(4, 8).astype(np.float32)
+    r = rng.randn(4, 8).astype(np.float32)
+    w = np.ones(8, np.float32)
+    b = np.zeros(8, np.float32)
+    out = F.fused_bias_dropout_residual_layer_norm(
+        _t(x), _t(r), ln_scale=_t(w), ln_bias=_t(b), dropout_rate=0.0)
+    s = x + r
+    mu, var = s.mean(-1, keepdims=True), s.var(-1, keepdims=True)
+    np.testing.assert_allclose(out.numpy(), (s - mu) / np.sqrt(var + 1e-5),
+                               rtol=1e-4, atol=1e-5)
