@@ -105,6 +105,11 @@ def _fn_key(fn: Callable):
 _plain_cache: dict = {}
 _fwd_vjp_cache: dict = {}
 
+# set by paddle_tpu.profiler while a host tracer is recording:
+# callable(op_name, start_ns, dur_ns) or None.  Mirrors the reference's
+# codegen'd per-op RecordEvent annotations (eager_gen.py:324).
+_op_observer = None
+
 
 def _plain_exec(fn: Callable, static_items: tuple):
     key = (_fn_key(fn), static_items)
@@ -231,11 +236,17 @@ def apply(op_name: str, fn: Callable, tensor_args: Sequence[Any],
     )
     grad_on = grad_on and any(mask)
 
+    obs = _op_observer
+    if obs is not None:
+        import time as _time
+        t0 = _time.perf_counter_ns()
     if not grad_on:
         out = _plain_exec(fn, static_items)(*arrays)
         vjp_fn = None
     else:
         out, vjp_fn = _fwd_vjp_exec(fn, static_items, mask)(*arrays)
+    if obs is not None:
+        obs(op_name, t0, _time.perf_counter_ns() - t0)
 
     multi = isinstance(out, (tuple, list))
     out_arrays = tuple(out) if multi else (out,)

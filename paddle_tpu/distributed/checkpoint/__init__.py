@@ -1,0 +1,17 @@
+"""Distributed (sharded) checkpointing with reshard-on-load.
+
+Parity with the reference distributed checkpoint
+(/root/reference/python/paddle/distributed/checkpoint/save_state_dict.py:135
+and load_state_dict.py): every rank writes its LOCAL shards plus a global
+metadata file mapping tensor -> [shard offsets -> file]; load reads whatever
+source shards overlap each target shard, so the same checkpoint restores
+onto a different mesh / different placements (dp<->tp<->pp relayouts).
+
+TPU-native mechanics: shards come from jax.Array.addressable_shards (the
+sharding IS the shard plan — no per-strategy save logic), and load rebuilds
+arrays with jax.make_array_from_single_device_arrays, letting any target
+NamedSharding drive the re-layout.
+"""
+from .api import load_state_dict, save_state_dict  # noqa: F401
+
+__all__ = ["save_state_dict", "load_state_dict"]

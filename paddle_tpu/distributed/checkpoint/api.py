@@ -1,0 +1,209 @@
+"""save_state_dict / load_state_dict implementation.
+
+Checkpoint layout on disk:
+    <path>/
+      metadata.json             # {tensors: {name: {shape, dtype, shards: [...]}}}
+      <rank>_<n>.npy            # one .npy per locally-written unique shard
+
+Each shard record: {"offset": [d0, d1, ...], "shape": [...], "file": "..."}.
+Offsets are global start indices of the shard block.  Duplicate shards
+(replicated placements) are written once by the lowest-id owning device.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+
+import numpy as np
+import jax
+
+__all__ = ["save_state_dict", "load_state_dict"]
+
+_META = "metadata.json"
+
+
+def _flatten(state_dict, prefix=""):
+    flat = {}
+    for k, v in state_dict.items():
+        name = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            flat.update(_flatten(v, name))
+        else:
+            flat[name] = v
+    return flat
+
+
+def _to_jax_array(v):
+    from ...core.tensor import Tensor
+    if isinstance(v, Tensor):
+        return v._data
+    if isinstance(v, jax.Array):
+        return v
+    import jax.numpy as jnp
+    return jnp.asarray(np.asarray(v))
+
+
+def _safe(name: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.-]", "_", name)
+
+
+def _process_rank() -> int:
+    return getattr(jax, "process_index", lambda: 0)()
+
+
+def save_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, unique_id=None):
+    """Write every rank's local shards + a global metadata file.
+
+    state_dict: (nested) dict of Tensor / jax.Array / numpy.  Works for
+    replicated, sharded, and hybrid (mesh) placements alike.
+    """
+    os.makedirs(path, exist_ok=True)
+    rank = _process_rank()
+    flat = _flatten(state_dict)
+    meta = {"tensors": {}}
+    n_files = 0
+    for name, val in flat.items():
+        arr = _to_jax_array(val)
+        shards_meta = []
+        seen_offsets = set()
+        for sh in arr.addressable_shards:
+            idx = sh.index  # tuple of slices into the global array
+            offset = tuple(
+                (s.start or 0) if isinstance(s, slice) else int(s)
+                for s in idx)
+            if offset in seen_offsets:
+                continue  # replicated copy: write once
+            seen_offsets.add(offset)
+            local = np.asarray(sh.data)
+            if local.dtype.name == "bfloat16":
+                # .npy has no bf16: store the raw bits as uint16 (the
+                # recorded tensor dtype restores the view on load)
+                local = local.view(np.uint16)
+            fname = f"{rank}_{n_files}.npy"
+            np.save(os.path.join(path, fname), local)
+            n_files += 1
+            shards_meta.append({
+                "offset": list(offset),
+                "shape": list(local.shape),
+                "file": fname,
+            })
+        meta["tensors"][name] = {
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+            "shards": shards_meta,
+        }
+    # single-host: this process IS the coordinator; multi-host would merge
+    # per-rank metadata here (each rank's shard lists are disjoint by offset)
+    with open(os.path.join(path, _META), "w") as f:
+        json.dump(meta, f)
+
+
+def _load_npy(path, fname, dtype_name):
+    data = np.load(os.path.join(path, fname))
+    if dtype_name == "bfloat16":
+        import ml_dtypes
+        data = data.view(ml_dtypes.bfloat16)
+    return data
+
+
+def _read_block(path, tmeta, want_offset, want_shape):
+    """Assemble the [want_offset, want_offset+want_shape) block of a tensor
+    from whatever saved shards overlap it."""
+    dtype_name = tmeta["dtype"]
+    if dtype_name == "bfloat16":
+        import ml_dtypes
+        out = np.empty(want_shape, dtype=ml_dtypes.bfloat16)
+    else:
+        out = np.empty(want_shape, dtype=np.dtype(dtype_name))
+    filled = np.zeros(want_shape, dtype=bool) if out.size else None
+    ndim = len(want_shape)
+    if ndim == 0:
+        return _load_npy(path, tmeta["shards"][0]["file"], dtype_name)
+    for sh in tmeta["shards"]:
+        s_off, s_shape = sh["offset"], sh["shape"]
+        # overlap of [s_off, s_off+s_shape) with [want_offset, +want_shape)
+        lo = [max(s_off[d], want_offset[d]) for d in range(ndim)]
+        hi = [min(s_off[d] + s_shape[d], want_offset[d] + want_shape[d])
+              for d in range(ndim)]
+        if any(l >= h for l, h in zip(lo, hi)):
+            continue
+        data = _load_npy(path, sh["file"], dtype_name)
+        src = tuple(slice(lo[d] - s_off[d], hi[d] - s_off[d])
+                    for d in range(ndim))
+        dst = tuple(slice(lo[d] - want_offset[d], hi[d] - want_offset[d])
+                    for d in range(ndim))
+        out[dst] = data[src]
+        if filled is not None:
+            filled[dst] = True
+    if filled is not None and not filled.all():
+        raise ValueError("checkpoint is missing data for requested block "
+                         f"(offset={want_offset}, shape={want_shape})")
+    return out
+
+
+def _load_one(path, tmeta, target):
+    """Produce a jax.Array matching `target`'s sharding, filled from disk."""
+    import jax.numpy as jnp
+    global_shape = tuple(tmeta["shape"])
+    sharding = target.sharding
+    dtype = target.dtype
+    if tuple(target.shape) != global_shape:
+        raise ValueError(
+            f"shape mismatch: checkpoint {global_shape} vs target "
+            f"{tuple(target.shape)}")
+    if not getattr(target, "committed", True):
+        # uncommitted target: plain array, free to migrate between devices
+        full = _read_block(path, tmeta, (0,) * len(global_shape),
+                           global_shape)
+        return jnp.asarray(full).astype(dtype)
+    idx_map = sharding.addressable_devices_indices_map(global_shape)
+    per_device = []
+    block_cache = {}  # replicated layouts share one disk read per block
+    for dev, idx in idx_map.items():
+        offset = tuple((s.start or 0) for s in idx) if idx else ()
+        shape = tuple(
+            ((s.stop if s.stop is not None else global_shape[d]) -
+             (s.start or 0))
+            for d, s in enumerate(idx)) if idx else ()
+        key = (offset, shape)
+        block = block_cache.get(key)
+        if block is None:
+            block = block_cache[key] = jnp.asarray(
+                _read_block(path, tmeta, offset, shape)).astype(dtype)
+        per_device.append(jax.device_put(block, dev))
+    return jax.make_array_from_single_device_arrays(
+        global_shape, sharding, per_device)
+
+
+def load_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, unique_id=None, offload=False):
+    """Fill `state_dict`'s tensors in place from a checkpoint at `path`,
+    resharding to each target's CURRENT sharding/placement (which may differ
+    from the one it was saved with)."""
+    from ...core.tensor import Tensor
+    with open(os.path.join(path, _META)) as f:
+        meta = json.load(f)
+    tensors = meta["tensors"]
+
+    def walk(d, prefix=""):
+        for k, v in d.items():
+            name = f"{prefix}.{k}" if prefix else str(k)
+            if isinstance(v, dict):
+                walk(v, name)
+                continue
+            if name not in tensors:
+                raise KeyError(f"'{name}' not found in checkpoint {path}")
+            tmeta = tensors[name]
+            if isinstance(v, Tensor):
+                v._data = _load_one(path, tmeta, v._data)
+            elif isinstance(v, jax.Array):
+                d[k] = _load_one(path, tmeta, v)
+            else:
+                block = _read_block(path, tmeta,
+                                    (0,) * len(tmeta["shape"]),
+                                    tuple(tmeta["shape"]))
+                d[k] = block
+    walk(state_dict)
+    return state_dict

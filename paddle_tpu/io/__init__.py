@@ -360,9 +360,20 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in idx_batch])
 
     def __iter__(self):
+        # reader-time attribution for the throughput meter
+        # (reference timer.py hooks the reader the same way)
+        from ..profiler.timer import benchmark as _benchmark
+        bm = _benchmark()
         if self.num_workers == 0:
-            yield from self._iter_batches()
-            return
+            it = self._iter_batches()
+            while True:
+                bm.before_reader()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return
+                bm.after_reader()
+                yield item
         q: queue.Queue = queue.Queue(maxsize=self.prefetch_factor * self.num_workers)
         sentinel = object()
 
@@ -370,13 +381,18 @@ class DataLoader:
             try:
                 for b in self._iter_batches():
                     q.put(b)
-            finally:
                 q.put(sentinel)
+            except BaseException as e:  # surface worker errors to the consumer
+                q.put(e)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
         while True:
+            bm.before_reader()
             item = q.get()
             if item is sentinel:
                 break
+            if isinstance(item, BaseException):
+                raise item
+            bm.after_reader()
             yield item

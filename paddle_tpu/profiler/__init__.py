@@ -1,1 +1,22 @@
+"""Profiler.
 
+Parity with /root/reference/python/paddle/profiler/profiler.py (Profiler
+:358, scheduler states :89, export_chrome_tracing :227) and
+profiler_statistic.py, re-based on TPU tooling: host annotations are
+recorded by a lightweight in-process tracer (and mirrored into
+jax.profiler.TraceAnnotation so they appear in XPlane device traces), while
+device-side timelines come from jax.profiler.start_trace/stop_trace
+(TensorBoard-compatible) — replacing the reference's CUPTI CudaTracer.
+Chrome-trace JSON export keeps the reference's output contract.
+"""
+from .profiler import (  # noqa: F401
+    Profiler, ProfilerState, ProfilerTarget, RecordEvent,
+    export_chrome_tracing, load_profiler_result, make_scheduler,
+)
+from .timer import benchmark  # noqa: F401
+
+__all__ = [
+    "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+    "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+    "benchmark",
+]

@@ -1,0 +1,281 @@
+"""Profiler core: scheduler-driven host tracer + chrome-trace export.
+
+Reference call shape (python/paddle/profiler/profiler.py):
+    p = Profiler(targets=[...], scheduler=(2, 5), on_trace_ready=...)
+    p.start(); loop: train_step(); p.step(); ...; p.stop()
+    p.summary()
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from enum import Enum
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1          # accepted for API compat; maps to the TPU device trace
+    TPU = 1
+    CUSTOM_DEVICE = 2
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0):
+    """Reference make_scheduler: step -> ProfilerState cycle
+    [CLOSED]*closed -> [READY]*ready -> [RECORD]*(record-1) ->
+    RECORD_AND_RETURN, repeated `repeat` times (0 = forever)."""
+    period = closed + ready + record
+
+    def scheduler_fn(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        step -= skip_first
+        cycle = step // period
+        if repeat and cycle >= repeat:
+            return ProfilerState.CLOSED
+        pos = step % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos < period - 1:
+            return ProfilerState.RECORD
+        return ProfilerState.RECORD_AND_RETURN
+
+    return scheduler_fn
+
+
+def _default_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name: str, worker_name: str | None = None):
+    """on_trace_ready callback writing chrome://tracing JSON
+    (reference profiler.py:227)."""
+    os.makedirs(dir_name, exist_ok=True)
+
+    def handler(prof: "Profiler"):
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(
+            dir_name, f"{name}_time_{int(time.time())}.paddle_trace.json")
+        prof._export_chrome(path)
+        prof._last_export_path = path
+
+    return handler
+
+
+def load_profiler_result(file_name: str):
+    with open(file_name) as f:
+        return json.load(f)
+
+
+class _HostTracer:
+    """Collects (name, start_ns, dur_ns, tid) host events."""
+
+    def __init__(self):
+        self.events = []
+        self.enabled = False
+        self._lock = threading.Lock()
+
+    def add(self, name, start_ns, dur_ns):
+        if not self.enabled:
+            return
+        with self._lock:
+            self.events.append(
+                (name, start_ns, dur_ns, threading.get_ident()))
+
+
+_active_tracer: _HostTracer | None = None
+
+
+class RecordEvent:
+    """Host annotation context manager (reference utils.py RecordEvent);
+    also mirrored into the device trace via jax.profiler.TraceAnnotation."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._jax_ann = None
+        self._begin_ns = None
+
+    def begin(self):
+        self._begin_ns = time.perf_counter_ns()
+        try:
+            import jax.profiler
+            self._jax_ann = jax.profiler.TraceAnnotation(self.name)
+            self._jax_ann.__enter__()
+        except Exception:
+            self._jax_ann = None
+
+    def end(self):
+        if self._jax_ann is not None:
+            self._jax_ann.__exit__(None, None, None)
+            self._jax_ann = None
+        if self._begin_ns is not None and _active_tracer is not None:
+            _active_tracer.add(self.name, self._begin_ns,
+                               time.perf_counter_ns() - self._begin_ns)
+        self._begin_ns = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+class Profiler:
+    """Scheduler-driven profiler (reference profiler.py:358)."""
+
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False, custom_device_types=None):
+        if scheduler is None:
+            self._scheduler = _default_scheduler
+        elif callable(scheduler):
+            self._scheduler = scheduler
+        elif isinstance(scheduler, (tuple, list)) and len(scheduler) == 2:
+            start, end = scheduler
+            self._scheduler = make_scheduler(
+                closed=max(start - 1, 0), ready=(1 if start >= 1 else 0),
+                record=end - start, repeat=1)
+        else:
+            raise TypeError("scheduler must be callable or (start, end)")
+        self._targets = list(targets or [ProfilerTarget.CPU])
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._tracer = _HostTracer()
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._device_trace_dir = None
+        self._device_tracing = False
+        self._last_export_path = None
+        from .timer import benchmark
+        self._benchmark = benchmark()
+
+    # -- state machinery --------------------------------------------------
+    def _transition(self, new_state: ProfilerState):
+        global _active_tracer
+        from ..core import dispatch as _dispatch
+        old = self._state
+        # RECORD_AND_RETURN is the LAST record step of a cycle: close it out
+        # whatever comes next (back-to-back cycles included)
+        if old is ProfilerState.RECORD_AND_RETURN:
+            self._finish_record()
+        if new_state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            if old is not ProfilerState.RECORD:
+                self._tracer.events.clear()
+            self._tracer.enabled = True
+            _active_tracer = self._tracer
+            # per-op host spans from the eager dispatch hot loop
+            _dispatch._op_observer = self._tracer.add
+            self._maybe_start_device_trace()
+        else:
+            if old is ProfilerState.RECORD:  # e.g. stop() mid-cycle
+                self._finish_record()
+            self._tracer.enabled = False
+            _active_tracer = None
+            _dispatch._op_observer = None
+        self._state = new_state
+
+    def _maybe_start_device_trace(self):
+        if self._timer_only or self._device_tracing:
+            return
+        want_device = any(t != ProfilerTarget.CPU for t in self._targets)
+        if not want_device:
+            return
+        try:
+            import jax.profiler
+            self._device_trace_dir = (self._device_trace_dir
+                                      or os.path.join(os.getcwd(),
+                                                      "profiler_log"))
+            jax.profiler.start_trace(self._device_trace_dir)
+            self._device_tracing = True
+        except Exception:
+            self._device_tracing = False
+
+    def _finish_record(self):
+        if self._device_tracing:
+            try:
+                import jax.profiler
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._device_tracing = False
+        if self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+
+    # -- public API -------------------------------------------------------
+    def start(self):
+        self._benchmark.begin()
+        self._transition(self._scheduler(self._step))
+
+    def step(self, num_samples=None):
+        self._benchmark.step(num_samples)
+        self._step += 1
+        self._transition(self._scheduler(self._step))
+
+    def stop(self):
+        self._benchmark.end()
+        self._transition(ProfilerState.CLOSED)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def step_info(self, unit=None):
+        return self._benchmark.step_info(unit)
+
+    # -- results ----------------------------------------------------------
+    def events(self):
+        return list(self._tracer.events)
+
+    def _export_chrome(self, path):
+        trace_events = []
+        for name, start_ns, dur_ns, tid in self._tracer.events:
+            trace_events.append({
+                "ph": "X", "cat": "host", "name": name,
+                "ts": start_ns / 1000.0, "dur": dur_ns / 1000.0,
+                "pid": os.getpid(), "tid": tid,
+            })
+        with open(path, "w") as f:
+            json.dump({"traceEvents": trace_events,
+                       "displayTimeUnit": "ms"}, f)
+
+    def export(self, path, format="json"):
+        self._export_chrome(path)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        """Aggregate host events by name (reference profiler_statistic
+        table, condensed)."""
+        agg = {}
+        for name, _, dur_ns, _ in self._tracer.events:
+            tot, cnt, mx = agg.get(name, (0.0, 0, 0.0))
+            agg[name] = (tot + dur_ns, cnt + 1, max(mx, dur_ns))
+        unit_div = {"ms": 1e6, "us": 1e3, "s": 1e9}[time_unit]
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total(' + time_unit + ')':>14}"
+                 f"{'Avg(' + time_unit + ')':>12}{'Max(' + time_unit + ')':>12}"]
+        for name, (tot, cnt, mx) in sorted(agg.items(),
+                                           key=lambda kv: -kv[1][0]):
+            lines.append(f"{name[:39]:<40}{cnt:>8}{tot / unit_div:>14.3f}"
+                         f"{tot / cnt / unit_div:>12.3f}{mx / unit_div:>12.3f}")
+        table = "\n".join(lines)
+        print(table)
+        return table

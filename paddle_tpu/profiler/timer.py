@@ -1,0 +1,104 @@
+"""Throughput benchmark timer (reference python/paddle/profiler/timer.py).
+
+benchmark() returns the global Benchmark: begin()/step(n)/end() bracket the
+train loop and step_info() reports reader cost, batch cost and ips
+(items/sec) — the meter used for the BASELINE.md perf numbers.
+"""
+from __future__ import annotations
+
+import time
+
+__all__ = ["benchmark", "Benchmark"]
+
+
+class _Stat:
+    def __init__(self):
+        self.total = 0.0
+        self.count = 0
+        self.window_total = 0.0
+        self.window_count = 0
+
+    def add(self, v):
+        self.total += v
+        self.count += 1
+        self.window_total += v
+        self.window_count += 1
+
+    def reset_window(self):
+        self.window_total = 0.0
+        self.window_count = 0
+
+    @property
+    def avg(self):
+        return self.total / self.count if self.count else 0.0
+
+    @property
+    def window_avg(self):
+        return (self.window_total / self.window_count
+                if self.window_count else 0.0)
+
+
+class Benchmark:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._last = None
+        self._reader_mark = None
+        self.batch_cost = _Stat()
+        self.reader_cost = _Stat()
+        self._samples = 0
+        self._window_samples = 0
+        self._running = False
+
+    # hooks called by DataLoader to attribute reader time
+    def before_reader(self):
+        self._reader_mark = time.perf_counter()
+
+    def after_reader(self):
+        if self._reader_mark is not None and self._running:
+            self.reader_cost.add(time.perf_counter() - self._reader_mark)
+            self._reader_mark = None
+
+    def begin(self):
+        self._running = True
+        self._last = time.perf_counter()
+
+    def step(self, num_samples=None):
+        if not self._running:
+            self.begin()
+        now = time.perf_counter()
+        self.batch_cost.add(now - self._last)
+        self._last = now
+        if num_samples:
+            self._samples += num_samples
+            self._window_samples += num_samples
+
+    def end(self):
+        self._running = False
+
+    @property
+    def ips(self):
+        """Items/sec over the current window (falls back to steps/sec)."""
+        t = self.batch_cost.window_total
+        if t <= 0:
+            return 0.0
+        n = self._window_samples or self.batch_cost.window_count
+        return n / t
+
+    def step_info(self, unit=None):
+        u = unit or "samples"
+        msg = (f"reader_cost: {self.reader_cost.window_avg:.5f} s, "
+               f"batch_cost: {self.batch_cost.window_avg:.5f} s, "
+               f"ips: {self.ips:.3f} {u}/s")
+        self.batch_cost.reset_window()
+        self.reader_cost.reset_window()
+        self._window_samples = 0
+        return msg
+
+
+_benchmark = Benchmark()
+
+
+def benchmark() -> Benchmark:
+    return _benchmark
