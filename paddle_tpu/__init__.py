@@ -59,6 +59,7 @@ from . import amp  # noqa: E402
 from . import autograd  # noqa: E402
 from . import device  # noqa: E402
 from . import distributed  # noqa: E402
+from . import distribution  # noqa: E402
 from . import framework  # noqa: E402
 from . import hapi  # noqa: E402
 from . import incubate  # noqa: E402

@@ -20,8 +20,6 @@ import jax
 
 __all__ = ["save_state_dict", "load_state_dict"]
 
-_META = "metadata.json"
-
 
 def _flatten(state_dict, prefix=""):
     flat = {}
@@ -94,14 +92,35 @@ def save_state_dict(state_dict, path, process_group=None,
             "dtype": str(arr.dtype),
             "shards": shards_meta,
         }
-    # single-host: this process IS the coordinator; multi-host would merge
-    # per-rank metadata here (each rank's shard lists are disjoint by offset)
-    with open(os.path.join(path, _META), "w") as f:
+    # each rank writes its OWN metadata file (no write races); load merges
+    # them all — the per-rank shard lists are disjoint by offset
+    tmp = os.path.join(path, f".metadata.{rank}.json.tmp")
+    with open(tmp, "w") as f:
         json.dump(meta, f)
+    os.replace(tmp, os.path.join(path, f"metadata.{rank}.json"))
+
+
+def _read_meta(path):
+    """Merge every rank's metadata file into one tensor->shards map."""
+    import glob
+    files = sorted(glob.glob(os.path.join(path, "metadata*.json")))
+    if not files:
+        raise FileNotFoundError(f"no metadata files under {path}")
+    tensors = {}
+    for fp in files:
+        with open(fp) as f:
+            part = json.load(f)
+        for name, tmeta in part["tensors"].items():
+            if name in tensors:
+                tensors[name]["shards"].extend(tmeta["shards"])
+            else:
+                tensors[name] = tmeta
+    return tensors
 
 
 def _load_npy(path, fname, dtype_name):
-    data = np.load(os.path.join(path, fname))
+    # mmap: partial-block reshard reads touch only the needed slices
+    data = np.load(os.path.join(path, fname), mmap_mode="r")
     if dtype_name == "bfloat16":
         import ml_dtypes
         data = data.view(ml_dtypes.bfloat16)
@@ -183,9 +202,7 @@ def load_state_dict(state_dict, path, process_group=None,
     resharding to each target's CURRENT sharding/placement (which may differ
     from the one it was saved with)."""
     from ...core.tensor import Tensor
-    with open(os.path.join(path, _META)) as f:
-        meta = json.load(f)
-    tensors = meta["tensors"]
+    tensors = _read_meta(path)
 
     def walk(d, prefix=""):
         for k, v in d.items():

@@ -1,0 +1,12 @@
+"""paddle.distributed.launch equivalent.
+
+Parity with /root/reference/python/paddle/distributed/launch/main.py:23
+(collective controller + elastic restarts), TPU-shaped: the env contract
+(PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_CURRENT_ENDPOINT /
+PADDLE_TRAINER_ENDPOINTS / PADDLE_MASTER) is preserved so fleet code reads
+ranks identically, and the same variables seed jax.distributed
+(coordinator address/process id) instead of NCCL rendezvous.
+"""
+from .main import launch, main  # noqa: F401
+
+__all__ = ["launch", "main"]

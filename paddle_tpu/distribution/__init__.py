@@ -1,0 +1,26 @@
+"""Probability distributions (reference: python/paddle/distribution/ — ~30
+distributions, transforms, and the KL registry)."""
+from .distribution import Distribution, ExponentialFamily  # noqa: F401
+from .continuous import (  # noqa: F401
+    Beta, Cauchy, Chi2, Dirichlet, Exponential, Gamma, Gumbel, Laplace,
+    LogNormal, MultivariateNormal, Normal, StudentT, Uniform,
+)
+from .discrete import (  # noqa: F401
+    Bernoulli, Binomial, Categorical, Geometric, Multinomial, Poisson,
+)
+from .transform import (  # noqa: F401
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    PowerTransform, SigmoidTransform, TanhTransform, Transform,
+    TransformedDistribution,
+)
+from .kl import kl_divergence, register_kl  # noqa: F401
+
+__all__ = [
+    "Distribution", "ExponentialFamily", "Normal", "Uniform", "Exponential",
+    "Laplace", "LogNormal", "Gumbel", "Cauchy", "Beta", "Gamma", "Chi2",
+    "StudentT", "Dirichlet", "MultivariateNormal", "Bernoulli", "Binomial",
+    "Categorical", "Geometric", "Multinomial", "Poisson", "Transform",
+    "AffineTransform", "ExpTransform", "PowerTransform", "SigmoidTransform",
+    "TanhTransform", "AbsTransform", "ChainTransform",
+    "TransformedDistribution", "kl_divergence", "register_kl",
+]

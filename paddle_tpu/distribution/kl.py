@@ -1,0 +1,124 @@
+"""KL divergence registry (reference python/paddle/distribution/kl.py:
+kl_divergence + register_kl dispatch on (type(p), type(q)) with MRO
+resolution)."""
+from __future__ import annotations
+
+import math
+
+from ..ops import math as _m
+from .continuous import Beta, Dirichlet, Exponential, Gamma, Laplace, \
+    LogNormal, Normal, Uniform
+from .discrete import Bernoulli, Categorical, Geometric, Poisson
+
+__all__ = ["kl_divergence", "register_kl"]
+
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    def decorator(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return decorator
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        # MRO-based fallback (subclasses, e.g. Chi2 -> Gamma)
+        candidates = [
+            (cp, cq) for (cp, cq) in _KL_REGISTRY
+            if isinstance(p, cp) and isinstance(q, cq)]
+        if not candidates:
+            raise NotImplementedError(
+                f"no KL registered for ({type(p).__name__}, "
+                f"{type(q).__name__})")
+        fn = _KL_REGISTRY[candidates[0]]
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2.0
+    t1 = ((p.loc - q.loc) / q.scale) ** 2.0
+    return 0.5 * (var_ratio + t1 - 1.0 - _m.log(var_ratio))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return _m.log((q.high - q.low) / (p.high - p.low))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = q.rate / p.rate
+    return r - 1.0 - _m.log(r)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    d = _m.abs(p.loc - q.loc)
+    return (_m.log(q.scale / p.scale) + d / q.scale
+            + (p.scale / q.scale) * _m.exp(-d / p.scale) - 1.0)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    a_p, b_p = p.concentration, p.rate
+    a_q, b_q = q.concentration, q.rate
+    return ((a_p - a_q) * _m.digamma(a_p) - _m.lgamma(a_p) + _m.lgamma(a_q)
+            + a_q * (_m.log(b_p) - _m.log(b_q))
+            + a_p * (b_q / b_p - 1.0))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    def lbeta(a, b):
+        return _m.lgamma(a) + _m.lgamma(b) - _m.lgamma(a + b)
+    s_p = p.alpha + p.beta
+    return (lbeta(q.alpha, q.beta) - lbeta(p.alpha, p.beta)
+            + (p.alpha - q.alpha) * _m.digamma(p.alpha)
+            + (p.beta - q.beta) * _m.digamma(p.beta)
+            + (q.alpha - p.alpha + q.beta - p.beta) * _m.digamma(s_p))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    from ..ops.math import sum as _sum
+    a_p, a_q = p.concentration, q.concentration
+    a0 = _sum(a_p, axis=-1)
+    return (_m.lgamma(a0) - _sum(_m.lgamma(a_p), axis=-1)
+            - _m.lgamma(_sum(a_q, axis=-1))
+            + _sum(_m.lgamma(a_q), axis=-1)
+            + _sum((a_p - a_q) * (_m.digamma(a_p)
+                                  - _m.digamma(a0).unsqueeze(-1)), axis=-1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    from .discrete import _xlogy
+    pp, pq = p.probs, q.probs
+    return (_xlogy(pp, pp / pq) + _xlogy(1.0 - pp, (1.0 - pp) / (1.0 - pq)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    from ..ops.math import sum as _sum
+    return _sum(p.probs * (p.logits - q.logits), axis=-1)
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson(p, q):
+    return p.rate * (_m.log(p.rate) - _m.log(q.rate)) - p.rate + q.rate
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    return ((1.0 - p.probs) / p.probs * (_m.log1p(-p.probs)
+                                         - _m.log1p(-q.probs))
+            + _m.log(p.probs) - _m.log(q.probs))
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal(p, q):
+    return _kl_normal(p._base, q._base)

@@ -1,0 +1,156 @@
+"""Transforms + TransformedDistribution.
+
+Parity with /root/reference/python/paddle/distribution/{transform.py,
+transformed_distribution.py}: invertible maps with log|det J| enabling
+change-of-variable densities.
+"""
+from __future__ import annotations
+
+import math
+
+from ..core.tensor import Tensor
+from ..ops import creation as _c
+from ..ops import math as _m
+from .distribution import Distribution, _t
+
+__all__ = ["Transform", "AffineTransform", "ExpTransform", "PowerTransform",
+           "SigmoidTransform", "TanhTransform", "AbsTransform",
+           "ChainTransform", "TransformedDistribution"]
+
+
+class Transform:
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def forward(self, x):
+        return self.loc + self.scale * x
+
+    def inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return _m.log(_m.abs(self.scale)) * _c.ones_like(x)
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return _m.exp(x)
+
+    def inverse(self, y):
+        return _m.log(y)
+
+    def forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _t(power)
+
+    def forward(self, x):
+        return x ** self.power
+
+    def inverse(self, y):
+        return y ** (1.0 / self.power)
+
+    def forward_log_det_jacobian(self, x):
+        return _m.log(_m.abs(self.power * x ** (self.power - 1.0)))
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        from ..nn.functional.activation import sigmoid
+        return sigmoid(x)
+
+    def inverse(self, y):
+        return _m.log(y) - _m.log1p(-y)
+
+    def forward_log_det_jacobian(self, x):
+        from ..nn.functional.activation import softplus
+        return -softplus(-x) - softplus(x)
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return _m.tanh(x)
+
+    def inverse(self, y):
+        return 0.5 * (_m.log1p(y) - _m.log1p(-y))
+
+    def forward_log_det_jacobian(self, x):
+        from ..nn.functional.activation import softplus
+        # log(1 - tanh(x)^2) = 2 (log2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - softplus(-2.0 * x))
+
+
+class AbsTransform(Transform):
+    def forward(self, x):
+        return _m.abs(x)
+
+    def inverse(self, y):
+        return y
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            j = t.forward_log_det_jacobian(x)
+            total = j if total is None else total + j
+            x = t.forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """base distribution pushed through transforms
+    (reference transformed_distribution.py)."""
+
+    def __init__(self, base, transforms, name=None):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transform = (transforms[0] if len(transforms) == 1
+                          else ChainTransform(transforms))
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        return self.transform.forward(self.base.sample(shape))
+
+    def rsample(self, shape=()):
+        return self.transform.forward(self.base.rsample(shape))
+
+    def log_prob(self, value):
+        x = self.transform.inverse(value)
+        return (self.base.log_prob(x)
+                - self.transform.forward_log_det_jacobian(x))

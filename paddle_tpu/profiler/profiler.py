@@ -36,6 +36,11 @@ def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
     """Reference make_scheduler: step -> ProfilerState cycle
     [CLOSED]*closed -> [READY]*ready -> [RECORD]*(record-1) ->
     RECORD_AND_RETURN, repeated `repeat` times (0 = forever)."""
+    if closed < 0 or ready < 0:
+        raise ValueError("closed/ready must be >= 0")
+    if record < 1:
+        raise ValueError("record must be >= 1 (each cycle needs at least "
+                         "the RECORD_AND_RETURN step)")
     period = closed + ready + record
 
     def scheduler_fn(step: int) -> ProfilerState:
@@ -66,10 +71,16 @@ def export_chrome_tracing(dir_name: str, worker_name: str | None = None):
     (reference profiler.py:227)."""
     os.makedirs(dir_name, exist_ok=True)
 
+    seq = [0]
+
     def handler(prof: "Profiler"):
         name = worker_name or f"host_{os.getpid()}"
+        # ns timestamp + per-handler sequence: cycles flushed within the
+        # same second must not overwrite each other
         path = os.path.join(
-            dir_name, f"{name}_time_{int(time.time())}.paddle_trace.json")
+            dir_name,
+            f"{name}_time_{time.time_ns()}_{seq[0]}.paddle_trace.json")
+        seq[0] += 1
         prof._export_chrome(path)
         prof._last_export_path = path
 
@@ -110,6 +121,8 @@ class RecordEvent:
         self._begin_ns = None
 
     def begin(self):
+        if _active_tracer is None:
+            return  # no profiler recording: annotations are free
         self._begin_ns = time.perf_counter_ns()
         try:
             import jax.profiler

@@ -114,6 +114,26 @@ def test_load_missing_key_raises(tmp_path):
         load_state_dict({"b": jnp.zeros((2,))}, str(tmp_path))
 
 
+def test_multi_rank_metadata_merges(tmp_path, monkeypatch):
+    """Each rank writes its own metadata file; load merges all of them
+    (no last-writer-wins race on a shared metadata.json)."""
+    rng = np.random.RandomState(9)
+    a = jnp.asarray(rng.randn(4, 4), jnp.float32)
+    b = jnp.asarray(rng.randn(6), jnp.float32)
+    save_state_dict({"a": a}, str(tmp_path))           # rank 0
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    save_state_dict({"b": b}, str(tmp_path))           # "rank 1"
+    monkeypatch.undo()
+    import os
+    metas = [f for f in os.listdir(tmp_path) if f.startswith("metadata")]
+    assert len(metas) == 2
+    tgt = {"a": jnp.zeros((4, 4), jnp.float32),
+           "b": jnp.zeros((6,), jnp.float32)}
+    load_state_dict(tgt, str(tmp_path))
+    np.testing.assert_allclose(np.asarray(tgt["a"]), np.asarray(a))
+    np.testing.assert_allclose(np.asarray(tgt["b"]), np.asarray(b))
+
+
 def test_bfloat16_roundtrip(tmp_path):
     w = jnp.asarray(np.random.RandomState(3).randn(8, 8), jnp.bfloat16)
     save_state_dict({"w": w}, str(tmp_path))

@@ -1,0 +1,191 @@
+"""Distribution namespace: closed-form log_prob/entropy/KL + sampling moments.
+
+Mirrors the reference's per-distribution tests (test/distribution/
+test_distribution_*.py: scipy-checked log_prob and KL) using hand-derived
+closed forms instead of scipy.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as dist
+
+
+def test_normal_log_prob_entropy_kl():
+    n = dist.Normal(loc=1.0, scale=2.0)
+    lp = float(n.log_prob(paddle.to_tensor(2.0)).numpy())
+    ref = -((2.0 - 1.0) ** 2) / (2 * 4.0) - math.log(2.0) \
+        - 0.5 * math.log(2 * math.pi)
+    assert abs(lp - ref) < 1e-5
+    ent = float(n.entropy().numpy())
+    assert abs(ent - (0.5 + 0.5 * math.log(2 * math.pi) + math.log(2.0))) < 1e-5
+    m = dist.Normal(0.0, 1.0)
+    kl = float(dist.kl_divergence(n, m).numpy())
+    ref_kl = 0.5 * (4.0 + 1.0 - 1.0 - math.log(4.0))
+    assert abs(kl - ref_kl) < 1e-5
+    kl_self = float(dist.kl_divergence(n, dist.Normal(1.0, 2.0)).numpy())
+    assert abs(kl_self) < 1e-6
+
+
+def test_normal_sampling_moments():
+    paddle.seed(0)
+    n = dist.Normal(loc=3.0, scale=0.5)
+    s = n.sample((20000,)).numpy()
+    assert abs(s.mean() - 3.0) < 0.02
+    assert abs(s.std() - 0.5) < 0.02
+
+
+def test_uniform_support_and_entropy():
+    u = dist.Uniform(low=-1.0, high=3.0)
+    assert abs(float(u.entropy().numpy()) - math.log(4.0)) < 1e-6
+    lp_in = float(u.log_prob(paddle.to_tensor(0.0)).numpy())
+    assert abs(lp_in + math.log(4.0)) < 1e-6
+    lp_out = float(u.log_prob(paddle.to_tensor(5.0)).numpy())
+    assert lp_out == -np.inf
+    paddle.seed(1)
+    s = u.sample((5000,)).numpy()
+    assert s.min() >= -1.0 and s.max() < 3.0
+
+
+def test_gamma_beta_logprob():
+    g = dist.Gamma(concentration=2.0, rate=3.0)
+    x = 0.7
+    ref = (2.0 * math.log(3.0) + (2.0 - 1.0) * math.log(x) - 3.0 * x
+           - math.lgamma(2.0))
+    assert abs(float(g.log_prob(paddle.to_tensor(x)).numpy()) - ref) < 1e-5
+    assert abs(float(g.mean.numpy()) - 2.0 / 3.0) < 1e-6
+
+    b = dist.Beta(alpha=2.0, beta=3.0)
+    x = 0.4
+    lbeta = math.lgamma(2.0) + math.lgamma(3.0) - math.lgamma(5.0)
+    ref = (2.0 - 1) * math.log(x) + (3.0 - 1) * math.log(1 - x) - lbeta
+    assert abs(float(b.log_prob(paddle.to_tensor(x)).numpy()) - ref) < 1e-5
+
+
+def test_chi2_is_gamma_and_kl_mro_fallback():
+    c = dist.Chi2(df=4.0)
+    assert abs(float(c.mean.numpy()) - 4.0) < 1e-6
+    # Chi2 vs Gamma KL resolves through the (Gamma, Gamma) registration
+    g = dist.Gamma(2.0, 0.5)
+    assert abs(float(dist.kl_divergence(c, g).numpy())) < 1e-6
+
+
+def test_bernoulli_categorical():
+    be = dist.Bernoulli(probs=0.3)
+    assert abs(float(be.log_prob(paddle.to_tensor(1.0)).numpy())
+               - math.log(0.3)) < 1e-6
+    ent_ref = -(0.3 * math.log(0.3) + 0.7 * math.log(0.7))
+    assert abs(float(be.entropy().numpy()) - ent_ref) < 1e-6
+
+    c = dist.Categorical(probs=[0.2, 0.3, 0.5])
+    assert abs(float(c.log_prob(paddle.to_tensor(2)).numpy())
+               - math.log(0.5)) < 1e-5
+    ent = float(c.entropy().numpy())
+    ref = -sum(p * math.log(p) for p in (0.2, 0.3, 0.5))
+    assert abs(ent - ref) < 1e-5
+    paddle.seed(3)
+    s = c.sample((8000,)).numpy()
+    freq = np.bincount(s.astype(int), minlength=3) / 8000.0
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.03)
+
+
+def test_multinomial_binomial_poisson_geometric():
+    m = dist.Multinomial(10, paddle.to_tensor([0.5, 0.5]))
+    lp = float(m.log_prob(paddle.to_tensor([5.0, 5.0])).numpy())
+    ref = math.lgamma(11) - 2 * math.lgamma(6) + 10 * math.log(0.5)
+    assert abs(lp - ref) < 1e-4
+
+    b = dist.Binomial(10, 0.4)
+    lp = float(b.log_prob(paddle.to_tensor(3.0)).numpy())
+    ref = (math.lgamma(11) - math.lgamma(4) - math.lgamma(8)
+           + 3 * math.log(0.4) + 7 * math.log(0.6))
+    assert abs(lp - ref) < 1e-5
+
+    p = dist.Poisson(2.5)
+    lp = float(p.log_prob(paddle.to_tensor(3.0)).numpy())
+    ref = 3 * math.log(2.5) - 2.5 - math.lgamma(4)
+    assert abs(lp - ref) < 1e-5
+
+    g = dist.Geometric(0.25)
+    lp = float(g.log_prob(paddle.to_tensor(2.0)).numpy())
+    assert abs(lp - (2 * math.log(0.75) + math.log(0.25))) < 1e-6
+
+
+def test_dirichlet_and_mvn():
+    d = dist.Dirichlet(paddle.to_tensor([1.0, 2.0, 3.0]))
+    np.testing.assert_allclose(d.mean.numpy(), [1 / 6, 2 / 6, 3 / 6],
+                               rtol=1e-5)
+    x = np.array([0.2, 0.3, 0.5], np.float32)
+    lp = float(d.log_prob(paddle.to_tensor(x)).numpy())
+    lnorm = (sum(math.lgamma(a) for a in (1., 2., 3.)) - math.lgamma(6.0))
+    ref = sum((a - 1) * math.log(v) for a, v in zip((1., 2., 3.), x)) - lnorm
+    assert abs(lp - ref) < 1e-4
+
+    cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+    mvn = dist.MultivariateNormal(paddle.to_tensor([0.0, 0.0]),
+                                  covariance_matrix=paddle.to_tensor(cov))
+    v = np.array([0.3, -0.2], np.float32)
+    lp = float(mvn.log_prob(paddle.to_tensor(v)).numpy())
+    inv = np.linalg.inv(cov)
+    ref = -0.5 * (2 * math.log(2 * math.pi) + math.log(np.linalg.det(cov))
+                  + v @ inv @ v)
+    assert abs(lp - ref) < 1e-4
+    paddle.seed(5)
+    s = mvn.sample((20000,)).numpy()
+    np.testing.assert_allclose(np.cov(s.T), cov, atol=0.06)
+
+
+def test_rsample_differentiable():
+    loc = paddle.to_tensor(0.5, stop_gradient=False)
+    scale = paddle.to_tensor(1.5, stop_gradient=False)
+    n = dist.Normal(loc, scale)
+    paddle.seed(7)
+    s = n.rsample((64,))
+    assert not s.stop_gradient
+    s.sum().backward()
+    assert abs(float(loc.grad.numpy()) - 64.0) < 1e-4  # d/dloc sum = N
+    assert scale.grad is not None
+
+
+def test_transformed_distribution_lognormal_equivalence():
+    base = dist.Normal(0.3, 0.7)
+    td = dist.TransformedDistribution(base, [dist.ExpTransform()])
+    ln = dist.LogNormal(0.3, 0.7)
+    for v in (0.5, 1.0, 2.3):
+        a = float(td.log_prob(paddle.to_tensor(v)).numpy())
+        b = float(ln.log_prob(paddle.to_tensor(v)).numpy())
+        assert abs(a - b) < 1e-5
+
+
+def test_affine_sigmoid_tanh_transforms():
+    t = dist.AffineTransform(1.0, 2.0)
+    x = paddle.to_tensor(0.5)
+    assert abs(float(t.forward(x).numpy()) - 2.0) < 1e-6
+    assert abs(float(t.inverse(t.forward(x)).numpy()) - 0.5) < 1e-6
+    assert abs(float(t.forward_log_det_jacobian(x).numpy())
+               - math.log(2.0)) < 1e-6
+
+    for tr in (dist.SigmoidTransform(), dist.TanhTransform()):
+        y = tr.forward(x)
+        back = float(tr.inverse(y).numpy())
+        assert abs(back - 0.5) < 1e-5
+        # numeric jacobian check
+        eps = 1e-4
+        num = (float(tr.forward(paddle.to_tensor(0.5 + eps)).numpy())
+               - float(tr.forward(paddle.to_tensor(0.5 - eps)).numpy())) / (2 * eps)
+        assert abs(float(tr.forward_log_det_jacobian(x).numpy())
+                   - math.log(num)) < 1e-3
+
+
+def test_kl_registry_custom():
+    class MyDist(dist.Normal):
+        pass
+
+    @dist.register_kl(MyDist, MyDist)
+    def _kl_my(p, q):
+        return paddle.to_tensor(42.0)
+
+    assert float(dist.kl_divergence(MyDist(0., 1.), MyDist(0., 1.)).numpy()) \
+        == 42.0

@@ -1,0 +1,74 @@
+"""launch CLI: env contract, multi-process spawn, elastic restart.
+
+Mirrors the reference's launch tests (test/legacy_test/test_run.py spawns
+the CLI on dummy scripts and checks PADDLE_* env propagation)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_launch(tmp_path, script_body, extra_args=(), nproc=2):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(script_body))
+    env = dict(os.environ, PYTHONPATH=REPO + os.pathsep +
+               os.environ.get("PYTHONPATH", ""), JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", str(nproc),
+           "--log_dir", str(tmp_path / "log"), *extra_args, str(script)]
+    return subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=120, cwd=str(tmp_path))
+
+
+def test_launch_sets_env_contract(tmp_path):
+    r = _run_launch(tmp_path, """
+        import os
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        n = os.environ["PADDLE_TRAINERS_NUM"]
+        eps = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")
+        assert len(eps) == int(n)
+        assert os.environ["PADDLE_CURRENT_ENDPOINT"] == eps[int(rank)]
+        assert os.environ["PADDLE_MASTER"]
+        with open(f"done_{rank}", "w") as f:
+            f.write(os.environ["PADDLE_CURRENT_ENDPOINT"])
+    """)
+    assert r.returncode == 0, r.stderr
+    assert (tmp_path / "done_0").exists() and (tmp_path / "done_1").exists()
+    # distinct endpoints per rank
+    assert (tmp_path / "done_0").read_text() != \
+        (tmp_path / "done_1").read_text()
+
+
+def test_launch_propagates_failure(tmp_path):
+    r = _run_launch(tmp_path, """
+        import os, sys
+        sys.exit(7 if os.environ["PADDLE_TRAINER_ID"] == "1" else 0)
+    """)
+    assert r.returncode == 7
+
+
+def test_launch_elastic_restart(tmp_path):
+    """First attempt fails; the relaunch (elastic restart) succeeds."""
+    r = _run_launch(tmp_path, """
+        import os, sys
+        marker = "attempted_" + os.environ["PADDLE_TRAINER_ID"]
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            sys.exit(1)   # fail the first attempt
+        open("ok_" + os.environ["PADDLE_TRAINER_ID"], "w").close()
+    """, extra_args=("--elastic_level", "1", "--max_restart", "2"))
+    assert r.returncode == 0, r.stderr
+    assert (tmp_path / "ok_0").exists() and (tmp_path / "ok_1").exists()
+    assert "restart 1/2" in r.stderr
+
+
+def test_launch_writes_worker_logs(tmp_path):
+    r = _run_launch(tmp_path, """
+        import os
+        print("hello from rank", os.environ["PADDLE_TRAINER_ID"])
+    """)
+    assert r.returncode == 0
+    log0 = (tmp_path / "log" / "workerlog.0").read_text()
+    assert "hello from rank 0" in log0
