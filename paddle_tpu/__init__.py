@@ -60,6 +60,7 @@ from . import autograd  # noqa: E402
 from . import device  # noqa: E402
 from . import distributed  # noqa: E402
 from . import distribution  # noqa: E402
+from . import fft  # noqa: E402
 from . import framework  # noqa: E402
 from . import hapi  # noqa: E402
 from . import incubate  # noqa: E402
@@ -69,6 +70,8 @@ from . import linalg  # noqa: E402
 from . import metric  # noqa: E402
 from . import nn  # noqa: E402
 from . import optimizer  # noqa: E402
+from . import signal  # noqa: E402
+from . import sparse  # noqa: E402
 from . import vision  # noqa: E402
 
 from .framework.io import load, save  # noqa: E402

@@ -143,11 +143,40 @@ def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs):
     return shard_tensor(fn(*args, **kwargs), mesh, placements)
 
 
+def _resolve_partial(dist_tensor, target_placements):
+    """Sum per-device partial values over every mesh axis whose Partial
+    placement is being dropped (reference p_to_r / p_to_s reshard
+    functions, phi/core/distributed/auto_parallel/reshard/)."""
+    src_attr = dist_tensor._dist_attr
+    if src_attr is None:
+        return dist_tensor._data
+    mesh = src_attr.process_mesh
+    reduce_axes = []
+    for i, pl in enumerate(src_attr.placements):
+        tgt = (target_placements[i]
+               if i < len(target_placements) else Replicate())
+        if isinstance(pl, Partial) and not isinstance(tgt, Partial):
+            reduce_axes.append(mesh.dim_names[i])
+    if not reduce_axes:
+        return dist_tensor._data
+    from jax import shard_map
+    from jax import lax
+    jm = mesh.jax_mesh()
+    spec = _to_partition_spec(mesh, src_attr.placements, dist_tensor.ndim)
+    # check_vma=False: the "replicated" input really carries per-device
+    # partial values; psum performs the pending reduction
+    fn = shard_map(lambda x: lax.psum(x, tuple(reduce_axes)),
+                   mesh=jm, in_specs=spec, out_specs=spec, check_vma=False)
+    return jax.jit(fn)(dist_tensor._data)
+
+
 def reshard(dist_tensor, mesh: ProcessMesh, placements):
     """Convert placements (XLA emits the collectives: allgather for s->r,
-    slice for r->s, reduce for p->r, all_to_all for s->s')."""
+    slice for r->s, psum for p->r, reduce_scatter for p->s, all_to_all for
+    s->s')."""
+    arr = _resolve_partial(dist_tensor, placements)
     sharding = _sharding_for(mesh, placements, dist_tensor.ndim)
-    arr = jax.device_put(dist_tensor._data, sharding)
+    arr = jax.device_put(arr, sharding)
     out = Tensor(arr, stop_gradient=dist_tensor.stop_gradient,
                  name=dist_tensor.name)
     out._dist_attr = DistAttr(mesh, placements)

@@ -59,6 +59,16 @@ def save_state_dict(state_dict, path, process_group=None,
     """
     os.makedirs(path, exist_ok=True)
     rank = _process_rank()
+    if rank == coordinator_rank:
+        # clear any previous checkpoint at this path: stale metadata from a
+        # save with MORE ranks (or the legacy single metadata.json) would
+        # otherwise merge old shards into the new load.  Multi-host callers
+        # must barrier between this save and any concurrent one (the
+        # reference save_state_dict has the same contract).
+        import glob
+        for f in glob.glob(os.path.join(path, "metadata*.json")) + \
+                glob.glob(os.path.join(path, "*.npy")):
+            os.remove(f)
     flat = _flatten(state_dict)
     meta = {"tensors": {}}
     n_files = 0

@@ -55,9 +55,10 @@ def _parse_args(argv=None):
     return p.parse_args(argv)
 
 
-def _worker_env(rank, nprocs, ports, master, nnodes):
+def _worker_env(rank, nprocs, ports, master, nnodes, device_ids=None):
     env = dict(os.environ)
     endpoints = ",".join(f"127.0.0.1:{p}" for p in ports)
+    dev = device_ids[rank] if device_ids else str(rank)
     env.update({
         "PADDLE_TRAINER_ID": str(rank),
         "PADDLE_LOCAL_RANK": str(rank),
@@ -66,7 +67,7 @@ def _worker_env(rank, nprocs, ports, master, nnodes):
         "PADDLE_TRAINER_ENDPOINTS": endpoints,
         "PADDLE_MASTER": master,
         "PADDLE_NNODES": str(nnodes),
-        "FLAGS_selected_tpus": str(rank),
+        "FLAGS_selected_tpus": dev,
     })
     return env
 
@@ -75,10 +76,13 @@ def _spawn(args, nprocs):
     os.makedirs(args.log_dir, exist_ok=True)
     ports = [_free_port() for _ in range(nprocs)]
     master = args.master or f"127.0.0.1:{ports[0]}"
+    device_ids = ([d.strip() for d in args.devices.split(",")]
+                  if args.devices else None)
     procs = []
     logs = []
     for rank in range(nprocs):
-        env = _worker_env(rank, nprocs, ports, master, args.nnodes)
+        env = _worker_env(rank, nprocs, ports, master, args.nnodes,
+                          device_ids)
         cmd = [sys.executable, "-u", args.training_script,
                *args.training_script_args]
         logf = open(os.path.join(args.log_dir,

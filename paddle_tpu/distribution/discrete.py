@@ -75,7 +75,10 @@ class Categorical(Distribution):
         if logits is None and probs is None:
             raise ValueError("need logits or probs")
         if probs is not None:
-            self.probs = _t(probs)
+            from ..ops.math import sum as _sum
+            p = _t(probs)
+            # normalize count-style weights (torch/paddle semantics)
+            self.probs = p / _sum(p, axis=-1, keepdim=True)
             self.logits = _m.log(self.probs)
         else:
             lg = _t(logits)

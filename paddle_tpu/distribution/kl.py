@@ -25,7 +25,9 @@ def register_kl(cls_p, cls_q):
 def kl_divergence(p, q):
     fn = _KL_REGISTRY.get((type(p), type(q)))
     if fn is None:
-        # MRO-based fallback (subclasses, e.g. Chi2 -> Gamma)
+        # most-specific MRO fallback (subclasses, e.g. Chi2 -> Gamma):
+        # rank each applicable registration by how close its classes sit
+        # in the argument types' MROs (torch's _dispatch_kl does the same)
         candidates = [
             (cp, cq) for (cp, cq) in _KL_REGISTRY
             if isinstance(p, cp) and isinstance(q, cq)]
@@ -33,7 +35,11 @@ def kl_divergence(p, q):
             raise NotImplementedError(
                 f"no KL registered for ({type(p).__name__}, "
                 f"{type(q).__name__})")
-        fn = _KL_REGISTRY[candidates[0]]
+        mro_p = type(p).__mro__
+        mro_q = type(q).__mro__
+        best = min(candidates,
+                   key=lambda c: (mro_p.index(c[0]) + mro_q.index(c[1])))
+        fn = _KL_REGISTRY[best]
     return fn(p, q)
 
 

@@ -1,0 +1,111 @@
+"""paddle.fft namespace (reference python/paddle/fft.py — 1:1 API over the
+cuFFT kernels; here each transform is one dispatched XLA op over jnp.fft).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core import dispatch as D
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+           "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+           "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _norm(norm):
+    # paddle uses "backward"/"forward"/"ortho" like numpy
+    return norm if norm is not None else "backward"
+
+
+def _fft1(jfn, name):
+    def op(x, n=None, axis=-1, norm="backward", name_arg=None):
+        return D.apply(name, lambda a, n, axis, norm: jfn(a, n, axis, norm),
+                       (x,), {"n": n, "axis": int(axis), "norm": _norm(norm)})
+    op.__name__ = name
+    return op
+
+
+fft = _fft1(jnp.fft.fft, "fft")
+ifft = _fft1(jnp.fft.ifft, "ifft")
+rfft = _fft1(jnp.fft.rfft, "rfft")
+irfft = _fft1(jnp.fft.irfft, "irfft")
+hfft = _fft1(jnp.fft.hfft, "hfft")
+ihfft = _fft1(jnp.fft.ihfft, "ihfft")
+
+
+def _fftn(jfn, name):
+    def op(x, s=None, axes=None, norm="backward", name_arg=None):
+        s_t = tuple(s) if s is not None else None
+        ax_t = tuple(axes) if axes is not None else None
+        return D.apply(name, lambda a, s, axes, norm: jfn(a, s, axes, norm),
+                       (x,), {"s": s_t, "axes": ax_t, "norm": _norm(norm)})
+    op.__name__ = name
+    return op
+
+
+fftn = _fftn(jnp.fft.fftn, "fftn")
+ifftn = _fftn(jnp.fft.ifftn, "ifftn")
+rfftn = _fftn(jnp.fft.rfftn, "rfftn")
+irfftn = _fftn(jnp.fft.irfftn, "irfftn")
+
+
+def _fft2(nfn, name):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name_arg=None):
+        return nfn(x, s, axes, norm)
+    op.__name__ = name
+    return op
+
+
+fft2 = _fft2(fftn, "fft2")
+ifft2 = _fft2(ifftn, "ifft2")
+rfft2 = _fft2(rfftn, "rfft2")
+irfft2 = _fft2(irfftn, "irfft2")
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    ax = tuple(axes) if axes is not None else (-1,)
+    out = x
+    for a in ax[:-1]:
+        out = ifft(out, axis=a, norm=norm)
+    return hfft(out, n=(s[-1] if s else None), axis=ax[-1], norm=norm)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    ax = tuple(axes) if axes is not None else (-1,)
+    out = ihfft(x, n=(s[-1] if s else None), axis=ax[-1], norm=norm)
+    for a in ax[:-1]:
+        out = fft(out, axis=a, norm=norm)
+    return out
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s, axes, norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s, axes, norm)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+    return Tensor(jnp.fft.fftfreq(int(n), float(d)).astype(
+        jnp.float32 if dtype is None else dtype))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+    return Tensor(jnp.fft.rfftfreq(int(n), float(d)).astype(
+        jnp.float32 if dtype is None else dtype))
+
+
+def fftshift(x, axes=None, name=None):
+    ax = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+    return D.apply("fftshift", lambda a, axes: jnp.fft.fftshift(a, axes),
+                   (x,), {"axes": ax})
+
+
+def ifftshift(x, axes=None, name=None):
+    ax = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+    return D.apply("ifftshift", lambda a, axes: jnp.fft.ifftshift(a, axes),
+                   (x,), {"axes": ax})

@@ -21,7 +21,7 @@ from ..core.tensor import Parameter, Tensor
 from ..nn.layer.layers import Layer
 
 __all__ = ["to_static", "save", "load", "ignore_module", "not_to_static",
-           "TracedFunction"]
+           "TracedFunction", "TranslatedLayer", "InputSpec"]
 
 
 def _tree_to_arrays(obj):
@@ -142,17 +142,129 @@ def ignore_module(modules):
     return None
 
 
-def save(layer, path, input_spec=None, **configs):
-    """jit.save analog: persist params + (optionally) the traced signature.
+class InputSpec:
+    """Input signature element (reference paddle.static.InputSpec)."""
 
-    StableHLO program export lands with the inference-deploy milestone; the
-    state_dict payload round-trips through paddle_tpu.load today.
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(int(s) if s is not None else None for s in shape)
+        self.dtype = dtype
+        self.name = name
+
+    def _struct(self):
+        from ..core.dtype import convert_dtype
+        if any(s is None for s in self.shape):
+            raise ValueError(
+                "dynamic dims are not supported in jit.save; give concrete "
+                f"shapes (got {self.shape})")
+        return jax.ShapeDtypeStruct(self.shape,
+                                    convert_dtype(self.dtype).np_dtype)
+
+    @classmethod
+    def from_tensor(cls, t, name=None):
+        return cls(tuple(t.shape), t.dtype.name, name)
+
+
+def _spec_struct(spec):
+    if isinstance(spec, InputSpec):
+        return spec._struct()
+    if isinstance(spec, Tensor):
+        return jax.ShapeDtypeStruct(tuple(spec.shape), spec._data.dtype)
+    if isinstance(spec, jax.Array):
+        return jax.ShapeDtypeStruct(spec.shape, spec.dtype)
+    raise TypeError(f"input_spec entries must be InputSpec/Tensor, got "
+                    f"{type(spec)}")
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Serialize a Layer/function as a deployable program:
+    <path>.pdmodel   — the StableHLO program (jax.export serialization;
+                       the TPU analog of the reference's translated static
+                       program, jit/api.py save)
+    <path>.pdiparams — parameters + buffers (npz)
+    jit.load(path) restores a TranslatedLayer that executes the saved
+    program without the original python code.
     """
-    from ..framework.io import save as _save
-    state = layer.state_dict() if isinstance(layer, Layer) else {}
-    _save({"state_dict": state, "class": type(layer).__name__}, path + ".pdparams")
+    import numpy as np
+    from jax import export as jax_export
+
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (a list of "
+                         "InputSpec or example Tensors)")
+    fn = layer.forward if isinstance(layer, Layer) else layer
+    if isinstance(fn, TracedFunction):
+        fn = fn._fn
+    named_params = (dict(layer.named_parameters())
+                    if isinstance(layer, Layer) else {})
+    named_buffers = (dict(layer.named_buffers())
+                     if isinstance(layer, Layer) else {})
+
+    def pure(param_arrays, buffer_arrays, *in_arrays):
+        saved_p = {k: p._data for k, p in named_params.items()}
+        saved_b = {k: b._data for k, b in named_buffers.items()}
+        try:
+            for k, p in named_params.items():
+                p._data = param_arrays[k]
+            for k, b in named_buffers.items():
+                b._data = buffer_arrays[k]
+            t_args = _tree_to_tensors(in_arrays)
+            with dispatch.no_grad():
+                out = fn(*t_args)
+            return _tree_to_arrays(out)
+        finally:
+            for k, p in named_params.items():
+                p._data = saved_p[k]
+            for k, b in named_buffers.items():
+                b._data = saved_b[k]
+
+    p_structs = {k: jax.ShapeDtypeStruct(p._data.shape, p._data.dtype)
+                 for k, p in named_params.items()}
+    b_structs = {k: jax.ShapeDtypeStruct(b._data.shape, b._data.dtype)
+                 for k, b in named_buffers.items()}
+    in_structs = [_spec_struct(s) for s in input_spec]
+    exported = jax_export.export(jax.jit(pure))(p_structs, b_structs,
+                                                *in_structs)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    np.savez(path + ".pdiparams",
+             **{f"param::{k}": np.asarray(p._data)
+                for k, p in named_params.items()},
+             **{f"buffer::{k}": np.asarray(b._data)
+                for k, b in named_buffers.items()})
+
+
+class TranslatedLayer(Layer):
+    """A loaded serialized program (reference jit/translated_layer.py):
+    parameters are real Parameters (trainable state_dict), forward executes
+    the deserialized StableHLO program."""
+
+    def __init__(self, exported, params, buffers):
+        super().__init__()
+        self._exported = exported
+        self._loaded_params = {}
+        for k, arr in params.items():
+            p = Parameter(jnp.asarray(arr))
+            self._loaded_params[k] = p
+            # register flat under the ORIGINAL dotted name so state_dict
+            # keys match the source model's (set_state_dict round-trips)
+            self._parameters[k] = p
+        self._loaded_buffers = {k: jnp.asarray(v) for k, v in buffers.items()}
+
+    def forward(self, *inputs):
+        arrays = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+                  for i in inputs]
+        p = {k: t._data for k, t in self._loaded_params.items()}
+        out = self._exported.call(p, self._loaded_buffers, *arrays)
+        return _tree_to_tensors(out)
 
 
 def load(path, **configs):
-    from ..framework.io import load as _load
-    return _load(path + ".pdparams")
+    import numpy as np
+    from jax import export as jax_export
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    params, buffers = {}, {}
+    with np.load(path + ".pdiparams.npz") as z:
+        for key in z.files:
+            kind, name = key.split("::", 1)
+            (params if kind == "param" else buffers)[name] = z[key]
+    return TranslatedLayer(exported, params, buffers)

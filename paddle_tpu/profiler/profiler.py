@@ -121,15 +121,20 @@ class RecordEvent:
         self._begin_ns = None
 
     def begin(self):
-        if _active_tracer is None:
-            return  # no profiler recording: annotations are free
-        self._begin_ns = time.perf_counter_ns()
+        # Always emit the device-trace annotation: a user-driven
+        # jax.profiler.start_trace must still see RecordEvent markers even
+        # with no host Profiler active (TraceMe is ~free when no device
+        # trace is running).  Host-event bookkeeping only runs while a
+        # Profiler records.
         try:
             import jax.profiler
             self._jax_ann = jax.profiler.TraceAnnotation(self.name)
             self._jax_ann.__enter__()
         except Exception:
             self._jax_ann = None
+        if _active_tracer is None:
+            return
+        self._begin_ns = time.perf_counter_ns()
 
     def end(self):
         if self._jax_ann is not None:

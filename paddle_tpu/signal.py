@@ -1,0 +1,134 @@
+"""paddle.signal namespace (reference python/paddle/signal.py: frame,
+overlap_add, stft, istft)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core import dispatch as D
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice x into overlapping frames along `axis`
+    (reference signal.py frame)."""
+    def impl(a, frame_length, hop_length, axis):
+        ax = axis % a.ndim
+        n = a.shape[ax]
+        num = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(num)[:, None] * hop_length
+               + jnp.arange(frame_length)[None, :])       # [num, L]
+        out = jnp.take(a, idx.reshape(-1), axis=ax)
+        shape = list(a.shape)
+        shape[ax:ax + 1] = [num, frame_length]
+        out = out.reshape(shape)
+        # paddle layout: frame_length then num_frames on the last two dims
+        if axis in (-1, a.ndim - 1):
+            out = jnp.swapaxes(out, ax, ax + 1)
+        return out
+    return D.apply("frame", impl, (x,),
+                   {"frame_length": int(frame_length),
+                    "hop_length": int(hop_length), "axis": int(axis)})
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (reference signal.py overlap_add): x has
+    [..., frame_length, num_frames] on the trailing dims (axis=-1)."""
+    def impl(a, hop_length, axis):
+        if axis in (-1, a.ndim - 1):
+            frames = jnp.swapaxes(a, -1, -2)    # [..., num, L]
+        else:
+            frames = a
+        *batch, num, L = frames.shape
+        n = (num - 1) * hop_length + L
+        out = jnp.zeros((*batch, n), frames.dtype)
+        for i in range(num):                    # static unroll: num is small
+            out = out.at[..., i * hop_length:i * hop_length + L].add(
+                frames[..., i, :])
+        return out
+    return D.apply("overlap_add", impl, (x,),
+                   {"hop_length": int(hop_length), "axis": int(axis)})
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (reference signal.py stft).
+    x: [B, T] or [T] real.  Returns [B, n_fft//2+1, num_frames] complex
+    (onesided) like the reference."""
+    hop = hop_length if hop_length is not None else n_fft // 4
+    wl = win_length if win_length is not None else n_fft
+    args = (x,) + ((window,) if window is not None else ())
+
+    def impl(a, *rest, n_fft, hop, wl, center, pad_mode, normalized,
+             onesided, has_window):
+        w = rest[0] if has_window else jnp.ones((wl,), jnp.float32)
+        if wl < n_fft:  # center-pad window to n_fft
+            lp = (n_fft - wl) // 2
+            w = jnp.pad(w, (lp, n_fft - wl - lp))
+        squeeze = a.ndim == 1
+        if squeeze:
+            a = a[None]
+        if center:
+            a = jnp.pad(a, ((0, 0), (n_fft // 2, n_fft // 2)),
+                        mode=pad_mode)
+        num = 1 + (a.shape[-1] - n_fft) // hop
+        idx = (jnp.arange(num)[:, None] * hop
+               + jnp.arange(n_fft)[None, :])
+        frames = a[:, idx] * w[None, None, :]             # [B, num, n_fft]
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        out = jnp.swapaxes(spec, -1, -2)                  # [B, F, num]
+        return out[0] if squeeze else out
+    return D.apply("stft", impl, args,
+                   {"n_fft": int(n_fft), "hop": int(hop), "wl": int(wl),
+                    "center": bool(center), "pad_mode": pad_mode,
+                    "normalized": bool(normalized),
+                    "onesided": bool(onesided),
+                    "has_window": window is not None})
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with window-square normalization
+    (reference signal.py istft)."""
+    hop = hop_length if hop_length is not None else n_fft // 4
+    wl = win_length if win_length is not None else n_fft
+    args = (x,) + ((window,) if window is not None else ())
+
+    def impl(spec, *rest, n_fft, hop, wl, center, normalized, onesided,
+             length, has_window):
+        w = rest[0] if has_window else jnp.ones((wl,), jnp.float32)
+        if wl < n_fft:
+            lp = (n_fft - wl) // 2
+            w = jnp.pad(w, (lp, n_fft - wl - lp))
+        squeeze = spec.ndim == 2
+        if squeeze:
+            spec = spec[None]
+        frames_f = jnp.swapaxes(spec, -1, -2)             # [B, num, F]
+        if normalized:
+            frames_f = frames_f * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = (jnp.fft.irfft(frames_f, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(frames_f, axis=-1).real)
+        frames = frames * w[None, None, :]
+        B, num, _ = frames.shape
+        n = (num - 1) * hop + n_fft
+        out = jnp.zeros((B, n), frames.dtype)
+        wsq = jnp.zeros((n,), jnp.float32)
+        for i in range(num):
+            out = out.at[:, i * hop:i * hop + n_fft].add(frames[:, i])
+            wsq = wsq.at[i * hop:i * hop + n_fft].add(w * w)
+        out = out / jnp.maximum(wsq, 1e-11)[None, :]
+        if center:
+            out = out[:, n_fft // 2: n - n_fft // 2]
+        if length is not None:
+            out = out[:, :length]
+        return out[0] if squeeze else out
+    return D.apply("istft", impl, args,
+                   {"n_fft": int(n_fft), "hop": int(hop), "wl": int(wl),
+                    "center": bool(center), "normalized": bool(normalized),
+                    "onesided": bool(onesided), "length": length,
+                    "has_window": window is not None})

@@ -1,0 +1,269 @@
+"""paddle.sparse namespace.
+
+Parity with /root/reference/python/paddle/sparse/ (SparseCooTensor /
+SparseCsrTensor from paddle/phi/core/sparse_{coo,csr}_tensor.h, unary/binary
+ops, matmul, sparse nn) built on jax.experimental.sparse: COO is a BCOO
+array (TPU-friendly: index/value arrays with static nse, ops lower to
+gather/scatter/segment-sum XLA programs), CSR is BCSR.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "SparseCsrTensor", "is_same_shape", "add", "subtract",
+           "multiply", "divide", "matmul", "masked_matmul", "transpose",
+           "relu", "nn", "functional"]
+
+
+class SparseCooTensor:
+    """COO sparse tensor (reference sparse_coo_tensor.h): indices [ndim, nse]
+    + values [nse]."""
+
+    def __init__(self, bcoo):
+        self._bcoo = bcoo
+
+    # -- metadata --
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        from ..core.dtype import convert_dtype
+        return convert_dtype(self._bcoo.dtype)
+
+    @property
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self):
+        return Tensor(jnp.swapaxes(self._bcoo.indices, 0, 1))  # [ndim, nse]
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self):
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(
+            self._bcoo.sum_duplicates(nse=self._bcoo.nse)))
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates(nse=self._bcoo.nse))
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz},\n"
+                f"  indices={np.asarray(self.indices()._data)},\n"
+                f"  values={np.asarray(self.values()._data)})")
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor (reference sparse_csr_tensor.h)."""
+
+    def __init__(self, bcsr):
+        self._bcsr = bcsr
+
+    @property
+    def shape(self):
+        return list(self._bcsr.shape)
+
+    @property
+    def dtype(self):
+        from ..core.dtype import convert_dtype
+        return convert_dtype(self._bcsr.dtype)
+
+    @property
+    def nnz(self):
+        return int(self._bcsr.nse)
+
+    def crows(self):
+        return Tensor(self._bcsr.indptr)
+
+    def cols(self):
+        return Tensor(self._bcsr.indices)
+
+    def values(self):
+        return Tensor(self._bcsr.data)
+
+    def to_dense(self):
+        return Tensor(self._bcsr.todense())
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return SparseCooTensor(self._bcsr.to_bcoo())
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """Build a COO tensor from [ndim, nse] indices + [nse] values
+    (reference python/paddle/sparse/creation.py)."""
+    idx = indices._data if isinstance(indices, Tensor) else jnp.asarray(
+        np.asarray(indices))
+    val = values._data if isinstance(values, Tensor) else jnp.asarray(
+        np.asarray(values))
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        val = val.astype(convert_dtype(dtype).np_dtype)
+    idx = jnp.swapaxes(idx.astype(jnp.int32), 0, 1)       # -> [nse, ndim]
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx).max(axis=0))
+    return SparseCooTensor(
+        jsparse.BCOO((val, idx), shape=tuple(int(s) for s in shape)))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    cr = crows._data if isinstance(crows, Tensor) else jnp.asarray(
+        np.asarray(crows))
+    cl = cols._data if isinstance(cols, Tensor) else jnp.asarray(
+        np.asarray(cols))
+    val = values._data if isinstance(values, Tensor) else jnp.asarray(
+        np.asarray(values))
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        val = val.astype(convert_dtype(dtype).np_dtype)
+    return SparseCsrTensor(jsparse.BCSR(
+        (val, cl.astype(jnp.int32), cr.astype(jnp.int32)),
+        shape=tuple(int(s) for s in shape)))
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    """Dense Tensor -> COO (Tensor method surface in the reference)."""
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return SparseCooTensor(jsparse.BCOO.fromdense(arr))
+
+
+def is_same_shape(x, y):
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def _coo(x):
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo
+    if isinstance(x, SparseCsrTensor):
+        return x._bcsr.to_bcoo()
+    raise TypeError(f"expected sparse tensor, got {type(x)}")
+
+
+def _binary_dense(x, y, fn):
+    # elementwise through dense (XLA fuses; sparse-sparse union semantics)
+    out = fn(_coo(x).todense(), _coo(y).todense() if not isinstance(y, Tensor)
+             else y._data)
+    return SparseCooTensor(jsparse.BCOO.fromdense(out))
+
+
+def add(x, y, name=None):
+    return _binary_dense(x, y, jnp.add)
+
+
+def subtract(x, y, name=None):
+    return _binary_dense(x, y, jnp.subtract)
+
+
+def multiply(x, y, name=None):
+    return _binary_dense(x, y, jnp.multiply)
+
+
+def divide(x, y, name=None):
+    return _binary_dense(x, y, jnp.divide)
+
+
+def transpose(x, perm, name=None):
+    return SparseCooTensor(_coo(x).transpose(tuple(perm)))
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense -> dense Tensor (reference sparse.matmul)."""
+    if isinstance(y, Tensor):
+        out = _coo(x) @ y._data
+        return Tensor(out)
+    out = _coo(x) @ _coo(y).todense()
+    return Tensor(out)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """(x @ y) sampled at mask's sparsity (reference masked_matmul, the
+    SDDMM kernel): only the positions present in `mask` are produced."""
+    xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    ya = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+    m = _coo(mask)
+    rows = m.indices[:, 0]
+    cols = m.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xa[rows, :], jnp.swapaxes(ya, -1, -2)[cols, :])
+    return SparseCooTensor(jsparse.BCOO((vals, m.indices), shape=m.shape))
+
+
+# -- sparse unary + nn surface ---------------------------------------------
+
+def _unary(x, fn):
+    c = _coo(x)
+    return SparseCooTensor(jsparse.BCOO((fn(c.data), c.indices),
+                                        shape=c.shape))
+
+
+def relu(x, name=None):
+    return _unary(x, lambda v: jnp.maximum(v, 0))
+
+
+def sin(x, name=None):
+    return _unary(x, jnp.sin)
+
+
+def tanh(x, name=None):
+    return _unary(x, jnp.tanh)
+
+
+def sqrt(x, name=None):
+    return _unary(x, jnp.sqrt)
+
+
+def abs(x, name=None):
+    return _unary(x, jnp.abs)
+
+
+def neg(x, name=None):
+    return _unary(x, jnp.negative)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    c = _coo(x)
+    from ..core.dtype import convert_dtype
+    data = c.data if value_dtype is None else c.data.astype(
+        convert_dtype(value_dtype).np_dtype)
+    idx = c.indices if index_dtype is None else c.indices.astype(
+        convert_dtype(index_dtype).np_dtype)
+    return SparseCooTensor(jsparse.BCOO((data, idx), shape=c.shape))
+
+
+class _SparseReLU:
+    def __call__(self, x):
+        return relu(x)
+
+
+class _nn_namespace:
+    ReLU = _SparseReLU
+
+    class functional:
+        relu = staticmethod(relu)
+
+
+nn = _nn_namespace
+functional = _nn_namespace.functional

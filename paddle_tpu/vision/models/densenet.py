@@ -1,0 +1,101 @@
+"""DenseNet (parity with /root/reference/python/paddle/vision/models/densenet.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_CFGS = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+    264: (64, 32, (6, 12, 64, 48)),
+}
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth, bn_size):
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(in_c)
+        self.conv1 = nn.Conv2D(in_c, bn_size * growth, 1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.bn1(x)))
+        out = self.conv2(self.relu(self.bn2(out)))
+        return concat([x, out], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, in_c, out_c):
+        super().__init__()
+        self.bn = nn.BatchNorm2D(in_c)
+        self.conv = nn.Conv2D(in_c, out_c, 1, bias_attr=False)
+        self.relu = nn.ReLU()
+        self.pool = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        init_c, growth, blocks = _CFGS[layers]
+        self.num_classes = num_classes
+        feats = [nn.Conv2D(3, init_c, 7, stride=2, padding=3,
+                           bias_attr=False),
+                 nn.BatchNorm2D(init_c), nn.ReLU(),
+                 nn.MaxPool2D(3, stride=2, padding=1)]
+        c = init_c
+        for i, n in enumerate(blocks):
+            for _ in range(n):
+                feats.append(_DenseLayer(c, growth, bn_size))
+                c += growth
+            if i != len(blocks) - 1:
+                feats.append(_Transition(c, c // 2))
+                c //= 2
+        feats += [nn.BatchNorm2D(c), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        self.pool = nn.AdaptiveAvgPool2D(1) if with_pool else None
+        self.classifier = (nn.Linear(c, num_classes)
+                           if num_classes > 0 else None)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.classifier is not None:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def _densenet(n, **kwargs):
+    return DenseNet(layers=n, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, **kwargs)

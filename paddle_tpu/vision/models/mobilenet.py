@@ -1,0 +1,220 @@
+"""MobileNet V1/V2/V3 (parity with /root/reference/python/paddle/vision/
+models/{mobilenetv1,mobilenetv2,mobilenetv3}.py)."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["MobileNetV1", "mobilenet_v1", "MobileNetV2", "mobilenet_v2",
+           "MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+           "mobilenet_v3_large"]
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def _conv_bn(in_c, out_c, k, stride=1, groups=1, act="relu"):
+    layers = [nn.Conv2D(in_c, out_c, k, stride=stride, padding=k // 2,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(out_c)]
+    if act == "relu":
+        layers.append(nn.ReLU())
+    elif act == "relu6":
+        layers.append(nn.ReLU6())
+    elif act == "hardswish":
+        layers.append(nn.Hardswish())
+    return nn.Sequential(*layers)
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        s = lambda c: max(8, int(c * scale))
+        cfg = [  # (out, stride) of each depthwise-separable block
+            (64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+            (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+            (1024, 1)]
+        layers = [_conv_bn(3, s(32), 3, stride=2)]
+        in_c = s(32)
+        for out, stride in cfg:
+            layers.append(_conv_bn(in_c, in_c, 3, stride=stride,
+                                   groups=in_c))          # depthwise
+            layers.append(_conv_bn(in_c, s(out), 1))      # pointwise
+            in_c = s(out)
+        self.features = nn.Sequential(*layers)
+        self.pool = nn.AdaptiveAvgPool2D(1) if with_pool else None
+        self.fc = (nn.Linear(s(1024), num_classes)
+                   if num_classes > 0 else None)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.fc is not None:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, in_c, out_c, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(in_c * expand_ratio))
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if expand_ratio != 1:
+            layers.append(_conv_bn(in_c, hidden, 1, act="relu6"))
+        layers.append(_conv_bn(hidden, hidden, 3, stride=stride,
+                               groups=hidden, act="relu6"))
+        layers.append(nn.Conv2D(hidden, out_c, 1, bias_attr=False))
+        layers.append(nn.BatchNorm2D(out_c))
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        in_c = _make_divisible(32 * scale)
+        last = _make_divisible(1280 * max(1.0, scale))
+        layers = [_conv_bn(3, in_c, 3, stride=2, act="relu6")]
+        for t, c, n, s in cfg:
+            out_c = _make_divisible(c * scale)
+            for i in range(n):
+                layers.append(InvertedResidual(
+                    in_c, out_c, s if i == 0 else 1, t))
+                in_c = out_c
+        layers.append(_conv_bn(in_c, last, 1, act="relu6"))
+        self.features = nn.Sequential(*layers)
+        self.pool = nn.AdaptiveAvgPool2D(1) if with_pool else None
+        self.classifier = (nn.Sequential(nn.Dropout(0.2),
+                                         nn.Linear(last, num_classes))
+                           if num_classes > 0 else None)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.classifier is not None:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class SqueezeExcite(nn.Layer):
+    def __init__(self, c, r=4):
+        super().__init__()
+        mid = _make_divisible(c // r)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(c, mid, 1)
+        self.fc2 = nn.Conv2D(mid, c, 1)
+        self.relu = nn.ReLU()
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _V3Block(nn.Layer):
+    def __init__(self, in_c, exp, out_c, k, stride, se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if exp != in_c:
+            layers.append(_conv_bn(in_c, exp, 1, act=act))
+        layers.append(_conv_bn(exp, exp, k, stride=stride, groups=exp,
+                               act=act))
+        if se:
+            layers.append(SqueezeExcite(exp))
+        layers.append(nn.Conv2D(exp, out_c, 1, bias_attr=False))
+        layers.append(nn.BatchNorm2D(out_c))
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+_V3_SMALL = [  # k, exp, out, se, act, stride
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1)]
+
+_V3_LARGE = [
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1)]
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_exp, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        sc = lambda c: _make_divisible(c * scale)
+        in_c = sc(16)
+        layers = [_conv_bn(3, in_c, 3, stride=2, act="hardswish")]
+        for k, exp, out, se, act, stride in cfg:
+            layers.append(_V3Block(in_c, sc(exp), sc(out), k, stride, se,
+                                   act))
+            in_c = sc(out)
+        layers.append(_conv_bn(in_c, sc(last_exp), 1, act="hardswish"))
+        self.features = nn.Sequential(*layers)
+        self.pool = nn.AdaptiveAvgPool2D(1) if with_pool else None
+        head = _make_divisible(1280 * max(1.0, scale)) \
+            if last_exp == 960 else 1024
+        self.classifier = (nn.Sequential(
+            nn.Linear(sc(last_exp), head), nn.Hardswish(), nn.Dropout(0.2),
+            nn.Linear(head, num_classes)) if num_classes > 0 else None)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.classifier is not None:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_SMALL, 576, scale, num_classes, with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_LARGE, 960, scale, num_classes, with_pool)
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV2(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Large(scale=scale, **kwargs)

@@ -1,0 +1,122 @@
+"""ShuffleNetV2 (parity with /root/reference/python/paddle/vision/models/
+shufflenetv2.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0"]
+
+_STAGE_OUT = {
+    0.25: (24, 24, 48, 96, 512), 0.33: (24, 32, 64, 128, 512),
+    0.5: (24, 48, 96, 192, 1024), 1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024), 2.0: (24, 244, 488, 976, 2048),
+}
+
+
+def _channel_shuffle(x, groups):
+    b, c, h, w = x.shape
+    x = x.reshape([b, groups, c // groups, h, w])
+    x = x.transpose([0, 2, 1, 3, 4])
+    return x.reshape([b, c, h, w])
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride > 1:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_c, in_c, 3, stride=stride, padding=1,
+                          groups=in_c, bias_attr=False),
+                nn.BatchNorm2D(in_c),
+                nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), nn.ReLU())
+            b2_in = in_c
+        else:
+            self.branch1 = None
+            b2_in = in_c // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(b2_in, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), nn.ReLU(),
+            nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
+                      groups=branch_c, bias_attr=False),
+            nn.BatchNorm2D(branch_c),
+            nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), nn.ReLU())
+
+    def forward(self, x):
+        if self.stride > 1:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        else:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = concat([x1, self.branch2(x2)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        c1, c2, c3, c4, c5 = _STAGE_OUT[scale]
+        self.num_classes = num_classes
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, c1, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(c1), nn.ReLU())
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_c = c1
+        for out_c, repeat in zip((c2, c3, c4), (4, 8, 4)):
+            units = [_ShuffleUnit(in_c, out_c, 2)]
+            units += [_ShuffleUnit(out_c, out_c, 1) for _ in range(repeat - 1)]
+            stages.append(nn.Sequential(*units))
+            in_c = out_c
+        self.stages = nn.LayerList(stages)
+        self.conv5 = nn.Sequential(
+            nn.Conv2D(in_c, c5, 1, bias_attr=False), nn.BatchNorm2D(c5),
+            nn.ReLU())
+        self.pool = nn.AdaptiveAvgPool2D(1) if with_pool else None
+        self.fc = nn.Linear(c5, num_classes) if num_classes > 0 else None
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        for s in self.stages:
+            x = s(x)
+        x = self.conv5(x)
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.fc is not None:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def _shufflenet(scale, **kwargs):
+    return ShuffleNetV2(scale=scale, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet(0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet(1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(2.0, **kwargs)

@@ -1,0 +1,82 @@
+"""fft + signal namespaces vs numpy references (mirrors test/legacy_test/
+test_fft.py and test_stft_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft, signal
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_fft_roundtrip_and_numpy_parity():
+    rng = np.random.RandomState(0)
+    x = rng.randn(16).astype(np.float32)
+    X = fft.fft(_t(x))
+    np.testing.assert_allclose(X.numpy(), np.fft.fft(x), rtol=1e-4,
+                               atol=1e-5)
+    back = fft.ifft(X)
+    np.testing.assert_allclose(back.numpy().real, x, rtol=1e-4, atol=1e-5)
+
+
+def test_rfft_irfft():
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 32).astype(np.float32)
+    X = fft.rfft(_t(x))
+    assert X.shape == [4, 17]
+    np.testing.assert_allclose(X.numpy(), np.fft.rfft(x, axis=-1),
+                               rtol=1e-4, atol=1e-5)
+    back = fft.irfft(X, n=32)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-4, atol=1e-5)
+
+
+def test_fft2_fftn_norms():
+    rng = np.random.RandomState(2)
+    x = rng.randn(8, 8).astype(np.float32)
+    np.testing.assert_allclose(fft.fft2(_t(x)).numpy(), np.fft.fft2(x),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        fft.fftn(_t(x), norm="ortho").numpy(),
+        np.fft.fftn(x, norm="ortho"), rtol=1e-4, atol=1e-4)
+
+
+def test_fftshift_fftfreq():
+    f = fft.fftfreq(8, d=0.5)
+    np.testing.assert_allclose(f.numpy(), np.fft.fftfreq(8, 0.5), rtol=1e-6)
+    x = _t(np.arange(8, dtype=np.float32))
+    np.testing.assert_allclose(fft.fftshift(x).numpy(),
+                               np.fft.fftshift(np.arange(8)), rtol=0)
+    np.testing.assert_allclose(
+        fft.ifftshift(fft.fftshift(x)).numpy(), np.arange(8), rtol=0)
+
+
+def test_frame_overlap_add_inverse():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 20).astype(np.float32)
+    f = signal.frame(_t(x), frame_length=8, hop_length=8)  # no overlap
+    assert f.shape == [2, 8, 2]
+    back = signal.overlap_add(f, hop_length=8)
+    np.testing.assert_allclose(back.numpy(), x[:, :16], rtol=1e-6)
+
+
+def test_stft_matches_manual_dft():
+    rng = np.random.RandomState(4)
+    x = rng.randn(64).astype(np.float32)
+    S = signal.stft(_t(x), n_fft=16, hop_length=4, center=False)
+    assert S.shape == [9, 13]  # [n_fft//2+1, 1+(64-16)//4]
+    # manual frame 0
+    ref0 = np.fft.rfft(x[:16])
+    np.testing.assert_allclose(S.numpy()[:, 0], ref0, rtol=1e-4, atol=1e-4)
+
+
+def test_stft_istft_roundtrip():
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 128).astype(np.float32)
+    win = np.hanning(16).astype(np.float32)
+    S = signal.stft(_t(x), n_fft=16, hop_length=4, window=_t(win),
+                    center=True)
+    back = signal.istft(S, n_fft=16, hop_length=4, window=_t(win),
+                        center=True, length=128)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-3, atol=1e-4)

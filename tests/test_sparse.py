@@ -1,0 +1,75 @@
+"""sparse namespace (mirrors test/legacy_test/test_sparse_*_op.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _coo_example():
+    indices = [[0, 1, 2], [1, 2, 0]]
+    values = [1.0, 2.0, 3.0]
+    return sparse.sparse_coo_tensor(indices, values, shape=[3, 3])
+
+
+def test_coo_create_and_to_dense():
+    s = _coo_example()
+    assert s.nnz == 3 and s.shape == [3, 3]
+    dense = s.to_dense().numpy()
+    ref = np.zeros((3, 3), np.float32)
+    ref[0, 1], ref[1, 2], ref[2, 0] = 1.0, 2.0, 3.0
+    np.testing.assert_allclose(dense, ref)
+    np.testing.assert_allclose(s.values().numpy(), [1.0, 2.0, 3.0])
+    assert s.indices().shape == [2, 3]
+
+
+def test_csr_create_and_convert():
+    s = sparse.sparse_csr_tensor(
+        crows=[0, 1, 2, 3], cols=[1, 2, 0], values=[1.0, 2.0, 3.0],
+        shape=[3, 3])
+    assert s.is_sparse_csr() and s.nnz == 3
+    dense = s.to_dense().numpy()
+    coo = s.to_sparse_coo()
+    np.testing.assert_allclose(coo.to_dense().numpy(), dense)
+
+
+def test_elementwise_and_unary():
+    a = _coo_example()
+    b = _coo_example()
+    np.testing.assert_allclose(sparse.add(a, b).to_dense().numpy(),
+                               2 * a.to_dense().numpy())
+    np.testing.assert_allclose(sparse.multiply(a, b).to_dense().numpy(),
+                               a.to_dense().numpy() ** 2)
+    neg = sparse.neg(a)
+    relu = sparse.relu(neg)
+    np.testing.assert_allclose(relu.to_dense().numpy(),
+                               np.zeros((3, 3), np.float32))
+
+
+def test_matmul_sparse_dense():
+    s = _coo_example()
+    rng = np.random.RandomState(0)
+    d = paddle.to_tensor(rng.randn(3, 4).astype(np.float32))
+    out = sparse.matmul(s, d)
+    np.testing.assert_allclose(out.numpy(), s.to_dense().numpy() @ d.numpy(),
+                               rtol=1e-5)
+
+
+def test_masked_matmul_sddmm():
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.randn(3, 5).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(5, 3).astype(np.float32))
+    mask = _coo_example()
+    out = sparse.masked_matmul(x, y, mask)
+    full = x.numpy() @ y.numpy()
+    ref = np.where(mask.to_dense().numpy() != 0, full, 0.0)
+    np.testing.assert_allclose(out.to_dense().numpy(), ref, rtol=1e-5)
+
+
+def test_dense_roundtrip_and_transpose():
+    rng = np.random.RandomState(2)
+    d = rng.randn(4, 3).astype(np.float32)
+    d[d < 0.5] = 0.0
+    s = sparse.to_sparse_coo(paddle.to_tensor(d))
+    np.testing.assert_allclose(s.to_dense().numpy(), d)
+    st = sparse.transpose(s, [1, 0])
+    np.testing.assert_allclose(st.to_dense().numpy(), d.T)

@@ -1,0 +1,72 @@
+"""Vision model zoo: forward shapes + trainability smoke (mirrors the
+reference test/legacy_test/test_vision_models.py strategy — build each
+model, run a tiny batch, check the logit shape)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models
+
+
+def _check(model, size=64, classes=10):
+    x = paddle.randn([2, 3, size, size])
+    out = model(x)
+    assert out.shape == [2, classes]
+    return out
+
+
+@pytest.mark.parametrize("ctor", [
+    models.vgg11, models.vgg16,
+    models.alexnet,
+    models.squeezenet1_0, models.squeezenet1_1,
+    models.mobilenet_v1, models.mobilenet_v2,
+    models.mobilenet_v3_small, models.mobilenet_v3_large,
+    models.shufflenet_v2_x0_25, models.shufflenet_v2_x1_0,
+    models.googlenet,
+])
+def test_model_forward_shape(ctor):
+    paddle.seed(0)
+    model = ctor(num_classes=10)
+    model.eval()
+    _check(model)
+
+
+def test_densenet121_forward():
+    paddle.seed(0)
+    m = models.densenet121(num_classes=10)
+    m.eval()
+    _check(m)
+
+
+def test_vgg_with_batchnorm():
+    paddle.seed(0)
+    m = models.vgg11(batch_norm=True, num_classes=10)
+    m.eval()
+    _check(m)
+
+
+def test_mobilenet_scale():
+    paddle.seed(0)
+    m = models.mobilenet_v2(scale=0.5, num_classes=10)
+    m.eval()
+    _check(m)
+
+
+def test_model_trains():
+    import paddle_tpu.nn as nn
+    paddle.seed(0)
+    m = models.mobilenet_v3_small(num_classes=4)
+    m.train()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=m.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(4, 3, 64, 64).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 4, (4,)).astype(np.int64))
+    losses = []
+    for _ in range(4):
+        loss = nn.functional.cross_entropy(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
