@@ -170,6 +170,8 @@ def _rms(x, w, eps=1e-6):
 def _attention(q, k, v):
     # q/k/v: [m, S, h_loc, d]; causal
     m_, s, h, d = q.shape
+    if _use_tpu_flash(s, d):
+        return _flash_attention_tpu(q, k, v)
     qf = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
     kf = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
     vf = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
@@ -178,6 +180,33 @@ def _attention(q, k, v):
     scores = jnp.where(mask, scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("mhqk,mhkd->mhqd", probs, vf)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def _use_tpu_flash(s, d):
+    """Route causal attention through the fused TPU flash kernels
+    (Pallas fwd+bwd; the analog of the reference's FA2 CUDA path,
+    flash_attn_kernel.cu) when shapes tile onto the MXU."""
+    if jax.default_backend() != "tpu":
+        return False
+    from ..core.flags import get_flag
+    if not get_flag("use_pallas_kernels"):
+        return False
+    return s % 128 == 0 and d in (64, 128, 256)
+
+
+def _flash_attention_tpu(q, k, v):
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as _tpu_flash)
+    d = q.shape[-1]
+    qt = jnp.swapaxes(q, 1, 2)          # [m, h, S, d]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    # the kernel's index maps use i32 literals; trace them with x64 off
+    # (our package enables x64 globally for paddle dtype parity)
+    with jax.experimental.disable_x64():
+        out = _tpu_flash(qt, kt, vt, causal=True,
+                         sm_scale=1.0 / math.sqrt(d))
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
