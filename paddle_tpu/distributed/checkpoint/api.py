@@ -50,46 +50,82 @@ def _process_rank() -> int:
     return getattr(jax, "process_index", lambda: 0)()
 
 
+def _existing_uids(path):
+    import glob
+    uids = set()
+    for fp in glob.glob(os.path.join(path, "metadata_*.json")):
+        m = re.match(r"metadata_(\d+)\.\d+\.json$", os.path.basename(fp))
+        if m:
+            uids.add(int(m.group(1)))
+    return uids
+
+
+def _offset_of(idx):
+    return tuple((s.start or 0) if isinstance(s, slice) else int(s)
+                 for s in idx)
+
+
 def save_state_dict(state_dict, path, process_group=None,
-                    coordinator_rank=0, unique_id=None):
+                    coordinator_rank=0, unique_id=None, keep=2):
     """Write every rank's local shards + a global metadata file.
 
     state_dict: (nested) dict of Tensor / jax.Array / numpy.  Works for
     replicated, sharded, and hybrid (mesh) placements alike.
+
+    Checkpoint files are versioned by `unique_id`; ranks of one logical
+    save never delete each other's in-flight files (the round-1 cleanup
+    race), because load reads only the newest complete version and the
+    coordinator prunes only versions older than the newest `keep`.
+    Single-process saves may omit unique_id (auto: max existing + 1);
+    multi-process saves MUST pass a shared unique_id (e.g. the step
+    number) because directory scans on skewed ranks can disagree — the
+    reference solves the same problem by all_gather'ing the id
+    (reference python/paddle/distributed/checkpoint/save_state_dict.py).
     """
     os.makedirs(path, exist_ok=True)
     rank = _process_rank()
-    if rank == coordinator_rank:
-        # clear any previous checkpoint at this path: stale metadata from a
-        # save with MORE ranks (or the legacy single metadata.json) would
-        # otherwise merge old shards into the new load.  Multi-host callers
-        # must barrier between this save and any concurrent one (the
-        # reference save_state_dict has the same contract).
-        import glob
-        for f in glob.glob(os.path.join(path, "metadata*.json")) + \
-                glob.glob(os.path.join(path, "*.npy")):
-            os.remove(f)
+    if unique_id is None:
+        if getattr(jax, "process_count", lambda: 1)() > 1:
+            raise ValueError(
+                "save_state_dict: multi-process saves must pass a shared "
+                "unique_id (e.g. the global step) — auto-assignment by "
+                "directory scan races across skewed ranks")
+        uids = _existing_uids(path)
+        unique_id = (max(uids) + 1) if uids else 0
     flat = _flatten(state_dict)
     meta = {"tensors": {}}
     n_files = 0
     for name, val in flat.items():
         arr = _to_jax_array(val)
         shards_meta = []
+        # Replicated blocks are written once GLOBALLY: only the process
+        # owning the lowest-id device that holds a given offset block writes
+        # it (the reference's dedup_tensor step).
+        owner = {}
+        try:
+            for dev, idx in arr.sharding.devices_indices_map(
+                    arr.shape).items():
+                off = _offset_of(idx) if idx else ()
+                if off not in owner or dev.id < owner[off].id:
+                    owner[off] = dev
+        except Exception:
+            owner = None  # single-device / odd sharding: local dedup below
         seen_offsets = set()
+        addressable = {sh.device for sh in arr.addressable_shards}
         for sh in arr.addressable_shards:
-            idx = sh.index  # tuple of slices into the global array
-            offset = tuple(
-                (s.start or 0) if isinstance(s, slice) else int(s)
-                for s in idx)
+            offset = _offset_of(sh.index) if sh.index else ()
             if offset in seen_offsets:
-                continue  # replicated copy: write once
+                continue  # replicated copy within this process: write once
+            if owner is not None and owner.get(offset) is not None \
+                    and owner[offset] not in addressable:
+                continue  # a lower-id device on another process owns it
             seen_offsets.add(offset)
             local = np.asarray(sh.data)
             if local.dtype.name == "bfloat16":
                 # .npy has no bf16: store the raw bits as uint16 (the
                 # recorded tensor dtype restores the view on load)
                 local = local.view(np.uint16)
-            fname = f"{rank}_{n_files}.npy"
+            fname = f"{unique_id}.{rank}_{n_files}.npy"
             np.save(os.path.join(path, fname), local)
             n_files += 1
             shards_meta.append({
@@ -104,16 +140,46 @@ def save_state_dict(state_dict, path, process_group=None,
         }
     # each rank writes its OWN metadata file (no write races); load merges
     # them all — the per-rank shard lists are disjoint by offset
-    tmp = os.path.join(path, f".metadata.{rank}.json.tmp")
+    tmp = os.path.join(path, f".metadata_{unique_id}.{rank}.json.tmp")
     with open(tmp, "w") as f:
         json.dump(meta, f)
-    os.replace(tmp, os.path.join(path, f"metadata.{rank}.json"))
+    os.replace(tmp,
+               os.path.join(path, f"metadata_{unique_id}.{rank}.json"))
+    if rank == coordinator_rank and keep is not None:
+        _prune_old_versions(path, unique_id, keep)
+    return unique_id
+
+
+def _prune_old_versions(path, current_uid, keep):
+    """Delete files of versions older than the newest `keep` — safe at any
+    time because peers only ever write the CURRENT uid and load reads only
+    the max uid."""
+    import glob
+    uids = sorted(u for u in _existing_uids(path) | {current_uid})
+    for old in uids[:-keep] if keep > 0 else uids:
+        if old == current_uid:
+            continue
+        for f in (glob.glob(os.path.join(path, f"metadata_{old}.*.json"))
+                  + glob.glob(os.path.join(path, f"{old}.*.npy"))):
+            try:
+                os.remove(f)
+            except OSError:
+                pass
 
 
 def _read_meta(path):
-    """Merge every rank's metadata file into one tensor->shards map."""
+    """Merge the newest version's metadata files into one tensor->shards map.
+
+    Falls back to legacy (unversioned `metadata.json` / `metadata.<r>.json`)
+    checkpoints when no versioned files exist.
+    """
     import glob
-    files = sorted(glob.glob(os.path.join(path, "metadata*.json")))
+    uids = _existing_uids(path)
+    if uids:
+        files = sorted(
+            glob.glob(os.path.join(path, f"metadata_{max(uids)}.*.json")))
+    else:
+        files = sorted(glob.glob(os.path.join(path, "metadata*.json")))
     if not files:
         raise FileNotFoundError(f"no metadata files under {path}")
     tensors = {}

@@ -69,22 +69,16 @@ class Bernoulli(ExponentialFamily):
 
 class Categorical(Distribution):
     def __init__(self, logits=None, probs=None, name=None):
-        # NB: the reference Categorical(logits) treats logits as UNNORMALIZED
-        # (possibly non-log) weights; we follow torch-style true logits when
-        # given `logits`, probabilities when given `probs`.
+        # Reference semantics (python/paddle/distribution/categorical.py:148):
+        # `logits` is treated as UNNORMALIZED NON-LOG weights and normalized
+        # by their plain sum — NOT torch-style log-softmax.  Both arguments
+        # therefore normalize the same way.
         if logits is None and probs is None:
             raise ValueError("need logits or probs")
-        if probs is not None:
-            from ..ops.math import sum as _sum
-            p = _t(probs)
-            # normalize count-style weights (torch/paddle semantics)
-            self.probs = p / _sum(p, axis=-1, keepdim=True)
-            self.logits = _m.log(self.probs)
-        else:
-            lg = _t(logits)
-            from ..ops.math import logsumexp
-            self.logits = lg - logsumexp(lg, axis=-1, keepdim=True)
-            self.probs = _m.exp(self.logits)
+        from ..ops.math import sum as _sum
+        w = _t(probs if probs is not None else logits)
+        self.probs = w / _sum(w, axis=-1, keepdim=True)
+        self.logits = _m.log(self.probs)
         shape = tuple(self.probs.shape)
         super().__init__(shape[:-1])
 

@@ -16,12 +16,19 @@ import jax.numpy as jnp
 from ...core import dispatch as D
 from ...core.flags import get_flag
 
-__all__ = ["scaled_dot_product_attention", "flash_attention", "sdp_kernel"]
+__all__ = ["scaled_dot_product_attention", "flash_attention",
+           "flash_attn_unpadded", "sdp_kernel"]
+
+
+from ...ops.pallas.flash_attention import _repeat_kv
 
 
 def _sdpa_ref(q, k, v, *rest, causal, dropout_p, scale, has_mask):
-    # q/k/v: [B, S, H, D] (paddle flash-attention layout)
+    # q/k/v: [B, S, H, D] (paddle flash-attention layout); GQA when
+    # k/v carry fewer heads (reference flash_attention.py GQA path)
     mask = rest[0] if has_mask else None
+    group = q.shape[2] // k.shape[2]
+    k, v = _repeat_kv(k, group), _repeat_kv(v, group)
     qt = jnp.swapaxes(q, 1, 2)  # [B, H, S, D]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
@@ -48,7 +55,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     use_pallas = get_flag("use_pallas_kernels")
     if use_pallas and attn_mask is None and dropout_p == 0.0:
         from ...ops.pallas.flash_attention import flash_attention_fwd
-        if flash_attention_fwd.supports(query.shape, query.dtype.name):
+        if flash_attention_fwd.supports(query.shape, query.dtype.name,
+                                        tuple(key.shape)):
             return D.apply(
                 "flash_attention", flash_attention_fwd,
                 (query, key, value), {"causal": bool(is_causal)})
@@ -65,6 +73,57 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
                                        training)
     if return_softmax:
         return out, None
+    return out, None
+
+
+def _unpadded_impl(q, k, v, cu_q, cu_k, *, scale, causal):
+    # q/k/v: [total_tokens, heads, dim]; sequences are concatenated and
+    # delimited by cu_seqlens (reference flash_attn_unpadded :756).
+    group = q.shape[1] // k.shape[1]
+    if group > 1:  # 3-D [T, Hk, D]: reuse the shared 4-D helper
+        k = _repeat_kv(k[None], group)[0]
+        v = _repeat_kv(v[None], group)[0]
+    tq, tk = q.shape[0], k.shape[0]
+    pos_q = jnp.arange(tq)
+    pos_k = jnp.arange(tk)
+    # segment id = index of the containing [cu[i], cu[i+1]) interval
+    seg_q = jnp.searchsorted(cu_q, pos_q, side="right") - 1
+    seg_k = jnp.searchsorted(cu_k, pos_k, side="right") - 1
+    rel_q = pos_q - cu_q[seg_q]          # position within own sequence
+    rel_k = pos_k - cu_k[seg_k]
+    mask = seg_q[:, None] == seg_k[None, :]
+    if causal:
+        mask = mask & (rel_q[:, None] >= rel_k[None, :])
+    scores = jnp.einsum("qhd,khd->hqk",
+                        q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    scores = jnp.where(mask[None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # rows whose sequence is empty are all -inf -> nan; zero them
+    probs = jnp.where(mask[None], probs, 0.0)
+    out = jnp.einsum("hqk,khd->qhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen attention over concatenated sequences
+    (reference nn/functional/flash_attention.py:756).
+
+    Inputs are [total_tokens, num_heads, head_dim] with `cu_seqlens_*`
+    holding cumulative sequence offsets (len = batch+1).  Implemented as a
+    segment-masked composition XLA fuses; a Pallas varlen kernel can slot in
+    behind the same API.
+    """
+    if dropout and training:
+        raise NotImplementedError(
+            "flash_attn_unpadded: attention dropout is not implemented; "
+            "pass dropout=0.0")
+    out = D.apply("flash_attn_unpadded", _unpadded_impl,
+                  (query, key, value, cu_seqlens_q, cu_seqlens_k),
+                  {"scale": float(scale), "causal": bool(causal)})
     return out, None
 
 

@@ -1,13 +1,18 @@
-"""Flash attention (TPU Pallas).
+"""Flash attention (TPU Pallas), forward AND backward.
 
-TPU-native analog of the reference's FA2 CUDA kernel
-(/root/reference/paddle/phi/kernels/gpu/flash_attn_kernel.cu wrapping
-third_party/flashattn).  Forward is a Pallas online-softmax kernel tiled for
-the MXU; backward falls back to XLA's fused attention gradient (jax.vjp over
-the reference composition) — a custom_vjp pairs them.
+TPU-native analog of the reference's FA2 CUDA kernels
+(/root/reference/paddle/phi/kernels/gpu/flash_attn_kernel.cu and
+flash_attn_grad_kernel.cu wrapping third_party/flashattn, surfaced at
+python/paddle/nn/functional/flash_attention.py:358).
 
-Layout: [batch, seq, heads, head_dim] in, same out (matches paddle
-flash_attention API).
+Forward: online-softmax kernel tiled for the MXU, emitting the per-row
+logsumexp.  Backward: two Pallas kernels (dk/dv then dq) that RECOMPUTE the
+probability tiles from q/k + the saved logsumexp — residuals are O(S·D+S),
+never the O(S^2) score matrix.  GQA (num_kv_heads < num_heads) is handled in
+the index maps; grouped dk/dv partials are summed over the query-head group.
+
+Layout: q [batch, seq, heads, head_dim]; k/v [batch, seq, kv_heads, head_dim]
+(paddle flash_attention layout), output [batch, seq, heads, head_dim].
 """
 from __future__ import annotations
 
@@ -19,7 +24,6 @@ import jax.numpy as jnp
 
 try:
     from jax.experimental import pallas as pl
-    import jax.experimental.pallas.tpu as pltpu
     _HAS_PALLAS = True
 except Exception:  # pragma: no cover
     _HAS_PALLAS = False
@@ -27,8 +31,22 @@ except Exception:  # pragma: no cover
 _BLOCK_Q = 128
 _BLOCK_K = 128
 
+# Tests flip this to run the same kernels via the Pallas interpreter on CPU.
+INTERPRET = False
+
+
+def _repeat_kv(x, group):
+    if group == 1:
+        return x
+    b, s, hk, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, s, hk, group, d)
+                            ).reshape(b, s, hk * group, d)
+
 
 def _ref_attention(q, k, v, causal):
+    """O(S^2) reference composition (numerics oracle + XLA fallback)."""
+    group = q.shape[2] // k.shape[2]
+    k, v = _repeat_kv(k, group), _repeat_kv(v, group)
     qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
     kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
     vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
@@ -43,7 +61,8 @@ def _ref_attention(q, k, v, causal):
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, sm_scale, block_k, kv_len):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                causal, sm_scale, block_k, kv_len):
     # grid: (batch*heads, q_blocks); refs are [block_q, d] / [kv_len, d]
     q = q_ref[...].astype(jnp.float32) * sm_scale
     block_q, d = q.shape
@@ -61,8 +80,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, sm_scale, block_k, kv_len
         v = v_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
         s = q @ k.T  # [block_q, block_k]
         if causal:
-            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
         m_new = jnp.maximum(m_i, jnp.max(s, axis=1))
         p = jnp.exp(s - m_new[:, None])
@@ -80,16 +101,34 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, sm_scale, block_k, kv_len
         hi = jnp.int32(num_k_blocks)
     acc, m_i, l_i = jax.lax.fori_loop(jnp.int32(0), hi, body, (acc, m_i, l_i))
     o_ref[...] = (acc / l_i[:, None]).astype(o_ref.dtype)
+    lse_ref[...] = m_i + jnp.log(l_i)
+
+
+def _gqa_maps(h, group):
+    """Index maps over grid (bh, blk) for q-layout [B*H] and kv-layout
+    [B*HK] flattened leading dims (HK = H // group)."""
+    hk = h // group
+
+    def q_map(bh, blk):
+        return (bh, blk, blk - blk)
+
+    def kv_map(bh, blk):
+        kvh = (bh // h) * hk + (bh % h) // group
+        return (kvh, blk - blk, blk - blk)
+
+    return q_map, kv_map
 
 
 def _flash_fwd_pallas(q, k, v, causal):
+    """Returns (out, lse); lse is [B*H, Sq] float32 in the scaled domain."""
     b, sq, h, d = q.shape
-    sk = k.shape[1]
+    sk, hk = k.shape[1], k.shape[2]
+    group = h // hk
     sm_scale = 1.0 / math.sqrt(d)
     # flatten batch*heads; layout [BH, S, D]
     qr = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
-    kr = jnp.swapaxes(k, 1, 2).reshape(b * h, sk, d)
-    vr = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
+    kr = jnp.swapaxes(k, 1, 2).reshape(b * hk, sk, d)
+    vr = jnp.swapaxes(v, 1, 2).reshape(b * hk, sk, d)
 
     block_q = min(_BLOCK_Q, sq)
     block_k = min(_BLOCK_K, sk)
@@ -98,41 +137,215 @@ def _flash_fwd_pallas(q, k, v, causal):
                                block_k=block_k, kv_len=sk)
     # NB: x64 mode promotes literal 0 to i64, which Mosaic rejects in the
     # index-map return tuple; derive an i32 zero from the grid index instead.
-    def _q_map(bh, qb):
-        return (bh, qb, qb - qb)
+    q_map, kv_map = _gqa_maps(h, group)
 
-    def _kv_map(bh, qb):
-        return (bh, qb - qb, qb - qb)
-
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q),
         in_specs=[
-            pl.BlockSpec((None, block_q, d), _q_map),
-            pl.BlockSpec((None, sk, d), _kv_map),
-            pl.BlockSpec((None, sk, d), _kv_map),
+            pl.BlockSpec((None, block_q, d), q_map),
+            pl.BlockSpec((None, sk, d), kv_map),
+            pl.BlockSpec((None, sk, d), kv_map),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), _q_map),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), q_map),
+            pl.BlockSpec((None, block_q), lambda bh, qb: (bh, qb)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ],
+        interpret=INTERPRET,
     )(qr, kr, vr)
-    return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)
+    return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2), lse
+
+
+def _bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                     dk_ref, dv_ref, *, causal, sm_scale, block_q, q_len):
+    # grid: (batch*heads, k_blocks); k/v refs [block_k, d];
+    # q/do refs [q_len, d]; lse/delta refs [q_len]
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    block_k, d = k.shape
+    k_idx = pl.program_id(1)
+
+    dk = jnp.zeros((block_k, d), jnp.float32)
+    dv = jnp.zeros((block_k, d), jnp.float32)
+    num_q_blocks = q_len // block_q
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.dslice(qb * block_q, block_q)]
+        delta = delta_ref[pl.dslice(qb * block_q, block_q)]
+        # transposed score tile: [block_k, block_q]
+        st = (k @ q.T) * sm_scale
+        if causal:
+            k_pos = k_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 0)
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 1)
+            st = jnp.where(q_pos >= k_pos, st, -jnp.inf)
+        pt = jnp.exp(st - lse[None, :])
+        dv = dv + pt @ do
+        dpt = v @ do.T                       # [block_k, block_q]
+        dst = pt * (dpt - delta[None, :]) * sm_scale
+        dk = dk + dst @ q
+        return dk, dv
+
+    if causal:
+        # first q block intersecting the band: q_pos >= k_idx*block_k
+        lo = (k_idx.astype(jnp.int32) * jnp.int32(block_k)) \
+            // jnp.int32(block_q)
+    else:
+        lo = jnp.int32(0)
+    dk, dv = jax.lax.fori_loop(lo, jnp.int32(num_q_blocks), body, (dk, dv))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(k_ref, v_ref, do_ref, lse_ref, delta_ref, q_ref,
+                   dq_ref, *, causal, sm_scale, block_k, kv_len):
+    # grid: (batch*heads, q_blocks); q/do/dq refs [block_q, d];
+    # k/v refs [kv_len, d]; lse/delta refs [block_q]
+    q = q_ref[...].astype(jnp.float32)
+    do = do_ref[...].astype(jnp.float32)
+    lse = lse_ref[...]
+    delta = delta_ref[...]
+    block_q, d = q.shape
+    q_idx = pl.program_id(1)
+
+    dq = jnp.zeros((block_q, d), jnp.float32)
+    num_k_blocks = kv_len // block_k
+
+    def body(kb, dq):
+        k = k_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        s = (q @ k.T) * sm_scale
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        p = jnp.exp(s - lse[:, None])
+        dp = do @ v.T
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return dq + ds @ k
+
+    if causal:
+        q_end = (q_idx.astype(jnp.int32) + jnp.int32(1)) * jnp.int32(block_q)
+        hi = jnp.minimum(jnp.int32(num_k_blocks),
+                         q_end // jnp.int32(block_k) + jnp.int32(1))
+    else:
+        hi = jnp.int32(num_k_blocks)
+    dq = jax.lax.fori_loop(jnp.int32(0), hi, body, dq)
+    dq_ref[...] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, g, causal):
+    b, sq, h, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    group = h // hk
+    sm_scale = 1.0 / math.sqrt(d)
+
+    qr = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
+    kr = jnp.swapaxes(k, 1, 2).reshape(b * hk, sk, d)
+    vr = jnp.swapaxes(v, 1, 2).reshape(b * hk, sk, d)
+    dor = jnp.swapaxes(g, 1, 2).reshape(b * h, sq, d)
+    outr = jnp.swapaxes(out, 1, 2).reshape(b * h, sq, d)
+
+    # delta_i = rowsum(dO_i * O_i) — O(S·D) precompute, standard FA2 trick
+    delta = jnp.sum(dor.astype(jnp.float32) * outr.astype(jnp.float32),
+                    axis=-1)  # [BH, Sq]
+
+    block_q = min(_BLOCK_Q, sq)
+    block_k = min(_BLOCK_K, sk)
+    q_map, kv_map = _gqa_maps(h, group)
+
+    def vec_q_map(bh, blk):
+        return (bh, blk - blk)
+
+    # ---- dk/dv: grid over (B*H, k blocks); per-query-head partials are
+    # summed over the GQA group afterwards (group is small).
+    k_blk_map = lambda bh, kb: (bh, kb, kb - kb)  # noqa: E731
+
+    dk_part, dv_part = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, causal=causal, sm_scale=sm_scale,
+                          block_q=block_q, q_len=sq),
+        grid=(b * h, sk // block_k),
+        in_specs=[
+            # q/do are full-seq blocks: the block index along seq must be a
+            # literal 0 (kb-kb), NOT the k-block id — relying on Pallas's
+            # out-of-range clamp would be wrong-by-construction
+            pl.BlockSpec((None, sq, d), lambda bh, kb: (bh, kb - kb, kb - kb)),
+            pl.BlockSpec((None, sq, d), lambda bh, kb: (bh, kb - kb, kb - kb)),
+            pl.BlockSpec((None, sq), vec_q_map),      # lse
+            pl.BlockSpec((None, sq), vec_q_map),      # delta
+            pl.BlockSpec((None, block_k, d),
+                         lambda bh, kb, _h=h, _g=group, _hk=hk:
+                         ((bh // _h) * _hk + (bh % _h) // _g, kb, kb - kb)),
+            pl.BlockSpec((None, block_k, d),
+                         lambda bh, kb, _h=h, _g=group, _hk=hk:
+                         ((bh // _h) * _hk + (bh % _h) // _g, kb, kb - kb)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), k_blk_map),
+            pl.BlockSpec((None, block_k, d), k_blk_map),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sk, d), jnp.float32),
+        ],
+        interpret=INTERPRET,
+    )(qr, dor, lse, delta, kr, vr)
+
+    if group > 1:
+        dk_r = dk_part.reshape(b, hk, group, sk, d).sum(axis=2)
+        dv_r = dv_part.reshape(b, hk, group, sk, d).sum(axis=2)
+    else:
+        dk_r = dk_part.reshape(b, hk, sk, d)
+        dv_r = dv_part.reshape(b, hk, sk, d)
+    dk = jnp.swapaxes(dk_r, 1, 2).astype(k.dtype)
+    dv = jnp.swapaxes(dv_r, 1, 2).astype(v.dtype)
+
+    # ---- dq: grid over (B*H, q blocks)
+    dq_flat = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
+                          block_k=block_k, kv_len=sk),
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, sk, d), kv_map),      # k
+            pl.BlockSpec((None, sk, d), kv_map),      # v
+            pl.BlockSpec((None, block_q, d), q_map),  # do
+            pl.BlockSpec((None, block_q), lambda bh, qb: (bh, qb)),
+            pl.BlockSpec((None, block_q), lambda bh, qb: (bh, qb)),
+            pl.BlockSpec((None, block_q, d), q_map),  # q
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), q_map),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=INTERPRET,
+    )(kr, vr, dor, lse, delta, qr)
+    dq = jnp.swapaxes(dq_flat.reshape(b, h, sq, d), 1, 2)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
 def _flash_attention(causal, q, k, v):
-    return _flash_fwd_pallas(q, k, v, causal)
+    out, _ = _flash_fwd_pallas(q, k, v, causal)
+    return out
 
 
 def _flash_fwd_rule(causal, q, k, v):
-    out = _flash_fwd_pallas(q, k, v, causal)
-    return out, (q, k, v)
+    out, lse = _flash_fwd_pallas(q, k, v, causal)
+    # residuals are O(S·D) + O(S): inputs, output, logsumexp — never scores
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(causal, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _ref_attention(q, k, v, causal), q, k, v)
-    dq, dk, dv = vjp(g)
-    return dq, dk, dv
+    q, k, v, out, lse = res
+    return _flash_bwd_pallas(q, k, v, out, lse, g, causal)
 
 
 _flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -145,16 +358,24 @@ class _FlashFwd:
         return _flash_attention(bool(causal), q, k, v)
 
     @staticmethod
-    def supports(shape, dtype_name) -> bool:
+    def supports(shape, dtype_name, kv_shape=None) -> bool:
         if not _HAS_PALLAS:
             return False
-        if jax.default_backend() not in ("tpu",):
+        if jax.default_backend() not in ("tpu",) and not INTERPRET:
             return False
         if len(shape) != 4:
             return False
         b, s, h, d = shape
         if d % 128 != 0 and d not in (64, 128, 256):
             return False
+        if kv_shape is not None:
+            if len(kv_shape) != 4 or kv_shape[0] != b or kv_shape[3] != d:
+                return False
+            hk = kv_shape[2]
+            if hk == 0 or h % hk != 0:  # GQA group must divide heads
+                return False
+            if kv_shape[1] % 128 != 0:
+                return False
         return s % 128 == 0 and dtype_name in ("float32", "bfloat16")
 
     # identity used as the dispatch cache key

@@ -55,9 +55,21 @@ def _ln_kernel(x_ref, w_ref, b_ref, o_ref, *, eps):
     o_ref[...] = (y * w[None, :] + b[None, :]).astype(o_ref.dtype)
 
 
+def _pick_block_r(R):
+    """Largest power-of-two block <= _BLOCK_R that exactly divides R.
+
+    The grid is R // block_r with no ragged-tail masking, so block_r MUST
+    divide R; _supports guarantees R % 8 == 0, making 8 the floor here.
+    """
+    for b in (256, 128, 64, 32, 16, 8):
+        if b <= _BLOCK_R and R % b == 0:
+            return b
+    return None
+
+
 def _row_call(kernel, out_dtype, x2d, *vecs):
     R, H = x2d.shape
-    block_r = min(_BLOCK_R, R)
+    block_r = _pick_block_r(R)
     # i32-pin every index-map return (x64 mode promotes literal 0 to i64,
     # which Mosaic rejects)
     vec_specs = [pl.BlockSpec((H,), lambda r: (r - r,)) for _ in vecs]
@@ -79,7 +91,7 @@ def _supports(shape, dtype_name):
     rows = 1
     for s in shape[:-1]:
         rows *= s
-    return H % 128 == 0 and rows % 8 == 0
+    return H % 128 == 0 and rows % 8 == 0 and _pick_block_r(rows) is not None
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))

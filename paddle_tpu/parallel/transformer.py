@@ -51,6 +51,9 @@ class HybridParallelConfig:
                                       # the reference's "sep" hybrid axis slot
                                       # (topology.py:199) upgraded to true CP
     num_microbatches: int = 1
+    pp_schedule: str = "1f1b"         # "1f1b" (memory-bounded, the reference
+                                      # pipeline_parallel.py:684 schedule) or
+                                      # "gpipe" (scan + jax.grad transpose)
     remat: bool = True
     dtype: Any = jnp.float32          # activation/param dtype (bf16 on TPU)
     lr: float = 1e-3
@@ -196,18 +199,10 @@ def _use_tpu_flash(s, d):
 
 
 def _flash_attention_tpu(q, k, v):
-    from jax.experimental.pallas.ops.tpu.flash_attention import (
-        flash_attention as _tpu_flash)
-    d = q.shape[-1]
-    qt = jnp.swapaxes(q, 1, 2)          # [m, h, S, d]
-    kt = jnp.swapaxes(k, 1, 2)
-    vt = jnp.swapaxes(v, 1, 2)
-    # the kernel's index maps use i32 literals; trace them with x64 off
-    # (our package enables x64 globally for paddle dtype parity)
-    with jax.experimental.disable_x64():
-        out = _tpu_flash(qt, kt, vt, causal=True,
-                         sm_scale=1.0 / math.sqrt(d))
-    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+    # in-repo Pallas FA2 (fwd + bwd kernels, O(S) residuals); q/k/v are
+    # already [m, S, h_loc, d] — the kernel's native layout
+    from ..ops.pallas.flash_attention import _flash_attention
+    return _flash_attention(True, q, k, v)
 
 
 def _make_block(cfg: LlamaConfig, hp: HybridParallelConfig):
@@ -300,70 +295,80 @@ def _vocab_parallel_xent(h, head, labels, cfg, pos_weight=None,
     return wsum / jnp.maximum(wcount, 1.0)
 
 
-def _forward_loss(params, tokens, cfg, hp):
-    """Per-device forward: GPipe pipeline over M microbatches, returns loss.
-    tokens: LOCAL [M, m, S] int32 (already dp-sharded on batch)."""
+def _stage_apply(params, tok_mb, act_in, cfg, hp):
+    """One pipeline-stage application on one microbatch (SPMD-uniform).
+
+    tok_mb: [m, S] the tokens of the microbatch THIS stage processes now
+    (stage 0 embeds them; the last stage takes its labels from them).
+    act_in: [m, s_loc, H] activation arriving from the previous stage
+    (ignored on stage 0 via the `where`, so its cotangent is exactly zero
+    there — which is what closes the backward ppermute ring).
+    Returns (act_out [m, s_loc, H], mb_loss f32 — meaningful on last stage).
+    """
     block = _make_block(cfg, hp)
     if hp.remat:
         block = jax.checkpoint(block)
+    stage = lax.axis_index("pp")
+    S = tok_mb.shape[1]
+    S_cp = S // hp.cp                 # this cp rank's contiguous seq slice
+    cp_start = lax.axis_index("cp") * S_cp
+    # tokens are replicated over cp; each cp rank embeds only its slice
+    tok_cp = lax.dynamic_slice_in_dim(tok_mb, cp_start, S_cp, axis=1)
+    fresh = _vocab_parallel_embed(tok_cp, params["embed"], cfg, hp)
+    inp = jnp.where(stage == 0, fresh, act_in)
+
+    def body(x, pl):
+        return block(x, pl), None
+
+    out, _ = lax.scan(body, inp, params["layers"])
+
+    hN = _rms(out, params["norm_f"], cfg.rms_norm_eps)
+    h_full = lax.all_gather(hN, "tp", axis=1, tiled=True)  # [m, S_cp, H]
+    # next-token shift; global final position has no target -> masked
+    tok_ext = jnp.concatenate([tok_mb, tok_mb[:, :1]], axis=1)
+    labels = lax.dynamic_slice_in_dim(tok_ext, cp_start + 1, S_cp, axis=1)
+    pos_w = ((cp_start + jnp.arange(S_cp)) < S - 1).astype(jnp.float32)
+    ws, wc = _vocab_parallel_xent(h_full, params["head"], labels, cfg,
+                                  pos_weight=pos_w, reduction="sumcount")
+    if hp.cp > 1:
+        ws = lax.psum(ws, "cp")
+        wc = lax.psum(wc, "cp")
+    mb_loss = ws / jnp.maximum(wc, 1.0)
+    return out, mb_loss
+
+
+def _pcast_all(x):
+    # new-style shard_map tracks which mesh axes a value varies over; scan
+    # needs carry-in vma == carry-out vma, so pre-mark zero carries as
+    # varying over every mesh axis the body's outputs vary over.
+    return lax.pcast(x, ("pp", "dp", "cp", "tp"), to="varying")
+
+
+def _forward_loss(params, tokens, cfg, hp):
+    """Per-device forward: GPipe pipeline over M microbatches, returns loss.
+    tokens: LOCAL [M, m, S] int32 (already dp-sharded on batch)."""
     M = hp.num_microbatches
     pp = hp.pp
     stage = lax.axis_index("pp")
-    L_loc = cfg.num_hidden_layers // pp
     m = tokens.shape[1]
     S = tokens.shape[2]
-    S_cp = S // hp.cp                 # this cp rank's contiguous seq slice
-    s_loc = S_cp // hp.tp             # further seq-sharded over tp (SP)
-    cp_start = lax.axis_index("cp") * S_cp
+    s_loc = S // hp.cp // hp.tp       # seq-sharded over cp then tp (SP)
     H = cfg.hidden_size
-
-    def stage_fn(x):
-        def body(x, pl):
-            return block(x, pl), None
-        x, _ = lax.scan(body, x, params["layers"])
-        return x
 
     perm = [(i, (i + 1) % pp) for i in range(pp)]
 
     def tick(carry, t):
         act, acc_loss = carry
         mb = jnp.clip(t - stage, 0, M - 1)
-        tok_mb = lax.dynamic_index_in_dim(tokens, jnp.clip(t, 0, M - 1), axis=0,
-                                          keepdims=False)
-        # tokens are replicated over cp; each cp rank embeds only its slice
-        tok_cp = lax.dynamic_slice_in_dim(tok_mb, cp_start, S_cp, axis=1)
-        fresh = _vocab_parallel_embed(tok_cp, params["embed"], cfg, hp)
-        inp = jnp.where(stage == 0, fresh, act)
-        out = stage_fn(inp)
-
-        # last stage: head + loss for this microbatch (when valid)
-        my_tok = lax.dynamic_index_in_dim(tokens, mb, axis=0, keepdims=False)
-        hN = _rms(out, params["norm_f"], cfg.rms_norm_eps)
-        h_full = lax.all_gather(hN, "tp", axis=1, tiled=True)  # [m, S_cp, H]
-        # next-token shift; global final position has no target -> masked
-        tok_ext = jnp.concatenate([my_tok, my_tok[:, :1]], axis=1)
-        labels = lax.dynamic_slice_in_dim(tok_ext, cp_start + 1, S_cp, axis=1)
-        pos_w = ((cp_start + jnp.arange(S_cp)) < S - 1).astype(jnp.float32)
-        ws, wc = _vocab_parallel_xent(h_full, params["head"], labels, cfg,
-                                      pos_weight=pos_w, reduction="sumcount")
-        if hp.cp > 1:
-            ws = lax.psum(ws, "cp")
-            wc = lax.psum(wc, "cp")
-        mb_loss = ws / jnp.maximum(wc, 1.0)
+        tok_mb = lax.dynamic_index_in_dim(tokens, mb, axis=0, keepdims=False)
+        out, mb_loss = _stage_apply(params, tok_mb, act, cfg, hp)
         valid = ((t - stage) >= 0) & ((t - stage) < M) & (stage == pp - 1)
         acc_loss = acc_loss + jnp.where(valid, mb_loss, 0.0)
-
         act_next = lax.ppermute(out, "pp", perm) if pp > 1 else out
         return (act_next, acc_loss), None
 
-    act0 = jnp.zeros((m, s_loc, H), hp.dtype)
-    loss0 = jnp.zeros((), jnp.float32)
-    # new-style shard_map tracks which mesh axes a value varies over; scan
-    # needs carry-in vma == carry-out vma, so pre-mark the zero carries as
-    # varying over every mesh axis the body's outputs vary over.
-    all_axes = ("pp", "dp", "cp", "tp")
-    act0 = lax.pcast(act0, all_axes, to="varying")
-    loss0 = lax.pcast(loss0, all_axes, to="varying")
+    act0 = _pcast_all(jnp.zeros((m, s_loc, H), hp.dtype))
+    loss0 = _pcast_all(jnp.zeros((), jnp.float32))
     (act, total_loss), _ = lax.scan(tick, (act0, loss0),
                                     jnp.arange(M + pp - 1))
     loss = total_loss / M
@@ -371,6 +376,91 @@ def _forward_loss(params, tokens, cfg, hp):
     # ppermute transpose); sum over pp puts the last stage's loss everywhere
     loss = lax.psum(loss, "pp")
     return loss
+
+
+def _value_and_grad_1f1b(params, tokens, cfg, hp):
+    """Manual 1F1B pipeline schedule: returns (loss, grads).
+
+    TPU-native re-design of the reference's eager 1F1B work queue
+    (fleet/meta_parallel/pipeline_parallel.py:684): one lax.scan whose every
+    step runs ONE forward phase and ONE backward phase per stage —
+
+      F(f) at stage s in step t=f+s;  B(b) at stage s in step t=b+2pp-2-s
+
+    with activations ppermuted forward and activation-cotangents ppermuted
+    backward each step.  The backward phase re-derives the stage vjp from a
+    saved STAGE INPUT (recompute-in-backward), so resident activation state
+    is a ring of min(M, 2pp-2) stage inputs — bounded in pp, not in M.
+    GPipe-by-transpose (jax.grad over the forward scan) instead keeps all
+    M+pp-1 per-tick residuals live, the memory bound 1F1B exists to fix.
+
+    Gradients are accumulated in float32 across microbatches.
+    """
+    M = hp.num_microbatches
+    pp = hp.pp
+    stage = lax.axis_index("pp")
+    m = tokens.shape[1]
+    S = tokens.shape[2]
+    s_loc = S // hp.cp // hp.tp
+    H = cfg.hidden_size
+    nslots = max(1, min(M, 2 * pp - 2))
+    perm_f = [(i, (i + 1) % pp) for i in range(pp)]
+    perm_b = [(i, (i - 1) % pp) for i in range(pp)]
+    T = M + 2 * pp - 2
+
+    def sf(p, tok_mb, a):
+        return _stage_apply(p, tok_mb, a, cfg, hp)
+
+    def step(carry, t):
+        act, gact, slots, gparams, loss_acc = carry
+
+        # ---- forward phase: F(f), f = t - stage
+        f = t - stage
+        f_ok = (f >= 0) & (f < M)
+        fc = jnp.clip(f, 0, M - 1)
+        tok_f = lax.dynamic_index_in_dim(tokens, fc, axis=0, keepdims=False)
+        out, mb_loss = sf(params, tok_f, act)
+        loss_acc = loss_acc + jnp.where(f_ok & (stage == pp - 1), mb_loss, 0.0)
+        # save the stage INPUT for the backward recompute (ring slot)
+        slots = jnp.where(
+            f_ok,
+            lax.dynamic_update_index_in_dim(slots, act, fc % nslots, 0),
+            slots)
+        act_next = lax.ppermute(out, "pp", perm_f) if pp > 1 else out
+
+        # ---- backward phase: B(b), b = t - (2pp - 2 - stage)
+        bb = t - (2 * pp - 2 - stage)
+        b_ok = (bb >= 0) & (bb < M)
+        bc = jnp.clip(bb, 0, M - 1)
+        tok_b = lax.dynamic_index_in_dim(tokens, bc, axis=0, keepdims=False)
+        a_in = lax.dynamic_index_in_dim(slots, bc % nslots, axis=0,
+                                        keepdims=False)
+        _, vjp = jax.vjp(lambda p, a: sf(p, tok_b, a), params, a_in)
+        # cotangents: the loss seed lands on the last stage only; the
+        # activation cotangent is whatever the next stage sent last step
+        # (stage 0's act_in cotangent is structurally zero, so the ring
+        # delivers zeros to the last stage for free).
+        g_loss = jnp.where(b_ok & (stage == pp - 1),
+                           jnp.float32(1.0 / M), jnp.float32(0.0))
+        gp, ga = vjp((gact, g_loss))
+        gparams = jax.tree.map(
+            lambda acc, g: acc + jnp.where(b_ok, g.astype(acc.dtype), 0.0),
+            gparams, gp)
+        ga = jnp.where(b_ok, ga, jnp.zeros_like(ga))
+        gact_next = lax.ppermute(ga, "pp", perm_b) if pp > 1 else ga
+
+        return (act_next, gact_next, slots, gparams, loss_acc), None
+
+    act0 = _pcast_all(jnp.zeros((m, s_loc, H), hp.dtype))
+    gact0 = _pcast_all(jnp.zeros((m, s_loc, H), hp.dtype))
+    slots0 = _pcast_all(jnp.zeros((nslots, m, s_loc, H), hp.dtype))
+    gparams0 = jax.tree.map(
+        lambda p: _pcast_all(jnp.zeros(p.shape, jnp.float32)), params)
+    loss0 = _pcast_all(jnp.zeros((), jnp.float32))
+    (act, gact, slots, gparams, loss_acc), _ = lax.scan(
+        step, (act0, gact0, slots0, gparams0, loss0), jnp.arange(T))
+    loss = lax.psum(loss_acc / M, "pp")
+    return loss, gparams
 
 
 def _adamw_update(params, grads, opt_state, hp):
@@ -455,8 +545,11 @@ def build_train_step(cfg: LlamaConfig, hp: HybridParallelConfig, mesh: Mesh):
         M = hp.num_microbatches
         mS = tokens.shape
         tokens = tokens.reshape(M, mS[0] // M, mS[1])
-        loss, grads = jax.value_and_grad(
-            lambda p: _forward_loss(p, tokens, cfg, hp))(params)
+        if hp.pp > 1 and hp.pp_schedule == "1f1b":
+            loss, grads = _value_and_grad_1f1b(params, tokens, cfg, hp)
+        else:
+            loss, grads = jax.value_and_grad(
+                lambda p: _forward_loss(p, tokens, cfg, hp))(params)
         grads = _reduce_grads(grads, hp)
         loss = lax.pmean(loss, "dp")
         new_params, new_opt = _adamw_update(params, grads, opt_state, hp)

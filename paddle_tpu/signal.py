@@ -35,16 +35,23 @@ def overlap_add(x, hop_length, axis=-1, name=None):
     """Inverse of frame (reference signal.py overlap_add): x has
     [..., frame_length, num_frames] on the trailing dims (axis=-1)."""
     def impl(a, hop_length, axis):
-        if axis in (-1, a.ndim - 1):
+        if axis not in (0, -1):
+            raise ValueError(
+                "overlap_add: axis must be 0 or -1, got %r" % (axis,))
+        if axis == -1:
             frames = jnp.swapaxes(a, -1, -2)    # [..., num, L]
         else:
-            frames = a
+            # axis=0 layout puts [num_frames, frame_length] on the LEADING
+            # dims; move them (as [num, L]) to the end, fold, move back.
+            frames = jnp.moveaxis(a, (0, 1), (-2, -1))  # [..., num, L]
         *batch, num, L = frames.shape
         n = (num - 1) * hop_length + L
         out = jnp.zeros((*batch, n), frames.dtype)
         for i in range(num):                    # static unroll: num is small
             out = out.at[..., i * hop_length:i * hop_length + L].add(
                 frames[..., i, :])
+        if axis == 0:
+            out = jnp.moveaxis(out, -1, 0)      # [n, ...batch]
         return out
     return D.apply("overlap_add", impl, (x,),
                    {"hop_length": int(hop_length), "axis": int(axis)})

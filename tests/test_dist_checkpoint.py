@@ -120,9 +120,11 @@ def test_multi_rank_metadata_merges(tmp_path, monkeypatch):
     rng = np.random.RandomState(9)
     a = jnp.asarray(rng.randn(4, 4), jnp.float32)
     b = jnp.asarray(rng.randn(6), jnp.float32)
-    save_state_dict({"a": a}, str(tmp_path))           # rank 0
+    # ranks of one logical save share a unique_id (multi-host contract:
+    # pass the step number; auto-assignment is only safe single-host)
+    save_state_dict({"a": a}, str(tmp_path), unique_id=0)   # rank 0
     monkeypatch.setattr(jax, "process_index", lambda: 1)
-    save_state_dict({"b": b}, str(tmp_path))           # "rank 1"
+    save_state_dict({"b": b}, str(tmp_path), unique_id=0)   # "rank 1"
     monkeypatch.undo()
     import os
     metas = [f for f in os.listdir(tmp_path) if f.startswith("metadata")]
