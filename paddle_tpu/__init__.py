@@ -8,12 +8,18 @@ from __future__ import annotations
 
 import os as _os
 
-# x64 must be configured before any jax computation: the reference framework
-# supports float64/int64 tensors as first-class dtypes (python ints create
-# int64 tensors), so we match.
-import jax as _jax
+# jax_enable_x64 stays OFF: it widens default intermediates on a bf16
+# machine and breaks Pallas/Mosaic lowering (r2 BENCH + index-map
+# RecursionError).  int64/float64 parity with the reference (python ints ->
+# int64 tensors, python/paddle/tensor/creation.py) is scoped to creation ops
+# via core.dtype.x64_scope, which builds 64-bit arrays under
+# jax.enable_x64(True); the arrays keep their dtype afterwards.
+import warnings as _warnings
 
-_jax.config.update("jax_enable_x64", True)
+import jax as _jax  # noqa: F401
+
+_warnings.filterwarnings(
+    "ignore", message="Explicitly requested dtype.*truncated", category=UserWarning)
 
 __version__ = "0.1.0"
 

@@ -112,3 +112,35 @@ def is_floating_point_dtype(d) -> bool:
 
 def is_integer_dtype(d) -> bool:
     return convert_dtype(d).is_integer
+
+
+_X64_NAMES = frozenset({"int64", "uint64", "float64", "complex128"})
+
+
+def x64_scope(*dtype_likes):
+    """Context manager enabling 64-bit array creation when any requested
+    dtype is 64-bit.
+
+    jax_enable_x64 stays globally OFF (it widens intermediates on a bf16
+    machine and breaks Pallas/Mosaic index-map lowering); parity with the
+    reference's first-class int64/float64 tensors
+    (/root/reference/python/paddle/tensor/creation.py default int64) is
+    scoped to the creation ops: arrays requested as 64-bit are built under
+    jax.enable_x64(True) and keep that dtype afterwards.  Mixed 64/32-bit
+    compute may demote results to 32-bit — the documented TPU-first
+    deviation.
+    """
+    import contextlib
+
+    import jax
+
+    for d in dtype_likes:
+        if d is None:
+            continue
+        try:
+            name = np.dtype(d.np_dtype if isinstance(d, dtype) else d).name
+        except TypeError:
+            continue
+        if name in _X64_NAMES:
+            return jax.enable_x64(True)
+    return contextlib.nullcontext()

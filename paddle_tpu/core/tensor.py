@@ -166,9 +166,11 @@ class Tensor:
 
     def astype(self, dt) -> "Tensor":
         from . import dispatch
+        from .dtype import x64_scope
         target = convert_dtype(dt).np_dtype
 
-        return dispatch.apply("cast", _cast_impl, (self,), {"target": str(target)})
+        with x64_scope(target):
+            return dispatch.apply("cast", _cast_impl, (self,), {"target": str(target)})
 
     cast = astype
 
@@ -321,10 +323,13 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True):
             jdt = np.complex64
         # numpy arrays keep their dtype
 
+    from .dtype import x64_scope
     if isinstance(data, np.ndarray) and jdt is None:
-        arr = jnp.asarray(data)
+        with x64_scope(data.dtype):
+            arr = jnp.asarray(data)
     else:
-        arr = jnp.asarray(np.asarray(data), dtype=jdt)
+        with x64_scope(jdt):
+            arr = jnp.asarray(np.asarray(data), dtype=jdt)
 
     if place is not None:
         p = place if isinstance(place, place_mod.Place) else place_mod._parse_device(place)

@@ -56,7 +56,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     if use_pallas and attn_mask is None and dropout_p == 0.0:
         from ...ops.pallas.flash_attention import flash_attention_fwd
         if flash_attention_fwd.supports(query.shape, query.dtype.name,
-                                        tuple(key.shape)):
+                                        tuple(key.shape), bool(is_causal)):
             return D.apply(
                 "flash_attention", flash_attention_fwd,
                 (query, key, value), {"causal": bool(is_causal)})

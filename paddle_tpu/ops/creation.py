@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core import dispatch as D
-from ..core.dtype import convert_dtype, to_jax_dtype
+from ..core.dtype import convert_dtype, to_jax_dtype, x64_scope
 from ..core.tensor import Tensor, to_tensor
 
 __all__ = [
@@ -34,12 +34,21 @@ def _dt(dtype, default="float32"):
     return to_jax_dtype(convert_dtype(dtype if dtype is not None else default))
 
 
+def _make(jdt, build, *args, **kw):
+    # 64-bit dtypes (paddle-parity int64 defaults etc.) are created under a
+    # scoped jax.enable_x64 — see core.dtype.x64_scope
+    with x64_scope(jdt):
+        return Tensor(build(*args, **kw))
+
+
 def zeros(shape, dtype=None, name=None):
-    return Tensor(jnp.zeros(_shape_tuple(shape), _dt(dtype)))
+    dt = _dt(dtype)
+    return _make(dt, jnp.zeros, _shape_tuple(shape), dt)
 
 
 def ones(shape, dtype=None, name=None):
-    return Tensor(jnp.ones(_shape_tuple(shape), _dt(dtype)))
+    dt = _dt(dtype)
+    return _make(dt, jnp.ones, _shape_tuple(shape), dt)
 
 
 def full(shape, fill_value, dtype=None, name=None):
@@ -52,11 +61,13 @@ def full(shape, fill_value, dtype=None, name=None):
             dtype = "int64"
         else:
             dtype = "float32"
-    return Tensor(jnp.full(_shape_tuple(shape), fill_value, _dt(dtype)))
+    dt = _dt(dtype)
+    return _make(dt, jnp.full, _shape_tuple(shape), fill_value, dt)
 
 
 def empty(shape, dtype=None, name=None):
-    return Tensor(jnp.zeros(_shape_tuple(shape), _dt(dtype)))
+    dt = _dt(dtype)
+    return _make(dt, jnp.zeros, _shape_tuple(shape), dt)
 
 
 def _like_dt(x, dtype):
@@ -64,17 +75,20 @@ def _like_dt(x, dtype):
 
 
 def zeros_like(x, dtype=None, name=None):
-    return Tensor(jnp.zeros(x._data.shape, _like_dt(x, dtype)))
+    dt = _like_dt(x, dtype)
+    return _make(dt, jnp.zeros, x._data.shape, dt)
 
 
 def ones_like(x, dtype=None, name=None):
-    return Tensor(jnp.ones(x._data.shape, _like_dt(x, dtype)))
+    dt = _like_dt(x, dtype)
+    return _make(dt, jnp.ones, x._data.shape, dt)
 
 
 def full_like(x, fill_value, dtype=None, name=None):
     if isinstance(fill_value, Tensor):
         fill_value = fill_value.item()
-    return Tensor(jnp.full(x._data.shape, fill_value, _like_dt(x, dtype)))
+    dt = _like_dt(x, dtype)
+    return _make(dt, jnp.full, x._data.shape, fill_value, dt)
 
 
 def empty_like(x, dtype=None, name=None):
@@ -90,26 +104,30 @@ def arange(start=0, end=None, step=1, dtype=None, name=None):
     if dtype is None:
         dtype = ("int64" if all(isinstance(v, (int, np.integer)) for v in (start, end, step))
                  else "float32")
-    return Tensor(jnp.arange(start, end, step, _dt(dtype)))
+    dt = _dt(dtype)
+    return _make(dt, jnp.arange, start, end, step, dt)
 
 
 def linspace(start, stop, num, dtype=None, name=None):
     def val(v):
         return v.item() if isinstance(v, Tensor) else v
-    return Tensor(jnp.linspace(val(start), val(stop), int(val(num)), dtype=_dt(dtype)))
+    dt = _dt(dtype)
+    return _make(dt, jnp.linspace, val(start), val(stop), int(val(num)), dtype=dt)
 
 
 def logspace(start, stop, num, base=10.0, dtype=None, name=None):
     def val(v):
         return v.item() if isinstance(v, Tensor) else v
-    return Tensor(jnp.logspace(val(start), val(stop), int(val(num)), base=val(base),
-                               dtype=_dt(dtype)))
+    dt = _dt(dtype)
+    return _make(dt, jnp.logspace, val(start), val(stop), int(val(num)),
+                 base=val(base), dtype=dt)
 
 
 def eye(num_rows, num_columns=None, dtype=None, name=None):
-    return Tensor(jnp.eye(int(num_rows),
-                          int(num_columns) if num_columns is not None else None,
-                          dtype=_dt(dtype)))
+    dt = _dt(dtype)
+    return _make(dt, jnp.eye, int(num_rows),
+                 int(num_columns) if num_columns is not None else None,
+                 dtype=dt)
 
 
 def _tril(x, diagonal):
@@ -190,9 +208,11 @@ def polar(abs_t, angle, name=None):
 
 def tril_indices(row, col, offset=0, dtype="int64"):
     r, c = np.tril_indices(row, offset, col)
-    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype)))
+    dt = _dt(dtype)
+    return _make(dt, jnp.asarray, np.stack([r, c]), dtype=dt)
 
 
 def triu_indices(row, col=None, offset=0, dtype="int64"):
     r, c = np.triu_indices(row, offset, col if col is not None else row)
-    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype)))
+    dt = _dt(dtype)
+    return _make(dt, jnp.asarray, np.stack([r, c]), dtype=dt)

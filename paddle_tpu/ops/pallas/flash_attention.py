@@ -101,7 +101,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         hi = jnp.int32(num_k_blocks)
     acc, m_i, l_i = jax.lax.fori_loop(jnp.int32(0), hi, body, (acc, m_i, l_i))
     o_ref[...] = (acc / l_i[:, None]).astype(o_ref.dtype)
-    lse_ref[...] = m_i + jnp.log(l_i)
+    # lse ref is [1, block_q]: kept 3-D as [BH, 1, Sq] outside so the block's
+    # last-two dims (1, block_q) satisfy Mosaic's (8,128)-divisible-or-full rule
+    lse_ref[...] = (m_i + jnp.log(l_i))[None, :]
 
 
 def _gqa_maps(h, group):
@@ -110,17 +112,18 @@ def _gqa_maps(h, group):
     hk = h // group
 
     def q_map(bh, blk):
-        return (bh, blk, blk - blk)
+        return (bh, blk, 0)
 
     def kv_map(bh, blk):
         kvh = (bh // h) * hk + (bh % h) // group
-        return (kvh, blk - blk, blk - blk)
+        return (kvh, 0, 0)
 
     return q_map, kv_map
 
 
 def _flash_fwd_pallas(q, k, v, causal):
-    """Returns (out, lse); lse is [B*H, Sq] float32 in the scaled domain."""
+    """Returns (out, lse); lse is [B*H, 1, Sq] float32 in the scaled domain
+    (the singleton dim keeps the Pallas vector blocks TPU-tileable)."""
     b, sq, h, d = q.shape
     sk, hk = k.shape[1], k.shape[2]
     group = h // hk
@@ -135,8 +138,6 @@ def _flash_fwd_pallas(q, k, v, causal):
 
     kernel = functools.partial(_fwd_kernel, causal=causal, sm_scale=sm_scale,
                                block_k=block_k, kv_len=sk)
-    # NB: x64 mode promotes literal 0 to i64, which Mosaic rejects in the
-    # index-map return tuple; derive an i32 zero from the grid index instead.
     q_map, kv_map = _gqa_maps(h, group)
 
     out, lse = pl.pallas_call(
@@ -149,11 +150,11 @@ def _flash_fwd_pallas(q, k, v, causal):
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, d), q_map),
-            pl.BlockSpec((None, block_q), lambda bh, qb: (bh, qb)),
+            pl.BlockSpec((None, 1, block_q), lambda bh, qb: (bh, 0, qb)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32),
         ],
         interpret=INTERPRET,
     )(qr, kr, vr)
@@ -163,7 +164,7 @@ def _flash_fwd_pallas(q, k, v, causal):
 def _bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
                      dk_ref, dv_ref, *, causal, sm_scale, block_q, q_len):
     # grid: (batch*heads, k_blocks); k/v refs [block_k, d];
-    # q/do refs [q_len, d]; lse/delta refs [q_len]
+    # q/do refs [q_len, d]; lse/delta refs [1, q_len]
     k = k_ref[...].astype(jnp.float32)
     v = v_ref[...].astype(jnp.float32)
     block_k, d = k.shape
@@ -177,8 +178,8 @@ def _bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         dk, dv = carry
         q = q_ref[pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[pl.dslice(qb * block_q, block_q)]
-        delta = delta_ref[pl.dslice(qb * block_q, block_q)]
+        lse = lse_ref[0, pl.dslice(qb * block_q, block_q)]
+        delta = delta_ref[0, pl.dslice(qb * block_q, block_q)]
         # transposed score tile: [block_k, block_q]
         st = (k @ q.T) * sm_scale
         if causal:
@@ -208,11 +209,11 @@ def _bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
 def _bwd_dq_kernel(k_ref, v_ref, do_ref, lse_ref, delta_ref, q_ref,
                    dq_ref, *, causal, sm_scale, block_k, kv_len):
     # grid: (batch*heads, q_blocks); q/do/dq refs [block_q, d];
-    # k/v refs [kv_len, d]; lse/delta refs [block_q]
+    # k/v refs [kv_len, d]; lse/delta refs [1, block_q]
     q = q_ref[...].astype(jnp.float32)
     do = do_ref[...].astype(jnp.float32)
-    lse = lse_ref[...]
-    delta = delta_ref[...]
+    lse = lse_ref[0, :]
+    delta = delta_ref[0, :]
     block_q, d = q.shape
     q_idx = pl.program_id(1)
 
@@ -256,20 +257,21 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal):
     dor = jnp.swapaxes(g, 1, 2).reshape(b * h, sq, d)
     outr = jnp.swapaxes(out, 1, 2).reshape(b * h, sq, d)
 
-    # delta_i = rowsum(dO_i * O_i) — O(S·D) precompute, standard FA2 trick
+    # delta_i = rowsum(dO_i * O_i) — O(S·D) precompute, standard FA2 trick;
+    # carried [BH, 1, Sq] like lse for TPU-legal vector tiling
     delta = jnp.sum(dor.astype(jnp.float32) * outr.astype(jnp.float32),
-                    axis=-1)  # [BH, Sq]
+                    axis=-1)[:, None, :]
 
     block_q = min(_BLOCK_Q, sq)
     block_k = min(_BLOCK_K, sk)
     q_map, kv_map = _gqa_maps(h, group)
 
     def vec_q_map(bh, blk):
-        return (bh, blk - blk)
+        return (bh, 0, 0)
 
     # ---- dk/dv: grid over (B*H, k blocks); per-query-head partials are
     # summed over the GQA group afterwards (group is small).
-    k_blk_map = lambda bh, kb: (bh, kb, kb - kb)  # noqa: E731
+    k_blk_map = lambda bh, kb: (bh, kb, 0)  # noqa: E731
 
     dk_part, dv_part = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, causal=causal, sm_scale=sm_scale,
@@ -279,16 +281,16 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal):
             # q/do are full-seq blocks: the block index along seq must be a
             # literal 0 (kb-kb), NOT the k-block id — relying on Pallas's
             # out-of-range clamp would be wrong-by-construction
-            pl.BlockSpec((None, sq, d), lambda bh, kb: (bh, kb - kb, kb - kb)),
-            pl.BlockSpec((None, sq, d), lambda bh, kb: (bh, kb - kb, kb - kb)),
-            pl.BlockSpec((None, sq), vec_q_map),      # lse
-            pl.BlockSpec((None, sq), vec_q_map),      # delta
+            pl.BlockSpec((None, sq, d), lambda bh, kb: (bh, 0, 0)),
+            pl.BlockSpec((None, sq, d), lambda bh, kb: (bh, 0, 0)),
+            pl.BlockSpec((None, 1, sq), vec_q_map),   # lse
+            pl.BlockSpec((None, 1, sq), vec_q_map),   # delta
             pl.BlockSpec((None, block_k, d),
                          lambda bh, kb, _h=h, _g=group, _hk=hk:
-                         ((bh // _h) * _hk + (bh % _h) // _g, kb, kb - kb)),
+                         ((bh // _h) * _hk + (bh % _h) // _g, kb, 0)),
             pl.BlockSpec((None, block_k, d),
                          lambda bh, kb, _h=h, _g=group, _hk=hk:
-                         ((bh // _h) * _hk + (bh % _h) // _g, kb, kb - kb)),
+                         ((bh // _h) * _hk + (bh % _h) // _g, kb, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, d), k_blk_map),
@@ -319,8 +321,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal):
             pl.BlockSpec((None, sk, d), kv_map),      # k
             pl.BlockSpec((None, sk, d), kv_map),      # v
             pl.BlockSpec((None, block_q, d), q_map),  # do
-            pl.BlockSpec((None, block_q), lambda bh, qb: (bh, qb)),
-            pl.BlockSpec((None, block_q), lambda bh, qb: (bh, qb)),
+            pl.BlockSpec((None, 1, block_q), lambda bh, qb: (bh, 0, qb)),
+            pl.BlockSpec((None, 1, block_q), lambda bh, qb: (bh, 0, qb)),
             pl.BlockSpec((None, block_q, d), q_map),  # q
         ],
         out_specs=pl.BlockSpec((None, block_q, d), q_map),
@@ -351,32 +353,116 @@ def _flash_bwd_rule(causal, res, g):
 _flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+def _shapes_eligible(shape, dtype_name, kv_shape=None, causal=True) -> bool:
+    """Static shape heuristic: do these shapes tile onto the MXU at all?"""
+    if not _HAS_PALLAS:
+        return False
+    if jax.default_backend() not in ("tpu",) and not INTERPRET:
+        return False
+    if len(shape) != 4:
+        return False
+    b, s, h, d = shape
+    if d % 128 != 0 and d not in (64, 128, 256):
+        return False
+    if kv_shape is not None:
+        if len(kv_shape) != 4 or kv_shape[0] != b or kv_shape[3] != d:
+            return False
+        hk = kv_shape[2]
+        if hk == 0 or h % hk != 0:  # GQA group must divide heads
+            return False
+        if kv_shape[1] % 128 != 0:
+            return False
+        # the kernel's causal mask is top-left aligned (q_pos >= k_pos);
+        # _ref_attention uses bottom-right alignment for sq != sk, so
+        # cross-length causal must NOT take the kernel path
+        if causal and kv_shape[1] != s:
+            return False
+    return s % 128 == 0 and dtype_name in ("float32", "bfloat16")
+
+
+# (shapes, dtype, causal, backend) -> bool.  The r2 bench died because a
+# shape heuristic said yes and Mosaic said no at run time; the authoritative
+# check is an actual lowering, done ONCE per shape and cached.
+_PROBE_CACHE: dict = {}
+_PROBE_LOGGED = False
+
+
+def _probe_lowering(q_sds, k_sds, causal) -> bool:
+    """Compile-probe the fwd+bwd kernels for these abstract shapes.
+
+    Returns False (and logs once) on any lowering/compile failure so callers
+    degrade to `_ref_attention` instead of zeroing the whole program — the
+    TPU analog of the reference's kernel-selection fallback around FA2
+    (flash_attn_kernel.cu dispatch path).
+    """
+    global _PROBE_LOGGED
+    key = (tuple(q_sds.shape), tuple(k_sds.shape), str(q_sds.dtype),
+           bool(causal), jax.default_backend())
+    hit = _PROBE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    if INTERPRET:  # interpreter enforces no TPU tiling rules; nothing to probe
+        _PROBE_CACHE[key] = True
+        return True
+
+    def fwd_bwd(q, k, v, g):
+        out, vjp = jax.vjp(
+            lambda q_, k_, v_: _flash_attention(causal, q_, k_, v_), q, k, v)
+        return out, vjp(g)
+
+    try:
+        jax.jit(fwd_bwd).lower(q_sds, k_sds, k_sds, q_sds).compile()
+        ok = True
+    except Exception as e:  # Mosaic/XLA lowering failure -> fallback
+        ok = False
+        if not _PROBE_LOGGED:
+            _PROBE_LOGGED = True
+            import logging
+            logging.getLogger("paddle_tpu").warning(
+                "Pallas flash-attention failed to lower for q=%s k=%s "
+                "(causal=%s): %s -- falling back to the XLA composition",
+                q_sds.shape, k_sds.shape, causal, str(e)[:500])
+    _PROBE_CACHE[key] = ok
+    return ok
+
+
+def use_flash(q, k, causal=True) -> bool:
+    """THE eligibility predicate (single source of truth): flag + static
+    shape check + one-time lowering probe."""
+    from ...core.flags import get_flag
+    if not get_flag("use_pallas_kernels"):
+        return False
+    if not _shapes_eligible(tuple(q.shape), jnp.dtype(q.dtype).name,
+                            tuple(k.shape), bool(causal)):
+        return False
+    return _probe_lowering(jax.ShapeDtypeStruct(q.shape, q.dtype),
+                           jax.ShapeDtypeStruct(k.shape, k.dtype), causal)
+
+
+def attention(q, k, v, causal=True):
+    """Fused attention with automatic fallback: Pallas flash kernels when
+    they provably lower on this backend, else the XLA composition."""
+    if use_flash(q, k, causal):
+        return _flash_attention(bool(causal), q, k, v)
+    return _ref_attention(q, k, v, causal)
+
+
 class _FlashFwd:
-    """Callable op with a static shape-eligibility check."""
+    """Callable op with the centralized eligibility check."""
 
     def __call__(self, q, k, v, causal):
         return _flash_attention(bool(causal), q, k, v)
 
     @staticmethod
-    def supports(shape, dtype_name, kv_shape=None) -> bool:
-        if not _HAS_PALLAS:
+    def supports(shape, dtype_name, kv_shape=None, causal=True) -> bool:
+        if not _shapes_eligible(shape, dtype_name, kv_shape, bool(causal)):
             return False
-        if jax.default_backend() not in ("tpu",) and not INTERPRET:
-            return False
-        if len(shape) != 4:
-            return False
-        b, s, h, d = shape
-        if d % 128 != 0 and d not in (64, 128, 256):
-            return False
-        if kv_shape is not None:
-            if len(kv_shape) != 4 or kv_shape[0] != b or kv_shape[3] != d:
-                return False
-            hk = kv_shape[2]
-            if hk == 0 or h % hk != 0:  # GQA group must divide heads
-                return False
-            if kv_shape[1] % 128 != 0:
-                return False
-        return s % 128 == 0 and dtype_name in ("float32", "bfloat16")
+        import numpy as _np
+        dt = jnp.bfloat16 if dtype_name == "bfloat16" else _np.dtype(dtype_name)
+        kv = kv_shape if kv_shape is not None else shape
+        return _probe_lowering(jax.ShapeDtypeStruct(tuple(shape), dt),
+                               jax.ShapeDtypeStruct(tuple(kv), dt),
+                               bool(causal))
 
     # identity used as the dispatch cache key
     def __hash__(self):

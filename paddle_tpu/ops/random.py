@@ -108,11 +108,16 @@ def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
     if high is None:
         low, high = 0, low
     key = random_state.next_key()
-    return D.apply("randint",
-                   lambda k, shape, dtype, lo, hi: jax.random.randint(
-                       k, shape, lo, hi, np.dtype(dtype)),
-                   (key,), {"shape": _shape(shape), "dtype": str(_dt(dtype, "int64")),
-                            "lo": int(low), "hi": int(high)})
+    # random *creation* ops keep the reference's int64 default via the same
+    # scoped-x64 policy as ops/creation.py (core.dtype.x64_scope)
+    from ..core.dtype import x64_scope
+    dt = _dt(dtype, "int64")
+    with x64_scope(dt):
+        return D.apply("randint",
+                       lambda k, shape, dtype, lo, hi: jax.random.randint(
+                           k, shape, lo, hi, np.dtype(dtype)),
+                       (key,), {"shape": _shape(shape), "dtype": str(dt),
+                                "lo": int(low), "hi": int(high)})
 
 
 def randint_like(x, low=0, high=None, dtype=None, name=None):
@@ -121,9 +126,12 @@ def randint_like(x, low=0, high=None, dtype=None, name=None):
 
 def randperm(n, dtype="int64", name=None):
     key = random_state.next_key()
-    return D.apply("randperm",
-                   lambda k, n, dtype: jax.random.permutation(k, n).astype(np.dtype(dtype)),
-                   (key,), {"n": int(n), "dtype": str(_dt(dtype, "int64"))})
+    from ..core.dtype import x64_scope
+    dt = _dt(dtype, "int64")
+    with x64_scope(dt):
+        return D.apply("randperm",
+                       lambda k, n, dtype: jax.random.permutation(k, n).astype(np.dtype(dtype)),
+                       (key,), {"n": int(n), "dtype": str(dt)})
 
 
 def bernoulli(x, p=None, name=None):

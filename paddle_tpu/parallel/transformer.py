@@ -171,38 +171,12 @@ def _rms(x, w, eps=1e-6):
 
 
 def _attention(q, k, v):
-    # q/k/v: [m, S, h_loc, d]; causal
-    m_, s, h, d = q.shape
-    if _use_tpu_flash(s, d):
-        return _flash_attention_tpu(q, k, v)
-    qf = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
-    kf = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
-    vf = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
-    scores = jnp.einsum("mhqd,mhkd->mhqk", qf, kf) / math.sqrt(d)
-    mask = jnp.tril(jnp.ones((s, s), bool))
-    scores = jnp.where(mask, scores, -jnp.inf)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("mhqk,mhkd->mhqd", probs, vf)
-    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
-
-
-def _use_tpu_flash(s, d):
-    """Route causal attention through the fused TPU flash kernels
-    (Pallas fwd+bwd; the analog of the reference's FA2 CUDA path,
-    flash_attn_kernel.cu) when shapes tile onto the MXU."""
-    if jax.default_backend() != "tpu":
-        return False
-    from ..core.flags import get_flag
-    if not get_flag("use_pallas_kernels"):
-        return False
-    return s % 128 == 0 and d in (64, 128, 256)
-
-
-def _flash_attention_tpu(q, k, v):
-    # in-repo Pallas FA2 (fwd + bwd kernels, O(S) residuals); q/k/v are
-    # already [m, S, h_loc, d] — the kernel's native layout
-    from ..ops.pallas.flash_attention import _flash_attention
-    return _flash_attention(True, q, k, v)
+    # q/k/v: [m, S, h_loc(, h_kv_loc), d]; causal.  Eligibility + the
+    # one-time Mosaic lowering probe + XLA fallback all live in
+    # ops.pallas.flash_attention.attention — the single kernel-selection
+    # layer (TPU analog of the reference's flash_attn_kernel.cu dispatch).
+    from ..ops.pallas.flash_attention import attention
+    return attention(q, k, v, causal=True)
 
 
 def _make_block(cfg: LlamaConfig, hp: HybridParallelConfig):
