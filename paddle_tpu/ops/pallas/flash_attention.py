@@ -61,10 +61,18 @@ def _ref_attention(q, k, v, causal):
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
+def _dot_f32(a, b, dims):
+    """Matmul keeping operands in their storage dtype (bf16 runs the MXU at
+    full rate; f32 operands would run at a fraction of it) with float32
+    accumulation."""
+    return jax.lax.dot_general(a, b, (dims, ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                 causal, sm_scale, block_k, kv_len):
     # grid: (batch*heads, q_blocks); refs are [block_q, d] / [kv_len, d]
-    q = q_ref[...].astype(jnp.float32) * sm_scale
+    q = q_ref[...]
     block_q, d = q.shape
     q_idx = pl.program_id(1)
 
@@ -76,9 +84,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
     def body(kb, carry):
         acc, m_i, l_i = carry
-        k = k_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
-        s = q @ k.T  # [block_q, block_k]
+        k = k_ref[pl.dslice(kb * block_k, block_k), :]
+        v = v_ref[pl.dslice(kb * block_k, block_k), :]
+        s = _dot_f32(q, k, ((1,), (1,))) * sm_scale  # [block_q, block_k] f32
         if causal:
             q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -89,7 +97,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m_i - m_new)
         l_new = alpha * l_i + jnp.sum(p, axis=1)
-        acc = acc * alpha[:, None] + p @ v
+        acc = acc * alpha[:, None] + _dot_f32(p.astype(v.dtype), v,
+                                              ((1,), (0,)))
         return acc, m_new, l_new
 
     if causal:
@@ -165,8 +174,8 @@ def _bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
                      dk_ref, dv_ref, *, causal, sm_scale, block_q, q_len):
     # grid: (batch*heads, k_blocks); k/v refs [block_k, d];
     # q/do refs [q_len, d]; lse/delta refs [1, q_len]
-    k = k_ref[...].astype(jnp.float32)
-    v = v_ref[...].astype(jnp.float32)
+    k = k_ref[...]
+    v = v_ref[...]
     block_k, d = k.shape
     k_idx = pl.program_id(1)
 
@@ -176,12 +185,12 @@ def _bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
 
     def body(qb, carry):
         dk, dv = carry
-        q = q_ref[pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[pl.dslice(qb * block_q, block_q), :]
+        do = do_ref[pl.dslice(qb * block_q, block_q), :]
         lse = lse_ref[0, pl.dslice(qb * block_q, block_q)]
         delta = delta_ref[0, pl.dslice(qb * block_q, block_q)]
-        # transposed score tile: [block_k, block_q]
-        st = (k @ q.T) * sm_scale
+        # transposed score tile: [block_k, block_q] f32
+        st = _dot_f32(k, q, ((1,), (1,))) * sm_scale
         if causal:
             k_pos = k_idx * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_k, block_q), 0)
@@ -189,10 +198,11 @@ def _bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
                 jnp.int32, (block_k, block_q), 1)
             st = jnp.where(q_pos >= k_pos, st, -jnp.inf)
         pt = jnp.exp(st - lse[None, :])
-        dv = dv + pt @ do
-        dpt = v @ do.T                       # [block_k, block_q]
+        ptc = pt.astype(do.dtype)
+        dv = dv + _dot_f32(ptc, do, ((1,), (0,)))
+        dpt = _dot_f32(v, do, ((1,), (1,)))  # [block_k, block_q] f32
         dst = pt * (dpt - delta[None, :]) * sm_scale
-        dk = dk + dst @ q
+        dk = dk + _dot_f32(dst.astype(q.dtype), q, ((1,), (0,)))
         return dk, dv
 
     if causal:
@@ -210,8 +220,8 @@ def _bwd_dq_kernel(k_ref, v_ref, do_ref, lse_ref, delta_ref, q_ref,
                    dq_ref, *, causal, sm_scale, block_k, kv_len):
     # grid: (batch*heads, q_blocks); q/do/dq refs [block_q, d];
     # k/v refs [kv_len, d]; lse/delta refs [1, block_q]
-    q = q_ref[...].astype(jnp.float32)
-    do = do_ref[...].astype(jnp.float32)
+    q = q_ref[...]
+    do = do_ref[...]
     lse = lse_ref[0, :]
     delta = delta_ref[0, :]
     block_q, d = q.shape
@@ -221,9 +231,9 @@ def _bwd_dq_kernel(k_ref, v_ref, do_ref, lse_ref, delta_ref, q_ref,
     num_k_blocks = kv_len // block_k
 
     def body(kb, dq):
-        k = k_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
-        s = (q @ k.T) * sm_scale
+        k = k_ref[pl.dslice(kb * block_k, block_k), :]
+        v = v_ref[pl.dslice(kb * block_k, block_k), :]
+        s = _dot_f32(q, k, ((1,), (1,))) * sm_scale
         if causal:
             q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -231,9 +241,9 @@ def _bwd_dq_kernel(k_ref, v_ref, do_ref, lse_ref, delta_ref, q_ref,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
         p = jnp.exp(s - lse[:, None])
-        dp = do @ v.T
+        dp = _dot_f32(do, v, ((1,), (1,)))
         ds = p * (dp - delta[:, None]) * sm_scale
-        return dq + ds @ k
+        return dq + _dot_f32(ds.astype(k.dtype), k, ((1,), (0,)))
 
     if causal:
         q_end = (q_idx.astype(jnp.int32) + jnp.int32(1)) * jnp.int32(block_q)

@@ -32,6 +32,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
@@ -55,6 +56,18 @@ class HybridParallelConfig:
                                       # pipeline_parallel.py:684 schedule) or
                                       # "gpipe" (scan + jax.grad transpose)
     remat: bool = True
+    remat_policy: str = "attn"        # "full" = recompute everything;
+                                      # "attn" = save attention outputs
+                                      # (skips re-running the flash fwd
+                                      # kernel inside backward)
+    zero_stage: int = 0               # 0: replicate opt state over dp;
+                                      # >=1: ZeRO — shard Adam m/v over dp,
+                                      # reduce-scatter grads, allgather the
+                                      # updated param shards (the reference's
+                                      # DygraphShardingOptimizer /
+                                      # GroupShardedStage2 semantics,
+                                      # dygraph_sharding_optimizer.py:54,
+                                      # group_sharded_stage2.py:47)
     dtype: Any = jnp.float32          # activation/param dtype (bf16 on TPU)
     lr: float = 1e-3
     betas: tuple = (0.9, 0.95)
@@ -133,8 +146,47 @@ def param_specs(hp: HybridParallelConfig):
     }
 
 
-def opt_state_specs(hp):
+def _zero_dim(shape, spec, dp):
+    """First dim not already mesh-sharded whose (local) size divides by dp —
+    the dim ZeRO shards optimizer state / scatters grads along (-1: none)."""
+    for d in range(len(shape)):
+        ax = spec[d] if d < len(spec) else None
+        if ax is None and shape[d] % dp == 0:
+            return d
+    return -1
+
+
+def zero_dims(hp, shapes):
+    """Pytree of ZeRO shard dims (-1 = keep replicated) for a shape tree."""
     ps = param_specs(hp)
+    if hp.zero_stage < 1 or hp.dp <= 1:
+        return jax.tree.map(lambda s: -1, ps,
+                            is_leaf=lambda x: isinstance(x, P))
+    return jax.tree.map(
+        lambda spec, s: _zero_dim(tuple(s.shape), spec, hp.dp),
+        ps, shapes, is_leaf=lambda x: isinstance(x, P))
+
+
+def opt_state_specs(hp, shapes=None):
+    """m/v placement; with zero_stage>=1 (and shapes given) Adam moments are
+    additionally sharded over dp — per-chip optimizer bytes drop ~dp x
+    (the reference's DygraphShardingOptimizer partition,
+    dygraph_sharding_optimizer.py:54)."""
+    ps = param_specs(hp)
+    if hp.zero_stage >= 1 and hp.dp > 1 and shapes is not None:
+        zd = zero_dims(hp, shapes)
+
+        def mv_spec(spec, s, d):
+            if d < 0:
+                return spec
+            parts = list(spec) + [None] * (len(s.shape) - len(spec))
+            parts[d] = "dp"
+            return P(*parts)
+
+        mv = jax.tree.map(lambda spec, s, d: mv_spec(spec, s, d),
+                          ps, shapes, zd,
+                          is_leaf=lambda x: isinstance(x, P))
+        return {"m": mv, "v": mv, "step": P()}
     return {"m": ps, "v": ps, "step": P()}
 
 
@@ -202,6 +254,10 @@ def _make_block(cfg: LlamaConfig, hp: HybridParallelConfig):
             att = ring_attention(q, k, v, "cp", causal=True)
         else:
             att = _attention(q, k, v)
+        # named so the "attn" remat policy can SAVE attention outputs:
+        # under full per-block remat the flash kernel's forward would run
+        # again in backward on top of its own lse-based recompute
+        att = checkpoint_name(att, "attn_out")
         att = att.reshape(m_, s, n_heads_local * head_dim)
         o_partial = jnp.einsum("msk,kh->msh", att, p["wo"])  # partial over tp
         o = lax.psum_scatter(o_partial, "tp", scatter_dimension=1, tiled=True)
@@ -241,8 +297,10 @@ def _vocab_parallel_xent(h, head, labels, cfg, pos_weight=None,
     (reference ParallelCrossEntropy, mp_ops.py).  pos_weight [S] masks
     positions out of the mean (e.g. the final position of a shifted
     next-token objective, which has no valid target)."""
-    logits = jnp.einsum("msh,hv->msv", h.astype(jnp.float32),
-                        head.astype(jnp.float32))
+    # bf16 operands at full MXU rate with f32 accumulation — an f32 x f32
+    # matmul here (the model's largest) would run at a fraction of peak
+    logits = jnp.einsum("msh,hv->msv", h, head,
+                        preferred_element_type=jnp.float32)
     v_local = logits.shape[-1]
     tp_idx = lax.axis_index("tp")
     lo = tp_idx * v_local
@@ -281,7 +339,9 @@ def _stage_apply(params, tok_mb, act_in, cfg, hp):
     """
     block = _make_block(cfg, hp)
     if hp.remat:
-        block = jax.checkpoint(block)
+        policy = (jax.checkpoint_policies.save_only_these_names("attn_out")
+                  if getattr(hp, "remat_policy", "attn") == "attn" else None)
+        block = jax.checkpoint(block, policy=policy)
     stage = lax.axis_index("pp")
     S = tok_mb.shape[1]
     S_cp = S // hp.cp                 # this cp rank's contiguous seq slice
@@ -437,24 +497,29 @@ def _value_and_grad_1f1b(params, tokens, cfg, hp):
     return loss, gparams
 
 
-def _adamw_update(params, grads, opt_state, hp):
+def _adamw_update(params, grads, opt_state, hp, zdims=None):
     b1, b2 = hp.betas
     step = opt_state["step"] + 1
     bc1 = 1.0 - b1 ** step.astype(jnp.float32)
     bc2 = 1.0 - b2 ** step.astype(jnp.float32)
+    zero_on = zdims is not None and hp.zero_stage >= 1 and hp.dp > 1
 
     # Exact global grad-norm clip (matches ClipGradByGlobalNorm across the
     # hybrid topology, hybrid_parallel_optimizer.py:536 in the reference):
     # each leaf contributes its LOCAL shard's sumsq psum'd over exactly the
-    # mesh axes it is sharded on, so every device — and every dp/pp/tp
+    # mesh axes it is sharded on, so every device — and every dp/pp/tp/zero
     # configuration — sees the same global norm.
     specs = param_specs(hp)
     flat_gs, _ = jax.tree.flatten(grads)
     flat_specs, _ = jax.tree.flatten(specs, is_leaf=lambda x: isinstance(x, P))
+    flat_zd = (jax.tree.leaves(zdims) if zdims is not None
+               else [-1] * len(flat_gs))
     sumsq = jnp.zeros((), jnp.float32)
-    for g, spec in zip(flat_gs, flat_specs):
+    for g, spec, zd in zip(flat_gs, flat_specs, flat_zd):
         local = jnp.sum(g.astype(jnp.float32) ** 2)
         axes = tuple(a for a in spec if a is not None)
+        if zero_on and zd >= 0:
+            axes = axes + ("dp",)  # grad is a distinct dp shard under ZeRO
         if axes:
             local = lax.psum(local, axes)
         sumsq = sumsq + local
@@ -462,7 +527,7 @@ def _adamw_update(params, grads, opt_state, hp):
     scale = jnp.minimum(1.0, hp.grad_clip_norm / (gnorm + 1e-6)) \
         if hp.grad_clip_norm else 1.0
 
-    def upd(p, g, m, v):
+    def adam(p, g, m, v):
         gf = g.astype(jnp.float32) * scale
         m2 = b1 * m + (1 - b1) * gf
         v2 = b2 * v + (1 - b2) * gf * gf
@@ -472,27 +537,53 @@ def _adamw_update(params, grads, opt_state, hp):
             pf = pf * (1.0 - hp.lr * hp.weight_decay)
         return (pf - hp.lr * upd_).astype(p.dtype), m2, v2
 
+    def upd(p, g, m, v, zd):
+        if not (zero_on and zd >= 0):
+            return adam(p, g, m, v)
+        # ZeRO: update only this dp rank's param shard with its grad/moment
+        # shards, then allgather the updated shards (the reference's
+        # stage-1/2 step: reduce_scatter -> local adam -> param allgather,
+        # dygraph_sharding_optimizer.py:592)
+        sz = p.shape[zd] // hp.dp
+        idx = lax.axis_index("dp") * sz
+        p_shard = lax.dynamic_slice_in_dim(p, idx, sz, axis=zd)
+        new_shard, m2, v2 = adam(p_shard, g, m, v)
+        new_p = lax.all_gather(new_shard, "dp", axis=zd, tiled=True)
+        return new_p, m2, v2
+
     flat_p, treedef = jax.tree.flatten(params)
     flat_g = jax.tree.leaves(grads)
     flat_m = jax.tree.leaves(opt_state["m"])
     flat_v = jax.tree.leaves(opt_state["v"])
-    out = [upd(p, g, m, v) for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v)]
+    out = [upd(p, g, m, v, zd) for p, g, m, v, zd
+           in zip(flat_p, flat_g, flat_m, flat_v, flat_zd)]
     new_p = jax.tree.unflatten(treedef, [o[0] for o in out])
     new_m = jax.tree.unflatten(treedef, [o[1] for o in out])
     new_v = jax.tree.unflatten(treedef, [o[2] for o in out])
     return new_p, {"m": new_m, "v": new_v, "step": step}
 
 
-def _reduce_grads(grads, hp):
+def _reduce_grads(grads, hp, zdims=None):
     """Cross-axis gradient reductions the manual-SPMD forward leaves pending:
-    - dp: every param is replicated over dp -> pmean
+    - dp: every param is replicated over dp -> pmean; under ZeRO
+      (hp.zero_stage>=1) shardable leaves instead REDUCE-SCATTER over dp —
+      each dp rank keeps only its grad shard (the reference's stage-2
+      reduce_scatter, group_sharded_stage2.py:47)
     - pp: embed/head/norm_f are replicated over pp but only some stages
       produce nonzero grads -> psum
     - tp: norm weights (used in the sequence-sharded region) are replicated
       over tp with partial grads -> psum  (the reference's SP
       allreduce hooks, sequence_parallel_utils.py:192)
     """
-    grads = jax.tree.map(lambda g: lax.pmean(g, "dp"), grads)
+    if zdims is not None and hp.zero_stage >= 1 and hp.dp > 1:
+        def red(g, d):
+            if d < 0:
+                return lax.pmean(g, "dp")
+            return lax.psum_scatter(g, "dp", scatter_dimension=d,
+                                    tiled=True) / hp.dp
+        grads = jax.tree.map(red, grads, zdims)
+    else:
+        grads = jax.tree.map(lambda g: lax.pmean(g, "dp"), grads)
     if hp.cp > 1:
         # every param is replicated over cp; each cp rank saw only its
         # sequence slice -> grads are partial sums over cp
@@ -512,7 +603,9 @@ def build_train_step(cfg: LlamaConfig, hp: HybridParallelConfig, mesh: Mesh):
     program; parameter/optimizer buffers are donated.
     """
     ps = param_specs(hp)
-    os_specs = {"m": ps, "v": ps, "step": P()}
+    shapes = jax.eval_shape(lambda: init_params(cfg, hp, 0))
+    os_specs = opt_state_specs(hp, shapes)
+    zd = zero_dims(hp, shapes)
 
     def sharded_step(params, opt_state, tokens):
         # tokens arrive [M*m_local, S]; regroup into microbatches
@@ -524,9 +617,9 @@ def build_train_step(cfg: LlamaConfig, hp: HybridParallelConfig, mesh: Mesh):
         else:
             loss, grads = jax.value_and_grad(
                 lambda p: _forward_loss(p, tokens, cfg, hp))(params)
-        grads = _reduce_grads(grads, hp)
+        grads = _reduce_grads(grads, hp, zd)
         loss = lax.pmean(loss, "dp")
-        new_params, new_opt = _adamw_update(params, grads, opt_state, hp)
+        new_params, new_opt = _adamw_update(params, grads, opt_state, hp, zd)
         return new_params, new_opt, loss
 
     tok_spec = P("dp", None)
@@ -546,7 +639,7 @@ def shard_params(params, hp, mesh):
 
 
 def shard_opt_state(opt_state, hp, mesh):
-    specs = {"m": param_specs(hp), "v": param_specs(hp), "step": P()}
+    specs = opt_state_specs(hp, opt_state["m"])
     return jax.tree.map(
         lambda t, s: jax.device_put(t, NamedSharding(mesh, s)),
         opt_state, specs, is_leaf=lambda x: isinstance(x, jnp.ndarray))

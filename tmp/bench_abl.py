@@ -1,0 +1,38 @@
+import os, sys, time, json
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+from paddle_tpu.models.llama import LlamaConfig
+from paddle_tpu.parallel import (HybridParallelConfig, build_mesh, build_train_step,
+                                 init_opt_state, init_params, shard_opt_state, shard_params)
+import paddle_tpu.parallel.transformer as T
+
+variant = sys.argv[1]
+batch = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                  num_hidden_layers=24, num_attention_heads=16, num_key_value_heads=16,
+                  max_position_embeddings=2048)
+seq, steps = 2048, 6
+remat = variant != "noremat"
+if variant == "noflash":
+    from paddle_tpu.core.flags import set_flags
+    set_flags({"use_pallas_kernels": False})
+if variant == "nohead":
+    def _xent_stub(h, head, labels, cfg, pos_weight=None, reduction="mean"):
+        s = jnp.sum(h.astype(jnp.float32) ** 2)
+        if reduction == "sumcount":
+            return s, jnp.float32(h.shape[0] * h.shape[1])
+        return s
+    T._vocab_parallel_xent = _xent_stub
+hp = HybridParallelConfig(dp=1, pp=1, tp=1, num_microbatches=1, remat=remat, dtype=jnp.bfloat16)
+mesh = build_mesh(hp)
+params = shard_params(init_params(cfg, hp, seed=0), hp, mesh)
+opt = shard_opt_state(init_opt_state(params), hp, mesh)
+step = build_train_step(cfg, hp, mesh)
+tokens = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+params, opt, loss = step(params, opt, tokens); float(loss)
+t0 = time.perf_counter()
+for _ in range(steps):
+    params, opt, loss = step(params, opt, tokens)
+float(loss)
+dt = time.perf_counter() - t0
+print(json.dumps({"variant": variant, "batch": batch, "tokps": round(batch*seq*steps/dt,1)}))
