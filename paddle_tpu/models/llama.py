@@ -27,6 +27,12 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-6
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
+    # MoE (0 = dense SwiGLU FFN). When set, every layer's FFN becomes a
+    # top-k gated expert mixture (reference moe_layer.py architecture).
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    moe_aux_weight: float = 0.01
 
     @staticmethod
     def llama_7b():

@@ -56,10 +56,17 @@ class HybridParallelConfig:
                                       # pipeline_parallel.py:684 schedule) or
                                       # "gpipe" (scan + jax.grad transpose)
     remat: bool = True
-    remat_policy: str = "attn"        # "full" = recompute everything;
+    remat_policy: str = "full"        # "full" = recompute everything
+                                      # (hardware-validated default);
                                       # "attn" = save attention outputs
                                       # (skips re-running the flash fwd
                                       # kernel inside backward)
+    ep: int = 1                       # expert parallel: 1 (experts local /
+                                      # replicated) or == dp (experts sharded
+                                      # over the dp axis, tokens exchanged by
+                                      # all_to_all — the reference's
+                                      # global_scatter/global_gather EP,
+                                      # moe_layer.py)
     zero_stage: int = 0               # 0: replicate opt state over dp;
                                       # >=1: ZeRO — shard Adam m/v over dp,
                                       # reduce-scatter grads, allgather the
@@ -101,12 +108,28 @@ def init_params(cfg: LlamaConfig, hp: HybridParallelConfig, seed=0):
     H, F, V, L = (cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size,
                   cfg.num_hidden_layers)
     dt = hp.dtype
+    # GQA: wk/wv project to num_key_value_heads * head_dim
+    # (reference flash_attention.py:358 GQA surface)
+    Hkv = cfg.num_key_value_heads * (H // cfg.num_attention_heads)
 
     def normal(key, shape, scale):
         return (scale * jax.random.normal(key, shape, jnp.float32)).astype(dt)
 
-    keys = jax.random.split(k, 10)
+    keys = jax.random.split(k, 12)
     s = 0.02
+    if cfg.moe_experts:
+        E = cfg.moe_experts
+        ffn = {
+            "moe_gate": s * jax.random.normal(keys[6], (L, H, E), jnp.float32),
+            "moe_w_in": normal(keys[7], (L, E, H, F), s),
+            "moe_w_out": normal(keys[8], (L, E, F, H), s / math.sqrt(2 * L)),
+        }
+    else:
+        ffn = {
+            "w_gate": normal(keys[6], (L, H, F), s),
+            "w_up": normal(keys[7], (L, H, F), s),
+            "w_down": normal(keys[8], (L, F, H), s / math.sqrt(2 * L)),
+        }
     params = {
         "embed": normal(keys[0], (V, H), s),
         "norm_f": jnp.ones((H,), dt),
@@ -114,20 +137,30 @@ def init_params(cfg: LlamaConfig, hp: HybridParallelConfig, seed=0):
         "layers": {
             "ln1": jnp.ones((L, H), dt),
             "wq": normal(keys[2], (L, H, H), s),
-            "wk": normal(keys[3], (L, H, H), s),
-            "wv": normal(keys[4], (L, H, H), s),
+            "wk": normal(keys[3], (L, H, Hkv), s),
+            "wv": normal(keys[4], (L, H, Hkv), s),
             "wo": normal(keys[5], (L, H, H), s / math.sqrt(2 * L)),
             "ln2": jnp.ones((L, H), dt),
-            "w_gate": normal(keys[6], (L, H, F), s),
-            "w_up": normal(keys[7], (L, H, F), s),
-            "w_down": normal(keys[8], (L, F, H), s / math.sqrt(2 * L)),
+            **ffn,
         },
     }
     return params
 
 
-def param_specs(hp: HybridParallelConfig):
-    """PartitionSpecs for the param pytree over Mesh('pp','dp','tp')."""
+def param_specs(hp: HybridParallelConfig, moe: bool = False):
+    """PartitionSpecs for the param pytree over Mesh('pp','dp','cp','tp')."""
+    ep_ax = "dp" if hp.ep > 1 else None
+    ffn = ({
+        # experts stacked on dim 1, sharded over the dp axis under EP;
+        # expert FFN dim sharded over tp like the dense FFN
+        "moe_gate": P("pp", None, None),
+        "moe_w_in": P("pp", ep_ax, None, "tp"),
+        "moe_w_out": P("pp", ep_ax, "tp", None),
+    } if moe else {
+        "w_gate": P("pp", None, "tp"),
+        "w_up": P("pp", None, "tp"),
+        "w_down": P("pp", "tp", None),
+    })
     return {
         "embed": P("tp", None),            # vocab-parallel
         "norm_f": P(),
@@ -139,16 +172,23 @@ def param_specs(hp: HybridParallelConfig):
             "wv": P("pp", None, "tp"),
             "wo": P("pp", "tp", None),
             "ln2": P("pp", None),
-            "w_gate": P("pp", None, "tp"),
-            "w_up": P("pp", None, "tp"),
-            "w_down": P("pp", "tp", None),
+            **ffn,
         },
     }
 
 
+def _is_moe_tree(tree) -> bool:
+    layers = tree.get("layers", {}) if isinstance(tree, dict) else {}
+    return "moe_w_in" in layers
+
+
 def _zero_dim(shape, spec, dp):
     """First dim not already mesh-sharded whose (local) size divides by dp —
-    the dim ZeRO shards optimizer state / scatters grads along (-1: none)."""
+    the dim ZeRO shards optimizer state / scatters grads along (-1: none).
+    Params already sharded over dp (EP expert weights) stay as-is: their
+    optimizer state is dp-local by construction."""
+    if "dp" in tuple(spec):
+        return -1
     for d in range(len(shape)):
         ax = spec[d] if d < len(spec) else None
         if ax is None and shape[d] % dp == 0:
@@ -158,7 +198,7 @@ def _zero_dim(shape, spec, dp):
 
 def zero_dims(hp, shapes):
     """Pytree of ZeRO shard dims (-1 = keep replicated) for a shape tree."""
-    ps = param_specs(hp)
+    ps = param_specs(hp, _is_moe_tree(shapes))
     if hp.zero_stage < 1 or hp.dp <= 1:
         return jax.tree.map(lambda s: -1, ps,
                             is_leaf=lambda x: isinstance(x, P))
@@ -172,7 +212,7 @@ def opt_state_specs(hp, shapes=None):
     additionally sharded over dp — per-chip optimizer bytes drop ~dp x
     (the reference's DygraphShardingOptimizer partition,
     dygraph_sharding_optimizer.py:54)."""
-    ps = param_specs(hp)
+    ps = param_specs(hp, _is_moe_tree(shapes) if shapes is not None else False)
     if hp.zero_stage >= 1 and hp.dp > 1 and shapes is not None:
         zd = zero_dims(hp, shapes)
 
@@ -233,27 +273,32 @@ def _attention(q, k, v):
 
 def _make_block(cfg: LlamaConfig, hp: HybridParallelConfig):
     n_heads_local = cfg.num_attention_heads // hp.tp
+    n_kv_local = cfg.num_key_value_heads // hp.tp
     head_dim = cfg.hidden_size // cfg.num_attention_heads
 
     def block(x, p):
         # x: [m, S_cp/tp, H] sequence-sharded over tp (SP region) of this
-        # cp rank's contiguous sequence slice
+        # cp rank's contiguous sequence slice.  Returns (x, aux_loss).
         pos0 = lax.axis_index("cp") * (x.shape[1] * hp.tp)  # S_cp per rank
         h = _rms(x, p["ln1"], cfg.rms_norm_eps)
         h = lax.all_gather(h, "tp", axis=1, tiled=True)      # -> [m, S_cp, H]
         q = jnp.einsum("msh,hk->msk", h, p["wq"])            # [m, S_cp, H/tp]
-        k = jnp.einsum("msh,hk->msk", h, p["wk"])
+        k = jnp.einsum("msh,hk->msk", h, p["wk"])            # GQA: Hkv/tp
         v = jnp.einsum("msh,hk->msk", h, p["wv"])
         m_, s = q.shape[0], q.shape[1]
         q = q.reshape(m_, s, n_heads_local, head_dim)
-        k = k.reshape(m_, s, n_heads_local, head_dim)
-        v = v.reshape(m_, s, n_heads_local, head_dim)
+        k = k.reshape(m_, s, n_kv_local, head_dim)
+        v = v.reshape(m_, s, n_kv_local, head_dim)
         q = _rope(q, cfg.rope_theta, pos0)
         k = _rope(k, cfg.rope_theta, pos0)
         if hp.cp > 1:
+            if n_kv_local < n_heads_local:   # ring kernel wants equal heads
+                from ..ops.pallas.flash_attention import _repeat_kv
+                rep = n_heads_local // n_kv_local
+                k, v = _repeat_kv(k, rep), _repeat_kv(v, rep)
             att = ring_attention(q, k, v, "cp", causal=True)
         else:
-            att = _attention(q, k, v)
+            att = _attention(q, k, v)        # GQA-aware kernel dispatch
         # named so the "attn" remat policy can SAVE attention outputs:
         # under full per-block remat the flash kernel's forward would run
         # again in backward on top of its own lse-based recompute
@@ -265,12 +310,26 @@ def _make_block(cfg: LlamaConfig, hp: HybridParallelConfig):
 
         h2 = _rms(x, p["ln2"], cfg.rms_norm_eps)
         h2 = lax.all_gather(h2, "tp", axis=1, tiled=True)
-        g = jnp.einsum("msh,hf->msf", h2, p["w_gate"])
-        u = jnp.einsum("msh,hf->msf", h2, p["w_up"])
-        a = jax.nn.silu(g.astype(jnp.float32)).astype(g.dtype) * u
-        d_partial = jnp.einsum("msf,fh->msh", a, p["w_down"])
+        if cfg.moe_experts:
+            from .moe import moe_ffn
+            H = h2.shape[-1]
+            xt = h2.reshape(m_ * s, H)
+            y, aux = moe_ffn(
+                xt,
+                {"gate": p["moe_gate"], "w_in": p["moe_w_in"],
+                 "w_out": p["moe_w_out"]},
+                ep_axis="dp" if hp.ep > 1 else None,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor)
+            d_partial = y.reshape(m_, s, H)  # partial over tp (F sharded)
+        else:
+            g = jnp.einsum("msh,hf->msf", h2, p["w_gate"])
+            u = jnp.einsum("msh,hf->msf", h2, p["w_up"])
+            a = jax.nn.silu(g.astype(jnp.float32)).astype(g.dtype) * u
+            d_partial = jnp.einsum("msf,fh->msh", a, p["w_down"])
+            aux = jnp.zeros((), jnp.float32)
         d = lax.psum_scatter(d_partial, "tp", scatter_dimension=1, tiled=True)
-        return x + d
+        return x + d, aux
 
     return block
 
@@ -335,7 +394,8 @@ def _stage_apply(params, tok_mb, act_in, cfg, hp):
     act_in: [m, s_loc, H] activation arriving from the previous stage
     (ignored on stage 0 via the `where`, so its cotangent is exactly zero
     there — which is what closes the backward ppermute ring).
-    Returns (act_out [m, s_loc, H], mb_loss f32 — meaningful on last stage).
+    Returns (act_out [m, s_loc, H], mb_loss f32 — xent meaningful on the
+    last stage, plus THIS stage's MoE aux loss on every stage).
     """
     block = _make_block(cfg, hp)
     if hp.remat:
@@ -351,10 +411,15 @@ def _stage_apply(params, tok_mb, act_in, cfg, hp):
     fresh = _vocab_parallel_embed(tok_cp, params["embed"], cfg, hp)
     inp = jnp.where(stage == 0, fresh, act_in)
 
-    def body(x, pl):
-        return block(x, pl), None
+    def body(carry, pl):
+        x, aux_acc = carry
+        x, aux = block(x, pl)
+        return (x, aux_acc + aux), None
 
-    out, _ = lax.scan(body, inp, params["layers"])
+    (out, aux_total), _ = lax.scan(
+        body, (inp, jnp.zeros((), jnp.float32)), params["layers"])
+    if cfg.moe_experts:
+        aux_total = _aux_consistent(aux_total, hp)
 
     hN = _rms(out, params["norm_f"], cfg.rms_norm_eps)
     h_full = lax.all_gather(hN, "tp", axis=1, tiled=True)  # [m, S_cp, H]
@@ -368,7 +433,26 @@ def _stage_apply(params, tok_mb, act_in, cfg, hp):
         ws = lax.psum(ws, "cp")
         wc = lax.psum(wc, "cp")
     mb_loss = ws / jnp.maximum(wc, 1.0)
-    return out, mb_loss
+    return out, mb_loss, aux_total
+
+
+def _aux_consistent(aux, hp):
+    """Make the MoE aux loss consistent across tp/cp ranks in BOTH value and
+    gradient.
+
+    Value: the aux objective is the cp-MEAN of per-slice aux (identical on
+    every rank, so the step's replicated loss output is well-defined).
+    Gradient: gating runs on tp-replicated tokens, so a naive per-rank aux
+    term would be counted tp times once grads are summed by the collective
+    transposes (and _reduce_grads psums over cp).  The differentiable share
+    is therefore masked to tp rank 0 and scaled 1/cp; the remaining value
+    rides through stop_gradient.
+    """
+    gshare = aux / hp.cp
+    if hp.tp > 1:
+        gshare = jnp.where(lax.axis_index("tp") == 0, gshare, 0.0)
+    value = lax.pmean(aux, "cp") if hp.cp > 1 else aux
+    return gshare + lax.stop_gradient(value - gshare)
 
 
 def _pcast_all(x):
@@ -395,9 +479,12 @@ def _forward_loss(params, tokens, cfg, hp):
         act, acc_loss = carry
         mb = jnp.clip(t - stage, 0, M - 1)
         tok_mb = lax.dynamic_index_in_dim(tokens, mb, axis=0, keepdims=False)
-        out, mb_loss = _stage_apply(params, tok_mb, act, cfg, hp)
-        valid = ((t - stage) >= 0) & ((t - stage) < M) & (stage == pp - 1)
-        acc_loss = acc_loss + jnp.where(valid, mb_loss, 0.0)
+        out, mb_loss, aux = _stage_apply(params, tok_mb, act, cfg, hp)
+        f_ok = ((t - stage) >= 0) & ((t - stage) < M)
+        valid = f_ok & (stage == pp - 1)
+        # each stage owns its layers' MoE aux loss on every real microbatch
+        acc_loss = acc_loss + jnp.where(valid, mb_loss, 0.0) \
+            + jnp.where(f_ok, cfg.moe_aux_weight * aux, 0.0)
         act_next = lax.ppermute(out, "pp", perm) if pp > 1 else out
         return (act_next, acc_loss), None
 
@@ -407,7 +494,8 @@ def _forward_loss(params, tokens, cfg, hp):
                                     jnp.arange(M + pp - 1))
     loss = total_loss / M
     # every stage needs the same loss value out (grads already flow via
-    # ppermute transpose); sum over pp puts the last stage's loss everywhere
+    # ppermute transpose); sum over pp combines the last stage's xent with
+    # every stage's aux term
     loss = lax.psum(loss, "pp")
     return loss
 
@@ -453,8 +541,9 @@ def _value_and_grad_1f1b(params, tokens, cfg, hp):
         f_ok = (f >= 0) & (f < M)
         fc = jnp.clip(f, 0, M - 1)
         tok_f = lax.dynamic_index_in_dim(tokens, fc, axis=0, keepdims=False)
-        out, mb_loss = sf(params, tok_f, act)
-        loss_acc = loss_acc + jnp.where(f_ok & (stage == pp - 1), mb_loss, 0.0)
+        out, mb_loss, aux = sf(params, tok_f, act)
+        loss_acc = loss_acc + jnp.where(f_ok & (stage == pp - 1), mb_loss, 0.0) \
+            + jnp.where(f_ok, cfg.moe_aux_weight * aux, 0.0)
         # save the stage INPUT for the backward recompute (ring slot)
         slots = jnp.where(
             f_ok,
@@ -470,13 +559,16 @@ def _value_and_grad_1f1b(params, tokens, cfg, hp):
         a_in = lax.dynamic_index_in_dim(slots, bc % nslots, axis=0,
                                         keepdims=False)
         _, vjp = jax.vjp(lambda p, a: sf(p, tok_b, a), params, a_in)
-        # cotangents: the loss seed lands on the last stage only; the
-        # activation cotangent is whatever the next stage sent last step
-        # (stage 0's act_in cotangent is structurally zero, so the ring
-        # delivers zeros to the last stage for free).
+        # cotangents: the xent loss seed lands on the last stage only; every
+        # stage seeds its own MoE aux term; the activation cotangent is
+        # whatever the next stage sent last step (stage 0's act_in cotangent
+        # is structurally zero, so the ring delivers zeros to the last stage
+        # for free).
         g_loss = jnp.where(b_ok & (stage == pp - 1),
                            jnp.float32(1.0 / M), jnp.float32(0.0))
-        gp, ga = vjp((gact, g_loss))
+        g_aux = jnp.where(b_ok, jnp.float32(cfg.moe_aux_weight / M),
+                          jnp.float32(0.0))
+        gp, ga = vjp((gact, g_loss, g_aux))
         gparams = jax.tree.map(
             lambda acc, g: acc + jnp.where(b_ok, g.astype(acc.dtype), 0.0),
             gparams, gp)
@@ -509,7 +601,7 @@ def _adamw_update(params, grads, opt_state, hp, zdims=None):
     # each leaf contributes its LOCAL shard's sumsq psum'd over exactly the
     # mesh axes it is sharded on, so every device — and every dp/pp/tp/zero
     # configuration — sees the same global norm.
-    specs = param_specs(hp)
+    specs = param_specs(hp, _is_moe_tree(grads))
     flat_gs, _ = jax.tree.flatten(grads)
     flat_specs, _ = jax.tree.flatten(specs, is_leaf=lambda x: isinstance(x, P))
     flat_zd = (jax.tree.leaves(zdims) if zdims is not None
@@ -575,15 +667,25 @@ def _reduce_grads(grads, hp, zdims=None):
       over tp with partial grads -> psum  (the reference's SP
       allreduce hooks, sequence_parallel_utils.py:192)
     """
-    if zdims is not None and hp.zero_stage >= 1 and hp.dp > 1:
-        def red(g, d):
-            if d < 0:
-                return lax.pmean(g, "dp")
+    specs = param_specs(hp, _is_moe_tree(grads))
+    if zdims is None:
+        zdims = jax.tree.map(lambda s: -1, specs,
+                             is_leaf=lambda x: isinstance(x, P))
+
+    def red(g, d, spec):
+        if "dp" in tuple(spec):
+            # dp-sharded leaf (EP expert weights): the all_to_all transpose
+            # already delivered the cross-rank sum; the global objective is
+            # the dp-MEAN of per-rank losses, so scale only
+            return g / hp.dp
+        if hp.zero_stage >= 1 and hp.dp > 1 and d >= 0:
             return lax.psum_scatter(g, "dp", scatter_dimension=d,
                                     tiled=True) / hp.dp
-        grads = jax.tree.map(red, grads, zdims)
-    else:
-        grads = jax.tree.map(lambda g: lax.pmean(g, "dp"), grads)
+        return lax.pmean(g, "dp")
+
+    grads = jax.tree.map(lambda spec, g, d: red(g, d, spec),
+                         specs, grads, zdims,
+                         is_leaf=lambda x: isinstance(x, P))
     if hp.cp > 1:
         # every param is replicated over cp; each cp rank saw only its
         # sequence slice -> grads are partial sums over cp
@@ -593,6 +695,12 @@ def _reduce_grads(grads, hp, zdims=None):
     grads["norm_f"] = lax.psum(grads["norm_f"], "tp")
     grads["layers"]["ln1"] = lax.psum(grads["layers"]["ln1"], "tp")
     grads["layers"]["ln2"] = lax.psum(grads["layers"]["ln2"], "tp")
+    if "moe_gate" in grads["layers"]:
+        # tp-replicated gate: the combine-path grad is a partial sum over tp
+        # (expert outputs are F-sharded); the aux-path grad contributes once
+        # (masked to tp rank 0 in _aux_consistent) -> psum completes both
+        grads["layers"]["moe_gate"] = lax.psum(
+            grads["layers"]["moe_gate"], "tp")
     return grads
 
 
@@ -602,7 +710,20 @@ def build_train_step(cfg: LlamaConfig, hp: HybridParallelConfig, mesh: Mesh):
     tokens: GLOBAL [dp * M * m, S] int32.  The whole step is one jitted
     program; parameter/optimizer buffers are donated.
     """
-    ps = param_specs(hp)
+    if cfg.num_key_value_heads % hp.tp:
+        raise ValueError(
+            f"num_key_value_heads={cfg.num_key_value_heads} must divide by "
+            f"tp={hp.tp} (kv heads are sharded over tp)")
+    if hp.ep not in (1, hp.dp):
+        raise ValueError(
+            f"ep must be 1 or equal to dp (experts ride the dp axis); "
+            f"got ep={hp.ep}, dp={hp.dp}")
+    if hp.ep > 1 and not cfg.moe_experts:
+        raise ValueError("ep > 1 requires cfg.moe_experts > 0")
+    if cfg.moe_experts and hp.ep > 1 and cfg.moe_experts % hp.ep:
+        raise ValueError(
+            f"moe_experts={cfg.moe_experts} must divide by ep={hp.ep}")
+    ps = param_specs(hp, cfg.moe_experts > 0)
     shapes = jax.eval_shape(lambda: init_params(cfg, hp, 0))
     os_specs = opt_state_specs(hp, shapes)
     zd = zero_dims(hp, shapes)
@@ -632,7 +753,7 @@ def build_train_step(cfg: LlamaConfig, hp: HybridParallelConfig, mesh: Mesh):
 
 def shard_params(params, hp, mesh):
     """Place an (unsharded) param pytree onto the mesh per param_specs."""
-    specs = param_specs(hp)
+    specs = param_specs(hp, _is_moe_tree(params))
     return jax.tree.map(
         lambda t, s: jax.device_put(t, NamedSharding(mesh, s)), params, specs,
         is_leaf=lambda x: isinstance(x, jnp.ndarray))

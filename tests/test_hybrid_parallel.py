@@ -163,3 +163,84 @@ def test_zero_with_pp_and_1f1b():
                                              num_microbatches=2,
                                              zero_stage=1))
     assert losses[-1] < losses[0]
+
+
+def test_gqa_hybrid_matches_single():
+    """GQA (kv_heads < heads) through the hybrid step must align with the
+    single-device run (reference flash_attention.py:358 GQA surface)."""
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4, ffn=64,
+                           seq=16)
+    cfg.num_key_value_heads = 2
+
+    def run(hp, B=8, steps=4):
+        mesh = build_mesh(hp)
+        params = shard_params(init_params(cfg, hp, seed=0), hp, mesh)
+        opt = shard_opt_state(init_opt_state(params), hp, mesh)
+        step = build_train_step(cfg, hp, mesh)
+        tok = jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, (B, 16)),
+            jnp.int32)
+        out = []
+        for _ in range(steps):
+            params, opt, loss = step(params, opt, tok)
+            out.append(float(loss))
+        return out
+
+    single = run(HybridParallelConfig(dp=1, pp=1, tp=1))
+    hybrid = run(HybridParallelConfig(dp=2, pp=2, tp=2, num_microbatches=2))
+    np.testing.assert_allclose(hybrid, single, atol=2e-4, rtol=2e-4)
+
+
+def test_moe_trainer_single_and_ep():
+    """MoE FFN in the flagship trainer: converges single-device, and the
+    expert-parallel (ep=dp) all_to_all path stays close to it (reference
+    moe_layer.py global_scatter/global_gather)."""
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4, ffn=64,
+                           seq=16)
+    cfg.moe_experts = 4
+
+    def run(hp, B=8, steps=4):
+        mesh = build_mesh(hp)
+        params = shard_params(init_params(cfg, hp, seed=0), hp, mesh)
+        opt = shard_opt_state(init_opt_state(params), hp, mesh)
+        step = build_train_step(cfg, hp, mesh)
+        tok = jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, (B, 16)),
+            jnp.int32)
+        out = []
+        for _ in range(steps):
+            params, opt, loss = step(params, opt, tok)
+            out.append(float(loss))
+        return out
+
+    single = run(HybridParallelConfig(dp=1, pp=1, tp=1))
+    assert single[-1] < single[0]
+    ep = run(HybridParallelConfig(dp=4, pp=1, tp=2, ep=4))
+    # capacity-based dispatch differs slightly between layouts; same model,
+    # same data, loss trajectories must track closely
+    np.testing.assert_allclose(ep, single, atol=5e-3, rtol=5e-3)
+    moe_pp = run(HybridParallelConfig(dp=2, pp=2, tp=2, ep=2,
+                                      num_microbatches=2))
+    assert np.isfinite(moe_pp).all() and moe_pp[-1] < moe_pp[0]
+
+
+def test_moe_gate_replicas_stay_identical_across_tp():
+    """The tp-replicated gate must receive a complete (psum'd) gradient —
+    a missing tp reduction silently diverges the replicas (r3 review)."""
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4, ffn=64,
+                           seq=16)
+    cfg.moe_experts = 4
+    hp = HybridParallelConfig(dp=1, pp=1, tp=2)
+    mesh = build_mesh(hp)
+    params = shard_params(init_params(cfg, hp, seed=0), hp, mesh)
+    opt = shard_opt_state(init_opt_state(params), hp, mesh)
+    step = build_train_step(cfg, hp, mesh)
+    tok = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 16)),
+        jnp.int32)
+    for _ in range(4):
+        params, opt, loss = step(params, opt, tok)
+    g = params["layers"]["moe_gate"]
+    shards = [np.asarray(s.data) for s in g.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_array_equal(shards[0], s)

@@ -1,0 +1,27 @@
+import sys, time, json
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+from paddle_tpu.models.llama import LlamaConfig
+from paddle_tpu.parallel import (HybridParallelConfig, build_mesh, build_train_step,
+                                 init_opt_state, init_params, shard_opt_state, shard_params)
+policy = sys.argv[1] if len(sys.argv) > 1 else "attn"
+cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                  num_hidden_layers=24, num_attention_heads=16, num_key_value_heads=16,
+                  max_position_embeddings=2048)
+batch, seq, steps = 8, 2048, 4
+hp = HybridParallelConfig(dp=1, pp=1, tp=1, num_microbatches=1, remat=True,
+                          remat_policy=policy, dtype=jnp.bfloat16)
+mesh = build_mesh(hp)
+params = shard_params(init_params(cfg, hp, seed=0), hp, mesh)
+opt = shard_opt_state(init_opt_state(params), hp, mesh)
+step = build_train_step(cfg, hp, mesh)
+tokens = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+t0 = time.perf_counter()
+params, opt, loss = step(params, opt, tokens); float(loss)
+print(f"warmup+compile: {time.perf_counter()-t0:.1f}s", flush=True)
+t0 = time.perf_counter()
+for _ in range(steps):
+    params, opt, loss = step(params, opt, tokens)
+float(loss)
+dt = time.perf_counter() - t0
+print(json.dumps({"policy": policy, "tokps": round(batch*seq*steps/dt,1)}))
