@@ -62,8 +62,11 @@ def _is_float0(g):
 
 
 def _topo_counts(roots: Sequence[GradNode]):
-    """Pending-consumer (in-degree) count per reachable node."""
+    """Pending-consumer (in-degree) count per reachable node, plus the
+    pending edge count per reachable LEAF tensor (used to fire leaf hooks
+    exactly once, on the final accumulated grad)."""
     counts: dict[int, int] = collections.defaultdict(int)
+    leaf_counts: dict[int, int] = collections.defaultdict(int)
     stack = list(roots)
     seen = set()
     while stack:
@@ -71,11 +74,17 @@ def _topo_counts(roots: Sequence[GradNode]):
         if id(node) in seen:
             continue
         seen.add(id(node))
-        for p in node.parents or ():
-            if p is not None and p._grad_node is not None:
+        mask = node.mask if node.mask is not None else (True,) * len(
+            node.parents or ())
+        for p, m in zip(node.parents or (), mask):
+            if p is None:
+                continue
+            if p._grad_node is not None:
                 counts[id(p._grad_node)] += 1
                 stack.append(p._grad_node)
-    return counts
+            elif m and not p.stop_gradient:
+                leaf_counts[id(p)] += 1
+    return counts, leaf_counts
 
 
 def backward(tensors, grad_tensors=None, retain_graph=False,
@@ -99,10 +108,40 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
     elif isinstance(grad_tensors, Tensor):
         grad_tensors = [grad_tensors]
     _capture = _capture or {}
+    global _backward_serial
+    _backward_serial += 1
 
     def tap(t, g_arr):
         if id(t) in _capture:
             _capture_out[id(t)] = _accumulate(_capture_out.get(id(t)), g_arr)
+
+    # Leaf hook semantics (matches the reference's grad-ready hooks,
+    # reducer.h:88): a leaf's hooks fire ONCE per backward, with the leaf's
+    # FULLY-ACCUMULATED gradient for this backward, at the moment its last
+    # contribution arrives (mid-backward, so comm hooks overlap with the
+    # remaining backward).  Contributions are staged in `leaf_partial` until
+    # the pending edge-count hits zero.
+    leaf_pending: dict[int, int] = {}
+    leaf_partial: dict[int, object] = {}
+    leaf_obj: dict[int, object] = {}
+    root_leaf_arrivals: list = []
+
+    def leaf_arrival(p, g_arr):
+        """g_arr may be None (missing edge); still consumes a pending slot."""
+        if not _accumulate_leaves:
+            return
+        pid = id(p)
+        if g_arr is not None:
+            leaf_partial[pid] = _accumulate(leaf_partial.get(pid), g_arr)
+            leaf_obj[pid] = p
+        leaf_pending[pid] = leaf_pending.get(pid, 0) - 1
+        if leaf_pending[pid] <= 0 and pid in leaf_partial:
+            final = leaf_partial.pop(pid)
+            for hook in p._backward_hooks:
+                res = hook(Tensor(final))
+                if res is not None:
+                    final = res._data if isinstance(res, Tensor) else res
+            p._accumulate_grad_raw(final)
 
     # Cotangent buffers per node: list aligned with node outputs.
     buffers: dict[int, list] = {}
@@ -119,17 +158,28 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
         tap(t, g_arr)
         node = t._grad_node
         if node is None:
-            if _accumulate_leaves:
-                t._accumulate_grad(g_arr)
+            root_leaf_arrivals.append((t, g_arr))
             continue
         buf = buffers.setdefault(id(node), [None] * len(node.out_meta))
         buf[t._output_index] = _accumulate(buf[t._output_index], g_arr)
         root_nodes.append(node)
 
     if not root_nodes:
+        # only root leaves: each arrival is its own final grad
+        for t, g_arr in root_leaf_arrivals:
+            leaf_pending[id(t)] = leaf_pending.get(id(t), 0) + 1
+        for t, g_arr in root_leaf_arrivals:
+            leaf_arrival(t, g_arr)
+        _run_post_backward()
         return
 
-    counts = _topo_counts(root_nodes)
+    counts, leaf_edges = _topo_counts(root_nodes)
+    for pid, n in leaf_edges.items():
+        leaf_pending[pid] = leaf_pending.get(pid, 0) + n
+    for t, g_arr in root_leaf_arrivals:
+        leaf_pending[id(t)] = leaf_pending.get(id(t), 0) + 1
+    for t, g_arr in root_leaf_arrivals:
+        leaf_arrival(t, g_arr)
     processed = set()
     ready = collections.deque()
     for n in {id(r): r for r in root_nodes}.values():
@@ -179,7 +229,8 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
             missing = g is None or _is_float0(g) or p.stop_gradient
             if not missing:
                 # non-leaf tensor hooks fire when the cotangent arrives here
-                # (leaf hooks fire inside _accumulate_grad)
+                # (leaf hooks fire once, on the final accumulated grad, in
+                # leaf_arrival)
                 if p._backward_hooks and p._grad_node is not None:
                     from .tensor import Tensor
                     for hook in p._backward_hooks:
@@ -188,8 +239,8 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
                             g = res._data if isinstance(res, Tensor) else res
                 tap(p, g)
             if p._grad_node is None:
-                if not missing and _accumulate_leaves and not p.stop_gradient:
-                    p._accumulate_grad(g)
+                if not p.stop_gradient:
+                    leaf_arrival(p, None if missing else g)
             else:
                 child = p._grad_node
                 if not missing:
@@ -202,6 +253,36 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
 
         if not retain_graph:
             node.release()
+
+    _run_post_backward()
+
+
+# -- post-backward notification (the reference's backward-done point where
+# EagerReducer finalizes unused-parameter buckets, reducer.h:88) ------------
+_backward_serial = 0
+_post_backward_callbacks: list = []
+
+
+def backward_serial() -> int:
+    """Monotonic id of the current/most-recent backward pass."""
+    return _backward_serial
+
+
+def register_post_backward_callback(cb):
+    """cb() runs after every backward() completes; returns a remover."""
+    _post_backward_callbacks.append(cb)
+
+    def remove():
+        try:
+            _post_backward_callbacks.remove(cb)
+        except ValueError:
+            pass
+    return remove
+
+
+def _run_post_backward():
+    for cb in list(_post_backward_callbacks):
+        cb()
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,

@@ -109,6 +109,11 @@ class Tensor:
             res = hook(Tensor(g_arr))
             if res is not None:
                 g_arr = res._data if isinstance(res, Tensor) else res
+        self._accumulate_grad_raw(g_arr)
+
+    def _accumulate_grad_raw(self, g_arr):
+        """Accumulate into .grad without firing hooks (the tape fires leaf
+        hooks itself, once per backward, on the final grad)."""
         if self._grad is None:
             self._grad = Tensor(g_arr, stop_gradient=True)
         else:

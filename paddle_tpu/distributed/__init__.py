@@ -16,12 +16,13 @@ __all__ = [
     "ReduceOp", "new_group", "get_group", "spawn", "ProcessMesh",
     "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer", "Shard",
     "Replicate", "Partial", "destroy_process_group", "split",
+    "all_gather_object", "reduce_scatter", "isend", "irecv",
 ]
 
 from .collective import (  # noqa: E402,F401
-    ReduceOp, all_gather, all_reduce, all_to_all, alltoall, barrier, broadcast,
-    destroy_process_group, get_group, new_group, recv, reduce, reduce_scatter,
-    scatter, send,
+    ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all, alltoall,
+    barrier, broadcast, destroy_process_group, get_group, isend, irecv,
+    new_group, recv, reduce, reduce_scatter, scatter, send,
 )
 from .parallel import (  # noqa: E402,F401
     DataParallel, ParallelEnv, get_rank, get_world_size, init_parallel_env,

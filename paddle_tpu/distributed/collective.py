@@ -155,9 +155,13 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     g = group or _world_group()
     if g.nranks <= 1:
         return tensor
+    from . import eager_comm
+    if eager_comm.available():
+        out = eager_comm.all_reduce(arr, tuple(g.ranks), int(op))
+        return _wrap_inplace(tensor, out)
     raise RuntimeError(
-        "eager cross-device all_reduce requires the tensor to live on a "
-        "sharded mesh; use shard_map/fleet captured mode or a 1-rank group")
+        "eager cross-device all_reduce requires a multi-process runtime "
+        "(init_parallel_env under distributed.launch) or captured mode")
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
@@ -177,13 +181,37 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
             tensor_list.append(tensor)
             return tensor_list
         return tensor
-    raise RuntimeError("eager cross-device all_gather requires captured mode")
+    from . import eager_comm
+    if eager_comm.available():
+        out = eager_comm.all_gather(arr, tuple(g.ranks))
+        if isinstance(tensor_list, list):
+            for i in range(g.nranks):
+                tensor_list.append(Tensor(out[i]))
+            return tensor_list
+        return Tensor(out)
+    raise RuntimeError("eager cross-device all_gather requires a "
+                       "multi-process runtime or captured mode")
 
 
 def all_gather_object(object_list, obj, group=None):
     g = group or _world_group()
     if g.nranks <= 1:
         object_list.append(obj)
+        return object_list
+    from . import eager_comm
+    if eager_comm.available():
+        import pickle
+        payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+        sizes = eager_comm.all_gather(
+            jnp.asarray([payload.size], jnp.int32), tuple(g.ranks))
+        cap = int(np.asarray(sizes).max())
+        buf = np.zeros((cap,), np.uint8)
+        buf[:payload.size] = payload
+        got = np.asarray(eager_comm.all_gather(jnp.asarray(buf),
+                                               tuple(g.ranks)))
+        for i in range(g.nranks):
+            n = int(np.asarray(sizes)[i, 0])
+            object_list.append(pickle.loads(got[i, :n].tobytes()))
         return object_list
     raise RuntimeError("all_gather_object requires multi-host runtime")
 
@@ -199,7 +227,15 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         return _wrap_inplace(tensor, out)
     if g.nranks <= 1:
         return tensor
-    raise RuntimeError("eager cross-device broadcast requires captured mode")
+    from . import eager_comm
+    if eager_comm.available():
+        src_idx = g.get_group_rank(src)
+        if src_idx < 0:
+            raise ValueError(f"src rank {src} is not in group {g.ranks}")
+        out = eager_comm.broadcast(arr, tuple(g.ranks), src_idx)
+        return _wrap_inplace(tensor, out)
+    raise RuntimeError("eager cross-device broadcast requires a "
+                       "multi-process runtime or captured mode")
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -220,7 +256,26 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         stacked = jnp.stack([_maybe_tensor(t) for t in tensor_list])
         idx = jax.lax.axis_index(ax)
         return _wrap_inplace(tensor, stacked[idx])
-    raise RuntimeError("eager cross-device scatter requires captured mode")
+    from . import eager_comm
+    if eager_comm.available():
+        # scatter = alltoall taking only src's slots: every rank contributes
+        # its (stacked) list — non-src ranks pass zeros — then broadcasts
+        # src's row and picks its own slot
+        me = g.get_group_rank(_my_rank())
+        src_idx = g.get_group_rank(src)
+        if me < 0 or src_idx < 0:
+            raise ValueError(
+                f"scatter: rank {_my_rank()} / src {src} must both be in "
+                f"group {g.ranks}")
+        if tensor_list is not None:
+            stack = jnp.stack([jnp.asarray(_maybe_tensor(t))
+                               for t in tensor_list])
+        else:
+            stack = jnp.stack([jnp.zeros_like(arr)] * g.nranks)
+        row = eager_comm.broadcast(stack, tuple(g.ranks), src_idx)
+        return _wrap_inplace(tensor, row[me])
+    raise RuntimeError("eager cross-device scatter requires a "
+                       "multi-process runtime or captured mode")
 
 
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -236,7 +291,13 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=Tru
         if tensor_list:
             tensor.set_value(tensor_list[0])
         return tensor
-    raise RuntimeError("eager cross-device reduce_scatter requires captured mode")
+    from . import eager_comm
+    if eager_comm.available():
+        stack = jnp.stack([jnp.asarray(a) for a in arrs])
+        out = eager_comm.reduce_scatter(stack, tuple(g.ranks), int(op))
+        return _wrap_inplace(tensor, out)
+    raise RuntimeError("eager cross-device reduce_scatter requires a "
+                       "multi-process runtime or captured mode")
 
 
 def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
@@ -253,7 +314,15 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     if g.nranks <= 1:
         out_tensor_list.extend(in_tensor_list)
         return out_tensor_list
-    raise RuntimeError("eager cross-device alltoall requires captured mode")
+    from . import eager_comm
+    if eager_comm.available():
+        stack = jnp.stack([jnp.asarray(a) for a in arrs])
+        out = eager_comm.all_to_all(stack, tuple(g.ranks))
+        for i in range(g.nranks):
+            out_tensor_list.append(Tensor(out[i]))
+        return out_tensor_list
+    raise RuntimeError("eager cross-device alltoall requires a "
+                       "multi-process runtime or captured mode")
 
 
 all_to_all = alltoall
@@ -272,7 +341,17 @@ def send(tensor, dst=0, group=None, sync_op=True):
     if g.nranks <= 1:
         _p2p_buffer.append(np.asarray(arr))
         return tensor
-    raise RuntimeError("eager cross-device send requires captured mode")
+    from . import eager_comm
+    if eager_comm.available():
+        # 2-sided p2p: src and dst both enter the pair program (NCCL-style);
+        # the pair group is (me, dst)
+        me = _my_rank()
+        eager_comm.p2p(arr, (min(me, dst), max(me, dst)),
+                       src_index=(0 if me < dst else 1),
+                       dst_index=(1 if me < dst else 0))
+        return tensor
+    raise RuntimeError("eager cross-device send requires a multi-process "
+                       "runtime or captured mode")
 
 
 _p2p_buffer: list = []
@@ -284,7 +363,16 @@ def recv(tensor, src=0, group=None, sync_op=True):
         if _p2p_buffer:
             tensor.set_value(_p2p_buffer.pop(0))
         return tensor
-    raise RuntimeError("eager cross-device recv requires captured mode")
+    from . import eager_comm
+    if eager_comm.available():
+        me = _my_rank()
+        arr = _maybe_tensor(tensor)
+        out = eager_comm.p2p(arr, (min(me, src), max(me, src)),
+                             src_index=(0 if src < me else 1),
+                             dst_index=(0 if me < src else 1))
+        return _wrap_inplace(tensor, out)
+    raise RuntimeError("eager cross-device recv requires a multi-process "
+                       "runtime or captured mode")
 
 
 class P2POp:
@@ -314,7 +402,17 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     return all_gather(gather_list if gather_list is not None else [], tensor, group)
 
 
+def _my_rank() -> int:
+    from .parallel import get_rank
+    return get_rank()
+
+
 def barrier(group=None):
+    g = group or _world_group()
+    from . import eager_comm
+    if g.nranks > 1 and eager_comm.available():
+        eager_comm.barrier(tuple(g.ranks))
+        return
     jnp.zeros(()).block_until_ready()
 
 

@@ -1,0 +1,146 @@
+"""Eager cross-process collectives (multi-controller).
+
+TPU-native re-design of the reference's eager ProcessGroup path
+(/root/reference/paddle/fluid/distributed/collective/process_group_nccl.cc:732
+NCCL comm init + per-collective stream launches): after
+``jax.distributed.initialize`` every process sees the global device set, and
+each eager collective executes ONE cached compiled XLA program over a 1-D
+mesh of the group's devices.  The local tensor becomes the process's shard
+of a global array (``jax.make_array_from_single_device_arrays``); the
+program body is plain jnp (sum/index/transpose) and XLA lowers the sharding
+constraint into the actual collective (psum / all-gather / all-to-all) over
+ICI/DCN — or Gloo on the CPU backend, which is what the 2-process CPU tests
+exercise.
+
+Every collective here is SPMD: all member processes must call it (matching
+NCCL semantics in the reference, including send/recv pairs).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["available", "all_reduce", "all_gather", "broadcast",
+           "reduce_scatter", "all_to_all", "p2p", "barrier", "REDUCERS"]
+
+
+def available() -> bool:
+    return jax.process_count() > 1
+
+
+_mesh_cache: dict = {}
+
+
+def _group_mesh(ranks: tuple) -> Mesh:
+    """1-D mesh over one device per member process (rank == process index,
+    the launch contract's one-process-per-host model)."""
+    mesh = _mesh_cache.get(ranks)
+    if mesh is None:
+        by_proc = {}
+        for d in jax.devices():
+            by_proc.setdefault(d.process_index, []).append(d)
+        devs = [sorted(by_proc[r], key=lambda d: d.id)[0] for r in ranks]
+        mesh = Mesh(np.array(devs), ("world",))
+        _mesh_cache[ranks] = mesh
+    return mesh
+
+
+def _global(local, mesh, n):
+    """Lift a local [*, ...] array into the global stacked [n, ...] array."""
+    local = jnp.asarray(local)
+    mine = [d for d in mesh.devices.flat
+            if d.process_index == jax.process_index()][0]
+    shard = jax.device_put(local[None], mine)
+    return jax.make_array_from_single_device_arrays(
+        (n,) + tuple(local.shape),
+        NamedSharding(mesh, P("world")),
+        [shard])
+
+
+_prog_cache: dict = {}
+
+
+def _program(key, mesh, body, out_spec):
+    prog = _prog_cache.get(key)
+    if prog is None:
+        prog = jax.jit(body, out_shardings=NamedSharding(mesh, out_spec))
+        _prog_cache[key] = prog
+    return prog
+
+
+def _local_out(garr):
+    return garr.addressable_data(0)
+
+
+REDUCERS = {
+    0: lambda x: x.sum(axis=0),                     # SUM
+    1: lambda x: x.max(axis=0),                     # MAX
+    2: lambda x: x.min(axis=0),                     # MIN
+    3: lambda x: x.prod(axis=0),                    # PROD
+    4: lambda x: x.mean(axis=0),                    # AVG
+}
+
+
+def all_reduce(local, ranks, op=0):
+    mesh = _group_mesh(tuple(ranks))
+    n = len(ranks)
+    g = _global(local, mesh, n)
+    key = ("ar", tuple(ranks), op, g.shape, str(g.dtype))
+    out = _program(key, mesh, REDUCERS[op], P())(g)
+    return _local_out(out)
+
+
+def all_gather(local, ranks):
+    """Returns the stacked [n, ...] result on every member."""
+    mesh = _group_mesh(tuple(ranks))
+    n = len(ranks)
+    g = _global(local, mesh, n)
+    key = ("ag", tuple(ranks), g.shape, str(g.dtype))
+    out = _program(key, mesh, lambda x: x, P())(g)
+    return _local_out(out)
+
+
+def broadcast(local, ranks, src_index):
+    mesh = _group_mesh(tuple(ranks))
+    n = len(ranks)
+    g = _global(local, mesh, n)
+    key = ("bc", tuple(ranks), int(src_index), g.shape, str(g.dtype))
+    out = _program(key, mesh, lambda x: x[src_index], P())(g)
+    return _local_out(out)
+
+
+def reduce_scatter(local_stack, ranks, op=0):
+    """local_stack: [n, ...] (this process's contribution for every member);
+    returns this member's reduced slot [...]."""
+    mesh = _group_mesh(tuple(ranks))
+    n = len(ranks)
+    g = _global(local_stack, mesh, n)          # [n, n, ...]
+    key = ("rs", tuple(ranks), op, g.shape, str(g.dtype))
+    out = _program(key, mesh, REDUCERS[op], P("world"))(g)
+    return jnp.squeeze(_local_out(out), axis=0)
+
+
+def all_to_all(local_stack, ranks):
+    """local_stack: [n, ...] destination-major; returns [n, ...] where slot i
+    came from member i."""
+    mesh = _group_mesh(tuple(ranks))
+    n = len(ranks)
+    g = _global(local_stack, mesh, n)          # [n_src, n_dst, ...]
+    key = ("a2a", tuple(ranks), g.shape, str(g.dtype))
+    out = _program(key, mesh, lambda x: jnp.swapaxes(x, 0, 1),
+                   P("world"))(g)
+    return jnp.squeeze(_local_out(out), axis=0)
+
+
+def p2p(local, ranks, src_index, dst_index):
+    """Point-to-point as a 2-sided collective (both src and dst — and only
+    they — call with the SAME buffer shape, NCCL-style).  Returns src's
+    tensor on every caller; the recv side assigns it, the send side ignores
+    it."""
+    return broadcast(local, ranks, src_index)
+
+
+def barrier(ranks):
+    all_reduce(jnp.zeros((), jnp.float32), ranks).block_until_ready()

@@ -13,7 +13,9 @@ from __future__ import annotations
 
 import os
 
+import numpy as np
 import jax
+import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
@@ -29,10 +31,14 @@ class ParallelEnv:
     back to JAX process topology."""
 
     def __init__(self):
-        self._rank = int(os.environ.get("PADDLE_TRAINER_ID",
-                                        os.environ.get("RANK", jax.process_index())))
-        self._world_size = int(os.environ.get(
-            "PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", jax.process_count())))
+        # env first; jax.process_index()/count() only as a LAST resort —
+        # touching them initializes the XLA backend, which must not happen
+        # before jax.distributed.initialize() in multi-process mode
+        r = os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK"))
+        w = os.environ.get("PADDLE_TRAINERS_NUM",
+                           os.environ.get("WORLD_SIZE"))
+        self._rank = int(r) if r is not None else jax.process_index()
+        self._world_size = int(w) if w is not None else jax.process_count()
         self._device_id = int(os.environ.get("FLAGS_selected_tpus",
                                              os.environ.get("LOCAL_RANK", 0)))
 
@@ -77,9 +83,23 @@ def init_parallel_env():
     if _initialized:
         return
     env = ParallelEnv()
-    if env.world_size > 1 and jax.process_count() == 1:
-        coordinator = os.environ.get("PADDLE_MASTER",
-                                     env.trainer_endpoints[0])
+    # NB: do NOT call jax.process_count() here — it would initialize the
+    # XLA backend and make jax.distributed.initialize impossible
+    already_multi = jax.distributed.is_initialized() \
+        if hasattr(jax.distributed, "is_initialized") else False
+    if env.world_size > 1 and not already_multi:
+        # Coordinator priority: explicit override; PADDLE_MASTER host at
+        # port+1 (the master port itself is bound by the launch KV store,
+        # and only PADDLE_MASTER is shared across nodes); single-node
+        # fallback: rank-0's trainer endpoint.
+        coordinator = os.environ.get("PADDLE_TPU_COORDINATOR")
+        if coordinator is None:
+            master = os.environ.get("PADDLE_MASTER")
+            if master and ":" in master:
+                host, port = master.rsplit(":", 1)
+                coordinator = f"{host}:{int(port) + 1}"
+            else:
+                coordinator = env.trainer_endpoints[0]
         try:
             jax.distributed.initialize(
                 coordinator_address=coordinator,
@@ -125,13 +145,133 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
         "chips from one process")
 
 
+class _BucketReducer:
+    """EagerReducer analog (reference reducer.h:88): group parameters into
+    ~comm_buffer_size-MB buckets in reverse creation order (the order grads
+    become ready in backward); when every grad of a bucket has arrived,
+    flatten-concat them and launch ONE fused all-reduce.  JAX dispatch is
+    async, so the fused program for bucket k overlaps with the backward
+    compute producing bucket k+1 — the same overlap the reference gets from
+    comm streams."""
+
+    def __init__(self, params, group, world, bucket_mb=25, last_bucket_mb=1):
+        self.group = group
+        self.world = world
+        self.enabled = True
+        self.buckets = []           # list[list[Parameter]]
+        self._bucket_of = {}        # id(param) -> bucket index
+        cap_last = last_bucket_mb * (1 << 20)
+        cap = bucket_mb * (1 << 20)
+        cur, cur_bytes, limit = [], 0, cap_last  # first (=last-ready) small
+        for p in reversed(list(params)):
+            nbytes = int(np.prod(p.shape)) * p.dtype.itemsize
+            if cur and cur_bytes + nbytes > limit:
+                self.buckets.append(cur)
+                cur, cur_bytes, limit = [], 0, cap
+            cur.append(p)
+            cur_bytes += nbytes
+        if cur:
+            self.buckets.append(cur)
+        for bi, bucket in enumerate(self.buckets):
+            for p in bucket:
+                self._bucket_of[id(p)] = bi
+        self._pending = [dict() for _ in self.buckets]
+        self._serial = -1
+        # finalize unused-parameter buckets when backward completes (the
+        # reference's backward-done reducer finalization, reducer.h:88)
+        from ..core import tape as _tape
+        self._remove_cb = _tape.register_post_backward_callback(
+            self._on_backward_done)
+
+    def _sync_serial(self):
+        from ..core import tape as _tape
+        s = _tape.backward_serial()
+        if s != self._serial:
+            # a new backward: stale pending grads from a backward that never
+            # completed its buckets must not leak into this one
+            self._pending = [dict() for _ in self.buckets]
+            self._serial = s
+
+    def on_grad(self, p, grad_arr):
+        """Called from the param's leaf hook — which the tape fires ONCE per
+        backward with the final accumulated grad (shared/tied params
+        included).  Returns the array the hook should hand back (the fused
+        reduced slice when this grad completes its bucket, the raw grad
+        otherwise)."""
+        if not self.enabled or self.world <= 1:
+            return grad_arr
+        self._sync_serial()
+        bi = self._bucket_of[id(p)]
+        pend = self._pending[bi]
+        pend[id(p)] = grad_arr
+        bucket = self.buckets[bi]
+        if len(pend) < len(bucket):
+            return grad_arr
+        return self._flush(bi, ret_for=id(p))
+
+    def _flush(self, bi, ret_for=None):
+        from . import eager_comm
+        bucket = self.buckets[bi]
+        pend = self._pending[bi]
+        flat = jnp.concatenate(
+            [jnp.ravel(pend[id(p)].astype(jnp.float32)) for p in bucket])
+        g = self.group
+        ranks = tuple(g.ranks) if g is not None else tuple(range(self.world))
+        reduced = eager_comm.all_reduce(flat, ranks, op=4)  # AVG
+        ret = None
+        off = 0
+        for p in bucket:
+            n = int(np.prod(p.shape))
+            raw = pend[id(p)]
+            piece = reduced[off:off + n].reshape(tuple(p.shape)) \
+                .astype(raw.dtype)
+            off += n
+            if id(p) == ret_for:
+                ret = piece   # tape accumulates it into p.grad
+            elif p._grad is not None:
+                # p.grad already holds prior-accumulation + this backward's
+                # raw grad; swap raw for reduced WITHOUT touching earlier
+                # accumulated steps
+                p._grad._data = p._grad._data + (piece - raw).astype(
+                    p._grad._data.dtype)
+            else:
+                p._grad = Tensor(piece, stop_gradient=True)
+        self._pending[bi] = {}
+        return ret
+
+    def _on_backward_done(self):
+        from ..core import tape as _tape
+        if not self.enabled or self.world <= 1:
+            return
+        if self._serial != _tape.backward_serial():
+            return  # this backward produced no grads for our params
+        if any(self._pending[bi] for bi in range(len(self.buckets))):
+            self.flush_incomplete()
+
+    def flush_incomplete(self):
+        """Reduce buckets whose params produced no grad this backward
+        (unused parameters contribute zeros — every rank must still enter
+        the collective)."""
+        for bi, bucket in enumerate(self.buckets):
+            pend = self._pending[bi]
+            if not pend:
+                continue
+            for p in bucket:
+                if id(p) not in pend:
+                    pend[id(p)] = jnp.zeros(tuple(p.shape),
+                                            jnp.dtype(p.dtype.np_dtype))
+            self._flush(bi)
+
+
 class DataParallel(Layer):
-    """Eager data-parallel wrapper (reference: parallel.py:219 + EagerReducer).
+    """Eager data-parallel wrapper (reference: parallel.py:219 + EagerReducer
+    reducer.h:88).
 
     Under the single-controller TPU model, cross-chip gradient averaging is
-    performed by the compiled train step over the 'dp' mesh axis; this wrapper
-    exists for API parity and multi-host eager mode, where it registers
-    grad hooks that all-reduce over the world group.
+    performed by the compiled train step over the 'dp' mesh axis.  In
+    multi-process eager mode (init_parallel_env under distributed.launch)
+    grad hooks feed a bucketed reducer that launches fused all-reduces over
+    the world group, overlapping with backward.
     """
 
     def __init__(self, layers, strategy=None, comm_buffer_size=25,
@@ -142,18 +282,20 @@ class DataParallel(Layer):
         self.group = group
         self.find_unused_parameters = find_unused_parameters
         world = get_world_size(group)
+        self._reducer = None
         if world > 1:
-            from .collective import ReduceOp, all_reduce
+            params = [p for p in layers.parameters() if not p.stop_gradient]
+            self._reducer = _BucketReducer(params, group, world,
+                                           comm_buffer_size,
+                                           last_comm_buffer_size)
 
             def make_hook(p):
                 def hook(grad):
-                    out = all_reduce(grad, ReduceOp.SUM, self.group)
-                    from ..ops.math import scale
-                    return scale(out, 1.0 / world)
+                    out = self._reducer.on_grad(p, grad._data)
+                    return Tensor(out) if out is not None else grad
                 return hook
-            for p in layers.parameters():
-                if not p.stop_gradient:
-                    p.register_hook(make_hook(p))
+            for p in params:
+                p.register_hook(make_hook(p))
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
@@ -168,8 +310,20 @@ class DataParallel(Layer):
         return loss
 
     def apply_collective_grads(self):
-        pass
+        if self._reducer is not None:
+            self._reducer.flush_incomplete()
 
     def no_sync(self):
         import contextlib
-        return contextlib.nullcontext()
+
+        @contextlib.contextmanager
+        def ctx():
+            if self._reducer is None:
+                yield
+                return
+            self._reducer.enabled = False
+            try:
+                yield
+            finally:
+                self._reducer.enabled = True
+        return ctx()

@@ -1,0 +1,218 @@
+"""Eager cross-process collectives + bucketed DataParallel (2-process CPU).
+
+Mirrors the reference's subprocess-spawned collective tests
+(test/collective/test_communication_api_base.py:28): the driver launches
+worker scripts via paddle_tpu.distributed.launch; workers run REAL
+cross-process eager collectives over jax.distributed (Gloo on CPU).
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _launch(tmp_path, script_body, nproc=2, timeout=240):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(script_body))
+    env = dict(os.environ, PYTHONPATH=REPO + os.pathsep +
+               os.environ.get("PYTHONPATH", ""), JAX_PLATFORMS="cpu",
+               XLA_FLAGS="")  # one device per process
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", str(nproc),
+           "--log_dir", str(tmp_path / "log"), str(script)]
+    return subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=timeout, cwd=str(tmp_path))
+
+
+def test_eager_collectives_cross_process(tmp_path):
+    r = _launch(tmp_path, """
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+
+        dist.init_parallel_env()
+        rank = dist.get_rank()
+        world = dist.get_world_size()
+        assert world == 2 and jax.process_count() == 2
+
+        # all_reduce SUM
+        t = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
+        dist.all_reduce(t)
+        np.testing.assert_allclose(t.numpy(), np.full((4,), 3.0))
+
+        # broadcast from rank 1
+        t = paddle.to_tensor(np.full((3,), float(rank), np.float32))
+        dist.broadcast(t, src=1)
+        np.testing.assert_allclose(t.numpy(), np.full((3,), 1.0))
+
+        # all_gather
+        outs = []
+        dist.all_gather(outs, paddle.to_tensor(
+            np.full((2,), float(rank), np.float32)))
+        assert len(outs) == 2
+        np.testing.assert_allclose(outs[0].numpy(), np.zeros(2))
+        np.testing.assert_allclose(outs[1].numpy(), np.ones(2))
+
+        # reduce_scatter
+        out = paddle.to_tensor(np.zeros((2,), np.float32))
+        ins = [paddle.to_tensor(np.full((2,), float(rank * 2 + i), np.float32))
+               for i in range(2)]
+        dist.reduce_scatter(out, ins)
+        # rank r gets sum_i ins_i[r]: slot0 = 0+2, slot1 = 1+3
+        np.testing.assert_allclose(out.numpy(),
+                                   np.full((2,), 2.0 if rank == 0 else 4.0))
+
+        # alltoall
+        outs = []
+        ins = [paddle.to_tensor(np.full((2,), float(rank * 10 + i), np.float32))
+               for i in range(2)]
+        dist.alltoall(outs, ins)
+        np.testing.assert_allclose(outs[0].numpy(),
+                                   np.full((2,), 0.0 if rank == 0 else 1.0))
+        np.testing.assert_allclose(outs[1].numpy(),
+                                   np.full((2,), 10.0 if rank == 0 else 11.0))
+
+        # send/recv pair
+        if rank == 0:
+            dist.send(paddle.to_tensor(np.full((2,), 7.0, np.float32)), dst=1)
+        else:
+            buf = paddle.to_tensor(np.zeros((2,), np.float32))
+            dist.recv(buf, src=0)
+            np.testing.assert_allclose(buf.numpy(), np.full((2,), 7.0))
+
+        # all_gather_object
+        objs = []
+        dist.all_gather_object(objs, {"rank": rank, "tag": "x" * (rank + 1)})
+        assert objs == [{"rank": 0, "tag": "x"}, {"rank": 1, "tag": "xx"}]
+
+        dist.barrier()
+        with open(f"ok_{rank}", "w") as f:
+            f.write("pass")
+    """)
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert (tmp_path / "ok_0").exists() and (tmp_path / "ok_1").exists()
+
+
+def test_data_parallel_bucketed_reducer_cross_process(tmp_path):
+    r = _launch(tmp_path, """
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import paddle_tpu.distributed as dist
+
+        dist.init_parallel_env()
+        rank = dist.get_rank()
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        dp = paddle.DataParallel(net, comm_buffer_size=1)
+        assert dp._reducer is not None and len(dp._reducer.buckets) >= 1
+
+        # per-rank distinct data; grads must equal the mean of both ranks'
+        # local grads (verified against a local 2-batch reference)
+        x_all = np.random.RandomState(42).randn(4, 8).astype(np.float32)
+        y_all = np.random.RandomState(43).randn(4, 4).astype(np.float32)
+        x_local = paddle.to_tensor(x_all[rank * 2:(rank + 1) * 2])
+        y_local = paddle.to_tensor(y_all[rank * 2:(rank + 1) * 2])
+
+        loss = nn.functional.mse_loss(dp(x_local), y_local)
+        loss.backward()
+        dp.apply_collective_grads()
+
+        # reference: same net on the FULL batch (mse mean over both halves
+        # == mean of per-half mse; grads likewise)
+        paddle.seed(0)
+        ref = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        rloss = nn.functional.mse_loss(
+            ref(paddle.to_tensor(x_all)), paddle.to_tensor(y_all))
+        rloss.backward()
+
+        for p, q in zip(net.parameters(), ref.parameters()):
+            np.testing.assert_allclose(p.grad.numpy(), q.grad.numpy(),
+                                       rtol=1e-5, atol=1e-6)
+
+        # no_sync leaves grads local
+        net2 = nn.Linear(4, 4)
+        dp2 = paddle.DataParallel(net2)
+        xb = paddle.to_tensor(
+            np.full((2, 4), float(rank + 1), np.float32))
+        with dp2.no_sync():
+            out = dp2(xb)
+            out.sum().backward()
+        g0 = net2.parameters()[0].grad.numpy().copy()
+        local_expected = np.full_like(g0, (rank + 1) * 2.0)
+        np.testing.assert_allclose(g0, local_expected, rtol=1e-5)
+
+        with open(f"dp_ok_{rank}", "w") as f:
+            f.write("pass")
+    """)
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert (tmp_path / "dp_ok_0").exists() and (tmp_path / "dp_ok_1").exists()
+
+
+def test_shared_params_and_grad_accumulation(tmp_path):
+    """Leaf hooks fire once per backward with the FINAL grad, so tied/shared
+    layers bucket-reduce correctly, and a second backward accumulates on top
+    of the reduced grads (r3 review findings 1 and 3)."""
+    r = _launch(tmp_path, """
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import paddle_tpu.distributed as dist
+
+        dist.init_parallel_env()
+        rank = dist.get_rank()
+
+        class Twice(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 4)  # applied twice (shared)
+            def forward(self, x):
+                return self.lin(self.lin(x))
+
+        paddle.seed(0)
+        net = Twice()
+        dp = paddle.DataParallel(net)
+
+        x_all = np.random.RandomState(7).randn(4, 4).astype(np.float32)
+        x_local = paddle.to_tensor(x_all[rank * 2:(rank + 1) * 2])
+        dp(x_local).mean().backward()
+
+        paddle.seed(0)
+        ref = Twice()
+        # DDP objective = mean over ranks of per-rank mean loss
+        l0 = ref(paddle.to_tensor(x_all[:2])).mean()
+        l1 = ref(paddle.to_tensor(x_all[2:])).mean()
+        ((l0 + l1) * 0.5).backward()
+
+        for p, q in zip(net.parameters(), ref.parameters()):
+            np.testing.assert_allclose(p.grad.numpy(), q.grad.numpy(),
+                                       rtol=1e-5, atol=1e-6)
+
+        # gradient accumulation: a second backward adds the reduced grads
+        g_first = [p.grad.numpy().copy() for p in net.parameters()]
+        dp(x_local).mean().backward()
+        for p, g1 in zip(net.parameters(), g_first):
+            np.testing.assert_allclose(p.grad.numpy(), 2 * g1,
+                                       rtol=1e-5, atol=1e-6)
+
+        with open(f"shared_ok_{rank}", "w") as f:
+            f.write("pass")
+    """)
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert (tmp_path / "shared_ok_0").exists()
+    assert (tmp_path / "shared_ok_1").exists()

@@ -1,0 +1,19 @@
+import os, sys
+rank = int(sys.argv[1]); world = int(sys.argv[2]); port = sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PYTHONPATH", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=world, process_id=rank)
+import jax.numpy as jnp, numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+devs = jax.devices()
+print(f"rank{rank}: {len(devs)} devices", flush=True)
+mesh = Mesh(np.array(devs), ("world",))
+local = jnp.full((4,), float(rank + 1))
+garr = jax.make_array_from_single_device_arrays(
+    (world * 4,), NamedSharding(mesh, P("world")), [local])
+out = jax.jit(lambda x: x.reshape(world, 4).sum(axis=0),
+              out_shardings=NamedSharding(mesh, P()))(garr)
+print(f"rank{rank}: allreduce -> {np.asarray(out.addressable_data(0))}", flush=True)

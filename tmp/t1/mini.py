@@ -1,0 +1,12 @@
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+if len(sys.argv) > 1 and sys.argv[1] == "paddle":
+    import paddle_tpu  # suspect
+try:
+    jax.distributed.initialize(coordinator_address="127.0.0.1:23999",
+                               num_processes=1, process_id=0)
+    print("init OK")
+except Exception as e:
+    print("init FAIL:", e)

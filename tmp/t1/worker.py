@@ -1,0 +1,15 @@
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from jax._src import xla_bridge
+import paddle_tpu as paddle
+print("pre-init backends:", list(xla_bridge._backends.keys()), flush=True)
+import numpy as np
+import paddle_tpu.distributed as dist
+dist.init_parallel_env()
+rank = dist.get_rank()
+print("rank", rank, "procs", jax.process_count(), flush=True)
+t = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
+dist.all_reduce(t)
+print("AR:", t.numpy(), flush=True)

@@ -1,0 +1,67 @@
+
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+dist.init_parallel_env()
+rank = dist.get_rank()
+world = dist.get_world_size()
+assert world == 2 and jax.process_count() == 2
+
+# all_reduce SUM
+t = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
+dist.all_reduce(t)
+np.testing.assert_allclose(t.numpy(), np.full((4,), 3.0))
+
+# broadcast from rank 1
+t = paddle.to_tensor(np.full((3,), float(rank), np.float32))
+dist.broadcast(t, src=1)
+np.testing.assert_allclose(t.numpy(), np.full((3,), 1.0))
+
+# all_gather
+outs = []
+dist.all_gather(outs, paddle.to_tensor(
+    np.full((2,), float(rank), np.float32)))
+assert len(outs) == 2
+np.testing.assert_allclose(outs[0].numpy(), np.zeros(2))
+np.testing.assert_allclose(outs[1].numpy(), np.ones(2))
+
+# reduce_scatter
+out = paddle.to_tensor(np.zeros((2,), np.float32))
+ins = [paddle.to_tensor(np.full((2,), float(rank * 2 + i), np.float32))
+       for i in range(2)]
+dist.reduce_scatter(out, ins)
+# rank r gets sum_i ins_i[r]: slot0 = 0+2, slot1 = 1+3
+np.testing.assert_allclose(out.numpy(),
+                           np.full((2,), 2.0 if rank == 0 else 4.0))
+
+# alltoall
+outs = []
+ins = [paddle.to_tensor(np.full((2,), float(rank * 10 + i), np.float32))
+       for i in range(2)]
+dist.alltoall(outs, ins)
+np.testing.assert_allclose(outs[0].numpy(),
+                           np.full((2,), 0.0 if rank == 0 else 1.0))
+np.testing.assert_allclose(outs[1].numpy(),
+                           np.full((2,), 10.0 if rank == 0 else 11.0))
+
+# send/recv pair
+if rank == 0:
+    dist.send(paddle.to_tensor(np.full((2,), 7.0, np.float32)), dst=1)
+else:
+    buf = paddle.to_tensor(np.zeros((2,), np.float32))
+    dist.recv(buf, src=0)
+    np.testing.assert_allclose(buf.numpy(), np.full((2,), 7.0))
+
+# all_gather_object
+objs = []
+dist.all_gather_object(objs, {"rank": rank, "tag": "x" * (rank + 1)})
+assert objs == [{"rank": 0, "tag": "x"}, {"rank": 1, "tag": "xx"}]
+
+dist.barrier()
+with open(f"ok_{rank}", "w") as f:
+    f.write("pass")
