@@ -53,8 +53,14 @@ class HybridParallelConfig:
                                       # (topology.py:199) upgraded to true CP
     num_microbatches: int = 1
     pp_schedule: str = "1f1b"         # "1f1b" (memory-bounded, the reference
-                                      # pipeline_parallel.py:684 schedule) or
-                                      # "gpipe" (scan + jax.grad transpose)
+                                      # pipeline_parallel.py:684 schedule),
+                                      # "gpipe" (scan + jax.grad transpose),
+                                      # or "vpp" (interleaved virtual
+                                      # pipeline, vpp chunks per stage — the
+                                      # reference PipelineParallelWith-
+                                      # Interleave, pipeline_parallel.py:1308)
+    vpp: int = 1                      # virtual chunks per stage (vpp > 1
+                                      # requires pp_schedule="vpp")
     remat: bool = True
     remat_policy: str = "full"        # "full" = recompute everything
                                       # (hardware-validated default);
@@ -436,6 +442,134 @@ def _stage_apply(params, tok_mb, act_in, cfg, hp):
     return out, mb_loss, aux_total
 
 
+def vpp_layer_perm(L, pp, v):
+    """Permutation mapping LOGICAL layer order to the interleaved placement:
+    physical stage s holds virtual chunks {c*pp + s | c < v} concatenated,
+    so the contiguous pp-sharding of the stacked [L, ...] layer params puts
+    each stage's v chunks in its shard."""
+    Lc = L // (pp * v)
+    Lloc = L // pp
+    perm = np.zeros(L, np.int32)
+    for s in range(pp):
+        for c in range(v):
+            for j in range(Lc):
+                perm[s * Lloc + c * Lc + j] = (c * pp + s) * Lc + j
+    return perm
+
+
+def _vpp_stage_apply(params, tok_mb, act_in, cfg, hp, chunk, first, last):
+    """One interleaved chunk application (traced chunk index / first / last
+    flags).  Same per-device math as _stage_apply but over ONE of this
+    stage's vpp layer chunks."""
+    block = _make_block(cfg, hp)
+    if hp.remat:
+        policy = (jax.checkpoint_policies.save_only_these_names("attn_out")
+                  if getattr(hp, "remat_policy", "full") == "attn" else None)
+        block = jax.checkpoint(block, policy=policy)
+    Lloc = cfg.num_hidden_layers // hp.pp
+    Lc = Lloc // hp.vpp
+    layers_c = jax.tree.map(
+        lambda x: lax.dynamic_slice_in_dim(x, chunk * Lc, Lc, axis=0),
+        params["layers"])
+    S = tok_mb.shape[1]
+    S_cp = S // hp.cp
+    cp_start = lax.axis_index("cp") * S_cp
+    tok_cp = lax.dynamic_slice_in_dim(tok_mb, cp_start, S_cp, axis=1)
+    fresh = _vocab_parallel_embed(tok_cp, params["embed"], cfg, hp)
+    inp = jnp.where(first, fresh, act_in)
+
+    def body(carry, pl):
+        x, aux_acc = carry
+        x, aux = block(x, pl)
+        return (x, aux_acc + aux), None
+
+    (out, aux_total), _ = lax.scan(
+        body, (inp, jnp.zeros((), jnp.float32)), layers_c)
+    if cfg.moe_experts:
+        aux_total = _aux_consistent(aux_total, hp)
+
+    hN = _rms(out, params["norm_f"], cfg.rms_norm_eps)
+    h_full = lax.all_gather(hN, "tp", axis=1, tiled=True)
+    tok_ext = jnp.concatenate([tok_mb, tok_mb[:, :1]], axis=1)
+    labels = lax.dynamic_slice_in_dim(tok_ext, cp_start + 1, S_cp, axis=1)
+    pos_w = ((cp_start + jnp.arange(S_cp)) < S - 1).astype(jnp.float32)
+    ws, wc = _vocab_parallel_xent(h_full, params["head"], labels, cfg,
+                                  pos_weight=pos_w, reduction="sumcount")
+    if hp.cp > 1:
+        ws = lax.psum(ws, "cp")
+        wc = lax.psum(wc, "cp")
+    mb_loss = ws / jnp.maximum(wc, 1.0)
+    return out, mb_loss, aux_total
+
+
+def _forward_loss_vpp(params, tokens, cfg, hp):
+    """Interleaved (circular) virtual-pipeline forward: vpp chunks per
+    stage, ONE chunk application per stage per tick, activations riding the
+    same forward ppermute ring (virtual stage c*pp+s-1's output lands on
+    virtual stage c*pp+s exactly one tick later).  Fill/drain bubble is
+    (pp-1) CHUNK ticks — vpp x smaller than GPipe/1F1B's (pp-1) full-stage
+    ticks (the reference's PipelineParallelWithInterleave purpose,
+    pipeline_parallel.py:1308).  Backward is the scan transpose.
+
+    Stream order per stage: for each round r (pp microbatches), chunks
+    0..vpp-1, microbatches r*pp..r*pp+pp-1 — requires M % pp == 0.
+    """
+    M = hp.num_microbatches
+    pp = hp.pp
+    V = hp.vpp
+    stage = lax.axis_index("pp")
+    m_sz = tokens.shape[1]
+    S = tokens.shape[2]
+    s_loc = S // hp.cp // hp.tp
+    H = cfg.hidden_size
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+    T = V * M + pp - 1
+
+    def tick(carry, t):
+        act, acc_loss = carry
+        i = t - stage                   # this stage's stream position
+        ok = (i >= 0) & (i < V * M)
+        ic = jnp.clip(i, 0, V * M - 1)
+        r = ic // (V * pp)
+        rem = ic % (V * pp)
+        c = rem // pp
+        k = rem % pp
+        mb = r * pp + k
+        first = (c == 0) & (stage == 0)
+        last = (c == V - 1) & (stage == pp - 1)
+        tok_mb = lax.dynamic_index_in_dim(tokens, mb, axis=0, keepdims=False)
+        out, mb_loss, aux = _vpp_stage_apply(params, tok_mb, act, cfg, hp,
+                                             c, first, last)
+        acc_loss = acc_loss + jnp.where(ok & last, mb_loss, 0.0) \
+            + jnp.where(ok, cfg.moe_aux_weight * aux, 0.0)
+        act_next = lax.ppermute(out, "pp", perm) if pp > 1 else out
+        return (act_next, acc_loss), None
+
+    act0 = _pcast_all(jnp.zeros((m_sz, s_loc, H), hp.dtype))
+    loss0 = _pcast_all(jnp.zeros((), jnp.float32))
+    (_, total_loss), _ = lax.scan(tick, (act0, loss0), jnp.arange(T))
+    loss = lax.psum(total_loss / M, "pp")
+    return loss
+
+
+def pipeline_schedule_stats(hp, M=None):
+    """Static fill/drain accounting per stage (forward pass).
+
+    relative_time is in full-stage-load units (one GPipe tick == 1): the
+    interleaved schedule's bubble is (pp-1)/vpp instead of (pp-1)."""
+    M = M if M is not None else hp.num_microbatches
+    if hp.pp_schedule == "vpp" and hp.vpp > 1:
+        ticks = hp.vpp * M + hp.pp - 1
+        bubble = (hp.pp - 1) / ticks
+        rel_time = ticks / hp.vpp
+    else:
+        ticks = M + hp.pp - 1
+        bubble = (hp.pp - 1) / ticks
+        rel_time = float(ticks)
+    return {"ticks": ticks, "bubble_fraction": bubble,
+            "relative_time": rel_time}
+
+
 def _aux_consistent(aux, hp):
     """Make the MoE aux loss consistent across tp/cp ranks in BOTH value and
     gradient.
@@ -710,6 +844,15 @@ def build_train_step(cfg: LlamaConfig, hp: HybridParallelConfig, mesh: Mesh):
     tokens: GLOBAL [dp * M * m, S] int32.  The whole step is one jitted
     program; parameter/optimizer buffers are donated.
     """
+    if hp.pp_schedule == "vpp" and hp.vpp > 1:
+        if cfg.num_hidden_layers % (hp.pp * hp.vpp):
+            raise ValueError(
+                f"layers={cfg.num_hidden_layers} must divide by "
+                f"pp*vpp={hp.pp * hp.vpp}")
+        if hp.num_microbatches % hp.pp:
+            raise ValueError(
+                f"vpp schedule needs num_microbatches % pp == 0 "
+                f"(got {hp.num_microbatches} % {hp.pp})")
     if cfg.num_key_value_heads % hp.tp:
         raise ValueError(
             f"num_key_value_heads={cfg.num_key_value_heads} must divide by "
@@ -735,6 +878,9 @@ def build_train_step(cfg: LlamaConfig, hp: HybridParallelConfig, mesh: Mesh):
         tokens = tokens.reshape(M, mS[0] // M, mS[1])
         if hp.pp > 1 and hp.pp_schedule == "1f1b":
             loss, grads = _value_and_grad_1f1b(params, tokens, cfg, hp)
+        elif hp.pp_schedule == "vpp" and hp.vpp > 1:
+            loss, grads = jax.value_and_grad(
+                lambda p: _forward_loss_vpp(p, tokens, cfg, hp))(params)
         else:
             loss, grads = jax.value_and_grad(
                 lambda p: _forward_loss(p, tokens, cfg, hp))(params)
@@ -752,7 +898,17 @@ def build_train_step(cfg: LlamaConfig, hp: HybridParallelConfig, mesh: Mesh):
 
 
 def shard_params(params, hp, mesh):
-    """Place an (unsharded) param pytree onto the mesh per param_specs."""
+    """Place an (unsharded) param pytree onto the mesh per param_specs.
+
+    Under the interleaved schedule the stacked layer params are permuted
+    (vpp_layer_perm) so the contiguous pp-shard of each stage holds its vpp
+    chunks; logical layer order is preserved by the schedule."""
+    if hp.pp_schedule == "vpp" and hp.vpp > 1:
+        perm = vpp_layer_perm(
+            next(iter(jax.tree.leaves(params["layers"]))).shape[0],
+            hp.pp, hp.vpp)
+        params = dict(params)
+        params["layers"] = jax.tree.map(lambda x: x[perm], params["layers"])
     specs = param_specs(hp, _is_moe_tree(params))
     return jax.tree.map(
         lambda t, s: jax.device_put(t, NamedSharding(mesh, s)), params, specs,
