@@ -1,0 +1,190 @@
+"""OpTest sweep over the high-traffic op surface.
+
+Reference model: test/legacy_test/op_test.py:418 — NumPy-reference forward
+checks in eager AND captured mode, plus finite-difference gradient checks,
+one declarative entry per op.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+from op_test import OpSpec
+
+R = np.random.RandomState(7)
+
+
+def _f(*shape):
+    # values away from kinks (|x| > 0.1) so finite differences stay clean
+    a = R.randn(*shape).astype(np.float32)
+    return a + np.sign(a) * 0.15
+
+
+def _pos(*shape):
+    return (np.abs(R.randn(*shape)) + 0.5).astype(np.float32)
+
+
+def _softmax_np(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+OPS = [
+    # -- elementwise binary -------------------------------------------------
+    OpSpec("add", paddle.add, lambda a, b: a + b, [_f(3, 4), _f(3, 4)]),
+    OpSpec("subtract", paddle.subtract, lambda a, b: a - b,
+           [_f(3, 4), _f(3, 4)]),
+    OpSpec("multiply", paddle.multiply, lambda a, b: a * b,
+           [_f(3, 4), _f(3, 4)]),
+    OpSpec("divide", paddle.divide, lambda a, b: a / b,
+           [_f(3, 4), _pos(3, 4)]),
+    OpSpec("maximum", paddle.maximum, np.maximum, [_f(3, 4), _f(3, 4)]),
+    OpSpec("minimum", paddle.minimum, np.minimum, [_f(3, 4), _f(3, 4)]),
+    OpSpec("pow", paddle.pow, lambda a, b: a ** b, [_pos(3, 4), _pos(3, 4)]),
+    OpSpec("broadcast_add", paddle.add, lambda a, b: a + b,
+           [_f(3, 4), _f(1, 4)]),
+    # -- elementwise unary --------------------------------------------------
+    OpSpec("exp", paddle.exp, np.exp, [_f(3, 4)]),
+    OpSpec("log", paddle.log, np.log, [_pos(3, 4)]),
+    OpSpec("sqrt", paddle.sqrt, np.sqrt, [_pos(3, 4)]),
+    OpSpec("rsqrt", paddle.rsqrt, lambda a: 1 / np.sqrt(a), [_pos(3, 4)]),
+    OpSpec("abs", paddle.abs, np.abs, [_f(3, 4)]),
+    OpSpec("sin", paddle.sin, np.sin, [_f(3, 4)]),
+    OpSpec("cos", paddle.cos, np.cos, [_f(3, 4)]),
+    OpSpec("tanh", paddle.tanh, np.tanh, [_f(3, 4)]),
+    OpSpec("square", paddle.square, np.square, [_f(3, 4)]),
+    OpSpec("reciprocal", paddle.reciprocal, lambda a: 1 / a, [_pos(3, 4)]),
+    OpSpec("floor", paddle.floor, np.floor, [_f(3, 4)], grad=False),
+    OpSpec("ceil", paddle.ceil, np.ceil, [_f(3, 4)], grad=False),
+    OpSpec("round", paddle.round, np.round, [_f(3, 4)], grad=False),
+    OpSpec("sign", paddle.sign, np.sign, [_f(3, 4)], grad=False),
+    OpSpec("clip", paddle.clip, lambda a, min, max: np.clip(a, min, max),
+           [_f(3, 4)], {"min": -0.5, "max": 0.5}),
+    # -- activations --------------------------------------------------------
+    OpSpec("relu", F.relu, lambda a: np.maximum(a, 0), [_f(3, 4)]),
+    OpSpec("sigmoid", F.sigmoid, lambda a: 1 / (1 + np.exp(-a)), [_f(3, 4)]),
+    OpSpec("gelu", F.gelu,
+           lambda a: 0.5 * a * (1 + np.vectorize(np.math.erf)(a / np.sqrt(2)))
+           if hasattr(np, "math") else a,
+           [_f(3, 4)], fwd_tol=1e-4),
+    OpSpec("silu", F.silu, lambda a: a / (1 + np.exp(-a)), [_f(3, 4)]),
+    OpSpec("softmax", F.softmax, _softmax_np, [_f(3, 5)], {"axis": -1}),
+    OpSpec("log_softmax", F.log_softmax,
+           lambda a, axis=-1: np.log(_softmax_np(a, axis)),
+           [_f(3, 5)], {"axis": -1}),
+    OpSpec("leaky_relu", F.leaky_relu,
+           lambda a, negative_slope=0.01: np.where(a > 0, a,
+                                                   negative_slope * a),
+           [_f(3, 4)], {"negative_slope": 0.1}),
+    OpSpec("elu", F.elu,
+           lambda a, alpha=1.0: np.where(a > 0, a, alpha * (np.exp(a) - 1)),
+           [_f(3, 4)], {"alpha": 1.0}),
+    OpSpec("softplus", F.softplus,
+           lambda a, beta=1.0, threshold=20.0: np.log1p(np.exp(beta * a)) / beta,
+           [_f(3, 4)]),
+    OpSpec("hardswish", F.hardswish,
+           lambda a: a * np.clip(a + 3, 0, 6) / 6, [_f(3, 4)]),
+    # -- matmul / linalg ----------------------------------------------------
+    OpSpec("matmul", paddle.matmul, lambda a, b: a @ b,
+           [_f(3, 4), _f(4, 5)]),
+    OpSpec("matmul_batched", paddle.matmul, lambda a, b: a @ b,
+           [_f(2, 3, 4), _f(2, 4, 5)]),
+    OpSpec("t", lambda a: paddle.transpose(a, (1, 0)), np.transpose,
+           [_f(3, 4)]),
+    # -- reductions ---------------------------------------------------------
+    OpSpec("sum", paddle.sum, lambda a, axis=None: a.sum(axis=axis),
+           [_f(3, 4)], {"axis": 1}),
+    OpSpec("mean", paddle.mean, lambda a, axis=None: a.mean(axis=axis),
+           [_f(3, 4)], {"axis": 0}),
+    OpSpec("max", paddle.max, lambda a, axis=None: a.max(axis=axis),
+           [_f(3, 4)], {"axis": 1}),
+    OpSpec("min", paddle.min, lambda a, axis=None: a.min(axis=axis),
+           [_f(3, 4)], {"axis": 1}),
+    OpSpec("prod", paddle.prod, lambda a, axis=None: a.prod(axis=axis),
+           [_pos(2, 3)], {"axis": 1}),
+    OpSpec("logsumexp", paddle.logsumexp,
+           lambda a, axis=None: np.log(np.exp(a).sum(axis=axis)),
+           [_f(3, 4)], {"axis": 1}),
+    OpSpec("cumsum", paddle.cumsum, lambda a, axis=None: a.cumsum(axis=axis),
+           [_f(3, 4)], {"axis": 1}),
+    # -- shape manipulation -------------------------------------------------
+    OpSpec("reshape", paddle.reshape,
+           lambda a, shape: a.reshape(shape), [_f(3, 4)], {"shape": (4, 3)}),
+    OpSpec("transpose", paddle.transpose,
+           lambda a, perm: np.transpose(a, perm),
+           [_f(2, 3, 4)], {"perm": (2, 0, 1)}),
+    OpSpec("squeeze", paddle.squeeze,
+           lambda a, axis=None: np.squeeze(a, axis),
+           [_f(3, 1, 4)], {"axis": 1}),
+    OpSpec("unsqueeze", paddle.unsqueeze,
+           lambda a, axis: np.expand_dims(a, axis), [_f(3, 4)], {"axis": 1}),
+    OpSpec("flatten", paddle.flatten, lambda a: a.reshape(-1),
+           [_f(3, 4, 2)]),
+    OpSpec("tile", paddle.tile,
+           lambda a, repeat_times: np.tile(a, repeat_times),
+           [_f(2, 3)], {"repeat_times": (2, 2)}),
+    OpSpec("expand", paddle.expand,
+           lambda a, shape: np.broadcast_to(a, shape),
+           [_f(1, 3)], {"shape": (4, 3)}),
+    OpSpec("concat", lambda a, b, axis=0: paddle.concat([a, b], axis=axis),
+           lambda a, b, axis=0: np.concatenate([a, b], axis=axis),
+           [_f(2, 3), _f(2, 3)], {"axis": 1}),
+    OpSpec("stack", lambda a, b, axis=0: paddle.stack([a, b], axis=axis),
+           lambda a, b, axis=0: np.stack([a, b], axis=axis),
+           [_f(2, 3), _f(2, 3)], {"axis": 1}),
+    OpSpec("split0",
+           lambda a, num_or_sections=2, axis=1:
+           paddle.split(a, num_or_sections, axis)[0],
+           lambda a, num_or_sections=2, axis=1:
+           np.split(a, num_or_sections, axis)[0],
+           [_f(2, 4)]),
+    OpSpec("pad", lambda a, pad: F.pad(a, pad),
+           lambda a, pad: np.pad(a, [(pad[0], pad[1]), (pad[2], pad[3])]),
+           [_f(3, 4)], {"pad": (1, 1, 0, 2)}),
+    # -- indexing -----------------------------------------------------------
+    OpSpec("gather", paddle.gather,
+           lambda a, idx, axis=0: np.take(a, idx, axis=axis),
+           [_f(5, 3), np.array([0, 2, 4])], grad=False),
+    OpSpec("index_select", paddle.index_select,
+           lambda a, idx, axis=0: np.take(a, idx, axis=axis),
+           [_f(5, 3), np.array([1, 3])], grad=False),
+    OpSpec("where", paddle.where,
+           lambda c, a, b: np.where(c, a, b),
+           [R.rand(3, 4) > 0.5, _f(3, 4), _f(3, 4)]),
+    # -- comparison / logic (no grads) -------------------------------------
+    OpSpec("equal", paddle.equal, np.equal,
+           [np.array([1, 2, 3]), np.array([1, 0, 3])], grad=False),
+    OpSpec("greater_than", paddle.greater_than, np.greater,
+           [_f(3, 4), _f(3, 4)], grad=False),
+    OpSpec("argmax", paddle.argmax,
+           lambda a, axis=None: a.argmax(axis=axis),
+           [_f(3, 4)], {"axis": 1}, grad=False),
+    OpSpec("argsort", paddle.argsort,
+           lambda a, axis=-1: np.argsort(a, axis=axis, kind="stable"),
+           [_f(3, 4)], grad=False),
+    OpSpec("sort", paddle.sort, lambda a, axis=-1: np.sort(a, axis=axis),
+           [_f(3, 4)], grad=False),
+    # -- losses / norms -----------------------------------------------------
+    OpSpec("mse_loss", F.mse_loss, lambda a, b: ((a - b) ** 2).mean(),
+           [_f(4, 3), _f(4, 3)]),
+    OpSpec("l1_loss", F.l1_loss, lambda a, b: np.abs(a - b).mean(),
+           [_f(4, 3), _f(4, 3)]),
+]
+
+
+_GELU_ERF = None
+
+
+def _gelu_ref(a):
+    from scipy.special import erf  # pragma: no cover
+    return 0.5 * a * (1 + erf(a / np.sqrt(2)))
+
+
+@pytest.mark.parametrize("spec", OPS, ids=[s.name for s in OPS])
+def test_op(spec):
+    if spec.name == "gelu":
+        import math as _m
+        spec.np_ref = lambda a: 0.5 * a * (
+            1 + np.vectorize(_m.erf)(a / np.sqrt(2.0)))
+    spec.run()

@@ -1,0 +1,131 @@
+"""RNN family: SimpleRNN/LSTM/GRU layers + cells.
+
+Reference surface: python/paddle/nn/layer/rnn.py (cells :742/:919/:1145,
+RNN :1340, BiRNN :1422, fused multi-layer classes :1860+).  Numerics oracle:
+torch's CPU RNNs — paddle and torch share the exact gate conventions
+(LSTM gate order [i,f,g,o]; GRU r/z with r inside the candidate's hidden
+term; h' = z*h + (1-z)*c)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+torch = pytest.importorskip("torch")
+
+
+def _copy_rnn_weights(pd, th, layers, directions, lstm_or_gru):
+    for layer in range(layers):
+        for d in range(directions):
+            sfx = "_reverse" if d == 1 else ""
+            for nm in ("weight_ih", "weight_hh", "bias_ih", "bias_hh"):
+                src = getattr(th, f"{nm}_l{layer}{sfx}")
+                getattr(pd, f"{nm}_l{layer}{sfx}").set_value(
+                    src.detach().numpy())
+
+
+@pytest.mark.parametrize("mode,paddle_cls,torch_cls", [
+    ("rnn", nn.SimpleRNN, torch.nn.RNN),
+    ("lstm", nn.LSTM, torch.nn.LSTM),
+    ("gru", nn.GRU, torch.nn.GRU),
+])
+@pytest.mark.parametrize("bidi", [False, True])
+def test_fused_rnn_matches_torch(mode, paddle_cls, torch_cls, bidi):
+    B, T, I, H, L = 3, 5, 4, 6, 2
+    x = np.random.RandomState(0).randn(B, T, I).astype(np.float32)
+    th = torch_cls(I, H, num_layers=L, batch_first=True,
+                   bidirectional=bidi)
+    pd = paddle_cls(I, H, num_layers=L,
+                    direction="bidirectional" if bidi else "forward")
+    _copy_rnn_weights(pd, th, L, 2 if bidi else 1, mode)
+
+    with torch.no_grad():
+        t_out, t_state = th(torch.from_numpy(x))
+    p_out, p_state = pd(paddle.to_tensor(x))
+    np.testing.assert_allclose(p_out.numpy(), t_out.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    if mode == "lstm":
+        np.testing.assert_allclose(p_state[0].numpy(), t_state[0].numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(p_state[1].numpy(), t_state[1].numpy(),
+                                   rtol=1e-4, atol=1e-5)
+    else:
+        np.testing.assert_allclose(p_state.numpy(), t_state.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_backward_finite_difference():
+    """Analytic LSTM grads vs finite differences of the loss."""
+    B, T, I, H = 2, 3, 3, 4
+    x_np = np.random.RandomState(1).randn(B, T, I).astype(np.float32)
+    paddle.seed(0)
+    net = nn.LSTM(I, H)
+
+    def loss_value():
+        out, _ = net(paddle.to_tensor(x_np))
+        return float(out.sum().numpy())
+
+    out, _ = net(paddle.to_tensor(x_np))
+    out.sum().backward()
+    w = net.weight_ih_l0
+    analytic = w.grad.numpy()
+
+    h = 1e-3
+    w_np = w.numpy().copy()
+    for idx in [(0, 0), (3, 2), (2 * H, 1)]:
+        pert = w_np.copy()
+        pert[idx] += h
+        w.set_value(pert)
+        fp = loss_value()
+        pert[idx] -= 2 * h
+        w.set_value(pert)
+        fm = loss_value()
+        w.set_value(w_np)
+        numeric = (fp - fm) / (2 * h)
+        np.testing.assert_allclose(analytic[idx], numeric, rtol=2e-2,
+                                   atol=2e-3)
+
+
+def test_cells_match_fused_single_step():
+    """Single-step cells agree with the fused scan at T=1."""
+    B, I, H = 2, 3, 4
+    x = np.random.RandomState(2).randn(B, 1, I).astype(np.float32)
+    paddle.seed(0)
+    lstm = nn.LSTM(I, H)
+    cell = nn.LSTMCell(I, H)
+    cell.weight_ih.set_value(lstm.weight_ih_l0.numpy())
+    cell.weight_hh.set_value(lstm.weight_hh_l0.numpy())
+    cell.bias_ih.set_value(lstm.bias_ih_l0.numpy())
+    cell.bias_hh.set_value(lstm.bias_hh_l0.numpy())
+    out, (hn, cn) = lstm(paddle.to_tensor(x))
+    y, (h1, c1) = cell(paddle.to_tensor(x[:, 0]))
+    np.testing.assert_allclose(out.numpy()[:, 0], y.numpy(), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(hn.numpy()[0], h1.numpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_rnn_wrapper_and_birnn():
+    B, T, I, H = 2, 4, 3, 5
+    x = paddle.to_tensor(np.random.RandomState(3).randn(B, T, I)
+                         .astype(np.float32))
+    out, st = nn.RNN(nn.GRUCell(I, H))(x)
+    assert out.shape == [B, T, H]
+    out2, st2 = nn.BiRNN(nn.SimpleRNNCell(I, H), nn.SimpleRNNCell(I, H))(x)
+    assert out2.shape == [B, T, 2 * H]
+
+
+def test_sequence_length_masking():
+    B, T, I, H = 3, 5, 3, 4
+    x = np.random.RandomState(4).randn(B, T, I).astype(np.float32)
+    sl = np.array([2, 5, 3], np.int64)
+    net = nn.GRU(I, H)
+    out, hn = net(paddle.to_tensor(x), sequence_length=paddle.to_tensor(sl))
+    o = out.numpy()
+    assert np.abs(o[0, 2:]).max() == 0.0
+    assert np.abs(o[2, 3:]).max() == 0.0
+    assert np.abs(o[1]).min() >= 0.0  # full length untouched
+    # final state == state at the last VALID step
+    out_full, _ = net(paddle.to_tensor(x[:1, :2]))
+    np.testing.assert_allclose(hn.numpy()[0, 0], out_full.numpy()[0, -1],
+                               rtol=1e-5, atol=1e-6)
