@@ -1,13 +1,21 @@
 """Data loading: Dataset, DataLoader, samplers.
 
-Parity with /root/reference/python/paddle/io/ (reader.py:262 DataLoader).
-Round-1 design: thread-prefetching host pipeline feeding device tensors;
-multiprocess workers land with the C++ data runtime.
+Parity with /root/reference/python/paddle/io/ (reader.py:262 DataLoader,
+multiprocess path _DataLoaderIterMultiProcess).
+
+Worker model: `num_workers > 0` forks real worker PROCESSES.  Each worker
+receives batch-index assignments over its own index queue, runs the (numpy
+level) collate in-process, and ships results through a shared result queue;
+the parent reorders by batch id and converts to device tensors.  Workers
+never touch jax, so no device state crosses the fork.  Set
+`use_multiprocess=False` (or env PADDLE_TPU_LOADER_THREADS=1) to keep the
+round-1 thread-prefetch pipeline.
 """
 from __future__ import annotations
 
 import itertools
 import math
+import os
 import queue
 import threading
 
@@ -301,6 +309,198 @@ def default_collate_fn(batch):
     return batch
 
 
+def _np_collate(batch):
+    """Worker-side collate: numpy only (workers must not initialize jax)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    if isinstance(sample, (list, tuple)):
+        return tuple(_np_collate(list(items)) for items in zip(*batch))
+    if isinstance(sample, dict):
+        return {k: _np_collate([d[k] for d in batch]) for k in sample}
+    return batch
+
+
+def _to_device(obj):
+    """Parent-side: numpy trees from workers -> device tensors."""
+    if isinstance(obj, np.ndarray):
+        return to_tensor(obj)
+    if isinstance(obj, tuple):
+        return tuple(_to_device(o) for o in obj)
+    if isinstance(obj, list):
+        return [_to_device(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _to_device(v) for k, v in obj.items()}
+    return obj
+
+
+class _ExcInfo:
+    def __init__(self, exc):
+        import traceback
+        self.msg = "".join(traceback.format_exception(
+            type(exc), exc, exc.__traceback__))
+        self.type_name = type(exc).__name__
+
+    def reraise(self):
+        raise RuntimeError(
+            f"DataLoader worker raised {self.type_name}:\n{self.msg}")
+
+
+def _map_worker_loop(dataset, index_q, result_q, collate, worker_id,
+                     num_workers, worker_init_fn):
+    """Map-style worker: pull (batch_id, indices), collate, ship numpy."""
+    global _worker_info
+    _worker_info = _WorkerInfo(worker_id, num_workers, dataset)
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        task = index_q.get()
+        if task is None:
+            break
+        bid, indices = task
+        try:
+            batch = collate([dataset[i] for i in indices])
+            result_q.put((bid, batch))
+        except Exception as e:  # noqa: BLE001 — surfaced in the parent
+            result_q.put((bid, _ExcInfo(e)))
+    result_q.put((-1, worker_id))  # drained
+
+
+def _iterable_worker_loop(dataset, result_q, collate, batch_size, drop_last,
+                          worker_id, num_workers, worker_init_fn):
+    """Iterable-style worker: each worker iterates the dataset with
+    get_worker_info() set (sharding is the dataset's responsibility,
+    reference reader.py iterable semantics)."""
+    global _worker_info
+    _worker_info = _WorkerInfo(worker_id, num_workers, dataset)
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    try:
+        batch = []
+        for sample in dataset:
+            batch.append(sample)
+            if len(batch) == batch_size:
+                result_q.put((0, collate(batch)))
+                batch = []
+        if batch and not drop_last:
+            result_q.put((0, collate(batch)))
+    except Exception as e:  # noqa: BLE001
+        result_q.put((0, _ExcInfo(e)))
+    result_q.put((-1, worker_id))
+
+
+class _MultiprocessIter:
+    """Parent-side driver: distributes batch ids round-robin over per-worker
+    index queues, reorders results by batch id, converts to device tensors.
+    Graceful shutdown: sentinels + join, terminate stragglers."""
+
+    def __init__(self, loader):
+        import multiprocessing as mp
+        ctx = mp.get_context("fork")
+        self.loader = loader
+        self.timeout = loader.timeout or None
+        self.result_q = ctx.Queue()
+        self.workers = []
+        self.index_qs = []
+        n = loader.num_workers
+        collate = loader._worker_collate
+        if loader._iterable:
+            self._total = None
+            for w in range(n):
+                p = ctx.Process(
+                    target=_iterable_worker_loop,
+                    args=(loader.dataset, self.result_q, collate,
+                          loader.batch_size, loader.drop_last, w, n,
+                          loader.worker_init_fn),
+                    daemon=True)
+                p.start()
+                self.workers.append(p)
+        else:
+            batches = list(loader.batch_sampler) \
+                if loader.batch_sampler is not None \
+                else [[i] for i in range(len(loader.dataset))]
+            self._total = len(batches)
+            for w in range(n):
+                iq = ctx.Queue()
+                self.index_qs.append(iq)
+                p = ctx.Process(
+                    target=_map_worker_loop,
+                    args=(loader.dataset, iq, self.result_q, collate, w, n,
+                          loader.worker_init_fn),
+                    daemon=True)
+                p.start()
+                self.workers.append(p)
+            for bid, idxs in enumerate(batches):
+                self.index_qs[bid % n].put((bid, list(idxs)))
+            for iq in self.index_qs:
+                iq.put(None)
+        self._buffer = {}
+        self._next = 0
+        self._live = n
+
+    def __iter__(self):
+        from ..profiler.timer import benchmark as _benchmark
+        bm = _benchmark()
+        try:
+            while self._live > 0 or self._buffer:
+                if self._total is not None and self._next >= self._total:
+                    break
+                bm.before_reader()
+                item = self._pull()
+                if item is None:
+                    break
+                bm.after_reader()
+                yield item
+        finally:
+            self.shutdown()
+
+    def _pull(self):
+        # ordered reassembly for map-style; arrival order for iterable
+        while True:
+            if self._total is not None and self._next in self._buffer:
+                out = self._buffer.pop(self._next)
+                self._next += 1
+                return out
+            if self._live == 0:
+                if self._total is None:
+                    return None
+                if self._next >= self._total:
+                    return None
+            try:
+                bid, payload = self.result_q.get(timeout=self.timeout)
+            except queue.Empty:
+                raise RuntimeError(
+                    f"DataLoader timed out after {self.timeout}s waiting "
+                    "for worker data")
+            if bid == -1:
+                self._live -= 1
+                continue
+            if isinstance(payload, _ExcInfo):
+                self.shutdown()
+                payload.reraise()
+            batch = _to_device(payload)
+            if self._total is None:
+                return batch
+            self._buffer[bid] = batch
+
+    def shutdown(self):
+        for iq in self.index_qs:
+            try:
+                iq.close()
+            except Exception:
+                pass
+        for p in self.workers:
+            p.join(timeout=1.0)
+        for p in self.workers:
+            if p.is_alive():
+                p.terminate()
+        self.workers = []
+
+
 class DataLoader:
     """Batched, shuffled, prefetching loader.
 
@@ -313,11 +513,18 @@ class DataLoader:
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
-                 worker_init_fn=None, persistent_workers=False):
+                 worker_init_fn=None, persistent_workers=False,
+                 use_multiprocess=True):
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
+        # workers run numpy-level collate (no jax in child processes)
+        self._worker_collate = collate_fn or _np_collate
         self.num_workers = num_workers
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.use_multiprocess = use_multiprocess and not int(
+            os.environ.get("PADDLE_TPU_LOADER_THREADS", "0"))
         self.prefetch_factor = max(2, prefetch_factor)
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
@@ -374,6 +581,9 @@ class DataLoader:
                     return
                 bm.after_reader()
                 yield item
+        if self.use_multiprocess and self.num_workers > 0:
+            yield from _MultiprocessIter(self)
+            return
         q: queue.Queue = queue.Queue(maxsize=self.prefetch_factor * self.num_workers)
         sentinel = object()
 
