@@ -121,7 +121,7 @@ class GradScaler:
         self._dynamic = use_dynamic_loss_scaling
         self._good_steps = 0
         self._bad_steps = 0
-        self._found_inf = False
+        self._found_inf_t = None   # DEVICE bool; host-synced only in update()
         self._unscaled = False
 
     def is_enable(self):
@@ -147,8 +147,10 @@ class GradScaler:
             return
         self._unscaled = True
         inv = 1.0 / self._scale
-        # one fused finite-check with a single host sync at the end — per-grad
-        # bool() syncs would stall the TPU dispatch queue once per parameter
+        # fused finite-check kept ON DEVICE: found_inf stays a device bool
+        # through step() (the optimizer masks its update with it) and is
+        # host-synced exactly once, in update() — matching the reference's
+        # tensor-found_inf flow (python/paddle/amp/grad_scaler.py)
         bad_count = jnp.zeros((), jnp.int32)
         for p in (optimizer._parameter_list or []):
             g = p._grad
@@ -157,7 +159,7 @@ class GradScaler:
             arr = g._data.astype(jnp.float32) * inv
             bad_count = bad_count + jnp.sum(~jnp.isfinite(arr)).astype(jnp.int32)
             g._data = arr.astype(g._data.dtype) if g._data.dtype != jnp.float32 else arr
-        self._found_inf = bool(bad_count > 0)
+        self._found_inf_t = bad_count > 0
 
     def step(self, optimizer):
         """Unscale (if the user hasn't already) and step when grads are
@@ -167,19 +169,29 @@ class GradScaler:
             optimizer.step()
             return
         self.unscale_(optimizer)
-        if not self._found_inf:
+        # no host sync: the compiled optimizer update is masked by the
+        # device-side found_inf bool
+        optimizer._skip_update_mask = self._found_inf_t
+        try:
             optimizer.step()
+        finally:
+            optimizer._skip_update_mask = None
 
     def minimize(self, optimizer, scaled_loss):
         self.step(optimizer)
         self.update()
 
+    @property
+    def _found_inf(self):
+        t = self._found_inf_t
+        return bool(t) if t is not None else False
+
     def update(self):
         self._unscaled = False
         if not (self._enable and self._dynamic):
-            self._found_inf = False
+            self._found_inf_t = None
             return
-        if self._found_inf:
+        if self._found_inf:   # the step's single host sync
             self._bad_steps += 1
             self._good_steps = 0
             if self._bad_steps >= self._decr_every_n:
@@ -191,7 +203,7 @@ class GradScaler:
             if self._good_steps >= self._incr_every_n:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
-        self._found_inf = False
+        self._found_inf_t = None
 
     def state_dict(self):
         return {"scale": self._scale, "incr_ratio": self._incr_ratio,

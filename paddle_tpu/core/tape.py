@@ -199,8 +199,11 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
             )
 
         buf = buffers.pop(id(node), [None] * len(node.out_meta))
+        # cotangents must carry the recorded OUTPUT dtype — under AMP a bf16
+        # output can receive an f32 cotangent from a mixed-precision consumer
         cts = tuple(
-            b if b is not None else _zero_cotangent(shape, dt)
+            (b.astype(dt) if b.dtype != dt else b) if b is not None
+            else _zero_cotangent(shape, dt)
             for b, (shape, dt) in zip(buf, node.out_meta)
         )
         cotangents = cts if len(cts) > 1 else cts[0]

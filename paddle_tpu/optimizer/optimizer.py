@@ -82,13 +82,21 @@ class Optimizer:
         if cached is not None:
             return cached
         slot_names = tuple(self._slot_names())
-        _, param_lrs, wds = key
+        _, param_lrs, wds, masked = key
 
-        def run(params, grads, states, lr, extra):
+        def run(params, grads, states, lr, extra, *maybe_mask):
+            # masked variant: skip_mask is a DEVICE bool (AMP found_inf) —
+            # when true the whole update is an identity, so the found_inf
+            # decision never forces a host sync inside step()
+            mask = maybe_mask[0] if masked else None
             new_params, new_states = [], []
             for p, g, st, plr, wd in zip(params, grads, states, param_lrs, wds):
                 np_, nst = self._update_arrays(p, g, dict(zip(slot_names, st)),
                                               lr, plr, wd, extra)
+                if masked:
+                    np_ = jnp.where(mask, p, np_)
+                    nst = {n: jnp.where(mask, st[i], nst[n])
+                           for i, n in enumerate(slot_names)}
                 new_params.append(np_)
                 new_states.append(tuple(nst[n] for n in slot_names))
             return new_params, new_states
@@ -141,9 +149,13 @@ class Optimizer:
         wds = tuple(self._weight_decay_for(p) for p, _ in params_grads)
         extra = self._extra_args()
 
-        key = (tuple((tuple(p.shape), str(p.dtype)) for p in params), param_lrs, wds)
-        new_params, new_states = self._compiled_step(key)(
-            params, grads, states, lr, extra)
+        mask = getattr(self, "_skip_update_mask", None)
+        key = (tuple((tuple(p.shape), str(p.dtype)) for p in params),
+               param_lrs, wds, mask is not None)
+        args = (params, grads, states, lr, extra)
+        if mask is not None:
+            args = args + (mask,)
+        new_params, new_states = self._compiled_step(key)(*args)
 
         for (p, _), np_, nst in zip(params_grads, new_params, new_states):
             p._data = np_
