@@ -67,6 +67,12 @@ class OpSpec:
     aliases: list[str] = dataclasses.field(default_factory=list)
     inplace: str | None = None
     differentiable: bool = True
+    # kernel-driven ops (yaml as TRUE source): "module:function" of the jnp
+    # kernel; the public wrapper is then GENERATED (op_wrappers.py) and
+    # adding an op = one yaml entry + one jnp kernel (reference
+    # ops.yaml:8-18 kernel/backward fields)
+    kernel: str | None = None
+    backward: str | None = None
 
     def resolve(self):
         """Import and return the implementing callable."""
@@ -85,6 +91,10 @@ class OpSpec:
             d["inplace"] = self.inplace
         if not self.differentiable:
             d["differentiable"] = False
+        if self.kernel:
+            d["kernel"] = self.kernel
+        if self.backward:
+            d["backward"] = self.backward
         return d
 
     @classmethod
@@ -97,6 +107,8 @@ class OpSpec:
             aliases=list(d.get("aliases", [])),
             inplace=d.get("inplace"),
             differentiable=bool(d.get("differentiable", True)),
+            kernel=d.get("kernel"),
+            backward=d.get("backward"),
         )
 
 
@@ -134,6 +146,10 @@ def dump_schema(specs: list[OpSpec], path: Path | None = None):
             lines.append(f"  inplace: {s.inplace}")
         if not s.differentiable:
             lines.append("  differentiable: false")
+        if s.kernel:
+            lines.append(f"  kernel: {s.kernel}")
+        if s.backward:
+            lines.append(f"  backward: {s.backward}")
         lines.append("")
     path.write_text("\n".join(lines))
     return path

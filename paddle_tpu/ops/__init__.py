@@ -8,8 +8,9 @@ modules.
 from __future__ import annotations
 
 from . import creation, indexing, linalg, logic, manipulation, math, random
+from .generated import op_wrappers
 
-_MODULES = (math, manipulation, logic, linalg, creation, random)
+_MODULES = (math, manipulation, logic, linalg, creation, random, op_wrappers)
 
 
 def _collect():

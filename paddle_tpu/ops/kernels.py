@@ -1,0 +1,76 @@
+"""jnp kernels for kernel-driven schema ops.
+
+Adding an op to the framework = one entry in ops/ops.yaml with a
+``kernel: paddle_tpu.ops.kernels:<fn>`` field + the jnp kernel here; then
+``python -m paddle_tpu.codegen`` regenerates the public wrapper, registry,
+Tensor-method binding and typing stub (the reference's five-generator
+pipeline, SURVEY.md §2.2, collapsed to one).
+
+Kernels receive raw jax arrays (the dispatcher unwraps Tensors) plus the
+schema's non-Tensor attrs as keyword arguments, and return arrays.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sinc(x):
+    # normalized sinc (reference paddle.sinc): sin(pi x)/(pi x), 1 at 0
+    return jnp.sinc(x)
+
+
+def trapezoid(y, *maybe_x, dx=1.0, axis=-1, _has_x=False):
+    if _has_x:
+        return jnp.trapezoid(y, x=maybe_x[0], axis=axis)
+    return jnp.trapezoid(y, dx=dx, axis=axis)
+
+
+def cumulative_trapezoid(y, *maybe_x, dx=1.0, axis=-1, _has_x=False):
+    x = maybe_x[0] if _has_x else None
+    # cumulative integral with len-1 along axis (matches
+    # scipy.integrate.cumulative_trapezoid / reference semantics)
+    n = y.shape[axis]
+    ya = jnp.moveaxis(y, axis, -1)
+    mids = (ya[..., 1:] + ya[..., :-1]) * 0.5
+    if x is not None:
+        xa = jnp.moveaxis(jnp.broadcast_to(x, y.shape), axis, -1) \
+            if x.ndim == y.ndim else x
+        if xa.ndim == 1:
+            d = xa[1:] - xa[:-1]
+        else:
+            d = xa[..., 1:] - xa[..., :-1]
+        out = jnp.cumsum(mids * d, axis=-1)
+    else:
+        out = jnp.cumsum(mids * dx, axis=-1)
+    del n
+    return jnp.moveaxis(out, -1, axis)
+
+
+def polygamma(x, n=1):
+    from jax.scipy.special import polygamma as _pg
+    return _pg(n, x)
+
+
+def i0e(x):
+    from jax.scipy.special import i0e as _i0e
+    return _i0e(x)
+
+
+def i1e(x):
+    from jax.scipy.special import i1e as _i1e
+    return _i1e(x)
+
+
+def pdist(x, p=2.0):
+    # pairwise distances, condensed upper-triangular form [n*(n-1)/2].
+    # select the strict upper triangle BEFORE the root so the zero diagonal
+    # never feeds sqrt's gradient (0 * inf -> nan in the vjp otherwise)
+    n = x.shape[0]
+    diff = x[:, None, :] - x[None, :, :]
+    iu = jnp.triu_indices(n, k=1)
+    if p == 2.0:
+        sq = jnp.sum(diff * diff, axis=-1)[iu]
+        return jnp.sqrt(sq)
+    ab = jnp.sum(jnp.abs(diff) ** p, axis=-1)[iu]
+    return ab ** (1.0 / p)

@@ -188,3 +188,52 @@ def test_op(spec):
         spec.np_ref = lambda a: 0.5 * a * (
             1 + np.vectorize(_m.erf)(a / np.sqrt(2.0)))
     spec.run()
+
+
+# -- kernel-driven schema ops (ops.yaml `kernel:` field -> generated
+# wrappers; adding an op = yaml entry + jnp kernel) ------------------------
+import math as _math
+
+
+def _sinc_np(a):
+    return np.sinc(a)
+
+
+KERNEL_OPS = [
+    OpSpec("sinc", paddle.sinc, _sinc_np, [_f(3, 4)]),
+    OpSpec("trapezoid", paddle.trapezoid,
+           lambda y, axis=-1: np.trapezoid(y, axis=axis)
+           if hasattr(np, "trapezoid") else np.trapz(y, axis=axis),
+           [_f(3, 5)]),
+    OpSpec("cumulative_trapezoid", paddle.cumulative_trapezoid,
+           lambda y: np.cumsum((y[..., 1:] + y[..., :-1]) * 0.5, axis=-1),
+           [_f(3, 5)]),
+    OpSpec("i0e", paddle.i0e,
+           lambda a: np.vectorize(
+               lambda v: float(__import__("scipy.special",
+                                          fromlist=["i0e"]).i0e(v)))(a),
+           [_pos(3, 4)], fwd_tol=1e-4, grad_tol=1e-2),
+    OpSpec("pdist", paddle.pdist,
+           lambda x, p=2.0: np.sqrt(
+               ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1))[
+               np.triu_indices(x.shape[0], k=1)],
+           [_f(4, 3)], grad_tol=1e-2),
+]
+
+
+@pytest.mark.parametrize("spec", KERNEL_OPS, ids=[s.name for s in KERNEL_OPS])
+def test_kernel_driven_op(spec):
+    spec.run()
+
+
+def test_adding_an_op_is_yaml_plus_kernel():
+    """The codegen contract: every yaml entry with a kernel field produces a
+    working public wrapper, Tensor method (when declared), and registry
+    entry."""
+    from paddle_tpu.ops.generated import OP_REGISTRY
+    from paddle_tpu.ops.generated import op_wrappers
+    spec = OP_REGISTRY["sinc"]
+    assert spec.kernel == "paddle_tpu.ops.kernels:sinc"
+    assert callable(getattr(op_wrappers, "sinc"))
+    t = paddle.to_tensor(np.array([0.5], np.float32))
+    np.testing.assert_allclose(t.sinc().numpy(), np.sinc(0.5), rtol=1e-6)
