@@ -78,6 +78,7 @@ from . import nn  # noqa: E402
 from . import optimizer  # noqa: E402
 from . import signal  # noqa: E402
 from . import sparse  # noqa: E402
+from . import utils  # noqa: E402
 from . import vision  # noqa: E402
 
 from .framework.io import load, save  # noqa: E402

@@ -214,3 +214,5 @@ class GradScaler:
         self._scale = state.get("scale", self._scale)
         self._good_steps = state.get("incr_count", 0)
         self._bad_steps = state.get("decr_count", 0)
+
+from . import debugging  # noqa: E402,F401

@@ -1,0 +1,166 @@
+"""AMP debugging tools: tensor checker + operator stats.
+
+Parity with /root/reference/python/paddle/amp/debugging.py
+(TensorCheckerConfig :173, enable_tensor_checker/disable_tensor_checker,
+check_numerics, enable_operator_stats_collection).  The checker rides the
+dispatcher's per-op output hook (the analog of the reference's generated
+ad_func CheckTensorHasNanOrInf calls, paddle/fluid/eager/nan_inf_utils.h:38).
+"""
+from __future__ import annotations
+
+import enum
+import logging
+
+import jax.numpy as jnp
+
+__all__ = ["DebugMode", "TensorCheckerConfig", "enable_tensor_checker",
+           "disable_tensor_checker", "check_numerics",
+           "enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats"]
+
+_log = logging.getLogger("paddle_tpu.amp.debugging")
+
+
+class DebugMode(enum.Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 2
+
+
+class TensorCheckerConfig:
+    """Per-op numeric checking policy.
+
+    enable: master switch.  debug_mode: abort vs log.  checked_op_list /
+    skipped_op_list: restrict which dispatcher ops are checked.
+    debug_step: optional (start, end) step window; advance with
+    update_and_check_step_id() once per iteration (the reference's
+    TensorCheckerConfig semantics)."""
+
+    def __init__(self, enable, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=1):
+        self.enable = bool(enable)
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = set(checked_op_list or [])
+        self.skipped_op_list = set(skipped_op_list or [])
+        self.debug_step = debug_step
+        self.stack_height_limit = stack_height_limit
+        self._step = 0
+
+    def update_and_check_step_id(self):
+        self._step += 1
+        return self._in_window()
+
+    def _in_window(self):
+        if self.debug_step is None:
+            return True
+        lo, hi = self.debug_step
+        return lo <= self._step <= hi
+
+    def _should_check(self, op_name):
+        if not self.enable or not self._in_window():
+            return False
+        if op_name in self.skipped_op_list:
+            return False
+        if self.checked_op_list and op_name not in self.checked_op_list:
+            return False
+        return True
+
+
+_active_config: TensorCheckerConfig | None = None
+
+
+def _checker_cb(op_name, out_arrays):
+    cfg = _active_config
+    if cfg is None or not cfg._should_check(op_name):
+        return
+    for a in out_arrays:
+        if not jnp.issubdtype(a.dtype, jnp.inexact):
+            continue
+        bad = int(jnp.sum(~jnp.isfinite(a)))
+        if bad:
+            msg = (f"[tensor checker] op '{op_name}' produced {bad} "
+                   f"non-finite values (shape={tuple(a.shape)}, "
+                   f"dtype={a.dtype})")
+            if cfg.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+                raise FloatingPointError(msg)
+            _log.warning(msg)
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig):
+    global _active_config
+    _active_config = checker_config
+    from ..core import dispatch
+    dispatch.set_tensor_checker(_checker_cb)
+
+
+def disable_tensor_checker():
+    global _active_config
+    _active_config = None
+    from ..core import dispatch
+    dispatch.set_tensor_checker(None)
+
+
+def check_numerics(tensor, op_type="", var_name="",
+                   debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """Explicit one-tensor check (reference paddle.amp.debugging.check_numerics)."""
+    arr = tensor._data if hasattr(tensor, "_data") else jnp.asarray(tensor)
+    bad = int(jnp.sum(~jnp.isfinite(arr))) \
+        if jnp.issubdtype(arr.dtype, jnp.inexact) else 0
+    if bad:
+        msg = (f"[check_numerics] {op_type}:{var_name} has {bad} non-finite "
+               f"values (shape={tuple(arr.shape)})")
+        if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+            raise FloatingPointError(msg)
+        _log.warning(msg)
+    return tensor
+
+
+# --- operator stats (reference enable_operator_stats_collection) ----------
+
+_op_stats: dict | None = None
+_prev_observer = None
+
+
+def enable_operator_stats_collection():
+    """Count dispatcher ops by name until disabled (the reference collects
+    per-dtype op calls during an autocast block)."""
+    global _op_stats, _prev_observer
+    from ..core import dispatch
+    _op_stats = {}
+
+    def obs(op_name, t0, dur_ns):
+        rec = _op_stats.setdefault(op_name, [0, 0])
+        rec[0] += 1
+        rec[1] += dur_ns
+
+    _prev_observer = dispatch.get_op_observer()
+    dispatch.set_op_observer(obs)
+
+
+def disable_operator_stats_collection():
+    global _op_stats
+    from ..core import dispatch
+    dispatch.set_op_observer(_prev_observer)
+    stats = _op_stats or {}
+    _op_stats = None
+    lines = ["<------------------------------ op list ------------------"
+             "------------>",
+             f"{'op name':<40} {'calls':>8} {'total us':>12}"]
+    for name, (n, ns) in sorted(stats.items(), key=lambda kv: -kv[1][1]):
+        lines.append(f"{name:<40} {n:>8} {ns / 1000.0:>12.1f}")
+    print("\n".join(lines))
+    return stats
+
+
+class collect_operator_stats:
+    """Context manager variant."""
+
+    def __enter__(self):
+        enable_operator_stats_collection()
+        return self
+
+    def __exit__(self, *exc):
+        disable_operator_stats_collection()
+        return False

@@ -110,6 +110,24 @@ _fwd_vjp_cache: dict = {}
 # codegen'd per-op RecordEvent annotations (eager_gen.py:324).
 _op_observer = None
 
+# set by paddle_tpu.amp.debugging: callable(op_name, out_arrays) or None —
+# the per-op numeric checker hook (reference nan_inf_utils.h:38 call sites).
+_tensor_checker = None
+
+
+def set_op_observer(obs):
+    global _op_observer
+    _op_observer = obs
+
+
+def get_op_observer():
+    return _op_observer
+
+
+def set_tensor_checker(cb):
+    global _tensor_checker
+    _tensor_checker = cb
+
 
 def _plain_exec(fn: Callable, static_items: tuple):
     key = (_fn_key(fn), static_items)
@@ -253,6 +271,8 @@ def apply(op_name: str, fn: Callable, tensor_args: Sequence[Any],
 
     if get_flag("check_nan_inf"):
         _check_nan_inf(op_name, out_arrays)
+    if _tensor_checker is not None:
+        _tensor_checker(op_name, out_arrays)
 
     out_tensors = tuple(
         Tensor(a, stop_gradient=not grad_on) for a in out_arrays
@@ -260,6 +280,12 @@ def apply(op_name: str, fn: Callable, tensor_args: Sequence[Any],
 
     if grad_on:
         node = GradNode(op_name, vjp_fn, mask, parents, out_tensors)
+        # functional-replay record for higher-order grad: parents feed their
+        # positions at replay time; everything else is a baked constant
+        node.replay = (
+            fn, dict(static_items),
+            tuple(None if (p is not None and m) else a
+                  for p, m, a in zip(parents, mask, arrays)))
         for i, t in enumerate(out_tensors):
             t._grad_node = node
             t._output_index = i

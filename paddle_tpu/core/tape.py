@@ -24,7 +24,7 @@ class GradNode:
 
     __slots__ = (
         "op_name", "vjp_fn", "mask", "parents", "out_meta", "_hooks",
-        "released", "__weakref__",
+        "released", "replay", "__weakref__",
     )
 
     def __init__(self, op_name, vjp_fn, mask, parents, out_tensors):
@@ -38,10 +38,15 @@ class GradNode:
         self.out_meta = [(tuple(t.shape), t.dtype.np_dtype) for t in out_tensors]
         self._hooks = []
         self.released = False
+        # (fn, static_kwargs, const_arrays) for the functional-replay path
+        # (higher-order grad): const_arrays holds the non-parent inputs,
+        # None marks positions fed by parent tensors.
+        self.replay = None
 
     def release(self):
         self.vjp_fn = None
         self.parents = None
+        self.replay = None
         self.released = True
 
 
@@ -302,10 +307,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     if isinstance(inputs, Tensor):
         inputs = [inputs]
     if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (higher-order eager grad) is not supported yet; "
-            "use the functional jax transforms via paddle_tpu.jit for that."
-        )
+        return _grad_create_graph(outputs, inputs, grad_outputs,
+                                  allow_unused)
 
     capture = {id(t): t for t in inputs}
     captured: dict[int, object] = {}
@@ -325,3 +328,87 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         else:
             results.append(Tensor(g, stop_gradient=True))
     return results
+
+
+def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
+    """Higher-order paddle.grad by FUNCTIONAL REPLAY (TPU-idiomatic design
+    for the reference's higher-order eager AD, general_grad.h): rebuild a
+    pure jax function from the recorded op DAG (each GradNode kept its
+    forward fn + static attrs + constant inputs), differentiate it with
+    jax.vjp, and run the result through the dispatcher — so the produced
+    grads are themselves recorded tensors, differentiable to any order."""
+    from .tensor import Tensor
+    from . import dispatch as D
+
+    in_ids = {id(t): i for i, t in enumerate(inputs)}
+
+    def replay(*in_arrays):
+        node_cache: dict[int, tuple] = {}
+
+        def tensor_value(t):
+            if id(t) in in_ids:
+                return in_arrays[in_ids[id(t)]]
+            node = t._grad_node
+            if node is None:
+                return t._data          # leaf/constant (incl stop_gradient)
+            if node.replay is None:
+                if node.released:
+                    raise RuntimeError(
+                        "create_graph replay hit a released node; run the "
+                        "first backward with retain_graph=True")
+                raise NotImplementedError(
+                    f"create_graph through op '{node.op_name}' (custom "
+                    "PyLayer backward) is not supported — express it as "
+                    "regular ops for higher-order grad")
+            outs = node_value(node)
+            return outs[t._output_index]
+
+        def node_value(node):
+            got = node_cache.get(id(node))
+            if got is not None:
+                return got
+            if node.released:
+                raise RuntimeError(
+                    "create_graph replay hit a released node; the first "
+                    "backward must use retain_graph=True (or be this call)")
+            fn, kwargs, consts = node.replay
+            args = []
+            for p, c in zip(node.parents, consts):
+                args.append(tensor_value(p) if p is not None else c)
+            out = fn(*args, **kwargs)
+            outs = tuple(out) if isinstance(out, (tuple, list)) else (out,)
+            node_cache[id(node)] = outs
+            return outs
+
+        return tuple(tensor_value(t) for t in outputs)
+
+    if grad_outputs is None:
+        seeds = tuple(jnp.ones(tuple(t.shape), t._data.dtype)
+                      for t in outputs)
+    else:
+        gs = grad_outputs if isinstance(grad_outputs, (list, tuple)) \
+            else [grad_outputs]
+        seeds = tuple(
+            (g._data if isinstance(g, Tensor) else jnp.asarray(g))
+            if g is not None else jnp.ones(tuple(t.shape), t._data.dtype)
+            for g, t in zip(gs, outputs))
+
+    n_in = len(inputs)
+
+    def grad_fn(*arrays):
+        in_arrays = arrays[:n_in]
+        seed_arrays = arrays[n_in:]
+        _, vjp = jax.vjp(replay, *in_arrays)
+        gs = vjp(tuple(seed_arrays))
+        # single-input: return the bare array (the dispatcher's 1-output
+        # convention — a 1-tuple would desync the recorded vjp structure)
+        return gs if n_in > 1 else gs[0]
+
+    results = D.apply("higher_order_grad", grad_fn,
+                      tuple(inputs) + tuple(Tensor(s) for s in seeds), {})
+    results = list(results) if isinstance(results, (tuple, list)) \
+        else [results]
+    out = []
+    for t, g in zip(inputs, results):
+        out.append(g)
+    return out

@@ -1,1 +1,4 @@
+"""Utility namespace (reference python/paddle/utils/)."""
+from . import cpp_extension  # noqa: F401
 
+__all__ = ["cpp_extension"]

@@ -1,0 +1,130 @@
+"""Custom-op extension surface.
+
+Capability parity with the reference's out-of-tree op path
+(/root/reference/python/paddle/utils/cpp_extension/cpp_extension.py:92
+`setup`, :895 `load`; C ABI paddle/phi/capi/).  The TPU-native analog: a
+custom op is a jnp composition or a Pallas kernel registered into the SAME
+schema/dispatch machinery the built-in ops use — no C++ build step, because
+XLA/Mosaic are the kernel compilers.
+
+    import paddle_tpu as paddle
+
+    def my_norm_kernel(x, eps=1e-6):          # jnp or pallas_call body
+        import jax.numpy as jnp
+        return x / (jnp.abs(x).max() + eps)
+
+    paddle.utils.cpp_extension.register_op(
+        "my_norm", my_norm_kernel, tensor_args=["x"],
+        attrs={"eps": 1e-6}, tensor_method=True)
+
+    y = paddle.my_norm(paddle.randn([4]))     # public namespace
+    y = paddle.randn([4]).my_norm()           # Tensor method
+
+Autograd comes from jax.vjp over the kernel; pass ``vjp=`` for a custom
+backward (a ``jax.custom_vjp``-wrapped kernel also works unchanged).
+"""
+from __future__ import annotations
+
+import inspect
+
+__all__ = ["register_op", "registered_ops", "CppExtension", "CUDAExtension",
+           "BuildExtension", "setup", "load"]
+
+
+_REGISTERED: dict = {}
+
+
+def register_op(name, kernel, tensor_args=None, attrs=None,
+                tensor_method=False, vjp=None, num_outputs=None):
+    """Register `kernel` as public op `name` (dispatch + namespace + method).
+
+    kernel: fn(*arrays, **attrs) -> array(s) — jnp composition or a
+        function invoking pl.pallas_call (it runs under jit, so Mosaic
+        compiles it like the in-tree Pallas kernels).
+    tensor_args: ordered dynamic-input names (default: the kernel's
+        positional params).
+    attrs: default static attrs (compile-time constants).
+    vjp: optional custom backward — fn(residuals, cotangents) paired via
+        jax.custom_vjp semantics; simplest is to pass a kernel already
+        wrapped in jax.custom_vjp.
+    """
+    from ..core import dispatch as D
+    from ..core.tensor import Tensor
+
+    if vjp is not None:
+        import jax
+
+        fwd_raw = kernel
+
+        def _fwd(*a, **kw):
+            out = fwd_raw(*a, **kw)
+            return out, (a, kw)
+
+        def _bwd(res, g):
+            a, kw = res
+            return tuple(vjp(a, g, **kw))
+
+        wrapped = jax.custom_vjp(fwd_raw)
+        wrapped.defvjp(_fwd, _bwd)
+        kernel = wrapped
+
+    if tensor_args is None:
+        params = inspect.signature(kernel).parameters
+        tensor_args = [p for p, v in params.items()
+                       if v.default is inspect.Parameter.empty
+                       and v.kind in (v.POSITIONAL_ONLY,
+                                      v.POSITIONAL_OR_KEYWORD)]
+    defaults = dict(attrs or {})
+
+    def public(*args, **kwargs):
+        n = len(tensor_args)
+        tens = args[:n]
+        merged = dict(defaults)
+        merged.update(kwargs)
+        return D.apply(name, kernel, tuple(tens), merged,
+                       num_outputs=num_outputs)
+
+    public.__name__ = name
+    public.__doc__ = f"custom op {name!r} (registered via cpp_extension)"
+    _REGISTERED[name] = public
+
+    import paddle_tpu
+    from paddle_tpu import ops
+    setattr(paddle_tpu, name, public)
+    ops.PUBLIC_OPS[name] = public
+    if tensor_method:
+        setattr(Tensor, name, public)
+    return public
+
+
+def registered_ops():
+    return dict(_REGISTERED)
+
+
+# --- build-system API compat (no C++ toolchain step needed on TPU) --------
+
+class CppExtension:
+    def __init__(self, sources=None, *args, **kwargs):
+        self.sources = sources or []
+
+
+CUDAExtension = CppExtension
+
+
+class BuildExtension:
+    @classmethod
+    def with_options(cls, **options):
+        return cls
+
+
+def setup(**kwargs):
+    raise NotImplementedError(
+        "paddle_tpu custom ops are jnp/Pallas kernels registered at runtime "
+        "via register_op(); there is no C++ build step (XLA/Mosaic compile "
+        "the kernels)")
+
+
+def load(name=None, sources=None, **kwargs):
+    raise NotImplementedError(
+        "use paddle_tpu.utils.cpp_extension.register_op — custom kernels "
+        "are jnp/Pallas functions, JIT-compiled by XLA/Mosaic")

@@ -1,5 +1,6 @@
 """AMP: autocast lists, GradScaler protocol."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
@@ -66,3 +67,73 @@ def test_o2_decorate_keeps_norms_fp32():
     assert net[1].weight.dtype == paddle.float32
     y = net(paddle.randn([2, 4]).astype("bfloat16"))
     assert y.shape == [2, 2]
+
+
+def test_tensor_checker_config():
+    """amp.debugging.TensorCheckerConfig (reference debugging.py:173):
+    per-op nan/inf checking with abort/log modes and op filtering."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.amp import debugging as dbg
+
+    cfg = dbg.TensorCheckerConfig(
+        enable=True, debug_mode=dbg.DebugMode.CHECK_NAN_INF_AND_ABORT)
+    dbg.enable_tensor_checker(cfg)
+    try:
+        x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        with pytest.raises(FloatingPointError, match="divide"):
+            _ = paddle.divide(x, paddle.to_tensor(
+                np.array([1.0, 0.0], np.float32)))
+        # skipped op passes
+        cfg.skipped_op_list.add("divide")
+        _ = paddle.divide(x, paddle.to_tensor(
+            np.array([1.0, 0.0], np.float32)))
+    finally:
+        dbg.disable_tensor_checker()
+    # disabled: no check
+    _ = paddle.divide(x, paddle.to_tensor(np.array([1.0, 0.0], np.float32)))
+
+
+def test_check_numerics_and_operator_stats(capsys):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.amp import debugging as dbg
+
+    t = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    dbg.check_numerics(t, "op", "x")  # finite: no raise
+    bad = paddle.to_tensor(np.array([np.inf], np.float32))
+    with pytest.raises(FloatingPointError):
+        dbg.check_numerics(bad, "op", "x")
+
+    with dbg.collect_operator_stats():
+        _ = paddle.add(t, t)
+        _ = paddle.multiply(t, t)
+    out = capsys.readouterr().out
+    assert "add" in out and "multiply" in out
+
+
+def test_custom_op_registration():
+    """utils.cpp_extension.register_op (reference cpp_extension.py:92 /
+    phi capi custom-op slot): jnp kernel -> schema dispatch + namespace +
+    Tensor method, with autograd."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    def double_plus(x, bias=0.0):
+        import jax.numpy as jnp
+        return 2.0 * x + bias
+
+    paddle.utils.cpp_extension.register_op(
+        "double_plus", double_plus, tensor_args=["x"],
+        attrs={"bias": 0.0}, tensor_method=True)
+
+    t = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(paddle.double_plus(t, bias=1.0).numpy(),
+                               [3.0, 5.0])
+    np.testing.assert_allclose(t.double_plus().numpy(), [2.0, 4.0])
+    t.stop_gradient = False
+    paddle.double_plus(t).sum().backward()
+    np.testing.assert_allclose(t.grad.numpy(), [2.0, 2.0])

@@ -237,3 +237,42 @@ def test_embedding_padding_idx_no_grad():
     g = emb.weight.grad.numpy()
     np.testing.assert_allclose(g[0], np.zeros(3))   # padding row: zero grad
     assert np.abs(g[1]).sum() > 0
+
+
+def test_higher_order_grad_create_graph():
+    """paddle.grad(create_graph=True) via functional replay: third
+    derivatives, backward-through-grad, multi-input second order
+    (reference general_grad.h higher-order path)."""
+    x = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
+    x.stop_gradient = False
+    y = x * x * x
+    g1 = paddle.grad(y, x, create_graph=True)[0]       # 3x^2
+    np.testing.assert_allclose(g1.numpy(), [12.0, 27.0])
+    assert not g1.stop_gradient
+    g2 = paddle.grad(g1.sum(), x, create_graph=True)[0]  # 6x
+    np.testing.assert_allclose(g2.numpy(), [12.0, 18.0])
+    g3 = paddle.grad(g2.sum(), x)[0]                   # 6
+    np.testing.assert_allclose(g3.numpy(), [6.0, 6.0])
+
+
+def test_backward_through_create_graph_grad():
+    x = paddle.to_tensor(np.array([1.5], np.float32))
+    x.stop_gradient = False
+    z = paddle.sin(x)
+    gz = paddle.grad(z, x, create_graph=True)[0]       # cos
+    (gz * gz).backward()                               # -2 cos sin
+    np.testing.assert_allclose(x.grad.numpy(),
+                               -2 * np.cos(1.5) * np.sin(1.5), rtol=1e-5)
+
+
+def test_higher_order_multi_input():
+    a = paddle.to_tensor(np.array([1.0], np.float32))
+    b = paddle.to_tensor(np.array([2.0], np.float32))
+    a.stop_gradient = False
+    b.stop_gradient = False
+    f = a * b + a * a
+    ga, gb = paddle.grad(f, [a, b], create_graph=True)
+    np.testing.assert_allclose(ga.numpy(), [4.0])
+    np.testing.assert_allclose(gb.numpy(), [1.0])
+    gaa = paddle.grad(ga, a)[0]
+    np.testing.assert_allclose(gaa.numpy(), [2.0])

@@ -41,9 +41,12 @@ def test_signatures_match_schema():
 
 
 def test_public_surface_covered():
-    """Every public op exported from paddle_tpu.ops is declared in the schema."""
+    """Every public op exported from paddle_tpu.ops is declared in the schema
+    (runtime-registered custom ops are exempt — they live outside yaml by
+    design, reference custom_operator.cc)."""
     from paddle_tpu.ops import PUBLIC_OPS
-    missing = set(PUBLIC_OPS) - set(OP_REGISTRY)
+    from paddle_tpu.utils.cpp_extension import registered_ops
+    missing = set(PUBLIC_OPS) - set(OP_REGISTRY) - set(registered_ops())
     assert not missing, f"undeclared public ops: {sorted(missing)}"
 
 
