@@ -62,12 +62,14 @@ bool = bool_  # paddle.bool
 
 # Subpackages (imported lazily where heavy).
 from . import amp  # noqa: E402
+from . import audio  # noqa: E402
 from . import autograd  # noqa: E402
 from . import device  # noqa: E402
 from . import distributed  # noqa: E402
 from . import distribution  # noqa: E402
 from . import fft  # noqa: E402
 from . import framework  # noqa: E402
+from . import geometric  # noqa: E402
 from . import hapi  # noqa: E402
 from . import incubate  # noqa: E402
 from . import io  # noqa: E402
@@ -75,9 +77,11 @@ from . import jit  # noqa: E402
 from . import linalg  # noqa: E402
 from . import metric  # noqa: E402
 from . import nn  # noqa: E402
+from . import quantization  # noqa: E402
 from . import optimizer  # noqa: E402
 from . import signal  # noqa: E402
 from . import sparse  # noqa: E402
+from . import text  # noqa: E402
 from . import utils  # noqa: E402
 from . import vision  # noqa: E402
 

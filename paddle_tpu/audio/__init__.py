@@ -1,0 +1,31 @@
+"""Audio feature extraction.
+
+Capability parity with /root/reference/python/paddle/audio/ (features/
+Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC layers; functional/
+window.py get_window, functional.py hz_to_mel/mel_to_hz/
+compute_fbank_matrix/power_to_db/create_dct).  Built on the framework's own
+stft (signal.py) — batched FFTs run on the MXU-adjacent XLA FFT path, no
+soundfile backends needed for the compute surface.
+"""
+from __future__ import annotations
+
+from . import features, functional  # noqa: F401
+from .features import (  # noqa: F401
+    LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram,
+)
+
+__all__ = ["features", "functional", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC", "backends"]
+
+
+class backends:
+    """Audio IO backends (reference paddle.audio.backends): the TPU build
+    ships no soundfile dependency; list_available_backends reports that."""
+
+    @staticmethod
+    def list_available_backends():
+        return []
+
+    @staticmethod
+    def get_current_backend():
+        return None

@@ -1,0 +1,129 @@
+"""Audio functional ops (reference python/paddle/audio/functional/).
+
+hz<->mel conversion (HTK and slaney), mel filterbanks, dB conversion, DCT
+matrix, window functions — all jnp compositions.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..core import dispatch as D
+from ..core.tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def _as_arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def hz_to_mel(freq, htk=False):
+    f = _as_arr(freq).astype(jnp.float32)
+    if htk:
+        out = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:  # slaney
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(f / min_log_hz) / logstep,
+                        mels)
+    return Tensor(out) if isinstance(freq, Tensor) else out
+
+
+def mel_to_hz(mel, htk=False):
+    m = _as_arr(mel).astype(jnp.float32)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(m >= min_log_mel,
+                        min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                        freqs)
+    return Tensor(out) if isinstance(mel, Tensor) else out
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    lo = hz_to_mel(jnp.asarray(f_min), htk)
+    hi = hz_to_mel(jnp.asarray(f_max), htk)
+    mels = jnp.linspace(lo, hi, n_mels)
+    return Tensor(mel_to_hz(mels, htk))
+
+
+def fft_frequencies(sr, n_fft):
+    return Tensor(jnp.linspace(0, float(sr) / 2, 1 + n_fft // 2))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """[n_mels, 1 + n_fft//2] triangular mel filterbank."""
+    f_max = f_max if f_max is not None else float(sr) / 2
+    fft_f = jnp.linspace(0, float(sr) / 2, 1 + n_fft // 2)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)._data
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    return Tensor(weights.astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    def impl(s, ref_value, amin, top_db):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+        log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(amin, ref_value))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+
+    return D.apply("power_to_db", impl, (spect,),
+                   {"ref_value": float(ref_value), "amin": float(amin),
+                    "top_db": None if top_db is None else float(top_db)})
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """[n_mels, n_mfcc] DCT-II matrix (reference create_dct)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)[None, :]
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct = dct * jnp.where(k == 0, math.sqrt(1.0 / (4 * n_mels)),
+                              math.sqrt(1.0 / (2 * n_mels))) * 2.0
+    return Tensor(dct.astype(dtype))
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """hann/hamming/blackman/bartlett/rect window (reference window.py)."""
+    if isinstance(window, tuple):
+        window = window[0]
+    n = win_length
+    periodic = fftbins
+    m = n if periodic else n - 1
+    i = jnp.arange(n, dtype=jnp.float32)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * i / m)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * i / m)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * jnp.cos(2 * math.pi * i / m)
+             + 0.08 * jnp.cos(4 * math.pi * i / m))
+    elif window == "bartlett":
+        w = 1.0 - jnp.abs(2.0 * i / m - 1.0)
+    elif window in ("rect", "boxcar", "ones"):
+        w = jnp.ones((n,), jnp.float32)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(w.astype(dtype))

@@ -133,3 +133,7 @@ define_flag("eager_compile_cache_size", 4096, "Max cached compiled single-op exe
 define_flag("benchmark", False, "Synchronize after each op for timing (debug).")
 define_flag("use_pallas_kernels", True, "Use Pallas fused kernels where registered.")
 define_flag("log_compiles", False, "Log XLA compilations of eager ops.")
+define_flag("comm_watchdog_timeout", 0.0,
+            "Seconds before an in-flight eager collective is reported as "
+            "hung by the comm watchdog (0 disables; reference "
+            "comm_task_manager.h).")

@@ -70,8 +70,9 @@ def _program(key, mesh, body, out_spec):
     return prog
 
 
-def _local_out(garr):
-    return garr.addressable_data(0)
+def _local_out(garr, desc="collective", ranks=()):
+    from .watchdog import watch
+    return watch(desc, ranks, garr.addressable_data(0))
 
 
 REDUCERS = {
@@ -89,7 +90,7 @@ def all_reduce(local, ranks, op=0):
     g = _global(local, mesh, n)
     key = ("ar", tuple(ranks), op, g.shape, str(g.dtype))
     out = _program(key, mesh, REDUCERS[op], P())(g)
-    return _local_out(out)
+    return _local_out(out, "all_reduce", ranks)
 
 
 def all_gather(local, ranks):
@@ -99,7 +100,7 @@ def all_gather(local, ranks):
     g = _global(local, mesh, n)
     key = ("ag", tuple(ranks), g.shape, str(g.dtype))
     out = _program(key, mesh, lambda x: x, P())(g)
-    return _local_out(out)
+    return _local_out(out, "all_gather", ranks)
 
 
 def broadcast(local, ranks, src_index):
@@ -108,7 +109,7 @@ def broadcast(local, ranks, src_index):
     g = _global(local, mesh, n)
     key = ("bc", tuple(ranks), int(src_index), g.shape, str(g.dtype))
     out = _program(key, mesh, lambda x: x[src_index], P())(g)
-    return _local_out(out)
+    return _local_out(out, "broadcast", ranks)
 
 
 def reduce_scatter(local_stack, ranks, op=0):
@@ -119,7 +120,7 @@ def reduce_scatter(local_stack, ranks, op=0):
     g = _global(local_stack, mesh, n)          # [n, n, ...]
     key = ("rs", tuple(ranks), op, g.shape, str(g.dtype))
     out = _program(key, mesh, REDUCERS[op], P("world"))(g)
-    return jnp.squeeze(_local_out(out), axis=0)
+    return jnp.squeeze(_local_out(out, "reduce_scatter", ranks), axis=0)
 
 
 def all_to_all(local_stack, ranks):
@@ -131,7 +132,7 @@ def all_to_all(local_stack, ranks):
     key = ("a2a", tuple(ranks), g.shape, str(g.dtype))
     out = _program(key, mesh, lambda x: jnp.swapaxes(x, 0, 1),
                    P("world"))(g)
-    return jnp.squeeze(_local_out(out), axis=0)
+    return jnp.squeeze(_local_out(out, "all_to_all", ranks), axis=0)
 
 
 def p2p(local, ranks, src_index, dst_index):

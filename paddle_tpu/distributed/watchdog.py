@@ -1,0 +1,120 @@
+"""Communication watchdog: hang detection for in-flight collectives.
+
+TPU-native analog of the reference's CommTaskManager
+(/root/reference/paddle/phi/core/distributed/comm_task_manager.h:37 +
+nccl_comm_task.cc IsTimeout): every eager collective registers its result
+future; a daemon thread watches readiness and, past the timeout
+(FLAGS_comm_watchdog_timeout seconds, 0 disables), logs a CRITICAL
+diagnostic dump of every pending task (op, group, shape, elapsed) — the
+debugging signal a hung multi-host job needs.
+
+XLA arrays are futures (async dispatch); readiness is observed without
+blocking via jax.Array.is_ready().
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+__all__ = ["CommTaskManager", "comm_task_manager", "watch"]
+
+_log = logging.getLogger("paddle_tpu.distributed.watchdog")
+
+
+class _Task:
+    __slots__ = ("desc", "ranks", "shape", "start", "array", "reported")
+
+    def __init__(self, desc, ranks, array):
+        self.desc = desc
+        self.ranks = tuple(ranks)
+        self.shape = tuple(getattr(array, "shape", ()))
+        self.start = time.monotonic()
+        self.array = array
+        self.reported = False
+
+
+class CommTaskManager:
+    """Background watcher over registered collective futures."""
+
+    def __init__(self, poll_interval=1.0):
+        self._tasks: list[_Task] = []
+        self._lock = threading.Lock()
+        self._thread = None
+        self._poll = poll_interval
+        self._stop = threading.Event()
+
+    def _timeout(self) -> float:
+        from ..core.flags import get_flag
+        try:
+            return float(get_flag("comm_watchdog_timeout"))
+        except Exception:
+            return 0.0
+
+    def register(self, desc, ranks, array):
+        if self._timeout() <= 0:
+            return array
+        with self._lock:
+            self._tasks.append(_Task(desc, ranks, array))
+            if self._thread is None or not self._thread.is_alive():
+                self._stop.clear()
+                self._thread = threading.Thread(target=self._loop,
+                                                daemon=True,
+                                                name="comm-watchdog")
+                self._thread.start()
+        return array
+
+    def _loop(self):
+        while not self._stop.is_set():
+            time.sleep(self._poll)
+            timeout = self._timeout()
+            now = time.monotonic()
+            with self._lock:
+                still = []
+                overdue = []
+                for t in self._tasks:
+                    ready = True
+                    try:
+                        ready = bool(t.array.is_ready())
+                    except Exception:
+                        ready = True  # backend without is_ready: stop watching
+                    if ready:
+                        continue
+                    still.append(t)
+                    if timeout > 0 and now - t.start > timeout \
+                            and not t.reported:
+                        t.reported = True
+                        overdue.append(t)
+                self._tasks = still
+                empty = not still
+            for t in overdue:
+                self._dump(t, now)
+            if empty:
+                return  # thread exits when the queue drains
+
+    def _dump(self, task, now):
+        with self._lock:
+            pending = [(t.desc, t.ranks, t.shape,
+                        round(now - t.start, 1)) for t in self._tasks]
+        _log.critical(
+            "[comm watchdog] collective %r over ranks %s (shape %s) has "
+            "been in flight for %.1fs (> FLAGS_comm_watchdog_timeout). "
+            "Pending comm tasks: %s — likely a rank mismatch or a peer "
+            "process hang (reference comm_task_manager.h diagnosis dump).",
+            task.desc, task.ranks, task.shape,
+            now - task.start, pending)
+
+    def pending(self):
+        with self._lock:
+            return [(t.desc, t.ranks, t.shape) for t in self._tasks]
+
+    def shutdown(self):
+        self._stop.set()
+
+
+comm_task_manager = CommTaskManager()
+
+
+def watch(desc, ranks, array):
+    """Register an in-flight collective result with the watchdog."""
+    return comm_task_manager.register(desc, ranks, array)

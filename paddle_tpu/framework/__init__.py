@@ -18,3 +18,4 @@ _default_dtype = ["float32"]
 def set_default_dtype(d):
     from ..core.dtype import convert_dtype
     _default_dtype[0] = convert_dtype(d).name
+from . import log_helper, monitor  # noqa: E402,F401

@@ -1,0 +1,37 @@
+"""Logging helpers (reference python/paddle/base/log_helper.py).
+
+One shared formatter/handler policy for framework loggers, plus the fleet
+per-rank prefixing used by distributed launches
+(python/paddle/distributed/fleet/utils/log_util.py).
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+__all__ = ["get_logger", "set_level", "logger"]
+
+_FMT = "%(asctime)s %(levelname)s [%(name)s] %(message)s"
+
+
+def get_logger(name="paddle_tpu", level=None, fmt=_FMT):
+    log = logging.getLogger(name)
+    if not any(isinstance(h, logging.StreamHandler) for h in log.handlers):
+        handler = logging.StreamHandler()
+        rank = os.environ.get("PADDLE_TRAINER_ID")
+        prefix = f"[rank {rank}] " if rank is not None else ""
+        handler.setFormatter(logging.Formatter(prefix + fmt))
+        log.addHandler(handler)
+        log.propagate = False
+    if level is not None:
+        log.setLevel(level)
+    elif log.level == logging.NOTSET:
+        log.setLevel(os.environ.get("PADDLE_TPU_LOG_LEVEL", "WARNING"))
+    return log
+
+
+def set_level(level, name="paddle_tpu"):
+    logging.getLogger(name).setLevel(level)
+
+
+logger = get_logger()

@@ -1,0 +1,86 @@
+"""Runtime stat monitor: named int64 gauges with peaks.
+
+Parity with the reference's monitor registry
+(/root/reference/paddle/phi/core/platform/monitor.h StatRegistry + the
+memory stat surface paddle/phi/core/memory/stats.h).  Backed by the native
+gauge table (csrc/stats.cc) when the C core is built, with a pure-Python
+fallback so the API always works.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["stat_update", "stat_current", "stat_peak", "stat_reset_peak",
+           "StatGauge"]
+
+_py_stats: dict = {}
+_py_lock = threading.Lock()
+
+
+def _native():
+    from ..core import _native
+    return _native.peek() or _native.load()
+
+
+def stat_update(name: str, delta: int, device_id: int = 0) -> int:
+    """Add delta to gauge `name`; returns the new current value."""
+    lib = _native()
+    if lib is not None:
+        return int(lib.ptcore_stat_update(name.encode(), device_id,
+                                          int(delta)))
+    with _py_lock:
+        cur, peak = _py_stats.get((name, device_id), (0, 0))
+        cur += int(delta)
+        _py_stats[(name, device_id)] = (cur, max(peak, cur))
+        return cur
+
+
+def stat_current(name: str, device_id: int = 0) -> int:
+    lib = _native()
+    if lib is not None:
+        return int(lib.ptcore_stat_current(name.encode(), device_id))
+    with _py_lock:
+        return _py_stats.get((name, device_id), (0, 0))[0]
+
+
+def stat_peak(name: str, device_id: int = 0) -> int:
+    lib = _native()
+    if lib is not None:
+        return int(lib.ptcore_stat_peak(name.encode(), device_id))
+    with _py_lock:
+        return _py_stats.get((name, device_id), (0, 0))[1]
+
+
+def stat_reset_peak(name: str, device_id: int = 0):
+    lib = _native()
+    if lib is not None:
+        lib.ptcore_stat_reset_peak(name.encode(), device_id)
+        return
+    with _py_lock:
+        cur, _ = _py_stats.get((name, device_id), (0, 0))
+        _py_stats[(name, device_id)] = (cur, cur)
+
+
+class StatGauge:
+    """Object handle over one named gauge (reference StatValue)."""
+
+    def __init__(self, name: str, device_id: int = 0):
+        self.name = name
+        self.device_id = device_id
+
+    def add(self, delta: int) -> int:
+        return stat_update(self.name, delta, self.device_id)
+
+    def sub(self, delta: int) -> int:
+        return stat_update(self.name, -delta, self.device_id)
+
+    @property
+    def current(self) -> int:
+        return stat_current(self.name, self.device_id)
+
+    @property
+    def peak(self) -> int:
+        return stat_peak(self.name, self.device_id)
+
+    def reset_peak(self):
+        stat_reset_peak(self.name, self.device_id)

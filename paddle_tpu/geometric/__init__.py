@@ -1,0 +1,174 @@
+"""Graph learning ops (message passing + sampling).
+
+Capability parity with /root/reference/python/paddle/geometric/
+(message_passing/send_recv.py send_u_recv/send_ue_recv/send_uv, math.py
+segment_* reductions, sampling/neighbors.py sample_neighbors; phi kernels
+paddle/phi/kernels/gpu/graph_send_*).  TPU-native: every reduction lowers
+to jax.ops.segment_* (one XLA scatter), gather stays a take — no custom
+CUDA kernels needed.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch as D
+from ..core.tensor import Tensor
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_max", "segment_min", "sample_neighbors",
+           "reindex_graph"]
+
+
+_SEGMENT = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # composed
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def _segment_reduce(data, seg, num, pool):
+    if pool == "mean":
+        s = jax.ops.segment_sum(data, seg, num_segments=num)
+        cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype),
+                                  seg, num_segments=num)
+        return s / jnp.maximum(cnt, 1.0)[(...,) + (None,) * (data.ndim - 1)]
+    out = _SEGMENT[pool](data, seg, num_segments=num)
+    if pool in ("max", "min"):
+        # empty segments give +-inf in XLA; the reference zeroes them
+        out = jnp.where(jnp.isfinite(out), out, jnp.zeros((), out.dtype))
+    return out
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src] along edges, reduce at dst
+    (reference send_recv.py send_u_recv)."""
+    def impl(x, src, dst, reduce_op, out_size):
+        num = out_size if out_size is not None else x.shape[0]
+        msgs = jnp.take(x, src, axis=0)
+        return _segment_reduce(msgs, dst, num, reduce_op)
+
+    return D.apply("send_u_recv", impl, (x, src_index, dst_index),
+                   {"reduce_op": reduce_op,
+                    "out_size": int(out_size) if out_size is not None
+                    else None})
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Combine x[src] with edge features y, reduce at dst."""
+    def impl(x, y, src, dst, message_op, reduce_op, out_size):
+        num = out_size if out_size is not None else x.shape[0]
+        m = jnp.take(x, src, axis=0)
+        if message_op == "add":
+            msgs = m + y
+        elif message_op == "sub":
+            msgs = m - y
+        elif message_op == "mul":
+            msgs = m * y
+        elif message_op == "div":
+            msgs = m / y
+        else:
+            raise ValueError(f"unknown message_op {message_op!r}")
+        return _segment_reduce(msgs, dst, num, reduce_op)
+
+    return D.apply("send_ue_recv", impl, (x, y, src_index, dst_index),
+                   {"message_op": message_op, "reduce_op": reduce_op,
+                    "out_size": int(out_size) if out_size is not None
+                    else None})
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from both endpoints (reference send_uv)."""
+    def impl(x, y, src, dst, message_op):
+        xu = jnp.take(x, src, axis=0)
+        yv = jnp.take(y, dst, axis=0)
+        if message_op == "add":
+            return xu + yv
+        if message_op == "sub":
+            return xu - yv
+        if message_op == "mul":
+            return xu * yv
+        if message_op == "div":
+            return xu / yv
+        raise ValueError(f"unknown message_op {message_op!r}")
+
+    return D.apply("send_uv", impl, (x, y, src_index, dst_index),
+                   {"message_op": message_op})
+
+
+def _make_segment(pool):
+    def fn(data, segment_ids, name=None):
+        def impl(data, seg, pool):
+            num = int(jnp.max(seg)) + 1 if not isinstance(seg, jax.core.Tracer) \
+                else data.shape[0]
+            return _segment_reduce(data, seg, num, pool)
+
+        # segment count must be static: computed from the (host) ids
+        seg = segment_ids._data if isinstance(segment_ids, Tensor) \
+            else jnp.asarray(segment_ids)
+        num = int(jnp.max(seg)) + 1 if seg.size else 0
+
+        def impl2(data, seg, pool, num):
+            return _segment_reduce(data, seg, num, pool)
+
+        return D.apply(f"segment_{pool}", impl2, (data, segment_ids),
+                       {"pool": pool, "num": num})
+    fn.__name__ = f"segment_{pool}"
+    return fn
+
+
+segment_sum = _make_segment("sum")
+segment_mean = _make_segment("mean")
+segment_max = _make_segment("max")
+segment_min = _make_segment("min")
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """Uniform neighbor sampling on a CSC graph (reference
+    sampling/neighbors.py).  Host-side (graph sampling is data loading, not
+    device compute — the reference runs it on CPU too)."""
+    rng = np.random.default_rng(0 if perm_buffer is None else None)
+    row_np = np.asarray(row.numpy() if isinstance(row, Tensor) else row)
+    ptr = np.asarray(colptr.numpy() if isinstance(colptr, Tensor) else colptr)
+    nodes = np.asarray(input_nodes.numpy()
+                       if isinstance(input_nodes, Tensor) else input_nodes)
+    out_n, out_count = [], []
+    for v in nodes:
+        beg, end = int(ptr[v]), int(ptr[v + 1])
+        neigh = row_np[beg:end]
+        if sample_size > 0 and len(neigh) > sample_size:
+            neigh = rng.choice(neigh, size=sample_size, replace=False)
+        out_n.append(neigh)
+        out_count.append(len(neigh))
+    out_neighbors = Tensor(jnp.asarray(
+        np.concatenate(out_n) if out_n else np.zeros((0,), row_np.dtype)))
+    out_counts = Tensor(jnp.asarray(np.asarray(out_count, np.int32)))
+    return out_neighbors, out_counts
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact global node ids to local ids (reference reindex_graph)."""
+    x_np = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    n_np = np.asarray(neighbors.numpy()
+                      if isinstance(neighbors, Tensor) else neighbors)
+    uniq = list(dict.fromkeys(x_np.tolist()))
+    mapping = {v: i for i, v in enumerate(uniq)}
+    for v in n_np.tolist():
+        if v not in mapping:
+            mapping[v] = len(mapping)
+            uniq.append(v)
+    reindexed = np.asarray([mapping[v] for v in n_np.tolist()],
+                           np.int64 if n_np.dtype.kind == "i" else n_np.dtype)
+    nodes = np.asarray(uniq, x_np.dtype)
+    return (Tensor(jnp.asarray(reindexed)),
+            Tensor(jnp.asarray(nodes)),
+            Tensor(jnp.asarray(np.asarray(count.numpy()
+                                          if isinstance(count, Tensor)
+                                          else count))))

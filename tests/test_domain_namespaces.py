@@ -1,0 +1,150 @@
+"""Domain namespaces: geometric, audio, text, quantization
+(reference python/paddle/{geometric,audio,text,quantization}/ — SURVEY §2.6
+row 57)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# -- geometric --------------------------------------------------------------
+
+def test_send_u_recv_reductions():
+    x = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]], np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int64))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int64))
+    out = paddle.geometric.send_u_recv(x, src, dst, reduce_op="sum")
+    # dst0 <- x[0]; dst1 <- x[0]+x[2]; dst2 <- x[1]
+    np.testing.assert_allclose(out.numpy(),
+                               [[1, 2], [6, 8], [3, 4]])
+    out_max = paddle.geometric.send_u_recv(x, src, dst, reduce_op="max")
+    np.testing.assert_allclose(out_max.numpy(), [[1, 2], [5, 6], [3, 4]])
+    out_mean = paddle.geometric.send_u_recv(x, src, dst, reduce_op="mean")
+    np.testing.assert_allclose(out_mean.numpy(), [[1, 2], [3, 4], [3, 4]])
+
+
+def test_send_ue_recv_and_send_uv():
+    x = paddle.to_tensor(np.array([[1.], [2.], [3.]], np.float32))
+    e = paddle.to_tensor(np.array([[10.], [20.], [30.]], np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2], np.int64))
+    dst = paddle.to_tensor(np.array([2, 0, 1], np.int64))
+    out = paddle.geometric.send_ue_recv(x, e, src, dst, "add", "sum")
+    np.testing.assert_allclose(out.numpy(), [[22.], [33.], [11.]])
+    uv = paddle.geometric.send_uv(x, x, src, dst, "mul")
+    np.testing.assert_allclose(uv.numpy(), [[3.], [2.], [6.]])
+
+
+def test_segment_ops_and_grads():
+    data = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]],
+                                     np.float32))
+    data.stop_gradient = False
+    seg = paddle.to_tensor(np.array([0, 0, 1], np.int64))
+    s = paddle.geometric.segment_sum(data, seg)
+    np.testing.assert_allclose(s.numpy(), [[4., 6.], [5., 6.]])
+    s.sum().backward()
+    np.testing.assert_allclose(data.grad.numpy(), np.ones((3, 2)))
+    m = paddle.geometric.segment_mean(data, seg)
+    np.testing.assert_allclose(m.numpy(), [[2., 3.], [5., 6.]])
+
+
+def test_sample_neighbors_and_reindex():
+    # CSC: node0 neighbors [1,2]; node1 [2]; node2 []
+    row = paddle.to_tensor(np.array([1, 2, 2], np.int64))
+    colptr = paddle.to_tensor(np.array([0, 2, 3, 3], np.int64))
+    nodes = paddle.to_tensor(np.array([0, 1], np.int64))
+    neigh, counts = paddle.geometric.sample_neighbors(row, colptr, nodes)
+    assert counts.numpy().tolist() == [2, 1]
+    re, uniq, cnt = paddle.geometric.reindex_graph(nodes, neigh, counts)
+    assert len(uniq.numpy()) >= 2
+
+
+# -- audio ------------------------------------------------------------------
+
+def test_audio_mel_pipeline():
+    sr, n = 8000, 2048
+    t = np.arange(n) / sr
+    wav = paddle.to_tensor(
+        np.sin(2 * np.pi * 440.0 * t)[None, :].astype(np.float32))
+    spec = paddle.audio.Spectrogram(n_fft=256, hop_length=128)(wav)
+    assert spec.shape[1] == 129  # 1 + n_fft/2
+    mel = paddle.audio.MelSpectrogram(sr=sr, n_fft=256, hop_length=128,
+                                      n_mels=32)(wav)
+    assert mel.shape[1] == 32
+    logmel = paddle.audio.LogMelSpectrogram(sr=sr, n_fft=256,
+                                            hop_length=128, n_mels=32)(wav)
+    assert np.isfinite(logmel.numpy()).all()
+    mfcc = paddle.audio.MFCC(sr=sr, n_mfcc=13, n_fft=256, hop_length=128,
+                             n_mels=32)(wav)
+    assert mfcc.shape[1] == 13
+
+
+def test_audio_functional_mel_scale():
+    from paddle_tpu.audio import functional as AF
+    # htk round trip
+    hz = np.array([440.0, 1000.0, 4000.0], np.float32)
+    mel = AF.hz_to_mel(paddle.to_tensor(hz), htk=True)
+    back = AF.mel_to_hz(mel, htk=True)
+    np.testing.assert_allclose(back.numpy(), hz, rtol=1e-4)
+    fb = AF.compute_fbank_matrix(8000, 256, n_mels=20)
+    assert fb.shape == [20, 129]
+    assert float(fb.numpy().min()) >= 0.0
+    w = AF.get_window("hann", 128)
+    assert w.shape == [128]
+
+
+# -- text -------------------------------------------------------------------
+
+def test_text_datasets():
+    imdb = paddle.text.Imdb(mode="train")
+    doc, label = imdb[0]
+    assert doc.dtype == np.int64 and label in (0, 1)
+    assert len(imdb) > 0
+    housing = paddle.text.UCIHousing(mode="test")
+    x, y = housing[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    conll = paddle.text.Conll05st()
+    sample = conll[0]
+    assert len(sample) == 9  # words + 5 ctx + pred + mark + labels
+    ml = paddle.text.Movielens()
+    assert len(ml[0]) == 5
+
+
+# -- quantization -----------------------------------------------------------
+
+def test_qat_fake_quant_trains():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.quantization import QAT, QuantConfig
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    q = QAT(QuantConfig()).quantize(net)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                         .astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(1).randn(4, 4)
+                         .astype(np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=q.parameters())
+    losses = []
+    for _ in range(8):
+        loss = nn.functional.mse_loss(q(x), y)
+        loss.backward()          # straight-through grads
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+    # quantized output differs from fp model but stays close
+    fp = net(x).numpy()
+    qo = q(x).numpy()
+    assert not np.allclose(fp, qo)
+
+
+def test_ptq_calibration_scale():
+    from paddle_tpu.quantization import AbsmaxObserver, quant_forward
+    obs = AbsmaxObserver()
+    data = paddle.to_tensor(np.array([-3.0, 1.0, 2.5], np.float32))
+    obs.observe(data)
+    assert obs.scale() == 3.0
+    out = quant_forward(data, paddle.to_tensor(
+        np.asarray(obs.scale(), np.float32)))
+    # values representable on the int8 grid, max error <= scale/127
+    assert np.abs(out.numpy() - data.numpy()).max() <= 3.0 / 127 + 1e-6

@@ -216,3 +216,57 @@ def test_shared_params_and_grad_accumulation(tmp_path):
     assert r.returncode == 0, r.stderr[-3000:]
     assert (tmp_path / "shared_ok_0").exists()
     assert (tmp_path / "shared_ok_1").exists()
+
+
+def test_comm_watchdog_reports_hangs(caplog):
+    """Watchdog (reference comm_task_manager.h:37): an unready collective
+    future past FLAGS_comm_watchdog_timeout produces a CRITICAL dump."""
+    import logging
+    import time as _time
+
+    import paddle_tpu  # noqa: F401  (flag registry)
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.distributed.watchdog import CommTaskManager
+
+    class _NeverReady:
+        shape = (4,)
+
+        def is_ready(self):
+            return False
+
+    mgr = CommTaskManager(poll_interval=0.05)
+    set_flags({"comm_watchdog_timeout": 0.1})
+    try:
+        with caplog.at_level(logging.CRITICAL,
+                             logger="paddle_tpu.distributed.watchdog"):
+            mgr.register("all_reduce", (0, 1), _NeverReady())
+            _time.sleep(0.5)
+        assert any("comm watchdog" in r.message for r in caplog.records)
+        assert mgr.pending()
+    finally:
+        set_flags({"comm_watchdog_timeout": 0.0})
+        mgr.shutdown()
+
+
+def test_comm_watchdog_clears_ready_tasks():
+    import time as _time
+
+    import paddle_tpu  # noqa: F401
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.distributed.watchdog import CommTaskManager
+
+    class _Ready:
+        shape = (2,)
+
+        def is_ready(self):
+            return True
+
+    mgr = CommTaskManager(poll_interval=0.05)
+    set_flags({"comm_watchdog_timeout": 5.0})
+    try:
+        mgr.register("broadcast", (0,), _Ready())
+        _time.sleep(0.3)
+        assert not mgr.pending()
+    finally:
+        set_flags({"comm_watchdog_timeout": 0.0})
+        mgr.shutdown()

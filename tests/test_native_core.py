@@ -178,3 +178,34 @@ def test_stats_gauges():
     assert N.stat_peak("test_hbm", dev=1) == 150
     N.stat_reset_peak("test_hbm", dev=1)
     assert N.stat_peak("test_hbm", dev=1) == 30
+
+
+def test_monitor_stat_gauges():
+    """framework.monitor (reference platform/monitor.h StatRegistry):
+    named gauges with current/peak over the native table (python fallback
+    otherwise)."""
+    from paddle_tpu.framework import monitor
+
+    g = monitor.StatGauge("test_gauge_xyz")
+    base = g.current
+    g.add(100)
+    assert g.current == base + 100
+    assert g.peak >= base + 100
+    g.sub(40)
+    assert g.current == base + 60
+    peak_before = g.peak
+    g.reset_peak()
+    assert g.peak <= peak_before
+    assert g.peak == g.current
+
+
+def test_log_helper_rank_prefix(monkeypatch):
+    import logging
+
+    from paddle_tpu.framework import log_helper
+
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+    log = log_helper.get_logger("paddle_tpu.test_rank_prefix",
+                                level=logging.INFO)
+    handler = log.handlers[0]
+    assert "[rank 3]" in handler.formatter._fmt
