@@ -1,0 +1,38 @@
+"""Hybrid-parallel strategy auto-tuner.
+
+Capability parity with the reference auto-tuner
+(``python/paddle/distributed/auto_tuner/{tuner,search,prune,cost_model,
+recorder}.py``): enumerate candidate hybrid-parallel configurations, prune
+infeasible ones, rank the rest, and record trial results.
+
+TPU-native design differences (not a port):
+
+- The reference ranks candidates only by *running* trial jobs (launching a
+  full distributed task per config, ``tuner.py:62``).  On TPU the XLA
+  ahead-of-time path gives us a much cheaper oracle: every candidate can be
+  **compiled without hardware** on a virtual host-device mesh and scored from
+  ``compiled.cost_analysis()`` / ``memory_analysis()`` — see
+  ``AutoTuner.measure_cfg``.  Real trial runs remain available through
+  ``paddle_tpu.distributed.launch``.
+- The memory/cost models (``cost_model.py``) are analytic formulas over the
+  mesh axes (dp/tp/pp/cp + ZeRO stage) instead of the reference's
+  per-op benchmark table, because under XLA the per-op table is the
+  compiler's job; what the tuner needs is the *parallelism* cost surface
+  (bubble fraction, collective volume over ICI, HBM footprint).
+"""
+from .cost_model import estimate_memory_bytes, estimate_step_time
+from .prune import list_prune_rules, prune_config, register_prune
+from .recorder import HistoryRecorder
+from .search import GridSearch
+from .tuner import AutoTuner
+
+__all__ = [
+    "AutoTuner",
+    "GridSearch",
+    "HistoryRecorder",
+    "estimate_memory_bytes",
+    "estimate_step_time",
+    "list_prune_rules",
+    "prune_config",
+    "register_prune",
+]

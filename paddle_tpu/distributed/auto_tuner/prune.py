@@ -1,0 +1,110 @@
+"""Candidate pruning rules (reference auto_tuner/prune.py — 934 LoC of
+``@register_prune`` rules over dp/mp/pp/sharding/micro-batch axes).
+
+Same registry pattern; TPU-shaped rule set.  A rule returns True when the
+candidate should be PRUNED.  Signature: ``rule(tuner_cfg, cfg, history)``.
+"""
+from __future__ import annotations
+
+from .cost_model import DEFAULT_HBM_BYTES, estimate_memory_bytes
+
+_PRUNE_RULES: list = []
+
+
+def register_prune(fn):
+    """Decorator mirroring the reference's ``register_prune`` (prune.py:29)."""
+    _PRUNE_RULES.append(fn)
+    return fn
+
+
+def list_prune_rules():
+    return [f.__name__ for f in _PRUNE_RULES]
+
+
+def prune_config(tuner_cfg: dict, cfg: dict, history=None) -> str | None:
+    """Return the name of the first rule that rejects cfg, else None."""
+    for rule in _PRUNE_RULES:
+        if rule(tuner_cfg, cfg, history or []):
+            return rule.__name__
+    return None
+
+
+@register_prune
+def prune_by_device_count(tuner_cfg, cfg, history):
+    """dp*tp*pp*cp must exactly cover the device mesh."""
+    n = tuner_cfg.get("num_devices", 8)
+    return (cfg.get("dp", 1) * cfg.get("tp", 1) * cfg.get("pp", 1)
+            * cfg.get("cp", 1)) != n
+
+
+@register_prune
+def prune_by_tp_divisibility(tuner_cfg, cfg, history):
+    """tp must divide heads, kv heads, hidden, ffn, and vocab (reference
+    prune.py:118 _prune_by_mp)."""
+    m = tuner_cfg["model_cfg"]
+    tp = cfg.get("tp", 1)
+    kv = m.get("num_key_value_heads", m["num_attention_heads"])
+    for dim in (m["num_attention_heads"], kv, m["hidden_size"],
+                m["intermediate_size"], m["vocab_size"]):
+        if dim % tp:
+            return True
+    return False
+
+
+@register_prune
+def prune_by_pp_divisibility(tuner_cfg, cfg, history):
+    """pp*vpp must divide the layer count; microbatches must cover pp
+    (reference prune.py:176 _prune_by_pp)."""
+    m = tuner_cfg["model_cfg"]
+    pp = cfg.get("pp", 1)
+    vpp = cfg.get("vpp", 1)
+    if m["num_hidden_layers"] % (pp * vpp):
+        return True
+    return pp > 1 and cfg.get("num_microbatches", 1) < pp
+
+
+@register_prune
+def prune_by_cp_divisibility(tuner_cfg, cfg, history):
+    seq = cfg.get("seq_len", tuner_cfg.get("seq_len", 2048))
+    return seq % cfg.get("cp", 1) != 0
+
+
+@register_prune
+def prune_by_batch(tuner_cfg, cfg, history):
+    """global batch = dp * micro_batch_size * num_microbatches must hold."""
+    gbs = tuner_cfg.get("global_batch_size")
+    if gbs is None:
+        return False
+    return (cfg.get("dp", 1) * cfg.get("micro_batch_size", 1)
+            * cfg.get("num_microbatches", 1)) != gbs
+
+
+@register_prune
+def prune_by_zero(tuner_cfg, cfg, history):
+    """ZeRO sharding needs a dp axis to shard over."""
+    return cfg.get("zero_stage", 0) > 0 and cfg.get("dp", 1) == 1
+
+
+@register_prune
+def prune_by_memory_estimate(tuner_cfg, cfg, history):
+    """Analytic HBM-footprint prune (reference memory_cost_model.py applied
+    in prune.py:823 _prune_by_memory_estimation)."""
+    hbm = tuner_cfg.get("hbm_bytes", DEFAULT_HBM_BYTES)
+    est = estimate_memory_bytes(tuner_cfg["model_cfg"], cfg)
+    return est > tuner_cfg.get("memory_fraction", 0.9) * hbm
+
+
+@register_prune
+def prune_by_history_oom(tuner_cfg, cfg, history):
+    """Skip configs dominated by an OOM trial: same parallelism with a
+    per-chip batch at least as large that already OOMed
+    (reference prune.py:329 history-based pruning)."""
+    for rec in history:
+        if rec.get("status") != "oom":
+            continue
+        same_axes = all(rec.get(k, 1) == cfg.get(k, 1)
+                        for k in ("dp", "tp", "pp", "cp", "zero_stage"))
+        if same_axes and cfg.get("micro_batch_size", 1) >= rec.get(
+                "micro_batch_size", 1):
+            return True
+    return False

@@ -1,0 +1,69 @@
+"""Trial-history recorder (reference auto_tuner/recorder.py ``HistoryRecorder``:
+store per-task configs + metric, sort, persist to CSV, resume)."""
+from __future__ import annotations
+
+import csv
+import os
+
+_AXES = ("dp", "tp", "pp", "cp", "vpp", "zero_stage", "micro_batch_size",
+         "num_microbatches", "recompute")
+
+
+class HistoryRecorder:
+    def __init__(self, metric_name: str = "tokens_per_sec",
+                 direction: str = "max"):
+        self.metric_name = metric_name
+        self.direction = direction
+        self.history: list[dict] = []
+
+    def add_cfg(self, **cfg):
+        self.history.append(dict(cfg))
+
+    def sort_metric(self):
+        """Ranked view: errored/OOM trials sink to the bottom."""
+        def key(rec):
+            v = rec.get(self.metric_name)
+            if v is None:
+                return float("inf")
+            return -v if self.direction == "max" else v
+        self.history.sort(key=key)
+
+    def get_best(self):
+        """(best_cfg, err) — err True when no successful trial exists
+        (reference recorder.py:60 returns the same pair)."""
+        self.sort_metric()
+        if not self.history or self.history[0].get(self.metric_name) is None:
+            return None, True
+        return self.history[0], False
+
+    def store_history(self, path: str):
+        keys: list[str] = []
+        for rec in self.history:
+            for k in rec:
+                if k not in keys:
+                    keys.append(k)
+        with open(path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=keys)
+            w.writeheader()
+            w.writerows(self.history)
+
+    def load_history(self, path: str):
+        if not os.path.exists(path):
+            return
+        with open(path, newline="") as f:
+            for row in csv.DictReader(f):
+                rec: dict = {}
+                for k, v in row.items():
+                    if v in ("", None):
+                        rec[k] = None
+                    elif v in ("True", "False"):
+                        rec[k] = v == "True"
+                    else:
+                        try:
+                            rec[k] = int(v)
+                        except ValueError:
+                            try:
+                                rec[k] = float(v)
+                            except ValueError:
+                                rec[k] = v
+                self.history.append(rec)

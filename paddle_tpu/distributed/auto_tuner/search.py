@@ -1,0 +1,73 @@
+"""Search algorithms over the candidate space (reference auto_tuner/search.py
+``SearchAlgo/GridSearch``; candidate enumeration reference
+``auto_tuner/utils.py:default_candidates``)."""
+from __future__ import annotations
+
+import itertools
+
+from .cost_model import estimate_step_time
+from .prune import prune_config
+
+
+def default_candidates(tuner_cfg: dict) -> dict:
+    """Fill per-axis candidate lists from the device/model config.
+
+    Mirrors ``utils.py:default_candidates`` ("auto" expands to divisors of
+    the device count / layer count), without the GPU-specific axes.
+    """
+    n = tuner_cfg.get("num_devices", 8)
+    divs = [d for d in range(1, n + 1) if n % d == 0]
+    m = tuner_cfg["model_cfg"]
+    cand = dict(tuner_cfg.get("candidates", {}))
+    cand.setdefault("dp", divs)
+    cand.setdefault("tp", divs)
+    cand.setdefault("pp", [d for d in divs
+                           if m["num_hidden_layers"] % d == 0])
+    cand.setdefault("cp", [1])
+    cand.setdefault("vpp", [1])
+    cand.setdefault("zero_stage", [0, 1, 2])
+    cand.setdefault("micro_batch_size", [1, 2, 4, 8])
+    cand.setdefault("num_microbatches", [1, 2, 4, 8])
+    cand.setdefault("recompute", [True])
+    return cand
+
+
+class GridSearch:
+    """Exhaustive product of the candidate axes, pruned, yielded best-first
+    by the analytic cost model (the reference yields in raw grid order and
+    relies on trial runs; pre-sorting by estimated step time makes early
+    stopping meaningful when each trial is a compile probe or a real run)."""
+
+    def __init__(self, tuner_cfg: dict):
+        self.tuner_cfg = dict(tuner_cfg)
+        self.tuner_cfg["candidates"] = default_candidates(tuner_cfg)
+        self._all = self._enumerate()
+        self._idx = 0
+
+    def _enumerate(self):
+        cand = self.tuner_cfg["candidates"]
+        keys = list(cand)
+        out = []
+        for combo in itertools.product(*(cand[k] for k in keys)):
+            cfg = dict(zip(keys, combo))
+            cfg.setdefault("seq_len", self.tuner_cfg.get("seq_len", 2048))
+            if prune_config(self.tuner_cfg, cfg) is None:
+                cfg["_est_step_time"] = estimate_step_time(
+                    self.tuner_cfg["model_cfg"], cfg)
+                out.append(cfg)
+        out.sort(key=lambda c: c["_est_step_time"])
+        return out
+
+    @property
+    def num_candidates(self):
+        return len(self._all)
+
+    def search_once(self, history_cfgs):
+        """Next un-tried candidate, re-checking history-dependent prunes."""
+        from .prune import prune_by_history_oom
+        while self._idx < len(self._all):
+            cfg = self._all[self._idx]
+            self._idx += 1
+            if not prune_by_history_oom(self.tuner_cfg, cfg, history_cfgs):
+                return dict(cfg)
+        return None

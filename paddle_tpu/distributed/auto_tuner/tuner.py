@@ -1,0 +1,147 @@
+"""AutoTuner driver (reference auto_tuner/tuner.py ``AutoTuner``).
+
+``search_once``/``add_cfg``/``resume_from_history`` keep the reference's
+loop contract.  ``measure_cfg`` is the TPU-native trial runner: instead of
+launching a full distributed job per candidate (reference tuner launches
+tasks via the launch controller), it AOT-compiles the flagship hybrid train
+step for the candidate's mesh on virtual host devices and scores it from
+XLA's ``cost_analysis``/``memory_analysis`` — minutes of cluster time per
+trial become seconds of compile time, with OOM detected from the analyzed
+per-chip footprint rather than a crashed job.
+"""
+from __future__ import annotations
+
+import os
+
+from .recorder import HistoryRecorder
+from .search import GridSearch
+
+
+class AutoTuner:
+    def __init__(self, tuner_cfg: dict):
+        self.tuner_cfg = dict(tuner_cfg)
+        self.task_limit = tuner_cfg.get("task_limit", 100)
+        self.cur_task_id = 1
+        algo = tuner_cfg.get("search_algo", {"name": "grid"})
+        if isinstance(algo, dict):
+            algo = algo.get("name", "grid")
+        if algo != "grid":
+            raise NotImplementedError(f"search_algo {algo!r}")
+        self.algo = GridSearch(self.tuner_cfg)
+        self.recorder = HistoryRecorder(
+            tuner_cfg.get("metric_cfg", {}).get("name", "tokens_per_sec"),
+            tuner_cfg.get("metric_cfg", {}).get("OptimizationDirection",
+                                                "max"))
+        self.history_cfgs = self.recorder.history
+
+    def search_once(self):
+        if self.cur_task_id > self.task_limit:
+            return None
+        cfg = self.algo.search_once(self.history_cfgs)
+        if cfg is not None:
+            self.cur_task_id += 1
+        return cfg
+
+    def add_cfg(self, cfg):
+        self.recorder.add_cfg(**cfg)
+
+    def get_best(self):
+        return self.recorder.get_best()
+
+    def resume_from_history(self, path):
+        self.recorder.load_history(path)
+
+    def store_history(self, path):
+        self.recorder.store_history(path)
+
+    # ---- TPU-native trial runner -------------------------------------
+
+    def measure_cfg(self, cfg, model_cfg=None):
+        """Compile-probe one candidate; returns the cfg annotated with
+        status ("ok"/"oom"/"error"), analyzed per-chip bytes, and a
+        cost-model-calibrated tokens_per_sec estimate.
+
+        Requires enough (virtual) devices for dp*tp*pp*cp — use
+        ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` off-TPU.
+        """
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ...models.llama import LlamaConfig
+        from ...parallel import (
+            HybridParallelConfig, build_mesh, build_train_step,
+            init_opt_state, init_params,
+        )
+        from .cost_model import DEFAULT_HBM_BYTES, estimate_step_time
+
+        m = dict(model_cfg or self.tuner_cfg["model_cfg"])
+        out = dict(cfg)
+        try:
+            lcfg = LlamaConfig(**m)
+            hp = HybridParallelConfig(
+                dp=cfg.get("dp", 1), tp=cfg.get("tp", 1),
+                pp=cfg.get("pp", 1), cp=cfg.get("cp", 1),
+                vpp=cfg.get("vpp", 1),
+                pp_schedule="vpp" if cfg.get("vpp", 1) > 1 else "1f1b",
+                num_microbatches=cfg.get("num_microbatches", 1),
+                remat=cfg.get("recompute", True),
+                zero_stage=cfg.get("zero_stage", 0),
+                dtype=jnp.bfloat16)
+            mesh = build_mesh(hp)
+            params = init_params(lcfg, hp, seed=0)
+            opt = init_opt_state(params)
+            step = build_train_step(lcfg, hp, mesh)
+            batch = (cfg.get("micro_batch_size", 1) * hp.dp
+                     * cfg.get("num_microbatches", 1))
+            seq = cfg.get("seq_len", 2048)
+            tokens = jnp.zeros((batch, seq), jnp.int32)
+            # build_train_step returns a jitted fn: AOT-lower it directly.
+            compiled = step.lower(params, opt, tokens).compile()
+            mem = compiled.memory_analysis()
+            per_chip = 0
+            if mem is not None:
+                per_chip = (getattr(mem, "temp_size_in_bytes", 0)
+                            + getattr(mem, "argument_size_in_bytes", 0)
+                            + getattr(mem, "output_size_in_bytes", 0)
+                            - getattr(mem, "alias_size_in_bytes", 0))
+            out["analyzed_bytes_per_chip"] = int(per_chip)
+            hbm = self.tuner_cfg.get("hbm_bytes", DEFAULT_HBM_BYTES)
+            if per_chip > hbm:
+                out["status"] = "oom"
+                out[self.recorder.metric_name] = None
+            else:
+                out["status"] = "ok"
+                est = estimate_step_time(m, cfg)
+                n_tok = hp.dp * cfg.get("micro_batch_size", 1) * \
+                    cfg.get("num_microbatches", 1) * seq
+                out[self.recorder.metric_name] = round(n_tok / est, 1)
+            # flop count from XLA when available (calibration hook)
+            try:
+                ca = compiled.cost_analysis()
+                ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+                if ca and "flops" in ca:
+                    out["analyzed_flops"] = float(np.float64(ca["flops"]))
+            except Exception:
+                pass
+        except Exception as e:
+            out["status"] = "error"
+            out["error"] = f"{type(e).__name__}: {e}"[:300]
+            out[self.recorder.metric_name] = None
+        return out
+
+    def tune(self, max_trials=None, history_path=None):
+        """Full loop: search → compile-probe → record, returns best cfg."""
+        trials = 0
+        while True:
+            if max_trials is not None and trials >= max_trials:
+                break
+            cfg = self.search_once()
+            if cfg is None:
+                break
+            self.add_cfg(self.measure_cfg(cfg))
+            trials += 1
+        if history_path:
+            os.makedirs(os.path.dirname(os.path.abspath(history_path)),
+                        exist_ok=True)
+            self.store_history(history_path)
+        return self.get_best()

@@ -90,7 +90,15 @@ class CommTaskManager:
             for t in overdue:
                 self._dump(t, now)
             if empty:
-                return  # thread exits when the queue drains
+                # Exit decision must be atomic with register()'s alive-check:
+                # a task registered after the drain above would otherwise be
+                # orphaned on a thread that is about to return.  Re-check
+                # under the lock and hand off ownership before exiting.
+                with self._lock:
+                    if self._tasks:
+                        continue
+                    self._thread = None
+                    return
 
     def _dump(self, task, now):
         with self._lock:

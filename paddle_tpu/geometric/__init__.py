@@ -102,11 +102,6 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
 
 def _make_segment(pool):
     def fn(data, segment_ids, name=None):
-        def impl(data, seg, pool):
-            num = int(jnp.max(seg)) + 1 if not isinstance(seg, jax.core.Tracer) \
-                else data.shape[0]
-            return _segment_reduce(data, seg, num, pool)
-
         # segment count must be static: computed from the (host) ids
         seg = segment_ids._data if isinstance(segment_ids, Tensor) \
             else jnp.asarray(segment_ids)
@@ -132,8 +127,10 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
                      name=None):
     """Uniform neighbor sampling on a CSC graph (reference
     sampling/neighbors.py).  Host-side (graph sampling is data loading, not
-    device compute — the reference runs it on CPU too)."""
-    rng = np.random.default_rng(0 if perm_buffer is None else None)
+    device compute — the reference runs it on CPU too).  Draws fresh
+    randomness per call (OS entropy), like the reference's unseeded
+    thread-local generators."""
+    rng = np.random.default_rng()
     row_np = np.asarray(row.numpy() if isinstance(row, Tensor) else row)
     ptr = np.asarray(colptr.numpy() if isinstance(colptr, Tensor) else colptr)
     nodes = np.asarray(input_nodes.numpy()

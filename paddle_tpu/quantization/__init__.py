@@ -41,18 +41,30 @@ dequant_forward = quant_forward  # simulation dequantizes inline
 
 
 class AbsmaxObserver:
-    """Running abs-max calibration observer (reference observers/abs_max.py)."""
+    """Running abs-max calibration observer (reference observers/abs_max.py).
+
+    The running max is kept as a DEVICE scalar (no float()/host sync per
+    observation); under jax.jit tracing observation is a no-op so converted
+    models still compile to one XLA program with trace-time-frozen scales.
+    """
 
     def __init__(self, quant_bits=8):
         self.quant_bits = quant_bits
-        self._absmax = 0.0
+        self._absmax = None
 
     def observe(self, x):
+        import jax
         arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-        self._absmax = max(self._absmax, float(jnp.max(jnp.abs(arr))))
+        if isinstance(arr, jax.core.Tracer):
+            return  # tracing: scales are frozen, do not leak tracers
+        cur = jnp.max(jnp.abs(arr)).astype(jnp.float32)
+        self._absmax = cur if self._absmax is None \
+            else jnp.maximum(self._absmax, cur)
 
     def scale(self):
-        return self._absmax if self._absmax > 0 else 1.0
+        if self._absmax is None:
+            return jnp.float32(1.0)
+        return jnp.maximum(self._absmax, jnp.float32(1e-9))
 
     def __call__(self, layer=None):
         return AbsmaxObserver(self.quant_bits)
@@ -98,11 +110,12 @@ class QuantConfig:
 class _QuantedLinear(Layer):
     """Linear with fake-quantized weights (+ optionally activations)."""
 
-    def __init__(self, linear, bits=8, quant_input=True):
+    def __init__(self, linear, bits=8, quant_input=True, quant_weight=True):
         super().__init__()
         self.inner = linear
         self.bits = bits
         self.quant_input = quant_input
+        self.quant_weight = quant_weight
         self.w_observer = AbsmaxObserver(bits)
         self.in_observer = AbsmaxObserver(bits)
         self.w_observer.observe(linear.weight)
@@ -112,23 +125,45 @@ class _QuantedLinear(Layer):
         if self.quant_input:
             self.in_observer.observe(x)
             x = quant_forward(
-                x, Tensor(jnp.asarray(self.in_observer.scale(),
-                                      jnp.float32)), self.bits)
-        w = quant_forward(
-            self.inner.weight,
-            Tensor(jnp.asarray(self.w_observer.scale(), jnp.float32)),
-            self.bits)
+                x, Tensor(jnp.asarray(self.in_observer.scale())), self.bits)
+        w = self.inner.weight
+        if self.quant_weight:
+            w = quant_forward(
+                w, Tensor(jnp.asarray(self.w_observer.scale())), self.bits)
         b = getattr(self.inner, "bias", None)
         return F.linear(x, w, b)
 
 
-def _swap_linears(model, bits, quant_input):
+def _quant_plan(config: QuantConfig | None, layer):
+    """(quant_weight, quant_input) for this layer, or None to leave it alone.
+
+    An unconfigured/empty QuantConfig quantizes every Linear (weight +
+    activation); once the config names layers/types or global quanters, only
+    configured layers convert — reference config.py semantics, where
+    add_layer_config(..., activation=None, weight=None) EXCLUDES a layer.
+    """
+    if config is None or (not config._layer_configs
+                          and config.activation is None
+                          and config.weight is None):
+        return True, True
+    c = config._config_for(layer)
+    if c is None or (c.get("activation") is None and c.get("weight") is None):
+        return None
+    return c.get("weight") is not None, c.get("activation") is not None
+
+
+def _swap_linears(model, bits, config=None):
     from ..nn.layer.common import Linear
     for name, child in list(model.named_children()):
         if isinstance(child, Linear):
-            setattr(model, name, _QuantedLinear(child, bits, quant_input))
+            plan = _quant_plan(config, child)
+            if plan is not None:
+                qw, qi = plan
+                setattr(model, name,
+                        _QuantedLinear(child, bits, quant_input=qi,
+                                       quant_weight=qw))
         else:
-            _swap_linears(child, bits, quant_input)
+            _swap_linears(child, bits, config)
     return model
 
 
@@ -143,7 +178,7 @@ class QAT:
     def quantize(self, model, inplace=False):
         import copy
         m = model if inplace else copy.deepcopy(model)
-        return _swap_linears(m, self.bits, quant_input=True)
+        return _swap_linears(m, self.bits, self.config)
 
 
 class PTQ:
@@ -157,8 +192,8 @@ class PTQ:
     def quantize(self, model, inplace=False):
         import copy
         m = model if inplace else copy.deepcopy(model)
-        return _swap_linears(m, self.bits, quant_input=True)
+        return _swap_linears(m, self.bits, self.config)
 
     def convert(self, model, inplace=False):
         # scales are already frozen in the observers after calibration runs
-        return model if inplace else model
+        return model

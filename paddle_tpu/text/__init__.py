@@ -83,6 +83,11 @@ class Conll05st(Dataset):
     LABEL_DICT = 67
 
     def __init__(self, data_file=None, mode="train"):
+        if data_file is not None:
+            raise NotImplementedError(
+                "Conll05st serves synthetic SRL-schema data only (zero-egress"
+                " build); loading a real corpus from data_file is not"
+                " implemented — pass data_file=None.")
         rng = np.random.RandomState(3 if mode == "train" else 4)
         n = 256 if mode == "train" else 64
         self.samples = []
@@ -114,6 +119,11 @@ class Movielens(Dataset):
     (reference movielens.py)."""
 
     def __init__(self, data_file=None, mode="train"):
+        if data_file is not None:
+            raise NotImplementedError(
+                "Movielens serves synthetic schema-shaped data only"
+                " (zero-egress build); loading the real dataset from"
+                " data_file is not implemented — pass data_file=None.")
         rng = np.random.RandomState(11 if mode == "train" else 12)
         n = 1024 if mode == "train" else 256
         self.user = rng.randint(0, 943, (n,)).astype(np.int64)

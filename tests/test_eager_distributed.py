@@ -236,6 +236,11 @@ def test_comm_watchdog_reports_hangs(caplog):
 
     mgr = CommTaskManager(poll_interval=0.05)
     set_flags({"comm_watchdog_timeout": 0.1})
+    # framework/log_helper.py stops propagation at the "paddle_tpu" package
+    # logger (one-handler policy, reference log_helper.py); re-enable it so
+    # records reach caplog's root handler for the duration of the capture.
+    pkg_log = logging.getLogger("paddle_tpu")
+    pkg_log.propagate = True
     try:
         with caplog.at_level(logging.CRITICAL,
                              logger="paddle_tpu.distributed.watchdog"):
@@ -244,6 +249,7 @@ def test_comm_watchdog_reports_hangs(caplog):
         assert any("comm watchdog" in r.message for r in caplog.records)
         assert mgr.pending()
     finally:
+        pkg_log.propagate = False
         set_flags({"comm_watchdog_timeout": 0.0})
         mgr.shutdown()
 
