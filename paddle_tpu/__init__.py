@@ -36,6 +36,7 @@ from .core.place import (  # noqa: F401
     is_compiled_with_rocm, is_compiled_with_xpu, set_device,
 )
 from .core.flags import get_flags, set_flags  # noqa: F401
+from .core.selected_rows import SelectedRows, merge_selected_rows  # noqa: F401
 from .core.tensor import Parameter, Tensor, to_tensor  # noqa: F401
 
 no_grad = _dispatch.no_grad
@@ -79,8 +80,11 @@ from . import metric  # noqa: E402
 from . import nn  # noqa: E402
 from . import quantization  # noqa: E402
 from . import optimizer  # noqa: E402
+from . import hub  # noqa: E402
+from . import onnx  # noqa: E402
 from . import signal  # noqa: E402
 from . import sparse  # noqa: E402
+from . import strings  # noqa: E402
 from . import text  # noqa: E402
 from . import utils  # noqa: E402
 from . import vision  # noqa: E402

@@ -1,0 +1,100 @@
+"""SelectedRows: row-sparse tensor (reference paddle/phi/core/selected_rows.h
+— rows + height + value table, used for sparse embedding gradients, plus the
+merge kernel paddle/phi/kernels/selected_rows/).
+
+TPU-native: the value table is a dense jax array [len(rows), ...dims]; merge
+(duplicate-row accumulation) is a segment-sum on device — XLA turns it into a
+single scatter-add, the same access pattern the reference's CUDA merge kernel
+hand-writes.  `to_dense` is a scatter into the [height, ...] frame, which is
+also exactly how a sparse embedding gradient is applied.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor
+
+__all__ = ["SelectedRows", "merge_selected_rows"]
+
+
+def _data(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class SelectedRows:
+    """Row-sparse value table: value[i] is the slice for dense row rows[i].
+
+    rows may repeat (un-merged gradients); `height` is the dense dim-0 size.
+    """
+
+    def __init__(self, rows, height, value=None):
+        self.rows = np.asarray(rows, np.int64).reshape(-1)
+        self.height = int(height)
+        self._value = None if value is None else _data(value)
+        if self._value is not None and \
+                self._value.shape[0] != self.rows.shape[0]:
+            raise ValueError(
+                f"value dim0 {self._value.shape[0]} != len(rows) "
+                f"{self.rows.shape[0]}")
+
+    # --- reference SelectedRows surface (selected_rows.h) ---------------
+
+    def get_value(self):
+        return Tensor(self._value)
+
+    def set_value(self, value):
+        self._value = _data(value)
+
+    value = property(lambda self: Tensor(self._value),
+                     lambda self, v: self.set_value(v))
+
+    def has_key(self, key) -> bool:
+        return bool(np.any(self.rows == int(key)))
+
+    def index(self, key) -> int:
+        hits = np.nonzero(self.rows == int(key))[0]
+        if hits.size == 0:
+            raise KeyError(f"row {key} not in SelectedRows")
+        return int(hits[0])
+
+    def sync_index(self):  # parity no-op: rows stay host-side + sorted lazily
+        return None
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self._value.shape[1:])
+
+    def numel(self):
+        return int(np.prod(self.shape))
+
+    # --- conversions ----------------------------------------------------
+
+    def to_dense(self) -> Tensor:
+        """Scatter-add rows into the dense [height, ...] frame."""
+        dense = jnp.zeros((self.height,) + tuple(self._value.shape[1:]),
+                          self._value.dtype)
+        return Tensor(dense.at[jnp.asarray(self.rows)].add(self._value))
+
+    @staticmethod
+    def from_dense(x, rows):
+        arr = _data(x)
+        rows = np.asarray(rows, np.int64).reshape(-1)
+        return SelectedRows(rows, arr.shape[0], arr[jnp.asarray(rows)])
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"rows={self.rows.tolist()[:8]}"
+                f"{'...' if self.rows.size > 8 else ''}, "
+                f"value_shape={tuple(self._value.shape)})")
+
+
+def merge_selected_rows(sr: SelectedRows) -> SelectedRows:
+    """Accumulate duplicate rows (reference
+    phi/kernels/selected_rows/merge_selected_rows_kernel.h): output rows are
+    unique + sorted, values summed per row."""
+    uniq, inv = np.unique(sr.rows, return_inverse=True)
+    merged = jnp.zeros((uniq.shape[0],) + tuple(sr._value.shape[1:]),
+                       sr._value.dtype)
+    merged = merged.at[jnp.asarray(inv)].add(sr._value)
+    return SelectedRows(uniq, sr.height, merged)

@@ -35,6 +35,7 @@ from .auto_parallel.api import (  # noqa: E402,F401
 from .auto_parallel.process_mesh import ProcessMesh  # noqa: E402,F401
 from . import checkpoint  # noqa: E402,F401
 from . import fleet  # noqa: E402,F401
+from . import rpc  # noqa: E402,F401
 from . import sharding  # noqa: E402,F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: E402,F401
 from .fleet.layers.mpu.mp_ops import split  # noqa: E402,F401

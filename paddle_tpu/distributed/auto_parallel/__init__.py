@@ -3,4 +3,5 @@ from .api import (  # noqa: F401
     DistAttr, Partial, Placement, Replicate, Shard, dtensor_from_fn, reshard,
     shard_layer, shard_optimizer, shard_tensor, to_static, unshard_dtensor,
 )
+from .planner import CostEstimator, apply_plan, plan_layer  # noqa: F401
 from .process_mesh import ProcessMesh, get_mesh, set_mesh  # noqa: F401

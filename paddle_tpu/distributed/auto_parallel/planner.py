@@ -1,0 +1,142 @@
+"""Auto-parallel sharding planner + cost estimator.
+
+Reference counterparts: the static auto-parallel planner/completion/cost
+stack (python/paddle/distributed/auto_parallel/static/{planner_v2.py,
+completion.py,cost/}, python/paddle/cost_model/cost_model.py).  There the
+planner searches per-op dist attrs and a completion pass propagates them.
+
+TPU-native split of that work: PROPAGATION is XLA-GSPMD's job (sharding
+annotations flow through the whole program, SURVEY §7.1), so the planner's
+only real decision is the per-PARAMETER placement seed.  `plan_layer`
+chooses those seeds from the same rules the reference's planner encodes as
+op-level strategies (embedding -> row-shard vocab, linear -> alternate
+column/row so adjacent matmuls chain without a reshard, small/1-D ->
+replicate), and `CostEstimator` prices a candidate plan (per-device bytes +
+collective volume) so callers can compare plans or meshes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .api import Replicate, Shard, shard_tensor
+from .process_mesh import ProcessMesh
+
+__all__ = ["CostEstimator", "plan_layer", "apply_plan"]
+
+_MIN_SHARD_ELEMS = 16384        # below this, sharding costs more than it saves
+
+
+def _placements_for(name: str, shape, mesh_dim_size: int, alternate: int):
+    """Placement heuristic for one parameter.
+
+    Returns (placements, next_alternate).  alternate flips between
+    column (dim -1) and row (dim 0) sharding for consecutive 2-D weights —
+    the Megatron pairing (reference mp_layers.py Column/RowParallelLinear)
+    that the reference planner rediscovers via strategy search.
+    """
+    shape = tuple(int(s) for s in shape)
+    n = int(np.prod(shape)) if shape else 0
+    lname = name.lower()
+    if len(shape) < 2 or n < _MIN_SHARD_ELEMS:
+        return [Replicate()], alternate
+    if any(k in lname for k in ("embed", "vocab", "head", "lm_head",
+                                "word_embeddings")):
+        # row-shard the vocab dim (VocabParallelEmbedding, mp_layers.py:49)
+        dim = 0 if shape[0] >= shape[-1] else len(shape) - 1
+        if shape[dim] % mesh_dim_size == 0:
+            return [Shard(dim)], alternate
+        return [Replicate()], alternate
+    # generic 2-D+ weight: alternate column/row so y = x @ W1 @ W2 keeps the
+    # intermediate sharded with zero reshard between them
+    dim = (len(shape) - 1) if alternate == 0 else 0
+    if shape[dim] % mesh_dim_size != 0:
+        dim = 0 if dim != 0 else len(shape) - 1   # try the other dim
+        if shape[dim] % mesh_dim_size != 0:
+            return [Replicate()], alternate
+    return [Shard(dim)], 1 - alternate
+
+
+def plan_layer(layer, mesh: ProcessMesh, mesh_dim: int | str = 0) -> dict:
+    """Propose a placement per parameter of a ``nn.Layer``.
+
+    Returns {param_name: [Placement, ...]} over ``mesh``'s ``mesh_dim``.
+    Purely advisory — apply with ``apply_plan`` or hand-edit first.
+    """
+    if isinstance(mesh_dim, str):
+        mesh_dim = list(mesh.dim_names).index(mesh_dim)
+    size = mesh.shape[mesh_dim]
+    plan = {}
+    alternate = 0
+    for name, p in layer.named_parameters():
+        placements, alternate = _placements_for(name, p.shape, size,
+                                                alternate)
+        # planner output is per mesh-dim; other dims replicate
+        full = [Replicate()] * len(mesh.shape)
+        full[mesh_dim] = placements[0]
+        plan[name] = full
+    return plan
+
+
+def apply_plan(layer, mesh: ProcessMesh, plan: dict):
+    """shard_tensor every planned parameter in place (the reference's
+    completion+partition applied eagerly); returns the layer."""
+    for name, p in layer.named_parameters():
+        placements = plan.get(name)
+        if placements is None:
+            continue
+        sharded = shard_tensor(p, mesh, placements)
+        # keep Parameter identity/metadata; swap the data in place
+        p._data = sharded._data
+    return layer
+
+
+class CostEstimator:
+    """Price a plan: per-device parameter bytes + per-step collective bytes.
+
+    Reference: python/paddle/cost_model/cost_model.py + auto_parallel
+    static/cost/ estimators.  Collective pricing uses ring-cost bytes over
+    the mesh dim (2(n-1)/n for allreduce, (n-1)/n for allgather /
+    reduce-scatter), the same closed forms the reference's CommOpCost
+    classes encode per op.
+    """
+
+    def __init__(self, mesh: ProcessMesh, bytes_per_elem: int = 4):
+        self.mesh = mesh
+        self.bytes_per_elem = bytes_per_elem
+
+    def param_bytes_per_device(self, layer, plan: dict) -> int:
+        total = 0
+        for name, p in layer.named_parameters():
+            n = int(np.prod(p.shape)) if len(p.shape) else 1
+            factor = 1
+            for d, pl in enumerate(plan.get(name, [])):
+                if isinstance(pl, Shard):
+                    factor *= self.mesh.shape[d]
+            total += (n + factor - 1) // factor * self.bytes_per_elem
+        return total
+
+    def grad_sync_bytes(self, layer, plan: dict, dp_size: int) -> int:
+        """Allreduce ring bytes per step for the replicated (dp) grads."""
+        if dp_size <= 1:
+            return 0
+        total = 0
+        for name, p in layer.named_parameters():
+            n = int(np.prod(p.shape)) if len(p.shape) else 1
+            factor = 1
+            for d, pl in enumerate(plan.get(name, [])):
+                if isinstance(pl, Shard):
+                    factor *= self.mesh.shape[d]
+            total += int(2 * (dp_size - 1) / dp_size * n // factor
+                         * self.bytes_per_elem)
+        return total
+
+    def compare(self, layer, plans: dict[str, dict],
+                dp_size: int = 1) -> list[tuple]:
+        """Rank named plans by (param bytes, sync bytes); best first."""
+        scored = []
+        for tag, plan in plans.items():
+            scored.append((tag,
+                           self.param_bytes_per_device(layer, plan),
+                           self.grad_sync_bytes(layer, plan, dp_size)))
+        scored.sort(key=lambda t: (t[1], t[2]))
+        return scored

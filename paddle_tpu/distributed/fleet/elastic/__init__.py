@@ -1,0 +1,14 @@
+"""Elastic training manager (reference
+python/paddle/distributed/fleet/elastic/manager.py:125 ElasticManager —
+etcd-backed node registry, membership watch, scale-event relaunch).
+
+TPU-native substitution: the registry rides the native TCPStore instead of
+etcd (this build's single coordination service, csrc/tcp_store.cc; no etcd
+in a TPU pod's control plane).  Nodes heartbeat a lease key; the watch
+thread detects joins/leaves from lease expiry and flips the manager into
+NeedLaunch, which the launch controller consumes to restart the job with
+the surviving node set.
+"""
+from .manager import ElasticManager, ElasticStatus
+
+__all__ = ["ElasticManager", "ElasticStatus"]

@@ -1,0 +1,83 @@
+"""Model hub (reference python/paddle/hapi/hub.py: paddle.hub.list / help /
+load over github/gitee/local repos with a hubconf.py entrypoint module).
+
+TPU-native/zero-egress scope: the ``local`` source is fully supported (same
+hubconf.py contract — callables listed in the module, optional
+``dependencies`` list); the remote sources raise with a clear message
+instead of attempting network fetches this environment cannot make.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+HUBCONF = "hubconf.py"
+VAR_DEPENDENCY = "dependencies"
+
+
+def _import_hubconf(repo_dir):
+    path = os.path.join(repo_dir, HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {HUBCONF} in {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    _check_dependencies(mod)
+    return mod
+
+
+def _check_dependencies(m):
+    deps = getattr(m, VAR_DEPENDENCY, None)
+    if not deps:
+        return
+    missing = [d for d in deps
+               if importlib.util.find_spec(d) is None]
+    if missing:
+        raise RuntimeError(
+            f"hub repo requires missing packages: {missing} (this "
+            "environment installs no packages; vendor the dependency or "
+            "drop it from hubconf.dependencies)")
+
+
+def _resolve(repo, source):
+    if source != "local":
+        raise NotImplementedError(
+            f"source={source!r}: this zero-egress TPU build supports "
+            "source='local' only (reference hub fetches github/gitee "
+            "archives, hapi/hub.py:97); clone the repo and pass its path")
+    return os.path.expanduser(repo)
+
+
+def list(repo_dir, source="local", force_reload=False):
+    """Entrypoint names exported by the repo's hubconf
+    (reference hub.py:188)."""
+    mod = _import_hubconf(_resolve(repo_dir, source))
+    return [name for name in dir(mod)
+            if callable(getattr(mod, name)) and not name.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):
+    """Docstring of one entrypoint (reference hub.py:239)."""
+    mod = _import_hubconf(_resolve(repo_dir, source))
+    entry = getattr(mod, model, None)
+    if entry is None or not callable(entry):
+        raise RuntimeError(f"no callable entrypoint {model!r} in hubconf")
+    return entry.__doc__
+
+
+def load(repo_dir, model, *args, source="local", force_reload=False,
+         **kwargs):
+    """Instantiate entrypoint ``model`` from the repo
+    (reference hub.py:290)."""
+    mod = _import_hubconf(_resolve(repo_dir, source))
+    entry = getattr(mod, model, None)
+    if entry is None or not callable(entry):
+        raise RuntimeError(f"no callable entrypoint {model!r} in hubconf")
+    return entry(*args, **kwargs)

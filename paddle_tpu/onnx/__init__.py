@@ -1,0 +1,38 @@
+"""Model export namespace (reference python/paddle/onnx/export.py —
+``paddle.onnx.export`` delegating to the external paddle2onnx converter).
+
+TPU-native substitution (SURVEY §2.8): the portable serving format for an
+XLA stack is **StableHLO**, not ONNX — ONNX cannot represent the sharded /
+fused programs this framework emits, and every XLA-hosting runtime (TF
+serving via SavedModel, IREE, PJRT plugins) ingests StableHLO directly.
+``export`` therefore emits the jit.save artifact set (.pdmodel =
+serialized StableHLO + .pdiparams) and keeps the reference's call shape
+``export(layer, path, input_spec=...)``.  Passing ``format='onnx'`` raises
+with this explanation rather than silently producing a different format.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=None,
+           format="stablehlo", **configs):
+    """Export ``layer`` for serving.
+
+    Args mirror the reference (python/paddle/onnx/export.py:30); ``path``
+    gets the same ``.pdmodel``/``.pdiparams`` suffix contract as jit.save.
+    ``opset_version`` is accepted for signature parity and ignored —
+    StableHLO carries its own versioning (serialization includes the
+    StableHLO version string).
+    """
+    if format not in ("stablehlo", "pdmodel"):
+        raise ValueError(
+            f"format={format!r} is not supported: this TPU-native build "
+            "exports StableHLO (the XLA-ecosystem interchange format) "
+            "instead of ONNX; load it with paddle_tpu.jit.load, TF "
+            "SavedModel tooling, or any PJRT/IREE runtime")
+    from ..jit import save as jit_save
+    if path.endswith(".onnx"):
+        path = path[:-len(".onnx")]
+    jit_save(layer, path, input_spec=input_spec, **configs)
+    return path

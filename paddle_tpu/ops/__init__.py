@@ -7,10 +7,12 @@ modules.
 """
 from __future__ import annotations
 
-from . import creation, indexing, linalg, logic, manipulation, math, random
+from . import (array, creation, indexing, linalg, logic, manipulation, math,
+               random)
 from .generated import op_wrappers
 
-_MODULES = (math, manipulation, logic, linalg, creation, random, op_wrappers)
+_MODULES = (math, manipulation, logic, linalg, creation, random, array,
+            op_wrappers)
 
 
 def _collect():

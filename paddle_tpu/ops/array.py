@@ -1,0 +1,72 @@
+"""TensorArray ops (reference python/paddle/tensor/array.py: create_array /
+array_write / array_read / array_length / array_pop over DENSE_TENSOR_ARRAY).
+
+TPU-native design: in eager mode a tensor array IS a Python list (exactly the
+reference's dygraph contract — its dygraph branches assert `isinstance(array,
+list)`).  Under `jit.to_static` capture, Python lists trace naturally through
+JAX (each write/read is resolved at trace time), so no IR-level array type is
+needed — the captured program sees the individual element tensors, which is
+strictly more XLA-friendly than a runtime array-of-buffers variable.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+__all__ = ["create_array", "array_write", "array_read", "array_length",
+           "array_pop"]
+
+
+def _index(i) -> int:
+    """Accept int or 0-D/[1] int Tensor (the reference's index contract)."""
+    if isinstance(i, Tensor):
+        import numpy as np
+        arr = np.asarray(i.numpy()).reshape(-1)
+        if arr.size != 1:
+            raise ValueError(
+                f"array index must have a single element, got shape "
+                f"{tuple(i.shape)}")
+        return int(arr[0])
+    return int(i)
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """New tensor array, optionally seeded with tensors
+    (reference array.py:232 create_array)."""
+    if initialized_list is None:
+        return []
+    if not isinstance(initialized_list, (list, tuple)):
+        raise TypeError(
+            f"initialized_list must be list/tuple, got "
+            f"{type(initialized_list).__name__}")
+    return list(initialized_list)
+
+
+def array_write(x, i, array=None):
+    """Write x at position i; appends when i == len (reference
+    array.py:189)."""
+    idx = _index(i)
+    if array is None:
+        array = []
+    if idx > len(array):
+        raise IndexError(
+            f"array_write index {idx} out of range for array of length "
+            f"{len(array)} (writes may extend by at most one)")
+    if idx == len(array):
+        array.append(x)
+    else:
+        array[idx] = x
+    return array
+
+def array_read(array, i):
+    """Read the element at position i (reference array.py:110)."""
+    return array[_index(i)]
+
+
+def array_length(array):
+    """Number of elements (reference array.py:43)."""
+    return len(array)
+
+
+def array_pop(array, i=-1):
+    """Remove and return element i (reference array.py:248 array_pop)."""
+    return array.pop(_index(i))

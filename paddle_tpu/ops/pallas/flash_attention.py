@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -28,11 +29,25 @@ try:
 except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
-_BLOCK_Q = 128
-_BLOCK_K = 128
+_BLOCK_Q = int(os.environ.get("PADDLE_TPU_FA_BLOCK_Q", "512"))
+_BLOCK_K = int(os.environ.get("PADDLE_TPU_FA_BLOCK_K", "512"))
 
 # Tests flip this to run the same kernels via the Pallas interpreter on CPU.
 INTERPRET = False
+
+
+def _pick_block(seq_len: int, pref: int) -> int:
+    """Largest power-of-two block <= pref that divides seq_len (>=128).
+
+    Big blocks matter on TPU: grid programs run sequentially on the one
+    TensorCore, so 128-wide tiles at head_dim 64 leave the MXU mostly idle
+    on per-program overhead — 512-wide tiles amortize it (measured 2.4x
+    step-time win at S=2048 on v5e, tmp/fa_block_sweep).
+    """
+    b = pref
+    while b > 128 and seq_len % b:
+        b //= 2
+    return min(b, seq_len)
 
 
 def _repeat_kv(x, group):
@@ -142,8 +157,8 @@ def _flash_fwd_pallas(q, k, v, causal):
     kr = jnp.swapaxes(k, 1, 2).reshape(b * hk, sk, d)
     vr = jnp.swapaxes(v, 1, 2).reshape(b * hk, sk, d)
 
-    block_q = min(_BLOCK_Q, sq)
-    block_k = min(_BLOCK_K, sk)
+    block_q = _pick_block(sq, _BLOCK_Q)
+    block_k = _pick_block(sk, _BLOCK_K)
 
     kernel = functools.partial(_fwd_kernel, causal=causal, sm_scale=sm_scale,
                                block_k=block_k, kv_len=sk)
@@ -272,8 +287,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal):
     delta = jnp.sum(dor.astype(jnp.float32) * outr.astype(jnp.float32),
                     axis=-1)[:, None, :]
 
-    block_q = min(_BLOCK_Q, sq)
-    block_k = min(_BLOCK_K, sk)
+    block_q = _pick_block(sq, _BLOCK_Q)
+    block_k = _pick_block(sk, _BLOCK_K)
     q_map, kv_map = _gqa_maps(h, group)
 
     def vec_q_map(bh, blk):

@@ -1,0 +1,94 @@
+"""String tensors (reference paddle/phi/core/string_tensor.h + the strings
+kernel family paddle/phi/kernels/strings/{strings_empty,strings_lower_upper}
+_kernel.h, schema paddle/phi/ops/yaml/strings_ops.yaml: empty / empty_like /
+lower / upper).
+
+TPU-native: strings are host data — the reference implements its pstring
+kernels on CPU only, and a TPU has no string support at all — so
+StringTensor wraps a numpy object array and the four schema ops run on host.
+UTF-8 handling rides Python's str (the reference carries its own unicode
+tables, paddle/phi/kernels/strings/unicode.h, because C++ must; Python
+need not).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["StringTensor", "empty", "empty_like", "lower", "upper"]
+
+
+class StringTensor:
+    """Dense tensor of variable-length UTF-8 strings."""
+
+    def __init__(self, data=None, shape=None):
+        if data is not None:
+            arr = np.asarray(data, dtype=object)
+            vec = arr.reshape(-1)
+            for i, s in enumerate(vec):
+                if not isinstance(s, str):
+                    vec[i] = "" if s is None else str(s)
+            self._data = vec.reshape(arr.shape)
+        else:
+            self._data = np.full(tuple(shape or ()), "", dtype=object)
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    def numel(self) -> int:
+        return int(self._data.size)
+
+    def numpy(self):
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        if isinstance(out, str):
+            return out
+        return StringTensor(out)
+
+    def __eq__(self, other):
+        other_data = other._data if isinstance(other, StringTensor) else other
+        return bool(np.array_equal(self._data, np.asarray(other_data,
+                                                          dtype=object)))
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, data={self._data!r})"
+
+
+def empty(shape, name=None) -> StringTensor:
+    """Uninitialized (empty-string) tensor (strings_ops.yaml strings_empty)."""
+    return StringTensor(shape=shape)
+
+
+def empty_like(x: StringTensor, name=None) -> StringTensor:
+    return StringTensor(shape=x.shape)
+
+
+def _map(x: StringTensor, fn) -> StringTensor:
+    vec = x._data.reshape(-1)
+    out = np.array([fn(s) for s in vec], dtype=object).reshape(x._data.shape)
+    return StringTensor(out)
+
+
+def lower(x: StringTensor, use_utf8_encoding=True, name=None) -> StringTensor:
+    """Elementwise lowercase (strings_ops.yaml strings_lower).
+
+    use_utf8_encoding=False restricts to ASCII-only case mapping, matching
+    the reference's charcases-mode split.
+    """
+    if use_utf8_encoding:
+        return _map(x, str.lower)
+    return _map(x, lambda s: "".join(
+        c.lower() if c.isascii() else c for c in s))
+
+
+def upper(x: StringTensor, use_utf8_encoding=True, name=None) -> StringTensor:
+    """Elementwise uppercase (strings_ops.yaml strings_upper)."""
+    if use_utf8_encoding:
+        return _map(x, str.upper)
+    return _map(x, lambda s: "".join(
+        c.upper() if c.isascii() else c for c in s))

@@ -1,0 +1,108 @@
+"""Elastic membership manager, onnx(StableHLO) export, hub (reference
+fleet/elastic/manager.py, python/paddle/onnx/export.py, hapi/hub.py)."""
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+from paddle_tpu.distributed.store import TCPStore
+
+
+@pytest.fixture
+def store():
+    s = TCPStore("127.0.0.1", 0, is_master=True, timeout=5.0)
+    yield s
+
+
+def test_elastic_membership_and_relaunch_signal(store):
+    a = ElasticManager(store, "job1", "hostA", np_range="1:3",
+                       heartbeat_interval=0.1, lease_ttl=1.0)
+    a.register()
+    try:
+        assert a.wait_ready(timeout=5.0)
+        time.sleep(0.3)
+        assert a.status() in (ElasticStatus.OK, ElasticStatus.WAIT)
+        assert a.members() == ["hostA"] or a.alive_nodes() == ["hostA"]
+
+        # second node joins -> membership change -> NEED_LAUNCH once
+        b = ElasticManager(store, "job1", "hostB", np_range="1:3",
+                           heartbeat_interval=0.1, lease_ttl=1.0)
+        b.register()
+        deadline = time.time() + 5.0
+        saw_relaunch = False
+        while time.time() < deadline:
+            if a.consume_relaunch():
+                saw_relaunch = True
+                break
+            time.sleep(0.05)
+        assert saw_relaunch
+        assert sorted(a.alive_nodes()) == ["hostA", "hostB"]
+
+        # node leaves -> another relaunch signal
+        b.exit()
+        deadline = time.time() + 5.0
+        saw_leave = False
+        while time.time() < deadline:
+            if a.consume_relaunch():
+                saw_leave = True
+                break
+            time.sleep(0.05)
+        assert saw_leave
+        assert a.alive_nodes() == ["hostA"]
+    finally:
+        a.exit()
+
+
+def test_elastic_below_range_waits(store):
+    m = ElasticManager(store, "job2", "only", np_range="2:4",
+                       heartbeat_interval=0.1, lease_ttl=1.0)
+    m.register()
+    try:
+        time.sleep(0.4)
+        assert m.status() == ElasticStatus.WAIT
+        assert not m.wait_ready(timeout=0.5)
+    finally:
+        m.exit()
+
+
+def test_onnx_export_emits_stablehlo(tmp_path):
+    net = nn.Sequential(nn.Linear(8, 4), nn.ReLU())
+    x = paddle.to_tensor(np.random.rand(2, 8).astype("float32"))
+    net(x)
+    out = paddle.onnx.export(net, str(tmp_path / "model.onnx"),
+                             input_spec=[x])
+    assert (tmp_path / "model.pdmodel").exists()
+    loaded = paddle.jit.load(out)
+    np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+    with pytest.raises(ValueError, match="StableHLO"):
+        paddle.onnx.export(net, str(tmp_path / "m2"), input_spec=[x],
+                           format="onnx")
+
+
+def test_hub_local_repo(tmp_path):
+    (tmp_path / "hubconf.py").write_text(textwrap.dedent("""
+        dependencies = ["numpy"]
+
+        def tiny_mlp(hidden=4):
+            \"\"\"A tiny MLP entrypoint.\"\"\"
+            import paddle_tpu.nn as nn
+            return nn.Sequential(nn.Linear(8, hidden), nn.ReLU())
+
+        def _private():
+            pass
+    """))
+    names = paddle.hub.list(str(tmp_path))
+    assert "tiny_mlp" in names and "_private" not in names
+    assert "tiny MLP" in paddle.hub.help(str(tmp_path), "tiny_mlp")
+    model = paddle.hub.load(str(tmp_path), "tiny_mlp", hidden=6)
+    x = paddle.to_tensor(np.random.rand(2, 8).astype("float32"))
+    assert tuple(model(x).shape) == (2, 6)
+
+    with pytest.raises(NotImplementedError, match="local"):
+        paddle.hub.list("owner/repo", source="github")

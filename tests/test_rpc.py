@@ -1,0 +1,94 @@
+"""RPC tests (reference test/rpc/ + python/paddle/distributed/rpc/rpc.py).
+
+Single-process loopback (world_size=1, worker calls itself) plus a
+2-process cross-worker exchange spawned via distributed.launch — the
+reference's subprocess-driver pattern (test_communication_api_base.py:28).
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _add(a, b):
+    return a + b
+
+
+def _boom():
+    raise ValueError("remote kaboom")
+
+
+def test_rpc_loopback():
+    import paddle_tpu.distributed.rpc as rpc
+
+    os.environ.pop("PADDLE_MASTER_ENDPOINT", None)
+    rpc.init_rpc("worker0", rank=0, world_size=1,
+                 master_endpoint="127.0.0.1:0")
+    try:
+        info = rpc.get_current_worker_info()
+        assert info.name == "worker0" and info.rank == 0
+        assert rpc.get_worker_info("worker0").port == info.port
+        assert [w.name for w in rpc.get_all_worker_infos()] == ["worker0"]
+
+        assert rpc.rpc_sync("worker0", _add, args=(2, 3)) == 5
+        fut = rpc.rpc_async("worker0", _add, args=(10,),
+                            kwargs={"b": 20})
+        assert fut.wait() == 30
+
+        # remote exceptions propagate to the caller
+        try:
+            rpc.rpc_sync("worker0", _boom)
+            raise AssertionError("expected ValueError")
+        except ValueError as e:
+            assert "remote kaboom" in str(e)
+
+        # unknown worker is a clear error
+        try:
+            rpc.rpc_sync("nobody", _add, args=(1, 2))
+            raise AssertionError("expected ValueError")
+        except ValueError as e:
+            assert "unknown RPC worker" in str(e)
+    finally:
+        rpc.shutdown()
+    # re-init after shutdown works
+    rpc.init_rpc("worker0", rank=0, world_size=1,
+                 master_endpoint="127.0.0.1:0")
+    rpc.shutdown()
+
+
+def test_rpc_cross_process(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import os
+        import paddle_tpu.distributed.rpc as rpc
+
+        rank = int(os.environ["PADDLE_TRAINER_ID"])
+        rpc.init_rpc(f"worker{rank}")
+
+        def mul(a, b):
+            return a * b
+
+        peer = f"worker{1 - rank}"
+        assert rpc.rpc_sync(peer, mul, args=(rank + 1, 10)) == (rank + 1) * 10
+        futs = [rpc.rpc_async(peer, mul, args=(i, i)) for i in range(4)]
+        assert [f.wait() for f in futs] == [0, 1, 4, 9]
+        infos = rpc.get_all_worker_infos()
+        assert [w.name for w in infos] == ["worker0", "worker1"]
+        rpc.shutdown()
+        print(f"rpc_ok_{rank}")
+    """))
+    env = dict(os.environ, PYTHONPATH=REPO + os.pathsep +
+               os.environ.get("PYTHONPATH", ""), JAX_PLATFORMS="cpu",
+               XLA_FLAGS="")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(tmp_path / "log"),
+         str(script)],
+        env=env, capture_output=True, text=True, timeout=240,
+        cwd=str(tmp_path))
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    logs = "".join(
+        (tmp_path / "log" / f"workerlog.{i}").read_text() for i in (0, 1))
+    assert "rpc_ok_0" in logs and "rpc_ok_1" in logs

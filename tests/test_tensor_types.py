@@ -1,0 +1,93 @@
+"""Auxiliary tensor types: TensorArray ops, SelectedRows, StringTensor
+(reference python/paddle/tensor/array.py, paddle/phi/core/selected_rows.h,
+paddle/phi/ops/yaml/strings_ops.yaml)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_tensor_array_ops():
+    arr = paddle.create_array(dtype="float32")
+    x = paddle.full([3, 3], 5.0, dtype="float32")
+    i = paddle.zeros([1], dtype="int32")
+    arr = paddle.array_write(x, i, array=arr)
+    assert paddle.array_length(arr) == 1
+    item = paddle.array_read(arr, i)
+    np.testing.assert_allclose(item.numpy(), np.full((3, 3), 5.0))
+
+    # append via i == len, overwrite via i < len
+    y = paddle.full([2], 1.0)
+    arr = paddle.array_write(y, 1, array=arr)
+    arr = paddle.array_write(paddle.full([2], 2.0), 1, array=arr)
+    assert paddle.array_length(arr) == 2
+    np.testing.assert_allclose(paddle.array_read(arr, 1).numpy(), [2.0, 2.0])
+
+    popped = paddle.array_pop(arr)
+    np.testing.assert_allclose(popped.numpy(), [2.0, 2.0])
+    assert paddle.array_length(arr) == 1
+
+    with pytest.raises(IndexError):
+        paddle.array_write(x, 5, array=arr)
+
+    seeded = paddle.create_array(initialized_list=[x])
+    assert paddle.array_length(seeded) == 1
+
+
+def test_tensor_array_traces_through_jit():
+    """List-based arrays resolve at trace time inside to_static."""
+    def fn(x):
+        arr = paddle.create_array()
+        arr = paddle.array_write(x, 0, array=arr)
+        arr = paddle.array_write(x * 2, 1, array=arr)
+        return paddle.array_read(arr, 0) + paddle.array_read(arr, 1)
+
+    x = paddle.to_tensor(np.ones((4,), np.float32))
+    st = paddle.jit.to_static(fn)
+    np.testing.assert_allclose(st(x).numpy(), np.full((4,), 3.0))
+
+
+def test_selected_rows_roundtrip_and_merge():
+    sr = paddle.SelectedRows(rows=[1, 3, 1], height=5,
+                             value=np.asarray([[1., 1.], [2., 2.], [3., 3.]],
+                                              np.float32))
+    assert sr.shape == (5, 2)
+    assert sr.has_key(3) and not sr.has_key(0)
+    assert sr.index(3) == 1
+
+    dense = sr.to_dense().numpy()            # duplicate rows accumulate
+    np.testing.assert_allclose(dense[1], [4., 4.])
+    np.testing.assert_allclose(dense[3], [2., 2.])
+    np.testing.assert_allclose(dense[0], [0., 0.])
+
+    merged = paddle.merge_selected_rows(sr)
+    assert merged.rows.tolist() == [1, 3]
+    np.testing.assert_allclose(merged.get_value().numpy(),
+                               [[4., 4.], [2., 2.]])
+    np.testing.assert_allclose(merged.to_dense().numpy(), dense)
+
+    back = paddle.SelectedRows.from_dense(merged.to_dense(), rows=[1, 3])
+    np.testing.assert_allclose(back.get_value().numpy(),
+                               [[4., 4.], [2., 2.]])
+
+
+def test_string_tensor_ops():
+    st = paddle.strings.StringTensor([["Hello", "World"], ["Straße", "ABC"]])
+    assert st.shape == (2, 2)
+    assert st[0, 0] == "Hello"
+
+    low = paddle.strings.lower(st)
+    assert low.tolist() == [["hello", "world"], ["straße", "abc"]]
+    up = paddle.strings.upper(st)
+    assert up.tolist()[0] == ["HELLO", "WORLD"]
+
+    # ascii-only mode leaves non-ascii chars untouched
+    low_ascii = paddle.strings.lower(
+        paddle.strings.StringTensor(["İZMİR"]), use_utf8_encoding=False)
+    assert low_ascii.tolist() == ["İzmİr"]
+
+    e = paddle.strings.empty([2, 3])
+    assert e.shape == (2, 3) and e[1, 2] == ""
+    el = paddle.strings.empty_like(st)
+    assert el.shape == st.shape
+    assert paddle.strings.StringTensor(["a"]) == paddle.strings.StringTensor(["a"])
