@@ -235,7 +235,10 @@ def _product_bench(on_tpu):
                           intermediate_size=2816, num_hidden_layers=24,
                           num_attention_heads=16, num_key_value_heads=16,
                           max_position_embeddings=2048)
-        batch, seq, steps = 8, 2048, 2
+        # batch sized for the EAGER path: no remat, f32 master weights, and
+        # per-op activations live simultaneously — b8 exhausts the 16 GB
+        # chip (BENCH r3 first run), b2 fits
+        batch, seq, steps = 2, 2048, 2
     else:
         cfg = LlamaConfig.tiny()
         batch, seq, steps = 2, 128, 2

@@ -258,11 +258,21 @@ def apply(op_name: str, fn: Callable, tensor_args: Sequence[Any],
     if obs is not None:
         import time as _time
         t0 = _time.perf_counter_ns()
-    if not grad_on:
-        out = _plain_exec(fn, static_items)(*arrays)
-        vjp_fn = None
-    else:
-        out, vjp_fn = _fwd_vjp_exec(fn, static_items, mask)(*arrays)
+    try:
+        if not grad_on:
+            out = _plain_exec(fn, static_items)(*arrays)
+            vjp_fn = None
+        else:
+            out, vjp_fn = _fwd_vjp_exec(fn, static_items, mask)(*arrays)
+    except RuntimeError as e:
+        # reference enforce.h policy: prefix the failing operator and append
+        # the decoded backend-status hint (external_error-table analog)
+        from .enforce import explain_runtime_error
+        hint = explain_runtime_error(e)
+        if hint:
+            raise RuntimeError(
+                f"[operator < {op_name} > error] {e} [Hint: {hint}]") from e
+        raise
     if obs is not None:
         obs(op_name, t0, _time.perf_counter_ns() - t0)
 

@@ -60,16 +60,23 @@ class ElasticManager:
     def _key(self, host):
         return f"elastic/{self.job_id}/node/{host}"
 
-    def _hosts_key(self):
-        return f"elastic/{self.job_id}/hosts"
+    def _nreg_key(self):
+        return f"elastic/{self.job_id}/nreg"
+
+    def _slot_key(self, idx):
+        return f"elastic/{self.job_id}/reg/{idx}"
 
     def register(self):
         """Join the registry and start heartbeat + watch threads
-        (reference manager.py: etcd put + refresh_lease loop)."""
-        hosts = self._list_registered()
-        if self.host_id not in hosts:
-            hosts.append(self.host_id)
-            self.store.set(self._hosts_key(), ",".join(hosts))
+        (reference manager.py: etcd put + refresh_lease loop).
+
+        Registration is race-free: each node atomically claims a slot index
+        via the store's add counter and writes only its own slot key —
+        concurrent joins cannot clobber each other the way a shared
+        read-modify-write hosts list would.
+        """
+        self._slot = self.store.add(self._nreg_key(), 1)
+        self.store.set(self._slot_key(self._slot), self.host_id)
         self._beat()
         for fn in (self._heartbeat_loop, self._watch_loop):
             t = threading.Thread(target=fn, daemon=True,
@@ -82,10 +89,18 @@ class ElasticManager:
 
     def _list_registered(self):
         try:
-            raw = self.store.get(self._hosts_key(), timeout=0.5)
-            return [h for h in raw.decode().split(",") if h]
+            n = self.store.add(self._nreg_key(), 0)
         except Exception:
             return []
+        out = []
+        for i in range(1, int(n) + 1):
+            try:
+                h = self.store.get(self._slot_key(i), timeout=0.5).decode()
+            except Exception:
+                continue
+            if h and h not in out:
+                out.append(h)
+        return out
 
     def alive_nodes(self) -> list[str]:
         now = time.time()
@@ -119,8 +134,15 @@ class ElasticManager:
             with self._lock:
                 self._members = cur
                 n = len(cur)
+                # NEED_LAUNCH latches until consume_relaunch() reads it —
+                # a controller polling slower than the heartbeat must not
+                # lose the signal (reference need_sync is consumed, not
+                # recomputed per watch tick)
+                latched = self._status in (ElasticStatus.NEED_LAUNCH,
+                                           ElasticStatus.EXIT)
                 if n < self.np_lo:
-                    self._status = ElasticStatus.WAIT
+                    if not latched:
+                        self._status = ElasticStatus.WAIT
                 elif n > self.np_hi:
                     self._status = ElasticStatus.ERROR
                 elif prev is not None and cur != prev \
@@ -128,7 +150,7 @@ class ElasticManager:
                     # in-range membership change: job must relaunch on the
                     # new node set (reference need_sync + NeedLaunch)
                     self._status = ElasticStatus.NEED_LAUNCH
-                elif self._status != ElasticStatus.EXIT:
+                elif not latched:
                     self._status = ElasticStatus.OK
             prev = cur
             self._stop.wait(self.heartbeat_interval)
@@ -166,8 +188,8 @@ class ElasticManager:
         self._stop.set()
         # drop this node from the registry so peers see the leave quickly
         try:
-            hosts = [h for h in self._list_registered() if h != self.host_id]
-            self.store.set(self._hosts_key(), ",".join(hosts))
+            if getattr(self, "_slot", None) is not None:
+                self.store.set(self._slot_key(self._slot), "")
             self.store.set(self._key(self.host_id), repr(0.0))
         except Exception:
             pass

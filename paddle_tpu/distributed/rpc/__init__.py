@@ -113,12 +113,21 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
                      timeout=float(os.environ.get("FLAGS_stop_check_timeout",
                                                   "900")))
 
-    # bind the service socket on an ephemeral port
+    # bind the service socket on all interfaces, advertise a ROUTABLE
+    # address (multi-host peers must be able to dial it — reference
+    # rpc.py:85 uses PADDLE_WORKER_ENDPOINT): prefer the launch env's
+    # endpoint host, else this host's resolved address.
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    srv.bind(("127.0.0.1", 0))
+    srv.bind(("0.0.0.0", 0))
     srv.listen(128)
-    ip, my_port = srv.getsockname()
+    my_port = srv.getsockname()[1]
+    ip = os.environ.get("PADDLE_CURRENT_ENDPOINT", "").rsplit(":", 1)[0]
+    if not ip:
+        try:
+            ip = socket.gethostbyname(socket.gethostname())
+        except OSError:
+            ip = "127.0.0.1"
 
     self_info = WorkerInfo(name, rank, ip, my_port)
     store.set(f"rpc/worker/{rank}",

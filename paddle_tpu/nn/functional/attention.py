@@ -113,14 +113,39 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     (reference nn/functional/flash_attention.py:756).
 
     Inputs are [total_tokens, num_heads, head_dim] with `cu_seqlens_*`
-    holding cumulative sequence offsets (len = batch+1).  Implemented as a
-    segment-masked composition XLA fuses; a Pallas varlen kernel can slot in
-    behind the same API.
+    holding cumulative sequence offsets (len = batch+1).  Dispatch: the
+    segment-aware Pallas varlen kernel family
+    (ops/pallas/flash_attention_varlen.py — true flash memory behavior,
+    O(sum s_i^2) compute via per-block kv ranges) when it provably lowers
+    on this backend, else the segment-masked XLA composition.
     """
     if dropout and training:
         raise NotImplementedError(
             "flash_attn_unpadded: attention dropout is not implemented; "
             "pass dropout=0.0")
+    from ...ops.pallas.flash_attention_varlen import (_varlen_attention,
+                                                      use_varlen_flash)
+    import jax as _jax
+
+    q_arr = query._data if hasattr(query, "_data") else query
+    k_arr = key._data if hasattr(key, "_data") else key
+    # probe the dtype the kernel will ACTUALLY run in: the dispatcher
+    # autocasts float inputs per AMP state, so under O2 an fp32 input
+    # executes as bf16 — probing the pre-cast dtype would cache a compile
+    # the real call never uses and skip the promised fallback
+    from ...core.dispatch import amp_state
+    cast_to = amp_state.autocast_dtype_for("flash_attn_unpadded")
+    eff_dtype = cast_to if cast_to is not None else q_arr.dtype
+    q_probe = _jax.ShapeDtypeStruct(q_arr.shape, eff_dtype)
+    k_probe = _jax.ShapeDtypeStruct(k_arr.shape, eff_dtype)
+    if use_varlen_flash(q_probe, k_probe, bool(causal)):
+        out = D.apply(
+            "flash_attn_unpadded",
+            lambda q, k, v, cq, ck, scale, causal: _varlen_attention(
+                causal, scale, q, k, v, cq, ck),
+            (query, key, value, cu_seqlens_q, cu_seqlens_k),
+            {"scale": float(scale), "causal": bool(causal)})
+        return out, None
     out = D.apply("flash_attn_unpadded", _unpadded_impl,
                   (query, key, value, cu_seqlens_q, cu_seqlens_k),
                   {"scale": float(scale), "causal": bool(causal)})

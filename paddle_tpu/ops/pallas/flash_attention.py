@@ -87,7 +87,12 @@ def _dot_f32(a, b, dims):
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                 causal, sm_scale, block_k, kv_len):
     # grid: (batch*heads, q_blocks); refs are [block_q, d] / [kv_len, d]
-    q = q_ref[...]
+    # sm_scale folded into q ONCE ([block_q, d] pass) instead of into every
+    # [block_q, block_k] score tile; causal masking (2 iotas + cmp + select
+    # per tile, all VPU) runs ONLY on diagonal-crossing blocks — interior
+    # blocks take the mask-free body.  The VPU passes per tile, not the MXU
+    # matmuls, bound this kernel at head_dim 64 (measured on v5e).
+    q = (q_ref[...].astype(jnp.float32) * sm_scale).astype(q_ref.dtype)
     block_q, d = q.shape
     q_idx = pl.program_id(1)
 
@@ -97,12 +102,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
     num_k_blocks = kv_len // block_k
 
-    def body(kb, carry):
+    def tile(kb, carry, masked):
         acc, m_i, l_i = carry
         k = k_ref[pl.dslice(kb * block_k, block_k), :]
         v = v_ref[pl.dslice(kb * block_k, block_k), :]
-        s = _dot_f32(q, k, ((1,), (1,))) * sm_scale  # [block_q, block_k] f32
-        if causal:
+        s = _dot_f32(q, k, ((1,), (1,)))             # [block_q, block_k] f32
+        if masked:
             q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
@@ -116,17 +121,29 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                                               ((1,), (0,)))
         return acc, m_new, l_new
 
+    carry = (acc, m_i, l_i)
     if causal:
-        # only iterate over k blocks that intersect the causal band
-        q_end = (q_idx.astype(jnp.int32) + jnp.int32(1)) * jnp.int32(block_q)
+        # interior blocks (entirely below the diagonal): mask-free body
+        q_lo = q_idx.astype(jnp.int32) * jnp.int32(block_q)
+        q_end = q_lo + jnp.int32(block_q)
+        full_hi = q_lo // jnp.int32(block_k)
         hi = jnp.minimum(jnp.int32(num_k_blocks),
-                         q_end // jnp.int32(block_k) + jnp.int32(1))
+                         (q_end - 1) // jnp.int32(block_k) + jnp.int32(1))
+        carry = jax.lax.fori_loop(
+            jnp.int32(0), full_hi,
+            lambda kb, c: tile(kb, c, masked=False), carry)
+        carry = jax.lax.fori_loop(
+            full_hi, hi, lambda kb, c: tile(kb, c, masked=True), carry)
     else:
-        hi = jnp.int32(num_k_blocks)
-    acc, m_i, l_i = jax.lax.fori_loop(jnp.int32(0), hi, body, (acc, m_i, l_i))
+        carry = jax.lax.fori_loop(
+            jnp.int32(0), jnp.int32(num_k_blocks),
+            lambda kb, c: tile(kb, c, masked=False), carry)
+    acc, m_i, l_i = carry
     o_ref[...] = (acc / l_i[:, None]).astype(o_ref.dtype)
     # lse ref is [1, block_q]: kept 3-D as [BH, 1, Sq] outside so the block's
-    # last-two dims (1, block_q) satisfy Mosaic's (8,128)-divisible-or-full rule
+    # last-two dims (1, block_q) satisfy Mosaic's (8,128)-divisible-or-full
+    # rule.  lse is in the SCALED (q*sm_scale) domain, matching what the
+    # backward kernels recompute.
     lse_ref[...] = (m_i + jnp.log(l_i))[None, :]
 
 
@@ -198,15 +215,19 @@ def _bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
     dv = jnp.zeros((block_k, d), jnp.float32)
     num_q_blocks = q_len // block_q
 
-    def body(qb, carry):
+    def tile(qb, carry, masked):
         dk, dv = carry
-        q = q_ref[pl.dslice(qb * block_q, block_q), :]
+        # sm_scale folded into the [block_q, d] q slice, not the
+        # [block_k, block_q] score tile; the dk matmul then needs NO extra
+        # dst * sm_scale pass (dk = dst^T (q*sm)).
+        q = (q_ref[pl.dslice(qb * block_q, block_q), :]
+             .astype(jnp.float32) * sm_scale).astype(q_ref.dtype)
         do = do_ref[pl.dslice(qb * block_q, block_q), :]
         lse = lse_ref[0, pl.dslice(qb * block_q, block_q)]
         delta = delta_ref[0, pl.dslice(qb * block_q, block_q)]
         # transposed score tile: [block_k, block_q] f32
-        st = _dot_f32(k, q, ((1,), (1,))) * sm_scale
-        if causal:
+        st = _dot_f32(k, q, ((1,), (1,)))
+        if masked:
             k_pos = k_idx * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_k, block_q), 0)
             q_pos = qb * block_q + jax.lax.broadcasted_iota(
@@ -216,17 +237,30 @@ def _bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         ptc = pt.astype(do.dtype)
         dv = dv + _dot_f32(ptc, do, ((1,), (0,)))
         dpt = _dot_f32(v, do, ((1,), (1,)))  # [block_k, block_q] f32
-        dst = pt * (dpt - delta[None, :]) * sm_scale
+        dst = pt * (dpt - delta[None, :])
         dk = dk + _dot_f32(dst.astype(q.dtype), q, ((1,), (0,)))
         return dk, dv
 
+    carry = (dk, dv)
     if causal:
-        # first q block intersecting the band: q_pos >= k_idx*block_k
-        lo = (k_idx.astype(jnp.int32) * jnp.int32(block_k)) \
-            // jnp.int32(block_q)
+        # q blocks [lo, full_lo) cross the diagonal (masked body); q blocks
+        # [full_lo, nqb) are entirely below it (mask-free body)
+        k_lo = k_idx.astype(jnp.int32) * jnp.int32(block_k)
+        lo = k_lo // jnp.int32(block_q)
+        full_lo = jnp.minimum(
+            jnp.int32(num_q_blocks),
+            (k_lo + jnp.int32(block_k - 1)) // jnp.int32(block_q)
+            + jnp.int32(1))
+        carry = jax.lax.fori_loop(
+            lo, full_lo, lambda qb, c: tile(qb, c, masked=True), carry)
+        carry = jax.lax.fori_loop(
+            full_lo, jnp.int32(num_q_blocks),
+            lambda qb, c: tile(qb, c, masked=False), carry)
     else:
-        lo = jnp.int32(0)
-    dk, dv = jax.lax.fori_loop(lo, jnp.int32(num_q_blocks), body, (dk, dv))
+        carry = jax.lax.fori_loop(
+            jnp.int32(0), jnp.int32(num_q_blocks),
+            lambda qb, c: tile(qb, c, masked=False), carry)
+    dk, dv = carry
     dk_ref[...] = dk.astype(dk_ref.dtype)
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
@@ -235,7 +269,9 @@ def _bwd_dq_kernel(k_ref, v_ref, do_ref, lse_ref, delta_ref, q_ref,
                    dq_ref, *, causal, sm_scale, block_k, kv_len):
     # grid: (batch*heads, q_blocks); q/do/dq refs [block_q, d];
     # k/v refs [kv_len, d]; lse/delta refs [1, block_q]
-    q = q_ref[...]
+    # sm_scale folded into q once; the dq matmul consumes a scaled k slice
+    # (dq = ds (k*sm)), so no per-tile ds * sm_scale pass
+    q = (q_ref[...].astype(jnp.float32) * sm_scale).astype(q_ref.dtype)
     do = do_ref[...]
     lse = lse_ref[0, :]
     delta = delta_ref[0, :]
@@ -245,11 +281,12 @@ def _bwd_dq_kernel(k_ref, v_ref, do_ref, lse_ref, delta_ref, q_ref,
     dq = jnp.zeros((block_q, d), jnp.float32)
     num_k_blocks = kv_len // block_k
 
-    def body(kb, dq):
+    def tile(kb, dq, masked):
         k = k_ref[pl.dslice(kb * block_k, block_k), :]
         v = v_ref[pl.dslice(kb * block_k, block_k), :]
-        s = _dot_f32(q, k, ((1,), (1,))) * sm_scale
-        if causal:
+        ks = (k.astype(jnp.float32) * sm_scale).astype(k.dtype)
+        s = _dot_f32(q, k, ((1,), (1,)))
+        if masked:
             q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
@@ -257,16 +294,24 @@ def _bwd_dq_kernel(k_ref, v_ref, do_ref, lse_ref, delta_ref, q_ref,
             s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
         p = jnp.exp(s - lse[:, None])
         dp = _dot_f32(do, v, ((1,), (1,)))
-        ds = p * (dp - delta[:, None]) * sm_scale
-        return dq + _dot_f32(ds.astype(k.dtype), k, ((1,), (0,)))
+        ds = p * (dp - delta[:, None])
+        return dq + _dot_f32(ds.astype(k.dtype), ks, ((1,), (0,)))
 
     if causal:
-        q_end = (q_idx.astype(jnp.int32) + jnp.int32(1)) * jnp.int32(block_q)
+        q_lo = q_idx.astype(jnp.int32) * jnp.int32(block_q)
+        q_end = q_lo + jnp.int32(block_q)
+        full_hi = q_lo // jnp.int32(block_k)
         hi = jnp.minimum(jnp.int32(num_k_blocks),
-                         q_end // jnp.int32(block_k) + jnp.int32(1))
+                         (q_end - 1) // jnp.int32(block_k) + jnp.int32(1))
+        dq = jax.lax.fori_loop(
+            jnp.int32(0), full_hi, lambda kb, a: tile(kb, a, masked=False),
+            dq)
+        dq = jax.lax.fori_loop(
+            full_hi, hi, lambda kb, a: tile(kb, a, masked=True), dq)
     else:
-        hi = jnp.int32(num_k_blocks)
-    dq = jax.lax.fori_loop(jnp.int32(0), hi, body, dq)
+        dq = jax.lax.fori_loop(
+            jnp.int32(0), jnp.int32(num_k_blocks),
+            lambda kb, a: tile(kb, a, masked=False), dq)
     dq_ref[...] = dq.astype(dq_ref.dtype)
 
 

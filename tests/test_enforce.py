@@ -1,0 +1,62 @@
+"""Enforce/error-policy tests (reference paddle/phi/core/enforce.h error
+summary + operator context, external_error tables analog)."""
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.enforce import (
+    EnforceError, InvalidArgumentError, UnimplementedError,
+    current_error_context, enforce, enforce_eq, error_context,
+    explain_runtime_error,
+)
+
+
+def test_typed_errors_and_enforce():
+    with pytest.raises(InvalidArgumentError):
+        enforce(False, "bad arg")
+    # typed errors double as their python analogs
+    with pytest.raises(ValueError):
+        enforce(False, "bad arg")
+    with pytest.raises(NotImplementedError):
+        raise UnimplementedError("later")
+    with pytest.raises(EnforceError, match="Expected 1 == 2"):
+        enforce_eq(1, 2)
+
+
+def test_error_context_prefixes_operator():
+    assert current_error_context() == ()
+    with pytest.raises(EnforceError,
+                       match=r"\[operator < conv2d > error\].*kernel size"):
+        with error_context("conv2d"):
+            assert current_error_context() == ("conv2d",)
+            enforce(False, "kernel size mismatch")
+    assert current_error_context() == ()
+
+    # nested contexts stack outermost-first
+    with pytest.raises(EnforceError,
+                       match=r"\[operator < outer > error\] "
+                             r"\[operator < inner > error\]"):
+        with error_context("outer"), error_context("inner"):
+            enforce(False, "boom")
+
+
+def test_explain_runtime_error_hints():
+    e = RuntimeError("RESOURCE_EXHAUSTED: TPU backend error")
+    assert "HBM" in explain_runtime_error(e)
+    assert "remat" in explain_runtime_error(e)
+    assert explain_runtime_error(RuntimeError("weird")) == ""
+    assert "use_pallas_kernels" in explain_runtime_error(
+        RuntimeError("INTERNAL: Mosaic failed"))
+
+
+def test_dispatch_enriches_xla_errors(monkeypatch):
+    """An op whose kernel raises an XLA-status error gets the operator
+    prefix + hint appended by the dispatcher."""
+    from paddle_tpu.core import dispatch as D
+
+    def bad_kernel(x):
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory allocating")
+
+    x = paddle.to_tensor([1.0, 2.0])
+    with pytest.raises(RuntimeError,
+                       match=r"\[operator < my_op > error\].*\[Hint: .*HBM"):
+        D.apply("my_op", bad_kernel, (x,))
