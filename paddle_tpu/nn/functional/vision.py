@@ -1,0 +1,132 @@
+"""Spatial-sampling functionals (reference python/paddle/nn/functional/
+vision.py: grid_sample, affine_grid + pooling.py max_unpool2d)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch as D
+
+__all__ = ["grid_sample", "affine_grid", "max_unpool2d"]
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x [N, C, H, W] at normalized grid [N, Ho, Wo, 2] locations
+    (reference nn/functional/vision.py grid_sample; kernel
+    phi/kernels/gpu/grid_sample_kernel.cu)."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"mode must be bilinear|nearest, got {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"bad padding_mode {padding_mode!r}")
+
+    def impl(x, grid, mode, padding_mode, align):
+        N, C, H, W = x.shape
+        g = grid.astype(jnp.float32)
+        gx, gy = g[..., 0], g[..., 1]
+        if align:
+            fx = (gx + 1) * 0.5 * (W - 1)
+            fy = (gy + 1) * 0.5 * (H - 1)
+        else:
+            fx = ((gx + 1) * W - 1) * 0.5
+            fy = ((gy + 1) * H - 1) * 0.5
+
+        def reflect(v, lo, hi):
+            rng = hi - lo
+            if rng <= 0:
+                return jnp.zeros_like(v)
+            v = jnp.abs(v - lo) % (2 * rng)
+            return lo + jnp.where(v > rng, 2 * rng - v, v)
+
+        if padding_mode == "reflection":
+            if align:
+                fx = reflect(fx, 0.0, W - 1.0)
+                fy = reflect(fy, 0.0, H - 1.0)
+            else:
+                fx = jnp.clip(reflect(fx, -0.5, W - 0.5), 0, W - 1)
+                fy = jnp.clip(reflect(fy, -0.5, H - 0.5), 0, H - 1)
+
+        def fetch(ix, iy):
+            # [N, Ho, Wo] int coords -> [N, C, Ho, Wo] values (+valid mask)
+            inb = ((ix >= 0) & (ix <= W - 1) & (iy >= 0) & (iy <= H - 1))
+            ixc = jnp.clip(ix, 0, W - 1).astype(jnp.int32)
+            iyc = jnp.clip(iy, 0, H - 1).astype(jnp.int32)
+            vals = jax.vmap(
+                lambda img, yy, xx: img[:, yy, xx])(x, iyc, ixc)
+            if padding_mode == "zeros":
+                vals = vals * inb[:, None].astype(vals.dtype)
+            return vals
+
+        if mode == "nearest":
+            return fetch(jnp.round(fx), jnp.round(fy)).astype(x.dtype)
+
+        x0 = jnp.floor(fx)
+        y0 = jnp.floor(fy)
+        wx = (fx - x0)[:, None]
+        wy = (fy - y0)[:, None]
+        out = (fetch(x0, y0) * (1 - wx) * (1 - wy)
+               + fetch(x0 + 1, y0) * wx * (1 - wy)
+               + fetch(x0, y0 + 1) * (1 - wx) * wy
+               + fetch(x0 + 1, y0 + 1) * wx * wy)
+        return out.astype(x.dtype)
+
+    return D.apply("grid_sample", impl, (x, grid),
+                   {"mode": str(mode), "padding_mode": str(padding_mode),
+                    "align": bool(align_corners)})
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2-D affine sampling grid from theta [N, 2, 3]
+    (reference nn/functional/vision.py affine_grid)."""
+    if hasattr(out_shape, "tolist"):
+        out_shape = [int(v) for v in out_shape.tolist()]
+    N, C, H, W = (int(v) for v in out_shape)
+
+    def impl(theta, H, W, align):
+        th = theta.astype(jnp.float32)
+        if align:
+            ys = jnp.linspace(-1.0, 1.0, H)
+            xs = jnp.linspace(-1.0, 1.0, W)
+        else:
+            ys = (jnp.arange(H) * 2 + 1) / H - 1
+            xs = (jnp.arange(W) * 2 + 1) / W - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)       # [H, W, 3]
+        out = jnp.einsum("hwk,nck->nhwc", base, th)     # [N, H, W, 2]
+        return out.astype(theta.dtype)
+
+    return D.apply("affine_grid", impl, (theta,),
+                   {"H": H, "W": W, "align": bool(align_corners)})
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """Invert max_pool2d using the saved flat indices (reference
+    nn/functional/pooling.py max_unpool2d)."""
+    if data_format != "NCHW":
+        raise ValueError("max_unpool2d supports NCHW only")
+    ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = ks if stride is None else (
+        (stride, stride) if isinstance(stride, int) else tuple(stride))
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+
+    def impl(x, idx, ks, st, pd, out_hw):
+        N, C, H, W = x.shape
+        if out_hw is None:
+            Ho = (H - 1) * st[0] - 2 * pd[0] + ks[0]
+            Wo = (W - 1) * st[1] - 2 * pd[1] + ks[1]
+        else:
+            Ho, Wo = out_hw
+        flat = jnp.zeros((N, C, Ho * Wo), x.dtype)
+        out = jax.vmap(jax.vmap(
+            lambda dst, src, ii: dst.at[ii.reshape(-1)].set(
+                src.reshape(-1))))(flat, x, idx.astype(jnp.int32))
+        return out.reshape(N, C, Ho, Wo)
+
+    out_hw = None
+    if output_size is not None:
+        out_hw = tuple(int(v) for v in output_size[-2:])
+    return D.apply("max_unpool2d", impl, (x, indices),
+                   {"ks": ks, "st": st, "pd": pd, "out_hw": out_hw})

@@ -8,11 +8,11 @@ modules.
 from __future__ import annotations
 
 from . import (array, creation, indexing, linalg, logic, manipulation, math,
-               random)
+               misc, random)
 from .generated import op_wrappers
 
 _MODULES = (math, manipulation, logic, linalg, creation, random, array,
-            op_wrappers)
+            misc, op_wrappers)
 
 
 def _collect():

@@ -1,0 +1,383 @@
+"""Remaining reference op-surface coverage: casting, structural fills,
+sequence/beam utilities, sampling, and norm reductions.
+
+Reference counterparts are cited per op (python/paddle/tensor/*.py wrappers
+over phi kernels, paddle/phi/ops/yaml/ops.yaml entries).  All device ops are
+pure-jnp kernels through the eager dispatcher; `edit_distance` is host-side
+(data-dependent DP, like the reference's CPU kernel).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch as D
+from ..core.dtype import to_jax_dtype
+from ..core.tensor import Tensor
+
+__all__ = [
+    "cast", "shape", "mv", "inverse", "multiplex", "reverse", "fill_",
+    "fill_diagonal", "fill_diagonal_tensor", "diag_embed", "clip_by_norm",
+    "mean_all", "frobenius_norm", "squared_l2_norm", "sequence_mask",
+    "gather_tree", "top_p_sampling", "temporal_shift", "edit_distance",
+    "viterbi_decode", "as_strided",
+]
+
+
+def _t(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def cast(x, dtype):
+    """paddle.cast (reference tensor/manipulation.py cast -> _C_ops.cast)."""
+    return D.apply("cast", lambda a, dt: a.astype(dt), (x,),
+                   {"dt": to_jax_dtype(dtype)})
+
+
+def shape(x, name=None):
+    """Shape as an int32 tensor (reference ops.yaml `shape`/`shape64`)."""
+    return Tensor(jnp.asarray(tuple(_t(x).shape), jnp.int32))
+
+
+def mv(x, vec, name=None):
+    """Matrix-vector product (reference tensor/linalg.py mv)."""
+    return D.apply("mv", lambda a, b: a @ b, (x, vec))
+
+
+def inverse(x, name=None):
+    """Matrix inverse (reference tensor/math.py inverse)."""
+    return D.apply("inverse", jnp.linalg.inv, (x,))
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select across candidate tensors: out[i] = inputs[index[i]][i]
+    (reference tensor/math.py multiplex)."""
+    def impl(idx, *cands):
+        stacked = jnp.stack(cands, axis=0)             # [C, B, ...]
+        sel = idx.reshape(-1).astype(jnp.int32)        # [B]
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[sel, rows]
+
+    return D.apply("multiplex", impl, (index, *inputs))
+
+
+def reverse(x, axis, name=None):
+    """Alias of flip (reference legacy `reverse` op)."""
+    from .manipulation import flip
+    return flip(x, axis)
+
+
+def fill_(x, value):
+    """In-place fill (reference Tensor.fill_, ops.yaml `fill`)."""
+    arr = _t(x)
+    x._data = jnp.full_like(arr, value)
+    return x
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    """Fill the main diagonal (reference Tensor.fill_diagonal_;
+    wrap continues the diagonal in tall matrices like the reference)."""
+    def impl(a, value, offset, wrap):
+        n, m = a.shape[-2], a.shape[-1]
+        i = jnp.arange(n)[:, None]
+        j = jnp.arange(m)[None, :]
+        diag = (j - i) == offset
+        if wrap and n > m:
+            period = m + 1
+            diag = ((i * m + j) % period == offset % period) if offset == 0 \
+                else diag
+        return jnp.where(diag, jnp.asarray(value, a.dtype), a)
+
+    return D.apply("fill_diagonal", impl, (x,),
+                   {"value": float(value), "offset": int(offset),
+                    "wrap": bool(wrap)})
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """Write tensor y along the (dim1, dim2) diagonal of x
+    (reference Tensor.fill_diagonal_tensor)."""
+    def impl(a, b, offset, dim1, dim2):
+        nd = a.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        perm = [d for d in range(nd) if d not in (d1, d2)] + [d1, d2]
+        ap = jnp.transpose(a, perm)
+        n, m = ap.shape[-2], ap.shape[-1]
+        i = jnp.arange(n)[:, None]
+        j = jnp.arange(m)[None, :]
+        mask = (j - i) == offset
+        # scatter b (last dim runs along the diagonal) into a carrier
+        dlen = min(n, m - offset) if offset >= 0 else min(n + offset, m)
+        di = jnp.arange(dlen)
+        rows = di if offset >= 0 else di - offset
+        cols = di + max(0, offset)
+        carrier = jnp.zeros_like(ap).at[..., rows, cols].set(
+            b.astype(a.dtype))
+        out = jnp.where(mask, carrier, ap)
+        inv = np.argsort(perm)
+        return jnp.transpose(out, inv)
+
+    return D.apply("fill_diagonal_tensor", impl, (x, y),
+                   {"offset": int(offset), "dim1": int(dim1),
+                    "dim2": int(dim2)})
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batched diagonal embedding (reference tensor/creation.py
+    diag_embed)."""
+    def impl(a, offset, dim1, dim2):
+        n = a.shape[-1] + abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        di = jnp.arange(a.shape[-1])
+        rows = di + max(0, -offset)
+        cols = di + max(0, offset)
+        out = base.at[..., rows, cols].set(a)
+        nd = out.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        # currently the two new dims are the last two; move them
+        perm = list(range(nd - 2))
+        order = sorted([d1, d2])
+        for pos, d in zip(order, (nd - 2, nd - 1)):
+            perm.insert(pos, d)
+        return jnp.transpose(out, perm)
+
+    return D.apply("diag_embed", impl, (x,),
+                   {"offset": int(offset), "dim1": int(dim1),
+                    "dim2": int(dim2)})
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """Scale down to L2 norm <= max_norm (reference ops.yaml
+    clip_by_norm; nn/clip.py ClipGradByNorm semantics)."""
+    def impl(a, max_norm):
+        norm = jnp.sqrt(jnp.sum(a.astype(jnp.float32) ** 2))
+        scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12),
+                          1.0)
+        return (a.astype(jnp.float32) * scale).astype(a.dtype)
+
+    return D.apply("clip_by_norm", impl, (x,), {"max_norm": float(max_norm)})
+
+
+def mean_all(x, name=None):
+    """Scalar mean over every element (reference ops.yaml mean_all)."""
+    return D.apply("mean_all", lambda a: jnp.mean(a), (x,))
+
+
+def frobenius_norm(x, axis=None, keepdim=False, name=None):
+    """(reference tensor/linalg.py frobenius_norm branch of norm)."""
+    def impl(a, axis, keepdim):
+        af = a.astype(jnp.float32)
+        out = jnp.sqrt(jnp.sum(af * af, axis=axis, keepdims=keepdim))
+        return out.astype(a.dtype)
+
+    ax = tuple(int(a) for a in axis) if isinstance(axis, (tuple, list)) \
+        else (None if axis is None else int(axis))
+    return D.apply("frobenius_norm", impl, (x,),
+                   {"axis": ax, "keepdim": bool(keepdim)})
+
+
+def squared_l2_norm(x, name=None):
+    """sum(x^2) as a scalar (reference ops.yaml squared_l2_norm — the grad
+    -clip helper kernel)."""
+    return D.apply("squared_l2_norm",
+                   lambda a: jnp.sum(a.astype(jnp.float32) ** 2), (x,))
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """Length vector -> boolean-ish mask [..., maxlen] (reference
+    tensor/creation.py sequence_mask / ops.yaml sequence_mask)."""
+    lens = _t(x)
+    if maxlen is None:
+        maxlen = int(jnp.max(lens)) if lens.size else 0
+
+    def impl(lens, maxlen, dt):
+        pos = jnp.arange(maxlen, dtype=lens.dtype)
+        return (pos[None, :] < lens[..., None].reshape(-1, 1)).reshape(
+            lens.shape + (maxlen,)).astype(dt)
+
+    return D.apply("sequence_mask", impl, (x,),
+                   {"maxlen": int(maxlen), "dt": to_jax_dtype(dtype)})
+
+
+def gather_tree(ids, parents, name=None):
+    """Reconstruct full beam-search sequences from per-step ids + parent
+    beam indices (reference tensor/manipulation.py gather_tree, kernel
+    phi/kernels/gather_tree_kernel).  ids/parents: [T, B, beam]."""
+    def impl(ids, parents):
+        T = ids.shape[0]
+        beams = jnp.broadcast_to(
+            jnp.arange(ids.shape[2], dtype=parents.dtype)[None, :],
+            (ids.shape[1], ids.shape[2]))
+
+        def step(carry, t):
+            beam = carry                      # [B, beam] beam index at t+1
+            tt = T - 1 - t
+            out = jnp.take_along_axis(ids[tt], beam, axis=1)
+            parent = jnp.take_along_axis(parents[tt], beam,
+                                         axis=1).astype(beam.dtype)
+            return parent, out
+
+        _, rev = jax.lax.scan(step, beams, jnp.arange(T))
+        return jnp.flip(rev, axis=0)
+
+    return D.apply("gather_tree", impl, (ids, parents))
+
+
+def top_p_sampling(x, ps, threshold=None, seed=-1, name=None):
+    """Nucleus sampling over probabilities x [B, V] with per-row p
+    (reference ops.yaml top_p_sampling).  Returns (sampled values,
+    sampled ids)."""
+    def impl(probs, ps, seed):
+        sort_idx = jnp.argsort(-probs, axis=-1)
+        sorted_p = jnp.take_along_axis(probs, sort_idx, axis=-1)
+        cum = jnp.cumsum(sorted_p, axis=-1)
+        keep = cum - sorted_p <= ps[:, None]     # always keep the top token
+        trimmed = jnp.where(keep, sorted_p, 0.0)
+        trimmed = trimmed / jnp.sum(trimmed, axis=-1, keepdims=True)
+        key = jax.random.PRNGKey(seed if seed >= 0 else 0)
+        pick = jax.random.categorical(
+            key, jnp.log(jnp.maximum(trimmed, 1e-38)), axis=-1)
+        ids = jnp.take_along_axis(sort_idx, pick[:, None], axis=-1)
+        vals = jnp.take_along_axis(probs, ids, axis=-1)
+        return vals, ids.astype(jnp.int64)
+
+    if seed < 0:
+        import random as _r
+        seed = _r.randint(0, 2 ** 31 - 1)
+    return D.apply("top_p_sampling", impl, (x, ps), {"seed": int(seed)},
+                   num_outputs=2)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """Shift a fraction of channels one step along the segment (time) dim
+    (reference nn/functional/extension.py temporal_shift)."""
+    def impl(a, seg_num, shift_ratio, data_format):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        back = jnp.pad(v[:, 1:, :c1], ((0, 0), (0, 1), (0, 0), (0, 0),
+                                       (0, 0)))
+        fwd = jnp.pad(v[:, :-1, c1:c2], ((0, 0), (1, 0), (0, 0), (0, 0),
+                                         (0, 0)))
+        out = jnp.concatenate([back, fwd, v[:, :, c2:]], axis=2)
+        out = out.reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return D.apply("temporal_shift", impl, (x,),
+                   {"seg_num": int(seg_num),
+                    "shift_ratio": float(shift_ratio),
+                    "data_format": str(data_format)})
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Batched Levenshtein distance (reference nn/functional/loss
+    edit_distance; CPU kernel phi/kernels/cpu/edit_distance_kernel.cc).
+    Host-side: the DP is data-dependent, the reference also runs it on CPU.
+    Returns (distance [B,1] float32, sequence_num [1] int64)."""
+    hyp = np.asarray(input.numpy() if isinstance(input, Tensor) else input)
+    ref = np.asarray(label.numpy() if isinstance(label, Tensor) else label)
+    hl = (np.asarray(input_length.numpy() if isinstance(input_length, Tensor)
+                     else input_length).reshape(-1)
+          if input_length is not None else
+          np.full((hyp.shape[0],), hyp.shape[1], np.int64))
+    ll = (np.asarray(label_length.numpy() if isinstance(label_length, Tensor)
+                     else label_length).reshape(-1)
+          if label_length is not None else
+          np.full((ref.shape[0],), ref.shape[1], np.int64))
+    ignored = set(ignored_tokens or ())
+    out = np.zeros((hyp.shape[0], 1), np.float32)
+    for b in range(hyp.shape[0]):
+        h = [t for t in hyp[b][:hl[b]].tolist() if t not in ignored]
+        r = [t for t in ref[b][:ll[b]].tolist() if t not in ignored]
+        dp = np.arange(len(r) + 1, dtype=np.float32)
+        for i, th in enumerate(h, 1):
+            prev = dp.copy()
+            dp[0] = i
+            for j, tr in enumerate(r, 1):
+                dp[j] = min(prev[j] + 1, dp[j - 1] + 1,
+                            prev[j - 1] + (th != tr))
+        d = dp[len(r)]
+        if normalized:
+            d = d / max(len(r), 1)
+        out[b, 0] = d
+    return (Tensor(jnp.asarray(out)),
+            Tensor(jnp.asarray([hyp.shape[0]], jnp.int64)))
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decoding (reference text/viterbi_decode.py /
+    ops.yaml viterbi_decode): potentials [B, T, N], transition [N(+2), ...].
+    Returns (scores [B], paths [B, T])."""
+    def impl(emis, trans, lens, with_tag):
+        B, T, N = emis.shape
+        emis = emis.astype(jnp.float32)
+        trans = trans.astype(jnp.float32)
+        if with_tag:
+            # rows/cols N and N+1 are BOS/EOS (reference convention)
+            start = trans[N, :N]
+            stop = trans[:N, N + 1]
+            tr = trans[:N, :N]
+        else:
+            start = jnp.zeros((N,), jnp.float32)
+            stop = jnp.zeros((N,), jnp.float32)
+            tr = trans
+
+        alpha0 = emis[:, 0] + start[None, :]
+
+        def step(carry, t):
+            alpha = carry                       # [B, N]
+            scores = alpha[:, :, None] + tr[None, :, :] + emis[:, t][:, None, :]
+            best = jnp.max(scores, axis=1)
+            back = jnp.argmax(scores, axis=1)
+            # positions past the sequence keep their alpha (masked)
+            live = (t < lens)[:, None]
+            return jnp.where(live, best, alpha), back
+
+        alpha, backs = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        final = alpha + stop[None, :] if with_tag else alpha
+        score = jnp.max(final, axis=-1)
+        last = jnp.argmax(final, axis=-1)
+
+        def walk(carry, t):
+            tag = carry                        # [B]
+            tt = T - 2 - t
+            prev = jnp.take_along_axis(backs[tt], tag[:, None], axis=1)[:, 0]
+            live = (tt + 1) < lens
+            newtag = jnp.where(live, prev, tag)
+            return newtag, tag
+
+        # rev emits tags at positions T-1 .. 1; the final carry is position 0
+        tag0, rev = jax.lax.scan(walk, last, jnp.arange(T - 1))
+        path = jnp.concatenate([tag0[:, None], jnp.flip(rev.T, axis=1)],
+                               axis=1)
+        return score, path.astype(jnp.int64)
+
+    return D.apply("viterbi_decode", impl,
+                   (potentials, transition_params, lengths),
+                   {"with_tag": bool(include_bos_eos_tag)}, num_outputs=2)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view materialized via gather (reference
+    tensor/manipulation.py as_strided over strided TensorImpl — XLA has no
+    aliasing views, so this produces the same VALUES as a copy)."""
+    def impl(a, shape, stride, offset):
+        flat = a.reshape(-1)
+        idx = jnp.asarray(offset, jnp.int32)
+        for n, s in zip(shape, stride):
+            idx = idx[..., None] + jnp.arange(n, dtype=jnp.int32) * s
+        return jnp.take(flat, idx.reshape(shape), mode="clip")
+
+    return D.apply("as_strided", impl, (x,),
+                   {"shape": tuple(int(s) for s in shape),
+                    "stride": tuple(int(s) for s in stride),
+                    "offset": int(offset)})

@@ -1,5 +1,6 @@
 """vision namespace (parity with /root/reference/python/paddle/vision/)."""
 from . import datasets  # noqa: F401
 from . import models  # noqa: F401
+from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
 from .models import LeNet  # noqa: F401

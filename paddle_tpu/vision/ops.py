@@ -1,0 +1,370 @@
+"""Detection / vision ops (reference python/paddle/vision/ops.py: nms,
+roi_align, roi_pool, psroi_pool, box_coder, yolo_box, deform_conv2d, ... over
+CUDA kernels).
+
+TPU-native scope: the dense, MXU/VPU-friendly ops run on device through the
+dispatcher (roi_align, roi_pool, box_coder, yolo_box, psroi_pool); NMS — a
+data-dependent sequential suppression — runs as a fixed-iteration on-device
+loop (lax.fori_loop over boxes, the standard XLA formulation) so it stays
+jittable.  deform_conv2d / generate_proposals / matrix_nms remain
+unimplemented (raise) — they are detection-pipeline specials the reference
+also gates behind CUDA kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch as D
+from ..core.tensor import Tensor
+
+__all__ = ["nms", "roi_align", "roi_pool", "psroi_pool", "box_coder",
+           "yolo_box", "deform_conv2d", "RoIAlign", "RoIPool"]
+
+
+def _t(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = (boxes[:, i] for i in range(4))
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Hard NMS (reference vision/ops.py:1934).  boxes [N, 4] xyxy.
+
+    Returns kept indices sorted by descending score.  Category-aware mode
+    offsets boxes per category so cross-category pairs never overlap (the
+    standard batched-NMS trick; numerically identical to per-category NMS).
+    """
+    b = _t(boxes).astype(jnp.float32)
+    n = b.shape[0]
+    s = (_t(scores).astype(jnp.float32) if scores is not None
+         else jnp.arange(n, 0, -1, dtype=jnp.float32))
+    if category_idxs is not None:
+        cat = _t(category_idxs).astype(jnp.float32)
+        span = jnp.max(b) - jnp.min(b) + 1.0
+        b = b + (cat * span)[:, None]
+
+    def impl(b, s, thr):
+        order = jnp.argsort(-s)
+        bs = b[order]
+        iou = _iou_matrix(bs)
+        keep = jnp.ones((bs.shape[0],), bool)
+
+        def body(i, keep):
+            # suppress j > i overlapping a KEPT i
+            sup = (iou[i] > thr) & (jnp.arange(keep.shape[0]) > i) & keep[i]
+            return keep & ~sup
+
+        keep = jax.lax.fori_loop(0, bs.shape[0], body, keep)
+        return order, keep
+
+    order, keep = D.apply(
+        "nms", impl, (Tensor(b), Tensor(s)),
+        {"thr": float(iou_threshold)}, num_outputs=2)
+    order_np = order.numpy()
+    keep_np = keep.numpy()
+    kept = order_np[keep_np]          # kept indices in descending-score order
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(jnp.asarray(kept))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (reference vision/ops.py:1705, kernel
+    phi/kernels/gpu/roi_align_kernel.cu): bilinear-sampled average pooling
+    over each box.  x [N, C, H, W]; boxes [R, 4] xyxy; boxes_num [N]."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def impl(x, boxes, boxes_num, ph, pw, scale, ratio, aligned):
+        N, C, H, W = x.shape
+        R = boxes.shape[0]
+        # map each roi to its batch image
+        ends = jnp.cumsum(boxes_num)
+        batch_of = jnp.searchsorted(ends, jnp.arange(R), side="right")
+        off = 0.5 if aligned else 0.0
+        bx = boxes.astype(jnp.float32) * scale - off
+
+        x1, y1, x2, y2 = bx[:, 0], bx[:, 1], bx[:, 2], bx[:, 3]
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        ns = ratio if ratio > 0 else 2    # samples per bin side
+        # sample grid [R, ph*ns] x [R, pw*ns]
+        iy = (jnp.arange(ph * ns) + 0.5) / ns
+        ix = (jnp.arange(pw * ns) + 0.5) / ns
+        sy = y1[:, None] + iy[None, :] * bin_h[:, None]   # [R, ph*ns]
+        sx = x1[:, None] + ix[None, :] * bin_w[:, None]   # [R, pw*ns]
+
+        def bilinear(img, yy, xx):
+            # img [C, H, W]; yy [Py], xx [Px] -> [C, Py, Px]
+            y0 = jnp.clip(jnp.floor(yy), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, W - 1)
+            y1_ = jnp.clip(y0 + 1, 0, H - 1)
+            x1_ = jnp.clip(x0 + 1, 0, W - 1)
+            wy1 = jnp.clip(yy, 0, H - 1) - y0
+            wx1 = jnp.clip(xx, 0, W - 1) - x0
+            y0i, y1i = y0.astype(jnp.int32), y1_.astype(jnp.int32)
+            x0i, x1i = x0.astype(jnp.int32), x1_.astype(jnp.int32)
+            g = lambda yi, xi: img[:, yi][:, :, xi]      # noqa: E731
+            out = (g(y0i, x0i) * ((1 - wy1)[:, None] * (1 - wx1)[None, :])
+                   + g(y0i, x1i) * ((1 - wy1)[:, None] * wx1[None, :])
+                   + g(y1i, x0i) * (wy1[:, None] * (1 - wx1)[None, :])
+                   + g(y1i, x1i) * (wy1[:, None] * wx1[None, :]))
+            return out
+
+        def one_roi(r):
+            img = x[batch_of[r]]
+            samp = bilinear(img, sy[r], sx[r])           # [C, ph*ns, pw*ns]
+            return samp.reshape(C, ph, ns, pw, ns).mean(axis=(2, 4))
+
+        return jax.vmap(one_roi)(jnp.arange(R)).astype(x.dtype)
+
+    return D.apply("roi_align", impl, (x, boxes, boxes_num),
+                   {"ph": int(ph), "pw": int(pw),
+                    "scale": float(spatial_scale),
+                    "ratio": int(sampling_ratio), "aligned": bool(aligned)})
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """RoIPool — max pooling over quantized bins (reference
+    vision/ops.py:1572).  Implemented as dense-sampled max (8 samples/bin),
+    which converges to the quantized max on integral grids."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def impl(x, boxes, boxes_num, ph, pw, scale):
+        N, C, H, W = x.shape
+        R = boxes.shape[0]
+        ends = jnp.cumsum(boxes_num)
+        batch_of = jnp.searchsorted(ends, jnp.arange(R), side="right")
+        bx = jnp.round(boxes.astype(jnp.float32) * scale)
+        x1, y1 = bx[:, 0], bx[:, 1]
+        rw = jnp.maximum(bx[:, 2] - x1 + 1, 1.0)
+        rh = jnp.maximum(bx[:, 3] - y1 + 1, 1.0)
+        ns = 8
+        iy = (jnp.arange(ph * ns) + 0.5) / (ph * ns)
+        ix = (jnp.arange(pw * ns) + 0.5) / (pw * ns)
+        sy = y1[:, None] + iy[None, :] * rh[:, None]
+        sx = x1[:, None] + ix[None, :] * rw[:, None]
+
+        def one_roi(r):
+            img = x[batch_of[r]]
+            yi = jnp.clip(sy[r].astype(jnp.int32), 0, H - 1)
+            xi = jnp.clip(sx[r].astype(jnp.int32), 0, W - 1)
+            samp = img[:, yi][:, :, xi]                  # [C, ph*ns, pw*ns]
+            return samp.reshape(C, ph, ns, pw, ns).max(axis=(2, 4))
+
+        return jax.vmap(one_roi)(jnp.arange(R)).astype(x.dtype)
+
+    return D.apply("roi_pool", impl, (x, boxes, boxes_num),
+                   {"ph": int(ph), "pw": int(pw),
+                    "scale": float(spatial_scale)})
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference vision/ops.py:1441):
+    channel c of output bin (i, j) averages input channel c*ph*pw + i*pw + j
+    over that bin."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def impl(x, boxes, boxes_num, ph, pw, scale):
+        N, C, H, W = x.shape
+        assert C % (ph * pw) == 0, "channels must divide output_size^2"
+        Cout = C // (ph * pw)
+        R = boxes.shape[0]
+        ends = jnp.cumsum(boxes_num)
+        batch_of = jnp.searchsorted(ends, jnp.arange(R), side="right")
+        bx = boxes.astype(jnp.float32) * scale
+        x1, y1 = bx[:, 0], bx[:, 1]
+        rw = jnp.maximum(bx[:, 2] - x1, 0.1)
+        rh = jnp.maximum(bx[:, 3] - y1, 0.1)
+        ns = 4
+        iy = (jnp.arange(ph * ns) + 0.5) / ns
+        ix = (jnp.arange(pw * ns) + 0.5) / ns
+        sy = y1[:, None] + iy[None, :] * (rh / ph)[:, None]
+        sx = x1[:, None] + ix[None, :] * (rw / pw)[:, None]
+
+        def one_roi(r):
+            img = x[batch_of[r]].reshape(Cout, ph, pw, H, W)
+            yi = jnp.clip(sy[r].astype(jnp.int32), 0, H - 1)
+            xi = jnp.clip(sx[r].astype(jnp.int32), 0, W - 1)
+            samp = img[:, :, :, yi][:, :, :, :, xi]  # [Cout,ph,pw,ph*ns,pw*ns]
+            samp = samp.reshape(Cout, ph, pw, ph, ns, pw, ns)
+
+            # bin (i, j) reads its own sensitive map (i, j) at location (i, j)
+            def bin_val(i, j):
+                return samp[:, i, j, i, :, j, :].mean(axis=(-1, -2))
+            rows = []
+            for i in range(ph):
+                cols = [bin_val(i, j) for j in range(pw)]
+                rows.append(jnp.stack(cols, axis=-1))
+            return jnp.stack(rows, axis=-2)   # [Cout, ph, pw]
+
+        return jax.vmap(one_roi)(jnp.arange(R)).astype(x.dtype)
+
+    return D.apply("psroi_pool", impl, (x, boxes, boxes_num),
+                   {"ph": int(ph), "pw": int(pw),
+                    "scale": float(spatial_scale)})
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (reference vision/ops.py:584)."""
+    def impl(prior, pvar, target, code_type, norm, axis):
+        prior = prior.astype(jnp.float32)
+        target = target.astype(jnp.float32)
+        one = 0.0 if norm else 1.0
+        pw = prior[:, 2] - prior[:, 0] + one
+        ph = prior[:, 3] - prior[:, 1] + one
+        pcx = prior[:, 0] + pw * 0.5
+        pcy = prior[:, 1] + ph * 0.5
+        pvar = pvar.astype(jnp.float32)
+        if code_type == "encode_center_size":
+            tw = target[:, 2] - target[:, 0] + one
+            th = target[:, 3] - target[:, 1] + one
+            tcx = target[:, 0] + tw * 0.5
+            tcy = target[:, 1] + th * 0.5
+            # [T, P] pairwise encode
+            dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+            dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+            dw = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+            dh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+            out = jnp.stack([dx, dy, dw, dh], axis=-1)
+            v = pvar if pvar.ndim == 1 else pvar[None, :, :]
+            return out / v
+        # decode: target [T, P, 4] or broadcast along `axis`
+        t = target
+        if t.ndim == 2:
+            t = t[:, None, :]
+        v = pvar if pvar.ndim == 1 else pvar[:, None, :] \
+            if axis == 0 else pvar[None, :, :]
+        if axis == 0:
+            pcx_, pcy_, pw_, ph_ = (a[None, :] for a in (pcx, pcy, pw, ph))
+        else:
+            pcx_, pcy_, pw_, ph_ = (a[:, None] for a in (pcx, pcy, pw, ph))
+        tv = t * v
+        ocx = tv[..., 0] * pw_ + pcx_
+        ocy = tv[..., 1] * ph_ + pcy_
+        ow = jnp.exp(tv[..., 2]) * pw_
+        oh = jnp.exp(tv[..., 3]) * ph_
+        return jnp.stack([ocx - ow * 0.5, ocy - oh * 0.5,
+                          ocx + ow * 0.5 - one, ocy + oh * 0.5 - one],
+                         axis=-1)
+
+    if prior_box_var is None:
+        pvar = Tensor(jnp.ones((4,), jnp.float32))
+    elif isinstance(prior_box_var, (list, tuple)):
+        pvar = Tensor(jnp.asarray(prior_box_var, jnp.float32))
+    else:
+        pvar = prior_box_var
+    return D.apply("box_coder", impl, (prior_box, pvar, target_box),
+                   {"code_type": str(code_type), "norm": bool(box_normalized),
+                    "axis": int(axis)})
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode YOLOv3 head outputs into boxes+scores (reference
+    vision/ops.py:277)."""
+    def impl(x, img_size, anchors, class_num, conf_thresh, ds, clip,
+             sxy, iou_aware, iaf):
+        N, C, H, W = x.shape
+        na = len(anchors) // 2
+        an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+        if iou_aware:
+            ioup = jax.nn.sigmoid(x[:, :na].astype(jnp.float32))
+            x = x[:, na:]
+        feat = x.reshape(N, na, 5 + class_num, H, W).astype(jnp.float32)
+        gx = jnp.arange(W, dtype=jnp.float32)[None, :]
+        gy = jnp.arange(H, dtype=jnp.float32)[:, None]
+        bias = 0.5 * (sxy - 1.0)
+        cx = (jax.nn.sigmoid(feat[:, :, 0]) * sxy - bias + gx[None, None]) / W
+        cy = (jax.nn.sigmoid(feat[:, :, 1]) * sxy - bias + gy[None, None]) / H
+        bw = jnp.exp(feat[:, :, 2]) * an[None, :, 0, None, None] / (ds * W)
+        bh = jnp.exp(feat[:, :, 3]) * an[None, :, 1, None, None] / (ds * H)
+        conf = jax.nn.sigmoid(feat[:, :, 4])
+        if iou_aware:
+            conf = conf ** (1 - iaf) * ioup ** iaf
+        cls = jax.nn.sigmoid(feat[:, :, 5:]) * conf[:, :, None]
+        ih = img_size[:, 0].astype(jnp.float32)
+        iw = img_size[:, 1].astype(jnp.float32)
+        x1 = (cx - bw * 0.5) * iw[:, None, None, None]
+        y1 = (cy - bh * 0.5) * ih[:, None, None, None]
+        x2 = (cx + bw * 0.5) * iw[:, None, None, None]
+        y2 = (cy + bh * 0.5) * ih[:, None, None, None]
+        if clip:
+            x1 = jnp.clip(x1, 0, iw[:, None, None, None] - 1)
+            y1 = jnp.clip(y1, 0, ih[:, None, None, None] - 1)
+            x2 = jnp.clip(x2, 0, iw[:, None, None, None] - 1)
+            y2 = jnp.clip(y2, 0, ih[:, None, None, None] - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(N, -1, 4)
+        scores = jnp.moveaxis(cls, 2, -1).reshape(N, -1, class_num)
+        # zero out low-confidence boxes (reference semantic)
+        keep = (conf.reshape(N, -1) >= conf_thresh)[..., None]
+        return boxes * keep, scores * keep
+
+    return D.apply("yolo_box", impl, (x, img_size),
+                   {"anchors": tuple(int(a) for a in anchors),
+                    "class_num": int(class_num),
+                    "conf_thresh": float(conf_thresh),
+                    "ds": int(downsample_ratio), "clip": bool(clip_bbox),
+                    "sxy": float(scale_x_y), "iou_aware": bool(iou_aware),
+                    "iaf": float(iou_aware_factor)}, num_outputs=2)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    raise NotImplementedError(
+        "deform_conv2d is not implemented in this TPU build (the reference "
+        "gates it behind a CUDA kernel, vision/ops.py:766); use roi_align "
+        "or standard conv2d, or register a custom Pallas kernel via "
+        "paddle_tpu.utils.cpp_extension")
+
+
+class RoIAlign:
+    """Layer wrapper (reference vision/ops.py:1826)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+class RoIPool:
+    """Layer wrapper (reference vision/ops.py:1657)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)

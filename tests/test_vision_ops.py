@@ -1,0 +1,151 @@
+"""Vision/detection op tests (reference python/paddle/vision/ops.py and
+nn/functional/vision.py; NumPy/torch-free oracles)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import ops as V
+
+
+def test_nms_basic():
+    boxes = paddle.to_tensor(np.asarray([
+        [0, 0, 10, 10],
+        [1, 1, 11, 11],      # overlaps box 0 heavily
+        [20, 20, 30, 30],
+        [21, 21, 31, 31],    # overlaps box 2 heavily
+    ], np.float32))
+    scores = paddle.to_tensor(np.asarray([0.9, 0.8, 0.95, 0.1], np.float32))
+    kept = V.nms(boxes, iou_threshold=0.5, scores=scores).numpy()
+    assert kept.tolist() == [2, 0]
+
+    # category-aware: same boxes in different categories both survive
+    cats = paddle.to_tensor(np.asarray([0, 1, 0, 0], np.int64))
+    kept2 = V.nms(boxes, 0.5, scores, category_idxs=cats,
+                  categories=[0, 1]).numpy()
+    assert set(kept2.tolist()) >= {0, 1, 2}
+
+    kept3 = V.nms(boxes, 0.5, scores, top_k=1).numpy()
+    assert kept3.tolist() == [2]
+
+
+def test_roi_align_uniform_map():
+    # constant feature map -> every roi bin equals the constant
+    x = paddle.to_tensor(np.full((1, 2, 8, 8), 3.0, np.float32))
+    boxes = paddle.to_tensor(np.asarray([[1., 1., 6., 6.]], np.float32))
+    num = paddle.to_tensor(np.asarray([1], np.int32))
+    out = V.roi_align(x, boxes, num, output_size=2)
+    assert out.shape == [1, 2, 2, 2]
+    np.testing.assert_allclose(out.numpy(), 3.0, rtol=1e-5)
+
+
+def test_roi_align_gradient_flows():
+    x = paddle.to_tensor(np.random.rand(1, 1, 8, 8).astype(np.float32),
+                         stop_gradient=False)
+    boxes = paddle.to_tensor(np.asarray([[0., 0., 7., 7.]], np.float32))
+    num = paddle.to_tensor(np.asarray([1], np.int32))
+    out = V.roi_align(x, boxes, num, output_size=4)
+    out.sum().backward()
+    assert x.grad is not None
+    assert float(np.abs(x.grad.numpy()).sum()) > 0
+
+
+def test_roi_pool_max_semantics():
+    x = np.zeros((1, 1, 8, 8), np.float32)
+    x[0, 0, 2, 2] = 9.0
+    out = V.roi_pool(paddle.to_tensor(x),
+                     paddle.to_tensor(np.asarray([[0., 0., 7., 7.]],
+                                                 np.float32)),
+                     paddle.to_tensor(np.asarray([1], np.int32)),
+                     output_size=2)
+    assert out.numpy()[0, 0, 0, 0] == 9.0     # max lands in the first bin
+    assert out.numpy()[0, 0, 1, 1] == 0.0
+
+
+def test_box_coder_roundtrip():
+    priors = np.asarray([[10., 10., 30., 30.], [40., 40., 80., 100.]],
+                        np.float32)
+    targets = np.asarray([[12., 8., 33., 28.], [44., 50., 88., 94.]],
+                         np.float32)
+    enc = V.box_coder(paddle.to_tensor(priors), [1., 1., 1., 1.],
+                      paddle.to_tensor(targets),
+                      code_type="encode_center_size")
+    # decode the diagonal (each target against its own prior)
+    diag = np.stack([enc.numpy()[i, i] for i in range(2)])
+    dec = V.box_coder(paddle.to_tensor(priors), [1., 1., 1., 1.],
+                      paddle.to_tensor(diag[:, None, :].repeat(2, 1)),
+                      code_type="decode_center_size", axis=0)
+    got = np.stack([dec.numpy()[i, i] for i in range(2)])
+    np.testing.assert_allclose(got, targets, rtol=1e-4, atol=1e-3)
+
+
+def test_yolo_box_shapes():
+    N, na, cls, H, W = 2, 3, 5, 4, 4
+    x = paddle.to_tensor(np.random.rand(
+        N, na * (5 + cls), H, W).astype(np.float32))
+    img = paddle.to_tensor(np.asarray([[64, 64], [32, 48]], np.int32))
+    boxes, scores = V.yolo_box(x, img, anchors=[10, 13, 16, 30, 33, 23],
+                               class_num=cls, conf_thresh=0.0,
+                               downsample_ratio=8)
+    assert boxes.shape == [N, na * H * W, 4]
+    assert scores.shape == [N, na * H * W, cls]
+    assert np.isfinite(boxes.numpy()).all()
+
+
+def test_grid_sample_identity_and_modes():
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    theta = paddle.to_tensor(np.asarray(
+        [[[1., 0., 0.], [0., 1., 0.]]], np.float32))
+    grid = F.affine_grid(theta, [1, 1, 4, 4], align_corners=True)
+    out = F.grid_sample(x, grid, align_corners=True)
+    np.testing.assert_allclose(out.numpy(), x.numpy(), atol=1e-4)
+
+    out_n = F.grid_sample(x, grid, mode="nearest", align_corners=True)
+    np.testing.assert_allclose(out_n.numpy(), x.numpy(), atol=1e-4)
+
+    # zeros padding: a grid pointing far outside samples 0
+    far = paddle.to_tensor(np.full((1, 2, 2, 2), 5.0, np.float32))
+    out_far = F.grid_sample(x, far, padding_mode="zeros")
+    np.testing.assert_allclose(out_far.numpy(), 0.0)
+    # border padding clamps to the corner value
+    out_border = F.grid_sample(x, far, padding_mode="border")
+    np.testing.assert_allclose(out_border.numpy(), 15.0)
+
+
+def test_grid_sample_grad():
+    x = paddle.to_tensor(np.random.rand(1, 1, 4, 4).astype(np.float32),
+                         stop_gradient=False)
+    theta = paddle.to_tensor(np.asarray(
+        [[[0.8, 0., 0.1], [0., 0.8, -0.1]]], np.float32),
+        stop_gradient=False)
+    grid = F.affine_grid(theta, [1, 1, 4, 4])
+    out = F.grid_sample(x, grid)
+    out.sum().backward()
+    assert x.grad is not None and theta.grad is not None
+    assert np.isfinite(theta.grad.numpy()).all()
+
+
+def test_max_unpool2d_roundtrip():
+    x = np.asarray([[[[1., 2.], [3., 4.]]]], np.float32)
+    idx = np.asarray([[[[0, 3], [12, 15]]]], np.int64)  # flat 4x4 positions
+    out = F.max_unpool2d(paddle.to_tensor(x), paddle.to_tensor(idx),
+                         kernel_size=2)
+    want = np.zeros((1, 1, 4, 4), np.float32)
+    want[0, 0, 0, 0] = 1.
+    want[0, 0, 0, 3] = 2.
+    want[0, 0, 3, 0] = 3.
+    want[0, 0, 3, 3] = 4.
+    np.testing.assert_allclose(out.numpy(), want)
+
+
+def test_psroi_pool_shapes():
+    x = paddle.to_tensor(np.random.rand(1, 8, 8, 8).astype(np.float32))
+    boxes = paddle.to_tensor(np.asarray([[0., 0., 7., 7.]], np.float32))
+    num = paddle.to_tensor(np.asarray([1], np.int32))
+    out = V.psroi_pool(x, boxes, num, output_size=2)
+    assert out.shape == [1, 2, 2, 2]   # 8 channels / (2*2) = 2 out channels
+
+
+def test_deform_conv_raises():
+    with pytest.raises(NotImplementedError, match="deform_conv2d"):
+        V.deform_conv2d(None, None, None)
