@@ -17,13 +17,17 @@ __all__ = ["set_device", "get_device", "get_all_device_type",
            "get_all_custom_device_type", "get_available_device",
            "get_available_custom_device", "synchronize", "device_count",
            "Stream", "Event", "current_stream", "set_stream", "stream_guard",
-           "get_cudnn_version", "is_compiled_with_cinn", "IS_WINDOWS", "cuda"]
+           "get_cudnn_version", "is_compiled_with_cinn", "IS_WINDOWS", "cuda",
+           "custom"]
+
+from . import custom  # noqa: E402,F401
 
 IS_WINDOWS = False
 
 
 def get_all_custom_device_type():
-    return []
+    from .custom import registered_custom_devices
+    return registered_custom_devices()
 
 
 def get_available_device():
@@ -31,7 +35,12 @@ def get_available_device():
 
 
 def get_available_custom_device():
-    return []
+    from .custom import get_custom_device, registered_custom_devices
+    out = []
+    for t in registered_custom_devices():
+        n = get_custom_device(t).visible_device_count()
+        out.extend(f"{t}:{i}" for i in range(n))
+    return out
 
 
 def synchronize(device=None):

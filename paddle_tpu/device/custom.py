@@ -1,0 +1,134 @@
+"""Custom-device plugin ABI.
+
+Reference counterpart: the pluggable-device interface
+(/root/reference/paddle/phi/backends/device_base.h:26 DeviceInterface —
+Init/SetDevice/stream/event/memcpy/alloc virtuals, registered through
+DeviceManager, device_manager.h:134; vendors ship a dlopen'd plugin, and the
+test suite exercises the ABI with a fake device,
+paddle/phi/backends/custom/fake_cpu_device.h + test/custom_runtime/).
+
+TPU-native split of that ABI:
+- The COMPUTE plug-in point on an XLA stack is a PJRT plugin: jax discovers
+  `jax_plugins` entry points and `register_plugin` at import; a vendor
+  backend arrives as a pip package, not a paddle-specific .so.
+  ``register_pjrt_plugin`` wraps that registration.
+- What remains framework-owned — the registry, device naming
+  (``custom_dev:0``), host-callback devices for prototyping — is this
+  module: ``CustomDeviceInterface`` mirrors DeviceInterface's virtuals at
+  python level, and registered types surface through
+  ``paddle.device.get_all_custom_device_type()`` exactly like the
+  reference's runtime query.
+"""
+from __future__ import annotations
+
+__all__ = ["CustomDeviceInterface", "register_custom_device",
+           "unregister_custom_device", "registered_custom_devices",
+           "get_custom_device", "register_pjrt_plugin", "FakeCPUDevice"]
+
+_REGISTRY: dict = {}
+
+
+class CustomDeviceInterface:
+    """Python mirror of the reference DeviceInterface virtual table
+    (device_base.h:26).  Subclass and override; defaults are sane no-ops so
+    a minimal host device only needs `memory_copy`/`allocate`."""
+
+    #: device type name, e.g. "fake_cpu" (reference GetDeviceType)
+    device_type: str = "custom"
+
+    def init(self):                                    # Init()
+        return None
+
+    def visible_device_count(self) -> int:             # GetDeviceCount()
+        return 1
+
+    def set_device(self, dev_id: int):                 # SetDevice()
+        return None
+
+    def allocate(self, size: int):                     # MemoryAllocate()
+        return bytearray(size)
+
+    def deallocate(self, ptr):                         # MemoryDeallocate()
+        return None
+
+    def memory_copy(self, dst, src, size: int,         # MemoryCopyH2D/D2H
+                    kind: str = "h2d"):
+        dst[:size] = src[:size]
+
+    def create_stream(self):                           # CreateStream()
+        return object()
+
+    def synchronize(self, dev_id: int = 0):            # SynchronizeDevice()
+        return None
+
+    def get_memory_stats(self, dev_id: int = 0):       # MemoryStats()
+        return {"total": 0, "free": 0}
+
+
+def register_custom_device(impl: CustomDeviceInterface):
+    """Register a device plugin (reference DeviceManager::Register via
+    phi/capi; also LoadCustomRuntimeLib for .so plugins)."""
+    if not isinstance(impl, CustomDeviceInterface):
+        raise TypeError("impl must be a CustomDeviceInterface")
+    name = impl.device_type
+    if name in _REGISTRY:
+        raise ValueError(f"custom device {name!r} already registered")
+    impl.init()
+    _REGISTRY[name] = impl
+    return impl
+
+
+def unregister_custom_device(device_type: str):
+    _REGISTRY.pop(device_type, None)
+
+
+def registered_custom_devices() -> list:
+    return sorted(_REGISTRY)
+
+
+def get_custom_device(device_type: str) -> CustomDeviceInterface:
+    try:
+        return _REGISTRY[device_type]
+    except KeyError:
+        raise ValueError(
+            f"no custom device {device_type!r}; registered: "
+            f"{sorted(_REGISTRY)}") from None
+
+
+def register_pjrt_plugin(name: str, library_path: str, options=None):
+    """Register a PJRT plugin as a JAX backend — the XLA-stack equivalent of
+    the reference's dlopen'd custom-runtime .so.  After registration the
+    device is a first-class jax backend (visible to jax.devices(name))."""
+    from jax._src.xla_bridge import register_plugin
+    register_plugin(name, library_path=library_path, options=options)
+
+
+class FakeCPUDevice(CustomDeviceInterface):
+    """Host-memory fake device (reference fake_cpu_device.h — used by
+    test/custom_runtime/ to exercise the ABI without hardware)."""
+
+    device_type = "fake_cpu"
+
+    def __init__(self, count: int = 2):
+        self._count = count
+        self._streams = 0
+        self._current = 0
+        self.initialized = False
+
+    def init(self):
+        self.initialized = True
+
+    def visible_device_count(self):
+        return self._count
+
+    def set_device(self, dev_id):
+        if not 0 <= dev_id < self._count:
+            raise ValueError(f"fake_cpu has {self._count} devices")
+        self._current = dev_id
+
+    def create_stream(self):
+        self._streams += 1
+        return self._streams
+
+    def get_memory_stats(self, dev_id=0):
+        return {"total": 1 << 30, "free": 1 << 29}

@@ -1,0 +1,54 @@
+"""Auto-tuning config (reference python/paddle/incubate/autotune.py
+``set_config`` — kernel / layout / dataloader tuning knobs backed by the C++
+autotune cache, paddle/phi/kernels/autotune/).
+
+TPU-native mapping:
+- kernel tuning  -> XLA's autotuner owns per-op algorithm choice under
+  jit; the knob here toggles the Pallas-kernel dispatch probes
+  (FLAGS_use_pallas_kernels) which is the only kernel-selection dimension
+  the framework itself controls.
+- layout tuning  -> XLA chooses layouts during compilation; accepted and
+  recorded as a no-op (the reference's layout pass is a CUDA NHWC/NCHW
+  concern).
+- dataloader tuning -> real: DataLoader consults
+  ``get_config()['dataloader']`` to benchmark worker counts over
+  ``tuning_steps`` batches and pick the fastest (the reference tunes
+  num_workers the same way).
+"""
+from __future__ import annotations
+
+import json
+
+__all__ = ["set_config", "get_config"]
+
+_config = {
+    "kernel": {"enable": True, "tuning_range": [1, 10]},
+    "layout": {"enable": False},
+    "dataloader": {"enable": False, "tuning_steps": 500},
+}
+
+
+def set_config(config=None):
+    """Accepts a dict (possibly partial) or a path to a JSON file
+    (reference autotune.py:60)."""
+    if config is None:
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    if not isinstance(config, dict):
+        raise TypeError("config must be None, dict, or a JSON file path")
+    for section in ("kernel", "layout", "dataloader"):
+        if section in config:
+            sec = config[section]
+            if not isinstance(sec, dict):
+                raise TypeError(f"config[{section!r}] must be a dict")
+            _config[section].update(sec)
+    if "kernel" in config:
+        from ..core.flags import set_flags
+        set_flags({"use_pallas_kernels":
+                   bool(_config["kernel"]["enable"])})
+
+
+def get_config() -> dict:
+    return {k: dict(v) for k, v in _config.items()}

@@ -566,7 +566,48 @@ class DataLoader:
             for idx_batch in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in idx_batch])
 
+    def _autotune_workers(self):
+        """Pick num_workers by measuring candidate counts on real batches
+        (reference incubate/autotune.py dataloader tuning: benchmark over
+        tuning_steps and keep the fastest).  Runs once per loader."""
+        import time as _time
+
+        from ..incubate.autotune import get_config
+        cfg = get_config().get("dataloader", {})
+        if not cfg.get("enable") or getattr(self, "_tuned", False):
+            return
+        self._tuned = True
+        steps = max(2, min(int(cfg.get("tuning_steps", 500)), 64))
+        best, best_dt = self.num_workers, float("inf")
+        for cand in {0, 2, self.num_workers}:
+            if cand < 0:
+                continue
+            self.num_workers = cand
+            it = iter(self._raw_iter())
+            try:
+                next(it)                       # warm (worker spin-up)
+            except StopIteration:
+                continue
+            t0 = _time.perf_counter()
+            n = 0
+            try:
+                for _ in range(steps):
+                    next(it)
+                    n += 1
+            except StopIteration:
+                pass
+            dt = (_time.perf_counter() - t0) / max(n, 1)
+            if n and dt < best_dt:
+                best, best_dt = cand, dt
+            del it
+        self.num_workers = best
+
+    def _raw_iter(self):
+        yield from DataLoader.__iter__(self)
+
     def __iter__(self):
+        if not getattr(self, "_tuned", False):
+            self._autotune_workers()
         # reader-time attribution for the throughput meter
         # (reference timer.py hooks the reader the same way)
         from ..profiler.timer import benchmark as _benchmark

@@ -20,6 +20,21 @@ pipeline_parallel.py:684 1F1B) re-designed for XLA:
 
 Vocab-parallel embedding + cross entropy follow the reference's
 VocabParallelEmbedding / ParallelCrossEntropy (mp_layers.py:49, mp_ops.py).
+
+On zero-bubble schedules (reference
+passes/pipeline_scheduler_pass/pipeline_zero_bubble.py): ZB-H1 splits the
+backward into B (input-grad) and W (weight-grad) phases and slots W into
+cooldown bubbles.  That split buys nothing in THIS design and is therefore
+deliberately not implemented: the compiled schedules are SPMD-uniform — every
+stage executes the same program text each scan tick with `where`-masked
+effects, so a "bubble" tick costs the same as a busy one and W work moved
+into it still adds its full cost to every tick.  Separating W would also
+force a second forward recompute per microbatch (the vjp that produces
+dparams cannot share the dact vjp's residuals across scan steps without
+O(M) activation storage), making ZB-H1 strictly slower here whenever
+M >= 2(pp-1).  The TPU-native lever for the same bubble is interleaving:
+the compiled VPP schedule (vpp>1) divides the bubble fraction by the chunk
+count, verified by `pipeline_stats` in tests/test_hybrid_parallel.py.
 """
 from __future__ import annotations
 

@@ -1,0 +1,56 @@
+"""incubate.autotune config tests (reference incubate/autotune.py
+set_config: kernel/layout/dataloader sections, JSON-file input)."""
+import json
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.autotune import get_config, set_config
+
+
+def teardown_module():
+    set_config({"kernel": {"enable": True},
+                "dataloader": {"enable": False, "tuning_steps": 500}})
+
+
+def test_set_config_sections_and_file(tmp_path):
+    set_config({"dataloader": {"enable": True, "tuning_steps": 4}})
+    assert get_config()["dataloader"] == {"enable": True, "tuning_steps": 4}
+
+    p = tmp_path / "at.json"
+    p.write_text(json.dumps({"kernel": {"enable": False}}))
+    set_config(str(p))
+    assert get_config()["kernel"]["enable"] is False
+    # kernel knob drives the pallas dispatch flag
+    assert paddle.get_flags(["FLAGS_use_pallas_kernels"])[
+        "FLAGS_use_pallas_kernels"] is False
+    set_config({"kernel": {"enable": True}})
+
+    try:
+        set_config(42)
+        raise AssertionError("expected TypeError")
+    except TypeError:
+        pass
+
+
+def test_dataloader_autotune_picks_workers():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class DS(Dataset):
+        def __getitem__(self, i):
+            return np.full((4,), i, np.float32)
+
+        def __len__(self):
+            return 64
+
+    set_config({"dataloader": {"enable": True, "tuning_steps": 2}})
+    loader = DataLoader(DS(), batch_size=4, num_workers=0)
+    batches = list(loader)
+    assert len(batches) == 16                 # data intact after tuning
+    assert loader._tuned
+    assert loader.num_workers in (0, 2)      # a measured decision was made
+    # disabled -> no tuning state on a fresh loader
+    set_config({"dataloader": {"enable": False}})
+    loader2 = DataLoader(DS(), batch_size=4, num_workers=0)
+    next(iter(loader2))
+    assert loader2.num_workers == 0

@@ -1,0 +1,68 @@
+"""Custom-device ABI tests (reference paddle/phi/backends/custom/
+fake_cpu_device.h + test/custom_runtime/ strategy: exercise the plugin
+interface with a fake device, no hardware)."""
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.device.custom import (
+    CustomDeviceInterface, FakeCPUDevice, get_custom_device,
+    register_custom_device, registered_custom_devices,
+    unregister_custom_device,
+)
+
+
+@pytest.fixture
+def fake():
+    dev = register_custom_device(FakeCPUDevice(count=2))
+    yield dev
+    unregister_custom_device("fake_cpu")
+
+
+def test_register_and_query(fake):
+    assert fake.initialized                      # init() ran at registration
+    assert "fake_cpu" in registered_custom_devices()
+    assert paddle.device.get_all_custom_device_type() == ["fake_cpu"]
+    assert paddle.device.get_available_custom_device() == \
+        ["fake_cpu:0", "fake_cpu:1"]
+    assert get_custom_device("fake_cpu") is fake
+
+
+def test_device_interface_contract(fake):
+    fake.set_device(1)
+    with pytest.raises(ValueError):
+        fake.set_device(5)
+    assert fake.create_stream() == 1
+    assert fake.create_stream() == 2
+    stats = fake.get_memory_stats(0)
+    assert stats["total"] > stats["free"] > 0
+
+    # memory path: default host implementation copies bytes
+    dst = bytearray(8)
+    fake.memory_copy(dst, b"abcdefgh", 8)
+    assert bytes(dst) == b"abcdefgh"
+
+
+def test_duplicate_and_unknown_registration(fake):
+    with pytest.raises(ValueError, match="already registered"):
+        register_custom_device(FakeCPUDevice())
+    with pytest.raises(ValueError, match="no custom device"):
+        get_custom_device("nope")
+    with pytest.raises(TypeError):
+        register_custom_device(object())
+
+
+def test_unregistered_state_clean():
+    assert "fake_cpu" not in registered_custom_devices()
+    assert paddle.device.get_available_custom_device() == []
+
+
+def test_subclass_minimal():
+    class MyDev(CustomDeviceInterface):
+        device_type = "npu_sim"
+
+    d = register_custom_device(MyDev())
+    try:
+        assert d.visible_device_count() == 1
+        assert "npu_sim" in paddle.device.get_all_custom_device_type()
+    finally:
+        unregister_custom_device("npu_sim")
