@@ -84,6 +84,7 @@ from . import hub  # noqa: E402
 from . import onnx  # noqa: E402
 from . import signal  # noqa: E402
 from . import sparse  # noqa: E402
+from . import static  # noqa: E402
 from . import strings  # noqa: E402
 from . import text  # noqa: E402
 from . import utils  # noqa: E402
@@ -91,6 +92,7 @@ from . import vision  # noqa: E402
 
 from .framework.io import load, save  # noqa: E402
 from .hapi.model import Model, summary  # noqa: E402
+from .hapi import callbacks  # noqa: E402  (paddle.callbacks alias)
 from .nn.layer.layers import Layer  # noqa: E402
 
 DataParallel = distributed.DataParallel

@@ -13,7 +13,7 @@ __all__ = [
     "Linear", "Identity", "Embedding", "Dropout", "Dropout2D", "Dropout3D",
     "AlphaDropout", "Flatten", "Upsample", "UpsamplingBilinear2D",
     "UpsamplingNearest2D", "Pad1D", "Pad2D", "Pad3D", "ZeroPad2D",
-    "CosineSimilarity", "Bilinear", "Unfold", "Fold", "PixelShuffle",
+    "CosineSimilarity", "PairwiseDistance", "Bilinear", "Unfold", "Fold", "PixelShuffle",
     "PixelUnshuffle", "ChannelShuffle", "LinearLossScale",
 ]
 
@@ -197,6 +197,23 @@ class CosineSimilarity(Layer):
 
     def forward(self, x1, x2):
         return F.cosine_similarity(x1, x2, self.axis, self.eps)
+
+
+class PairwiseDistance(Layer):
+    """p-norm distance between row pairs (reference
+    nn/layer/distance.py PairwiseDistance)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        import paddle_tpu as paddle
+        diff = x - y + paddle.full([1], self.epsilon, dtype=x.dtype)
+        return paddle.linalg.norm(diff, p=self.p, axis=-1,
+                                  keepdim=self.keepdim)
 
 
 class Bilinear(Layer):

@@ -1,0 +1,178 @@
+"""Static-graph user API shim (reference python/paddle/static/).
+
+TPU-native position (SURVEY §7.1/§7.2 step 6): there is no separate static
+IR — the reference's Program/Executor/CompiledProgram pipeline collapses
+into jit capture (trace once, XLA compiles).  This namespace keeps the
+load-bearing entry points users actually call so reference training scripts
+port without rewrites:
+
+- ``InputSpec`` — shared with paddle.jit (the real contract surface);
+- ``save_inference_model``/``load_inference_model`` — map to the StableHLO
+  artifact set of jit.save/load (the serving slot, SURVEY §7.4);
+- ``Program``/``default_main_program``/``program_guard``/``Executor`` —
+  accepted no-op shims so mode-guarded code paths run: under this design
+  "static mode" IS eager tracing, so the guard objects only carry names.
+
+Anything with true static-IR semantics (append_backward over a ProgramDesc,
+py_func, BuildStrategy knobs) raises with guidance instead of silently
+diverging.
+"""
+from __future__ import annotations
+
+from ..jit import InputSpec  # noqa: F401
+
+__all__ = [
+    "InputSpec", "Program", "Executor", "CompiledProgram",
+    "default_main_program", "default_startup_program", "program_guard",
+    "name_scope", "scope_guard", "global_scope", "data",
+    "save_inference_model", "load_inference_model", "save", "load",
+    "append_backward", "py_func", "nn",
+]
+
+
+class Program:
+    """Name-carrying shim: under jit capture there is no program object to
+    mutate (reference base/framework.py Program)."""
+
+    def __init__(self):
+        self._name = "program"
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return Program()
+
+
+_main = Program()
+_startup = Program()
+
+
+def default_main_program():
+    return _main
+
+
+def default_startup_program():
+    return _startup
+
+
+class _Guard:
+    def __init__(self, *a, **k):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def program_guard(main_program, startup_program=None):
+    return _Guard()
+
+
+def name_scope(prefix=None):
+    return _Guard()
+
+
+def scope_guard(scope):
+    return _Guard()
+
+
+def global_scope():
+    return _Guard()
+
+
+class Executor:
+    """Runs captured callables (reference base/executor.py Executor — the
+    interpreter role is XLA's; `.run` executes a traced fn or returns fetches
+    computed eagerly)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kw):
+        if callable(program):
+            return program(**(feed or {}))
+        if fetch_list is None:
+            return []
+        return [f.numpy() if hasattr(f, "numpy") else f for f in fetch_list]
+
+    def close(self):
+        return None
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare an input slot -> InputSpec (reference static/input.py data)."""
+    return InputSpec(shape=shape, dtype=dtype, name=name)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **configs):
+    """Serving export = StableHLO artifact set (reference static/io.py:513;
+    here delegating to jit.save's .pdmodel/.pdiparams contract).
+
+    fetch_vars must be the traced layer/function (jit.to_static output or
+    nn.Layer); feed_vars the example inputs or InputSpecs.
+    """
+    from ..jit import save as jit_save
+    target = configs.pop("layer", None) or fetch_vars
+    if isinstance(target, (list, tuple)):
+        if len(target) != 1:
+            raise ValueError(
+                "save_inference_model on this build exports ONE traced "
+                "callable; pass the layer/function (got a fetch list)")
+        target = target[0]
+    jit_save(target, path_prefix, input_spec=feed_vars, **configs)
+    return path_prefix
+
+
+def load_inference_model(path_prefix, executor=None, **configs):
+    """Returns the loaded callable (reference returns (program, feeds,
+    fetches); the callable subsumes all three here)."""
+    from ..jit import load as jit_load
+    return jit_load(path_prefix, **configs)
+
+
+def save(program, model_path, protocol=4, **configs):
+    raise NotImplementedError(
+        "static.save persists a ProgramDesc, which this TPU-native build "
+        "does not have; use paddle.save(state_dict) or "
+        "static.save_inference_model (StableHLO)")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    raise NotImplementedError(
+        "static.load reads a ProgramDesc; use paddle.load / "
+        "static.load_inference_model")
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    raise NotImplementedError(
+        "append_backward edits a static program; autograd here is "
+        "loss.backward() (eager) or jax.grad under jit capture")
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    raise NotImplementedError(
+        "py_func embeds host callbacks in a static graph; use "
+        "paddle_tpu.autograd.PyLayer (eager) or jax.pure_callback")
+
+
+class _NN:
+    """static.nn.* legacy layer builders are not provided — use paddle.nn."""
+
+    def __getattr__(self, name):
+        raise NotImplementedError(
+            f"paddle.static.nn.{name} (legacy static layer builder) is not "
+            "provided; use paddle_tpu.nn layers — they trace under "
+            "jit.to_static")
+
+
+nn = _NN()

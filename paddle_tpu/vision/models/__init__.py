@@ -8,3 +8,4 @@ from .mobilenet import *  # noqa: F401,F403
 from .densenet import *  # noqa: F401,F403
 from .shufflenetv2 import *  # noqa: F401,F403
 from .googlenet import *  # noqa: F401,F403
+from .inceptionv3 import *  # noqa: F401,F403

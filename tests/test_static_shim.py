@@ -1,0 +1,52 @@
+"""paddle.static shim tests (reference python/paddle/static/ — load-bearing
+entry points mapped onto jit capture; true static-IR APIs raise with
+guidance)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_input_spec_and_data():
+    spec = paddle.static.data("x", [None, 8], "float32")
+    assert isinstance(spec, paddle.static.InputSpec)
+    assert spec.name == "x"
+
+
+def test_program_guard_and_executor_run_traced():
+    net = nn.Sequential(nn.Linear(4, 2))
+    x = paddle.to_tensor(np.random.rand(3, 4).astype("float32"))
+    with paddle.static.program_guard(paddle.static.default_main_program(),
+                                     paddle.static.default_startup_program()):
+        traced = paddle.jit.to_static(net)
+    exe = paddle.static.Executor()
+    out = exe.run(lambda: traced(x))
+    assert tuple(out.shape) == (3, 2)
+
+
+def test_save_load_inference_model_roundtrip(tmp_path):
+    net = nn.Sequential(nn.Linear(8, 4), nn.ReLU())
+    x = paddle.to_tensor(np.random.rand(2, 8).astype("float32"))
+    net(x)
+    prefix = str(tmp_path / "serving")
+    paddle.static.save_inference_model(prefix, [x], [net])
+    loaded = paddle.static.load_inference_model(prefix)
+    np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_static_ir_apis_raise_with_guidance():
+    with pytest.raises(NotImplementedError, match="backward"):
+        paddle.static.append_backward(None)
+    with pytest.raises(NotImplementedError, match="PyLayer"):
+        paddle.static.py_func(None, None, None)
+    with pytest.raises(NotImplementedError, match="nn layers"):
+        paddle.static.nn.fc
+    with pytest.raises(NotImplementedError, match="state_dict"):
+        paddle.static.save(None, "p")
+
+
+def test_callbacks_alias():
+    assert paddle.callbacks.EarlyStopping is not None
+    assert paddle.callbacks.ModelCheckpoint is not None

@@ -70,3 +70,21 @@ def test_model_trains():
         opt.clear_grad()
         losses.append(float(loss.numpy()))
     assert losses[-1] < losses[0]
+
+
+def test_inception_v3_forward():
+    """InceptionV3 needs >= 75px inputs (stem downsamples 3x)."""
+    paddle.seed(0)
+    m = models.inception_v3(num_classes=10)
+    m.eval()
+    x = paddle.randn([1, 3, 83, 83])
+    out = m(x)
+    assert out.shape == [1, 10]
+
+
+def test_pairwise_distance_layer():
+    import paddle_tpu.nn as nn
+    pd = nn.PairwiseDistance(p=2.0)
+    x = paddle.to_tensor(np.asarray([[3., 4.], [0., 0.]], np.float32))
+    y = paddle.to_tensor(np.zeros((2, 2), np.float32))
+    np.testing.assert_allclose(pd(x, y).numpy(), [5.0, 0.0], atol=1e-4)
