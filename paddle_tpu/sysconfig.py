@@ -1,0 +1,22 @@
+"""Build-configuration paths (reference python/paddle/sysconfig.py:
+get_include/get_lib for compiling extensions against the installed
+package).  Points at the native core's headers and the built libptcore.so
+(csrc/ — the ctypes runtime this build uses instead of pybind)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def get_include() -> str:
+    """Directory of the native core headers (csrc/include)."""
+    return os.path.join(_ROOT, "csrc", "include")
+
+
+def get_lib() -> str:
+    """Directory containing libptcore.so (built by csrc/Makefile)."""
+    from .core import _native
+    return str(_native._LIB_PATH.parent)

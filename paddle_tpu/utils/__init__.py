@@ -1,4 +1,7 @@
 """Utility namespace (reference python/paddle/utils/)."""
 from . import cpp_extension  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import unique_name  # noqa: F401
+from .install_check import run_check  # noqa: F401
 
-__all__ = ["cpp_extension"]
+__all__ = ["cpp_extension", "dlpack", "unique_name", "run_check"]

@@ -1,0 +1,51 @@
+"""DLPack interop (reference python/paddle/utils/dlpack.py to_dlpack /
+from_dlpack over paddle/fluid/framework/dlpack_tensor.cc).
+
+TPU-native: jax arrays already speak the DLPack protocol
+(``__dlpack__``/``__dlpack_device__``), so export hands out the capsule from
+the underlying jax.Array and import consumes any DLPack-exporting producer
+(numpy, torch, cupy, jax) zero-copy where the backing memory allows.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack capsule (reference dlpack.py:34)."""
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return arr.__dlpack__()
+
+
+class _CapsuleExporter:
+    """Adapter: legacy raw capsules -> the modern __dlpack__ protocol jax
+    consumes.  A bare capsule carries no device info, so this path is for
+    HOST memory (numpy/torch-cpu interop — the dominant capsule producers);
+    device arrays should be passed as objects, which keep their
+    __dlpack_device__."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, stream=None, **kw):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)  # kDLCPU
+
+
+def from_dlpack(dlpack) -> Tensor:
+    """DLPack capsule or exporter object -> Tensor (reference dlpack.py:86).
+
+    Accepts either a raw capsule (host memory) or any object implementing
+    ``__dlpack__`` (the modern protocol the reference also honors).
+    """
+    if not hasattr(dlpack, "__dlpack__"):      # legacy capsule
+        dlpack = _CapsuleExporter(dlpack)
+    arr = jax.dlpack.from_dlpack(dlpack)
+    return Tensor(arr)

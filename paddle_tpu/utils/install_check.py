@@ -1,0 +1,41 @@
+"""Install verification (reference python/paddle/utils/install_check.py
+``run_check``: build a tiny model, run forward/backward on the available
+device(s), print a verdict).
+"""
+from __future__ import annotations
+
+__all__ = ["run_check"]
+
+
+def run_check():
+    """Train one tiny step on the default backend; raises on failure,
+    prints the reference's style of success message otherwise."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    backend = jax.default_backend()
+    n_dev = len(jax.devices())
+    print(f"Running verify PaddlePaddle(TPU-native) program ... "
+          f"(backend={backend}, devices={n_dev})")
+
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    x = paddle.to_tensor(np.random.rand(4, 8).astype("float32"))
+    y = paddle.to_tensor(np.random.rand(4, 1).astype("float32"))
+    loss = nn.functional.mse_loss(net(x), y)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    val = float(loss.numpy())
+    if not np.isfinite(val):
+        raise RuntimeError(f"install check produced non-finite loss {val}")
+
+    print(f"PaddlePaddle(TPU-native) works well on 1 {backend} device.")
+    if n_dev > 1:
+        print(f"PaddlePaddle(TPU-native) sees {n_dev} {backend} devices; "
+              "distributed paths use jax.sharding over this mesh.")
+    print("PaddlePaddle(TPU-native) is installed successfully!")

@@ -1,0 +1,47 @@
+"""Unique name generator (reference python/paddle/utils/unique_name.py →
+base/unique_name.py: generate/switch/guard over a process-wide counter map).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+__all__ = ["generate", "switch", "guard"]
+
+
+class _Generator:
+    def __init__(self):
+        self.ids: dict = {}
+        self.lock = threading.Lock()
+
+    def __call__(self, key: str) -> str:
+        with self.lock:
+            n = self.ids.get(key, 0)
+            self.ids[key] = n + 1
+        return f"{key}_{n}"
+
+
+_generator = _Generator()
+
+
+def generate(key: str) -> str:
+    return _generator(key)
+
+
+def switch(new_generator=None):
+    """Swap the process generator; returns the old one
+    (reference unique_name.py switch)."""
+    global _generator
+    old = _generator
+    _generator = new_generator if new_generator is not None else _Generator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """Scoped fresh namespace (reference unique_name.py guard)."""
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -1,0 +1,43 @@
+"""utils coverage: dlpack interop, unique_name, run_check, sysconfig
+(reference python/paddle/utils/{dlpack,unique_name,install_check}.py,
+sysconfig.py)."""
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import dlpack, unique_name
+
+
+def test_dlpack_roundtrip():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    cap = dlpack.to_dlpack(x)
+    y = dlpack.from_dlpack(cap)
+    np.testing.assert_allclose(y.numpy(), x.numpy())
+
+    # modern protocol: any __dlpack__ exporter (numpy) imports directly
+    z = dlpack.from_dlpack(np.asarray([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(z.numpy(), [1.0, 2.0])
+
+
+def test_unique_name_generate_and_guard():
+    a = unique_name.generate("fc")
+    b = unique_name.generate("fc")
+    assert a != b and a.startswith("fc_") and b.startswith("fc_")
+    with unique_name.guard():
+        assert unique_name.generate("fc") == "fc_0"   # fresh namespace
+    c = unique_name.generate("fc")
+    assert c not in (a, b, "fc_0") or c != "fc_0"
+
+
+def test_run_check(capsys):
+    paddle.utils.run_check()
+    out = capsys.readouterr().out
+    assert "installed successfully" in out
+
+
+def test_sysconfig_paths():
+    inc = paddle.sysconfig.get_include()
+    assert os.path.isdir(inc)
+    lib = paddle.sysconfig.get_lib()
+    assert os.path.isdir(lib)
