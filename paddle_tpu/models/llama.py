@@ -172,3 +172,202 @@ class LlamaForCausalLM(nn.Layer):
             loss = F.cross_entropy(logits, labels)
             return logits, loss
         return logits
+
+    # ------------------------------------------------------------------
+    # Autoregressive decoding (reference: paddle generation stack +
+    # incubate masked_multihead_attention / block_multihead_attention
+    # inference kernels, SURVEY §2.6 incubate row).  TPU-native: prefill +
+    # a lax.scan decode loop over a STATIC-length KV cache, compiled to one
+    # XLA program — no per-token dispatch, no dynamic shapes.
+    # ------------------------------------------------------------------
+
+    def _decode_params(self):
+        import jax.numpy as jnp
+        cfg = self.config
+        layers = []
+        for lyr in self.model.layers:
+            layers.append({
+                "ln1": lyr.input_layernorm.weight._data,
+                "wq": lyr.self_attn.q_proj.weight._data,
+                "wk": lyr.self_attn.k_proj.weight._data,
+                "wv": lyr.self_attn.v_proj.weight._data,
+                "wo": lyr.self_attn.o_proj.weight._data,
+                "ln2": lyr.post_attention_layernorm.weight._data,
+                "gate": lyr.mlp.gate_proj.weight._data,
+                "up": lyr.mlp.up_proj.weight._data,
+                "down": lyr.mlp.down_proj.weight._data,
+            })
+        import jax
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+        embed = self.model.embed_tokens.weight._data
+        head = embed.T if self.lm_head is None else self.lm_head.weight._data
+        return {"layers": stacked, "embed": embed,
+                "norm_f": self.model.norm.weight._data, "head": head}
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
+                 top_p=None, eos_token_id=None, seed=0):
+        """Greedy/top-p sampling with a compiled KV-cache decode loop.
+
+        input_ids: [B, S0] int tensor/array.  Returns [B, S0+max_new_tokens]
+        (generation frozen at eos when eos_token_id is given).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        cfg = self.config
+        ids = input_ids._data if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        ids = ids.astype(jnp.int32)
+        B, S0 = ids.shape
+        T = S0 + int(max_new_tokens)
+        params = self._decode_params()
+
+        key_cache = (B, S0, int(max_new_tokens), float(temperature),
+                     None if top_p is None else float(top_p),
+                     eos_token_id)
+        fn = getattr(self, "_gen_cache", {}).get(key_cache)
+        if fn is None:
+            fn = self._build_generate(B, S0, int(max_new_tokens),
+                                      float(temperature),
+                                      None if top_p is None else float(top_p),
+                                      eos_token_id)
+            if not hasattr(self, "_gen_cache"):
+                self._gen_cache = {}
+            self._gen_cache[key_cache] = fn
+        out = fn(params, ids, jax.random.PRNGKey(seed))
+        return Tensor(out)
+
+    def _build_generate(self, B, S0, max_new, temperature, top_p, eos_id):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        cfg = self.config
+        H = cfg.hidden_size
+        nh = cfg.num_attention_heads
+        kvh = cfg.num_key_value_heads
+        d = H // nh
+        L = cfg.num_hidden_layers
+        T = S0 + max_new
+        eps = cfg.rms_norm_eps
+        theta = cfg.rope_theta
+
+        def rms(x, w):
+            xf = x.astype(jnp.float32)
+            o = xf * lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + eps)
+            return (o * w.astype(jnp.float32)).astype(x.dtype)
+
+        def rope(x, pos):
+            # x [B, s, h, d]; pos [s] absolute positions
+            inv = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+            freqs = jnp.outer(pos.astype(jnp.float32), inv)
+            cos = jnp.cos(freqs)[None, :, None, :]
+            sin = jnp.sin(freqs)[None, :, None, :]
+            xf = x.astype(jnp.float32)
+            x1, x2 = xf[..., 0::2], xf[..., 1::2]
+            out = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+            return out.reshape(x.shape).astype(x.dtype)
+
+        def qkv(x, p, pos):
+            b, s = x.shape[:2]
+            h = rms(x, p["ln1"])
+            q = (h @ p["wq"]).reshape(b, s, nh, d)
+            k = (h @ p["wk"]).reshape(b, s, kvh, d)
+            v = (h @ p["wv"]).reshape(b, s, kvh, d)
+            return rope(q, pos), rope(k, pos), v
+
+        def attend(q, kc, vc, mask):
+            # q [B, s, nh, d]; kc/vc [B, T, kvh, d]; mask [s, T] bool
+            if kvh != nh:
+                kc = jnp.repeat(kc, nh // kvh, axis=2)
+                vc = jnp.repeat(vc, nh // kvh, axis=2)
+            sc = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                            kc.astype(jnp.float32)) / (d ** 0.5)
+            sc = jnp.where(mask[None, None], sc, -jnp.inf)
+            pr = jax.nn.softmax(sc, axis=-1)
+            return jnp.einsum("bhqk,bkhd->bqhd", pr,
+                              vc.astype(jnp.float32)).astype(q.dtype)
+
+        def block(x, p, kc, vc, pos, mask):
+            b, s = x.shape[:2]
+            q, k, v = qkv(x, p, pos)
+            kc = lax.dynamic_update_slice_in_dim(kc, k.astype(kc.dtype),
+                                                 pos[0], axis=1)
+            vc = lax.dynamic_update_slice_in_dim(vc, v.astype(vc.dtype),
+                                                 pos[0], axis=1)
+            att = attend(q, kc, vc, mask).reshape(b, s, nh * d)
+            x = x + att @ p["wo"]
+            h2 = rms(x, p["ln2"])
+            a = jax.nn.silu((h2 @ p["gate"]).astype(jnp.float32)
+                            ).astype(h2.dtype) * (h2 @ p["up"])
+            return x + a @ p["down"], kc, vc
+
+        def fwd(params, toks, caches_k, caches_v, pos, mask):
+            x = jnp.take(params["embed"], toks, axis=0)
+
+            def body(carry, inp):
+                x = carry
+                p, kc, vc = inp
+                x, kc, vc = block(x, p, kc, vc, pos, mask)
+                return x, (kc, vc)
+
+            x, (ck, cv) = lax.scan(body, x,
+                                   (params["layers"], caches_k, caches_v))
+            h = rms(x, params["norm_f"])
+            logits = (h[:, -1].astype(jnp.float32)
+                      @ params["head"].astype(jnp.float32))
+            return logits, ck, cv
+
+        def sample(logits, key):
+            if temperature == 0.0:
+                return jnp.argmax(logits, -1).astype(jnp.int32)
+            lg = logits / max(temperature, 1e-6)
+            if top_p is not None:
+                idx = jnp.argsort(-lg, axis=-1)
+                sp = jax.nn.softmax(jnp.take_along_axis(lg, idx, -1), -1)
+                cum = jnp.cumsum(sp, -1)
+                keep = cum - sp <= top_p          # always keep the top token
+                lg_sorted = jnp.where(keep, jnp.take_along_axis(lg, idx, -1),
+                                      -jnp.inf)
+                pick = jax.random.categorical(key, lg_sorted, axis=-1)
+                return jnp.take_along_axis(idx, pick[:, None],
+                                           -1)[:, 0].astype(jnp.int32)
+            return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+
+        def run(params, ids, key):
+            dt = params["embed"].dtype
+            ck = jnp.zeros((L, B, T, kvh, d), dt)
+            cv = jnp.zeros((L, B, T, kvh, d), dt)
+            # prefill over the prompt
+            pos0 = jnp.arange(S0)
+            mask0 = (jnp.arange(T)[None, :] <= pos0[:, None])
+            logits, ck, cv = fwd(params, ids, ck, cv, pos0, mask0)
+            key, sub = jax.random.split(key)
+            tok = sample(logits, sub)
+            done = jnp.zeros((B,), bool) if eos_id is None else tok == eos_id
+
+            def step(carry, t):
+                ck, cv, tok, key, done = carry
+                pos = S0 + t
+                if eos_id is not None:
+                    tok = jnp.where(done, jnp.int32(eos_id), tok)
+                emit = tok
+                mask = (jnp.arange(T) <= pos)[None, :]
+                logits, ck, cv = fwd(params, tok[:, None], ck, cv,
+                                     jnp.asarray([pos]), mask)
+                key, sub = jax.random.split(key)
+                nxt = sample(logits, sub)
+                if eos_id is not None:
+                    done = done | (nxt == eos_id)
+                return (ck, cv, nxt, key, done), emit
+
+            (_, _, last, _, done), toks = lax.scan(
+                step, (ck, cv, tok, key, done), jnp.arange(max_new - 1))
+            if eos_id is not None:   # freeze the final token too
+                last = jnp.where(done, jnp.int32(eos_id), last)
+            gen = jnp.concatenate([toks.T, last[:, None]], axis=1)
+            return jnp.concatenate([ids, gen], axis=1)
+
+        return jax.jit(run)

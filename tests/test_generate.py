@@ -1,0 +1,65 @@
+"""LLaMA autoregressive generation tests (reference generation stack +
+masked_multihead_attention decode kernels — here a compiled KV-cache
+lax.scan loop).
+
+The load-bearing check: KV-cache decode must produce EXACTLY the tokens
+that full-recompute argmax decoding produces.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(7)
+    cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4, ffn=64,
+                           seq=64)
+    cfg.num_key_value_heads = 2          # exercise GQA in the cache path
+    return LlamaForCausalLM(cfg)
+
+
+def _full_recompute_greedy(model, ids, n):
+    """Oracle: re-run the full forward per token, argmax."""
+    out = ids.copy()
+    for _ in range(n):
+        logits = model(paddle.to_tensor(out)).numpy()
+        nxt = logits[:, -1].argmax(-1).astype(np.int64)
+        out = np.concatenate([out, nxt[:, None]], axis=1)
+    return out
+
+
+def test_greedy_matches_full_recompute(model):
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 97, (2, 5)).astype(np.int64)
+    want = _full_recompute_greedy(model, ids, 6)
+    got = model.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                         temperature=0.0).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_generate_shapes_and_determinism(model):
+    ids = paddle.to_tensor(np.asarray([[1, 2, 3]], np.int64))
+    a = model.generate(ids, max_new_tokens=4, temperature=0.8, top_p=0.9,
+                       seed=5).numpy()
+    b = model.generate(ids, max_new_tokens=4, temperature=0.8, top_p=0.9,
+                       seed=5).numpy()
+    c = model.generate(ids, max_new_tokens=4, temperature=0.8, top_p=0.9,
+                       seed=6).numpy()
+    assert a.shape == (1, 7)
+    np.testing.assert_array_equal(a, b)       # same seed -> same tokens
+    assert (a[:, :3] == [[1, 2, 3]]).all()    # prompt preserved
+    assert not np.array_equal(a, c) or True   # different seed may differ
+
+
+def test_generate_eos_freezes(model):
+    ids = paddle.to_tensor(np.asarray([[4, 5]], np.int64))
+    greedy = model.generate(ids, max_new_tokens=8, temperature=0.0).numpy()
+    # pick the first generated token as a fake eos: everything after must
+    # be eos
+    eos = int(greedy[0, 2])
+    out = model.generate(ids, max_new_tokens=8, temperature=0.0,
+                         eos_token_id=eos).numpy()
+    assert (out[0, 2:] == eos).all()
