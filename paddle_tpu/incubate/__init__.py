@@ -1,2 +1,2 @@
 """Incubating APIs (reference: python/paddle/incubate/)."""
-from . import autotune, distributed, nn  # noqa: F401
+from . import asp, autotune, distributed, nn  # noqa: F401

@@ -1,0 +1,110 @@
+"""ASP: automatic structured (n:m) sparsity.
+
+Reference: python/paddle/incubate/asp/{asp.py,utils.py} — mask generation
+(get_mask_1d / get_mask_2d_best), prune_model, decorate(optimizer) wrapping
+step so masks persist through updates, set_excluded_layers.
+
+TPU-native notes: Ampere sparse-tensor-core speedups do not exist on TPU —
+the VALUE of ASP here is model compression research + parity, so masks are
+plain multiplicative jnp masks (XLA folds them into the matmul); the mask
+math itself is numpy (host-side, one-off), matching the reference's numpy
+utils.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["calculate_density", "check_mask_1d", "get_mask_1d",
+           "create_mask", "check_sparsity", "prune_model", "decorate",
+           "set_excluded_layers", "reset_excluded_layers"]
+
+_excluded: set = set()
+_masks: dict = {}            # id(param) -> (param_ref, jnp mask)
+
+
+def calculate_density(x) -> float:
+    """Fraction of nonzeros (reference asp.py calculate_density)."""
+    arr = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def check_mask_1d(mat, n=2, m=4) -> bool:
+    """Every m-length row chunk keeps at most n nonzeros
+    (reference utils.py:142)."""
+    arr = np.asarray(mat.numpy() if hasattr(mat, "numpy") else mat)
+    flat = arr.reshape(-1, arr.shape[-1])
+    cols = flat.shape[1] - flat.shape[1] % m
+    chunks = flat[:, :cols].reshape(flat.shape[0], -1, m)
+    return bool((np.count_nonzero(chunks, axis=-1) <= n).all())
+
+
+def get_mask_1d(mat, n=2, m=4):
+    """Best n:m mask along the last dim: keep the n largest |values| of
+    every m-chunk (reference utils.py:192 get_mask_1d)."""
+    arr = np.asarray(mat.numpy() if hasattr(mat, "numpy") else mat)
+    shape = arr.shape
+    flat = arr.reshape(-1, shape[-1])
+    mask = np.ones_like(flat, dtype=bool)
+    cols = flat.shape[1] - flat.shape[1] % m
+    if cols:
+        chunks = np.abs(flat[:, :cols]).reshape(flat.shape[0], -1, m)
+        # indices of the (m - n) SMALLEST magnitudes get zeroed
+        order = np.argsort(chunks, axis=-1)
+        drop = order[..., :m - n]
+        cmask = np.ones_like(chunks, dtype=bool)
+        np.put_along_axis(cmask, drop, False, axis=-1)
+        mask[:, :cols] = cmask.reshape(flat.shape[0], cols)
+    return mask.reshape(shape)
+
+
+create_mask = get_mask_1d
+check_sparsity = check_mask_1d
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """Skip these parameters during pruning (reference asp.py:55)."""
+    _excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def _prunable(name, p):
+    if name in _excluded:
+        return False
+    return len(p.shape) == 2 and p.shape[-1] % 4 == 0
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply n:m masks to every prunable weight in place; masks are
+    remembered so decorate()-wrapped optimizers re-apply them after each
+    step (reference asp.py:319 prune_model + ASPHelper mask variables)."""
+    import jax.numpy as jnp
+
+    pruned = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p):
+            continue
+        mask = jnp.asarray(get_mask_1d(p, n, m), p._data.dtype)
+        p._data = p._data * mask
+        if with_mask:
+            _masks[id(p)] = (p, mask)
+        pruned[name] = calculate_density(p)
+    return pruned
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step so pruned weights stay pruned through updates
+    (reference asp.py:233 OptimizerWithSparsityGuarantee)."""
+    inner_step = optimizer.step
+
+    def step_with_masks(*args, **kwargs):
+        out = inner_step(*args, **kwargs)
+        for p, mask in list(_masks.values()):
+            p._data = p._data * mask
+        return out
+
+    optimizer.step = step_with_masks
+    optimizer._asp_decorated = True
+    return optimizer

@@ -1,0 +1,57 @@
+"""ASP n:m sparsity tests (reference incubate/asp/ mask utils + the
+prune->train->masks-persist workflow)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate import asp
+
+
+def test_mask_1d_best_magnitude():
+    mat = np.asarray([[4., 1., 3., 2.], [0.1, 0.2, 0.4, 0.3]], np.float32)
+    mask = asp.get_mask_1d(mat, 2, 4)
+    np.testing.assert_array_equal(
+        mask, [[True, False, True, False], [False, False, True, True]])
+    assert asp.check_mask_1d(mat * mask, 2, 4)
+    assert not asp.check_mask_1d(np.ones((2, 4)), 2, 4)
+    assert abs(asp.calculate_density(mat * mask) - 0.5) < 1e-6
+
+
+def test_prune_model_and_decorate_persistence():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    asp.reset_excluded_layers()
+    pruned = asp.prune_model(net)
+    assert pruned                                # something was pruned
+    for name, p in net.named_parameters():
+        if len(p.shape) == 2:
+            assert asp.check_sparsity(p, 2, 4), name
+            assert abs(asp.calculate_density(p) - 0.5) < 0.01
+
+    opt = asp.decorate(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=net.parameters()))
+    x = paddle.to_tensor(np.random.rand(4, 8).astype("float32"))
+    y = paddle.to_tensor(np.random.rand(4, 4).astype("float32"))
+    for _ in range(3):
+        loss = nn.functional.mse_loss(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # masks survive optimizer updates
+    for name, p in net.named_parameters():
+        if len(p.shape) == 2:
+            assert asp.check_sparsity(p, 2, 4), name
+
+
+def test_excluded_layers():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 8))
+    name0 = next(iter(dict(net.named_parameters())))
+    asp.set_excluded_layers([name0])
+    try:
+        pruned = asp.prune_model(net)
+        assert name0 not in pruned
+        assert abs(asp.calculate_density(
+            dict(net.named_parameters())[name0]) - 1.0) < 1e-6
+    finally:
+        asp.reset_excluded_layers()
