@@ -371,3 +371,87 @@ class LlamaForCausalLM(nn.Layer):
             return jnp.concatenate([ids, gen], axis=1)
 
         return jax.jit(run)
+
+
+# ---------------------------------------------------------------------------
+# Hugging Face weight bridge (the "switch to this framework" on-ramp: load
+# any HF-format LLaMA checkpoint into LlamaForCausalLM.  Reference analog:
+# PaddleNLP's HF conversion utilities; kept in-tree here because checkpoint
+# portability is part of the capability surface).
+# ---------------------------------------------------------------------------
+
+def _unrotate_perm(d):
+    """Output-dim permutation mapping HF's rotate-half RoPE layout (pairs
+    (i, i + d/2)) onto this model's interleaved layout (pairs (2i, 2i+1))."""
+    import numpy as np
+    perm = np.empty(d, np.int64)
+    perm[0::2] = np.arange(d // 2)
+    perm[1::2] = np.arange(d // 2) + d // 2
+    return perm
+
+
+def convert_hf_state_dict(hf_state, config: LlamaConfig):
+    """HF transformers LLaMA state_dict -> this model's state_dict.
+
+    Handles: torch [out, in] -> [in, out] Linear transpose; the
+    rotate-half -> interleaved RoPE permutation on q/k projection outputs;
+    lm_head transpose.  Values come out as numpy float32.
+    """
+    import numpy as np
+
+    d = config.hidden_size // config.num_attention_heads
+    perm = _unrotate_perm(d)
+
+    def to_np(v):
+        if hasattr(v, "detach"):
+            v = v.detach().cpu().float().numpy()
+        return np.asarray(v, np.float32)
+
+    def permute_rows(w, n_heads):
+        # w: [n_heads * d, in] in HF layout; permute each head's rows
+        out = w.reshape(n_heads, d, -1)[:, perm, :]
+        return out.reshape(n_heads * d, -1)
+
+    out = {}
+    for k, v in hf_state.items():
+        v = to_np(v)
+        if k.endswith("rotary_emb.inv_freq"):
+            continue
+        if k.endswith("self_attn.q_proj.weight"):
+            v = permute_rows(v, config.num_attention_heads).T
+        elif k.endswith("self_attn.k_proj.weight"):
+            v = permute_rows(v, config.num_key_value_heads).T
+        elif k.endswith((
+                "self_attn.v_proj.weight", "self_attn.o_proj.weight",
+                "mlp.gate_proj.weight", "mlp.up_proj.weight",
+                "mlp.down_proj.weight", "lm_head.weight")):
+            v = v.T
+        out[k] = v
+    return out
+
+
+def from_hf(hf_model_or_state, config: LlamaConfig | None = None):
+    """Build LlamaForCausalLM from an HF transformers model/state_dict."""
+    if hasattr(hf_model_or_state, "state_dict"):
+        hf_cfg = getattr(hf_model_or_state, "config", None)
+        hf_state = hf_model_or_state.state_dict()
+        if config is None and hf_cfg is not None:
+            config = LlamaConfig(
+                vocab_size=hf_cfg.vocab_size,
+                hidden_size=hf_cfg.hidden_size,
+                intermediate_size=hf_cfg.intermediate_size,
+                num_hidden_layers=hf_cfg.num_hidden_layers,
+                num_attention_heads=hf_cfg.num_attention_heads,
+                num_key_value_heads=hf_cfg.num_key_value_heads,
+                max_position_embeddings=hf_cfg.max_position_embeddings,
+                rms_norm_eps=hf_cfg.rms_norm_eps,
+                rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
+                tie_word_embeddings=hf_cfg.tie_word_embeddings)
+    else:
+        hf_state = hf_model_or_state
+    if config is None:
+        raise ValueError("pass config= when converting a bare state_dict")
+    model = LlamaForCausalLM(config)
+    converted = convert_hf_state_dict(hf_state, config)
+    model.set_state_dict(converted)
+    return model
