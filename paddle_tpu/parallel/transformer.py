@@ -88,6 +88,9 @@ class HybridParallelConfig:
                                       # all_to_all — the reference's
                                       # global_scatter/global_gather EP,
                                       # moe_layer.py)
+    xent_chunk: int = 0               # >0: sequence-chunk the vocab-parallel
+                                      # cross entropy (bounds live f32
+                                      # logits to [m, chunk, V/tp]); 0 = off
     zero_stage: int = 0               # 0: replicate opt state over dp;
                                       # >=1: ZeRO — shard Adam m/v over dp,
                                       # reduce-scatter grads, allgather the
@@ -370,6 +373,41 @@ def _vocab_parallel_embed(tokens, embed, cfg, hp):
     return lax.psum_scatter(out, "tp", scatter_dimension=1, tiled=True)
 
 
+def _vocab_parallel_xent_chunked(h, head, labels, cfg, pos_weight,
+                                 chunk, reduction="sumcount"):
+    """Sequence-chunked wrapper over `_vocab_parallel_xent`: bounds the live
+    f32 logits to [m, chunk, V/tp] instead of [m, S, V/tp] (at the bench's
+    350M config the full-seq f32 logits are the single largest temp —
+    2 GB at b8xs2048xV32k).  jax.checkpoint per chunk keeps backward at the
+    same bound by recomputing each chunk's logits from its h slice.
+    """
+    S = h.shape[1]
+    if chunk <= 0 or S % chunk or S == chunk:
+        return _vocab_parallel_xent(h, head, labels, cfg,
+                                    pos_weight=pos_weight,
+                                    reduction=reduction)
+    n = S // chunk
+
+    @jax.checkpoint
+    def one(hc, lc, wc_):
+        return _vocab_parallel_xent(hc, head, lc, cfg, pos_weight=wc_,
+                                    reduction="sumcount")
+
+    def body(carry, xs):
+        ws_acc, wc_acc = carry
+        hc, lc, pw = xs
+        ws, wc = one(hc, lc, pw)
+        return (ws_acc + ws, wc_acc + wc), None
+
+    hs = h.reshape(h.shape[0], n, chunk, h.shape[2]).swapaxes(0, 1)
+    ls = labels.reshape(labels.shape[0], n, chunk).swapaxes(0, 1)
+    pw = pos_weight.reshape(n, chunk)
+    (ws, wc), _ = lax.scan(body, (jnp.zeros((), jnp.float32),
+                                  jnp.zeros((), jnp.float32)),
+                           (hs, ls, pw))
+    return ws, wc
+
+
 def _vocab_parallel_xent(h, head, labels, cfg, pos_weight=None,
                          reduction="mean"):
     """h [m, S, H] full-seq; head LOCAL [H, V/tp]; labels [m, S].
@@ -448,8 +486,8 @@ def _stage_apply(params, tok_mb, act_in, cfg, hp):
     tok_ext = jnp.concatenate([tok_mb, tok_mb[:, :1]], axis=1)
     labels = lax.dynamic_slice_in_dim(tok_ext, cp_start + 1, S_cp, axis=1)
     pos_w = ((cp_start + jnp.arange(S_cp)) < S - 1).astype(jnp.float32)
-    ws, wc = _vocab_parallel_xent(h_full, params["head"], labels, cfg,
-                                  pos_weight=pos_w, reduction="sumcount")
+    ws, wc = _vocab_parallel_xent_chunked(h_full, params["head"], labels,
+                                          cfg, pos_w, hp.xent_chunk)
     if hp.cp > 1:
         ws = lax.psum(ws, "cp")
         wc = lax.psum(wc, "cp")
@@ -508,8 +546,8 @@ def _vpp_stage_apply(params, tok_mb, act_in, cfg, hp, chunk, first, last):
     tok_ext = jnp.concatenate([tok_mb, tok_mb[:, :1]], axis=1)
     labels = lax.dynamic_slice_in_dim(tok_ext, cp_start + 1, S_cp, axis=1)
     pos_w = ((cp_start + jnp.arange(S_cp)) < S - 1).astype(jnp.float32)
-    ws, wc = _vocab_parallel_xent(h_full, params["head"], labels, cfg,
-                                  pos_weight=pos_w, reduction="sumcount")
+    ws, wc = _vocab_parallel_xent_chunked(h_full, params["head"], labels,
+                                          cfg, pos_w, hp.xent_chunk)
     if hp.cp > 1:
         ws = lax.psum(ws, "cp")
         wc = lax.psum(wc, "cp")

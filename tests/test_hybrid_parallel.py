@@ -289,3 +289,28 @@ def test_vpp_validations():
     mesh = build_mesh(hp)
     with pytest.raises(ValueError, match="num_microbatches"):
         build_train_step(cfg, hp, mesh)
+
+
+def test_xent_chunking_matches_unchunked():
+    """hp.xent_chunk bounds live logits without changing the loss/grads."""
+    import jax.numpy as jnp
+
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4, ffn=64,
+                           seq=32)
+
+    def run(chunk):
+        hp = HybridParallelConfig(dp=2, tp=2, pp=1, num_microbatches=1,
+                                  xent_chunk=chunk)
+        mesh = build_mesh(hp)
+        params = shard_params(init_params(cfg, hp, seed=3), hp, mesh)
+        opt = shard_opt_state(init_opt_state(params), hp, mesh)
+        step = build_train_step(cfg, hp, mesh)
+        tok = jnp.asarray(
+            np.random.RandomState(5).randint(0, 64, (4, 32)), jnp.int32)
+        params, opt, loss = step(params, opt, tok)
+        p2, o2, loss2 = step(params, opt, tok)
+        return float(loss), float(loss2)
+
+    base = run(0)
+    chunked = run(8)
+    np.testing.assert_allclose(chunked, base, rtol=2e-5, atol=2e-5)
