@@ -50,6 +50,11 @@ def _parse_args(argv=None):
     p.add_argument("--ips", default=None, help="legacy node ip list")
     p.add_argument("--elastic_level", type=int, default=-1)
     p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--auto_tuner_json", default=None,
+                   help="hybrid-parallel auto-tuner config (reference "
+                        "launch --auto_tuner_json): search+score candidate "
+                        "configs before launching; best config is exported "
+                        "to workers as PADDLE_AUTO_TUNER_BEST")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -118,8 +123,51 @@ def _wait(procs):
         time.sleep(0.2)
 
 
+def _run_auto_tuner(args) -> dict | None:
+    """Search+score hybrid configs before launching (reference
+    launch/main.py auto-tuner mode, which runs a trial JOB per candidate;
+    here candidates are scored by AOT compile probes — tuner.py
+    measure_cfg — so tuning happens in-process in seconds)."""
+    import json
+
+    # honor the caller's platform pin BEFORE any backend init: environment
+    # sitecustomize may re-pin JAX_PLATFORMS to a hardware plugin whose
+    # init can hang when the device service is unreachable (the
+    # tests/conftest.py pattern — env var alone is not enough)
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            import jax
+            jax.config.update("jax_platforms", plat.split(",")[0])
+        except Exception:
+            pass
+
+    from ..auto_tuner import AutoTuner
+
+    with open(args.auto_tuner_json) as f:
+        tuner_cfg = json.load(f)
+    max_trials = int(tuner_cfg.pop("max_trials", 8))
+    tuner = AutoTuner(tuner_cfg)
+    os.makedirs(args.log_dir, exist_ok=True)
+    hist = os.path.join(args.log_dir, "auto_tuner_history.csv")
+    best, err = tuner.tune(max_trials=max_trials, history_path=hist)
+    if err or best is None:
+        print(f"[launch] auto-tuner: no feasible config found "
+              f"(history: {hist})", file=sys.stderr)
+        return None
+    best = {k: v for k, v in best.items() if not k.startswith("_")}
+    print(f"[launch] auto-tuner best config: {best} (history: {hist})",
+          file=sys.stderr)
+    return best
+
+
 def launch(argv=None) -> int:
     args = _parse_args(argv)
+    if args.auto_tuner_json:
+        import json
+        best = _run_auto_tuner(args)
+        if best is not None:
+            os.environ["PADDLE_AUTO_TUNER_BEST"] = json.dumps(best)
     nprocs = args.nproc_per_node
     if nprocs is None:
         devs = args.devices

@@ -126,3 +126,51 @@ def test_tune_loop_end_to_end(tmp_path):
     assert len(tuner.history_cfgs) == 2
     if not err:            # at least one trial compiled
         assert best["status"] == "ok"
+
+
+def test_launch_auto_tuner_mode(tmp_path):
+    """launch --auto_tuner_json scores configs via compile probes and
+    exports the winner to workers as PADDLE_AUTO_TUNER_BEST."""
+    import json
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # pp=1 candidate: the pipeline-scan compile is minutes-cold on the
+    # 1-core CI host; dp*tp covers the mesh and exercises the same plumbing
+    cfg = dict(TUNER_CFG, max_trials=1, task_limit=1,
+               candidates={"dp": [4], "tp": [2], "pp": [1], "cp": [1],
+                           "vpp": [1], "zero_stage": [1],
+                           "micro_batch_size": [1],
+                           "num_microbatches": [1], "recompute": [True]})
+    cfg_path = tmp_path / "tuner.json"
+    cfg_path.write_text(json.dumps(cfg))
+
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent("""
+        import json, os
+        best = json.loads(os.environ["PADDLE_AUTO_TUNER_BEST"])
+        assert best["dp"] * best["tp"] * best.get("pp", 1) == 8
+        assert best["status"] == "ok"
+        print("tuner_best_seen")
+    """))
+    env = dict(os.environ,
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               # the launcher process compiles the probe; share the suite's
+               # persistent compile cache so warm runs don't pay it
+               JAX_COMPILATION_CACHE_DIR=os.path.join(REPO, ".jax_cache"))
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--log_dir", str(tmp_path / "log"),
+         "--auto_tuner_json", str(cfg_path), str(script)],
+        env=env, capture_output=True, text=True, timeout=500,
+        cwd=str(tmp_path))
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
+    assert "auto-tuner best config" in r.stderr
+    assert (tmp_path / "log" / "auto_tuner_history.csv").exists()
+    assert "tuner_best_seen" in \
+        (tmp_path / "log" / "workerlog.0").read_text()
