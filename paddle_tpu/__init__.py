@@ -86,6 +86,7 @@ from . import signal  # noqa: E402
 from . import sparse  # noqa: E402
 from . import static  # noqa: E402
 from . import sysconfig  # noqa: E402
+from . import version  # noqa: E402
 from . import strings  # noqa: E402
 from . import text  # noqa: E402
 from . import utils  # noqa: E402

@@ -7,10 +7,12 @@ from __future__ import annotations
 from ..core.dispatch import enable_grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
 from ..core.tape import backward as _tape_backward
 from ..core.tape import grad  # noqa: F401
+from .functional import hessian, jacobian, jvp, vjp  # noqa: F401
 from .py_layer import PyLayer, PyLayerContext  # noqa: F401
 
 __all__ = ["backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
-           "is_grad_enabled", "PyLayer", "PyLayerContext"]
+           "is_grad_enabled", "PyLayer", "PyLayerContext",
+           "vjp", "jvp", "jacobian", "hessian"]
 
 
 def backward(tensors, grad_tensors=None, retain_graph=False):

@@ -1,0 +1,136 @@
+"""Functional autodiff API (reference python/paddle/autograd/ +
+python/paddle/incubate/autograd/functional.py: vjp, jvp, jacobian, hessian
+built from double-grad machinery).
+
+TPU-native: these are direct surfacing of jax's functional transforms —
+the framework traces the user function ONCE with the eager tape disabled
+(the tape is for define-by-run .backward(); functional transforms get their
+derivatives from jax's program transformations, which is both exact and
+compiled).  Inputs/outputs stay paddle Tensors at the API boundary.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch as _dispatch
+from ..core.tensor import Tensor
+
+__all__ = ["vjp", "jvp", "jacobian", "hessian"]
+
+
+def _to_arrays(xs):
+    if isinstance(xs, (list, tuple)):
+        return tuple(x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                     for x in xs), True
+    return (xs._data if isinstance(xs, Tensor) else jnp.asarray(xs),), False
+
+
+def _wrap_fn(func, multi_in):
+    """paddle-Tensor function -> pure array function (tape disabled)."""
+    def f(*arrs):
+        with _dispatch.no_grad():
+            ins = [Tensor(a) for a in arrs]
+            out = func(*ins) if multi_in else func(ins[0])
+            if isinstance(out, (list, tuple)):
+                return tuple(o._data if isinstance(o, Tensor) else o
+                             for o in out)
+            return out._data if isinstance(out, Tensor) else out
+    return f
+
+
+def _wrap_out(out):
+    if isinstance(out, (list, tuple)):
+        return tuple(Tensor(o) for o in out)
+    return Tensor(out)
+
+
+def vjp(func, xs, v=None):
+    """(func(xs), vector-Jacobian product) — reference
+    incubate/autograd/functional.py vjp."""
+    arrs, multi = _to_arrays(xs)
+    f = _wrap_fn(func, multi)
+    out, pullback = jax.vjp(f, *arrs)
+    if v is None:
+        seed = jax.tree.map(jnp.ones_like, out)
+    else:
+        vv, _ = _to_arrays(v)
+        seed = vv if isinstance(out, tuple) else vv[0]
+    grads = pullback(seed)
+    grads = grads if multi else grads[0]
+    return _wrap_out(out), _wrap_out(grads)
+
+
+def jvp(func, xs, v=None):
+    """(func(xs), Jacobian-vector product) — reference functional.py jvp."""
+    arrs, multi = _to_arrays(xs)
+    f = _wrap_fn(func, multi)
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrs)
+    else:
+        tangents, _ = _to_arrays(v)
+    out, tangent_out = jax.jvp(f, arrs, tangents)
+    return _wrap_out(out), _wrap_out(tangent_out)
+
+
+class _LazyMatrix:
+    """Lazy view over a computed derivative tensor (the reference's
+    Jacobian/Hessian objects index lazily; here the transform already
+    produced the full tensor and this object only carries the view
+    semantics)."""
+
+    def __init__(self, data):
+        self._t = Tensor(data)
+
+    def __getitem__(self, idx):
+        return self._t[idx]
+
+    @property
+    def shape(self):
+        return self._t.shape
+
+    def numpy(self):
+        return self._t.numpy()
+
+    def tensor(self):
+        return self._t
+
+
+def jacobian(func, xs, batch_axis=None):
+    """Jacobian of func at xs (reference autograd/autograd.py jacobian).
+
+    Single input/single output: returns a lazy matrix of shape
+    [*out_shape, *in_shape] (batch_axis=0 keeps the leading batch dim
+    uncontracted, reference semantics).
+    """
+    arrs, multi = _to_arrays(xs)
+    f = _wrap_fn(func, multi)
+    jac = jax.jacrev(f, argnums=tuple(range(len(arrs))))(*arrs)
+    if not multi:
+        jac = jac[0] if isinstance(jac, tuple) else jac
+        if isinstance(jac, tuple):
+            jac = jac[0]
+        return _LazyMatrix(jac)
+    return tuple(_LazyMatrix(j) for j in jac)
+
+
+def hessian(func, xs, batch_axis=None):
+    """Hessian of a scalar-valued func at xs (reference autograd/autograd.py
+    hessian)."""
+    arrs, multi = _to_arrays(xs)
+    f = _wrap_fn(func, multi)
+
+    def scalar(*a):
+        out = f(*a)
+        out = out[0] if isinstance(out, tuple) else out
+        if out.ndim != 0:
+            raise ValueError(
+                f"hessian needs a scalar-valued func; got output shape "
+                f"{tuple(out.shape)}")
+        return out
+
+    hes = jax.hessian(scalar, argnums=tuple(range(len(arrs))))(*arrs)
+    if not multi:
+        h = hes[0][0] if isinstance(hes, tuple) else hes
+        return _LazyMatrix(h)
+    return tuple(tuple(_LazyMatrix(h) for h in row) for row in hes)

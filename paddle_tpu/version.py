@@ -1,0 +1,68 @@
+"""Version metadata (reference python/paddle/version/__init__.py —
+full_version/major/minor/patch/rc, commit, cuda()/cudnn()/nccl() probes,
+show())."""
+from __future__ import annotations
+
+import subprocess
+
+full_version = "0.1.0"
+major, minor, patch = (int(x) for x in full_version.split("."))
+rc = 0
+istaged = False
+
+__all__ = ["full_version", "commit", "show", "cuda", "cudnn", "nccl",
+           "xpu", "tpu"]
+
+
+def _git_commit() -> str:
+    import os
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+            timeout=5,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        if out.returncode == 0:
+            return out.stdout.strip()
+    except Exception:
+        pass
+    return "unknown"
+
+
+commit = _git_commit()
+
+
+def cuda():
+    """False: this build targets TPU via XLA (reference returns the CUDA
+    version string on GPU builds)."""
+    return False
+
+
+def cudnn():
+    return False
+
+
+def nccl():
+    """Collectives ride XLA over ICI/DCN, not NCCL."""
+    return False
+
+
+def xpu():
+    return False
+
+
+def tpu() -> str:
+    """TPU runtime identification: the jax/PJRT versions doing CINN+CUDA's
+    job in this build."""
+    import jax
+    return f"jax {jax.__version__}"
+
+
+def show() -> None:
+    """(reference version/__init__.py show())"""
+    print(f"full_version: {full_version}")
+    print(f"major: {major}")
+    print(f"minor: {minor}")
+    print(f"patch: {patch}")
+    print(f"rc: {rc}")
+    print(f"commit: {commit}")
+    print(f"tpu: {tpu()}")

@@ -1,0 +1,77 @@
+"""Functional autodiff API tests (reference incubate/autograd/functional.py
+vjp/jvp + autograd jacobian/hessian)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.autograd import hessian, jacobian, jvp, vjp
+
+
+def test_vjp_and_jvp():
+    def f(x):
+        return (x * x).sum()
+
+    x = paddle.to_tensor(np.asarray([1., 2., 3.], np.float32))
+    out, g = vjp(f, x)
+    np.testing.assert_allclose(out.numpy(), 14.0)
+    np.testing.assert_allclose(g.numpy(), [2., 4., 6.])
+
+    v = paddle.to_tensor(np.asarray([1., 0., 1.], np.float32))
+    out, t = jvp(f, x, v)
+    np.testing.assert_allclose(t.numpy(), 2 * 1 + 2 * 3)  # grad . v
+
+
+def test_jacobian_matches_analytic():
+    def f(x):
+        return x * x
+
+    x = paddle.to_tensor(np.asarray([1., 2., 3.], np.float32))
+    J = jacobian(f, x)
+    assert tuple(J.shape) == (3, 3)
+    np.testing.assert_allclose(J.numpy(), np.diag([2., 4., 6.]))
+    np.testing.assert_allclose(J[1, 1].numpy(), 4.0)      # lazy indexing
+
+
+def test_hessian_quadratic():
+    A = np.asarray([[2., 1.], [1., 3.]], np.float32)
+
+    def f(x):
+        Ax = paddle.matmul(paddle.to_tensor(A), x)
+        return (x * Ax).sum() * 0.5
+
+    x = paddle.to_tensor(np.asarray([1., -1.], np.float32))
+    H = hessian(f, x)
+    np.testing.assert_allclose(H.numpy(), (A + A.T) / 2, atol=1e-5)
+
+
+def test_jacobian_through_layer():
+    paddle.seed(0)
+    lin = nn.Linear(3, 2, bias_attr=False)
+
+    def f(x):
+        return lin(x)
+
+    x = paddle.to_tensor(np.asarray([0.5, -1., 2.], np.float32))
+    J = jacobian(f, x)
+    np.testing.assert_allclose(J.numpy(), lin.weight.numpy().T,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_multi_input_vjp():
+    def f(a, b):
+        return (a * b).sum()
+
+    a = paddle.to_tensor(np.asarray([1., 2.], np.float32))
+    b = paddle.to_tensor(np.asarray([3., 4.], np.float32))
+    out, (ga, gb) = vjp(f, [a, b])
+    np.testing.assert_allclose(out.numpy(), 11.0)
+    np.testing.assert_allclose(ga.numpy(), [3., 4.])
+    np.testing.assert_allclose(gb.numpy(), [1., 2.])
+
+
+def test_version_module(capsys):
+    assert paddle.version.full_version == paddle.__version__
+    assert paddle.version.cuda() is False
+    assert "jax" in paddle.version.tpu()
+    paddle.version.show()
+    assert "full_version" in capsys.readouterr().out
