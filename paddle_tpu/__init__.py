@@ -82,11 +82,45 @@ from . import quantization  # noqa: E402
 from . import optimizer  # noqa: E402
 from . import hub  # noqa: E402
 from . import onnx  # noqa: E402
+from . import regularizer  # noqa: E402
 from . import signal  # noqa: E402
 from . import sparse  # noqa: E402
 from . import static  # noqa: E402
 from . import sysconfig  # noqa: E402
 from . import version  # noqa: E402
+from .nn.initializer.attr import ParamAttr  # noqa: E402
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Mini-batch reader decorator (reference python/paddle/batch.py)."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+def iinfo(dtype):
+    """Integer dtype info (reference paddle.iinfo over ml_dtypes)."""
+    import numpy as _np
+    from .core.dtype import to_jax_dtype
+    return _np.iinfo(_np.dtype(to_jax_dtype(dtype)))
+
+
+def finfo(dtype):
+    """Float dtype info incl bfloat16 (reference paddle.finfo)."""
+    import ml_dtypes as _mld
+    import numpy as _np
+    from .core.dtype import to_jax_dtype
+    dt = _np.dtype(to_jax_dtype(dtype))
+    if dt == _np.dtype(_mld.bfloat16):
+        return _mld.finfo(_mld.bfloat16)
+    return _np.finfo(dt)
 from . import strings  # noqa: E402
 from . import text  # noqa: E402
 from . import utils  # noqa: E402

@@ -137,7 +137,15 @@ class Optimizer:
         slot_names = tuple(self._slot_names())
 
         params = [p._data for p, _ in params_grads]
-        grads = [g._data for _, g in params_grads]
+        # L1 regularization: grad += coeff * sign(p) (reference
+        # L1DecayRegularizer appends the same term pre-update)
+        grads = []
+        for p, g in params_grads:
+            l1 = self._l1_coeff_for(p)
+            gd = g._data
+            if l1:
+                gd = gd + jnp.asarray(l1, gd.dtype) * jnp.sign(p._data)
+            grads.append(gd)
         states = []
         for p, _ in params_grads:
             st = self._state_for(p)
@@ -164,12 +172,22 @@ class Optimizer:
                 st[n] = v
 
     def _weight_decay_for(self, p):
-        if getattr(p, "regularizer", None) is not None:
-            return float(p.regularizer._coeff)
-        reg = getattr(self, "regularization", None)
+        reg = getattr(p, "regularizer", None)
+        if reg is None:
+            reg = getattr(self, "regularization", None)
         if reg is not None:
-            return float(reg._coeff)
+            # L1 contributes sign(p) to the grad (see _apply_l1); only L2
+            # rides the fused decay slot
+            return 0.0 if getattr(reg, "_l1", False) else float(reg._coeff)
         return self._coupled_wd
+
+    def _l1_coeff_for(self, p):
+        reg = getattr(p, "regularizer", None)
+        if reg is None:
+            reg = getattr(self, "regularization", None)
+        if reg is not None and getattr(reg, "_l1", False):
+            return float(reg._coeff)
+        return 0.0
 
     def clear_grad(self, set_to_zero=True):
         for p in (self._parameter_list or []):

@@ -1,0 +1,65 @@
+"""regularizer / ParamAttr / batch / iinfo / finfo root APIs (reference
+python/paddle/regularizer.py, batch.py, paddle.iinfo/finfo)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_l2_decay_shrinks_weights():
+    paddle.seed(0)
+    net = nn.Linear(4, 4, bias_attr=False)
+    opt = paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=net.parameters(),
+        weight_decay=paddle.regularizer.L2Decay(0.5))
+    w0 = np.abs(net.weight.numpy()).sum()
+    x = paddle.to_tensor(np.zeros((2, 4), np.float32))
+    net(x).sum().backward()          # zero input -> zero grads
+    opt.step()
+    # pure decay: |w| strictly shrinks
+    assert np.abs(net.weight.numpy()).sum() < w0
+
+
+def test_l1_decay_signs_gradient():
+    paddle.seed(0)
+    net = nn.Linear(2, 2, bias_attr=False)
+    w0 = net.weight.numpy().copy()
+    opt = paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=net.parameters(),
+        weight_decay=paddle.regularizer.L1Decay(0.3))
+    x = paddle.to_tensor(np.zeros((1, 2), np.float32))
+    net(x).sum().backward()
+    opt.step()
+    # w <- w - lr * coeff * sign(w)
+    np.testing.assert_allclose(net.weight.numpy(),
+                               w0 - 0.1 * 0.3 * np.sign(w0), atol=1e-6)
+
+
+def test_param_attr_regularizer_overrides_global():
+    attr = paddle.ParamAttr(regularizer=paddle.regularizer.L2Decay(0.0))
+    lin = nn.Linear(2, 2, weight_attr=attr, bias_attr=False)
+    w0 = lin.weight.numpy().copy()
+    opt = paddle.optimizer.SGD(
+        learning_rate=0.5, parameters=lin.parameters(),
+        weight_decay=paddle.regularizer.L2Decay(0.9))
+    x = paddle.to_tensor(np.zeros((1, 2), np.float32))
+    lin(x).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(lin.weight.numpy(), w0, atol=1e-7)
+
+
+def test_batch_decorator():
+    def reader():
+        yield from range(7)
+
+    batches = list(paddle.batch(reader, 3)())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+    assert list(paddle.batch(reader, 3, drop_last=True)()) == \
+        [[0, 1, 2], [3, 4, 5]]
+
+
+def test_iinfo_finfo():
+    assert paddle.iinfo("int32").max == 2 ** 31 - 1
+    assert paddle.finfo("float32").eps > 0
+    bf = paddle.finfo("bfloat16")
+    assert bf.bits == 16 and float(bf.max) > 1e38
