@@ -1,6 +1,7 @@
 """nn namespace.  Parity with /root/reference/python/paddle/nn/__init__.py."""
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import utils  # noqa: F401
 from .clip import (  # noqa: F401
     ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue, clip_grad_norm_,
     clip_grad_value_,

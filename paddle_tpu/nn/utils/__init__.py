@@ -1,0 +1,174 @@
+"""nn.utils (reference python/paddle/nn/utils/: weight_norm_hook.py,
+spectral_norm_hook.py, clip_grad_norm_.py, clip_grad_value_.py,
+transform_parameters.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Parameter, Tensor
+
+__all__ = ["clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters", "weight_norm", "remove_weight_norm",
+           "spectral_norm"]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """In-place global grad-norm clip; returns the pre-clip total norm
+    (reference clip_grad_norm_.py)."""
+    params = [parameters] if isinstance(parameters, Tensor) else \
+        [p for p in parameters]
+    grads = [p.grad for p in params if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros((), jnp.float32))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(g._data.astype(jnp.float32))) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g._data.astype(jnp.float32)) ** norm_type)
+             for g in grads])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            f"grad norm is non-finite ({float(total)}); set "
+            "error_if_nonfinite=False to clip anyway")
+    scale = jnp.minimum(1.0, max_norm / (total + 1e-6))
+    for p in params:
+        if p.grad is not None:
+            p.grad._data = (p.grad._data.astype(jnp.float32)
+                            * scale).astype(p.grad._data.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    """In-place elementwise grad clamp (reference clip_grad_value_.py)."""
+    params = [parameters] if isinstance(parameters, Tensor) else \
+        list(parameters)
+    cv = abs(float(clip_value))
+    for p in params:
+        if p.grad is not None:
+            p.grad._data = jnp.clip(p.grad._data, -cv, cv)
+
+
+def parameters_to_vector(parameters, name=None) -> Tensor:
+    """Flatten+concat parameters (reference transform_parameters.py)."""
+    return Tensor(jnp.concatenate(
+        [p._data.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Scatter a flat vector back into the parameter list in place."""
+    arr = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p.shape)) if len(p.shape) else 1
+        p._data = arr[off:off + n].reshape(p._data.shape).astype(
+            p._data.dtype)
+        off += n
+    if off != arr.shape[0]:
+        raise ValueError(
+            f"vector has {arr.shape[0]} elements but parameters hold {off}")
+
+
+# ---------------------------------------------------------------------------
+# Weight norm: w = g * v / ||v||  (reference weight_norm_hook.py — swaps the
+# weight for (weight_g, weight_v) and recomputes w in a forward pre-hook).
+# ---------------------------------------------------------------------------
+
+def _norm_except(v, dim):
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(v.astype(jnp.float32) ** 2, axis=axes,
+                            keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    if getattr(layer, f"_{name}_norm_hook", None) is not None:
+        raise RuntimeError(f"weight_norm already applied to {name!r}")
+    w = getattr(layer, name)
+    if dim is None:
+        dim = -1  # treat whole tensor as one group
+    v0 = w._data
+    g0 = _norm_except(v0, dim) if dim >= 0 else \
+        jnp.sqrt(jnp.sum(v0.astype(jnp.float32) ** 2)).reshape(
+            (1,) * v0.ndim)
+    weight_v = Parameter(v0)
+    weight_g = Parameter(g0.astype(v0.dtype))
+    del layer._parameters[name]
+    layer.add_parameter(name + "_v", weight_v)
+    layer.add_parameter(name + "_g", weight_g)
+
+    def recompute(lyr, inputs):
+        v = getattr(lyr, name + "_v")
+        g = getattr(lyr, name + "_g")
+        if dim >= 0:
+            norm = (v.astype("float32") ** 2).sum(
+                axis=[i for i in range(len(v.shape)) if i != dim],
+                keepdim=True).sqrt()
+        else:
+            norm = (v.astype("float32") ** 2).sum().sqrt()
+        w = g.astype("float32") * v.astype("float32") / (norm + 1e-12)
+        setattr(lyr, name, w.astype(str(v.dtype).split(".")[-1]))
+        return None
+
+    handle = layer.register_forward_pre_hook(recompute)
+    setattr(layer, f"_{name}_norm_hook", handle)
+    recompute(layer, None)          # materialize w for direct access
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    handle = getattr(layer, f"_{name}_norm_hook", None)
+    if handle is None:
+        raise ValueError(f"no weight_norm on {name!r}")
+    handle.remove()
+    setattr(layer, f"_{name}_norm_hook", None)
+    w = getattr(layer, name)
+    data = w._data if isinstance(w, Tensor) else jnp.asarray(w)
+    del layer._parameters[name + "_v"]
+    del layer._parameters[name + "_g"]
+    layer.add_parameter(name, Parameter(data))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Divide the weight by its largest singular value, estimated by power
+    iteration refreshed each forward (reference spectral_norm_hook.py)."""
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    mat0 = np.moveaxis(np.asarray(w.numpy(), np.float32), dim, 0)
+    mat0 = mat0.reshape(mat0.shape[0], -1)
+    rng = np.random.RandomState(0)
+    state = {
+        "u": jnp.asarray(rng.randn(mat0.shape[0]), jnp.float32),
+        "v": jnp.asarray(rng.randn(mat0.shape[1]), jnp.float32),
+    }
+
+    def normalize(x):
+        return x / (jnp.linalg.norm(x) + eps)
+
+    def hook(lyr, inputs):
+        wt = getattr(lyr, name + "_orig")
+        mat = jnp.moveaxis(wt._data.astype(jnp.float32), dim, 0)
+        mat = mat.reshape(mat.shape[0], -1)
+        u, v = state["u"], state["v"]
+        for _ in range(n_power_iterations):
+            v = normalize(mat.T @ u)
+            u = normalize(mat @ v)
+        state["u"], state["v"] = u, v
+        sigma = u @ mat @ v
+        setattr(lyr, name,
+                Tensor((wt._data.astype(jnp.float32) / sigma).astype(
+                    wt._data.dtype)))
+        return None
+
+    orig = Parameter(w._data)
+    del layer._parameters[name]
+    layer.add_parameter(name + "_orig", orig)
+    handle = layer.register_forward_pre_hook(hook)
+    setattr(layer, f"_{name}_spectral_hook", handle)
+    hook(layer, None)
+    return layer

@@ -1,0 +1,81 @@
+"""nn.utils tests (reference python/paddle/nn/utils/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.nn.utils import (
+    clip_grad_norm_, clip_grad_value_, parameters_to_vector,
+    remove_weight_norm, spectral_norm, vector_to_parameters, weight_norm,
+)
+
+
+def _net():
+    paddle.seed(0)
+    return nn.Linear(3, 2)
+
+
+def test_clip_grad_norm():
+    net = _net()
+    x = paddle.to_tensor(np.ones((2, 3), np.float32) * 10)
+    (net(x) ** 2).sum().backward()
+    total = clip_grad_norm_(net.parameters(), max_norm=1.0)
+    assert float(total.numpy()) > 1.0          # pre-clip norm returned
+    post = np.sqrt(sum(np.sum(p.grad.numpy().astype(np.float64) ** 2)
+                       for p in net.parameters()))
+    np.testing.assert_allclose(post, 1.0, rtol=1e-4)
+
+
+def test_clip_grad_value():
+    net = _net()
+    x = paddle.to_tensor(np.ones((2, 3), np.float32) * 10)
+    (net(x) ** 2).sum().backward()
+    clip_grad_value_(net.parameters(), 0.05)
+    for p in net.parameters():
+        assert np.abs(p.grad.numpy()).max() <= 0.05 + 1e-7
+
+
+def test_parameters_vector_roundtrip():
+    net = _net()
+    vec = parameters_to_vector(net.parameters())
+    n = sum(int(np.prod(p.shape)) for p in net.parameters())
+    assert tuple(vec.shape) == (n,)
+    before = [p.numpy().copy() for p in net.parameters()]
+    vector_to_parameters(vec * 2.0, net.parameters())
+    for b, p in zip(before, net.parameters()):
+        np.testing.assert_allclose(p.numpy(), b * 2.0, rtol=1e-6)
+    with pytest.raises(ValueError):
+        vector_to_parameters(paddle.zeros([n + 1]), net.parameters())
+
+
+def test_weight_norm_preserves_function_and_reparameterizes():
+    net = _net()
+    x = paddle.to_tensor(np.random.rand(2, 3).astype(np.float32))
+    y0 = net(x).numpy()
+    weight_norm(net, "weight", dim=0)
+    names = [n for n, _ in net.named_parameters()]
+    assert "weight_v" in names and "weight_g" in names
+    assert "weight" not in names
+    np.testing.assert_allclose(net(x).numpy(), y0, rtol=1e-5, atol=1e-6)
+
+    # grads flow into v and g
+    net(x).sum().backward()
+    assert net.weight_v.grad is not None
+    assert net.weight_g.grad is not None
+
+    remove_weight_norm(net, "weight")
+    names = [n for n, _ in net.named_parameters()]
+    assert "weight" in names and "weight_v" not in names
+    np.testing.assert_allclose(net(x).numpy(), y0, rtol=1e-5, atol=1e-6)
+
+
+def test_spectral_norm_caps_singular_value():
+    net = _net()
+    # scale weight up so sigma >> 1
+    net.weight._data = net.weight._data * 50.0
+    spectral_norm(net, "weight", n_power_iterations=5)
+    x = paddle.to_tensor(np.eye(3, dtype=np.float32))
+    net(x)                                      # refresh via hook
+    w = net.weight.numpy()
+    sigma = np.linalg.svd(w, compute_uv=False).max()
+    np.testing.assert_allclose(sigma, 1.0, rtol=1e-2)
