@@ -27,7 +27,8 @@ from .core import dispatch as _dispatch
 from .core import tape as _tape
 from .core.dtype import (  # noqa: F401
     bfloat16, bool_, complex128, complex64, dtype, float16, float32, float64,
-    int16, int32, int64, int8, uint8,
+    float8_e4m3fn, float8_e5m2, int16, int32, int64, int8, pstring, raw,
+    uint8,
 )
 from .core.enforce import EnforceError  # noqa: F401
 from .core.place import (  # noqa: F401
@@ -89,6 +90,90 @@ from . import static  # noqa: E402
 from . import sysconfig  # noqa: E402
 from . import version  # noqa: E402
 from .nn.initializer.attr import ParamAttr  # noqa: E402
+
+
+_default_dtype = "float32"
+
+
+def set_default_dtype(d):
+    """Default float dtype for parameter/tensor creation (reference
+    framework set_default_dtype)."""
+    global _default_dtype
+    from .core.dtype import convert_dtype
+    _default_dtype = convert_dtype(d)
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor repr options (reference paddle.set_printoptions; reprs here
+    render through numpy, so this drives numpy's printoptions)."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not bool(sci_mode)
+    _np.set_printoptions(**kw)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Standalone Parameter factory (reference base/layers creation;
+    used by custom layers outside Layer.create_parameter)."""
+    import jax.numpy as _jnp
+
+    from .core.dtype import to_jax_dtype
+    from .nn.initializer import Constant, XavierNormal
+    init = default_initializer or (Constant(0.0) if is_bias
+                                   else XavierNormal())
+    p = Parameter(_jnp.zeros(tuple(int(s) for s in shape),
+                             to_jax_dtype(dtype)))
+    init(p)
+    if attr is not None and getattr(attr, "regularizer", None) is not None:
+        p.regularizer = attr.regularizer
+    return p
+
+
+class LazyGuard:
+    """Deferred-init guard (reference paddle.LazyGuard).  Parameter init is
+    a cheap jnp allocation under XLA, so laziness buys nothing — the guard
+    is accepted and is a no-op."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def get_cuda_rng_state():
+    """Accelerator RNG state (maps to the framework RNG; reference
+    get_cuda_rng_state)."""
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    return set_rng_state(state)
+
+
+def to_dlpack(x):
+    from .utils.dlpack import to_dlpack as _impl
+    return _impl(x)
+
+
+def from_dlpack(capsule):
+    from .utils.dlpack import from_dlpack as _impl
+    return _impl(capsule)
 
 
 def batch(reader, batch_size, drop_last=False):

@@ -14,6 +14,7 @@ import numpy as np
 __all__ = [
     "dtype", "bool_", "uint8", "int8", "int16", "int32", "int64",
     "float16", "bfloat16", "float32", "float64", "complex64", "complex128",
+    "float8_e4m3fn", "float8_e5m2", "pstring", "raw",
     "convert_dtype", "to_jax_dtype", "is_floating_point_dtype", "is_integer_dtype",
 ]
 
@@ -71,6 +72,13 @@ float32 = dtype("float32", np.float32)
 float64 = dtype("float64", np.float64)
 complex64 = dtype("complex64", np.complex64)
 complex128 = dtype("complex128", np.complex128)
+# fp8 training dtypes (reference exposes both; ml_dtypes provides them)
+import ml_dtypes as _mld
+float8_e4m3fn = dtype("float8_e4m3fn", _mld.float8_e4m3fn)
+float8_e5m2 = dtype("float8_e5m2", _mld.float8_e5m2)
+# legacy dtype markers (reference pstring / raw VarTypes)
+pstring = dtype("pstring", np.object_)
+raw = dtype("raw", np.void)
 
 _ALIASES = {
     "bool": bool_,

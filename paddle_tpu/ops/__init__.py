@@ -27,6 +27,18 @@ def _collect():
 
 PUBLIC_OPS = _collect()
 
+# Root-surface completion: `op_` inplace twins (buffer rebinding under
+# XLA), extra small ops, then name aliases — all data-driven so the
+# surfaces cannot drift (ops/inplace_aliases.py).
+from . import inplace_aliases as _ia  # noqa: E402
+
+PUBLIC_OPS.update(_ia.EXTRA_OPS)
+PUBLIC_OPS.update(_ia.derive_inplace(PUBLIC_OPS))
+for _alias, _target in _ia.ALIASES.items():
+    if _target in PUBLIC_OPS:
+        PUBLIC_OPS.setdefault(_alias, PUBLIC_OPS[_target])
+PUBLIC_OPS.update({k: v for k, v in _ia.CONSTANTS.items()})
+
 
 def monkey_patch_tensor():
     from ..core.tensor import Tensor

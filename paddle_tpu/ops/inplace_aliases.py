@@ -1,0 +1,218 @@
+"""Root-surface completion: inplace `op_` variants, aliases, constants.
+
+The reference exports an inplace twin for most elementwise ops
+(python/paddle/tensor/*.py `*_` wrappers over inplace kernels) plus a set
+of aliases and module constants.  Under XLA there is no in-place kernel —
+buffers are immutable — so `x_` computes out-of-place and rebinds the
+Tensor's buffer (exactly what the reference's inplace ops guarantee
+observably: x aliases the result).  The derivation is data-driven from the
+base ops so the two surfaces cannot drift.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["derive_inplace", "ALIASES", "CONSTANTS", "extra_ops"]
+
+# every `name_` the reference exports whose base op we implement
+_INPLACE_BASES = [
+    "abs", "acos", "addmm", "asin", "atan", "bernoulli", "bitwise_and",
+    "bitwise_not", "bitwise_or", "bitwise_xor", "cast", "ceil", "clip",
+    "copysign", "cos", "cosh", "cumprod", "cumsum", "digamma", "divide",
+    "equal", "erf", "exp", "expm1", "floor", "floor_divide", "frac",
+    "gammainc", "gammaincc", "gcd", "greater_equal", "greater_than",
+    "hypot", "i0", "index_add",
+    "index_fill", "index_put", "lcm", "ldexp", "less_equal", "less_than",
+    "lgamma", "log", "log10", "log1p", "log2", "logical_and",
+    "logical_not", "logical_or", "logical_xor", "logit", "masked_fill",
+    "masked_scatter", "mod", "multiply", "nan_to_num", "neg", "pow",
+    "reciprocal", "remainder", "renorm", "round", "rsqrt", "scale",
+    "sigmoid", "sin", "sinc", "sinh", "sqrt", "square", "subtract",
+    "tan", "tanh", "transpose", "tril", "triu", "trunc", "where",
+    "bitwise_left_shift", "bitwise_right_shift", "polygamma",
+    "multigammaln", "gammaln", "log_normal", "slice_scatter",
+]
+
+
+def _make_inplace(name, base):
+    def fn_(x, *args, **kwargs):
+        out = base(x, *args, **kwargs)
+        x._data = out._data if isinstance(out, Tensor) else out
+        return x
+    fn_.__name__ = name + "_"
+    fn_.__doc__ = (f"In-place variant of `{name}` (reference {name}_): "
+                   "computes out-of-place under XLA and rebinds x's buffer.")
+    return fn_
+
+
+def derive_inplace(public_ops: dict) -> dict:
+    out = {}
+    for name in _INPLACE_BASES:
+        base = public_ops.get(name)
+        if base is not None and name + "_" not in public_ops:
+            out[name + "_"] = _make_inplace(name, base)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# aliases: reference name -> existing op name
+# ---------------------------------------------------------------------------
+
+ALIASES = {
+    "negative": "neg",
+    "less": "less_than",
+    "less_": "less_than_",
+    "floor_mod": "mod",
+    "floor_mod_": "mod_",
+    "remainder": "mod",
+    "row_stack": "vstack",
+    "column_stack": "hstack",
+    "bitwise_invert": "bitwise_not",
+    "bitwise_invert_": "bitwise_not_",
+    "positive": "abs" if False else None,   # resolved in extra_ops
+}
+ALIASES = {k: v for k, v in ALIASES.items() if v}
+
+CONSTANTS = {
+    "inf": float("inf"),
+    "nan": float("nan"),
+    "pi": float(np.pi),
+    "e": float(np.e),
+    "newaxis": None,
+}
+
+
+# ---------------------------------------------------------------------------
+# remaining small ops the reference exports at root
+# ---------------------------------------------------------------------------
+
+def extra_ops():
+    import jax.numpy as jnp
+
+    from ..core import dispatch as D
+
+    def _t(x):
+        return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+    def positive(x, name=None):
+        """Identity on numeric tensors (reference tensor/math.py positive)."""
+        return D.apply("positive", lambda a: +a, (x,))
+
+    def t(input, name=None):
+        """Transpose <=2-D (reference tensor/linalg.py t)."""
+        a = _t(input)
+        if a.ndim > 2:
+            raise ValueError(f"paddle.t expects ndim<=2, got {a.ndim}")
+        return D.apply("t", lambda a: a.T, (input,))
+
+    def t_(input, name=None):
+        out = t(input)
+        input._data = out._data
+        return input
+
+    def matrix_transpose(x, name=None):
+        """Swap the last two dims (reference linalg matrix_transpose)."""
+        return D.apply("matrix_transpose",
+                       lambda a: jnp.swapaxes(a, -1, -2), (x,))
+
+    def rank(input, name=None):
+        """0-D int tensor holding ndim (reference tensor/attribute rank)."""
+        return Tensor(jnp.asarray(_t(input).ndim, jnp.int32))
+
+    def block_diag(inputs, name=None):
+        """Block-diagonal assembly (reference tensor/creation block_diag)."""
+        import jax.scipy.linalg as jsl
+        arrs = [jnp.atleast_2d(_t(x)) for x in inputs]
+        return Tensor(jsl.block_diag(*arrs))
+
+    def cartesian_prod(x, name=None):
+        """Cartesian product of 1-D tensors (reference cartesian_prod)."""
+        arrs = [_t(v) for v in x]
+        grids = jnp.meshgrid(*arrs, indexing="ij")
+        return Tensor(jnp.stack([g.reshape(-1) for g in grids], axis=-1))
+
+    def isin(x, test_x, assume_unique=False, invert=False, name=None):
+        def impl(a, b, invert):
+            out = jnp.isin(a, b)
+            return out != invert if invert else out
+        return D.apply("isin", impl, (x, test_x), {"invert": bool(invert)})
+
+    def vecdot(x, y, axis=-1, name=None):
+        def impl(a, b, axis):
+            return jnp.sum(a * b, axis=axis)
+        return D.apply("vecdot", impl, (x, y), {"axis": int(axis)})
+
+    def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+        a = np.asarray(_t(input))
+        lo, hi = (float(min), float(max)) if (min != 0 or max != 0) \
+            else (float(a.min()), float(a.max()))
+        return Tensor(jnp.asarray(
+            np.histogram_bin_edges(a, bins=bins, range=(lo, hi))
+            .astype(np.float32)))
+
+    def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                    name=None):
+        a = np.asarray(_t(x))
+        w = None if weights is None else np.asarray(_t(weights))
+        hist, edges = np.histogramdd(a, bins=bins, range=ranges,
+                                     density=density, weights=w)
+        return (Tensor(jnp.asarray(hist.astype(np.float32))),
+                [Tensor(jnp.asarray(e.astype(np.float32))) for e in edges])
+
+    def frexp(x, name=None):
+        def impl(a):
+            m, e = jnp.frexp(a)
+            return m, e.astype(jnp.int32)
+        return D.apply("frexp", impl, (x,), num_outputs=2)
+
+    def unfold(x, axis, size, step, name=None):
+        """Sliding windows along axis (reference Tensor.unfold)."""
+        def impl(a, axis, size, step):
+            n = (a.shape[axis] - size) // step + 1
+            idx = jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :]
+            out = jnp.take(a, idx.reshape(-1), axis=axis)
+            shp = list(a.shape)
+            shp[axis:axis + 1] = [n, size]
+            out = out.reshape(shp)
+            # reference puts the window dim LAST
+            return jnp.moveaxis(out, axis + 1, -1)
+
+        return D.apply("unfold_windows", impl, (x,),
+                       {"axis": int(axis), "size": int(size),
+                        "step": int(step)})
+
+    def check_shape(x, expected_shape):
+        """Shape assertion helper (reference check_shape)."""
+        got = tuple(_t(x).shape)
+        want = tuple(expected_shape)
+        ok = len(got) == len(want) and all(
+            w in (-1, None) or g == w for g, w in zip(got, want))
+        if not ok:
+            raise ValueError(f"shape mismatch: got {got}, expected {want}")
+        return True
+
+    def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+        def impl(inp, a, b, beta, alpha):
+            return beta * inp + alpha * (a @ b)
+        return D.apply("addmm", impl, (input, x, y),
+                       {"beta": float(beta), "alpha": float(alpha)})
+
+    return {k: v for k, v in locals().items()
+            if callable(v) and not k.startswith("_")}
+
+
+# materialize the extra ops as module attributes (the schema conformance
+# test resolves `module:name` to live callables)
+EXTRA_OPS = extra_ops()
+globals().update(EXTRA_OPS)
+
+
+def derived_names(public_ops: dict) -> set:
+    """Names derived programmatically from schema'd bases (inplace twins,
+    aliases, constants) — transitively covered by the schema."""
+    names = set(CONSTANTS)
+    names.update(a for a in ALIASES)
+    names.update(n + "_" for n in _INPLACE_BASES)
+    return names

@@ -21,7 +21,8 @@ __all__ = [
     "fill_diagonal", "fill_diagonal_tensor", "diag_embed", "clip_by_norm",
     "mean_all", "frobenius_norm", "squared_l2_norm", "sequence_mask",
     "gather_tree", "top_p_sampling", "temporal_shift", "edit_distance",
-    "viterbi_decode", "as_strided",
+    "viterbi_decode", "as_strided", "slice_scatter", "gammainc",
+    "gammaincc", "multigammaln",
 ]
 
 
@@ -381,3 +382,45 @@ def as_strided(x, shape, stride, offset=0, name=None):
                    {"shape": tuple(int(s) for s in shape),
                     "stride": tuple(int(s) for s in stride),
                     "offset": int(offset)})
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    """Write `value` into strided slices of x (reference
+    tensor/manipulation.py slice_scatter)."""
+    def impl(a, v, axes, starts, ends, strides):
+        idx = [slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = slice(s, e, st)
+        return a.at[tuple(idx)].set(v.astype(a.dtype))
+
+    return D.apply("slice_scatter", impl, (x, value),
+                   {"axes": tuple(int(a) for a in axes),
+                    "starts": tuple(int(s) for s in starts),
+                    "ends": tuple(int(e) for e in ends),
+                    "strides": tuple(int(s) for s in strides)})
+
+
+def gammainc(x, y, name=None):
+    """Regularized lower incomplete gamma P(x, y) (reference gammainc)."""
+    return D.apply("gammainc",
+                   lambda a, b: jax.scipy.special.gammainc(
+                       a.astype(jnp.float32), b.astype(jnp.float32)), (x, y))
+
+
+def gammaincc(x, y, name=None):
+    """Regularized upper incomplete gamma Q(x, y) (reference gammaincc)."""
+    return D.apply("gammaincc",
+                   lambda a, b: jax.scipy.special.gammaincc(
+                       a.astype(jnp.float32), b.astype(jnp.float32)), (x, y))
+
+
+def multigammaln(x, p, name=None):
+    """Log multivariate gamma (reference tensor/math.py multigammaln)."""
+    def impl(a, p):
+        af = a.astype(jnp.float32)
+        const = p * (p - 1) / 4.0 * jnp.log(jnp.pi).astype(jnp.float32)
+        terms = sum(jax.scipy.special.gammaln(af - i / 2.0)
+                    for i in range(p))
+        return const + terms
+
+    return D.apply("multigammaln", impl, (x,), {"p": int(p)})

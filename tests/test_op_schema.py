@@ -43,11 +43,35 @@ def test_signatures_match_schema():
 def test_public_surface_covered():
     """Every public op exported from paddle_tpu.ops is declared in the schema
     (runtime-registered custom ops are exempt — they live outside yaml by
-    design, reference custom_operator.cc)."""
+    design, reference custom_operator.cc; programmatically DERIVED names —
+    inplace twins, aliases, constants — are covered transitively by their
+    schema'd base ops, ops/inplace_aliases.py)."""
     from paddle_tpu.ops import PUBLIC_OPS
+    from paddle_tpu.ops import inplace_aliases as ia
     from paddle_tpu.utils.cpp_extension import registered_ops
-    missing = set(PUBLIC_OPS) - set(OP_REGISTRY) - set(registered_ops())
+    missing = (set(PUBLIC_OPS) - set(OP_REGISTRY) - set(registered_ops())
+               - ia.derived_names(PUBLIC_OPS))
     assert not missing, f"undeclared public ops: {sorted(missing)}"
+
+
+def test_inplace_twins_rebind_buffers():
+    """Derived `op_` twins mutate the tensor in place (reference inplace
+    kernel contract: x aliases the result)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.asarray([1.0, 4.0, 9.0], np.float32))
+    y = paddle.sqrt_(x)
+    assert y is x
+    np.testing.assert_allclose(x.numpy(), [1.0, 2.0, 3.0])
+    paddle.exp_(x)
+    np.testing.assert_allclose(x.numpy(), np.exp([1.0, 2.0, 3.0]),
+                               rtol=1e-6)
+    # constants + aliases exist at root
+    assert paddle.pi == np.pi and np.isnan(paddle.nan)
+    np.testing.assert_allclose(
+        paddle.negative(paddle.to_tensor([1.0, -2.0])).numpy(), [-1.0, 2.0])
 
 
 def test_tensor_methods_bound():
