@@ -12,7 +12,7 @@ import jax.numpy as jnp
 from .optimizer import Optimizer
 
 __all__ = ["SGD", "Momentum", "Adam", "AdamW", "Adagrad", "Adadelta", "Adamax",
-           "RMSProp", "Lamb"]
+           "RMSProp", "Lamb", "NAdam", "RAdam", "ASGD", "Rprop", "LBFGS"]
 
 
 def _wd_grad(p, g, wd):
@@ -273,3 +273,272 @@ class Lamb(Optimizer):
         ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         new_p = pf - (lr * param_lr) * ratio * r
         return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
+
+
+class NAdam(Optimizer):
+    """Nesterov-momentum Adam (reference python/paddle/optimizer/nadam.py).
+
+    The mu products are scalars depending only on the step count, so they
+    are carried as host floats and fed per step (`_extra_args`) instead of
+    per-parameter state."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1 = float(beta1)
+        self._beta2 = float(beta2)
+        self._epsilon = float(epsilon)
+        self._psi = float(momentum_decay)
+        self._mu_product = 1.0
+
+    def _slot_names(self):
+        return ("moment1", "moment2")
+
+    def _init_slot(self, name, p):
+        return jnp.zeros(p._data.shape, jnp.float32)
+
+    def _extra_args(self):
+        t = self._global_step
+        mu_t = self._beta1 * (1.0 - 0.5 * 0.96 ** (t * self._psi))
+        mu_t1 = self._beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        # running product is updated exactly once per step (extra args are
+        # computed once per _step_group batch; guard with the step count)
+        if getattr(self, "_mu_step", None) != t:
+            self._mu_product *= mu_t
+            self._mu_step = t
+        mp_t = self._mu_product
+        mp_t1 = mp_t * mu_t1
+        return (jnp.asarray(mu_t, jnp.float32),
+                jnp.asarray(mu_t1, jnp.float32),
+                jnp.asarray(mp_t, jnp.float32),
+                jnp.asarray(mp_t1, jnp.float32),
+                jnp.asarray(1.0 - self._beta2 ** t, jnp.float32))
+
+    def _update_arrays(self, p, g, state, lr, param_lr, wd, extra):
+        b2, eps = self._beta2, self._epsilon
+        mu_t, mu_t1, mp_t, mp_t1, bc2 = extra
+        gf = _wd_grad(p, g.astype(jnp.float32), wd)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * gf
+        v = b2 * state["moment2"] + (1 - b2) * gf * gf
+        m_hat = mu_t1 * m / (1.0 - mp_t1) + (1.0 - mu_t) * gf / (1.0 - mp_t)
+        v_hat = v / bc2
+        new_p = p.astype(jnp.float32) - lr * param_lr * m_hat / (
+            jnp.sqrt(v_hat) + eps)
+        return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (reference python/paddle/optimizer/radam.py): the
+    variance rectification term switches on once rho_t > 4; before that the
+    update is momentum-only."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1 = float(beta1)
+        self._beta2 = float(beta2)
+        self._epsilon = float(epsilon)
+
+    def _slot_names(self):
+        return ("moment1", "moment2")
+
+    def _init_slot(self, name, p):
+        return jnp.zeros(p._data.shape, jnp.float32)
+
+    def _extra_args(self):
+        t = self._global_step
+        b2 = self._beta2
+        rho_inf = 2.0 / (1.0 - b2) - 1.0
+        b2t = b2 ** t
+        rho_t = rho_inf - 2.0 * t * b2t / (1.0 - b2t)
+        if rho_t > 4.0:
+            r = (((rho_t - 4.0) * (rho_t - 2.0) * rho_inf)
+                 / ((rho_inf - 4.0) * (rho_inf - 2.0) * rho_t)) ** 0.5
+        else:
+            r = 0.0
+        return (jnp.asarray(1.0 - self._beta1 ** t, jnp.float32),
+                jnp.asarray(1.0 - b2t, jnp.float32),
+                jnp.asarray(r, jnp.float32),
+                jnp.asarray(1.0 if rho_t > 4.0 else 0.0, jnp.float32))
+
+    def _update_arrays(self, p, g, state, lr, param_lr, wd, extra):
+        bc1, bc2, r, rectified = extra
+        gf = _wd_grad(p, g.astype(jnp.float32), wd)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * gf
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * gf * gf
+        m_hat = m / bc1
+        v_hat = jnp.sqrt(v / bc2) + self._epsilon
+        upd = jnp.where(rectified > 0.5, r * m_hat / v_hat, m_hat)
+        new_p = p.astype(jnp.float32) - lr * param_lr * upd
+        return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
+
+
+class ASGD(Optimizer):
+    """Averaged SGD over the last `batch_num` gradients (reference
+    python/paddle/optimizer/asgd.py: d <- d - y + g; y <- g;
+    p <- p - lr/n * d)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._batch_num = max(1, int(batch_num))
+
+    def _slot_names(self):
+        return ("d", "y")
+
+    def _init_slot(self, name, p):
+        return jnp.zeros(p._data.shape, jnp.float32)
+
+    def _update_arrays(self, p, g, state, lr, param_lr, wd, extra):
+        gf = _wd_grad(p, g.astype(jnp.float32), wd)
+        d = state["d"] - state["y"] + gf
+        new_p = p.astype(jnp.float32) - lr * param_lr * d / self._batch_num
+        return new_p.astype(p.dtype), {"d": d, "y": gf}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference python/paddle/optimizer/rprop.py):
+    per-element step sizes grown on sign agreement, shrunk on disagreement
+    (where the gradient is also zeroed), update = -sign(g) * step."""
+
+    def __init__(self, learning_rate=0.001,
+                 learning_rate_range=(1e-5, 50.0), parameters=None,
+                 etas=(0.5, 1.2), grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr0 = float(learning_rate)
+        self._lr_min, self._lr_max = (float(v) for v in learning_rate_range)
+        self._eta_minus, self._eta_plus = (float(v) for v in etas)
+
+    def _slot_names(self):
+        return ("prev_grad", "steps")
+
+    def _init_slot(self, name, p):
+        if name == "steps":
+            return jnp.full(p._data.shape, self._lr0, jnp.float32)
+        return jnp.zeros(p._data.shape, jnp.float32)
+
+    def _update_arrays(self, p, g, state, lr, param_lr, wd, extra):
+        gf = g.astype(jnp.float32)
+        sign = gf * state["prev_grad"]
+        steps = jnp.where(
+            sign > 0, jnp.minimum(state["steps"] * self._eta_plus,
+                                  self._lr_max),
+            jnp.where(sign < 0,
+                      jnp.maximum(state["steps"] * self._eta_minus,
+                                  self._lr_min),
+                      state["steps"]))
+        gf = jnp.where(sign < 0, 0.0, gf)
+        new_p = p.astype(jnp.float32) - jnp.sign(gf) * steps
+        return new_p.astype(p.dtype), {"prev_grad": gf, "steps": steps}
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with closure re-evaluation (reference
+    python/paddle/optimizer/lbfgs.py).  Two-loop recursion over a
+    `history_size` window; line search is Armijo backtracking when
+    `line_search_fn='strong_wolfe'` is requested (a sufficient-decrease
+    subset of strong Wolfe — documented deviation) else a fixed lr step.
+    """
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._max_iter = int(max_iter)
+        self._tol_grad = float(tolerance_grad)
+        self._tol_change = float(tolerance_change)
+        self._history = int(history_size)
+        self._line_search = line_search_fn
+        self._s: list = []
+        self._y: list = []
+
+    def _flat_params(self):
+        return jnp.concatenate(
+            [p._data.astype(jnp.float32).reshape(-1)
+             for p in self._parameter_list])
+
+    def _set_flat(self, vec):
+        off = 0
+        for p in self._parameter_list:
+            n = int(p._data.size)
+            p._data = vec[off:off + n].reshape(p._data.shape).astype(
+                p._data.dtype)
+            off += n
+
+    def _flat_grad(self):
+        gs = []
+        for p in self._parameter_list:
+            g = p.grad
+            gs.append((jnp.zeros(p._data.shape, jnp.float32)
+                       if g is None else g._data.astype(jnp.float32))
+                      .reshape(-1))
+        return jnp.concatenate(gs)
+
+    def _direction(self, grad):
+        q = -grad
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / jnp.vdot(y, s)
+            a = rho * jnp.vdot(s, q)
+            q = q - a * y
+            alphas.append((a, rho, s, y))
+        if self._s:
+            s, y = self._s[-1], self._y[-1]
+            q = q * (jnp.vdot(s, y) / jnp.vdot(y, y))
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.vdot(y, q)
+            q = q + (a - b) * s
+        return q
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure computing the "
+                             "loss (reference lbfgs.py contract)")
+        loss = closure()
+        for _ in range(self._max_iter):
+            grad = self._flat_grad()
+            if float(jnp.max(jnp.abs(grad))) <= self._tol_grad:
+                break
+            d = self._direction(grad)
+            x0 = self._flat_params()
+            f0 = float(loss.numpy() if hasattr(loss, "numpy") else loss)
+            t = self.get_lr()
+            gtd = float(jnp.vdot(grad, d))
+            accepted = False
+            trials = 8 if self._line_search else 1
+            for _ls in range(trials):
+                self._set_flat(x0 + t * d)
+                self.clear_grad()
+                loss = closure()
+                f1 = float(loss.numpy() if hasattr(loss, "numpy") else loss)
+                if not self._line_search or f1 <= f0 + 1e-4 * t * gtd:
+                    accepted = True
+                    break
+                t *= 0.5
+            if not accepted:
+                self._set_flat(x0)
+                self.clear_grad()
+                loss = closure()
+                break
+            g1 = self._flat_grad()
+            s = self._flat_params() - x0
+            y = g1 - grad
+            if float(jnp.vdot(s, y)) > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                if len(self._s) > self._history:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            if float(jnp.max(jnp.abs(s))) <= self._tol_change:
+                break
+        self._global_step += 1
+        return loss

@@ -119,3 +119,53 @@ def test_weight_decay_l2():
     (w * 0.0).sum().backward()
     o.step()
     np.testing.assert_allclose(w.numpy(), [1.0 - 0.1 * 0.5], rtol=1e-5)
+
+
+@pytest.mark.parametrize("ctor", ["NAdam", "RAdam", "ASGD", "Rprop"])
+def test_new_optimizers_converge(ctor):
+    """Each optimizer family must reduce a quadratic loss
+    (reference per-optimizer convergence smoke)."""
+    paddle.seed(0)
+    net = nn.Linear(4, 1)
+    opt = getattr(paddle.optimizer, ctor)(
+        learning_rate=0.05, parameters=net.parameters())
+    x = paddle.to_tensor(np.random.RandomState(0).rand(16, 4)
+                         .astype("float32"))
+    y = paddle.to_tensor((np.random.RandomState(1).rand(16, 1) * 2)
+                         .astype("float32"))
+    first = None
+    for _ in range(25):
+        loss = ((net(x) - y) ** 2).mean()
+        if first is None:
+            first = float(loss.numpy())
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss.numpy()) < first * 0.9, (ctor, first,
+                                               float(loss.numpy()))
+
+
+def test_lbfgs_rosenbrock_style():
+    """LBFGS with closure drives a quadratic near its optimum in a few
+    outer steps (reference lbfgs.py closure contract)."""
+    paddle.seed(0)
+    net = nn.Linear(2, 1, bias_attr=False)
+    A = paddle.to_tensor(np.asarray([[1.0, 0.5]], np.float32))
+    target = paddle.to_tensor(np.asarray([[3.0]], np.float32))
+    opt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=10,
+                                 line_search_fn="strong_wolfe",
+                                 parameters=net.parameters())
+
+    def closure():
+        opt.clear_grad()
+        loss = ((net(A) - target) ** 2).mean()
+        loss.backward()
+        return loss
+
+    loss = opt.step(closure)
+    for _ in range(3):
+        loss = opt.step(closure)
+    assert float(loss.numpy()) < 1e-4
+
+    with pytest.raises(ValueError, match="closure"):
+        opt.step()
