@@ -136,9 +136,8 @@ def create_parameter(shape, dtype="float32", name=None, attr=None,
     from .nn.initializer import Constant, XavierNormal
     init = default_initializer or (Constant(0.0) if is_bias
                                    else XavierNormal())
-    p = Parameter(_jnp.zeros(tuple(int(s) for s in shape),
-                             to_jax_dtype(dtype)))
-    init(p)
+    data = init(tuple(int(s) for s in shape), dtype)
+    p = Parameter(_jnp.asarray(data, to_jax_dtype(dtype)))
     if attr is not None and getattr(attr, "regularizer", None) is not None:
         p.regularizer = attr.regularizer
     return p

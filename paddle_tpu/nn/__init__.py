@@ -9,6 +9,7 @@ from .clip import (  # noqa: F401
 from .initializer.attr import ParamAttr  # noqa: F401
 from .layer.activation import *  # noqa: F401,F403
 from .layer.common import *  # noqa: F401,F403
+from .layer.extension import *  # noqa: F401,F403
 from .layer.container import *  # noqa: F401,F403
 from .layer.conv import *  # noqa: F401,F403
 from .layer.layers import Layer  # noqa: F401

@@ -1,0 +1,500 @@
+"""Remaining functional surface (reference python/paddle/nn/functional/
+{activation,common,pooling,loss,extension,flash_attention}.py entries not
+covered elsewhere): inplace activation twins, distance, feature-alpha
+dropout, 1-D/3-D unpooling, fractional pooling, margin softmax,
+class-center sampling, adaptive log softmax, qkv-packed flash attention.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch as D
+from ...core.tensor import Tensor
+
+__all__ = [
+    "pairwise_distance", "elu_", "hardtanh_", "leaky_relu_",
+    "thresholded_relu_", "relu_", "sequence_mask", "gather_tree",
+    "temporal_shift", "feature_alpha_dropout", "max_unpool1d",
+    "max_unpool3d", "fractional_max_pool2d", "fractional_max_pool3d",
+    "margin_cross_entropy", "class_center_sample",
+    "adaptive_log_softmax_with_loss", "sparse_attention",
+    "flashmask_attention", "flash_attn_qkvpacked",
+    "flash_attn_varlen_qkvpacked", "rnnt_loss",
+]
+
+
+def _t(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """(reference nn/functional/distance.py pairwise_distance)."""
+    def impl(a, b, p, eps, keepdim):
+        d = (a - b).astype(jnp.float32) + eps
+        out = jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+        return out.astype(a.dtype)
+
+    return D.apply("pairwise_distance", impl, (x, y),
+                   {"p": float(p), "eps": float(epsilon),
+                    "keepdim": bool(keepdim)})
+
+
+def _inplace(fn):
+    def wrapped(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        x._data = out._data
+        return x
+    return wrapped
+
+
+def elu_(x, alpha=1.0, name=None):
+    from .activation import elu
+    return _inplace(elu)(x, alpha)
+
+
+def relu_(x, name=None):
+    from .activation import relu
+    return _inplace(relu)(x)
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):
+    from .activation import hardtanh
+    return _inplace(hardtanh)(x, min, max)
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    from .activation import leaky_relu
+    return _inplace(leaky_relu)(x, negative_slope)
+
+
+def thresholded_relu_(x, threshold=1.0, value=0.0, name=None):
+    from .activation import thresholded_relu
+    return _inplace(thresholded_relu)(x, threshold)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ...ops.misc import sequence_mask as _impl
+    return _impl(x, maxlen, dtype)
+
+
+def gather_tree(ids, parents, name=None):
+    from ...ops.misc import gather_tree as _impl
+    return _impl(ids, parents)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    from ...ops.misc import temporal_shift as _impl
+    return _impl(x, seg_num, shift_ratio, data_format)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout over whole channels (reference common.py
+    feature_alpha_dropout: SELU-preserving statistics, channel granularity
+    on [N, C, ...])."""
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(_t(x))
+    import random as _r
+    seed = _r.randint(0, 2 ** 31 - 1)
+
+    def impl(a, p, seed):
+        alpha_p = -1.7580993408473766
+        keep = 1.0 - p
+        key = jax.random.PRNGKey(seed)
+        mask_shape = a.shape[:2] + (1,) * (a.ndim - 2)
+        mask = jax.random.bernoulli(key, keep, mask_shape)
+        af = a.astype(jnp.float32)
+        a_scale = (keep + alpha_p ** 2 * keep * p) ** -0.5
+        b = -a_scale * p * alpha_p
+        out = jnp.where(mask, af, alpha_p)
+        return (out * a_scale + b).astype(a.dtype)
+
+    return D.apply("feature_alpha_dropout", impl, (x,),
+                   {"p": float(p), "seed": seed})
+
+
+def _unpool_nd(x, indices, spatial):
+    def impl(a, idx, out_sizes):
+        lead = a.shape[:2]
+        flat_out = 1
+        for s in out_sizes:
+            flat_out *= s
+        av = a.reshape(lead + (-1,))
+        iv = idx.reshape(lead + (-1,)).astype(jnp.int32)
+        base = jnp.zeros(lead + (flat_out,), a.dtype)
+        out = jax.vmap(jax.vmap(
+            lambda dst, src, ii: dst.at[ii].set(src)))(base, av, iv)
+        return out.reshape(lead + tuple(out_sizes))
+    return impl
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """(reference pooling.py max_unpool1d)."""
+    if data_format != "NCL":
+        raise ValueError("max_unpool1d supports NCL only")
+    ks = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    st = ks if stride is None else (
+        stride if isinstance(stride, int) else stride[0])
+    pd = padding if isinstance(padding, int) else padding[0]
+    L = x.shape[-1]
+    Lo = (int(output_size[-1]) if output_size is not None
+          else (L - 1) * st - 2 * pd + ks)
+    return D.apply("max_unpool1d", _unpool_nd(x, indices, 1),
+                   (x, indices), {"out_sizes": (Lo,)})
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    """(reference pooling.py max_unpool3d)."""
+    if data_format != "NCDHW":
+        raise ValueError("max_unpool3d supports NCDHW only")
+    ks = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = ks if stride is None else (
+        (stride,) * 3 if isinstance(stride, int) else tuple(stride))
+    pd = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    D_, H, W = x.shape[-3:]
+    if output_size is not None:
+        out_sizes = tuple(int(v) for v in output_size[-3:])
+    else:
+        out_sizes = tuple((n - 1) * s - 2 * p + k for n, s, p, k in
+                          zip((D_, H, W), st, pd, ks))
+    return D.apply("max_unpool3d", _unpool_nd(x, indices, 3),
+                   (x, indices), {"out_sizes": out_sizes})
+
+
+def _fractional_pool(x, output_size, random_u, nd, kernel_size=None):
+    """Fractional max pooling: pseudo-random pooling regions from the u
+    sequence (Graham 2014; reference pooling.py fractional_max_pool2d)."""
+    import random as _r
+    u = float(random_u) if random_u is not None else _r.random()
+    u = min(max(u, 1e-4), 1.0 - 1e-4)
+
+    def bounds(n_in, n_out):
+        # region edges partition [0, n_in): interior edges from the u
+        # sequence, endpoints pinned (Graham 2014 pseudo-random regions)
+        alpha = n_in / n_out
+        inner = jnp.floor(alpha * (jnp.arange(1, n_out) + u)).astype(
+            jnp.int32)
+        inner = jnp.clip(inner, 1, n_in - 1)
+        edges = jnp.concatenate([jnp.zeros((1,), jnp.int32), inner,
+                                 jnp.full((1,), n_in, jnp.int32)])
+        starts = edges[:-1]
+        ends = jnp.maximum(edges[1:], starts + 1)
+        return starts, jnp.minimum(ends, n_in)
+
+    def impl(a, out_sizes):
+        spatial = a.shape[-nd:]
+        out = a
+        # pool one spatial dim at a time (max is separable)
+        for d in range(nd):
+            n_in = spatial[d]
+            n_out = out_sizes[d]
+            starts, ends = bounds(n_in, n_out)
+            axis = a.ndim - nd + d
+
+            def pool_dim(i):
+                s = starts[i]
+                e = ends[i]
+                # static max window: alpha+1 elements, mask the overhang
+                w = int(-(-n_in // n_out)) + 1
+                sl = jax.lax.dynamic_slice_in_dim(
+                    out, jnp.minimum(s, n_in - w), w, axis=axis)
+                pos = jnp.minimum(s, n_in - w) + jnp.arange(w)
+                valid = (pos >= s) & (pos < e)
+                shape = [1] * sl.ndim
+                shape[axis] = w
+                sl = jnp.where(valid.reshape(shape), sl, -jnp.inf)
+                return jnp.max(sl, axis=axis)
+
+            out = jnp.stack([pool_dim(i) for i in range(n_out)], axis=axis)
+        return out.astype(a.dtype)
+
+    return impl
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    out = D.apply("fractional_max_pool2d",
+                  _fractional_pool(x, output_size, random_u, 2),
+                  (x,), {"out_sizes": tuple(int(v) for v in output_size)})
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool2d(return_mask=True) is not implemented")
+    return out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size,) * 3
+    out = D.apply("fractional_max_pool3d",
+                  _fractional_pool(x, output_size, random_u, 3),
+                  (x,), {"out_sizes": tuple(int(v) for v in output_size)})
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool3d(return_mask=True) is not implemented")
+    return out
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace-family margin softmax (reference loss.py
+    margin_cross_entropy: cos(m1*theta + m2) - m3 on the target logit,
+    then scaled softmax CE).  Single-group (non-model-parallel) form."""
+    def impl(lg, lb, m1, m2, m3, s, reduction, want_softmax):
+        lgf = jnp.clip(lg.astype(jnp.float32), -1.0, 1.0)
+        theta = jnp.arccos(jnp.take_along_axis(lgf, lb[:, None], 1)[:, 0])
+        target = jnp.cos(m1 * theta + m2) - m3
+        adj = lgf.at[jnp.arange(lgf.shape[0]), lb].set(target) * s
+        lse = jax.scipy.special.logsumexp(adj, axis=-1)
+        picked = jnp.take_along_axis(adj, lb[:, None], 1)[:, 0]
+        loss = lse - picked
+        if reduction == "mean":
+            loss = jnp.mean(loss)
+        elif reduction == "sum":
+            loss = jnp.sum(loss)
+        if want_softmax:
+            return loss, jax.nn.softmax(adj, axis=-1)
+        return loss
+
+    lb = label.flatten() if hasattr(label, "flatten") else label
+    kwargs = {"m1": float(margin1), "m2": float(margin2),
+              "m3": float(margin3), "s": float(scale),
+              "reduction": str(reduction),
+              "want_softmax": bool(return_softmax)}
+    if return_softmax:
+        return D.apply("margin_cross_entropy", impl, (logits, lb), kwargs,
+                       num_outputs=2)
+    return D.apply("margin_cross_entropy", impl, (logits, lb), kwargs)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """Sample negative class centers + remap labels (reference
+    common.py class_center_sample).  Host-side sampling (data-dependent
+    unique), like the reference's CPU path."""
+    import numpy as np
+
+    lb = np.asarray(label.numpy() if isinstance(label, Tensor) else label)
+    pos = np.unique(lb)
+    remaining = np.setdiff1d(np.arange(num_classes), pos)
+    n_extra = max(0, int(num_samples) - pos.size)
+    rng = np.random.default_rng()
+    extra = (rng.choice(remaining, size=min(n_extra, remaining.size),
+                        replace=False) if n_extra else
+             np.empty((0,), lb.dtype))
+    sampled = np.sort(np.concatenate([pos, extra])).astype(lb.dtype)
+    remap = {int(c): i for i, c in enumerate(sampled)}
+    new_label = np.asarray([remap[int(v)] for v in lb.reshape(-1)],
+                           lb.dtype).reshape(lb.shape)
+    return (Tensor(jnp.asarray(new_label)), Tensor(jnp.asarray(sampled)))
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive softmax (reference loss.py adaptive_log_softmax_with_loss;
+    Grave et al.): frequent classes in the head, rare classes through
+    per-cluster tail projections."""
+    def impl(x, lb, hw, *rest, cutoffs, has_bias):
+        xf = x.astype(jnp.float32)
+        n_clusters = len(cutoffs) - 1
+        head_size = cutoffs[0] + n_clusters
+        if has_bias:
+            hb, tails = rest[0], rest[1:]
+        else:
+            hb, tails = None, rest
+        head = xf @ hw.astype(jnp.float32)
+        if hb is not None:
+            head = head + hb.astype(jnp.float32)
+        head_lsm = jax.nn.log_softmax(head, axis=-1)
+        out = jnp.zeros((x.shape[0],), jnp.float32)
+        # in-head targets
+        in_head = lb < cutoffs[0]
+        safe = jnp.clip(lb, 0, head_size - 1)
+        out = jnp.where(in_head,
+                        jnp.take_along_axis(head_lsm, safe[:, None],
+                                            1)[:, 0], out)
+        for i in range(n_clusters):
+            lo, hi = cutoffs[i], cutoffs[i + 1]
+            w1, w2 = tails[2 * i], tails[2 * i + 1]
+            proj = (xf @ w1.astype(jnp.float32)) @ w2.astype(jnp.float32)
+            tail_lsm = jax.nn.log_softmax(proj, axis=-1)
+            in_tail = (lb >= lo) & (lb < hi)
+            rel = jnp.clip(lb - lo, 0, hi - lo - 1)
+            cluster_lp = head_lsm[:, cutoffs[0] + i]
+            lp = cluster_lp + jnp.take_along_axis(tail_lsm, rel[:, None],
+                                                  1)[:, 0]
+            out = jnp.where(in_tail, lp, out)
+        return out, -jnp.mean(out)
+
+    flat_tails = []
+    for pair in tail_weights:
+        flat_tails.extend(pair)
+    has_bias = head_bias is not None
+    args = (input, label, head_weight) + \
+        ((head_bias,) if has_bias else ()) + tuple(flat_tails)
+    return D.apply("adaptive_log_softmax_with_loss", impl, args,
+                   {"cutoffs": tuple(int(c) for c in cutoffs),
+                    "has_bias": has_bias},
+                   num_outputs=2)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    raise NotImplementedError(
+        "sparse_attention (block-sparse SDPA) is not implemented in this "
+        "TPU build — the reference gates it behind a CUDA-only kernel "
+        "(sparse_attention_kernel.cu). Use flash_attn_unpadded or a dense "
+        "mask with scaled_dot_product_attention; a Pallas block-sparse "
+        "kernel can be registered via paddle_tpu.utils.cpp_extension")
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=False, window_size=None,
+                        return_softmax_lse=False, return_seed_offset=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """FlashMask (reference flash_attention.py flashmask_attention):
+    row-bounded sparse masks.  Here the row bounds materialize into a dense
+    additive mask consumed by the fused SDPA path (numerics-equal; the
+    O(S) mask representation is an optimization the Pallas kernel can adopt
+    later)."""
+    from .attention import scaled_dot_product_attention
+
+    if startend_row_indices is None:
+        return scaled_dot_product_attention(query, key, value,
+                                            is_causal=causal)
+    idx = _t(startend_row_indices)       # [B, KVH, S, {1,2,4}]
+    S = query.shape[1]
+    rows = jnp.arange(S)[:, None]        # mask rows (query positions)
+    if idx.shape[-1] == 1:
+        start = idx[..., 0]
+        if causal:
+            # masked when row >= start[col]
+            masked = rows[None, None] >= start[:, :, None, :]
+        else:
+            masked = rows[None, None] >= start[:, :, None, :]
+    elif idx.shape[-1] == 2:
+        if causal:
+            start, end = idx[..., 0], idx[..., 1]
+            masked = (rows[None, None] >= start[:, :, None, :]) & \
+                     (rows[None, None] < end[:, :, None, :])
+        else:
+            start, end = idx[..., 0], idx[..., 1]
+            masked = (rows[None, None] >= start[:, :, None, :]) & \
+                     (rows[None, None] < end[:, :, None, :])
+    else:
+        ls, le, us, ue = (idx[..., i] for i in range(4))
+        masked = ((rows[None, None] >= ls[:, :, None, :]) &
+                  (rows[None, None] < le[:, :, None, :])) | \
+                 ((rows[None, None] >= us[:, :, None, :]) &
+                  (rows[None, None] < ue[:, :, None, :]))
+    # masked: [B, KVH, S(q), S(k)] -> additive bias broadcast over heads
+    nheads = query.shape[2]
+    kvh = masked.shape[1]
+    if kvh != nheads:
+        masked = jnp.repeat(masked, nheads // kvh, axis=1)
+    bias = jnp.where(masked, -jnp.inf, 0.0)
+    if causal:
+        causal_m = rows < jnp.arange(S)[None, :]
+        bias = bias + jnp.where(causal_m[None, None], -jnp.inf, 0.0)
+    return scaled_dot_product_attention(query, key, value,
+                                        attn_mask=Tensor(bias))
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, fixed_seed_offset=None,
+                         rng_name="", training=True, name=None):
+    """Packed [B, S, 3, H, D] qkv (reference flash_attention.py
+    flash_attn_qkvpacked) — unpacks and rides the fused attention path."""
+    from .attention import flash_attention
+
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax, training=training)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale,
+                                dropout=0.0, causal=False,
+                                return_softmax=False, fixed_seed_offset=None,
+                                rng_name="", varlen_padded=True,
+                                training=True, name=None):
+    """Packed varlen [T, 3, H, D] (reference flash_attn_varlen_qkvpacked)."""
+    from .attention import flash_attn_unpadded
+
+    q = qkv[:, 0]
+    k = qkv[:, 1]
+    v = qkv[:, 2]
+    return flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                               max_seqlen_q, max_seqlen_k, scale,
+                               dropout=dropout, causal=causal,
+                               return_softmax=return_softmax,
+                               training=training)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-T transducer loss (reference loss.py rnnt_loss; kernel
+    warprnnt).  Forward-variable DP over the (T, U) lattice via lax.scan
+    (log-space), batched; differentiable through the scan.
+    input: [B, T, U+1, V] log-probable activations (softmaxed internally),
+    label: [B, U]."""
+    def impl(acts, labels, in_lens, lb_lens, blank, reduction):
+        lp = jax.nn.log_softmax(acts.astype(jnp.float32), axis=-1)
+        B, T, U1, V = lp.shape
+        U = U1 - 1
+        NEG = -1e30
+
+        blank_lp = lp[..., blank]                       # [B, T, U+1]
+        lab_lp = jnp.take_along_axis(
+            lp[:, :, :U, :], labels[:, None, :, None].astype(jnp.int32),
+            axis=-1)[..., 0]                            # [B, T, U]
+
+        # alpha over t, carried row alpha[:, u] for u in 0..U
+        alpha0 = jnp.concatenate(
+            [jnp.zeros((B, 1)), jnp.full((B, U), NEG)], axis=1)
+
+        def emit_row(alpha_row, lab_t):
+            # within one t: alpha[u] += emit from alpha[u-1]
+            def body(u, row):
+                cand = row[:, u - 1] + lab_t[:, u - 1]
+                return row.at[:, u].set(jnp.logaddexp(row[:, u], cand))
+            return jax.lax.fori_loop(1, U + 1, body, alpha_row)
+
+        def step(alpha, t):
+            # blank at (t-1, u) advances time; emissions run within frame t
+            moved = alpha + blank_lp[:, t - 1]
+            emitted = emit_row(moved, lab_lp[:, t])
+            return emitted, alpha
+
+        # t = 0: only emissions within the first frame
+        alpha_t0 = emit_row(alpha0, lab_lp[:, 0])
+        # scan emits the PRE-step carry (rows 0..T-2); the final carry is
+        # row T-1
+        alpha_last, prev_rows = jax.lax.scan(step, alpha_t0,
+                                             jnp.arange(1, T))
+        all_alpha = jnp.concatenate([prev_rows, alpha_last[None]], axis=0)
+        # gather alpha at (T_b - 1, U_b) + final blank
+        tb = jnp.clip(in_lens.astype(jnp.int32) - 1, 0, T - 1)
+        ub = jnp.clip(lb_lens.astype(jnp.int32), 0, U)
+        a_final = all_alpha[tb, jnp.arange(B), ub]
+        final_blank = blank_lp[jnp.arange(B), tb, ub]
+        nll = -(a_final + final_blank)
+        if reduction == "mean":
+            return jnp.mean(nll)
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+
+    return D.apply("rnnt_loss", impl,
+                   (input, label, input_lengths, label_lengths),
+                   {"blank": int(blank), "reduction": str(reduction)})

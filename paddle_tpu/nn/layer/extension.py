@@ -1,0 +1,336 @@
+"""Remaining layer surface (reference python/paddle/nn/layer/
+{common,pooling,loss,container,rnn}.py entries not covered elsewhere).
+"""
+from __future__ import annotations
+
+from .. import functional as F
+from .layers import Layer
+
+__all__ = [
+    "FeatureAlphaDropout", "Softmax2D", "ParameterDict", "RNNTLoss",
+    "HSigmoidLoss", "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D",
+    "AdaptiveLogSoftmaxWithLoss", "Unflatten", "FractionalMaxPool2D",
+    "FractionalMaxPool3D", "ZeroPad1D", "ZeroPad3D", "BeamSearchDecoder",
+    "dynamic_decode",
+]
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, self.p, training=self.training)
+
+
+class Softmax2D(Layer):
+    """Softmax over channels of [N, C, H, W] (reference common.py
+    Softmax2D)."""
+
+    def forward(self, x):
+        if len(x.shape) != 4:
+            raise ValueError(f"Softmax2D expects 4-D NCHW, got {x.shape}")
+        return F.softmax(x, axis=1)
+
+
+class ParameterDict(Layer):
+    """Keyed parameter container (reference container.py ParameterDict)."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            self.update(parameters)
+
+    def update(self, parameters):
+        items = parameters.items() if hasattr(parameters, "items") \
+            else parameters
+        for k, v in items:
+            self.add_parameter(str(k), v)
+
+    def __getitem__(self, key):
+        return self._parameters[str(key)]
+
+    def __setitem__(self, key, value):
+        self.add_parameter(str(key), value)
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters)
+
+    def keys(self):
+        return self._parameters.keys()
+
+    def values(self):
+        return self._parameters.values()
+
+    def items(self):
+        return self._parameters.items()
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           blank=self.blank, reduction=self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid loss layer (reference loss.py HSigmoidLoss)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        from ..initializer import Normal
+        from ..initializer.attr import ParamAttr
+        self.num_classes = num_classes
+        c = num_classes - 1
+        self.weight = self.create_parameter(
+            [c, feature_size], attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=Normal(0.0, 1.0 / feature_size ** 0.5))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [c, 1], attr=ParamAttr._to_attr(bias_attr), is_bias=True))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table, path_code)
+
+
+class _UnpoolNd(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format=None,
+                 output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.output_size = output_size
+        self.data_format = data_format
+
+
+class MaxUnPool1D(_UnpoolNd):
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, self.kernel_size, self.stride,
+                              self.padding,
+                              output_size=self.output_size)
+
+
+class MaxUnPool2D(_UnpoolNd):
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self.kernel_size, self.stride,
+                              self.padding,
+                              output_size=self.output_size)
+
+
+class MaxUnPool3D(_UnpoolNd):
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, self.kernel_size, self.stride,
+                              self.padding,
+                              output_size=self.output_size)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, self.output_size,
+                                       random_u=self.random_u,
+                                       return_mask=self.return_mask)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, self.output_size,
+                                       random_u=self.random_u,
+                                       return_mask=self.return_mask)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Adaptive softmax layer (reference loss.py
+    AdaptiveLogSoftmaxWithLoss)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if cutoffs != sorted(cutoffs) or cutoffs[-1] > n_classes:
+            raise ValueError(f"bad cutoffs {cutoffs}")
+        self.cutoffs = cutoffs + [n_classes]
+        self.n_clusters = len(self.cutoffs) - 1
+        head_size = self.cutoffs[0] + self.n_clusters
+        self.head_weight = self.create_parameter([in_features, head_size])
+        self.head_bias = (self.create_parameter([head_size], is_bias=True)
+                          if head_bias else None)
+        self.tail_weights = []
+        for i in range(self.n_clusters):
+            hsz = max(1, int(in_features / (div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            w1 = self.create_parameter([in_features, hsz])
+            w2 = self.create_parameter([hsz, osz])
+            self.add_parameter(f"tail_{i}_0", w1)
+            self.add_parameter(f"tail_{i}_1", w2)
+            self.tail_weights.append([w1, w2])
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights, self.cutoffs,
+            self.head_bias)
+
+    def log_prob(self, input):
+        import jax
+        import jax.numpy as jnp
+
+        from ...core.tensor import Tensor
+        x = input._data.astype(jnp.float32)
+        head = x @ self.head_weight._data.astype(jnp.float32)
+        if self.head_bias is not None:
+            head = head + self.head_bias._data.astype(jnp.float32)
+        head_lsm = jax.nn.log_softmax(head, axis=-1)
+        outs = [head_lsm[..., :self.cutoffs[0]]]
+        for i in range(self.n_clusters):
+            w1, w2 = self.tail_weights[i]
+            proj = (x @ w1._data.astype(jnp.float32)) \
+                @ w2._data.astype(jnp.float32)
+            tail_lsm = jax.nn.log_softmax(proj, axis=-1)
+            outs.append(tail_lsm
+                        + head_lsm[..., self.cutoffs[0] + i][..., None])
+        return Tensor(jnp.concatenate(outs, axis=-1))
+
+    def predict(self, input):
+        return self.log_prob(input).argmax(axis=-1)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = shape
+
+    def forward(self, x):
+        from ...ops.manipulation import unflatten
+        return unflatten(x, self.axis, self.shape)
+
+
+class ZeroPad1D(Layer):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__()
+        self.padding = padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, "constant", 0.0, self.data_format)
+
+
+class ZeroPad3D(Layer):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__()
+        self.padding = padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, "constant", 0.0, self.data_format)
+
+
+# ---------------------------------------------------------------------------
+# Seq2seq decoding (reference nn/decode.py BeamSearchDecoder +
+# dynamic_decode).  Eager loop over the decoder cell; beams tracked with
+# gather_tree for final sequence reconstruction.
+# ---------------------------------------------------------------------------
+
+class BeamSearchDecoder:
+    """(reference nn/decode.py:BeamSearchDecoder) — wraps an RNN cell +
+    embedding/output functions for beam search via dynamic_decode."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
+    """Greedy-within-beam decode loop (reference nn/decode.py
+    dynamic_decode).  Returns (ids [B, T, beam], final_states).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ...core.tensor import Tensor
+    from ...ops.misc import gather_tree
+
+    cell = decoder.cell
+    beam = decoder.beam_size
+    state = inits
+    # infer batch from the initial state tree
+    leaves = [state] if isinstance(state, Tensor) else list(
+        state if isinstance(state, (list, tuple)) else [state])
+    B = leaves[0].shape[0]
+
+    # tile states across beams: [B, ...] -> [B*beam, ...]
+    def tile(t):
+        arr = t._data if isinstance(t, Tensor) else jnp.asarray(t)
+        return Tensor(jnp.repeat(arr, beam, axis=0))
+
+    state = [tile(s) for s in leaves]
+    tok = Tensor(jnp.full((B * beam,), decoder.start_token, jnp.int32))
+    log_probs = jnp.where(
+        jnp.arange(B * beam) % beam == 0, 0.0, -1e9)    # only beam 0 live
+    step_ids, step_parents = [], []
+    finished = jnp.zeros((B * beam,), bool)
+
+    for t in range(max_step_num):
+        emb = decoder.embedding_fn(tok) if decoder.embedding_fn else tok
+        out, new_state = cell(emb, state)    # states contract: a list
+        logits = decoder.output_fn(out) if decoder.output_fn else out
+        larr = logits._data if isinstance(logits, Tensor) \
+            else jnp.asarray(logits)
+        lsm = jax.nn.log_softmax(larr.astype(jnp.float32), axis=-1)
+        V = lsm.shape[-1]
+        # frozen beams only extend with end_token at no cost
+        frozen = jnp.full((B * beam, V), -1e9).at[:, decoder.end_token].set(0.0)
+        lsm = jnp.where(finished[:, None], frozen, lsm)
+        total = log_probs[:, None] + lsm                # [B*beam, V]
+        total = total.reshape(B, beam * V)
+        top_v, top_i = jax.lax.top_k(total, beam)
+        parent = top_i // V                             # beam index in 0..beam
+        sym = top_i % V
+        # flatten back to [B*beam]
+        gather = (jnp.arange(B)[:, None] * beam + parent).reshape(-1)
+        log_probs = top_v.reshape(-1)
+        tok = Tensor(sym.reshape(-1).astype(jnp.int32))
+        state = [Tensor(jnp.take(s._data, gather, axis=0))
+                 for s in (new_state if isinstance(new_state, (list, tuple))
+                           else [new_state])]
+        finished = jnp.take(finished, gather) | (
+            sym.reshape(-1) == decoder.end_token)
+        step_ids.append(sym)
+        step_parents.append(parent)
+        if bool(finished.all()):
+            break
+
+    ids = Tensor(jnp.stack(step_ids).astype(jnp.int64))       # [T, B, beam]
+    parents = Tensor(jnp.stack(step_parents).astype(jnp.int64))
+    seqs = gather_tree(ids, parents)
+    return seqs, state

@@ -618,15 +618,16 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
     if len(pad) == 2 * nd:
         width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
     else:
-        # paddle conv-style: pad applies to last len(pad)//2 spatial dims,
-        # ordered last-dim-first pairs
+        # paddle conv-style: pad pairs are LAST-dim-first — (left, right,
+        # top, bottom, front, back): pair 0 pads W, pair 1 pads H, pair 2
+        # pads D (reference nn/functional/common.py pad contract)
         k = len(pad) // 2
         width = [(0, 0)] * nd
         if data_format.endswith("C"):  # NHWC / NLC / NDHWC: spatial dims start at 1
             spatial = list(range(1, 1 + k))
         else:  # NCHW / NCL / NCDHW: spatial dims after channel
             spatial = list(range(nd - k, nd))
-        for i, dim in enumerate(spatial):
+        for i, dim in enumerate(reversed(spatial)):
             width[dim] = (pad[2 * i], pad[2 * i + 1])
 
     jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",

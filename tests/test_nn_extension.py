@@ -1,0 +1,182 @@
+"""Extended nn/F surface (reference nn/functional/{pooling,loss,common,
+flash_attention}.py + nn/layer + nn/decode.py remainders)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_pairwise_distance_and_inplace_activations():
+    x = paddle.to_tensor(np.asarray([[3., 4.]], np.float32))
+    y = paddle.to_tensor(np.zeros((1, 2), np.float32))
+    np.testing.assert_allclose(F.pairwise_distance(x, y).numpy(), [5.0],
+                               rtol=1e-4)
+    a = paddle.to_tensor(np.asarray([-1., 2.], np.float32))
+    out = F.relu_(a)
+    assert out is a
+    np.testing.assert_allclose(a.numpy(), [0., 2.])
+    F.leaky_relu_(paddle.to_tensor([-1.0]))     # smoke the other twins
+    F.hardtanh_(paddle.to_tensor([3.0]))
+    F.elu_(paddle.to_tensor([-3.0]))
+
+
+def test_max_unpool_1d_3d_roundtrip():
+    x1 = paddle.to_tensor(np.asarray([[[5., 7.]]], np.float32))
+    i1 = paddle.to_tensor(np.asarray([[[1, 3]]], np.int64))
+    out = F.max_unpool1d(x1, i1, kernel_size=2)
+    np.testing.assert_allclose(out.numpy(), [[[0., 5., 0., 7.]]])
+
+    x3 = paddle.to_tensor(np.ones((1, 1, 1, 1, 2), np.float32))
+    i3 = paddle.to_tensor(np.asarray([[[[[0, 7]]]]], np.int64))
+    out3 = F.max_unpool3d(x3, i3, kernel_size=2)
+    assert out3.shape == [1, 1, 2, 2, 4]
+    assert out3.numpy().reshape(-1)[0] == 1.0
+    assert out3.numpy().reshape(-1)[7] == 1.0
+
+
+def test_fractional_max_pool2d():
+    x = paddle.to_tensor(np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6))
+    out = F.fractional_max_pool2d(x, output_size=3, random_u=0.3)
+    assert out.shape == [1, 1, 3, 3]
+    # pooling regions partition the input: global max must survive
+    assert out.numpy().max() == 35.0
+
+
+def test_margin_cross_entropy_reduces_target_logit():
+    rng = np.random.RandomState(0)
+    logits = paddle.to_tensor(
+        (rng.rand(4, 10).astype(np.float32) - 0.5) * 2, stop_gradient=False)
+    label = paddle.to_tensor(np.asarray([1, 2, 3, 4], np.int64))
+    loss = F.margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                                  margin3=0.0, scale=16.0)
+    plain = F.margin_cross_entropy(logits, label, margin1=1.0, margin2=0.0,
+                                   margin3=0.0, scale=16.0)
+    # margin makes the task harder -> larger loss
+    assert float(loss.numpy()) > float(plain.numpy())
+    loss.backward()
+    assert logits.grad is not None
+
+
+def test_class_center_sample():
+    label = paddle.to_tensor(np.asarray([2, 7, 2, 9], np.int64))
+    new_label, sampled = F.class_center_sample(label, num_classes=20,
+                                               num_samples=6)
+    s = sampled.numpy()
+    assert set([2, 7, 9]).issubset(set(s.tolist()))
+    assert len(s) == 6
+    # remapped labels index into sampled
+    np.testing.assert_array_equal(s[new_label.numpy()],
+                                  label.numpy())
+
+
+def test_adaptive_log_softmax_with_loss():
+    paddle.seed(0)
+    layer = nn.AdaptiveLogSoftmaxWithLoss(in_features=16, n_classes=20,
+                                          cutoffs=[5, 12])
+    x = paddle.randn([8, 16])
+    y = paddle.to_tensor(np.random.RandomState(0).randint(0, 20, (8,)))
+    out, loss = layer(x, y)
+    assert np.isfinite(loss.numpy())
+    lp = layer.log_prob(x)
+    assert lp.shape == [8, 20]
+    # log-probs normalize
+    np.testing.assert_allclose(np.exp(lp.numpy()).sum(-1), 1.0, rtol=1e-3)
+    # the loss equals -mean(log_prob[label])
+    want = -np.mean(lp.numpy()[np.arange(8), y.numpy()])
+    np.testing.assert_allclose(float(loss.numpy()), want, rtol=1e-4)
+
+
+def test_rnnt_loss_simple():
+    """B=1, T=2, U=1: hand-checkable lattice."""
+    B, T, U, V = 1, 2, 1, 3
+    acts = np.zeros((B, T, U + 1, V), np.float32)
+    loss = F.rnnt_loss(paddle.to_tensor(acts),
+                       paddle.to_tensor(np.asarray([[1]], np.int64)),
+                       paddle.to_tensor(np.asarray([2], np.int64)),
+                       paddle.to_tensor(np.asarray([1], np.int64)),
+                       blank=0, reduction="none")
+    # uniform log-probs: each lattice transition costs log(3); 3 paths of
+    # 3 transitions each -> -log(3 * (1/3)^3) = 2 log 3 - log 3 ... just
+    # check against brute force: paths (emit@t0,b,b),(b,emit@t1,b) ->
+    # wait T=2: paths: emit at t0 then blanks (b at t0->t1, final b), or
+    # blank to t1, emit at t1, final b. p = 2 * (1/3)^3
+    want = -np.log(2 * (1 / 3) ** 3)
+    np.testing.assert_allclose(loss.numpy(), [want], rtol=1e-4)
+
+
+def test_flash_attn_qkvpacked_matches_unpacked():
+    paddle.seed(0)
+    qkv = paddle.randn([2, 16, 3, 2, 8])
+    out, _ = F.flash_attn_qkvpacked(qkv, causal=True)
+    ref, _ = F.flash_attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                               causal=True)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
+
+
+def test_flashmask_attention_causal_startrows():
+    """1-column LT variant vs a dense-mask oracle."""
+    paddle.seed(1)
+    B, S, H, D = 1, 8, 2, 8
+    q = paddle.randn([B, S, H, D])
+    # column j masked for rows >= start_j
+    starts = np.full((B, H, S, 1), S, np.int32)
+    starts[..., 4:, 0] = 5          # columns 4..7 masked from row 5 on
+    out = F.flashmask_attention(q, q, q, paddle.to_tensor(starts),
+                                causal=True)
+    assert out.shape == [B, S, H, D]
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_layers_construct_and_forward():
+    x = paddle.randn([2, 3, 4, 4])
+    assert nn.Softmax2D()(x).shape == [2, 3, 4, 4]
+    np.testing.assert_allclose(
+        nn.Softmax2D()(x).numpy().sum(1), 1.0, rtol=1e-4)
+
+    pd = nn.ParameterDict({"a": paddle.create_parameter([2, 2])})
+    assert len(pd) == 1 and "a" in list(pd.keys())
+    pd["b"] = paddle.create_parameter([3])
+    assert pd["b"].shape == [3]
+
+    u = nn.Unflatten(1, [2, 2])
+    assert u(paddle.randn([3, 4])).shape == [3, 2, 2]
+
+    z = nn.ZeroPad1D([1, 2])
+    assert z(paddle.randn([1, 2, 4])).shape == [1, 2, 7]
+    z3 = nn.ZeroPad3D([1, 1, 0, 0, 0, 0])
+    assert z3(paddle.randn([1, 1, 2, 2, 2])).shape == [1, 1, 2, 2, 4]
+
+    fd = nn.FeatureAlphaDropout(0.5)
+    fd.eval()
+    np.testing.assert_allclose(fd(x).numpy(), x.numpy())
+
+    fp = nn.FractionalMaxPool2D(output_size=2, random_u=0.5)
+    assert fp(x).shape == [2, 3, 2, 2]
+
+
+def test_beam_search_decode():
+    """Beam decode over a deterministic cell must return the argmax path."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Tensor
+
+    V = 5
+
+    class Cell:
+        def __call__(self, tok, state):
+            # logits prefer token (prev + 1) % V; state counts steps
+            arr = tok._data if isinstance(tok, Tensor) else jnp.asarray(tok)
+            nxt = (arr + 1) % V
+            logits = jnp.full((arr.shape[0], V), -5.0)
+            logits = logits.at[jnp.arange(arr.shape[0]), nxt].set(5.0)
+            return Tensor(logits), [Tensor(state[0]._data + 1)]
+
+    dec = nn.BeamSearchDecoder(Cell(), start_token=0, end_token=4,
+                               beam_size=2)
+    seqs, state = nn.dynamic_decode(
+        dec, inits=[Tensor(jnp.zeros((1, 1)))], max_step_num=6)
+    best = seqs.numpy()[:, 0, 0]
+    np.testing.assert_array_equal(best[:4], [1, 2, 3, 4])
+    assert (best[4:] == 4).all()     # frozen at end_token afterwards
