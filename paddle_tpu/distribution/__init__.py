@@ -13,6 +13,7 @@ from .transform import (  # noqa: F401
     PowerTransform, SigmoidTransform, TanhTransform, Transform,
     TransformedDistribution,
 )
+from .extra import ContinuousBernoulli, Independent, LKJCholesky  # noqa: F401,E402
 from .kl import kl_divergence, register_kl  # noqa: F401
 
 __all__ = [
@@ -23,4 +24,5 @@ __all__ = [
     "AffineTransform", "ExpTransform", "PowerTransform", "SigmoidTransform",
     "TanhTransform", "AbsTransform", "ChainTransform",
     "TransformedDistribution", "kl_divergence", "register_kl",
+    "ContinuousBernoulli", "Independent", "LKJCholesky",
 ]

@@ -1,0 +1,204 @@
+"""Remaining distributions (reference python/paddle/distribution/
+{continuous_bernoulli,independent,lkj_cholesky}.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random_state
+from ..core.tensor import Tensor
+from .distribution import Distribution, _t
+
+__all__ = ["ContinuousBernoulli", "Independent", "LKJCholesky"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class ContinuousBernoulli(Distribution):
+    """Continuous relaxation of Bernoulli on (0, 1) with parameter
+    `probs` (reference continuous_bernoulli.py; Loaiza-Ganem & Cunningham
+    2019).  log C(p) is the normalizing constant, evaluated with the
+    Taylor-safe branch near p=0.5 like the reference."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _t(probs)
+        self._lims = lims
+        super().__init__(tuple(self.probs.shape))
+
+    def _cut(self):
+        p = _arr(self.probs).astype(jnp.float32)
+        return jnp.clip(p, 1e-6, 1 - 1e-6)
+
+    def _log_const(self):
+        p = self._cut()
+        lo, hi = self._lims
+        safe = jnp.where((p < lo) | (p > hi), p, 0.25)
+        out = jnp.log(
+            (jnp.log1p(-safe) - jnp.log(safe))
+            / (1.0 - 2.0 * safe))
+        # 2nd-order Taylor expansion around 0.5 inside the cut
+        x = p - 0.5
+        taylor = jnp.log(2.0) + (4.0 / 3.0 + 104.0 / 45.0 * x * x) * x * x
+        return jnp.where((p < lo) | (p > hi), out, taylor)
+
+    @property
+    def mean(self):
+        p = self._cut()
+        lo, hi = self._lims
+        outside = p / (2.0 * p - 1.0) + 1.0 / (
+            2.0 * jnp.arctanh(1.0 - 2.0 * p))
+        x = p - 0.5
+        taylor = 0.5 + (1.0 / 3.0 + 16.0 / 45.0 * x * x) * x
+        return Tensor(jnp.where((p < lo) | (p > hi), outside, taylor))
+
+    @property
+    def variance(self):
+        p = self._cut()
+        lo, hi = self._lims
+        outside = p * (p - 1.0) / (1.0 - 2.0 * p) ** 2 + 1.0 / (
+            2.0 * jnp.arctanh(1.0 - 2.0 * p)) ** 2
+        x = p - 0.5
+        taylor = 1.0 / 12.0 - (1.0 / 15.0 - 128.0 / 945.0 * x * x) * x * x
+        return Tensor(jnp.where((p < lo) | (p > hi), outside, taylor))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        p = self._cut()
+        key = random_state.next_key()
+        u = jax.random.uniform(key, tuple(shape) + p.shape,
+                               minval=1e-6, maxval=1.0 - 1e-6)
+        # inverse CDF (reference icdf): off the central cut
+        icdf = jnp.where(
+            jnp.abs(p - 0.5) < 1e-4, u,
+            jnp.log1p(u * ((2.0 * p - 1.0) / (1.0 - p)))
+            / (jnp.log(p) - jnp.log1p(-p)))
+        return Tensor(jnp.clip(icdf, 0.0, 1.0))
+
+    def log_prob(self, value):
+        v = _arr(value).astype(jnp.float32)
+        p = self._cut()
+        return Tensor(v * jnp.log(p) + (1.0 - v) * jnp.log1p(-p)
+                      + self._log_const())
+
+    def prob(self, value):
+        return Tensor(jnp.exp(_arr(self.log_prob(value))))
+
+    def entropy(self):
+        # E[-log p(X)] = -(log C + mean*log p + (1-mean)*log(1-p))
+        p = self._cut()
+        m = _arr(self.mean)
+        return Tensor(-(self._log_const() + m * jnp.log(p)
+                        + (1.0 - m) * jnp.log1p(-p)))
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims of a base distribution as event dims
+    (reference independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_ndims=1):
+        self.base = base
+        self.reinterpreted_batch_ndims = int(reinterpreted_batch_ndims)
+        n = self.reinterpreted_batch_ndims
+        bshape = tuple(base.batch_shape)
+        if n > len(bshape):
+            raise ValueError(
+                f"reinterpreted_batch_ndims={n} exceeds base batch rank "
+                f"{len(bshape)}")
+        super().__init__(bshape[:len(bshape) - n],
+                         bshape[len(bshape) - n:] + tuple(base.event_shape))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = _arr(self.base.log_prob(value))
+        axes = tuple(range(lp.ndim - self.reinterpreted_batch_ndims,
+                           lp.ndim))
+        return Tensor(jnp.sum(lp, axis=axes) if axes else lp)
+
+    def entropy(self):
+        ent = _arr(self.base.entropy())
+        axes = tuple(range(ent.ndim - self.reinterpreted_batch_ndims,
+                           ent.ndim))
+        return Tensor(jnp.sum(ent, axis=axes) if axes else ent)
+
+
+class LKJCholesky(Distribution):
+    """LKJ prior over Cholesky factors of correlation matrices (reference
+    lkj_cholesky.py; onion-method sampling)."""
+
+    def __init__(self, dim=2, concentration=1.0,
+                 sample_method="onion", name=None):
+        if dim < 2:
+            raise ValueError(f"dim must be >= 2, got {dim}")
+        self.dim = int(dim)
+        self.concentration = _t(float(concentration)
+                                if not hasattr(concentration, "shape")
+                                else concentration)
+        self.sample_method = sample_method
+        super().__init__(tuple(self.concentration.shape),
+                         (self.dim, self.dim))
+
+    def sample(self, shape=()):
+        d = self.dim
+        eta = float(np.asarray(_arr(self.concentration)).reshape(-1)[0])
+        key = random_state.next_key()
+        n = int(np.prod(shape)) if shape else 1
+        keys = jax.random.split(key, n)
+
+        def one(k):
+            # onion method: build row by row
+            k1, k2 = jax.random.split(k)
+            L = jnp.zeros((d, d))
+            L = L.at[0, 0].set(1.0)
+            betas = eta + (d - 2 - jnp.arange(d - 1)) / 2.0
+            for i in range(1, d):
+                ki = jax.random.fold_in(k1, i)
+                ka, kb = jax.random.split(ki)
+                # y ~ Beta(i/2, beta_i) controls the row norm
+                y = jax.random.beta(ka, i / 2.0, betas[i - 1])
+                u = jax.random.normal(kb, (i,))
+                u = u / jnp.linalg.norm(u)
+                w = jnp.sqrt(y) * u
+                L = L.at[i, :i].set(w)
+                L = L.at[i, i].set(jnp.sqrt(jnp.clip(1.0 - y, 1e-12)))
+            return L
+
+        out = jnp.stack([one(k) for k in keys])
+        if shape:
+            out = out.reshape(tuple(shape) + (d, d))
+        else:
+            out = out[0]
+        return Tensor(out)
+
+    def log_prob(self, value):
+        L = _arr(value).astype(jnp.float32)
+        d = self.dim
+        eta = _arr(self.concentration).astype(jnp.float32)
+        diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+        orders = (d - 2.0 - jnp.arange(d - 1)) + 2.0 * (eta - 1.0)
+        unnorm = jnp.sum(orders * jnp.log(diag), axis=-1)
+        # normalizer (reference lkj_cholesky.py log_normalizer)
+        alpha = eta + 0.5 * (d - 1.0)
+        lognorm = 0.0
+        for i in range(1, d):
+            lognorm = lognorm + 0.5 * i * jnp.log(jnp.pi) \
+                + jax.scipy.special.gammaln(alpha - 0.5 * i) \
+                - jax.scipy.special.gammaln(alpha)
+        return Tensor(unnorm - lognorm)

@@ -189,3 +189,52 @@ def test_kl_registry_custom():
 
     assert float(dist.kl_divergence(MyDist(0., 1.), MyDist(0., 1.)).numpy()) \
         == 42.0
+
+
+def test_continuous_bernoulli():
+    from paddle_tpu.distribution import ContinuousBernoulli
+
+    cb = ContinuousBernoulli(0.3)
+    paddle.seed(0)
+    s = cb.sample([4000]).numpy()
+    assert ((s >= 0) & (s <= 1)).all()
+    np.testing.assert_allclose(s.mean(), float(cb.mean.numpy()), atol=0.02)
+    np.testing.assert_allclose(s.var(), float(cb.variance.numpy()),
+                               atol=0.02)
+    # log_prob integrates to ~1 over (0,1)
+    xs = np.linspace(1e-3, 1 - 1e-3, 2001).astype(np.float32)
+    lp = cb.log_prob(paddle.to_tensor(xs)).numpy()
+    integral = np.trapezoid(np.exp(lp), xs)
+    np.testing.assert_allclose(integral, 1.0, rtol=5e-3)  # edge truncation
+    # near-0.5 Taylor branch stays finite
+    cb2 = ContinuousBernoulli(0.5)
+    assert np.isfinite(cb2.log_prob(paddle.to_tensor(0.4)).numpy())
+
+
+def test_independent_sums_event_dims():
+    from paddle_tpu.distribution import Independent, Normal
+
+    base = Normal(np.zeros((4, 3), np.float32), np.ones((4, 3), np.float32))
+    ind = Independent(base, 1)
+    assert ind.event_shape == (3,) and ind.batch_shape == (4,)
+    v = paddle.to_tensor(np.zeros((4, 3), np.float32))
+    lp = ind.log_prob(v)
+    assert tuple(lp.shape) == (4,)
+    np.testing.assert_allclose(lp.numpy(),
+                               base.log_prob(v).numpy().sum(-1), rtol=1e-5)
+    assert tuple(ind.entropy().shape) == (4,)
+
+
+def test_lkj_cholesky():
+    from paddle_tpu.distribution import LKJCholesky
+
+    paddle.seed(3)
+    lkj = LKJCholesky(dim=3, concentration=2.0)
+    L = lkj.sample().numpy()
+    M = L @ L.T
+    np.testing.assert_allclose(np.diag(M), 1.0, atol=1e-5)   # correlation
+    assert (np.linalg.eigvalsh(M) > -1e-6).all()             # PSD
+    assert np.tril(L, -1).shape == (3, 3)
+    assert np.isfinite(lkj.log_prob(paddle.to_tensor(L)).numpy())
+    batch = lkj.sample([5])
+    assert tuple(batch.shape) == (5, 3, 3)
