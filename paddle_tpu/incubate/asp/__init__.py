@@ -19,7 +19,6 @@ __all__ = ["calculate_density", "check_mask_1d", "get_mask_1d",
            "set_excluded_layers", "reset_excluded_layers"]
 
 _excluded: set = set()
-_masks: dict = {}            # id(param) -> (param_ref, jnp mask)
 
 
 def calculate_density(x) -> float:
@@ -70,39 +69,44 @@ def reset_excluded_layers(main_program=None):
     _excluded.clear()
 
 
-def _prunable(name, p):
+def _prunable(name, p, m):
     if name in _excluded:
         return False
-    return len(p.shape) == 2 and p.shape[-1] % 4 == 0
+    return len(p.shape) == 2 and p.shape[-1] % m == 0
 
 
 def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
-    """Apply n:m masks to every prunable weight in place; masks are
-    remembered so decorate()-wrapped optimizers re-apply them after each
-    step (reference asp.py:319 prune_model + ASPHelper mask variables)."""
+    """Apply n:m masks to every prunable weight in place; each mask is
+    remembered ON ITS PARAMETER so decorate()-wrapped optimizers re-apply
+    it after updates (reference asp.py:319 prune_model + ASPHelper mask
+    variables).  Param-local storage means masks die with the model — no
+    process-global registry to leak across models."""
     import jax.numpy as jnp
 
     pruned = {}
     for name, p in model.named_parameters():
-        if not _prunable(name, p):
+        if not _prunable(name, p, m):
             continue
         mask = jnp.asarray(get_mask_1d(p, n, m), p._data.dtype)
         p._data = p._data * mask
         if with_mask:
-            _masks[id(p)] = (p, mask)
+            p._asp_mask = mask
         pruned[name] = calculate_density(p)
     return pruned
 
 
 def decorate(optimizer):
     """Wrap optimizer.step so pruned weights stay pruned through updates
-    (reference asp.py:233 OptimizerWithSparsityGuarantee)."""
+    (reference asp.py:233 OptimizerWithSparsityGuarantee).  Masks are read
+    from the optimizer's OWN parameter list at each step."""
     inner_step = optimizer.step
 
     def step_with_masks(*args, **kwargs):
         out = inner_step(*args, **kwargs)
-        for p, mask in list(_masks.values()):
-            p._data = p._data * mask
+        for p in (optimizer._parameter_list or []):
+            mask = getattr(p, "_asp_mask", None)
+            if mask is not None:
+                p._data = p._data * mask
         return out
 
     optimizer.step = step_with_masks

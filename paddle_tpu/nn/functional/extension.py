@@ -70,7 +70,7 @@ def leaky_relu_(x, negative_slope=0.01, name=None):
 
 def thresholded_relu_(x, threshold=1.0, value=0.0, name=None):
     from .activation import thresholded_relu
-    return _inplace(thresholded_relu)(x, threshold)
+    return _inplace(thresholded_relu)(x, threshold, value)
 
 
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):
@@ -374,21 +374,17 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
     S = query.shape[1]
     rows = jnp.arange(S)[:, None]        # mask rows (query positions)
     if idx.shape[-1] == 1:
-        start = idx[..., 0]
-        if causal:
-            # masked when row >= start[col]
-            masked = rows[None, None] >= start[:, :, None, :]
-        else:
-            masked = rows[None, None] >= start[:, :, None, :]
+        if not causal:
+            raise ValueError(
+                "flashmask_attention: the 1-column (LT-start) layout is "
+                "causal-only in the reference; pass causal=True or use the "
+                "2/4-column layouts")
+        # masked when row >= start[col]
+        masked = rows[None, None] >= idx[..., 0][:, :, None, :]
     elif idx.shape[-1] == 2:
-        if causal:
-            start, end = idx[..., 0], idx[..., 1]
-            masked = (rows[None, None] >= start[:, :, None, :]) & \
-                     (rows[None, None] < end[:, :, None, :])
-        else:
-            start, end = idx[..., 0], idx[..., 1]
-            masked = (rows[None, None] >= start[:, :, None, :]) & \
-                     (rows[None, None] < end[:, :, None, :])
+        start, end = idx[..., 0], idx[..., 1]
+        masked = (rows[None, None] >= start[:, :, None, :]) & \
+                 (rows[None, None] < end[:, :, None, :])
     else:
         ls, le, us, ue = (idx[..., i] for i in range(4))
         masked = ((rows[None, None] >= ls[:, :, None, :]) &

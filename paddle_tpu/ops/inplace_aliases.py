@@ -193,12 +193,6 @@ def extra_ops():
             raise ValueError(f"shape mismatch: got {got}, expected {want}")
         return True
 
-    def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
-        def impl(inp, a, b, beta, alpha):
-            return beta * inp + alpha * (a @ b)
-        return D.apply("addmm", impl, (input, x, y),
-                       {"beta": float(beta), "alpha": float(alpha)})
-
     return {k: v for k, v in locals().items()
             if callable(v) and not k.startswith("_")}
 

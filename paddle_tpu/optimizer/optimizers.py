@@ -328,6 +328,18 @@ class NAdam(Optimizer):
             jnp.sqrt(v_hat) + eps)
         return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
 
+    # the running mu-product is host state the slot system does not carry;
+    # without it a checkpoint resume would recompute wrong bias corrections
+    def state_dict(self):
+        out = super().state_dict()
+        out["mu_product"] = self._mu_product
+        return out
+
+    def set_state_dict(self, state_dict):
+        super().set_state_dict(state_dict)
+        self._mu_product = float(state_dict.get("mu_product", 1.0))
+        self._mu_step = self._global_step
+
 
 class RAdam(Optimizer):
     """Rectified Adam (reference python/paddle/optimizer/radam.py): the
@@ -379,8 +391,8 @@ class RAdam(Optimizer):
 
 class ASGD(Optimizer):
     """Averaged SGD over the last `batch_num` gradients (reference
-    python/paddle/optimizer/asgd.py: d <- d - y + g; y <- g;
-    p <- p - lr/n * d)."""
+    python/paddle/optimizer/asgd.py: d <- d - ys[i] + g; ys[i] <- g;
+    p <- p - lr/n * d, with ys an n-slot gradient ring)."""
 
     def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
                  weight_decay=None, grad_clip=None, multi_precision=False,
@@ -390,16 +402,30 @@ class ASGD(Optimizer):
         self._batch_num = max(1, int(batch_num))
 
     def _slot_names(self):
-        return ("d", "y")
+        return ("d", "ys")
 
     def _init_slot(self, name, p):
+        if name == "ys":
+            return jnp.zeros((self._batch_num,) + tuple(p._data.shape),
+                             jnp.float32)
         return jnp.zeros(p._data.shape, jnp.float32)
 
+    def _extra_args(self):
+        # ring index of the gradient being replaced this step
+        return (jnp.asarray((self._global_step - 1) % self._batch_num,
+                            jnp.int32),)
+
     def _update_arrays(self, p, g, state, lr, param_lr, wd, extra):
+        import jax as _jax
+        (idx,) = extra
         gf = _wd_grad(p, g.astype(jnp.float32), wd)
-        d = state["d"] - state["y"] + gf
+        old = _jax.lax.dynamic_index_in_dim(state["ys"], idx, axis=0,
+                                            keepdims=False)
+        d = state["d"] - old + gf
+        ys = _jax.lax.dynamic_update_index_in_dim(state["ys"], gf, idx,
+                                                  axis=0)
         new_p = p.astype(jnp.float32) - lr * param_lr * d / self._batch_num
-        return new_p.astype(p.dtype), {"d": d, "y": gf}
+        return new_p.astype(p.dtype), {"d": d, "ys": ys}
 
 
 class Rprop(Optimizer):

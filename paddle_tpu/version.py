@@ -28,7 +28,18 @@ def _git_commit() -> str:
     return "unknown"
 
 
-commit = _git_commit()
+_commit_cache = None
+
+
+def __getattr__(name):
+    # lazy: resolving the commit forks a git subprocess — do it on first
+    # access, not at `import paddle_tpu` (which every worker process pays)
+    if name == "commit":
+        global _commit_cache
+        if _commit_cache is None:
+            _commit_cache = _git_commit()
+        return _commit_cache
+    raise AttributeError(name)
 
 
 def cuda():
@@ -64,5 +75,5 @@ def show() -> None:
     print(f"minor: {minor}")
     print(f"patch: {patch}")
     print(f"rc: {rc}")
-    print(f"commit: {commit}")
+    print(f"commit: {__getattr__('commit')}")
     print(f"tpu: {tpu()}")
