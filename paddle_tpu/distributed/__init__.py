@@ -39,3 +39,77 @@ from . import rpc  # noqa: E402,F401
 from . import sharding  # noqa: E402,F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: E402,F401
 from .fleet.layers.mpu.mp_ops import split  # noqa: E402,F401
+from . import launch  # noqa: E402,F401
+from .auto_parallel.api import (  # noqa: E402,F401
+    DistAttr, Placement, unshard_dtensor,
+)
+from .auto_parallel.api import to_static  # noqa: E402,F401
+from .auto_parallel.process_mesh import get_mesh, set_mesh  # noqa: E402,F401
+from .extras import (  # noqa: E402,F401
+    CountFilterEntry, InMemoryDataset, LocalLayer, ParallelMode,
+    ProbabilityEntry, QueueDataset, ReduceType, ShardingStage1,
+    ShardingStage2, ShardingStage3, ShowClickEntry, alltoall_single,
+    broadcast_object_list, gather, get_backend, gloo_barrier,
+    gloo_init_parallel_env, gloo_release, is_available, scatter_object_list,
+    shard_dataloader, shard_scaler, to_distributed, wait,
+)
+from .intermediate import (  # noqa: E402,F401
+    ColWiseParallel, PrepareLayerInput, PrepareLayerOutput, RowWiseParallel,
+    SequenceParallelBegin, SequenceParallelDisable, SequenceParallelEnable,
+    SequenceParallelEnd, SplitPoint, parallelize,
+)
+
+
+class Strategy:
+    """Distributed strategy bag (reference auto_parallel/strategy.py):
+    attribute sections created on access, dict-like configuration."""
+
+    class _Section:
+        def __init__(self):
+            self.enable = False
+
+        def __setattr__(self, k, v):
+            object.__setattr__(self, k, v)
+
+    def __init__(self, config=None):
+        for sec in ("sharding", "gradient_merge", "pipeline", "amp",
+                    "recompute", "mp_optimization", "dp_optimization",
+                    "fused_passes"):
+            setattr(self, sec, Strategy._Section())
+        for k, v in (config or {}).items():
+            section = getattr(self, k, None)
+            if section is not None and isinstance(v, dict):
+                for kk, vv in v.items():
+                    setattr(section, kk, vv)
+
+
+class DistModel:
+    """Callable returned by the distributed to_static path (reference
+    auto_parallel/api.py DistModel): train()/eval()/predict() mode flips
+    over one captured program."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None):
+        self._layer = layer
+        self._loss = loss
+        self._optimizer = optimizer
+        self._mode = "train"
+        self._program = to_static(layer, loader, loss, optimizer, strategy)
+
+    def train(self):
+        self._mode = "train"
+        self._layer.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self._layer.eval()
+
+    def predict(self):
+        self._mode = "predict"
+        self._layer.eval()
+
+    def __call__(self, *args, **kwargs):
+        return self._program(*args, **kwargs)
+
+
+from . import io_utils as io  # noqa: E402,F401

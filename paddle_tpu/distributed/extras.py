@@ -1,0 +1,315 @@
+"""Remaining distributed surface (reference python/paddle/distributed/
+__init__.py __all__ rows not covered by the core modules): object
+collectives, backend queries, sharding-stage markers, the intermediate
+parallelize() plan API, dataloader/scaler sharding helpers, gloo shims,
+and the PS-era dataset classes (declared out of scope — SURVEY §7.4 —
+surfaced as guided stubs).
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "gather", "alltoall_single", "broadcast_object_list",
+    "scatter_object_list", "wait", "get_backend", "is_available",
+    "ParallelMode", "ReduceType", "Placement", "DistAttr",
+    "ShardingStage1", "ShardingStage2", "ShardingStage3", "shard_dataloader",
+    "shard_scaler", "LocalLayer", "to_distributed", "gloo_init_parallel_env",
+    "gloo_barrier", "gloo_release", "QueueDataset", "InMemoryDataset",
+    "CountFilterEntry", "ShowClickEntry", "ProbabilityEntry",
+]
+
+
+# --- small collectives -----------------------------------------------------
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Gather to dst (reference communication/gather.py) — implemented as
+    all_gather with non-dst ranks discarding (one XLA collective either
+    way; ICI makes the extra traffic negligible next to a real gather's
+    synchronization)."""
+    from .collective import all_gather
+    from .parallel import get_rank
+    tmp: list = []
+    all_gather(tmp, tensor, group=group)
+    if get_rank() == dst and gather_list is not None:
+        gather_list.clear()
+        gather_list.extend(tmp)
+    return gather_list
+
+
+def alltoall_single(in_tensor, out_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """Single-tensor all-to-all (reference communication/all_to_all.py
+    alltoall_single): even split over ranks."""
+    from .collective import alltoall
+    from .parallel import get_world_size
+    n = get_world_size(group)
+    if in_split_sizes or out_split_sizes:
+        raise NotImplementedError(
+            "alltoall_single with uneven split sizes is not implemented; "
+            "pad to even splits or use alltoall with explicit lists")
+    ins = [in_tensor[i * (in_tensor.shape[0] // n):
+                     (i + 1) * (in_tensor.shape[0] // n)] for i in range(n)]
+    outs: list = []
+    alltoall(outs, ins, group=group)
+    from ..ops.manipulation import concat
+    res = concat(outs, axis=0)
+    out_tensor._data = res._data
+    return out_tensor
+
+
+def _obj_to_tensor(obj):
+    data = np.frombuffer(pickle.dumps(obj, protocol=4), np.uint8).copy()
+    return Tensor(jnp.asarray(data)), len(data)
+
+
+def _tensor_to_obj(t, n):
+    return pickle.loads(np.asarray(t.numpy()[:n]).tobytes())
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Broadcast picklable objects (reference communication/
+    broadcast.py broadcast_object_list): lengths first, then one padded
+    byte tensor."""
+    from .collective import broadcast
+    from .parallel import get_rank
+    rank = get_rank()
+    if rank == src:
+        blobs = [_obj_to_tensor(o) for o in object_list]
+        lens = Tensor(jnp.asarray([n for _, n in blobs], jnp.int32))
+    else:
+        lens = Tensor(jnp.zeros((len(object_list),), jnp.int32))
+    broadcast(lens, src=src, group=group)
+    sizes = [int(v) for v in lens.numpy()]
+    for i, n in enumerate(sizes):
+        if rank == src:
+            buf = blobs[i][0]
+        else:
+            buf = Tensor(jnp.zeros((n,), jnp.uint8))
+        broadcast(buf, src=src, group=group)
+        object_list[i] = _tensor_to_obj(buf, n)
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Scatter one object per rank (reference communication/scatter.py
+    scatter_object_list) — broadcast all + local pick (object payloads are
+    control-plane small)."""
+    from .parallel import get_rank, get_world_size
+    n = get_world_size(group)
+    objs = list(in_object_list or [None] * n)
+    broadcast_object_list(objs, src=src, group=group)
+    out_object_list.clear()
+    out_object_list.append(objs[get_rank() % len(objs)])
+    return out_object_list
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Block until async work on tensor completes (reference
+    communication/wait.py) — XLA arrays are futures; block on readiness."""
+    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    try:
+        arr.block_until_ready()
+    except Exception:
+        pass
+    return tensor
+
+
+def get_backend(group=None) -> str:
+    """Communication backend name (reference collective.py get_backend —
+    'NCCL'/'GLOO'; here collectives compile into XLA over ICI/DCN)."""
+    return "XLA"
+
+
+def is_available() -> bool:
+    """(reference parallel.py is_available)"""
+    return True
+
+
+# --- enums / markers -------------------------------------------------------
+
+class ParallelMode:
+    """(reference parallel.py ParallelMode ints)"""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class ReduceType:
+    """(reference auto_parallel ReduceType)"""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class _ShardingStage:
+    stage: int = 0
+
+    def __init__(self, axis_name="dp", mesh=None):
+        self.axis_name = axis_name
+        self.mesh = mesh
+
+
+class ShardingStage1(_ShardingStage):
+    """ZeRO-1 marker for parallelize()/strategy configs (reference
+    auto_parallel ShardingStage1)."""
+    stage = 1
+
+
+class ShardingStage2(_ShardingStage):
+    stage = 2
+
+
+class ShardingStage3(_ShardingStage):
+    stage = 3
+
+
+# --- helpers over the user stack ------------------------------------------
+
+def shard_dataloader(dataloader, meshes, shard_dims=None, is_dataset_splitted=False,
+                     dense_tensor_idx=None):
+    """Wrap a DataLoader so every yielded batch is device-put with a
+    batch-dim sharding over the mesh (reference auto_parallel/api.py
+    shard_dataloader)."""
+    from .auto_parallel.api import shard_tensor
+    from .auto_parallel.process_mesh import ProcessMesh
+
+    mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+    dim = shard_dims if isinstance(shard_dims, (str, int)) or shard_dims is None \
+        else shard_dims[0]
+    if dim is None:
+        dim = mesh.dim_names[0]
+
+    from .auto_parallel.api import Replicate, Shard
+
+    axis = mesh.dim_names.index(dim) if isinstance(dim, str) else int(dim)
+
+    class _Sharded:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __iter__(self):
+            placements = [Replicate()] * len(mesh.shape)
+            placements[axis] = Shard(0)
+            for batch in self._inner:
+                if isinstance(batch, (list, tuple)):
+                    yield type(batch)(
+                        shard_tensor(b, mesh, placements) for b in batch)
+                else:
+                    yield shard_tensor(batch, mesh, placements)
+
+        def __len__(self):
+            return len(self._inner)
+
+    return _Sharded(dataloader)
+
+
+def shard_scaler(scaler):
+    """Make a GradScaler correct under sharding (reference api.py
+    shard_scaler).  The found_inf reduction here is already a global
+    device reduction under GSPMD, so the scaler is returned as-is."""
+    return scaler
+
+
+class LocalLayer:
+    """Marker base: keep this layer's params replicated during
+    parallelize() (reference auto_parallel LocalLayer)."""
+
+    pass
+
+
+def to_distributed(model, optimizer=None, dataloader=None, device_num=None,
+                   node_num=1, config=None):
+    """Experimental one-call conversion (reference incubate
+    to_distributed): plan placements with the auto-parallel planner and
+    apply them over the default mesh."""
+    import jax as _jax
+
+    from .auto_parallel.planner import apply_plan, plan_layer
+    from .auto_parallel.process_mesh import ProcessMesh
+
+    n = device_num or len(_jax.devices())
+    mesh = ProcessMesh(np.arange(n).reshape(1, n), dim_names=["dp", "mp"])
+    plan = plan_layer(model, mesh, mesh_dim="mp")
+    apply_plan(model, mesh, plan)
+    out = (model,)
+    if optimizer is not None:
+        out += (optimizer,)
+    if dataloader is not None:
+        out += (shard_dataloader(dataloader, mesh, "dp"),)
+    return out if len(out) > 1 else model
+
+
+# --- host-barrier (gloo) shims --------------------------------------------
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Host control-plane group (reference parallel_with_gloo.py) — the
+    TCPStore is this build's gloo: connect and barrier."""
+    from .store import TCPStore, barrier_via_store
+    host, port = server_endpoint.rsplit(":", 1)
+    store = TCPStore(host, int(port), is_master=(rank_id == 0),
+                     timeout=90.0)
+    barrier_via_store(store, "gloo_init", rank_id, rank_num)
+    global _gloo_store, _gloo_rank, _gloo_num
+    _gloo_store, _gloo_rank, _gloo_num = store, rank_id, rank_num
+
+
+_gloo_store = None
+_gloo_rank = 0
+_gloo_num = 1
+
+
+def gloo_barrier():
+    if _gloo_store is None:
+        raise RuntimeError("call gloo_init_parallel_env first")
+    from .store import barrier_via_store
+    barrier_via_store(_gloo_store, "gloo_barrier", _gloo_rank, _gloo_num)
+
+
+def gloo_release():
+    global _gloo_store
+    _gloo_store = None
+
+
+# --- PS-era datasets: out of scope (SURVEY §7.4), guided stubs -------------
+
+_PS_MSG = ("the parameter-server data pipeline ({name}) is outside this "
+           "TPU-native build's scope (SURVEY §7.4: brpc/rocksdb rec-sys "
+           "era); use paddle_tpu.io.DataLoader/Dataset")
+
+
+class QueueDataset:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_PS_MSG.format(name="QueueDataset"))
+
+
+class InMemoryDataset:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_PS_MSG.format(name="InMemoryDataset"))
+
+
+class CountFilterEntry:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_PS_MSG.format(name="CountFilterEntry"))
+
+
+class ShowClickEntry:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_PS_MSG.format(name="ShowClickEntry"))
+
+
+class ProbabilityEntry:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_PS_MSG.format(name="ProbabilityEntry"))

@@ -1,0 +1,155 @@
+"""Intermediate-level parallelize API (reference python/paddle/distributed/
+auto_parallel/intermediate/{parallelize,tensor_parallel,pipeline_parallel}
+.py): users name layers and attach plan objects; the engine applies
+placements.
+
+TPU-native: applying a plan = sharding the named layer's parameters over
+the mesh (GSPMD propagates through the compute); sequence-parallel region
+markers are accepted and recorded — under XLA the activation sharding is
+derived by propagation, so the markers only document intent.
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+__all__ = ["parallelize", "ColWiseParallel", "RowWiseParallel",
+           "SequenceParallelBegin", "SequenceParallelEnd",
+           "SequenceParallelEnable", "SequenceParallelDisable",
+           "PrepareLayerInput", "PrepareLayerOutput", "SplitPoint"]
+
+
+class _Plan:
+    def apply(self, layer, mesh, axis):
+        return None
+
+
+class ColWiseParallel(_Plan):
+    """Shard the layer weight's OUT dim (Megatron column parallel;
+    reference tensor_parallel.py ColWiseParallel)."""
+
+    def __init__(self, gather_output=False):
+        self.gather_output = gather_output
+
+    def apply(self, layer, mesh, axis):
+        from .auto_parallel.api import Replicate, Shard, shard_tensor
+        for name, p in layer.named_parameters(include_sublayers=False):
+            placements = [Replicate()] * len(mesh.shape)
+            placements[axis] = Shard(len(p.shape) - 1)
+            sharded = shard_tensor(p, mesh, placements)
+            p._data = sharded._data
+
+
+class RowWiseParallel(_Plan):
+    """Shard the layer weight's IN dim (Megatron row parallel)."""
+
+    def __init__(self, is_input_parallel=True):
+        self.is_input_parallel = is_input_parallel
+
+    def apply(self, layer, mesh, axis):
+        from .auto_parallel.api import Replicate, Shard, shard_tensor
+        for name, p in layer.named_parameters(include_sublayers=False):
+            placements = [Replicate()] * len(mesh.shape)
+            if len(p.shape) >= 2:
+                placements[axis] = Shard(0)
+                sharded = shard_tensor(p, mesh, placements)
+                p._data = sharded._data
+            # 1-D bias stays replicated (added after the row reduce)
+
+
+class _SPMarker(_Plan):
+    pass
+
+
+class SequenceParallelBegin(_SPMarker):
+    pass
+
+
+class SequenceParallelEnd(_SPMarker):
+    pass
+
+
+class SequenceParallelEnable(_SPMarker):
+    pass
+
+
+class SequenceParallelDisable(_SPMarker):
+    def __init__(self, need_transpose=True):
+        self.need_transpose = need_transpose
+
+
+class PrepareLayerInput(_Plan):
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def apply(self, layer, mesh, axis):
+        if self.fn is not None:
+            layer.register_forward_pre_hook(
+                lambda lyr, inputs: self.fn(process_mesh=mesh)(lyr, inputs))
+
+
+class PrepareLayerOutput(_Plan):
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def apply(self, layer, mesh, axis):
+        if self.fn is not None:
+            layer.register_forward_post_hook(
+                lambda lyr, inputs, outputs:
+                self.fn(process_mesh=mesh)(lyr, inputs, outputs))
+
+
+class SplitPoint:
+    """Pipeline split markers (reference pipeline_parallel.py SplitPoint)."""
+    BEGINNING = "beginning"
+    END = "end"
+
+
+def _match_layers(model, pattern):
+    """Name-glob over sublayers (reference parallelize name matching:
+    `llama.layers.*.self_attn.q_proj` style)."""
+    regex = re.compile("^" + re.escape(pattern).replace(r"\*", r"[^.]+")
+                       + "$")
+    out = []
+    for name, sub in model.named_sublayers():
+        if regex.match(name):
+            out.append(sub)
+    return out
+
+
+def parallelize(model, optimizer=None, mesh=None, config=None):
+    """Apply a parallelize_plan over named layers (reference
+    intermediate/parallelize.py).  config keys follow the reference:
+    {"mp_config": {"parallelize_plan": {name_glob: Plan|list}},
+     "dp_config"/"pp_config"/"sharding_config": recorded}.
+    Returns (model, optimizer).
+    """
+    from .auto_parallel.process_mesh import ProcessMesh, get_mesh
+
+    config = config or {}
+    if mesh is None:
+        mesh = get_mesh()
+    if mesh is None:
+        import jax
+        n = len(jax.devices())
+        mesh = ProcessMesh(np.arange(n).reshape(1, n),
+                           dim_names=["dp", "mp"])
+    axis = mesh.dim_names.index("mp") if "mp" in mesh.dim_names \
+        else len(mesh.shape) - 1
+
+    mp = (config.get("mp_config") or {}).get("parallelize_plan") or {}
+    for pattern, plans in mp.items():
+        plans = plans if isinstance(plans, (list, tuple)) else [plans]
+        targets = _match_layers(model, pattern)
+        if not targets and not isinstance(plans[0], _SPMarker):
+            import logging
+            logging.getLogger("paddle_tpu").warning(
+                "parallelize: no layers match %r", pattern)
+        for layer in targets:
+            for plan in plans:
+                plan.apply(layer, mesh, axis)
+    model._parallelize_config = config
+    if optimizer is not None:
+        return model, optimizer
+    return model

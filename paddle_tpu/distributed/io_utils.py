@@ -1,0 +1,40 @@
+"""distributed.io (reference python/paddle/distributed/io.py:
+save_persistables / load_persistables / is_persistable over static
+programs).  Here persistables are a Layer's parameters + buffers; rank 0
+writes, every rank can load (sharded checkpointing lives in
+distributed.checkpoint)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable"]
+
+
+def is_persistable(var) -> bool:
+    return bool(getattr(var, "persistable", False))
+
+
+def save_persistables(executor_or_layer, dirname, main_program=None,
+                      filename=None):
+    """Persist a layer's state (reference io.py save_persistables).  Only
+    rank 0 writes (replicated state is identical everywhere)."""
+    from .. import save
+    from .parallel import get_rank
+    layer = main_program if main_program is not None else executor_or_layer
+    if not hasattr(layer, "state_dict"):
+        raise TypeError("pass the Layer (this build has no static Program)")
+    if get_rank() == 0:
+        os.makedirs(dirname, exist_ok=True)
+        save(layer.state_dict(),
+             os.path.join(dirname, filename or "persistables.pdparams"))
+
+
+def load_persistables(executor_or_layer, dirname, main_program=None,
+                      filename=None):
+    from .. import load
+    layer = main_program if main_program is not None else executor_or_layer
+    if not hasattr(layer, "set_state_dict"):
+        raise TypeError("pass the Layer (this build has no static Program)")
+    layer.set_state_dict(
+        load(os.path.join(dirname, filename or "persistables.pdparams")))
+    return layer
