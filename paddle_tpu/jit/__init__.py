@@ -21,7 +21,10 @@ from ..core.tensor import Parameter, Tensor
 from ..nn.layer.layers import Layer
 
 __all__ = ["to_static", "save", "load", "ignore_module", "not_to_static",
-           "TracedFunction", "TranslatedLayer", "InputSpec"]
+           "TracedFunction", "TranslatedLayer", "InputSpec",
+           "set_code_level", "set_verbosity", "enable_to_static"]
+
+_to_static_enabled = True
 
 
 def _tree_to_arrays(obj):
@@ -97,6 +100,10 @@ class TracedFunction:
             self._compiled = jax.jit(pure)
 
     def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:
+            # enable_to_static(False): run the original eagerly (reference
+            # api.py enable_to_static contract)
+            return self._fn(*args, **kwargs)
         if self._compiled is None:
             self._build()
         a = _tree_to_arrays(args)
@@ -268,3 +275,26 @@ def load(path, **configs):
             kind, name = key.split("::", 1)
             (params if kind == "param" else buffers)[name] = z[key]
     return TranslatedLayer(exported, params, buffers)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Dy2static transformed-code logging (reference jit/api set_code_level).
+    This build traces via JAX rather than AST-transforming source, so the
+    knob maps to the capture-path log level."""
+    import logging
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if level > 0 else logging.WARNING)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """(reference jit/api set_verbosity)"""
+    import logging
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if level > 0 else logging.WARNING)
+
+
+def enable_to_static(enable=True):
+    """Globally toggle to_static capture (reference api.py enable_to_static):
+    when off, to_static-wrapped callables run eagerly."""
+    global _to_static_enabled
+    _to_static_enabled = bool(enable)

@@ -73,3 +73,34 @@ def test_to_static_layer_still_savable(tmp_path):
     paddle.jit.save(net, path, input_spec=[InputSpec([2, 8], "float32")])
     loaded = paddle.jit.load(path)
     np.testing.assert_allclose(ref.numpy(), loaded(x).numpy(), rtol=1e-6)
+
+
+def test_enable_to_static_toggle():
+    """enable_to_static(False) runs wrapped callables eagerly
+    (reference jit/api.py enable_to_static)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    calls = {"n": 0}
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            calls["n"] += 1          # python side effect: visible only eager
+            return self.fc(x)
+
+    net = paddle.jit.to_static(Net())
+    x = paddle.randn([2, 4])
+    net(x); net(x)
+    captured_calls = calls["n"]       # trace once regardless of call count
+    paddle.jit.enable_to_static(False)
+    try:
+        net(x); net(x)
+        assert calls["n"] == captured_calls + 2   # ran eagerly twice
+    finally:
+        paddle.jit.enable_to_static(True)
+    paddle.jit.set_verbosity(1)
+    paddle.jit.set_code_level(0)
