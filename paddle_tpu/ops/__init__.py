@@ -125,6 +125,81 @@ def monkey_patch_tensor():
     Tensor.uniform_ = random.uniform_
     Tensor.normal_ = random.normal_
 
+    # Bind the remaining reference Tensor-method surface from the public
+    # ops: every derived inplace twin, plus the free functions the
+    # reference also exposes as methods (tensor/__init__.py
+    # tensor_method_func rows not covered by the generated binding).
+    extra_methods = tuple(n for n in PUBLIC_OPS if n.endswith("_")) + (
+        "block_diag", "add_n", "inverse", "isin", "broadcast_shape",
+        "is_tensor", "reverse", "scatter_nd", "slice_scatter",
+        "top_p_sampling", "broadcast_tensors", "multi_dot", "frexp",
+        "trapezoid", "cumulative_trapezoid", "polar", "sigmoid",
+        "as_strided", "unfold", "diag_embed", "negative", "less",
+        "gammainc", "gammaincc", "cast", "mv", "matrix_transpose",
+        "multiplex", "multigammaln", "histogram_bin_edges", "histogramdd",
+        "cond", "cholesky_inverse", "ormqr", "svd_lowrank",
+    )
+    for name in extra_methods:
+        fn = PUBLIC_OPS.get(name)
+        if fn is not None and callable(fn) and not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+
+    # signal-domain methods (reference binds stft/istft as Tensor methods)
+    def _stft(self, *a, **k):
+        from ..signal import stft as _f
+        return _f(self, *a, **k)
+
+    def _istft(self, *a, **k):
+        from ..signal import istft as _f
+        return _f(self, *a, **k)
+
+    Tensor.stft = _stft
+    Tensor.istft = _istft
+
+    # storage-management inplace ops (reference eager_method set_/resize_)
+    def _set(self, source=None, shape=None, **kw):
+        import jax.numpy as _jnp
+        if source is not None:
+            src = source._data if isinstance(source, Tensor) else \
+                _jnp.asarray(source)
+            self._data = src
+        elif shape is not None:
+            self._data = _jnp.zeros(tuple(int(s) for s in shape),
+                                    self._data.dtype)
+        return self
+
+    def _resize(self, shape, fill_zero=False):
+        import jax.numpy as _jnp
+        n_new = 1
+        for s in shape:
+            n_new *= int(s)
+        flat = self._data.reshape(-1)
+        if n_new <= flat.shape[0]:
+            out = flat[:n_new]
+        else:
+            out = _jnp.concatenate(
+                [flat, _jnp.zeros((n_new - flat.shape[0],), flat.dtype)])
+        self._data = out.reshape(tuple(int(s) for s in shape))
+        return self
+
+    Tensor.set_ = _set
+    Tensor.resize_ = _resize
+
+    # legacy factory methods the reference binds on Tensor (create_* ignore
+    # self — LayerHelper-era surface)
+    import paddle_tpu as _root
+
+    Tensor.create_parameter = staticmethod(
+        lambda *a, **k: _root.create_parameter(*a, **k))
+
+    def _create_tensor(self=None, dtype="float32", name=None,
+                       persistable=False):
+        import jax.numpy as _jnp
+        from ..core.dtype import to_jax_dtype
+        return Tensor(_jnp.zeros((0,), to_jax_dtype(dtype)))
+
+    Tensor.create_tensor = _create_tensor
+
 
 def _inplace(t, out):
     t._data = out._data

@@ -23,6 +23,8 @@ _INPLACE_BASES = [
     "copysign", "cos", "cosh", "cumprod", "cumsum", "digamma", "divide",
     "equal", "erf", "exp", "expm1", "floor", "floor_divide", "frac",
     "gammainc", "gammaincc", "gcd", "greater_equal", "greater_than",
+    "not_equal", "atanh", "lerp", "erfinv", "put_along_axis", "sigmoid",
+    "acosh", "asinh",
     "hypot", "i0", "index_add",
     "index_fill", "index_put", "lcm", "ldexp", "less_equal", "less_than",
     "lgamma", "log", "log10", "log1p", "log2", "logical_and",
@@ -95,6 +97,11 @@ def extra_ops():
 
     def _t(x):
         return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+    def sigmoid(x, name=None):
+        """(reference tensor/ops sigmoid — also a Tensor method)"""
+        from ..nn.functional.activation import sigmoid as _f
+        return _f(x)
 
     def positive(x, name=None):
         """Identity on numeric tensors (reference tensor/math.py positive)."""

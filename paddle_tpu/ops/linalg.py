@@ -19,6 +19,7 @@ __all__ = [
     "eigh", "eigvals", "eigvalsh", "matrix_power", "matrix_rank", "pinv", "det",
     "slogdet", "triangular_solve", "cross", "cov", "corrcoef", "householder_product",
     "matrix_exp", "cdist", "dist", "multi_dot", "tensordot", "pca_lowrank",
+    "cond", "cholesky_inverse", "ormqr", "svd_lowrank",
 ]
 
 
@@ -282,3 +283,78 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
         return u[..., :q], s[..., :q], jnp.swapaxes(vt, -1, -2)[..., :q]
     qv = q if q is not None else min(x.shape[-2:])
     return D.apply("pca_lowrank", _impl, (x,), {"q": int(qv), "center": bool(center)})
+
+
+def cond(x, p=None, name=None):
+    """Condition number (reference tensor/linalg.py cond): sigma_max /
+    sigma_min for p=None/2/-2, else norm(x, p) * norm(inv(x), p)."""
+    def impl(a, p):
+        af = a.astype(jnp.float32)
+        if p is None or p in (2, -2):
+            s = jnp.linalg.svd(af, compute_uv=False)
+            ratio = s[..., 0] / s[..., -1]
+            return 1.0 / ratio if p == -2 else ratio
+        return jnp.linalg.norm(af, ord=p, axis=(-2, -1)) * \
+            jnp.linalg.norm(jnp.linalg.inv(af), ord=p, axis=(-2, -1))
+
+    pk = p if p is None or isinstance(p, str) else float(p)
+    if isinstance(pk, float) and pk in (2.0, -2.0):
+        pk = int(pk)
+    return D.apply("cond", impl, (x,), {"p": pk})
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse from a Cholesky factor (reference cholesky_inverse)."""
+    def impl(L, upper):
+        Lf = L.astype(jnp.float32)
+        import jax.scipy.linalg as jsl
+        eye = jnp.eye(Lf.shape[-1], dtype=jnp.float32)
+        return jsl.cho_solve((Lf, upper), eye)
+
+    return D.apply("cholesky_inverse", impl, (x,), {"upper": bool(upper)})
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """Multiply `other` by the Q of a Householder QR given (x, tau)
+    (reference tensor/linalg.py ormqr).  Q is materialized via
+    householder_product — O(m^2 k) like the reference's LAPACK path."""
+    def impl(a, tau, y, left, transpose):
+        af = a.astype(jnp.float32)
+        tf = tau.astype(jnp.float32)
+        yf = y.astype(jnp.float32)
+        m, k = af.shape[-2], af.shape[-1]
+        Q = jnp.eye(m, dtype=jnp.float32)
+        for i in range(k):
+            v = jnp.where(jnp.arange(m) < i, 0.0, af[..., :, i])
+            v = v.at[i].set(1.0)
+            H = jnp.eye(m) - tf[..., i] * jnp.outer(v, v)
+            Q = Q @ H
+        if transpose:
+            Q = Q.T
+        return (Q @ yf) if left else (yf @ Q)
+
+    return D.apply("ormqr", impl, (x, tau, other),
+                   {"left": bool(left), "transpose": bool(transpose)})
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (reference tensor/linalg.py svd_lowrank,
+    Halko et al. subspace iteration)."""
+    def impl(a, q, niter, seed):
+        af = a.astype(jnp.float32)
+        m, n = af.shape[-2], af.shape[-1]
+        key = jax.random.PRNGKey(seed)
+        omega = jax.random.normal(key, (n, q), jnp.float32)
+        y = af @ omega
+        Q, _ = jnp.linalg.qr(y)
+        for _ in range(niter):
+            Q, _ = jnp.linalg.qr(af.T @ Q)
+            Q, _ = jnp.linalg.qr(af @ Q)
+        B = Q.T @ af
+        u_b, s, vT = jnp.linalg.svd(B, full_matrices=False)
+        return Q @ u_b, s, vT.T
+
+    import random as _r
+    return D.apply("svd_lowrank", impl, (x,),
+                   {"q": int(q), "niter": int(niter),
+                    "seed": _r.randint(0, 2 ** 31 - 1)}, num_outputs=3)

@@ -159,3 +159,60 @@ def test_as_strided():
     want = np.lib.stride_tricks.as_strided(
         np.arange(12, dtype=np.float32), (5, 4), (8, 4))
     np.testing.assert_allclose(out.numpy(), want)
+
+
+def test_tensor_method_surface_complete():
+    """Every reference tensor_method_func name is bound on Tensor."""
+    import ast
+    src = open("/root/reference/python/paddle/tensor/__init__.py").read()
+    names = []
+    for node in ast.walk(ast.parse(src)):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) == "tensor_method_func":
+                    names = [ast.literal_eval(e) for e in node.value.elts]
+    missing = [n for n in names if not hasattr(paddle.Tensor, n)]
+    assert not missing, missing
+
+
+def test_new_linalg_ops():
+    import scipy.linalg as sla
+
+    A = np.asarray([[4., 0.], [0., 2.]], np.float32)
+    np.testing.assert_allclose(
+        float(paddle.linalg.cond(paddle.to_tensor(A)).numpy()), 2.0,
+        rtol=1e-5)
+
+    L = paddle.linalg.cholesky(paddle.to_tensor(A))
+    inv = paddle.linalg.cholesky_inverse(L)
+    np.testing.assert_allclose(inv.numpy() @ A, np.eye(2), atol=1e-5)
+
+    # ormqr vs LAPACK Q
+    B = np.random.RandomState(0).rand(5, 3).astype(np.float32)
+    res = sla.qr(B, mode="raw")
+    h = np.asarray(res[0][0], np.float32)
+    tau = np.asarray(res[0][1], np.float32)
+    y = np.random.RandomState(1).rand(5, 2).astype(np.float32)
+    out = paddle.linalg.ormqr(paddle.to_tensor(h), paddle.to_tensor(tau),
+                              paddle.to_tensor(y)).numpy()
+    Q = np.linalg.qr(B, mode="complete")[0]
+    np.testing.assert_allclose(out, Q @ y, atol=1e-5)
+
+    # randomized low-rank SVD reconstructs a rank-2 matrix
+    R = np.random.RandomState(2)
+    M = (R.rand(10, 2) @ R.rand(2, 8)).astype(np.float32)
+    u, s, v = paddle.linalg.svd_lowrank(paddle.to_tensor(M), q=4)
+    recon = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+    np.testing.assert_allclose(recon, M, atol=1e-4)
+
+
+def test_set_resize_sigmoid_methods():
+    x = paddle.to_tensor(np.asarray([1., 2., 3., 4.], np.float32))
+    x.resize_([2, 3])                 # grows with zeros
+    assert x.shape == [2, 3] and x.numpy()[1, 2] == 0.0
+    x.set_(paddle.to_tensor(np.ones((2,), np.float32)))
+    np.testing.assert_allclose(x.numpy(), [1., 1.])
+    s = paddle.to_tensor(np.asarray([0.0], np.float32))
+    np.testing.assert_allclose(s.sigmoid().numpy(), [0.5])
+    s.sigmoid_()
+    np.testing.assert_allclose(s.numpy(), [0.5])
