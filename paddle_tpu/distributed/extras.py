@@ -54,6 +54,10 @@ def alltoall_single(in_tensor, out_tensor, in_split_sizes=None,
         raise NotImplementedError(
             "alltoall_single with uneven split sizes is not implemented; "
             "pad to even splits or use alltoall with explicit lists")
+    if in_tensor.shape[0] % n:
+        raise ValueError(
+            f"alltoall_single: dim 0 ({in_tensor.shape[0]}) must divide by "
+            f"world size {n}")
     ins = [in_tensor[i * (in_tensor.shape[0] // n):
                      (i + 1) * (in_tensor.shape[0] // n)] for i in range(n)]
     outs: list = []
@@ -204,7 +208,10 @@ def shard_dataloader(dataloader, meshes, shard_dims=None, is_dataset_splitted=Fa
             placements = [Replicate()] * len(mesh.shape)
             placements[axis] = Shard(0)
             for batch in self._inner:
-                if isinstance(batch, (list, tuple)):
+                if isinstance(batch, dict):
+                    yield {k: shard_tensor(v, mesh, placements)
+                           for k, v in batch.items()}
+                elif isinstance(batch, (list, tuple)):
                     yield type(batch)(
                         shard_tensor(b, mesh, placements) for b in batch)
                 else:

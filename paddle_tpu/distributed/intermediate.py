@@ -141,6 +141,8 @@ def parallelize(model, optimizer=None, mesh=None, config=None):
     mp = (config.get("mp_config") or {}).get("parallelize_plan") or {}
     for pattern, plans in mp.items():
         plans = plans if isinstance(plans, (list, tuple)) else [plans]
+        if not plans:
+            continue
         targets = _match_layers(model, pattern)
         if not targets and not isinstance(plans[0], _SPMarker):
             import logging

@@ -282,15 +282,17 @@ def set_code_level(level=100, also_to_stdout=False):
     This build traces via JAX rather than AST-transforming source, so the
     knob maps to the capture-path log level."""
     import logging
-    logging.getLogger("paddle_tpu.jit").setLevel(
-        logging.DEBUG if level > 0 else logging.WARNING)
+    import sys
+    log = logging.getLogger("paddle_tpu.jit")
+    log.setLevel(logging.DEBUG if level > 0 else logging.WARNING)
+    if also_to_stdout and not any(
+            getattr(h, "stream", None) is sys.stdout for h in log.handlers):
+        log.addHandler(logging.StreamHandler(sys.stdout))
 
 
 def set_verbosity(level=0, also_to_stdout=False):
-    """(reference jit/api set_verbosity)"""
-    import logging
-    logging.getLogger("paddle_tpu.jit").setLevel(
-        logging.DEBUG if level > 0 else logging.WARNING)
+    """(reference jit/api set_verbosity — same logger as set_code_level)"""
+    set_code_level(level, also_to_stdout)
 
 
 def enable_to_static(enable=True):

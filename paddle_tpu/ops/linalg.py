@@ -309,7 +309,8 @@ def cholesky_inverse(x, upper=False, name=None):
         Lf = L.astype(jnp.float32)
         import jax.scipy.linalg as jsl
         eye = jnp.eye(Lf.shape[-1], dtype=jnp.float32)
-        return jsl.cho_solve((Lf, upper), eye)
+        # cho_solve's tuple is (c, LOWER): paddle's upper flag is inverted
+        return jsl.cho_solve((Lf, not upper), eye)
 
     return D.apply("cholesky_inverse", impl, (x,), {"upper": bool(upper)})
 
@@ -319,6 +320,10 @@ def ormqr(x, tau, other, left=True, transpose=False, name=None):
     (reference tensor/linalg.py ormqr).  Q is materialized via
     householder_product — O(m^2 k) like the reference's LAPACK path."""
     def impl(a, tau, y, left, transpose):
+        if a.ndim != 2:
+            raise ValueError(
+                f"ormqr: batched inputs are not supported (got x rank "
+                f"{a.ndim}); vmap over the batch dim")
         af = a.astype(jnp.float32)
         tf = tau.astype(jnp.float32)
         yf = y.astype(jnp.float32)
@@ -340,8 +345,10 @@ def ormqr(x, tau, other, left=True, transpose=False, name=None):
 def svd_lowrank(x, q=6, niter=2, M=None, name=None):
     """Randomized low-rank SVD (reference tensor/linalg.py svd_lowrank,
     Halko et al. subspace iteration)."""
-    def impl(a, q, niter, seed):
+    def impl(a, q, niter, seed, m=None):
         af = a.astype(jnp.float32)
+        if m is not None:
+            af = af - m.astype(jnp.float32)   # centering (PCA use)
         m, n = af.shape[-2], af.shape[-1]
         key = jax.random.PRNGKey(seed)
         omega = jax.random.normal(key, (n, q), jnp.float32)
@@ -355,6 +362,7 @@ def svd_lowrank(x, q=6, niter=2, M=None, name=None):
         return Q @ u_b, s, vT.T
 
     import random as _r
-    return D.apply("svd_lowrank", impl, (x,),
+    args = (x,) if M is None else (x, M)
+    return D.apply("svd_lowrank", impl, args,
                    {"q": int(q), "niter": int(niter),
                     "seed": _r.randint(0, 2 ** 31 - 1)}, num_outputs=3)
