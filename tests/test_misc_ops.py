@@ -183,9 +183,15 @@ def test_new_linalg_ops():
         float(paddle.linalg.cond(paddle.to_tensor(A)).numpy()), 2.0,
         rtol=1e-5)
 
-    L = paddle.linalg.cholesky(paddle.to_tensor(A))
+    # non-diagonal factor: catches triangle-flag inversions that a
+    # diagonal A cannot (both triangles coincide there)
+    B2 = np.asarray([[4., 1.], [1., 3.]], np.float32)
+    L = paddle.linalg.cholesky(paddle.to_tensor(B2))
     inv = paddle.linalg.cholesky_inverse(L)
-    np.testing.assert_allclose(inv.numpy() @ A, np.eye(2), atol=1e-5)
+    np.testing.assert_allclose(inv.numpy() @ B2, np.eye(2), atol=1e-5)
+    U = paddle.to_tensor(np.linalg.cholesky(B2).T.astype(np.float32))
+    inv_u = paddle.linalg.cholesky_inverse(U, upper=True)
+    np.testing.assert_allclose(inv_u.numpy() @ B2, np.eye(2), atol=1e-5)
 
     # ormqr vs LAPACK Q
     B = np.random.RandomState(0).rand(5, 3).astype(np.float32)
