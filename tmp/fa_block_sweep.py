@@ -1,0 +1,65 @@
+"""Sweep flash-attention block sizes on the real chip (subprocess per cfg)."""
+import json
+import os
+import subprocess
+import sys
+
+WORKER = r'''
+import json, os, sys, time
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+from paddle_tpu.models.llama import LlamaConfig
+from paddle_tpu.parallel import (HybridParallelConfig, build_mesh,
+    build_train_step, init_opt_state, init_params, shard_opt_state,
+    shard_params)
+from paddle_tpu.ops.pallas.flash_attention import _flash_attention
+
+B, S = 8, 2048
+# isolated fa fwd+bwd
+k = jax.random.PRNGKey(0)
+q = jax.random.normal(k, (B, S, 16, 64), jnp.bfloat16)
+kv = jax.random.normal(k, (B, S, 4, 64), jnp.bfloat16)
+fab = jax.jit(jax.grad(lambda q, kk, vv: _flash_attention(
+    True, q, kk, vv).astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+def sync(o):
+    return float(jax.tree.leaves(o)[0].astype(jnp.float32).ravel()[0])
+sync(fab(q, kv, kv))
+t0 = time.perf_counter(); out=None
+for _ in range(10): out = fab(q, kv, kv)
+sync(out)
+fa_ms = (time.perf_counter() - t0) / 10 * 1e3
+
+cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                  num_hidden_layers=24, num_attention_heads=16,
+                  num_key_value_heads=4, max_position_embeddings=2048)
+hp = HybridParallelConfig(dp=1, pp=1, tp=1, num_microbatches=1, remat=True,
+                          dtype=jnp.bfloat16)
+mesh = build_mesh(hp)
+params = shard_params(init_params(cfg, hp, seed=0), hp, mesh)
+opt = shard_opt_state(init_opt_state(params), hp, mesh)
+step = build_train_step(cfg, hp, mesh)
+tok = jnp.asarray(np.random.RandomState(0).randint(0, 32000, (B, S)), jnp.int32)
+p, o, loss = step(params, opt, tok); float(loss)
+t0 = time.perf_counter()
+for _ in range(6): p, o, loss = step(p, o, tok)
+float(loss)
+dt = (time.perf_counter() - t0) / 6
+print(json.dumps({"bq": os.environ.get("PADDLE_TPU_FA_BLOCK_Q"),
+                  "bk": os.environ.get("PADDLE_TPU_FA_BLOCK_K"),
+                  "fa_fwdbwd_ms": round(fa_ms, 2),
+                  "step_ms": round(dt * 1e3, 1),
+                  "tok_per_s": round(B * S / dt, 1)}))
+'''
+
+for bq, bk in [(128, 128), (256, 256), (512, 512), (1024, 512), (512, 1024),
+               (256, 512), (1024, 1024), (2048, 512)]:
+    env = dict(os.environ, PADDLE_TPU_FA_BLOCK_Q=str(bq),
+               PADDLE_TPU_FA_BLOCK_K=str(bk))
+    r = subprocess.run([sys.executable, "-c", WORKER], env=env,
+                       capture_output=True, text=True, timeout=560)
+    line = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    if line:
+        print(line[-1], flush=True)
+    else:
+        print(json.dumps({"bq": bq, "bk": bk,
+                          "error": r.stderr[-200:]}), flush=True)

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Poll the axon tunnel; when it answers, run hardware validation + perf.
+cd /root/repo
+for i in $(seq 1 200); do
+  if timeout 60 python -c "import jax; assert jax.default_backend()=='tpu'" 2>/dev/null; then
+    echo "[tunnel_watch] tunnel UP at $(date)" | tee /root/repo/tmp/tunnel_up.flag
+    echo "=== hardware kernel tests ===" > /root/repo/tmp/hw_results.log
+    PADDLE_TPU_HW_TESTS=1 timeout 1200 python -m pytest tests/test_tpu_hardware.py -q --noconftest >> /root/repo/tmp/hw_results.log 2>&1
+    echo "=== remat/kernel sweep ===" >> /root/repo/tmp/hw_results.log
+    timeout 1800 python tmp/remat_sweep.py >> /root/repo/tmp/hw_results.log 2>&1
+    echo "=== bench ===" >> /root/repo/tmp/hw_results.log
+    timeout 900 python bench.py >> /root/repo/tmp/hw_results.log 2>&1
+    echo "[tunnel_watch] done at $(date)" >> /root/repo/tmp/hw_results.log
+    exit 0
+  fi
+  sleep 120
+done
+echo "[tunnel_watch] gave up at $(date)"
