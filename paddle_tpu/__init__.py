@@ -79,6 +79,7 @@ from . import jit  # noqa: E402
 from . import linalg  # noqa: E402
 from . import metric  # noqa: E402
 from . import nn  # noqa: E402
+from . import profiler  # noqa: E402
 from . import quantization  # noqa: E402
 from . import optimizer  # noqa: E402
 from . import hub  # noqa: E402

@@ -345,7 +345,7 @@ def ormqr(x, tau, other, left=True, transpose=False, name=None):
 def svd_lowrank(x, q=6, niter=2, M=None, name=None):
     """Randomized low-rank SVD (reference tensor/linalg.py svd_lowrank,
     Halko et al. subspace iteration)."""
-    def impl(a, q, niter, seed, m=None):
+    def impl(a, m=None, q=6, niter=2, seed=0):
         af = a.astype(jnp.float32)
         if m is not None:
             af = af - m.astype(jnp.float32)   # centering (PCA use)

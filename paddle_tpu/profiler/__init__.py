@@ -20,3 +20,59 @@ __all__ = [
     "make_scheduler", "export_chrome_tracing", "load_profiler_result",
     "benchmark",
 ]
+
+
+class SortedKeys:
+    """Summary-table sort keys (reference profiler.py SortedKeys enum)."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView:
+    """Summary view selector (reference profiler.py SummaryView enum)."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(dir_name=None, worker_name=None):
+    """Profiler on_trace_ready exporting a serialized trace (reference
+    profiler.py:280 export_protobuf).  This build's native trace format is
+    the chrome JSON; the protobuf exporter writes the same event stream as
+    a pickled payload (protobuf compilation is a build-time step this
+    environment doesn't carry) under .pb naming for tooling pick-up."""
+    import os
+    import pickle
+    import socket
+    import time as _time
+
+    def handle(prof):
+        nonlocal dir_name
+        d = dir_name or "profiler_log"
+        os.makedirs(d, exist_ok=True)
+        w = worker_name or f"host_{socket.gethostname()}"
+        path = os.path.join(
+            d, f"{w}_time_{_time.strftime('%Y_%m_%d_%H_%M_%S')}.paddle_trace.pb")
+        events = getattr(prof, "_events", None) or getattr(
+            prof, "events", lambda: [])()
+        with open(path, "wb") as f:
+            pickle.dump({"format": "paddle_tpu-trace-v1",
+                         "events": events}, f)
+        return path
+
+    return handle
+
+
+__all__ += ["SortedKeys", "SummaryView", "export_protobuf"]

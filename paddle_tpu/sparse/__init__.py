@@ -49,7 +49,14 @@ class SparseCooTensor:
         return Tensor(self._bcoo.data)
 
     def to_dense(self):
-        return Tensor(self._bcoo.todense())
+        b = self._bcoo
+        if b.dtype == jnp.bool_:
+            # BCOO.todense scatter-adds, which rejects bool: densify the
+            # pattern in int space and cast back
+            d = jsparse.BCOO((b.data.astype(jnp.int32), b.indices),
+                             shape=b.shape).todense()
+            return Tensor(d.astype(jnp.bool_))
+        return Tensor(b.todense())
 
     def to_sparse_csr(self):
         return SparseCsrTensor(jsparse.BCSR.from_bcoo(
@@ -267,3 +274,140 @@ class _nn_namespace:
 
 nn = _nn_namespace
 functional = _nn_namespace.functional
+
+
+# --- remaining reference sparse __all__ surface (python/paddle/sparse/
+# unary.py, binary.py, multiary.py): value-wise unaries keep the sparsity
+# pattern; structure ops ride BCOO.
+
+def tan(x, name=None):
+    return _unary(x, jnp.tan)
+
+
+def asin(x, name=None):
+    return _unary(x, jnp.arcsin)
+
+
+def atan(x, name=None):
+    return _unary(x, jnp.arctan)
+
+
+def sinh(x, name=None):
+    return _unary(x, jnp.sinh)
+
+
+def asinh(x, name=None):
+    return _unary(x, jnp.arcsinh)
+
+
+def atanh(x, name=None):
+    return _unary(x, jnp.arctanh)
+
+
+def square(x, name=None):
+    return _unary(x, jnp.square)
+
+
+def log1p(x, name=None):
+    return _unary(x, jnp.log1p)
+
+
+def expm1(x, name=None):
+    return _unary(x, jnp.expm1)
+
+
+def deg2rad(x, name=None):
+    return _unary(x, jnp.deg2rad)
+
+
+def rad2deg(x, name=None):
+    return _unary(x, jnp.rad2deg)
+
+
+def isnan(x, name=None):
+    return _unary(x, jnp.isnan)
+
+
+def pow(x, factor, name=None):
+    return _unary(x, lambda v: jnp.power(v, factor))
+
+
+def coalesce(x, name=None):
+    return x.coalesce()
+
+
+def reshape(x, shape, name=None):
+    c = _coo(x)
+    return SparseCooTensor(jsparse.bcoo_reshape(
+        c, new_sizes=tuple(int(s) for s in shape)))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    """Sparse reduction (reference unary.py sum): returns dense Tensor for
+    full reductions, sparse for axis reductions kept sparse by BCOO."""
+    c = _coo(x)
+    if axis is None:
+        out = c.data.sum()
+        if dtype is not None:
+            from ..core.dtype import to_jax_dtype
+            out = out.astype(to_jax_dtype(dtype))
+        return Tensor(out)
+    dense = c.todense().sum(axis=axis, keepdims=keepdim)
+    return to_sparse_coo(Tensor(dense))
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix x dense vector (reference binary.py mv)."""
+    c = _coo(x)
+    v = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    return Tensor(c @ v)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(sparse x @ dense y) (reference multiary.py)."""
+    c = _coo(x)
+    yd = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+    idense = input._data if isinstance(input, Tensor) else jnp.asarray(input)
+    return Tensor(beta * idense + alpha * (c @ yd))
+
+
+def mask_as(x, mask, name=None):
+    """Dense tensor masked to `mask`'s sparsity pattern (reference
+    unary.py mask_as)."""
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    c = _coo(mask)
+    idx = tuple(c.indices[:, i] for i in range(c.indices.shape[1]))
+    vals = xd[idx]
+    return SparseCooTensor(jsparse.BCOO((vals, c.indices), shape=c.shape))
+
+
+def slice(x, axes, starts, ends, name=None):
+    """Sparse slice (reference unary.py slice) — dense roundtrip (BCOO
+    dynamic slicing needs static nse; slices here are host-driven)."""
+    import builtins
+    dense = _coo(x).todense()
+    idx = [builtins.slice(None)] * dense.ndim
+    for a, s, e in zip(axes, starts, ends):
+        idx[a] = builtins.slice(int(s), int(e))
+    return to_sparse_coo(Tensor(dense[tuple(idx)]))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA over a sparse matrix (reference multiary
+    pca_lowrank): densify (the factorization output is dense anyway) and
+    run the dense low-rank SVD."""
+    from ..ops.linalg import svd_lowrank
+    dense = _coo(x).todense()
+    qq = q or min(6, *dense.shape)
+    m = dense.mean(axis=0, keepdims=True) if center else None
+    t = Tensor(dense)
+    if center:
+        return svd_lowrank(t, q=qq, niter=niter,
+                           M=Tensor(jnp.broadcast_to(m, dense.shape)))
+    return svd_lowrank(t, q=qq, niter=niter)
+
+
+__all__ += ["tan", "asin", "atan", "sinh", "asinh", "atanh", "square",
+            "log1p", "expm1", "deg2rad", "rad2deg", "isnan", "pow",
+            "coalesce", "reshape", "sum", "mv", "addmm", "mask_as",
+            "slice", "pca_lowrank"]

@@ -73,3 +73,33 @@ def test_dense_roundtrip_and_transpose():
     np.testing.assert_allclose(s.to_dense().numpy(), d)
     st = sparse.transpose(s, [1, 0])
     np.testing.assert_allclose(st.to_dense().numpy(), d.T)
+
+
+def test_sparse_surface_extras():
+    """Extended sparse surface (reference sparse/{unary,binary,multiary})."""
+    import paddle_tpu.sparse as sp
+
+    d = np.asarray([[0., 2.], [3., 0.]], np.float32)
+    x = sp.to_sparse_coo(paddle.to_tensor(d))
+
+    np.testing.assert_allclose(sp.square(x).to_dense().numpy(), d ** 2)
+    np.testing.assert_allclose(sp.log1p(x).to_dense().numpy(), np.log1p(d))
+    np.testing.assert_allclose(sp.pow(x, 3).to_dense().numpy(), d ** 3)
+    np.testing.assert_allclose(float(sp.sum(x).numpy()), 5.0)
+    np.testing.assert_allclose(
+        sp.mv(x, paddle.to_tensor(np.ones(2, np.float32))).numpy(), [2., 3.])
+    np.testing.assert_allclose(
+        sp.addmm(paddle.to_tensor(np.ones((2, 2), np.float32)),
+                 x, paddle.to_tensor(np.eye(2, dtype=np.float32)),
+                 beta=0.5, alpha=2.0).numpy(), 0.5 + 2.0 * d)
+    np.testing.assert_allclose(
+        sp.mask_as(paddle.to_tensor(np.full((2, 2), 9., np.float32)),
+                   x).to_dense().numpy(), np.where(d != 0, 9., 0.))
+    np.testing.assert_allclose(
+        sp.slice(x, [0], [1], [2]).to_dense().numpy(), d[1:2])
+    np.testing.assert_allclose(
+        sp.reshape(x, [4]).to_dense().numpy(), d.reshape(-1))
+    assert sp.coalesce(x).nnz == x.nnz
+    assert bool(sp.isnan(x).to_dense().numpy().any()) is False
+    u, s_, v = sp.pca_lowrank(x, q=2)
+    assert tuple(u.shape) == (2, 2) and tuple(s_.shape) == (2,)
