@@ -17,7 +17,7 @@ import numpy as np
 from ...io import Dataset
 
 __all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "ImageFolder",
-           "DatasetFolder"]
+           "DatasetFolder", "Flowers", "VOC2012"]
 
 
 class MNIST(Dataset):
@@ -154,3 +154,54 @@ class ImageFolder(DatasetFolder):
 
     def __len__(self):
         return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Flowers-102 schema (reference datasets/flowers.py): RGB images +
+    1..102 labels.  Synthetic payload (zero-egress build) with the real
+    shape contract."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend="cv2"):
+        self.mode = mode
+        self.transform = transform
+        n = 512 if mode == "train" else 128
+        rng = np.random.RandomState(21 if mode == "train" else 22)
+        self.labels = rng.randint(1, 103, n).astype(np.int64)
+        self.images = rng.randint(0, 255, (n, 3, 96, 96)).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([self.labels[idx]], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation schema (reference datasets/voc2012.py):
+    (image [3, H, W], label mask [H, W] of class ids 0..20 + 255 ignore)."""
+
+    NUM_CLASSES = 21
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend="cv2"):
+        self.mode = mode
+        self.transform = transform
+        n = 128 if mode == "train" else 32
+        rng = np.random.RandomState(31 if mode == "train" else 32)
+        self.images = rng.randint(0, 255, (n, 3, 96, 96)).astype(np.uint8)
+        masks = rng.randint(0, self.NUM_CLASSES, (n, 96, 96))
+        ignore = rng.rand(n, 96, 96) < 0.05
+        self.labels = np.where(ignore, 255, masks).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)

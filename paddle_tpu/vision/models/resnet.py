@@ -5,7 +5,8 @@ from ... import nn
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
            "wide_resnet50_2", "wide_resnet101_2", "resnext50_32x4d",
-           "resnext101_32x4d", "resnext101_64x4d", "resnext152_64x4d"]
+           "resnext101_32x4d", "resnext101_64x4d", "resnext152_64x4d",
+           "resnext50_64x4d", "resnext152_32x4d"]
 
 
 class BasicBlock(nn.Layer):
@@ -177,3 +178,11 @@ def resnext101_64x4d(pretrained=False, **kwargs):
 
 def resnext152_64x4d(pretrained=False, **kwargs):
     return _resnet(BottleneckBlock, 152, width=4, groups=64, pretrained=pretrained, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 50, width=4, groups=64, pretrained=pretrained, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 152, width=4, groups=32, pretrained=pretrained, **kwargs)

@@ -7,7 +7,7 @@ from ...ops.manipulation import concat
 
 __all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
            "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
-           "shufflenet_v2_x2_0"]
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
 
 _STAGE_OUT = {
     0.25: (24, 24, 48, 96, 512), 0.33: (24, 32, 64, 128, 512),
@@ -23,8 +23,12 @@ def _channel_shuffle(x, groups):
     return x.reshape([b, c, h, w])
 
 
+def _act_layer(act):
+    return nn.Swish() if act == "swish" else nn.ReLU()
+
+
 class _ShuffleUnit(nn.Layer):
-    def __init__(self, in_c, out_c, stride):
+    def __init__(self, in_c, out_c, stride, act="relu"):
         super().__init__()
         self.stride = stride
         branch_c = out_c // 2
@@ -34,19 +38,19 @@ class _ShuffleUnit(nn.Layer):
                           groups=in_c, bias_attr=False),
                 nn.BatchNorm2D(in_c),
                 nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
-                nn.BatchNorm2D(branch_c), nn.ReLU())
+                nn.BatchNorm2D(branch_c), _act_layer(act))
             b2_in = in_c
         else:
             self.branch1 = None
             b2_in = in_c // 2
         self.branch2 = nn.Sequential(
             nn.Conv2D(b2_in, branch_c, 1, bias_attr=False),
-            nn.BatchNorm2D(branch_c), nn.ReLU(),
+            nn.BatchNorm2D(branch_c), _act_layer(act),
             nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
                       groups=branch_c, bias_attr=False),
             nn.BatchNorm2D(branch_c),
             nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
-            nn.BatchNorm2D(branch_c), nn.ReLU())
+            nn.BatchNorm2D(branch_c), _act_layer(act))
 
     def forward(self, x):
         if self.stride > 1:
@@ -66,19 +70,20 @@ class ShuffleNetV2(nn.Layer):
         self.num_classes = num_classes
         self.conv1 = nn.Sequential(
             nn.Conv2D(3, c1, 3, stride=2, padding=1, bias_attr=False),
-            nn.BatchNorm2D(c1), nn.ReLU())
+            nn.BatchNorm2D(c1), _act_layer(act))
         self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
         stages = []
         in_c = c1
         for out_c, repeat in zip((c2, c3, c4), (4, 8, 4)):
-            units = [_ShuffleUnit(in_c, out_c, 2)]
-            units += [_ShuffleUnit(out_c, out_c, 1) for _ in range(repeat - 1)]
+            units = [_ShuffleUnit(in_c, out_c, 2, act)]
+            units += [_ShuffleUnit(out_c, out_c, 1, act)
+                      for _ in range(repeat - 1)]
             stages.append(nn.Sequential(*units))
             in_c = out_c
         self.stages = nn.LayerList(stages)
         self.conv5 = nn.Sequential(
             nn.Conv2D(in_c, c5, 1, bias_attr=False), nn.BatchNorm2D(c5),
-            nn.ReLU())
+            _act_layer(act))
         self.pool = nn.AdaptiveAvgPool2D(1) if with_pool else None
         self.fc = nn.Linear(c5, num_classes) if num_classes > 0 else None
 
@@ -120,3 +125,7 @@ def shufflenet_v2_x1_5(pretrained=False, **kwargs):
 
 def shufflenet_v2_x2_0(pretrained=False, **kwargs):
     return _shufflenet(2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet(1.0, act="swish", **kwargs)

@@ -6,9 +6,11 @@ TPU-native scope: the dense, MXU/VPU-friendly ops run on device through the
 dispatcher (roi_align, roi_pool, box_coder, yolo_box, psroi_pool); NMS — a
 data-dependent sequential suppression — runs as a fixed-iteration on-device
 loop (lax.fori_loop over boxes, the standard XLA formulation) so it stays
-jittable.  deform_conv2d / generate_proposals / matrix_nms remain
-unimplemented (raise) — they are detection-pipeline specials the reference
-also gates behind CUDA kernels.
+jittable.  prior_box / matrix_nms / read_file / decode_jpeg run host-side
+(anchor generation and IO are data-pipeline work).  deform_conv2d /
+generate_proposals / yolo_loss / distribute_fpn_proposals raise with
+guidance — detection-pipeline specials the reference gates behind CUDA
+kernels.
 """
 from __future__ import annotations
 
@@ -19,7 +21,10 @@ from ..core import dispatch as D
 from ..core.tensor import Tensor
 
 __all__ = ["nms", "roi_align", "roi_pool", "psroi_pool", "box_coder",
-           "yolo_box", "deform_conv2d", "RoIAlign", "RoIPool"]
+           "yolo_box", "deform_conv2d", "RoIAlign", "RoIPool", "prior_box",
+           "matrix_nms", "read_file", "decode_jpeg", "PSRoIPool",
+           "DeformConv2D", "yolo_loss", "generate_proposals",
+           "distribute_fpn_proposals"]
 
 
 def _t(x):
@@ -368,3 +373,183 @@ class RoIPool:
     def __call__(self, x, boxes, boxes_num):
         return roi_pool(x, boxes, boxes_num, self.output_size,
                         self.spatial_scale)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior (anchor) box generation (reference vision/ops.py:438)."""
+    import numpy as np
+
+    fh, fw = (int(s) for s in input.shape[-2:])
+    ih, iw = (int(s) for s in image.shape[-2:])
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ars = [float(a) for a in aspect_ratios]
+    if flip:
+        ars = ars + [1.0 / a for a in ars if a != 1.0]
+
+    boxes, vars_ = [], []
+    for y in range(fh):
+        for x in range(fw):
+            cx = (x + offset) * step_w
+            cy = (y + offset) * step_h
+            cell = []
+            for k, ms in enumerate(min_sizes):
+                ms = float(ms)
+                cell.append((ms, ms))
+                if max_sizes:
+                    big = float(np.sqrt(ms * float(max_sizes[k])))
+                    cell.append((big, big))
+                for a in ars:
+                    if abs(a - 1.0) < 1e-6:
+                        continue
+                    cell.append((ms * np.sqrt(a), ms / np.sqrt(a)))
+            for bw, bh in cell:
+                box = [(cx - bw / 2) / iw, (cy - bh / 2) / ih,
+                       (cx + bw / 2) / iw, (cy + bh / 2) / ih]
+                if clip:
+                    box = [min(max(v, 0.0), 1.0) for v in box]
+                boxes.append(box)
+                vars_.append(list(variance))
+    n_priors = len(boxes) // (fh * fw)
+    b = jnp.asarray(boxes, jnp.float32).reshape(fh, fw, n_priors, 4)
+    v = jnp.asarray(vars_, jnp.float32).reshape(fh, fw, n_priors, 4)
+    return Tensor(b), Tensor(v)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (reference vision/ops.py matrix_nms; SOLOv2): soft decay
+    of each box's score by its IoU with higher-scoring same-class boxes."""
+    import numpy as np
+
+    b = np.asarray(_t(bboxes))      # [N, M, 4]
+    s = np.asarray(_t(scores))      # [N, C, M]
+    outs, idxs, nums = [], [], []
+    for n in range(b.shape[0]):
+        dets = []
+        det_idx = []
+        for c in range(s.shape[1]):
+            if c == background_label:
+                continue
+            sc = s[n, c]
+            keep = np.nonzero(sc >= score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-sc[keep])][:nms_top_k]
+            bb = b[n, order]
+            ss = sc[order]
+            iou = np.asarray(_iou_matrix(jnp.asarray(bb)))
+            decay = np.ones_like(ss)
+            for i in range(1, len(ss)):
+                ious_i = iou[:i, i]
+                if use_gaussian:
+                    d = np.exp(-(ious_i ** 2) / gaussian_sigma).min()
+                else:
+                    d = (1.0 - ious_i).min()
+                decay[i] = d
+            newsc = ss * decay
+            ok = newsc >= post_threshold
+            for j in np.nonzero(ok)[0]:
+                dets.append([c, newsc[j], *bb[j]])
+                det_idx.append(order[j] + n * b.shape[1])
+        dets = sorted(zip(dets, det_idx), key=lambda t: -t[0][1])[:keep_top_k]
+        nums.append(len(dets))
+        outs.extend(d for d, _ in dets)
+        idxs.extend(i for _, i in dets)
+    out = Tensor(jnp.asarray(np.asarray(outs, np.float32).reshape(-1, 6)))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(np.asarray(idxs, np.int64)
+                                      .reshape(-1, 1))))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.asarray(nums, np.int32))))
+    return tuple(res) if len(res) > 1 else out
+
+
+def read_file(filename, name=None):
+    """File bytes -> uint8 tensor (reference vision/ops.py:1345)."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    import numpy as np
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """JPEG bytes tensor -> CHW uint8 image (reference vision/ops.py:1388,
+    nvjpeg-backed there; PIL-backed here)."""
+    import io
+
+    import numpy as np
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise NotImplementedError(
+            "decode_jpeg needs PIL, which this image lacks; decode on the "
+            "host data pipeline instead") from e
+    raw = bytes(np.asarray(_t(x)).astype(np.uint8).tobytes())
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
+
+
+class PSRoIPool:
+    """Layer wrapper (reference vision/ops.py:1523)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+class DeformConv2D:
+    """(reference vision/ops.py:973) — constructible for API parity; the
+    kernel is CUDA-gated in the reference and unimplemented here."""
+
+    def __init__(self, *a, **k):
+        pass
+
+    def __call__(self, *a, **k):
+        return deform_conv2d(None, None, None)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    raise NotImplementedError(
+        "yolo_loss (YOLOv3 training loss with anchor matching) is not "
+        "implemented in this TPU build; compose it from yolo_box + "
+        "standard losses, or register a custom op")
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    raise NotImplementedError(
+        "generate_proposals (RPN pipeline) is not implemented; compose "
+        "box_coder + nms, or register a custom op")
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    raise NotImplementedError(
+        "distribute_fpn_proposals is not implemented; the level "
+        "assignment is floor(refer_level + log2(sqrt(area)/refer_scale)) "
+        "over roi areas — a five-line jnp composition if needed")

@@ -254,3 +254,325 @@ class BrightnessTransform(BaseTransform):
         arr = np.asarray(img, dtype=np.float32)
         factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
         return np.clip(arr * factor, 0, 255 if arr.max() > 1 else 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Remaining reference transform surface (vision/transforms/{transforms,
+# functional}.py).  All operate on CHW float arrays (the module's
+# convention); geometry ops build inverse-warp grids sampled with
+# nn.functional.grid_sample so they run the same code path on device.
+# ---------------------------------------------------------------------------
+
+def _chw(img):
+    return np.asarray(img, dtype=np.float32)
+
+
+def _scale_max(arr):
+    return 255.0 if arr.max() > 1 else 1.0
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _chw(img)
+    return np.clip(arr * brightness_factor, 0, _scale_max(arr))
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _chw(img)
+    mean = arr.mean()
+    return np.clip(mean + contrast_factor * (arr - mean), 0,
+                   _scale_max(arr))
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = _chw(img)
+    gray = (0.299 * arr[0] + 0.587 * arr[1] + 0.114 * arr[2])[None]
+    return np.clip(gray + saturation_factor * (arr - gray), 0,
+                   _scale_max(arr))
+
+
+def adjust_hue(img, hue_factor):
+    """Hue rotation in YIQ space (matrix form; reference adjust_hue)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = _chw(img)
+    scale = _scale_max(arr)
+    x = arr / scale
+    theta = hue_factor * 2.0 * np.pi
+    cos, sin = np.cos(theta), np.sin(theta)
+    # RGB->YIQ, rotate IQ, YIQ->RGB composed into one 3x3
+    t_yiq = np.array([[0.299, 0.587, 0.114],
+                      [0.595716, -0.274453, -0.321263],
+                      [0.211456, -0.522591, 0.311135]], np.float32)
+    rot = np.array([[1, 0, 0], [0, cos, -sin], [0, sin, cos]], np.float32)
+    t_rgb = np.linalg.inv(t_yiq)
+    m = t_rgb @ rot @ t_yiq
+    out = np.einsum("ij,jhw->ihw", m, x)
+    return np.clip(out, 0, 1.0) * scale
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _chw(img)
+    gray = (0.299 * arr[0] + 0.587 * arr[1] + 0.114 * arr[2])[None]
+    return np.repeat(gray, num_output_channels, axis=0)
+
+
+def crop(img, top, left, height, width):
+    return _crop(_chw(img), top, left, height, width)
+
+
+def center_crop(img, output_size):
+    arr = _chw(img)
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else output_size
+    h, w = arr.shape[-2:]
+    return _crop(arr, (h - oh) // 2, (w - ow) // 2, oh, ow)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = _chw(img)
+    if isinstance(padding, int):
+        l = r = t = b = padding
+    elif len(padding) == 2:
+        l = r = padding[0]
+        t = b = padding[1]
+    else:
+        l, t, r, b = padding
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(arr, ((0, 0), (t, b), (l, r)), mode=mode, **kw)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    arr = _chw(img) if not inplace else np.asarray(img)
+    out = arr if inplace else arr.copy()
+    out[..., i:i + h, j:j + w] = v
+    return out
+
+
+def _warp(img, matrix):
+    """Inverse-warp a CHW image by a 3x3 matrix in pixel coords via
+    grid_sample (device path shared with F.grid_sample)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    arr = _chw(img)
+    c, h, w = arr.shape
+    ys, xs = np.meshgrid(np.arange(h, dtype=np.float32),
+                         np.arange(w, dtype=np.float32), indexing="ij")
+    ones = np.ones_like(xs)
+    coords = np.stack([xs, ys, ones])                    # [3, H, W]
+    src = np.einsum("ij,jhw->ihw", matrix.astype(np.float32), coords)
+    src = src[:2] / np.maximum(src[2:3], 1e-8)
+    # normalize to [-1, 1]
+    gx = 2.0 * src[0] / max(w - 1, 1) - 1.0
+    gy = 2.0 * src[1] / max(h - 1, 1) - 1.0
+    grid = paddle.to_tensor(np.stack([gx, gy], -1)[None].astype(np.float32))
+    out = F.grid_sample(paddle.to_tensor(arr[None]), grid,
+                        align_corners=True)
+    return np.asarray(out.numpy()[0])
+
+
+def _affine_matrix(angle, translate, scale, shear, center):
+    cx, cy = center
+    rot = np.deg2rad(angle)
+    sx, sy = (np.deg2rad(s) for s in (shear if isinstance(shear, (list, tuple))
+                                      else (shear, 0.0)))
+    # forward affine (about center), then invert for the warp
+    a = np.cos(rot + sy) / max(np.cos(sy), 1e-8)
+    b = -np.cos(rot + sy) * np.tan(sx) / max(np.cos(sy), 1e-8) - np.sin(rot)
+    c = np.sin(rot + sy) / max(np.cos(sy), 1e-8)
+    d = -np.sin(rot + sy) * np.tan(sx) / max(np.cos(sy), 1e-8) + np.cos(rot)
+    m = np.array([[a * scale, b * scale, 0.0],
+                  [c * scale, d * scale, 0.0],
+                  [0.0, 0.0, 1.0]], np.float32)
+    pre = np.array([[1, 0, cx + translate[0]], [0, 1, cy + translate[1]],
+                    [0, 0, 1]], np.float32)
+    post = np.array([[1, 0, -cx], [0, 1, -cy], [0, 0, 1]], np.float32)
+    fwd = pre @ m @ post
+    return np.linalg.inv(fwd)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    arr = _chw(img)
+    h, w = arr.shape[-2:]
+    ctr = center or ((w - 1) * 0.5, (h - 1) * 0.5)
+    return _warp(arr, _affine_matrix(angle, translate, scale, shear, ctr))
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    return affine(img, angle, (0, 0), 1.0, (0.0, 0.0), interpolation, fill,
+                  center)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Projective warp from 4 point pairs (reference functional
+    perspective): solve the homography, inverse-warp."""
+    arr = _chw(img)
+    A = []
+    bvec = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        A.append([sx, sy, 1, 0, 0, 0, -ex * sx, -ex * sy])
+        bvec.append(ex)
+        A.append([0, 0, 0, sx, sy, 1, -ey * sx, -ey * sy])
+        bvec.append(ey)
+    coeff = np.linalg.solve(np.asarray(A, np.float32),
+                            np.asarray(bvec, np.float32))
+    fwd = np.append(coeff, 1.0).reshape(3, 3)
+    return _warp(arr, np.linalg.inv(fwd))
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, f)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = value
+
+    def _apply_image(self, img):
+        return adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """(reference transforms.py ColorJitter: random order of the four
+    component jitters)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.parts = []
+        if brightness:
+            self.parts.append(BrightnessTransform(brightness))
+        if contrast:
+            self.parts.append(ContrastTransform(contrast))
+        if saturation:
+            self.parts.append(SaturationTransform(saturation))
+        if hue:
+            self.parts.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        order = np.random.permutation(len(self.parts))
+        for i in order:
+            img = self.parts[i]._apply_image(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else tuple(degrees)
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, center=self.center, fill=self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else tuple(degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.center = center
+
+    def _apply_image(self, img):
+        h, w = _chw(img).shape[-2:]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = np.random.uniform(*self.scale) if self.scale else 1.0
+        sh = np.random.uniform(*self.shear) if self.shear else 0.0
+        return affine(img, angle, (tx, ty), sc, (sh, 0.0),
+                      center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return _chw(img)
+        h, w = _chw(img).shape[-2:]
+        d = self.distortion_scale
+        def j(lim):
+            return np.random.uniform(0, d * lim / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(j(w), j(h)), (w - 1 - j(w), j(h)),
+               (w - 1 - j(w), h - 1 - j(h)), (j(w), h - 1 - j(h))]
+        return perspective(img, start, end)
+
+
+class RandomErasing(BaseTransform):
+    """(reference transforms.py RandomErasing)"""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _chw(img)
+        if np.random.rand() >= self.prob:
+            return arr
+        c, h, w = arr.shape
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh)
+                j_ = np.random.randint(0, w - ew)
+                v = (np.random.rand(c, eh, ew).astype(np.float32)
+                     if self.value == "random" else self.value)
+                return erase(arr, i, j_, eh, ew, v)
+        return arr
+
+
+__all__ += ["SaturationTransform", "ContrastTransform", "HueTransform",
+            "ColorJitter", "RandomAffine", "RandomRotation",
+            "RandomPerspective", "Grayscale", "RandomErasing", "pad",
+            "affine", "rotate", "perspective", "to_grayscale", "crop",
+            "center_crop", "adjust_brightness", "adjust_contrast",
+            "adjust_hue", "adjust_saturation", "erase"]

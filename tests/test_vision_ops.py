@@ -149,3 +149,71 @@ def test_psroi_pool_shapes():
 def test_deform_conv_raises():
     with pytest.raises(NotImplementedError, match="deform_conv2d"):
         V.deform_conv2d(None, None, None)
+
+
+def test_prior_box():
+    feat = paddle.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+    img = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+    boxes, variances = V.prior_box(feat, img, min_sizes=[8.0],
+                                   max_sizes=[16.0],
+                                   aspect_ratios=[2.0], clip=True)
+    # priors per cell: min + max + 1 extra ratio = 3
+    assert boxes.shape == [4, 4, 3, 4]
+    assert variances.shape == [4, 4, 3, 4]
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 1).all()       # clipped, normalized
+    # center cell's min-size box is centered at (offset) * step / img
+    np.testing.assert_allclose(b[0, 0, 0], [0.0, 0.0, 8 / 32, 8 / 32],
+                               atol=1 / 32 + 1e-6)
+
+
+def test_matrix_nms():
+    boxes = np.asarray([[[0, 0, 10, 10], [1, 1, 11, 11],
+                         [20, 20, 30, 30]]], np.float32)
+    scores = np.asarray([[[0.0, 0.0, 0.0],       # background
+                          [0.9, 0.85, 0.8]]], np.float32)
+    out, idx, nums = V.matrix_nms(paddle.to_tensor(boxes),
+                                  paddle.to_tensor(scores),
+                                  score_threshold=0.1, post_threshold=0.1,
+                                  nms_top_k=10, keep_top_k=10,
+                                  return_index=True)
+    o = out.numpy()
+    assert nums.numpy()[0] == o.shape[0] >= 2
+    # highest score survives undecayed; the overlapping box is decayed
+    assert o[0, 1] == 0.9
+    overlapped = o[o[:, 1] < 0.9]
+    assert (overlapped[:, 1] <= 0.85 + 1e-6).all()
+
+
+def test_read_file_decode_jpeg(tmp_path):
+    from PIL import Image
+
+    # smooth gradient (noise images defeat the lossy codec)
+    gy, gx = np.meshgrid(np.linspace(0, 255, 10), np.linspace(0, 255, 12),
+                         indexing="ij")
+    img = np.stack([gy, gx, (gy + gx) / 2], -1).astype(np.uint8)
+    p = tmp_path / "img.jpg"
+    Image.fromarray(img).save(str(p), quality=95)
+    raw = V.read_file(str(p))
+    assert raw.numpy().dtype == np.uint8 and raw.shape[0] > 100
+    decoded = V.decode_jpeg(raw, mode="rgb")
+    assert decoded.shape == [3, 10, 12]
+    # lossy codec: just require rough agreement
+    err = np.abs(decoded.numpy().astype(np.int32).transpose(1, 2, 0)
+                 - img.astype(np.int32)).mean()
+    assert err < 16, err
+
+
+def test_psroi_pool_layer_and_stubs():
+    pool = V.PSRoIPool(2, 1.0)
+    x = paddle.to_tensor(np.random.rand(1, 8, 8, 8).astype(np.float32))
+    out = pool(x, paddle.to_tensor(np.asarray([[0., 0., 7., 7.]],
+                                              np.float32)),
+               paddle.to_tensor(np.asarray([1], np.int32)))
+    assert out.shape == [1, 2, 2, 2]
+    with pytest.raises(NotImplementedError):
+        V.yolo_loss(None, None, None, [], [], 3, 0.5, 32)
+    with pytest.raises(NotImplementedError):
+        V.generate_proposals(None, None, None, None, None)
+    with pytest.raises(NotImplementedError):
+        V.DeformConv2D()(None)
