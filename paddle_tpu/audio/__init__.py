@@ -9,12 +9,14 @@ soundfile backends needed for the compute surface.
 """
 from __future__ import annotations
 
-from . import features, functional  # noqa: F401
+from . import backends, datasets, features, functional  # noqa: F401
+from .backends import info, load, save  # noqa: F401
 from .features import (  # noqa: F401
     LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram,
 )
 
-__all__ = ["features", "functional", "Spectrogram", "MelSpectrogram",
+__all__ = ["features", "functional", "datasets", "backends", "load",
+           "save", "info", "Spectrogram", "MelSpectrogram",
            "LogMelSpectrogram", "MFCC", "backends"]
 
 

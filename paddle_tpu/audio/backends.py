@@ -1,0 +1,61 @@
+"""Audio IO (reference python/paddle/audio/backends/{init_backend,
+wave_backend}.py: paddle.audio.load/save/info over the stdlib wave module
+for 16-bit PCM WAV — the reference's no-soundfile fallback backend)."""
+from __future__ import annotations
+
+import wave
+from collections import namedtuple
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["load", "save", "info", "AudioInfo"]
+
+AudioInfo = namedtuple("AudioInfo", ["sample_rate", "num_samples",
+                                     "num_channels", "bits_per_sample",
+                                     "encoding"])
+
+
+def info(filepath: str) -> AudioInfo:
+    """(reference wave_backend.info)"""
+    with wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8, "PCM_S")
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """WAV -> (waveform Tensor, sample_rate) (reference wave_backend.load)."""
+    import jax.numpy as jnp
+
+    with wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    dt = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dt).reshape(-1, nch)
+    if normalize:
+        scale = float(2 ** (width * 8 - 1))
+        data = data.astype(np.float32) / scale
+    arr = data.T if channels_first else data
+    return Tensor(jnp.asarray(arr)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_16", bits_per_sample=16):
+    """(waveform, sr) -> 16-bit PCM WAV (reference wave_backend.save)."""
+    arr = np.asarray(src.numpy() if isinstance(src, Tensor) else src)
+    if channels_first:
+        arr = arr.T                       # -> [frames, channels]
+    if arr.dtype.kind == "f":
+        arr = np.clip(arr, -1.0, 1.0)
+        arr = (arr * (2 ** 15 - 1)).astype(np.int16)
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1] if arr.ndim > 1 else 1)
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(arr.astype(np.int16).tobytes())

@@ -1,0 +1,37 @@
+"""Audio datasets (reference python/paddle/audio/datasets/{tess,esc50}.py)
+— synthetic schema-shaped payloads (zero-egress build)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["TESS", "ESC50"]
+
+
+class TESS(Dataset):
+    """Emotion classification over 2800 utterances, 7 classes
+    (reference datasets/tess.py schema: waveform [n] + label)."""
+
+    n_class = 7
+    sample_rate = 24414
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 archive=None, **kwargs):
+        n = 256 if mode == "train" else 64
+        rng = np.random.RandomState(41 if mode == "train" else 42)
+        self.labels = rng.randint(0, self.n_class, n).astype(np.int64)
+        self.waves = (rng.randn(n, 4096).astype(np.float32) * 0.1)
+
+    def __getitem__(self, idx):
+        return self.waves[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class ESC50(TESS):
+    """Environmental sounds, 50 classes (reference datasets/esc50.py)."""
+
+    n_class = 50
+    sample_rate = 44100

@@ -18,7 +18,8 @@ from ..core.tensor import Tensor
 
 __all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
            "segment_mean", "segment_max", "segment_min", "sample_neighbors",
-           "reindex_graph"]
+           "reindex_graph", "weighted_sample_neighbors",
+           "reindex_heter_graph"]
 
 
 _SEGMENT = {
@@ -169,3 +170,54 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
             Tensor(jnp.asarray(np.asarray(count.numpy()
                                           if isinstance(count, Tensor)
                                           else count))))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weight-proportional neighbor sampling (reference
+    weighted_sample_neighbors); host-side like sample_neighbors."""
+    rng = np.random.default_rng()
+    row_np = np.asarray(row.numpy() if isinstance(row, Tensor) else row)
+    ptr = np.asarray(colptr.numpy() if isinstance(colptr, Tensor) else colptr)
+    w = np.asarray(edge_weight.numpy() if isinstance(edge_weight, Tensor)
+                   else edge_weight).astype(np.float64)
+    nodes = np.asarray(input_nodes.numpy()
+                       if isinstance(input_nodes, Tensor) else input_nodes)
+    out_n, out_count = [], []
+    for v in nodes:
+        beg, end = int(ptr[v]), int(ptr[v + 1])
+        neigh = row_np[beg:end]
+        wv = w[beg:end]
+        if sample_size > 0 and len(neigh) > sample_size:
+            p = wv / wv.sum() if wv.sum() > 0 else None
+            neigh = rng.choice(neigh, size=sample_size, replace=False, p=p)
+        out_n.append(neigh)
+        out_count.append(len(neigh))
+    out_neighbors = Tensor(jnp.asarray(
+        np.concatenate(out_n) if out_n else np.zeros((0,), row_np.dtype)))
+    return out_neighbors, Tensor(jnp.asarray(np.asarray(out_count, np.int32)))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous-graph reindex (reference reindex_heter_graph): one
+    shared node mapping across per-edge-type neighbor lists."""
+    x_np = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    n_lists = [np.asarray(n.numpy() if isinstance(n, Tensor) else n)
+               for n in neighbors]
+    uniq = list(dict.fromkeys(x_np.tolist()))
+    mapping = {v: i for i, v in enumerate(uniq)}
+    outs = []
+    for n_np in n_lists:
+        for v in n_np.tolist():
+            if v not in mapping:
+                mapping[v] = len(mapping)
+                uniq.append(v)
+        outs.append(Tensor(jnp.asarray(np.asarray(
+            [mapping[v] for v in n_np.tolist()], np.int64))))
+    nodes = Tensor(jnp.asarray(np.asarray(uniq, x_np.dtype)))
+    counts = [Tensor(jnp.asarray(np.asarray(
+        c.numpy() if isinstance(c, Tensor) else c)))
+        for c in count]
+    return outs, nodes, counts

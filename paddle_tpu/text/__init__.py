@@ -13,7 +13,8 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["Imdb", "UCIHousing", "Conll05st", "Movielens"]
+__all__ = ["Imdb", "UCIHousing", "Conll05st", "Movielens", "Imikolov",
+           "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode"]
 
 
 class Imdb(Dataset):
@@ -138,3 +139,97 @@ class Movielens(Dataset):
 
     def __len__(self):
         return len(self.user)
+
+
+class Imikolov(Dataset):
+    """PTB n-gram language-model dataset schema (reference
+    text/datasets/imikolov.py): data_type NGRAM yields (context..., target)
+    tuples over a small vocab.  Synthetic payload (zero-egress)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50):
+        if data_file is not None:
+            raise NotImplementedError(
+                "Imikolov serves synthetic n-gram data only (zero-egress "
+                "build); pass data_file=None")
+        rng = np.random.RandomState(51 if mode == "train" else 52)
+        vocab = 2000
+        n = 2048 if mode == "train" else 256
+        self.window_size = window_size
+        stream = rng.randint(0, vocab, n + window_size).astype(np.int64)
+        self.samples = [tuple(stream[i:i + window_size])
+                        for i in range(n)]
+        self._word_idx = {f"w{i}": i for i in range(vocab)}
+
+    def word_idx(self):
+        return self._word_idx
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class _WMT(Dataset):
+    """Translation-pair schema: (src_ids, trg_ids, trg_ids_next)
+    (reference text/datasets/wmt14.py)."""
+
+    DICT_SIZE = 3000
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 lang="en"):
+        if data_file is not None:
+            raise NotImplementedError(
+                f"{type(self).__name__} serves synthetic translation pairs "
+                "only (zero-egress build); pass data_file=None")
+        self.dict_size = self.DICT_SIZE if dict_size < 0 else dict_size
+        rng = np.random.RandomState(61 if mode == "train" else 62)
+        n = 512 if mode == "train" else 64
+        self.samples = []
+        for _ in range(n):
+            ls = rng.randint(4, 20)
+            lt = rng.randint(4, 20)
+            src = rng.randint(0, self.dict_size, ls).astype(np.int64)
+            trg = rng.randint(0, self.dict_size, lt).astype(np.int64)
+            trg_next = np.concatenate([trg[1:], [1]]).astype(np.int64)
+            self.samples.append((src, trg, trg_next))
+
+    def get_dict(self, lang="en", reverse=False):
+        d = {f"tok{i}": i for i in range(self.dict_size)}
+        return {v: k for k, v in d.items()} if reverse else d
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class WMT14(_WMT):
+    pass
+
+
+class WMT16(_WMT):
+    def get_dict(self, lang="en", reverse=False):
+        return super().get_dict(lang, reverse)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    from ..ops.misc import viterbi_decode as _impl
+    return _impl(potentials, transition_params, lengths,
+                 include_bos_eos_tag)
+
+
+class ViterbiDecoder:
+    """Layer wrapper holding the transitions (reference
+    text/viterbi_decode.py ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
