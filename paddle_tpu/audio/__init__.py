@@ -17,17 +17,8 @@ from .features import (  # noqa: F401
 
 __all__ = ["features", "functional", "datasets", "backends", "load",
            "save", "info", "Spectrogram", "MelSpectrogram",
-           "LogMelSpectrogram", "MFCC", "backends"]
+           "LogMelSpectrogram", "MFCC"]
 
-
-class backends:
-    """Audio IO backends (reference paddle.audio.backends): the TPU build
-    ships no soundfile dependency; list_available_backends reports that."""
-
-    @staticmethod
-    def list_available_backends():
-        return []
-
-    @staticmethod
-    def get_current_backend():
-        return None
+# backend listing helpers (reference audio/backends/init_backend.py)
+backends.list_available_backends = lambda: ["wave"]
+backends.get_current_backend = lambda: "wave"

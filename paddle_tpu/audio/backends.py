@@ -38,6 +38,9 @@ def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
         raw = f.readframes(n)
     dt = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
     data = np.frombuffer(raw, dt).reshape(-1, nch)
+    if width == 1:
+        # 8-bit PCM is offset-binary: silence at 128
+        data = data.astype(np.int16) - 128
     if normalize:
         scale = float(2 ** (width * 8 - 1))
         data = data.astype(np.float32) / scale

@@ -37,6 +37,9 @@ def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
     """linear + bias + act in one traced region (reference
     fused_linear_activation over cublasLt epilogue)."""
     from ....nn import functional as F
+    from ....ops.manipulation import transpose as _tp
+    if trans_x:
+        x = _tp(x, list(range(x.ndim - 2)) + [x.ndim - 1, x.ndim - 2])
     out = fused_linear(x, y, bias, transpose_weight=trans_y)
     act = {"gelu": F.gelu, "relu": F.relu, "none": lambda t: t}[activation]
     return act(out)
@@ -76,6 +79,11 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
     from ....nn import functional as F
     from ....ops.manipulation import reshape, transpose
 
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_multi_head_attention with cache_kv (incremental decode) "
+            "is not implemented; use LlamaForCausalLM.generate's compiled "
+            "KV-cache loop")
     residual = x
     if pre_layer_norm:
         x = F.layer_norm(x, x.shape[-1:], weight=pre_ln_scale,
@@ -187,6 +195,10 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens,
     q = transpose(query, [0, 2, 1, 3])      # -> [B, S, NH, D]
     k = transpose(key, [0, 2, 1, 3])
     v = transpose(value, [0, 2, 1, 3])
+    if scale is not None:
+        # SDPA divides by sqrt(d); pre-scale q so the net factor is `scale`
+        d = q.shape[-1]
+        q = q * float(scale * (d ** 0.5))
     B, S = q.shape[0], q.shape[1]
     Sk = k.shape[1]
     sl = seq_lens._data if isinstance(seq_lens, Tensor) else jnp.asarray(seq_lens)

@@ -353,6 +353,9 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):
             out = out.astype(to_jax_dtype(dtype))
         return Tensor(out)
     dense = c.todense().sum(axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        from ..core.dtype import to_jax_dtype
+        dense = dense.astype(to_jax_dtype(dtype))
     return to_sparse_coo(Tensor(dense))
 
 

@@ -444,14 +444,23 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
             bb = b[n, order]
             ss = sc[order]
             iou = np.asarray(_iou_matrix(jnp.asarray(bb)))
+            # Matrix NMS (SOLOv2): decay_j = min_i f(iou_ij)/f(comp_i),
+            # comp_i = max IoU of box i with any higher-scored box — the
+            # compensation keeps clustered high scorers from
+            # over-suppressing the rest
             decay = np.ones_like(ss)
+            comp = np.zeros_like(ss)
+            for i in range(1, len(ss)):
+                comp[i] = iou[:i, i].max()
             for i in range(1, len(ss)):
                 ious_i = iou[:i, i]
                 if use_gaussian:
-                    d = np.exp(-(ious_i ** 2) / gaussian_sigma).min()
+                    num = np.exp(-(ious_i ** 2) / gaussian_sigma)
+                    den = np.exp(-(comp[:i] ** 2) / gaussian_sigma)
                 else:
-                    d = (1.0 - ious_i).min()
-                decay[i] = d
+                    num = 1.0 - ious_i
+                    den = 1.0 - comp[:i]
+                decay[i] = (num / np.maximum(den, 1e-10)).min()
             newsc = ss * decay
             ok = newsc >= post_threshold
             for j in np.nonzero(ok)[0]:

@@ -504,7 +504,9 @@ class RandomAffine(BaseTransform):
             else tuple(degrees)
         self.translate = translate
         self.scale = scale
-        self.shear = shear
+        # scalar shear means the range (-shear, shear) (reference contract)
+        self.shear = ((-shear, shear) if shear is not None
+                      and np.isscalar(shear) else shear)
         self.center = center
 
     def _apply_image(self, img):
