@@ -1,2 +1,153 @@
 """Incubating APIs (reference: python/paddle/incubate/)."""
 from . import asp, autotune, distributed, nn  # noqa: F401
+
+# root incubate surface (reference incubate/__init__.py __all__)
+from ..geometric import (  # noqa: F401,E402
+    segment_max, segment_mean, segment_min, segment_sum,
+)
+from ..geometric import reindex_graph as graph_reindex  # noqa: F401,E402
+from ..geometric import sample_neighbors as graph_sample_neighbors  # noqa: F401,E402
+from ..geometric import send_u_recv as graph_send_recv  # noqa: F401,E402
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a loss as final (reference incubate identity_loss op; on IPU it
+    anchors the training graph — here it is the reduction only)."""
+    if reduction in ("none", 2):
+        return x
+    if reduction in ("sum", 0):
+        return x.sum()
+    if reduction in ("mean", 1):
+        return x.mean()
+    raise ValueError(f"bad reduction {reduction!r}")
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one traced region (reference incubate
+    softmax_mask_fuse CUDA kernel)."""
+    from ..nn import functional as F
+    return F.softmax(x + mask, axis=-1)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Causal-masked softmax (reference softmax_mask_fuse_upper_triangle):
+    positions above the diagonal are masked."""
+    import jax.numpy as jnp
+
+    from ..core import dispatch as D
+
+    def impl(a):
+        import jax
+        s = a.shape[-1]
+        mask = jnp.tril(jnp.ones((a.shape[-2], s), bool))
+        scores = jnp.where(mask, a.astype(jnp.float32), -jnp.inf)
+        return jax.nn.softmax(scores, axis=-1).astype(a.dtype)
+
+    return D.apply("softmax_mask_fuse_upper_triangle", impl, (x,))
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling (reference incubate graph_khop_sampler):
+    composed from per-hop sample_neighbors + reindex."""
+    from ..geometric import reindex_graph, sample_neighbors
+
+    nodes = input_nodes
+    all_neighbors = []
+    all_counts = []
+    for size in sample_sizes:
+        neigh, cnt = sample_neighbors(row, colptr, nodes, sample_size=size)
+        all_neighbors.append(neigh)
+        all_counts.append(cnt)
+        nodes = neigh
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    from ..ops.manipulation import concat
+    edge_src = concat(all_neighbors, axis=0)
+    counts = concat(all_counts, axis=0)
+    reindexed, uniq, _ = reindex_graph(input_nodes, edge_src, counts)
+    return edge_src, counts, uniq, reindexed
+
+
+class LookAhead:
+    """Lookahead optimizer wrapper (reference incubate/optimizer/lookahead):
+    k fast steps, then slow weights interpolate toward fast."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_count = 0
+        self._slow = None
+
+    def _params(self):
+        return self.inner_optimizer._parameter_list or []
+
+    def step(self, *a, **kw):
+        import jax.numpy as jnp
+        out = self.inner_optimizer.step(*a, **kw)
+        if self._slow is None:
+            # COPY: the inner optimizer's compiled step donates the param
+            # buffers, so aliased references would be deleted next step
+            self._slow = [jnp.copy(p._data) for p in self._params()]
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            for p, slow in zip(self._params(), self._slow):
+                p._data = slow + self.alpha * (p._data - slow)
+            self._slow = [jnp.copy(p._data) for p in self._params()]
+        return out
+
+    def clear_grad(self, *a, **kw):
+        return self.inner_optimizer.clear_grad(*a, **kw)
+
+    clear_gradients = clear_grad
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        return self.inner_optimizer.state_dict()
+
+    def set_state_dict(self, sd):
+        return self.inner_optimizer.set_state_dict(sd)
+
+
+class ModelAverage:
+    """Running parameter average with apply/restore (reference
+    incubate/optimizer/modelaverage.py: EMA-window average applied for
+    eval, restored for training)."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = list(parameters or [])
+        self._sums = [p._data * 0 for p in self._params]
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        self._sums = [s + p._data for s, p in zip(self._sums, self._params)]
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        import jax.numpy as jnp
+        if not self._count:
+            return
+        self._backup = [jnp.copy(p._data) for p in self._params]
+        for p, s in zip(self._params, self._sums):
+            p._data = (s / self._count).astype(p._data.dtype)
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, b in zip(self._params, self._backup):
+            p._data = b
+        self._backup = None
+
+
+def inference(*args, **kwargs):
+    raise NotImplementedError(
+        "paddle.incubate.inference wraps the TensorRT serving engine "
+        "(SURVEY §7.4 non-goal); export with jit.save/onnx.export "
+        "(StableHLO) and serve via a PJRT-hosting runtime")

@@ -1,1 +1,6 @@
 from . import functional  # noqa: F401
+from .layers import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm, FusedDropoutAdd, FusedFeedForward,
+    FusedLinear, FusedMultiHeadAttention, FusedMultiTransformer,
+    FusedTransformerEncoderLayer,
+)

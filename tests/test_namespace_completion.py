@@ -127,3 +127,64 @@ def test_text_datasets_and_viterbi_layer():
         paddle.to_tensor(np.random.rand(1, 4, 3).astype(np.float32)),
         paddle.to_tensor(np.asarray([4], np.int64)))
     assert paths.shape == [1, 4]
+
+
+def test_incubate_surfaces_complete():
+    for mod, path in [
+            ("incubate.nn",
+             "/root/reference/python/paddle/incubate/nn/__init__.py"),
+            ("incubate",
+             "/root/reference/python/paddle/incubate/__init__.py")]:
+        names = _ref_all(path)
+        obj = paddle
+        for part in mod.split("."):
+            obj = getattr(obj, part)
+        missing = [n for n in names if not hasattr(obj, n)]
+        assert not missing, f"{mod}: {missing}"
+
+
+def test_fused_layers_and_lookahead():
+    import paddle_tpu.nn as nn
+    IN = paddle.incubate.nn
+
+    lyr = IN.FusedTransformerEncoderLayer(16, 4, 32, dropout_rate=0.0)
+    lyr.eval()
+    x = paddle.randn([2, 5, 16])
+    out = lyr(x)
+    assert out.shape == [2, 5, 16]
+    assert np.isfinite(out.numpy()).all()
+
+    fl = IN.FusedLinear(8, 4)
+    assert fl(paddle.randn([3, 8])).shape == [3, 4]
+
+    # LookAhead: slow weights only move every k steps
+    net = nn.Linear(4, 1)
+    inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=net.parameters())
+    look = paddle.incubate.LookAhead(inner, alpha=0.5, k=2)
+    xb = paddle.to_tensor(np.ones((2, 4), np.float32))
+    w_start = net.weight.numpy().copy()
+    for _ in range(2):
+        net(xb).sum().backward()
+        look.step()
+        look.clear_grad()
+    assert not np.allclose(net.weight.numpy(), w_start)
+
+    # ModelAverage apply/restore roundtrip
+    ma = paddle.incubate.ModelAverage(parameters=net.parameters())
+    w_before = net.weight.numpy().copy()
+    ma.step()
+    net.weight._data = net.weight._data * 2.0
+    ma.step()
+    ma.apply()
+    averaged = net.weight.numpy().copy()
+    assert not np.allclose(averaged, net.weight._data * 0 + w_before * 2)
+    ma.restore()
+    np.testing.assert_allclose(net.weight.numpy(), w_before * 2.0)
+
+    # masked softmax helpers
+    s = paddle.incubate.softmax_mask_fuse_upper_triangle(
+        paddle.randn([1, 2, 4, 4]))
+    sn = s.numpy()
+    np.testing.assert_allclose(sn.sum(-1), 1.0, rtol=1e-4)
+    assert (sn[..., 0, 1:] == 0).all()       # causal row 0 sees only col 0
