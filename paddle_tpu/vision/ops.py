@@ -558,7 +558,31 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
 def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
                              refer_scale, pixel_offset=False,
                              rois_num=None, name=None):
-    raise NotImplementedError(
-        "distribute_fpn_proposals is not implemented; the level "
-        "assignment is floor(refer_level + log2(sqrt(area)/refer_scale)) "
-        "over roi areas — a five-line jnp composition if needed")
+    """Assign RoIs to FPN levels (reference vision/ops.py:1175): level =
+    clamp(floor(refer_level + log2(sqrt(area)/refer_scale))).  Returns
+    (per-level roi tensors, restore index, per-level counts)."""
+    import numpy as np
+
+    rois = np.asarray(_t(fpn_rois), np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 1e-12))
+    lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-12))
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+
+    multi_rois, counts, order = [], [], []
+    for L in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == L)[0]
+        multi_rois.append(Tensor(jnp.asarray(rois[idx].reshape(-1, 4))))
+        counts.append(len(idx))
+        order.extend(idx.tolist())
+    # restore_ind[i] = position of original roi i in the concatenated output
+    restore = np.empty(len(order), np.int64)
+    restore[np.asarray(order, np.int64)] = np.arange(len(order))
+    rois_num_per_level = [Tensor(jnp.asarray(np.asarray([c], np.int32)))
+                          for c in counts] if rois_num is not None else None
+    out = (multi_rois, Tensor(jnp.asarray(restore.reshape(-1, 1))))
+    if rois_num_per_level is not None:
+        return out[0], out[1], rois_num_per_level
+    return out

@@ -217,3 +217,23 @@ def test_psroi_pool_layer_and_stubs():
         V.generate_proposals(None, None, None, None, None)
     with pytest.raises(NotImplementedError):
         V.DeformConv2D()(None)
+
+
+def test_distribute_fpn_proposals():
+    rois = np.asarray([
+        [0, 0, 10, 10],       # tiny -> low level
+        [0, 0, 224, 224],     # refer scale -> refer level
+        [0, 0, 900, 900],     # huge -> high level
+    ], np.float32)
+    multi, restore, nums = V.distribute_fpn_proposals(
+        paddle.to_tensor(rois), min_level=2, max_level=5, refer_level=4,
+        refer_scale=224, rois_num=paddle.to_tensor(np.asarray([3], np.int32)))
+    assert len(multi) == 4                       # levels 2..5
+    assert multi[0].shape[0] == 1                # tiny at level 2
+    assert multi[2].shape[0] == 1                # 224 at refer level 4
+    assert multi[3].shape[0] == 1                # huge clamped to 5
+    # restore index reconstructs the original order
+    concat = np.concatenate([m.numpy() for m in multi])
+    back = concat[restore.numpy().reshape(-1)]
+    np.testing.assert_allclose(back, rois)
+    assert sum(int(n.numpy()[0]) for n in nums) == 3
