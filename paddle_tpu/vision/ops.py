@@ -550,9 +550,62 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
                        pre_nms_top_n=6000, post_nms_top_n=1000,
                        nms_thresh=0.5, min_size=0.1, eta=1.0,
                        pixel_offset=False, return_rois_num=False, name=None):
-    raise NotImplementedError(
-        "generate_proposals (RPN pipeline) is not implemented; compose "
-        "box_coder + nms, or register a custom op")
+    """RPN proposal generation (reference vision/ops.py:2106): decode
+    deltas against anchors, clip to the image, filter small boxes, NMS,
+    keep post_nms_top_n.  scores [N, A, H, W]; bbox_deltas [N, 4A, H, W];
+    anchors/variances [H, W, A, 4]."""
+    import numpy as np
+
+    sc = np.asarray(_t(scores), np.float32)
+    dl = np.asarray(_t(bbox_deltas), np.float32)
+    im = np.asarray(_t(img_size), np.float32)
+    an = np.asarray(_t(anchors), np.float32).reshape(-1, 4)
+    va = np.asarray(_t(variances), np.float32).reshape(-1, 4)
+    N, A = sc.shape[0], sc.shape[1]
+    off = 1.0 if pixel_offset else 0.0
+
+    all_rois, all_probs, nums = [], [], []
+    for n in range(N):
+        s_n = sc[n].transpose(1, 2, 0).reshape(-1)              # [H*W*A]
+        d_n = dl[n].reshape(A, 4, *dl.shape[-2:]).transpose(
+            2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s_n)[:pre_nms_top_n]
+        s_k, d_k = s_n[order], d_n[order]
+        an_k, va_k = an[order], va[order]
+        # decode (encode_center_size inverse, reference box_coder math)
+        aw = an_k[:, 2] - an_k[:, 0] + off
+        ah = an_k[:, 3] - an_k[:, 1] + off
+        acx = an_k[:, 0] + aw * 0.5
+        acy = an_k[:, 1] + ah * 0.5
+        cx = va_k[:, 0] * d_k[:, 0] * aw + acx
+        cy = va_k[:, 1] * d_k[:, 1] * ah + acy
+        w = np.exp(np.minimum(va_k[:, 2] * d_k[:, 2], 10.0)) * aw
+        h = np.exp(np.minimum(va_k[:, 3] * d_k[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - off, cy + h / 2 - off], axis=1)
+        H_img, W_img = im[n][0], im[n][1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, W_img - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, H_img - off)
+        ws = boxes[:, 2] - boxes[:, 0] + off
+        hs = boxes[:, 3] - boxes[:, 1] + off
+        keep = (ws >= min_size) & (hs >= min_size)
+        boxes, s_k = boxes[keep], s_k[keep]
+        if boxes.shape[0]:
+            kept = np.asarray(
+                nms(Tensor(jnp.asarray(boxes)), nms_thresh,
+                    Tensor(jnp.asarray(s_k))).numpy())[:post_nms_top_n]
+            boxes, s_k = boxes[kept], s_k[kept]
+        all_rois.append(boxes)
+        all_probs.append(s_k)
+        nums.append(boxes.shape[0])
+
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois, axis=0)
+                              if all_rois else np.zeros((0, 4), np.float32)))
+    probs = Tensor(jnp.asarray(np.concatenate(all_probs, axis=0)
+                               if all_probs else np.zeros((0,), np.float32)))
+    if return_rois_num:
+        return rois, probs, Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+    return rois, probs
 
 
 def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,

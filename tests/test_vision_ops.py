@@ -214,8 +214,6 @@ def test_psroi_pool_layer_and_stubs():
     with pytest.raises(NotImplementedError):
         V.yolo_loss(None, None, None, [], [], 3, 0.5, 32)
     with pytest.raises(NotImplementedError):
-        V.generate_proposals(None, None, None, None, None)
-    with pytest.raises(NotImplementedError):
         V.DeformConv2D()(None)
 
 
@@ -237,3 +235,33 @@ def test_distribute_fpn_proposals():
     back = concat[restore.numpy().reshape(-1)]
     np.testing.assert_allclose(back, rois)
     assert sum(int(n.numpy()[0]) for n in nums) == 3
+
+
+def test_generate_proposals():
+    H = W = 4
+    A = 2
+    rng = np.random.RandomState(0)
+    scores = paddle.to_tensor(rng.rand(1, A, H, W).astype(np.float32))
+    deltas = paddle.to_tensor(
+        (rng.rand(1, 4 * A, H, W).astype(np.float32) - 0.5) * 0.2)
+    img = paddle.to_tensor(np.asarray([[64.0, 64.0]], np.float32))
+    anchors = np.zeros((H, W, A, 4), np.float32)
+    for y in range(H):
+        for x in range(W):
+            for a in range(A):
+                size = 16.0 * (a + 1)
+                cx, cy = x * 16 + 8, y * 16 + 8
+                anchors[y, x, a] = [cx - size / 2, cy - size / 2,
+                                    cx + size / 2, cy + size / 2]
+    var = np.full((H, W, A, 4), 1.0, np.float32)
+    rois, probs, nums = V.generate_proposals(
+        scores, deltas, img, paddle.to_tensor(anchors),
+        paddle.to_tensor(var), pre_nms_top_n=20, post_nms_top_n=8,
+        nms_thresh=0.7, min_size=2.0, return_rois_num=True)
+    r = rois.numpy()
+    assert r.shape[0] == int(nums.numpy()[0]) <= 8
+    assert (r[:, 0] >= 0).all() and (r[:, 2] <= 64).all()
+    assert (r[:, 2] > r[:, 0]).all() and (r[:, 3] > r[:, 1]).all()
+    # scores sorted descending
+    p = probs.numpy()
+    assert (np.diff(p) <= 1e-6).all()
