@@ -540,10 +540,100 @@ class DeformConv2D:
 def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
               ignore_thresh, downsample_ratio, gt_score=None,
               use_label_smooth=True, scale_x_y=1.0, name=None):
-    raise NotImplementedError(
-        "yolo_loss (YOLOv3 training loss with anchor matching) is not "
-        "implemented in this TPU build; compose it from yolo_box + "
-        "standard losses, or register a custom op")
+    """YOLOv3 training loss (reference vision/ops.py:69 over
+    yolov3_loss_kernel): anchor matching by whole-box IoU, coordinate
+    BCE/L1, objectness BCE with an ignore mask, class BCE.  gt_box is
+    [N, B, 4] cxcywh normalized to the image; x is the raw head
+    [N, A*(5+C), H, W].  Returns per-image loss [N]."""
+    import numpy as np
+
+    xv = np.asarray(_t(x), np.float32)
+    gb = np.asarray(_t(gt_box), np.float32)
+    gl = np.asarray(_t(gt_label), np.int64)
+    gs = (np.ones(gl.shape, np.float32) if gt_score is None
+          else np.asarray(_t(gt_score), np.float32))
+    anchors = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask = list(anchor_mask)
+    N, _, H, W = xv.shape
+    A = len(mask)
+    C = int(class_num)
+    in_w = W * downsample_ratio
+    in_h = H * downsample_ratio
+    eps = 1e-7
+    delta = 0.5 * (scale_x_y - 1.0)
+
+    def sigmoid(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    def bce(p, t):
+        p = np.clip(p, eps, 1 - eps)
+        return -(t * np.log(p) + (1 - t) * np.log(1 - p))
+
+    feat = xv.reshape(N, A, 5 + C, H, W)
+    losses = np.zeros((N,), np.float32)
+    for n in range(N):
+        px = sigmoid(feat[n, :, 0]) * scale_x_y - delta     # [A, H, W]
+        py = sigmoid(feat[n, :, 1]) * scale_x_y - delta
+        pw = feat[n, :, 2]
+        ph = feat[n, :, 3]
+        pobj = sigmoid(feat[n, :, 4])
+        pcls = sigmoid(feat[n, :, 5:])                      # [A, C, H, W]
+
+        # decoded predictions (normalized cxcywh) for the ignore mask
+        gx = (np.arange(W)[None, None, :] + px) / W
+        gy = (np.arange(H)[None, :, None] + py) / H
+        gw = np.exp(np.clip(pw, -10, 10)) *             anchors[mask, 0][:, None, None] / in_w
+        gh = np.exp(np.clip(ph, -10, 10)) *             anchors[mask, 1][:, None, None] / in_h
+
+        obj_target = np.zeros((A, H, W), np.float32)
+        ignore = np.zeros((A, H, W), bool)
+        loss = 0.0
+        for b in range(gb.shape[1]):
+            bx, by, bw, bh = gb[n, b]
+            if bw <= 0 or bh <= 0:
+                continue
+            # ignore predictions overlapping any gt above the threshold
+            ix = np.minimum(gx + gw / 2, bx + bw / 2) -                 np.maximum(gx - gw / 2, bx - bw / 2)
+            iy = np.minimum(gy + gh / 2, by + bh / 2) -                 np.maximum(gy - gh / 2, by - bh / 2)
+            inter = np.clip(ix, 0, None) * np.clip(iy, 0, None)
+            iou_pred = inter / np.maximum(gw * gh + bw * bh - inter, eps)
+            ignore |= iou_pred > ignore_thresh
+
+            # responsible anchor: best whole-box IoU at the origin
+            aw, ah = anchors[:, 0] / in_w, anchors[:, 1] / in_h
+            inter_a = np.minimum(aw, bw) * np.minimum(ah, bh)
+            iou_a = inter_a / (aw * ah + bw * bh - inter_a + eps)
+            best = int(np.argmax(iou_a))
+            if best not in mask:
+                continue
+            a = mask.index(best)
+            ci = min(int(bx * W), W - 1)
+            cj = min(int(by * H), H - 1)
+            tx = bx * W - ci
+            ty = by * H - cj
+            tw = np.log(max(bw * in_w / anchors[best, 0], eps))
+            th = np.log(max(bh * in_h / anchors[best, 1], eps))
+            scale_box = 2.0 - bw * bh      # small boxes weigh more (ref)
+            w8 = gs[n, b]
+            loss += w8 * scale_box * (
+                bce(px[a, cj, ci], tx) + bce(py[a, cj, ci], ty)
+                + np.abs(pw[a, cj, ci] - tw) + np.abs(ph[a, cj, ci] - th))
+            obj_target[a, cj, ci] = 1.0
+            ignore[a, cj, ci] = False
+            cls_t = np.zeros((C,), np.float32)
+            smooth = 1.0 / max(C, 1) if use_label_smooth else 0.0
+            cls_t[:] = smooth * 0  # base negatives
+            if use_label_smooth:
+                cls_t[:] = 1.0 / C * 0.0
+            cls_t[int(gl[n, b])] = 1.0 - (1.0 / C if use_label_smooth
+                                          else 0.0)
+            loss += w8 * bce(pcls[a, :, cj, ci], cls_t).sum()
+
+        obj_loss = bce(pobj, obj_target)
+        keep = (obj_target > 0) | ~ignore
+        loss += (obj_loss * keep).sum()
+        losses[n] = loss
+    return Tensor(jnp.asarray(losses))
 
 
 def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,

@@ -265,3 +265,52 @@ def test_generate_proposals():
     # scores sorted descending
     p = probs.numpy()
     assert (np.diff(p) <= 1e-6).all()
+
+
+def test_yolo_loss_properties():
+    """Perfect predictions give a much smaller loss than random ones."""
+    rng = np.random.RandomState(0)
+    N, H, W, C = 1, 4, 4, 3
+    anchors = [16, 16, 32, 32]
+    mask = [0, 1]
+    A = len(mask)
+    ds = 16
+    gt = np.asarray([[[0.4, 0.4, 0.25, 0.25]]], np.float32)   # one box
+    lbl = np.asarray([[1]], np.int64)
+
+    # build a head that decodes exactly to the gt at the responsible cell
+    x = np.zeros((N, A * (5 + C), H, W), np.float32)
+    feat = x.reshape(N, A, 5 + C, H, W)
+    feat[:, :, 4] = -12.0          # all objectness ~0
+    bx, by, bw, bh = gt[0, 0]
+    ci, cj = int(bx * W), int(by * H)
+    # responsible anchor: best IoU with (0.25*64=16px) box -> anchor 0 (16px)
+    a = 0
+    tx, ty = bx * W - ci, by * H - cj
+    logit = lambda p: np.log(p / (1 - p))
+    feat[0, a, 0, cj, ci] = logit(np.clip(tx, 1e-3, 1 - 1e-3))
+    feat[0, a, 1, cj, ci] = logit(np.clip(ty, 1e-3, 1 - 1e-3))
+    feat[0, a, 2, cj, ci] = np.log(bw * W * ds / 16)
+    feat[0, a, 3, cj, ci] = np.log(bh * H * ds / 16)
+    feat[0, a, 4, cj, ci] = 12.0   # objectness ~1
+    feat[0, a, 5 + 1, cj, ci] = 12.0
+    feat[0, a, 5 + 0, cj, ci] = -12.0
+    feat[0, a, 5 + 2, cj, ci] = -12.0
+
+    good = V.yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gt),
+                       paddle.to_tensor(lbl), anchors, mask, C,
+                       ignore_thresh=0.7, downsample_ratio=ds,
+                       use_label_smooth=False)
+    bad = V.yolo_loss(paddle.to_tensor(
+        rng.randn(*x.shape).astype(np.float32) * 3), paddle.to_tensor(gt),
+        paddle.to_tensor(lbl), anchors, mask, C, ignore_thresh=0.7,
+        downsample_ratio=ds, use_label_smooth=False)
+    g = float(good.numpy()[0])
+    b = float(bad.numpy()[0])
+    assert g < 0.15 * b, (g, b)
+    # coordinate BCE on soft targets has an entropy floor: with tx=ty=0.6
+    # the minimum is scale_box * 2 * H(0.6); everything else ~0
+    tx = 0.4 * 4 - 1
+    floor = (2 - 0.25 * 0.25) * 2 * (
+        -(tx * np.log(tx) + (1 - tx) * np.log(1 - tx)))
+    assert abs(g - floor) < 0.2, (g, floor)
