@@ -96,41 +96,166 @@ class _LazyMatrix:
         return self._t
 
 
-def jacobian(func, xs, batch_axis=None):
-    """Jacobian of func at xs (reference autograd/autograd.py jacobian).
+def _batch_diag(jac, out_shape, in_shape):
+    """Full cross Jacobian [*out, *in] with leading batch dims on both
+    sides -> per-batch Jacobian [B, M, N] (reference batch_axis=0
+    semantics: no cross-batch terms)."""
+    B = out_shape[0]
+    M = 1
+    for d in out_shape[1:]:
+        M *= d
+    N = 1
+    for d in in_shape[1:]:
+        N *= d
+    j4 = jac.reshape(B, M, B, N)
+    return jnp.einsum("bmbn->bmn", j4)
 
-    Single input/single output: returns a lazy matrix of shape
-    [*out_shape, *in_shape] (batch_axis=0 keeps the leading batch dim
-    uncontracted, reference semantics).
+
+def _check_batch_axis(batch_axis):
+    if batch_axis is not None and batch_axis != 0:
+        raise ValueError(
+            f"batch_axis must be None or 0 (reference contract, "
+            f"autograd/autograd.py); got {batch_axis!r}")
+
+
+def _tape_jacobian_single(y, x, batch_axis):
+    """Jacobian of one computed tensor w.r.t. one input via repeated tape
+    backward (one one-hot VJP per output element — the eager analog of the
+    reference's double-grad formulation).
+
+    batch_axis=0 seeds all batch rows at once (cross-batch terms are zero
+    by the contract, so one backward recovers every batch's row): M
+    backwards instead of B*M.
     """
-    arrs, multi = _to_arrays(xs)
-    f = _wrap_fn(func, multi)
-    jac = jax.jacrev(f, argnums=tuple(range(len(arrs))))(*arrs)
-    if not multi:
-        jac = jac[0] if isinstance(jac, tuple) else jac
-        if isinstance(jac, tuple):
-            jac = jac[0]
-        return _LazyMatrix(jac)
-    return tuple(_LazyMatrix(j) for j in jac)
+    from ..core.tape import grad as tape_grad
+    import numpy as np
+
+    if batch_axis == 0:
+        B = y.shape[0] if y.shape else 1
+        M = int(np.prod(y.shape[1:])) if len(y.shape) > 1 else 1
+        N = int(np.prod(x.shape[1:])) if len(x.shape) > 1 else 1
+        rows = []
+        for m in range(M):
+            seed = jnp.zeros((B, M), y._data.dtype).at[:, m].set(1.0)
+            seed = seed.reshape(y._data.shape)
+            (g,) = tape_grad([y], [x], grad_outputs=[Tensor(seed)],
+                             retain_graph=True, allow_unused=True)
+            g = jnp.zeros_like(x._data) if g is None else g._data
+            rows.append(g.reshape(B, N))
+        return jnp.stack(rows, axis=1)                   # [B, M, N]
+
+    M = int(np.prod(y.shape)) if y.shape else 1
+    rows = []
+    for i in range(M):
+        seed = jnp.zeros((M,), y._data.dtype).at[i].set(1.0)
+        seed = seed.reshape(y._data.shape)
+        (g,) = tape_grad([y], [x], grad_outputs=[Tensor(seed)],
+                         retain_graph=True, allow_unused=True)
+        rows.append(jnp.zeros_like(x._data) if g is None else g._data)
+    return jnp.stack([r.reshape(-1) for r in rows])      # [M, N]
 
 
-def hessian(func, xs, batch_axis=None):
-    """Hessian of a scalar-valued func at xs (reference autograd/autograd.py
-    hessian)."""
-    arrs, multi = _to_arrays(xs)
-    f = _wrap_fn(func, multi)
+def jacobian(ys, xs, batch_axis=None):
+    """Jacobian (reference python/paddle/autograd/autograd.py jacobian).
 
-    def scalar(*a):
-        out = f(*a)
-        out = out[0] if isinstance(out, tuple) else out
-        if out.ndim != 0:
-            raise ValueError(
-                f"hessian needs a scalar-valued func; got output shape "
-                f"{tuple(out.shape)}")
-        return out
+    Reference contract: ``ys``/``xs`` are COMPUTED paddle Tensors (xs with
+    ``stop_gradient=False`` participating in ys' graph); returns a lazy
+    matrix [M, N] (flattened), or [B, M, N] with ``batch_axis=0`` (no
+    cross-batch terms).  A callable first argument selects the
+    incubate-style functional form ``jacobian(func, xs)`` for
+    compatibility with paddle.incubate.autograd.
+    """
+    _check_batch_axis(batch_axis)
+    if callable(ys) and not isinstance(ys, Tensor):
+        func = ys
+        arrs, multi = _to_arrays(xs)
+        f = _wrap_fn(func, multi)
+        argnums = tuple(range(len(arrs)))
+        if batch_axis == 0:
+            # vmap over the batch: per-example jacrev gives [B, M, N]
+            # directly with O(B) memory (no cross-batch blocks built)
+            import math
+            jac = jax.vmap(jax.jacrev(f, argnums=argnums))(*arrs)
+            jac = tuple(
+                j.reshape(j.shape[0], -1, math.prod(a.shape[1:]) or 1)
+                for j, a in zip(jac, arrs))
+        else:
+            jac = jax.jacrev(f, argnums=argnums)(*arrs)
+        if not multi:
+            jac = jac[0] if isinstance(jac, tuple) else jac
+            if isinstance(jac, tuple):
+                jac = jac[0]
+            return _LazyMatrix(jac)
+        return tuple(_LazyMatrix(j) for j in jac)
 
-    hes = jax.hessian(scalar, argnums=tuple(range(len(arrs))))(*arrs)
-    if not multi:
-        h = hes[0][0] if isinstance(hes, tuple) else hes
-        return _LazyMatrix(h)
-    return tuple(tuple(_LazyMatrix(h) for h in row) for row in hes)
+    ys_t = ys if isinstance(ys, (list, tuple)) else (ys,)
+    xs_t = xs if isinstance(xs, (list, tuple)) else (xs,)
+    out = tuple(tuple(_LazyMatrix(_tape_jacobian_single(y, x, batch_axis))
+                      for x in xs_t) for y in ys_t)
+    if not isinstance(ys, (list, tuple)):
+        out = out[0]
+        return out[0] if not isinstance(xs, (list, tuple)) else out
+    if not isinstance(xs, (list, tuple)):
+        return tuple(row[0] for row in out)
+    return out
+
+
+def hessian(ys, xs, batch_axis=None):
+    """Hessian (reference autograd/autograd.py hessian): ``ys`` a computed
+    scalar Tensor (or [B, 1] with ``batch_axis=0``), ``xs`` the inputs.
+    Callable first argument selects the incubate functional form."""
+    _check_batch_axis(batch_axis)
+    if callable(ys) and not isinstance(ys, Tensor):
+        func = ys
+        arrs, multi = _to_arrays(xs)
+        f = _wrap_fn(func, multi)
+
+        def scalar(*a):
+            out = f(*a)
+            out = out[0] if isinstance(out, tuple) else out
+            if out.ndim and out.shape[-1] == 1:
+                out = out[..., 0]
+            if out.ndim != 0:
+                raise ValueError(
+                    f"hessian needs a scalar-valued func; got output shape "
+                    f"{tuple(out.shape)}")
+            return out
+
+        argnums = tuple(range(len(arrs)))
+        if batch_axis == 0:
+            # per-example hessian via vmap: [B, Ni, Nj] without the
+            # O(B^2) cross-batch blocks
+            import math
+            hes = jax.vmap(jax.hessian(scalar, argnums=argnums))(*arrs)
+            hes = tuple(tuple(
+                h.reshape(h.shape[0], math.prod(ai.shape[1:]) or 1,
+                          math.prod(aj.shape[1:]) or 1)
+                for h, aj in zip(row, arrs))
+                for row, ai in zip(hes, arrs))
+        else:
+            hes = jax.hessian(scalar, argnums=argnums)(*arrs)
+        if not multi:
+            h = hes[0][0] if isinstance(hes, tuple) else hes
+            return _LazyMatrix(h)
+        return tuple(tuple(_LazyMatrix(h) for h in row) for row in hes)
+
+    from ..core.tape import grad as tape_grad
+    xs_t = xs if isinstance(xs, (list, tuple)) else (xs,)
+    if isinstance(ys, (list, tuple)) and len(ys) != 1:
+        raise ValueError(
+            f"hessian needs a single scalar ys; got {len(ys)} tensors")
+    y = ys[0] if isinstance(ys, (list, tuple)) else ys
+    import numpy as np
+    if int(np.prod(y.shape)) != (y.shape[0] if batch_axis == 0 and y.shape
+                                 else 1):
+        raise ValueError(
+            f"hessian needs scalar ys (or [B, 1] with batch_axis=0); got "
+            f"shape {tuple(y.shape)}")
+    firsts = tape_grad([y], list(xs_t), create_graph=True,
+                       allow_unused=True)
+    out = tuple(tuple(_LazyMatrix(
+        _tape_jacobian_single(g, x, batch_axis)) for x in xs_t)
+        for g in firsts)
+    if not isinstance(xs, (list, tuple)):
+        return out[0][0]
+    return out

@@ -388,7 +388,60 @@ def dice_loss(input, label, epsilon=1e-5, name=None):
 
 def hsigmoid_loss(input, label, num_classes, weight, bias=None, path_table=None,
                   path_code=None, is_sparse=False, name=None):
-    raise NotImplementedError("hsigmoid_loss: deferred (hierarchical softmax)")
+    """Hierarchical sigmoid loss (reference nn/functional/loss.py hsigmoid_loss
+    over phi hsigmoid_loss kernel + matrix_bit_code.h SimpleCode/CustomCode).
+
+    Default tree: binary-heap coding — for label l, c = l + num_classes,
+    path length = floor(log2(c)), node index at bit k = (c >> (k+1)) - 1,
+    bit value = (c >> k) & 1.  Loss per sample = sum over path bits of
+    BCE-with-logits(w[idx]·x + b[idx], bit).  Custom tree: path_table /
+    path_code rows (negative entries pad).  TPU formulation: the
+    variable-length paths become a fixed [N, L] gather + mask, so the
+    whole loss is one batched matvec (MXU) under jit.  is_sparse is a
+    storage hint in the reference; dense gather here.
+    """
+    def _hs(x, lab, w, b, pt, pc, num_classes):
+        K = w.shape[0]
+        l = lab.reshape(-1).astype(jnp.int32)
+        if pt is None:
+            c = l + num_classes                               # [N]
+            # max path length: bits needed for 2*num_classes
+            Lmax = max(int(num_classes - 1).bit_length(), 1)
+            bits = jnp.arange(Lmax, dtype=jnp.int32)
+            # floor(log2(c)) via vectorized find-last-set
+            length = jnp.sum((c[:, None] >> (bits[None, :] + 1)) > 0,
+                             axis=1)                          # [N]
+            idx = (c[:, None] >> (bits[None, :] + 1)) - 1     # [N, L]
+            bitv = ((c[:, None] >> bits[None, :]) & 1).astype(x.dtype)
+            mask = (bits[None, :] < length[:, None]).astype(x.dtype)
+        else:
+            idx = pt.astype(jnp.int32)
+            bitv = pc.astype(x.dtype)
+            mask = (idx >= 0).astype(x.dtype)
+        idx_safe = jnp.clip(idx, 0, K - 1)
+        pre = jnp.einsum("nd,nld->nl", x, w[idx_safe],
+                         preferred_element_type=jnp.float32).astype(x.dtype)
+        if b is not None:
+            pre = pre + b.reshape(-1)[idx_safe]
+        pre = jnp.clip(pre, -40.0, 40.0)                      # ref clip
+        loss_bits = jax.nn.softplus(pre) - bitv * pre
+        return jnp.sum(loss_bits * mask, axis=-1, keepdims=True)
+
+    tensors = [input, label, weight]
+    names = ["x", "lab", "w"]
+    opt = {"b": bias, "pt": path_table, "pc": path_code}
+    for k, v in opt.items():
+        if v is not None:
+            tensors.append(v)
+            names.append(k)
+
+    def impl(*arrs, num_classes):
+        kw = dict(zip(names, arrs))
+        return _hs(kw["x"], kw["lab"], kw["w"], kw.get("b"),
+                   kw.get("pt"), kw.get("pc"), num_classes)
+
+    return D.apply("hsigmoid_loss", impl, tuple(tensors),
+                   {"num_classes": int(num_classes)})
 
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,

@@ -7,10 +7,10 @@ dispatcher (roi_align, roi_pool, box_coder, yolo_box, psroi_pool); NMS — a
 data-dependent sequential suppression — runs as a fixed-iteration on-device
 loop (lax.fori_loop over boxes, the standard XLA formulation) so it stays
 jittable.  prior_box / matrix_nms / read_file / decode_jpeg run host-side
-(anchor generation and IO are data-pipeline work).  deform_conv2d /
-generate_proposals / yolo_loss / distribute_fpn_proposals raise with
-guidance — detection-pipeline specials the reference gates behind CUDA
-kernels.
+(anchor generation and IO are data-pipeline work).  deform_conv2d runs as a
+gather-based bilinear-sample + matmul formulation (jittable, MXU-friendly);
+yolo_loss / generate_proposals / distribute_fpn_proposals run host-side as
+the reference's detection-pipeline specials do (data-dependent shapes).
 """
 from __future__ import annotations
 
@@ -344,11 +344,96 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
 def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
                   dilation=1, deformable_groups=1, groups=1, mask=None,
                   name=None):
-    raise NotImplementedError(
-        "deform_conv2d is not implemented in this TPU build (the reference "
-        "gates it behind a CUDA kernel, vision/ops.py:766); use roi_align "
-        "or standard conv2d, or register a custom Pallas kernel via "
-        "paddle_tpu.utils.cpp_extension")
+    """Deformable convolution v1/v2 (reference vision/ops.py:766 over
+    deformable_conv CUDA kernel).  TPU formulation: bilinear gather of the
+    kH*kW deformed sample points into an im2col tensor, then one grouped
+    matmul against the flattened weight — the gather is VPU work, the
+    contraction lands on the MXU, and the whole thing is jittable and
+    differentiable through jax.grad.
+
+    x [N, Cin, H, W]; offset [N, 2*dg*kH*kW, Ho, Wo] with channels
+    alternating (dy, dx) per kernel point; mask [N, dg*kH*kW, Ho, Wo]
+    (v2) or None (v1); weight [Cout, Cin/groups, kH, kW].
+    """
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    sh, sw = _pair(stride)
+    ph_, pw_ = _pair(padding)
+    dh, dw = _pair(dilation)
+
+    def impl(x, offset, weight, mask, sh, sw, ph, pw, dh, dw, dg, groups):
+        N, Cin, H, W = x.shape
+        Cout, Cin_g, kH, kW = weight.shape
+        Ho, Wo = offset.shape[-2:]
+        K = kH * kW
+        Cg = Cin // dg
+
+        offv = offset.reshape(N, dg, K, 2, Ho, Wo).astype(jnp.float32)
+        # base sampling grid per kernel point
+        ki = (jnp.arange(K) // kW) * dh                    # [K]
+        kj = (jnp.arange(K) % kW) * dw
+        ybase = jnp.arange(Ho) * sh - ph                   # [Ho]
+        xbase = jnp.arange(Wo) * sw - pw
+        ys = (ybase[None, :, None] + ki[:, None, None]
+              + 0 * xbase[None, None, :])                  # [K, Ho, Wo]
+        xs = (xbase[None, None, :] + kj[:, None, None]
+              + 0 * ybase[None, :, None])
+        ys = ys[None, None] + offv[:, :, :, 0]             # [N, dg, K, Ho, Wo]
+        xs = xs[None, None] + offv[:, :, :, 1]
+
+        # bilinear corners; samples fully outside contribute zero
+        y0 = jnp.floor(ys)
+        x0 = jnp.floor(xs)
+        wy = ys - y0
+        wx = xs - x0
+        xg = x.reshape(N, dg, Cg, H * W)
+
+        def corner(yc, xc, w8):
+            valid = ((yc >= 0) & (yc <= H - 1) & (xc >= 0) & (xc <= W - 1))
+            yi = jnp.clip(yc, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(xc, 0, W - 1).astype(jnp.int32)
+            flat = (yi * W + xi).reshape(N, dg, 1, -1)     # [N,dg,1,K*Ho*Wo]
+            g = jnp.take_along_axis(
+                xg, jnp.broadcast_to(flat, (N, dg, Cg, flat.shape[-1])),
+                axis=-1).reshape(N, dg, Cg, K, Ho, Wo)
+            w8 = (w8 * valid)[:, :, None]                  # [N,dg,1,K,Ho,Wo]
+            return g * w8
+
+        samp = (corner(y0, x0, (1 - wy) * (1 - wx))
+                + corner(y0, x0 + 1, (1 - wy) * wx)
+                + corner(y0 + 1, x0, wy * (1 - wx))
+                + corner(y0 + 1, x0 + 1, wy * wx))         # [N,dg,Cg,K,Ho,Wo]
+        if mask is not None:
+            m = mask.reshape(N, dg, 1, K, Ho, Wo).astype(samp.dtype)
+            samp = samp * m
+
+        Cout_g = Cout // groups
+        Cin_gp = Cin // groups
+        cols = samp.reshape(N, Cin, K, Ho * Wo).reshape(
+            N, groups, Cin_gp, K, Ho * Wo)
+        wmat = weight.reshape(groups, Cout_g, Cin_gp, K).astype(samp.dtype)
+        out = jnp.einsum("ngckp,gock->ngop", cols, wmat,
+                         preferred_element_type=jnp.float32)
+        return out.reshape(N, Cout, Ho, Wo).astype(x.dtype)
+
+    tensors = (x, offset, weight) if mask is None \
+        else (x, offset, weight, mask)
+
+    if mask is None:
+        def impl2(x, offset, weight, **kw):
+            return impl(x, offset, weight, None, **kw)
+    else:
+        def impl2(x, offset, weight, mask, **kw):
+            return impl(x, offset, weight, mask, **kw)
+
+    out = D.apply("deform_conv2d", impl2, tensors,
+                  {"sh": sh, "sw": sw, "ph": ph_, "pw": pw_,
+                   "dh": dh, "dw": dw, "dg": int(deformable_groups),
+                   "groups": int(groups)})
+    if bias is not None:
+        out = out + bias.reshape((1, -1, 1, 1))
+    return out
 
 
 class RoIAlign:
@@ -526,15 +611,37 @@ class PSRoIPool:
                           self.spatial_scale)
 
 
-class DeformConv2D:
-    """(reference vision/ops.py:973) — constructible for API parity; the
-    kernel is CUDA-gated in the reference and unimplemented here."""
+from ..nn import Layer as _Layer  # noqa: E402  (after Tensor/dispatch deps)
 
-    def __init__(self, *a, **k):
-        pass
 
-    def __call__(self, *a, **k):
-        return deform_conv2d(None, None, None)
+class DeformConv2D(_Layer):
+    """Deformable conv layer (reference vision/ops.py:973): holds the
+    trainable conv weight/bias; offset (and v2 mask) arrive at call time
+    from a separate branch, as in the reference."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.deformable_groups = deformable_groups
+        self.groups = groups
+        from ..nn.initializer.attr import ParamAttr
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, *ks],
+            attr=ParamAttr._to_attr(weight_attr))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=ParamAttr._to_attr(bias_attr),
+            is_bias=True))
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             self.stride, self.padding, self.dilation,
+                             self.deformable_groups, self.groups, mask)
 
 
 def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
@@ -618,20 +725,24 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
             loss += w8 * scale_box * (
                 bce(px[a, cj, ci], tx) + bce(py[a, cj, ci], ty)
                 + np.abs(pw[a, cj, ci] - tw) + np.abs(ph[a, cj, ci] - th))
-            obj_target[a, cj, ci] = 1.0
+            obj_target[a, cj, ci] = w8
             ignore[a, cj, ci] = False
-            cls_t = np.zeros((C,), np.float32)
-            smooth = 1.0 / max(C, 1) if use_label_smooth else 0.0
-            cls_t[:] = smooth * 0  # base negatives
-            if use_label_smooth:
-                cls_t[:] = 1.0 / C * 0.0
-            cls_t[int(gl[n, b])] = 1.0 - (1.0 / C if use_label_smooth
-                                          else 0.0)
+            # label smoothing per the reference kernel: negatives get
+            # smooth_weight = min(1/C, 1/40), the positive 1 - smooth_weight
+            smooth = min(1.0 / max(C, 1), 1.0 / 40.0) if use_label_smooth \
+                else 0.0
+            cls_t = np.full((C,), smooth, np.float32)
+            cls_t[int(gl[n, b])] = 1.0 - smooth
             loss += w8 * bce(pcls[a, :, cj, ci], cls_t).sum()
 
-        obj_loss = bce(pobj, obj_target)
-        keep = (obj_target > 0) | ~ignore
-        loss += (obj_loss * keep).sum()
+        # objectness: positives target 1.0 weighted by the mixup score
+        # (reference CalcObjnessLoss: obj_mask holds the score); negatives
+        # target 0.0 unweighted; ignored cells contribute nothing
+        pos = obj_target > 0
+        obj_loss = bce(pobj, pos.astype(np.float32))
+        weight = np.where(pos, obj_target, 1.0)
+        keep = pos | ~ignore
+        loss += (obj_loss * weight * keep).sum()
         losses[n] = loss
     return Tensor(jnp.asarray(losses))
 
@@ -678,7 +789,13 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
         boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, H_img - off)
         ws = boxes[:, 2] - boxes[:, 0] + off
         hs = boxes[:, 3] - boxes[:, 1] + off
-        keep = (ws >= min_size) & (hs >= min_size)
+        ms = max(float(min_size), 1.0)   # reference clamps min_size to >= 1
+        keep = (ws >= ms) & (hs >= ms)
+        if pixel_offset:
+            # reference additionally requires the box center inside the image
+            cxs = boxes[:, 0] + ws / 2
+            cys = boxes[:, 1] + hs / 2
+            keep &= (cxs <= W_img) & (cys <= H_img)
         boxes, s_k = boxes[keep], s_k[keep]
         if boxes.shape[0]:
             kept = np.asarray(
@@ -714,18 +831,35 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-12))
     lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
 
+    # per-image roi spans: within each level, rois stay grouped by image
+    # and the per-level counts are [batch_size] tensors (reference
+    # distribute_fpn_proposals_kernel semantics)
+    if rois_num is not None:
+        per_img = np.asarray(_t(rois_num), np.int64).reshape(-1)
+    else:
+        per_img = np.asarray([rois.shape[0]], np.int64)
+    starts = np.concatenate([[0], np.cumsum(per_img)])
+
     multi_rois, counts, order = [], [], []
     for L in range(min_level, max_level + 1):
-        idx = np.nonzero(lvl == L)[0]
-        multi_rois.append(Tensor(jnp.asarray(rois[idx].reshape(-1, 4))))
-        counts.append(len(idx))
-        order.extend(idx.tolist())
+        idx_level, cnt_level = [], []
+        for n in range(len(per_img)):
+            img_idx = np.arange(starts[n], starts[n + 1])
+            idx = img_idx[lvl[starts[n]:starts[n + 1]] == L]
+            idx_level.append(idx)
+            cnt_level.append(len(idx))
+        idx_level = (np.concatenate(idx_level) if idx_level
+                     else np.zeros((0,), np.int64))
+        multi_rois.append(Tensor(jnp.asarray(
+            rois[idx_level].reshape(-1, 4))))
+        counts.append(cnt_level)
+        order.extend(idx_level.tolist())
     # restore_ind[i] = position of original roi i in the concatenated output
     restore = np.empty(len(order), np.int64)
     restore[np.asarray(order, np.int64)] = np.arange(len(order))
-    rois_num_per_level = [Tensor(jnp.asarray(np.asarray([c], np.int32)))
-                          for c in counts] if rois_num is not None else None
     out = (multi_rois, Tensor(jnp.asarray(restore.reshape(-1, 1))))
-    if rois_num_per_level is not None:
+    if rois_num is not None:
+        rois_num_per_level = [Tensor(jnp.asarray(np.asarray(c, np.int32)))
+                              for c in counts]
         return out[0], out[1], rois_num_per_level
     return out

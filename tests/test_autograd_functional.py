@@ -75,3 +75,42 @@ def test_version_module(capsys):
     assert "jax" in paddle.version.tpu()
     paddle.version.show()
     assert "full_version" in capsys.readouterr().out
+
+
+def test_jacobian_tensor_contract():
+    """Reference paddle.autograd.jacobian(ys, xs): computed-tensor form."""
+    x = paddle.to_tensor(np.asarray([1., 2., 3.], np.float32),
+                         stop_gradient=False)
+    y = x * x
+    J = jacobian(y, x)
+    np.testing.assert_allclose(J.numpy(), np.diag([2., 4., 6.]), rtol=1e-5)
+
+
+def test_jacobian_batch_axis():
+    """batch_axis=0 gives per-batch [B, M, N] with no cross-batch terms."""
+    xb = np.asarray([[1., 2.], [3., 4.]], np.float32)
+    x = paddle.to_tensor(xb, stop_gradient=False)
+    y = x * x                                    # elementwise: diag per batch
+    J = jacobian(y, x, batch_axis=0)
+    assert tuple(J.shape) == (2, 2, 2)
+    np.testing.assert_allclose(J.numpy()[0], np.diag(2 * xb[0]), rtol=1e-5)
+    np.testing.assert_allclose(J.numpy()[1], np.diag(2 * xb[1]), rtol=1e-5)
+
+    # functional form honors batch_axis the same way
+    J2 = jacobian(lambda t: t * t, paddle.to_tensor(xb), batch_axis=0)
+    np.testing.assert_allclose(J2.numpy(), J.numpy(), rtol=1e-5)
+
+    # invalid batch_axis is rejected, not ignored
+    try:
+        jacobian(y, x, batch_axis=1)
+        raise AssertionError("batch_axis=1 should raise")
+    except ValueError:
+        pass
+
+
+def test_hessian_tensor_contract():
+    x = paddle.to_tensor(np.asarray([1., 2.], np.float32),
+                         stop_gradient=False)
+    y = (x * x * x).sum()                        # H = diag(6x)
+    H = hessian(y, x)
+    np.testing.assert_allclose(H.numpy(), np.diag([6., 12.]), rtol=1e-5)

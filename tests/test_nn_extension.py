@@ -180,3 +180,58 @@ def test_beam_search_decode():
     best = seqs.numpy()[:, 0, 0]
     np.testing.assert_array_equal(best[:4], [1, 2, 3, 4])
     assert (best[4:] == 4).all()     # frozen at end_token afterwards
+
+
+def test_hsigmoid_loss_default_tree():
+    """Default binary-heap coding vs a numpy oracle (reference
+    matrix_bit_code.h SimpleCode: c = label + num_classes)."""
+    rng = np.random.RandomState(0)
+    N, D, C = 4, 5, 6
+    x = rng.randn(N, D).astype(np.float32)
+    lab = rng.randint(0, C, (N, 1)).astype(np.int64)
+    w = rng.randn(C - 1, D).astype(np.float32)
+    b = rng.randn(C - 1, 1).astype(np.float32)
+
+    def oracle():
+        out = np.zeros((N, 1), np.float32)
+        for n in range(N):
+            c = int(lab[n, 0]) + C
+            length = c.bit_length() - 1
+            s = 0.0
+            for k in range(length):
+                idx = (c >> (k + 1)) - 1
+                bit = (c >> k) & 1
+                pre = float(w[idx] @ x[n] + b[idx, 0])
+                pre = np.clip(pre, -40, 40)
+                s += np.log1p(np.exp(pre)) - bit * pre
+            out[n, 0] = s
+        return out
+
+    got = F.hsigmoid_loss(paddle.to_tensor(x), paddle.to_tensor(lab), C,
+                          paddle.to_tensor(w), paddle.to_tensor(b))
+    np.testing.assert_allclose(got.numpy(), oracle(), rtol=1e-4, atol=1e-5)
+
+
+def test_hsigmoid_loss_custom_path_and_grad():
+    rng = np.random.RandomState(1)
+    N, D, K, L = 3, 4, 5, 3
+    x = paddle.to_tensor(rng.randn(N, D).astype(np.float32),
+                         stop_gradient=False)
+    lab = paddle.to_tensor(np.zeros((N, 1), np.int64))
+    w = paddle.to_tensor(rng.randn(K, D).astype(np.float32),
+                         stop_gradient=False)
+    pt = np.asarray([[0, 1, -1], [2, -1, -1], [3, 4, 0]], np.int64)
+    pc = np.asarray([[1, 0, 0], [1, 1, 0], [0, 1, 1]], np.int64)
+    out = F.hsigmoid_loss(x, lab, K + 1, w, None,
+                          paddle.to_tensor(pt), paddle.to_tensor(pc))
+    assert out.shape == [N, 1]
+    assert np.isfinite(out.numpy()).all()
+    out.sum().backward()
+    assert x.grad is not None and w.grad is not None
+    assert np.abs(w.grad.numpy()).sum() > 0
+
+    # layer form
+    layer = nn.HSigmoidLoss(D, 8)
+    loss = layer(paddle.to_tensor(rng.randn(2, D).astype(np.float32)),
+                 paddle.to_tensor(np.asarray([[1], [5]], np.int64)))
+    assert loss.shape == [2, 1]

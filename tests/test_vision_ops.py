@@ -146,9 +146,43 @@ def test_psroi_pool_shapes():
     assert out.shape == [1, 2, 2, 2]   # 8 channels / (2*2) = 2 out channels
 
 
-def test_deform_conv_raises():
-    with pytest.raises(NotImplementedError, match="deform_conv2d"):
-        V.deform_conv2d(None, None, None)
+def test_deform_conv_zero_offset_matches_conv():
+    """With zero offsets and unit mask, deform_conv2d is a plain conv."""
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(0)
+    N, Cin, H, W, Cout, k = 2, 4, 8, 8, 6, 3
+    x = rng.randn(N, Cin, H, W).astype(np.float32)
+    w = rng.randn(Cout, Cin, k, k).astype(np.float32) * 0.2
+    Ho = Wo = H - k + 1
+    off = np.zeros((N, 2 * k * k, Ho, Wo), np.float32)
+    out = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                          paddle.to_tensor(w))
+    ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w))
+    np.testing.assert_allclose(out.numpy(), ref.numpy(),
+                               rtol=2e-4, atol=2e-4)
+    # v2: a half mask halves the output
+    m = np.full((N, k * k, Ho, Wo), 0.5, np.float32)
+    out2 = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                           paddle.to_tensor(w), mask=paddle.to_tensor(m))
+    np.testing.assert_allclose(out2.numpy(), ref.numpy() * 0.5,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_deform_conv_integer_offset_shifts():
+    """An integer (dy, dx) offset samples the shifted pixel exactly."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 1, 6, 6).astype(np.float32)
+    w = np.ones((1, 1, 1, 1), np.float32)
+    # 1x1 kernel, offset (+1, +2): out[h, w] = x[h+1, w+2] (zeros outside)
+    off = np.zeros((1, 2, 6, 6), np.float32)
+    off[:, 0] = 1.0
+    off[:, 1] = 2.0
+    out = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                          paddle.to_tensor(w)).numpy()[0, 0]
+    expect = np.zeros((6, 6), np.float32)
+    expect[:5, :4] = x[0, 0, 1:, 2:]
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
 
 
 def test_prior_box():
@@ -211,8 +245,10 @@ def test_psroi_pool_layer_and_stubs():
                                               np.float32)),
                paddle.to_tensor(np.asarray([1], np.int32)))
     assert out.shape == [1, 2, 2, 2]
-    with pytest.raises(NotImplementedError):
-        V.DeformConv2D()(None)
+    layer = V.DeformConv2D(4, 6, 3)
+    xx = paddle.to_tensor(np.random.rand(1, 4, 8, 8).astype(np.float32))
+    off = paddle.to_tensor(np.zeros((1, 18, 6, 6), np.float32))
+    assert layer(xx, off).shape == [1, 6, 6, 6]
 
 
 def test_distribute_fpn_proposals():
@@ -233,6 +269,31 @@ def test_distribute_fpn_proposals():
     back = concat[restore.numpy().reshape(-1)]
     np.testing.assert_allclose(back, rois)
     assert sum(int(n.numpy()[0]) for n in nums) == 3
+
+
+def test_distribute_fpn_proposals_batched():
+    """Per-level counts are [batch] tensors and rois stay image-grouped
+    within each level (reference distribute_fpn_proposals_kernel)."""
+    rois = np.asarray([
+        [0, 0, 10, 10],       # img0: tiny -> level 2
+        [0, 0, 900, 900],     # img0: huge -> level 5
+        [0, 0, 11, 11],       # img1: tiny -> level 2
+        [0, 0, 224, 224],     # img1: refer -> level 4
+    ], np.float32)
+    multi, restore, nums = V.distribute_fpn_proposals(
+        paddle.to_tensor(rois), min_level=2, max_level=5, refer_level=4,
+        refer_scale=224,
+        rois_num=paddle.to_tensor(np.asarray([2, 2], np.int32)))
+    # per-level counts are length-2 (per-image) vectors
+    assert all(n.numpy().shape == (2,) for n in nums)
+    np.testing.assert_array_equal(nums[0].numpy(), [1, 1])   # level 2
+    np.testing.assert_array_equal(nums[2].numpy(), [0, 1])   # level 4
+    np.testing.assert_array_equal(nums[3].numpy(), [1, 0])   # level 5
+    # within level 2 the img0 roi precedes the img1 roi
+    np.testing.assert_allclose(multi[0].numpy(),
+                               [[0, 0, 10, 10], [0, 0, 11, 11]])
+    concat = np.concatenate([m.numpy() for m in multi])
+    np.testing.assert_allclose(concat[restore.numpy().reshape(-1)], rois)
 
 
 def test_generate_proposals():
@@ -312,3 +373,64 @@ def test_yolo_loss_properties():
     floor = (2 - 0.25 * 0.25) * 2 * (
         -(tx * np.log(tx) + (1 - tx) * np.log(1 - tx)))
     assert abs(g - floor) < 0.2, (g, floor)
+
+
+def test_yolo_loss_label_smooth_and_gt_score():
+    """Label smoothing uses smooth = min(1/C, 1/40) (negatives -> smooth,
+    positive -> 1 - smooth), and gt_score scales the positive terms."""
+    rng = np.random.RandomState(3)
+    N, H, W, C = 1, 4, 4, 80          # C=80 exercises the 1/40 clamp
+    anchors = [16, 16, 32, 32]
+    mask = [0, 1]
+    ds = 16
+    x = rng.randn(N, len(mask) * (5 + C), H, W).astype(np.float32)
+    gt = np.asarray([[[0.4, 0.4, 0.25, 0.25]]], np.float32)
+    lbl = np.asarray([[1]], np.int64)
+
+    kw = dict(anchors=anchors, anchor_mask=mask, class_num=C,
+              ignore_thresh=0.7, downsample_ratio=ds)
+    smoothed = float(V.yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gt),
+                                 paddle.to_tensor(lbl), use_label_smooth=True,
+                                 **kw).numpy()[0])
+    hard = float(V.yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gt),
+                             paddle.to_tensor(lbl), use_label_smooth=False,
+                             **kw).numpy()[0])
+    assert smoothed != hard           # smoothing changed the class targets
+
+    # C=20 < 40 exercises the clamp: smooth must be 1/40, NOT 1/20.
+    # BCE is linear in the target, so smoothed - hard =
+    # s * sum_c dt_c * log((1-p_c)/p_c) with dt = +1 negatives, -1 positive.
+    C2 = 20
+    x2 = rng.randn(N, len(mask) * (5 + C2), H, W).astype(np.float32)
+    kw2 = dict(kw, class_num=C2)
+    sm2 = float(V.yolo_loss(paddle.to_tensor(x2), paddle.to_tensor(gt),
+                            paddle.to_tensor(lbl), use_label_smooth=True,
+                            **kw2).numpy()[0])
+    hd2 = float(V.yolo_loss(paddle.to_tensor(x2), paddle.to_tensor(gt),
+                            paddle.to_tensor(lbl), use_label_smooth=False,
+                            **kw2).numpy()[0])
+    # matched cell from the gt: anchor 0 (16px best IoU), ci = cj = 1
+    a, ci, cj = 0, int(0.4 * W), int(0.4 * H)
+    feat2 = x2.reshape(N, len(mask), 5 + C2, H, W)
+    p = 1.0 / (1.0 + np.exp(-feat2[0, a, 5:, cj, ci]))
+    dlog = np.log((1 - p) / p)
+    dt = np.ones(C2)
+    dt[int(lbl[0, 0])] = -1.0
+    for s, ok in [(1.0 / 40.0, True), (1.0 / C2, False)]:
+        close = abs((sm2 - hd2) - s * float((dt * dlog).sum())) < 1e-3
+        assert close == ok, (s, sm2 - hd2)
+
+    # gt_score scales coord/class/objectness positives: score 0.5 must give
+    # a loss strictly between score 0 (box ignored weight) and score 1
+    full = float(V.yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gt),
+                             paddle.to_tensor(lbl),
+                             gt_score=paddle.to_tensor(
+                                 np.asarray([[1.0]], np.float32)),
+                             use_label_smooth=False, **kw).numpy()[0])
+    half = float(V.yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gt),
+                             paddle.to_tensor(lbl),
+                             gt_score=paddle.to_tensor(
+                                 np.asarray([[0.5]], np.float32)),
+                             use_label_smooth=False, **kw).numpy()[0])
+    assert abs(full - hard) < 1e-5    # default score is 1.0
+    assert half != full

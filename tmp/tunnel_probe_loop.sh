@@ -1,0 +1,21 @@
+#!/bin/bash
+# Poll the axon TPU tunnel; exit 0 the moment it answers.
+# Probe runs in a subprocess with a hard timeout because a dead tunnel HANGS imports.
+cd /root/repo
+for i in $(seq 1 400); do
+  if timeout 90 python - <<'EOF' 2>/dev/null
+import jax
+assert jax.default_backend() == "tpu"
+import jax.numpy as jnp
+x = jnp.ones((128, 128))
+assert float((x @ x).sum()) == 128.0 * 128 * 128
+EOF
+  then
+    echo "TUNNEL UP at $(date -u +%FT%TZ) after $i probes"
+    exit 0
+  fi
+  echo "probe $i: tunnel down at $(date -u +%FT%TZ)"
+  sleep 90
+done
+echo "TUNNEL NEVER CAME UP"
+exit 1
