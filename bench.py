@@ -238,6 +238,12 @@ def main():
     # driven through the USER API — nn.Layer (LlamaForCausalLM) + AdamW +
     # amp auto_cast/GradScaler, eager dygraph loop — so the eager stack's
     # step overhead is a tracked number alongside the functional trainer.
+    # Free the functional trainer's device state first: params + Adam m/v
+    # (~3.4 GB at 350M) would otherwise sit in HBM under the eager run and
+    # OOM it (BENCH r4 first run).
+    del params, opt, step, loss
+    import gc
+    gc.collect()
     try:
         record["product_surface"] = _product_bench(on_tpu)
     except Exception as e:  # never let the product probe zero the headline
@@ -328,13 +334,15 @@ def _product_bench(on_tpu):
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 
     if on_tpu:
+        # same GQA config as the functional headline so the eager/functional
+        # ratio compares like-with-like (kv=4)
         cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
                           intermediate_size=2816, num_hidden_layers=24,
-                          num_attention_heads=16, num_key_value_heads=16,
+                          num_attention_heads=16, num_key_value_heads=4,
                           max_position_embeddings=2048)
-        # batch sized for the EAGER path: no remat, f32 master weights, and
-        # per-op activations live simultaneously — b8 exhausts the 16 GB
-        # chip (BENCH r3 first run), b2 fits
+        # batch sized for the EAGER path: no remat, f32 params + Adam m/v,
+        # and per-op activations live simultaneously on the tape — b8
+        # exhausts the 16 GB chip (BENCH r3 first run), b2 fits
         batch, seq, steps = 2, 2048, 2
     else:
         cfg = LlamaConfig.tiny()

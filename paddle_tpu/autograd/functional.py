@@ -96,21 +96,6 @@ class _LazyMatrix:
         return self._t
 
 
-def _batch_diag(jac, out_shape, in_shape):
-    """Full cross Jacobian [*out, *in] with leading batch dims on both
-    sides -> per-batch Jacobian [B, M, N] (reference batch_axis=0
-    semantics: no cross-batch terms)."""
-    B = out_shape[0]
-    M = 1
-    for d in out_shape[1:]:
-        M *= d
-    N = 1
-    for d in in_shape[1:]:
-        N *= d
-    j4 = jac.reshape(B, M, B, N)
-    return jnp.einsum("bmbn->bmn", j4)
-
-
 def _check_batch_axis(batch_axis):
     if batch_axis is not None and batch_axis != 0:
         raise ValueError(

@@ -386,6 +386,34 @@ def dice_loss(input, label, epsilon=1e-5, name=None):
     return D.apply("dice_loss", _dice, (input, label), {"eps": float(epsilon)})
 
 
+def _hs(x, lab, w, b, pt, pc, num_classes):
+    K = w.shape[0]
+    l = lab.reshape(-1).astype(jnp.int32)
+    if pt is None:
+        c = l + num_classes                               # [N]
+        # max path length: bits needed for 2*num_classes
+        Lmax = max(int(num_classes - 1).bit_length(), 1)
+        bits = jnp.arange(Lmax, dtype=jnp.int32)
+        # floor(log2(c)) via vectorized find-last-set
+        length = jnp.sum((c[:, None] >> (bits[None, :] + 1)) > 0,
+                         axis=1)                          # [N]
+        idx = (c[:, None] >> (bits[None, :] + 1)) - 1     # [N, L]
+        bitv = ((c[:, None] >> bits[None, :]) & 1).astype(x.dtype)
+        mask = (bits[None, :] < length[:, None]).astype(x.dtype)
+    else:
+        idx = pt.astype(jnp.int32)
+        bitv = pc.astype(x.dtype)
+        mask = (idx >= 0).astype(x.dtype)
+    idx_safe = jnp.clip(idx, 0, K - 1)
+    pre = jnp.einsum("nd,nld->nl", x, w[idx_safe],
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    if b is not None:
+        pre = pre + b.reshape(-1)[idx_safe]
+    pre = jnp.clip(pre, -40.0, 40.0)                      # ref clip
+    loss_bits = jax.nn.softplus(pre) - bitv * pre
+    return jnp.sum(loss_bits * mask, axis=-1, keepdims=True)
+
+
 def hsigmoid_loss(input, label, num_classes, weight, bias=None, path_table=None,
                   path_code=None, is_sparse=False, name=None):
     """Hierarchical sigmoid loss (reference nn/functional/loss.py hsigmoid_loss
@@ -398,35 +426,15 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None, path_table=None,
     path_code rows (negative entries pad).  TPU formulation: the
     variable-length paths become a fixed [N, L] gather + mask, so the
     whole loss is one batched matvec (MXU) under jit.  is_sparse is a
-    storage hint in the reference; dense gather here.
+    storage hint in the reference; dense gather here.  The impl functions
+    are module-level so the dispatcher's executable cache hits.
     """
-    def _hs(x, lab, w, b, pt, pc, num_classes):
-        K = w.shape[0]
-        l = lab.reshape(-1).astype(jnp.int32)
-        if pt is None:
-            c = l + num_classes                               # [N]
-            # max path length: bits needed for 2*num_classes
-            Lmax = max(int(num_classes - 1).bit_length(), 1)
-            bits = jnp.arange(Lmax, dtype=jnp.int32)
-            # floor(log2(c)) via vectorized find-last-set
-            length = jnp.sum((c[:, None] >> (bits[None, :] + 1)) > 0,
-                             axis=1)                          # [N]
-            idx = (c[:, None] >> (bits[None, :] + 1)) - 1     # [N, L]
-            bitv = ((c[:, None] >> bits[None, :]) & 1).astype(x.dtype)
-            mask = (bits[None, :] < length[:, None]).astype(x.dtype)
-        else:
-            idx = pt.astype(jnp.int32)
-            bitv = pc.astype(x.dtype)
-            mask = (idx >= 0).astype(x.dtype)
-        idx_safe = jnp.clip(idx, 0, K - 1)
-        pre = jnp.einsum("nd,nld->nl", x, w[idx_safe],
-                         preferred_element_type=jnp.float32).astype(x.dtype)
-        if b is not None:
-            pre = pre + b.reshape(-1)[idx_safe]
-        pre = jnp.clip(pre, -40.0, 40.0)                      # ref clip
-        loss_bits = jax.nn.softplus(pre) - bitv * pre
-        return jnp.sum(loss_bits * mask, axis=-1, keepdims=True)
-
+    if (path_table is None) != (path_code is None):
+        raise ValueError(
+            "hsigmoid_loss: path_table and path_code must be passed "
+            "together (reference contract); got "
+            f"path_table={'set' if path_table is not None else 'None'}, "
+            f"path_code={'set' if path_code is not None else 'None'}")
     tensors = [input, label, weight]
     names = ["x", "lab", "w"]
     opt = {"b": bias, "pt": path_table, "pc": path_code}
@@ -435,13 +443,16 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None, path_table=None,
             tensors.append(v)
             names.append(k)
 
-    def impl(*arrs, num_classes):
-        kw = dict(zip(names, arrs))
-        return _hs(kw["x"], kw["lab"], kw["w"], kw.get("b"),
-                   kw.get("pt"), kw.get("pc"), num_classes)
+    return D.apply("hsigmoid_loss", _hs_impl, tuple(tensors),
+                   {"num_classes": int(num_classes), "names": tuple(names)})
 
-    return D.apply("hsigmoid_loss", impl, tuple(tensors),
-                   {"num_classes": int(num_classes)})
+
+def _hs_impl(*arrs, num_classes, names):
+    # module-level (not a per-call closure) so the dispatcher's executable
+    # cache hits; the optional-arg combination rides in via static `names`
+    kw = dict(zip(names, arrs))
+    return _hs(kw["x"], kw["lab"], kw["w"], kw.get("b"),
+               kw.get("pt"), kw.get("pc"), num_classes)
 
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,

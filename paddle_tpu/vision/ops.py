@@ -341,6 +341,65 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
                     "iaf": float(iou_aware_factor)}, num_outputs=2)
 
 
+def _dcn_impl(x, offset, weight, mask, sh, sw, ph, pw, dh, dw, dg, groups):
+    N, Cin, H, W = x.shape
+    Cout, Cin_g, kH, kW = weight.shape
+    Ho, Wo = offset.shape[-2:]
+    K = kH * kW
+    Cg = Cin // dg
+
+    offv = offset.reshape(N, dg, K, 2, Ho, Wo).astype(jnp.float32)
+    # base sampling grid per kernel point
+    ki = (jnp.arange(K) // kW) * dh                    # [K]
+    kj = (jnp.arange(K) % kW) * dw
+    ybase = jnp.arange(Ho) * sh - ph                   # [Ho]
+    xbase = jnp.arange(Wo) * sw - pw
+    ys = (ybase[None, :, None] + ki[:, None, None]
+          + 0 * xbase[None, None, :])                  # [K, Ho, Wo]
+    xs = (xbase[None, None, :] + kj[:, None, None]
+          + 0 * ybase[None, :, None])
+    ys = ys[None, None] + offv[:, :, :, 0]             # [N, dg, K, Ho, Wo]
+    xs = xs[None, None] + offv[:, :, :, 1]
+
+    # bilinear corners; samples fully outside contribute zero
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = ys - y0
+    wx = xs - x0
+    xg = x.reshape(N, dg, Cg, H * W)
+
+    def corner(yc, xc, w8):
+        valid = ((yc >= 0) & (yc <= H - 1) & (xc >= 0) & (xc <= W - 1))
+        yi = jnp.clip(yc, 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(xc, 0, W - 1).astype(jnp.int32)
+        flat = (yi * W + xi).reshape(N, dg, 1, -1)     # [N,dg,1,K*Ho*Wo]
+        g = jnp.take_along_axis(
+            xg, jnp.broadcast_to(flat, (N, dg, Cg, flat.shape[-1])),
+            axis=-1).reshape(N, dg, Cg, K, Ho, Wo)
+        w8 = (w8 * valid)[:, :, None]                  # [N,dg,1,K,Ho,Wo]
+        return g * w8
+
+    samp = (corner(y0, x0, (1 - wy) * (1 - wx))
+            + corner(y0, x0 + 1, (1 - wy) * wx)
+            + corner(y0 + 1, x0, wy * (1 - wx))
+            + corner(y0 + 1, x0 + 1, wy * wx))         # [N,dg,Cg,K,Ho,Wo]
+    if mask is not None:
+        m = mask.reshape(N, dg, 1, K, Ho, Wo).astype(samp.dtype)
+        samp = samp * m
+
+    Cout_g = Cout // groups
+    Cin_gp = Cin // groups
+    cols = samp.reshape(N, Cin, K, Ho * Wo).reshape(
+        N, groups, Cin_gp, K, Ho * Wo)
+    wmat = weight.reshape(groups, Cout_g, Cin_gp, K).astype(samp.dtype)
+    out = jnp.einsum("ngckp,gock->ngop", cols, wmat,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(N, Cout, Ho, Wo).astype(x.dtype)
+
+def _dcn_impl_nomask(x, offset, weight, **kw):
+    return _dcn_impl(x, offset, weight, None, **kw)
+
+
 def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
                   dilation=1, deformable_groups=1, groups=1, mask=None,
                   name=None):
@@ -354,6 +413,9 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     x [N, Cin, H, W]; offset [N, 2*dg*kH*kW, Ho, Wo] with channels
     alternating (dy, dx) per kernel point; mask [N, dg*kH*kW, Ho, Wo]
     (v2) or None (v1); weight [Cout, Cin/groups, kH, kW].
+
+    The impls are module-level so the dispatcher's executable cache hits
+    (a closure-captured impl would recompile on every call).
     """
     def _pair(v):
         return (v, v) if isinstance(v, int) else tuple(v)
@@ -362,70 +424,9 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     ph_, pw_ = _pair(padding)
     dh, dw = _pair(dilation)
 
-    def impl(x, offset, weight, mask, sh, sw, ph, pw, dh, dw, dg, groups):
-        N, Cin, H, W = x.shape
-        Cout, Cin_g, kH, kW = weight.shape
-        Ho, Wo = offset.shape[-2:]
-        K = kH * kW
-        Cg = Cin // dg
-
-        offv = offset.reshape(N, dg, K, 2, Ho, Wo).astype(jnp.float32)
-        # base sampling grid per kernel point
-        ki = (jnp.arange(K) // kW) * dh                    # [K]
-        kj = (jnp.arange(K) % kW) * dw
-        ybase = jnp.arange(Ho) * sh - ph                   # [Ho]
-        xbase = jnp.arange(Wo) * sw - pw
-        ys = (ybase[None, :, None] + ki[:, None, None]
-              + 0 * xbase[None, None, :])                  # [K, Ho, Wo]
-        xs = (xbase[None, None, :] + kj[:, None, None]
-              + 0 * ybase[None, :, None])
-        ys = ys[None, None] + offv[:, :, :, 0]             # [N, dg, K, Ho, Wo]
-        xs = xs[None, None] + offv[:, :, :, 1]
-
-        # bilinear corners; samples fully outside contribute zero
-        y0 = jnp.floor(ys)
-        x0 = jnp.floor(xs)
-        wy = ys - y0
-        wx = xs - x0
-        xg = x.reshape(N, dg, Cg, H * W)
-
-        def corner(yc, xc, w8):
-            valid = ((yc >= 0) & (yc <= H - 1) & (xc >= 0) & (xc <= W - 1))
-            yi = jnp.clip(yc, 0, H - 1).astype(jnp.int32)
-            xi = jnp.clip(xc, 0, W - 1).astype(jnp.int32)
-            flat = (yi * W + xi).reshape(N, dg, 1, -1)     # [N,dg,1,K*Ho*Wo]
-            g = jnp.take_along_axis(
-                xg, jnp.broadcast_to(flat, (N, dg, Cg, flat.shape[-1])),
-                axis=-1).reshape(N, dg, Cg, K, Ho, Wo)
-            w8 = (w8 * valid)[:, :, None]                  # [N,dg,1,K,Ho,Wo]
-            return g * w8
-
-        samp = (corner(y0, x0, (1 - wy) * (1 - wx))
-                + corner(y0, x0 + 1, (1 - wy) * wx)
-                + corner(y0 + 1, x0, wy * (1 - wx))
-                + corner(y0 + 1, x0 + 1, wy * wx))         # [N,dg,Cg,K,Ho,Wo]
-        if mask is not None:
-            m = mask.reshape(N, dg, 1, K, Ho, Wo).astype(samp.dtype)
-            samp = samp * m
-
-        Cout_g = Cout // groups
-        Cin_gp = Cin // groups
-        cols = samp.reshape(N, Cin, K, Ho * Wo).reshape(
-            N, groups, Cin_gp, K, Ho * Wo)
-        wmat = weight.reshape(groups, Cout_g, Cin_gp, K).astype(samp.dtype)
-        out = jnp.einsum("ngckp,gock->ngop", cols, wmat,
-                         preferred_element_type=jnp.float32)
-        return out.reshape(N, Cout, Ho, Wo).astype(x.dtype)
-
     tensors = (x, offset, weight) if mask is None \
         else (x, offset, weight, mask)
-
-    if mask is None:
-        def impl2(x, offset, weight, **kw):
-            return impl(x, offset, weight, None, **kw)
-    else:
-        def impl2(x, offset, weight, mask, **kw):
-            return impl(x, offset, weight, mask, **kw)
+    impl2 = _dcn_impl_nomask if mask is None else _dcn_impl
 
     out = D.apply("deform_conv2d", impl2, tensors,
                   {"sh": sh, "sw": sw, "ph": ph_, "pw": pw_,
@@ -693,6 +694,7 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         gh = np.exp(np.clip(ph, -10, 10)) *             anchors[mask, 1][:, None, None] / in_h
 
         obj_target = np.zeros((A, H, W), np.float32)
+        matched = np.zeros((A, H, W), bool)
         ignore = np.zeros((A, H, W), bool)
         loss = 0.0
         for b in range(gb.shape[1]):
@@ -726,6 +728,7 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
                 bce(px[a, cj, ci], tx) + bce(py[a, cj, ci], ty)
                 + np.abs(pw[a, cj, ci] - tw) + np.abs(ph[a, cj, ci] - th))
             obj_target[a, cj, ci] = w8
+            matched[a, cj, ci] = True
             ignore[a, cj, ci] = False
             # label smoothing per the reference kernel: negatives get
             # smooth_weight = min(1/C, 1/40), the positive 1 - smooth_weight
@@ -737,11 +740,12 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
 
         # objectness: positives target 1.0 weighted by the mixup score
         # (reference CalcObjnessLoss: obj_mask holds the score); negatives
-        # target 0.0 unweighted; ignored cells contribute nothing
-        pos = obj_target > 0
-        obj_loss = bce(pobj, pos.astype(np.float32))
-        weight = np.where(pos, obj_target, 1.0)
-        keep = pos | ~ignore
+        # target 0.0 unweighted; ignored cells contribute nothing.  A
+        # matched cell stays positive even at score 0 (zero-weight) so the
+        # loss is continuous in gt_score.
+        obj_loss = bce(pobj, matched.astype(np.float32))
+        weight = np.where(matched, obj_target, 1.0)
+        keep = matched | ~ignore
         loss += (obj_loss * weight * keep).sum()
         losses[n] = loss
     return Tensor(jnp.asarray(losses))

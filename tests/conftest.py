@@ -6,18 +6,23 @@ without TPU hardware.  Must run before jax is imported anywhere.
 """
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+_HW = bool(os.environ.get("PADDLE_TPU_HW_TESTS"))
+
+if not _HW:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
 # The environment's sitecustomize pre-registers the axon TPU plugin and pins
 # JAX_PLATFORMS=axon; override through jax.config so tests always run on the
-# virtual 8-device CPU mesh.
+# virtual 8-device CPU mesh.  PADDLE_TPU_HW_TESTS=1 opts out of the CPU pin
+# so tests/test_tpu_hardware.py can reach the real chip.
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _HW:
+    jax.config.update("jax_platforms", "cpu")
 
 # Persistent XLA compile cache: the suite is compile-bound on the 1-core CI
 # host (VERDICT r1 weak #5); warm runs skip recompilation entirely.
