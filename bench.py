@@ -182,12 +182,14 @@ def main():
     except (OSError, json.JSONDecodeError):
         history = []
     vs_raw = None
-    for rec in reversed(history):
-        if rec.get("backend") == backend and rec.get("config") == config_tag:
-            prev = rec.get("tokens_per_sec")
-            if prev:
-                vs_raw = tokens_per_sec / prev
-            break
+    matching = [rec.get("tokens_per_sec") for rec in history
+                if rec.get("backend") == backend
+                and rec.get("config") == config_tag
+                and rec.get("tokens_per_sec")]
+    if matching:
+        last = sorted(matching[-3:])          # median of recent same-config
+        prev = last[len(last) // 2]
+        vs_raw = tokens_per_sec / prev
     # suppress the ratio when it sits inside the measured noise band
     # (max of this run's rep spread and 10%): report 1.0 + the raw value
     within_noise = (vs_raw is not None
@@ -358,7 +360,7 @@ def _product_bench(on_tpu):
     lab = pd.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)),
                        dtype="int64")
 
-    def one_step():
+    def one_step(tok, lab):
         with pd.amp.auto_cast(level="O2" if on_tpu else "O1"):
             _, loss = model(tok, labels=lab)
         scaler.scale(loss).backward()
@@ -367,16 +369,38 @@ def _product_bench(on_tpu):
         opt.clear_grad()
         return loss
 
-    loss = one_step()           # warmup/compile
+    out = {}
+
+    # captured dygraph: the SAME user step compiled as ONE XLA program
+    # (jit.capture_step) — the product surface's TPU-native fast path
+    cap = pd.jit.capture_step(one_step, models=model, optimizers=opt,
+                              scalers=scaler)
+    loss = cap(tok, lab)
     float(loss.numpy())
     t0 = _t.perf_counter()
     for _ in range(steps):
-        loss = one_step()
+        loss = cap(tok, lab)
     float(loss.numpy())
     dt = _t.perf_counter() - t0
-    return {"tokens_per_sec": round(batch * seq * steps / dt, 1),
-            "loss": float(loss.numpy()),
-            "path": "nn.Layer+AdamW+GradScaler eager dygraph"}
+    out["captured"] = {"tokens_per_sec": round(batch * seq * steps / dt, 1),
+                       "loss": float(loss.numpy()),
+                       "path": "nn.Layer+AdamW+GradScaler via jit.capture_step"}
+
+    # per-op eager dygraph (skipped on TPU: per-op remote dispatch makes a
+    # 24-layer warmup exceed any sane budget — that measurement IS the
+    # motivation for capture_step; the CPU number tracks the dispatcher)
+    if not on_tpu:
+        loss = one_step(tok, lab)           # warmup/compile
+        float(loss.numpy())
+        t0 = _t.perf_counter()
+        for _ in range(steps):
+            loss = one_step(tok, lab)
+        float(loss.numpy())
+        dt = _t.perf_counter() - t0
+        out["eager"] = {"tokens_per_sec": round(batch * seq * steps / dt, 1),
+                        "loss": float(loss.numpy()),
+                        "path": "nn.Layer+AdamW+GradScaler eager dygraph"}
+    return out
 
 
 if __name__ == "__main__":

@@ -101,6 +101,30 @@ def decorate(models, optimizers=None, level="O2", dtype="float16",
     return (models if single_model else model_list), optimizers
 
 
+_unscale_cache = {}
+
+
+def _fused_unscale(grads, inv):
+    """One compiled program: unscale every grad + global finite check."""
+    key = tuple((tuple(g.shape), str(g.dtype)) for g in grads)
+    exe = _unscale_cache.get(key)
+    if exe is None:
+        def run(gs, inv):
+            bad = jnp.zeros((), jnp.int32)
+            out = []
+            for g in gs:
+                arr = g.astype(jnp.float32) * inv
+                bad = bad + jnp.sum(~jnp.isfinite(arr)).astype(jnp.int32)
+                out.append(arr.astype(g.dtype)
+                           if g.dtype != jnp.float32 else arr)
+            return out, bad > 0
+        import jax
+        # donate the old grad buffers: their only other refs (p._grad._data)
+        # are overwritten right after the call, so XLA reuses them in place
+        exe = _unscale_cache[key] = jax.jit(run, donate_argnums=(0,))
+    return exe(list(grads), inv)
+
+
 class GradScaler:
     """Dynamic loss scaling (parity with
     /root/reference/python/paddle/amp/grad_scaler.py).
@@ -123,6 +147,7 @@ class GradScaler:
         self._bad_steps = 0
         self._found_inf_t = None   # DEVICE bool; host-synced only in update()
         self._unscaled = False
+        self._cap = None           # jit.capture_step: dynamic state arrays
 
     def is_enable(self):
         return self._enable
@@ -139,27 +164,41 @@ class GradScaler:
     def scale(self, var):
         if not self._enable:
             return var
+        if self._cap is not None:
+            # captured step: the scale is a dynamic program input
+            return var * Tensor(self._cap["scale"].astype(var._data.dtype))
+        if isinstance(self._scale, jnp.ndarray):
+            # device-resident scale left by a previous captured step
+            return var * Tensor(self._scale.astype(var._data.dtype))
         from ..ops.math import scale as _scale
         return _scale(var, self._scale)
+
+    def _scale_arr(self):
+        if self._cap is not None:
+            return self._cap["scale"]
+        return jnp.asarray(self._scale, jnp.float32)
 
     def unscale_(self, optimizer):
         if not self._enable or self._unscaled:
             return
         self._unscaled = True
-        inv = 1.0 / self._scale
-        # fused finite-check kept ON DEVICE: found_inf stays a device bool
-        # through step() (the optimizer masks its update with it) and is
-        # host-synced exactly once, in update() — matching the reference's
-        # tensor-found_inf flow (python/paddle/amp/grad_scaler.py)
-        bad_count = jnp.zeros((), jnp.int32)
-        for p in (optimizer._parameter_list or []):
-            g = p._grad
-            if g is None:
-                continue
-            arr = g._data.astype(jnp.float32) * inv
-            bad_count = bad_count + jnp.sum(~jnp.isfinite(arr)).astype(jnp.int32)
-            g._data = arr.astype(g._data.dtype) if g._data.dtype != jnp.float32 else arr
-        self._found_inf_t = bad_count > 0
+        # ONE fused program for unscale + finite-check across every grad
+        # (a per-param eager loop costs 3 dispatches per parameter — ruinous
+        # over a remote TPU link).  found_inf stays a device bool through
+        # step() (the optimizer masks its update with it) and is host-synced
+        # exactly once, in update() — matching the reference's tensor-
+        # found_inf flow (python/paddle/amp/grad_scaler.py).
+        inv = 1.0 / self._scale_arr()
+        with_grad = [p for p in (optimizer._parameter_list or [])
+                     if p._grad is not None]
+        if not with_grad:
+            self._found_inf_t = jnp.asarray(False)
+            return
+        grads = [p._grad._data for p in with_grad]
+        new_grads, found = _fused_unscale(grads, inv)
+        for p, g in zip(with_grad, new_grads):
+            p._grad._data = g
+        self._found_inf_t = found
 
     def step(self, optimizer):
         """Unscale (if the user hasn't already) and step when grads are
@@ -191,24 +230,65 @@ class GradScaler:
         if not (self._enable and self._dynamic):
             self._found_inf_t = None
             return
+        if self._cap is not None:
+            # captured step: the whole scale schedule is branch-free device
+            # arithmetic — no host sync anywhere in the compiled program
+            found = self._found_inf_t
+            if found is None:
+                found = jnp.asarray(False)
+            scale = self._cap["scale"]
+            good, bad = self._cap["good"], self._cap["bad"]
+            bad1 = jnp.where(found, bad + 1, jnp.zeros_like(bad))
+            good1 = jnp.where(found, jnp.zeros_like(good), good + 1)
+            decr = found & (bad1 >= self._decr_every_n)
+            incr = ~found & (good1 >= self._incr_every_n)
+            scale = jnp.where(
+                decr, jnp.maximum(scale * self._decr_ratio, 1.0),
+                jnp.where(incr, scale * self._incr_ratio, scale))
+            self._cap["scale"] = scale
+            self._cap["bad"] = jnp.where(decr, jnp.zeros_like(bad1), bad1)
+            self._cap["good"] = jnp.where(incr, jnp.zeros_like(good1), good1)
+            self._found_inf_t = None
+            return
         if self._found_inf:   # the step's single host sync
             self._bad_steps += 1
             self._good_steps = 0
             if self._bad_steps >= self._decr_every_n:
-                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._scale = max(float(self._scale) * self._decr_ratio, 1.0)
                 self._bad_steps = 0
         else:
             self._good_steps += 1
             self._bad_steps = 0
             if self._good_steps >= self._incr_every_n:
-                self._scale *= self._incr_ratio
+                self._scale = float(self._scale) * self._incr_ratio
                 self._good_steps = 0
         self._found_inf_t = None
 
+    # ---- jit.capture_step protocol ----
+    def _capture_state(self):
+        """Concrete (scale, good, bad) arrays to feed the captured program."""
+        return (jnp.asarray(self._scale, jnp.float32),
+                jnp.asarray(self._good_steps, jnp.int32),
+                jnp.asarray(self._bad_steps, jnp.int32))
+
+    def _begin_capture(self, scale, good, bad):
+        self._cap = {"scale": scale, "good": good, "bad": bad}
+
+    def _end_capture(self):
+        cap, self._cap = self._cap, None
+        return (cap["scale"], cap["good"], cap["bad"])
+
+    def _load_capture_state(self, scale, good, bad):
+        # keep device-resident: forcing floats here would host-sync per step
+        self._scale = scale
+        self._good_steps = good
+        self._bad_steps = bad
+
     def state_dict(self):
-        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
-                "decr_ratio": self._decr_ratio, "incr_count": self._good_steps,
-                "decr_count": self._bad_steps}
+        return {"scale": float(self._scale), "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_count": int(self._good_steps),
+                "decr_count": int(self._bad_steps)}
 
     def load_state_dict(self, state):
         self._scale = state.get("scale", self._scale)

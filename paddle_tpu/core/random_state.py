@@ -28,12 +28,54 @@ def get_seed():
     return _state["seed"]
 
 
+# Capture-mode override (jit.capture_step): while a train step is being
+# traced, random draws must come from a DYNAMIC key/counter threaded through
+# the compiled program — a concrete next_key() result would bake one fixed
+# key into the trace and every captured step would replay identical
+# randomness.  begin_capture installs (key_tracer, base_counter_tracer);
+# each next_key() folds base+n for a per-trace static n.
+_capture = threading.local()
+
+
+def begin_capture(key, base_counter):
+    _capture.state = {"key": key, "base": base_counter, "n": 0}
+
+
+def end_capture():
+    st = getattr(_capture, "state", None)
+    _capture.state = None
+    return 0 if st is None else st["n"]
+
+
+def capture_draws():
+    st = getattr(_capture, "state", None)
+    return 0 if st is None else st["n"]
+
+
 def next_key():
+    cap = getattr(_capture, "state", None)
+    if cap is not None:
+        cap["n"] += 1
+        return jax.random.fold_in(cap["key"], cap["base"] + cap["n"])
     with _lock:
         if _state["key"] is None:
             _state["key"] = jax.random.PRNGKey(np.random.randint(0, 2 ** 31))
         _state["counter"] += 1
         return jax.random.fold_in(_state["key"], _state["counter"])
+
+
+def ensure_key():
+    """Concrete (root_key, counter) for capture threading; inits if unseeded."""
+    with _lock:
+        if _state["key"] is None:
+            _state["key"] = jax.random.PRNGKey(np.random.randint(0, 2 ** 31))
+        return _state["key"], _state["counter"]
+
+
+def advance(n):
+    """Consume n draws from the global stream (post-captured-step)."""
+    with _lock:
+        _state["counter"] += int(n)
 
 
 def get_rng_state():

@@ -22,7 +22,8 @@ from ..nn.layer.layers import Layer
 
 __all__ = ["to_static", "save", "load", "ignore_module", "not_to_static",
            "TracedFunction", "TranslatedLayer", "InputSpec",
-           "set_code_level", "set_verbosity", "enable_to_static"]
+           "set_code_level", "set_verbosity", "enable_to_static",
+           "capture_step", "CapturedStep"]
 
 _to_static_enabled = True
 
@@ -300,3 +301,6 @@ def enable_to_static(enable=True):
     when off, to_static-wrapped callables run eagerly."""
     global _to_static_enabled
     _to_static_enabled = bool(enable)
+
+
+from .step import CapturedStep, capture_step  # noqa: E402

@@ -1,0 +1,284 @@
+"""Whole-train-step capture: the TPU answer to eager dispatch overhead.
+
+The reference keeps its dygraph hot loop fast with a C++ dispatch chain
+(/root/reference/paddle/fluid/pybind/eager_method.cc, eager_gen.py); on TPU
+no per-op dispatcher can win — every launch is a device round-trip, and over
+a remote PJRT link each one costs milliseconds.  The TPU-native fix is to
+compile the USER'S OWN dygraph step — forward, tape backward, GradScaler,
+optimizer update — into ONE XLA program (the same shape as the reference's
+dygraph-to-static SOT capture, /root/reference/python/paddle/jit/api.py:197,
+but with jax tracing as the capture mechanism).
+
+    step = paddle.jit.capture_step(train_step, models=model,
+                                   optimizers=opt, scalers=scaler)
+    for batch in loader:
+        loss = step(batch_x, batch_y)      # one fused XLA launch
+
+Mutable framework state — parameters (+ AMP master weights), buffers,
+optimizer accumulators, GradScaler scale schedule, global RNG stream — is
+threaded through the compiled program as explicit donated inputs/outputs, so
+repeated calls reuse buffers and never sync the host.  Dynamic scalars that
+must not bake into the trace (learning rate, Adam bias-correction step,
+loss-scale) ride as inputs; LR schedulers therefore keep working when
+stepped BETWEEN captured calls.
+
+Contract (enforced with clear errors):
+- the step function must not materialize tensors (``.numpy()``, ``float()``,
+  ``if tensor:``) — that is a host sync inside the compiled program;
+- gradients must be cleared inside the step (``opt.clear_grad()``);
+- optimizers whose update depends on host-side per-step state (NAdam's
+  mu-product, RAdam's rho branch) are rejected; the Adam/AdamW family,
+  SGD, Momentum, Adamax, Lamb and ASGD are supported.
+"""
+from __future__ import annotations
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random_state
+from ..core.tensor import Tensor
+
+__all__ = ["capture_step", "CapturedStep"]
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class CapturedStep:
+    """A user train-step function compiled as one XLA program."""
+
+    def __init__(self, fn, models=None, optimizers=None, scalers=None,
+                 donate=True):
+        self._fn = fn
+        self._models = _as_list(models)
+        self._optimizers = _as_list(optimizers)
+        self._scalers = _as_list(scalers)
+        self._donate = donate
+        self._compiled = None
+        self._rng_draws = 0
+
+        # ---- stable state inventory (built once) ----
+        seen = set()
+        self._params = []          # Parameter objects, stable order
+        for m in self._models:
+            for _, p in m.named_parameters():
+                if id(p) not in seen:
+                    seen.add(id(p))
+                    self._params.append(p)
+        for opt in self._optimizers:
+            for p in (opt._parameter_list or []):
+                if id(p) not in seen:
+                    seen.add(id(p))
+                    self._params.append(p)
+        self._buffers = []
+        bseen = set()
+        for m in self._models:
+            for _, b in m.named_buffers():
+                if b is not None and id(b) not in bseen:
+                    bseen.add(id(b))
+                    self._buffers.append(b)
+        # pre-create every optimizer slot so the state signature is stable
+        # from the first call (lazily-created slots would change the pytree
+        # structure between call 1 and call 2 and force a retrace)
+        for opt in self._optimizers:
+            for p in (opt._parameter_list or []):
+                if not p.stop_gradient:
+                    opt._state_for(p)
+        self._slot_index = []      # (opt_i, param_obj, slot_name)
+        for oi, opt in enumerate(self._optimizers):
+            names = tuple(opt._slot_names())
+            for p in (opt._parameter_list or []):
+                st = opt._accumulators.get(id(p))
+                if st is None:
+                    continue
+                for n in names:
+                    self._slot_index.append((oi, p, n))
+
+    # -- state gather/scatter ------------------------------------------------
+    def _gather_state(self):
+        donated = {
+            "params": [p._data for p in self._params],
+            "masters": [p._master_weight for p in self._params
+                        if getattr(p, "_master_weight", None) is not None],
+            "buffers": [b._data for b in self._buffers],
+            "slots": [self._optimizers[oi]._accumulators[id(p)][n]
+                      for oi, p, n in self._slot_index],
+            "scalers": [list(s._capture_state()) for s in self._scalers],
+        }
+        key, counter = random_state.ensure_key()
+        plain = {
+            "rng_key": key,
+            "rng_base": jnp.asarray(counter, jnp.int32),
+            "lrs": [jnp.asarray(opt.get_lr(), jnp.float32)
+                    for opt in self._optimizers],
+            "step_ts": [jnp.asarray(opt._global_step + 1, jnp.int32)
+                        for opt in self._optimizers],
+        }
+        return donated, plain
+
+    def _bind(self, donated, plain):
+        """Install state arrays into the live objects; return the saved
+        originals so the trace leaves no tracer behind."""
+        saved = {
+            "params": [(p, p._data) for p in self._params],
+            "masters": [(p, p._master_weight) for p in self._params
+                        if getattr(p, "_master_weight", None) is not None],
+            "buffers": [(b, b._data) for b in self._buffers],
+            "slots": [(self._optimizers[oi]._accumulators[id(p)], n,
+                       self._optimizers[oi]._accumulators[id(p)][n])
+                      for oi, p, n in self._slot_index],
+            "grads": [(p, p._grad) for p in self._params],
+            # the traced opt.step() bumps the host counter as a trace-time
+            # side effect; the wrapper owns the real per-call increment
+            "steps": [opt._global_step for opt in self._optimizers],
+        }
+        for p, arr in zip(self._params, donated["params"]):
+            p._data = arr
+        mi = 0
+        for p in self._params:
+            if getattr(p, "_master_weight", None) is not None:
+                p._master_weight = donated["masters"][mi]
+                mi += 1
+        for b, arr in zip(self._buffers, donated["buffers"]):
+            b._data = arr
+        for (oi, p, n), arr in zip(self._slot_index, donated["slots"]):
+            self._optimizers[oi]._accumulators[id(p)][n] = arr
+        for s, st in zip(self._scalers, donated["scalers"]):
+            s._begin_capture(*st)
+        for opt, lr, t in zip(self._optimizers, plain["lrs"],
+                              plain["step_ts"]):
+            opt._lr_override = lr
+            opt._step_t_override = t
+        random_state.begin_capture(plain["rng_key"], plain["rng_base"])
+        return saved
+
+    def _collect_new(self):
+        new = {
+            "params": [p._data for p in self._params],
+            "masters": [p._master_weight for p in self._params
+                        if getattr(p, "_master_weight", None) is not None],
+            "buffers": [b._data for b in self._buffers],
+            "slots": [self._optimizers[oi]._accumulators[id(p)][n]
+                      for oi, p, n in self._slot_index],
+            "scalers": [list(s._end_capture()) for s in self._scalers],
+        }
+        dirty = [p.name for p in self._params if p._grad is not None]
+        if dirty:
+            raise RuntimeError(
+                "capture_step: gradients still set after the step for "
+                f"{dirty[:3]}{'...' if len(dirty) > 3 else ''} — call "
+                "optimizer.clear_grad() inside the captured function "
+                "(grad accumulation across captured steps is not supported)")
+        # slots created mid-trace (a param unfrozen after construction)
+        # would be trace-local tracers invisible to the state threading
+        n_slots = sum(len(st) for opt in self._optimizers
+                      for st in opt._accumulators.values())
+        if n_slots != len(self._slot_index):
+            raise RuntimeError(
+                "capture_step: optimizer state changed during the step "
+                "(a parameter was unfrozen after capture was built?) — "
+                "rebuild the CapturedStep after changing stop_gradient")
+        return new
+
+    def _restore(self, saved):
+        for p, arr in saved["params"]:
+            p._data = arr
+        for p, arr in saved["masters"]:
+            p._master_weight = arr
+        for b, arr in saved["buffers"]:
+            b._data = arr
+        for st, n, arr in saved["slots"]:
+            st[n] = arr
+        for p, g in saved["grads"]:
+            p._grad = g
+        for s in self._scalers:
+            s._cap = None
+            s._found_inf_t = None
+        for opt, st in zip(self._optimizers, saved["steps"]):
+            opt._lr_override = None
+            opt._step_t_override = None
+            opt._global_step = st
+        self._rng_draws = random_state.end_capture()
+
+    # -- compile -------------------------------------------------------------
+    def _build(self):
+        from . import _tree_to_arrays, _tree_to_tensors
+
+        def pure(donated, plain, args, kwargs):
+            saved = self._bind(donated, plain)
+            try:
+                t_args = _tree_to_tensors(args, stop_gradient=True)
+                t_kwargs = _tree_to_tensors(kwargs, stop_gradient=True)
+                out = self._fn(*t_args, **t_kwargs)
+                new_state = self._collect_new()
+                return _tree_to_arrays(out), new_state
+            finally:
+                self._restore(saved)
+
+        self._compiled = jax.jit(
+            pure, donate_argnums=(0,) if self._donate else ())
+
+    # -- call ----------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        from . import _tree_to_arrays, _tree_to_tensors
+
+        if self._compiled is None:
+            self._build()
+        donated, plain = self._gather_state()
+        a_args = _tree_to_arrays(args)
+        a_kwargs = _tree_to_arrays(kwargs)
+        try:
+            with warnings.catch_warnings():
+                # inner per-op executables carry their own donation hints;
+                # under the enclosing trace those are expected to be unused
+                warnings.filterwarnings(
+                    "ignore", message=".*[Dd]onat.*", category=UserWarning)
+                out, new_state = self._compiled(donated, plain, a_args,
+                                                a_kwargs)
+        except jax.errors.ConcretizationTypeError as e:
+            raise RuntimeError(
+                "capture_step: the step function forced a host sync on a "
+                "traced value (float()/bool()/.numpy()/if-on-tensor). Keep "
+                "the step device-pure; read metrics from the returned "
+                "tensors instead.") from e
+        # write results back into the live objects
+        for p, arr in zip(self._params, new_state["params"]):
+            p._data = arr
+        mi = 0
+        for p in self._params:
+            if getattr(p, "_master_weight", None) is not None:
+                p._master_weight = new_state["masters"][mi]
+                mi += 1
+        for b, arr in zip(self._buffers, new_state["buffers"]):
+            b._data = arr
+        for (oi, p, n), arr in zip(self._slot_index, new_state["slots"]):
+            self._optimizers[oi]._accumulators[id(p)][n] = arr
+        for s, st in zip(self._scalers, new_state["scalers"]):
+            s._load_capture_state(*st)
+        for opt in self._optimizers:
+            opt._global_step += 1
+        random_state.advance(self._rng_draws)
+        return _tree_to_tensors(out, stop_gradient=True)
+
+
+def capture_step(fn=None, *, models=None, optimizers=None, scalers=None,
+                 donate=True):
+    """Compile a dygraph train-step function into one XLA program.
+
+    Decorator or direct form::
+
+        step = capture_step(train_step, models=m, optimizers=o, scalers=s)
+
+        @capture_step(models=m, optimizers=o)
+        def train_step(x, y): ...
+    """
+    if fn is None:
+        def deco(f):
+            return CapturedStep(f, models, optimizers, scalers, donate)
+        return deco
+    return CapturedStep(fn, models, optimizers, scalers, donate)

@@ -124,16 +124,24 @@ class Optimizer:
         # Pipeline-placed models keep each stage's params on its own device;
         # one XLA program can't mix committed devices, so run one fused
         # update per device group (the reference analog: per-stage optimizer
-        # instances in PP training).
+        # instances in PP training).  Under jit.capture_step the params are
+        # tracers (no .devices()) — the whole update is one group inside the
+        # enclosing program.
         by_dev = {}
         for pg in params_grads:
-            key = tuple(sorted(d.id for d in pg[0]._data.devices()))
+            try:
+                key = tuple(sorted(d.id for d in pg[0]._data.devices()))
+            except (AttributeError, jax.errors.ConcretizationTypeError):
+                key = None
             by_dev.setdefault(key, []).append(pg)
         for group in by_dev.values():
             self._step_group(group)
 
     def _step_group(self, params_grads):
-        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        # jit.capture_step threads the lr in as a dynamic input so schedulers
+        # stepped between captured calls take effect without retracing
+        ovr = getattr(self, "_lr_override", None)
+        lr = ovr if ovr is not None else jnp.asarray(self.get_lr(), jnp.float32)
         slot_names = tuple(self._slot_names())
 
         params = [p._data for p, _ in params_grads]
@@ -155,7 +163,19 @@ class Optimizer:
                   p.optimize_attr.get("learning_rate", 1.0) or 1.0)
             for p, _ in params_grads)
         wds = tuple(self._weight_decay_for(p) for p, _ in params_grads)
-        extra = self._extra_args()
+        t_dyn = getattr(self, "_step_t_override", None)
+        if t_dyn is not None:
+            # captured step: extra scalars (bias corrections etc.) must be
+            # functions of the DYNAMIC step input, not of the baked host int
+            dyn = getattr(self, "_extra_args_dynamic", None)
+            if dyn is None and type(self)._extra_args is not Optimizer._extra_args:
+                raise NotImplementedError(
+                    f"{type(self).__name__} computes host-side per-step "
+                    "state and cannot run under jit.capture_step; use "
+                    "Adam/AdamW/Adamax/Lamb/SGD/Momentum/ASGD or run eager")
+            extra = dyn(t_dyn) if dyn is not None else ()
+        else:
+            extra = self._extra_args()
 
         mask = getattr(self, "_skip_update_mask", None)
         key = (tuple((tuple(p.shape), str(p.dtype)) for p in params),

@@ -81,6 +81,11 @@ class Adam(Optimizer):
         return (jnp.asarray(1.0 - self._beta1 ** t, jnp.float32),
                 jnp.asarray(1.0 - self._beta2 ** t, jnp.float32))
 
+    def _extra_args_dynamic(self, t):
+        tf = t.astype(jnp.float32)
+        return (1.0 - jnp.asarray(self._beta1, jnp.float32) ** tf,
+                1.0 - jnp.asarray(self._beta2, jnp.float32) ** tf)
+
     def _decoupled(self):
         return False
 
@@ -193,6 +198,9 @@ class Adamax(Optimizer):
     def _extra_args(self):
         return (jnp.asarray(1.0 - self._beta1 ** self._global_step, jnp.float32),)
 
+    def _extra_args_dynamic(self, t):
+        return (1.0 - jnp.asarray(self._beta1, jnp.float32) ** t.astype(jnp.float32),)
+
     def _update_arrays(self, p, g, state, lr, param_lr, wd, extra):
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
         (bc1,) = extra
@@ -254,6 +262,11 @@ class Lamb(Optimizer):
         t = self._global_step
         return (jnp.asarray(1.0 - self._beta1 ** t, jnp.float32),
                 jnp.asarray(1.0 - self._beta2 ** t, jnp.float32))
+
+    def _extra_args_dynamic(self, t):
+        tf = t.astype(jnp.float32)
+        return (1.0 - jnp.asarray(self._beta1, jnp.float32) ** tf,
+                1.0 - jnp.asarray(self._beta2, jnp.float32) ** tf)
 
     def _weight_decay_for(self, p):
         if self._exclude_fn is not None and self._exclude_fn(p):
@@ -414,6 +427,9 @@ class ASGD(Optimizer):
         # ring index of the gradient being replaced this step
         return (jnp.asarray((self._global_step - 1) % self._batch_num,
                             jnp.int32),)
+
+    def _extra_args_dynamic(self, t):
+        return ((t.astype(jnp.int32) - 1) % self._batch_num,)
 
     def _update_arrays(self, p, g, state, lr, param_lr, wd, extra):
         import jax as _jax

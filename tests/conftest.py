@@ -6,7 +6,8 @@ without TPU hardware.  Must run before jax is imported anywhere.
 """
 import os
 
-_HW = bool(os.environ.get("PADDLE_TPU_HW_TESTS"))
+_HW = os.environ.get("PADDLE_TPU_HW_TESTS", "").lower() not in (
+    "", "0", "false", "no", "off")
 
 if not _HW:
     os.environ["JAX_PLATFORMS"] = "cpu"

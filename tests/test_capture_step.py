@@ -1,0 +1,215 @@
+"""jit.capture_step: whole-train-step capture (the dygraph product surface
+compiled as ONE XLA program — reference analog: dygraph-to-static SOT over a
+train step, /root/reference/python/paddle/jit/api.py:197)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _mlp(seed=0):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+
+
+def _data(seed=0):
+    rng = np.random.RandomState(seed)
+    return (paddle.to_tensor(rng.randn(8, 16).astype(np.float32)),
+            paddle.to_tensor(rng.randn(8, 4).astype(np.float32)))
+
+
+def _run_steps(step_fn, x, y, n):
+    losses = []
+    for _ in range(n):
+        losses.append(float(step_fn(x, y).numpy()))
+    return losses
+
+
+def test_captured_matches_eager():
+    x, y = _data()
+
+    def make(seed):
+        net = _mlp(seed)
+        opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                     parameters=net.parameters())
+
+        def step(x, y):
+            loss = F.mse_loss(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+        return net, opt, step
+
+    net_e, opt_e, step_e = make(7)
+    eager_losses = _run_steps(step_e, x, y, 4)
+
+    net_c, opt_c, step_c = make(7)
+    cap = paddle.jit.capture_step(step_c, models=net_c, optimizers=opt_c)
+    cap_losses = _run_steps(cap, x, y, 4)
+
+    np.testing.assert_allclose(cap_losses, eager_losses, rtol=2e-5)
+    for (k1, p1), (k2, p2) in zip(net_e.named_parameters(),
+                                  net_c.named_parameters()):
+        np.testing.assert_allclose(p2.numpy(), p1.numpy(), rtol=2e-5,
+                                   atol=1e-6, err_msg=k1)
+
+
+def test_single_trace_across_calls():
+    net = _mlp(1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    x, y = _data(1)
+    traces = []
+
+    def step(x, y):
+        traces.append(1)
+        loss = F.mse_loss(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    cap = paddle.jit.capture_step(step, models=net, optimizers=opt)
+    _run_steps(cap, x, y, 3)
+    assert len(traces) == 1, f"retraced: {len(traces)} traces for 3 calls"
+
+
+def test_lr_scheduler_between_steps():
+    # lr rides as a dynamic input: stepping the scheduler between captured
+    # calls must change the update WITHOUT retracing
+    x, y = _data(2)
+
+    def make():
+        net = _mlp(3)
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=1,
+                                              gamma=0.1)
+        opt = paddle.optimizer.SGD(learning_rate=sched,
+                                   parameters=net.parameters())
+        return net, sched, opt
+
+    net_e, sched_e, opt_e = make()
+
+    def step_e(x, y):
+        loss = F.mse_loss(net_e(x), y)
+        loss.backward()
+        opt_e.step()
+        opt_e.clear_grad()
+        return loss
+
+    for _ in range(3):
+        step_e(x, y)
+        sched_e.step()
+
+    net_c, sched_c, opt_c = make()
+
+    def step_c(x, y):
+        loss = F.mse_loss(net_c(x), y)
+        loss.backward()
+        opt_c.step()
+        opt_c.clear_grad()
+        return loss
+
+    cap = paddle.jit.capture_step(step_c, models=net_c, optimizers=opt_c)
+    for _ in range(3):
+        cap(x, y)
+        sched_c.step()
+
+    for (k, p1), (_, p2) in zip(net_e.named_parameters(),
+                                net_c.named_parameters()):
+        np.testing.assert_allclose(p2.numpy(), p1.numpy(), rtol=2e-5,
+                                   atol=1e-6, err_msg=k)
+
+
+def test_scaler_inf_skips_and_decays():
+    net = _mlp(4)
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=net.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0,
+                                   incr_every_n_steps=1000,
+                                   decr_every_n_nan_or_inf=1)
+    x, y = _data(4)
+
+    def step(x, y):
+        loss = F.mse_loss(net(x), y)
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        scaler.update()
+        opt.clear_grad()
+        return loss
+
+    cap = paddle.jit.capture_step(step, models=net, optimizers=opt,
+                                  scalers=scaler)
+    cap(x, y)
+    before = {k: p.numpy().copy() for k, p in net.named_parameters()}
+    bad_x = paddle.to_tensor(np.full((8, 16), np.inf, np.float32))
+    cap(bad_x, y)
+    after = {k: p.numpy() for k, p in net.named_parameters()}
+    for k in before:
+        np.testing.assert_array_equal(after[k], before[k],
+                                      err_msg=f"{k} updated on inf grads")
+    assert float(scaler.get_loss_scaling().numpy()) == 512.0
+    # recovery: a good step still updates
+    cap(x, y)
+    for k, p in net.named_parameters():
+        assert not np.array_equal(p.numpy(), before[k])
+
+
+def test_dropout_rng_advances_across_steps():
+    paddle.seed(42)
+    net = nn.Sequential(nn.Linear(16, 64), nn.Dropout(0.5), nn.Linear(64, 4))
+    opt = paddle.optimizer.SGD(learning_rate=0.0,
+                               parameters=net.parameters())
+    x, y = _data(5)
+
+    def step(x, y):
+        loss = F.mse_loss(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    cap = paddle.jit.capture_step(step, models=net, optimizers=opt)
+    l1 = float(cap(x, y).numpy())
+    l2 = float(cap(x, y).numpy())
+    l3 = float(cap(x, y).numpy())
+    # lr=0 -> identical params; only the dropout mask changes the loss
+    assert len({l1, l2, l3}) > 1, "dropout mask frozen across captured steps"
+
+
+def test_host_sync_inside_step_raises():
+    net = _mlp(6)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    x, y = _data(6)
+
+    def step(x, y):
+        loss = F.mse_loss(net(x), y)
+        float(loss.numpy())          # host sync inside the captured program
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    cap = paddle.jit.capture_step(step, models=net, optimizers=opt)
+    with pytest.raises(Exception, match="host sync|Tracer|concrete"):
+        cap(x, y)
+
+
+def test_uncleared_grads_raise():
+    net = _mlp(8)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    x, y = _data(8)
+
+    def step(x, y):
+        loss = F.mse_loss(net(x), y)
+        loss.backward()
+        opt.step()
+        return loss                  # no clear_grad
+
+    cap = paddle.jit.capture_step(step, models=net, optimizers=opt)
+    with pytest.raises(RuntimeError, match="clear_grad"):
+        cap(x, y)

@@ -1,18 +1,14 @@
 #!/bin/bash
-# Poll the axon tunnel; when it answers, run hardware validation + perf.
+# Poll the axon tunnel; write a flag file when it answers. Keep it light —
+# what to run on a restored tunnel is the operator's call.
 cd /root/repo
-for i in $(seq 1 200); do
+mkdir -p tmp
+rm -f tmp/tunnel_up.flag
+for i in $(seq 1 300); do
   if timeout 60 python -c "import jax; assert jax.default_backend()=='tpu'" 2>/dev/null; then
-    echo "[tunnel_watch] tunnel UP at $(date)" | tee /root/repo/tmp/tunnel_up.flag
-    echo "=== hardware kernel tests ===" > /root/repo/tmp/hw_results.log
-    PADDLE_TPU_HW_TESTS=1 timeout 1200 python -m pytest tests/test_tpu_hardware.py -q --noconftest >> /root/repo/tmp/hw_results.log 2>&1
-    echo "=== remat/kernel sweep ===" >> /root/repo/tmp/hw_results.log
-    timeout 1800 python tmp/remat_sweep.py >> /root/repo/tmp/hw_results.log 2>&1
-    echo "=== bench ===" >> /root/repo/tmp/hw_results.log
-    timeout 900 python bench.py >> /root/repo/tmp/hw_results.log 2>&1
-    echo "[tunnel_watch] done at $(date)" >> /root/repo/tmp/hw_results.log
+    echo "tunnel UP at $(date)" | tee tmp/tunnel_up.flag
     exit 0
   fi
-  sleep 120
+  sleep 110
 done
-echo "[tunnel_watch] gave up at $(date)"
+echo "gave up at $(date)"
