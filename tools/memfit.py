@@ -1,0 +1,121 @@
+"""North-star memory fit-proofs (VERDICT r4 item: BASELINE configs 3/4).
+
+Compiles the FULL hybrid-parallel train step for the LLaMA-7B and GPT-13B
+-class configs on a virtual device mesh and reads XLA's buffer-assignment
+memory analysis — a hardware-free proof that the per-chip footprint fits
+v5e HBM (16 GiB).  Per-chip estimate = argument + temp bytes of the
+per-device program (donated outputs alias arguments).
+
+The CPU lowering is CONSERVATIVE for attention: without the Pallas flash
+kernel the backward materializes [b, h, S, S] score tensors that the TPU
+program never allocates, so a FITS verdict here over-covers the real chip.
+
+Run:  XLA_FLAGS=--xla_force_host_platform_device_count=32 \
+      JAX_PLATFORMS=cpu python tools/memfit.py
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+HBM_GIB = 16.0
+BOUND_GIB = 15.5          # headroom under the 16 GiB chip
+
+
+def _fit_record(tag, cfg, hp, batch_per_dp, seq):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.parallel import (build_mesh, build_train_step,
+                                     init_params, param_specs)
+    from paddle_tpu.parallel.transformer import init_opt_state, opt_state_specs
+
+    mesh = build_mesh(hp)
+    shapes = jax.eval_shape(lambda: init_params(cfg, hp, 0))
+    os_shapes = jax.eval_shape(lambda: init_opt_state(shapes))
+    ps = param_specs(hp, False)
+    oss = opt_state_specs(hp, shapes)
+
+    def st(t, s):
+        return jax.ShapeDtypeStruct(t.shape, t.dtype,
+                                    sharding=NamedSharding(mesh, s))
+
+    pstructs = jax.tree.map(st, shapes, ps)
+    ostructs = jax.tree.map(st, os_shapes, oss)
+    tok = jax.ShapeDtypeStruct(
+        (hp.dp * batch_per_dp * hp.num_microbatches, seq), jnp.int32,
+        sharding=NamedSharding(mesh, P("dp", None)))
+    step = build_train_step(cfg, hp, mesh)
+    t0 = time.time()
+    ma = step.lower(pstructs, ostructs, tok).compile().memory_analysis()
+    total = (ma.argument_size_in_bytes + ma.temp_size_in_bytes) / 2 ** 30
+    return {
+        "config": tag,
+        "n_params": sum(int(np.prod(x.shape))
+                        for x in jax.tree.leaves(shapes)),
+        "mesh": {"dp": hp.dp, "pp": hp.pp, "tp": hp.tp,
+                 "zero_stage": hp.zero_stage,
+                 "num_microbatches": hp.num_microbatches},
+        "batch_per_dp": batch_per_dp, "seq": seq,
+        "argument_gib": round(ma.argument_size_in_bytes / 2 ** 30, 2),
+        "temp_gib": round(ma.temp_size_in_bytes / 2 ** 30, 2),
+        "per_chip_gib": round(total, 2),
+        "bound_gib": BOUND_GIB,
+        "fits": total <= BOUND_GIB,
+        "compile_s": round(time.time() - t0, 1),
+    }
+
+
+def run(which):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.parallel import HybridParallelConfig
+
+    n_dev = len(jax.devices())
+    records = []
+    if which in ("7b", "all"):
+        assert n_dev >= 16, f"need 16 virtual devices, have {n_dev}"
+        cfg7 = LlamaConfig(vocab_size=32000, hidden_size=4096,
+                           intermediate_size=11008, num_hidden_layers=32,
+                           num_attention_heads=32, num_key_value_heads=32,
+                           max_position_embeddings=2048)
+        # memory-preferred v5e-16 layout (BASELINE config 3 north star):
+        # tp8 x dp2, ZeRO-1, full remat, bf16, chunked vocab xent
+        records.append(_fit_record(
+            "llama-7b v5e-16 tp8xdp2 zero1 remat bf16", cfg7,
+            HybridParallelConfig(dp=2, pp=1, tp=8, remat=True, zero_stage=1,
+                                 dtype=jnp.bfloat16, xent_chunk=512),
+            batch_per_dp=4, seq=2048))
+        # perf-preferred tp4xdp4 recorded for the design note: the CPU
+        # lowering's fallback-attention temps push it just over the bound
+        records.append(_fit_record(
+            "llama-7b v5e-16 tp4xdp4 zero1 remat bf16 (informational)", cfg7,
+            HybridParallelConfig(dp=4, pp=1, tp=4, remat=True, zero_stage=1,
+                                 dtype=jnp.bfloat16, xent_chunk=512),
+            batch_per_dp=1, seq=2048))
+    if which in ("13b", "all"):
+        assert n_dev >= 32, f"need 32 virtual devices, have {n_dev}"
+        cfg13 = LlamaConfig(vocab_size=32000, hidden_size=5120,
+                            intermediate_size=13824, num_hidden_layers=40,
+                            num_attention_heads=40, num_key_value_heads=40,
+                            max_position_embeddings=2048)
+        # BASELINE config 4: hybrid TP+PP+DP + recompute (13B-class needs a
+        # v5e-32: f32 Adam moments alone are 104 GB = 6.5 GiB/chip on 16)
+        records.append(_fit_record(
+            "gpt3-13b-class v5e-32 tp4xpp4xdp2 zero1 M8 remat bf16", cfg13,
+            HybridParallelConfig(dp=2, pp=4, tp=4, remat=True, zero_stage=1,
+                                 num_microbatches=8, dtype=jnp.bfloat16,
+                                 xent_chunk=512),
+            batch_per_dp=1, seq=2048))
+    return records
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    print(json.dumps(run(which)))
