@@ -51,6 +51,9 @@ def _fit_record(tag, cfg, hp, batch_per_dp, seq):
     step = build_train_step(cfg, hp, mesh)
     t0 = time.time()
     ma = step.lower(pstructs, ostructs, tok).compile().memory_analysis()
+    if ma is None:
+        raise RuntimeError("backend returned no memory analysis "
+                           "(fit-proof needs the CPU or TPU XLA backend)")
     total = (ma.argument_size_in_bytes + ma.temp_size_in_bytes) / 2 ** 30
     return {
         "config": tag,
@@ -81,10 +84,9 @@ def run(which):
     records = []
     if which in ("7b", "all"):
         assert n_dev >= 16, f"need 16 virtual devices, have {n_dev}"
-        cfg7 = LlamaConfig(vocab_size=32000, hidden_size=4096,
-                           intermediate_size=11008, num_hidden_layers=32,
-                           num_attention_heads=32, num_key_value_heads=32,
-                           max_position_embeddings=2048)
+        import dataclasses
+        cfg7 = dataclasses.replace(LlamaConfig.llama_7b(),
+                                   max_position_embeddings=2048)
         # memory-preferred v5e-16 layout (BASELINE config 3 north star):
         # tp8 x dp2, ZeRO-1, full remat, bf16, chunked vocab xent
         records.append(_fit_record(
@@ -118,4 +120,6 @@ def run(which):
 
 if __name__ == "__main__":
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which not in ("7b", "13b", "all"):
+        sys.exit(f"usage: memfit.py [7b|13b|all] (got {which!r})")
     print(json.dumps(run(which)))
