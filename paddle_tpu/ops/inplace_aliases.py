@@ -90,6 +90,12 @@ CONSTANTS = {
 # remaining small ops the reference exports at root
 # ---------------------------------------------------------------------------
 
+def _block_diag_impl(*arrs):
+    import jax.numpy as jnp
+    import jax.scipy.linalg as jsl
+    return jsl.block_diag(*[jnp.atleast_2d(a) for a in arrs])
+
+
 def extra_ops():
     import jax.numpy as jnp
 
@@ -129,10 +135,9 @@ def extra_ops():
         return Tensor(jnp.asarray(_t(input).ndim, jnp.int32))
 
     def block_diag(inputs, name=None):
-        """Block-diagonal assembly (reference tensor/creation block_diag)."""
-        import jax.scipy.linalg as jsl
-        arrs = [jnp.atleast_2d(_t(x)) for x in inputs]
-        return Tensor(jsl.block_diag(*arrs))
+        """Block-diagonal assembly (reference tensor/creation block_diag).
+        Routed through the dispatcher so gradients flow to every block."""
+        return D.apply("block_diag", _block_diag_impl, tuple(inputs))
 
     def cartesian_prod(x, name=None):
         """Cartesian product of 1-D tensors (reference cartesian_prod)."""

@@ -482,7 +482,9 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
         a = a.ravel()
         axis = 0
     mask = np.ones(a.shape[axis], dtype=bool)
-    sl = [slice(None)] * a.ndim
+    # builtins.slice: this module's `slice` op shadows the builtin
+    import builtins
+    sl = [builtins.slice(None)] * a.ndim
     if a.shape[axis] > 1:
         d = np.diff(a, axis=axis)
         other = tuple(i for i in range(a.ndim) if i != axis)
