@@ -6,5 +6,9 @@ if __name__ == "__main__":
     if "--bootstrap" in sys.argv:
         from .bootstrap import main as bootstrap_main
         bootstrap_main()
-    n = generate_all()
-    print(f"generated registry/methods/stub for {n} ops")
+    if "--check" in sys.argv:
+        n = generate_all(check=True)
+        print(f"generated artifacts in sync for {n} ops")
+    else:
+        n = generate_all()
+        print(f"generated registry/methods/stub for {n} ops")

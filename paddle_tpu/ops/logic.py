@@ -18,37 +18,6 @@ __all__ = [
 ]
 
 
-def _binop(name, jfn):
-    def op(x, y, name=None):
-        return D.apply(op_name, jfn, (x, y))
-    op_name = name
-    op.__name__ = name
-    return op
-
-
-equal = _binop("equal", jnp.equal)
-not_equal = _binop("not_equal", jnp.not_equal)
-less_than = _binop("less_than", jnp.less)
-less_equal = _binop("less_equal", jnp.less_equal)
-greater_than = _binop("greater_than", jnp.greater)
-greater_equal = _binop("greater_equal", jnp.greater_equal)
-logical_and = _binop("logical_and", jnp.logical_and)
-logical_or = _binop("logical_or", jnp.logical_or)
-logical_xor = _binop("logical_xor", jnp.logical_xor)
-bitwise_and = _binop("bitwise_and", jnp.bitwise_and)
-bitwise_or = _binop("bitwise_or", jnp.bitwise_or)
-bitwise_xor = _binop("bitwise_xor", jnp.bitwise_xor)
-
-
-def logical_not(x, name=None):
-    return D.apply("logical_not", jnp.logical_not, (x,))
-
-
-def bitwise_not(x, name=None):
-    return D.apply("bitwise_not", jnp.bitwise_not, (x,))
-
-
-bitwise_invert = bitwise_not
 
 
 def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
@@ -89,3 +58,13 @@ def is_floating_point(x):
 
 def is_integer(x):
     return x.dtype.is_integer
+
+
+# kernel-driven (yaml source of truth) — see ops/kernels.py
+from .generated.op_wrappers import (  # noqa: E402,F401
+    equal, not_equal, less_than, less_equal, greater_than, greater_equal,
+    logical_and, logical_or, logical_xor, logical_not, bitwise_and,
+    bitwise_or, bitwise_xor, bitwise_not,
+)
+
+bitwise_invert = bitwise_not

@@ -47,176 +47,16 @@ def _axis(axis):
     return int(axis)
 
 
-# ---------------- binary elementwise ----------------
-
-def _binop(name, jfn):
-    def op(x, y, name=None):
-        return D.apply(op_name, jfn, (x, y))
-    op_name = name
-    op.__name__ = name
-    return op
-
-
-add = _binop("add", jnp.add)
-subtract = _binop("subtract", jnp.subtract)
-multiply = _binop("multiply", jnp.multiply)
-divide = _binop("divide", lambda x, y: jnp.true_divide(x, y))
-floor_divide = _binop("floor_divide", jnp.floor_divide)
-remainder = _binop("remainder", jnp.remainder)
-mod = remainder
-maximum = _binop("maximum", jnp.maximum)
-minimum = _binop("minimum", jnp.minimum)
-fmax = _binop("fmax", jnp.fmax)
-fmin = _binop("fmin", jnp.fmin)
-atan2 = _binop("atan2", jnp.arctan2)
-logaddexp = _binop("logaddexp", jnp.logaddexp)
-hypot = _binop("hypot", jnp.hypot)
-copysign = _binop("copysign", jnp.copysign)
-nextafter = _binop("nextafter", jnp.nextafter)
-heaviside = _binop("heaviside", jnp.heaviside)
-gcd = _binop("gcd", jnp.gcd)
-lcm = _binop("lcm", jnp.lcm)
-ldexp = _binop("ldexp", lambda x, y: jnp.ldexp(x, y.astype(jnp.int32)))
-bitwise_left_shift = _binop("bitwise_left_shift", jnp.left_shift)
-bitwise_right_shift = _binop("bitwise_right_shift", jnp.right_shift)
-
-
-def pow(x, y, name=None):
-    return D.apply("pow", jnp.power, (x, y))
-
-
 def float_power(x, y, name=None):
     return D.apply("float_power", lambda a, b: jnp.power(a.astype(jnp.float64), b), (x, y))
 
 
 # ---------------- matmul family ----------------
 
-def _matmul(x, y, transpose_x, transpose_y):
-    if transpose_x:
-        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
-    if transpose_y:
-        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
-    return jnp.matmul(x, y)
-
-
-def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
-    return D.apply("matmul", _matmul, (x, y),
-                   {"transpose_x": bool(transpose_x), "transpose_y": bool(transpose_y)})
-
-
-def mm(input, mat2, name=None):
-    return D.apply("matmul", _matmul, (input, mat2),
-                   {"transpose_x": False, "transpose_y": False})
-
-
-bmm = mm
-
-
-def dot(x, y, name=None):
-    return D.apply("dot", lambda a, b: jnp.sum(a * b, axis=-1), (x, y))
-
-
-def inner(x, y, name=None):
-    return D.apply("inner", jnp.inner, (x, y))
-
-
-def outer(x, y, name=None):
-    return D.apply("outer", lambda a, b: jnp.outer(a, b), (x, y))
-
-
-def kron(x, y, name=None):
-    return D.apply("kron", jnp.kron, (x, y))
-
-
-def _addmm(input, x, y, beta, alpha):
-    return beta * input + alpha * jnp.matmul(x, y)
-
-
-def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
-    return D.apply("addmm", _addmm, (input, x, y), {"beta": float(beta), "alpha": float(alpha)})
-
-
 def einsum(equation, *operands):
     ops = operands[0] if len(operands) == 1 and isinstance(operands[0], (list, tuple)) else operands
     return D.apply("einsum", lambda *arrs, equation: jnp.einsum(equation, *arrs),
                    tuple(ops), {"equation": equation})
-
-
-# ---------------- unary elementwise ----------------
-
-def _unop(name, jfn):
-    def op(x, name=None):
-        return D.apply(op_name, jfn, (x,))
-    op_name = name
-    op.__name__ = name
-    return op
-
-
-abs = _unop("abs", jnp.abs)
-neg = _unop("neg", jnp.negative)
-exp = _unop("exp", jnp.exp)
-expm1 = _unop("expm1", jnp.expm1)
-log = _unop("log", jnp.log)
-log2 = _unop("log2", jnp.log2)
-log10 = _unop("log10", jnp.log10)
-log1p = _unop("log1p", jnp.log1p)
-sqrt = _unop("sqrt", jnp.sqrt)
-rsqrt = _unop("rsqrt", lambda x: jax.lax.rsqrt(x))
-square = _unop("square", jnp.square)
-sin = _unop("sin", jnp.sin)
-cos = _unop("cos", jnp.cos)
-tan = _unop("tan", jnp.tan)
-asin = _unop("asin", jnp.arcsin)
-acos = _unop("acos", jnp.arccos)
-atan = _unop("atan", jnp.arctan)
-sinh = _unop("sinh", jnp.sinh)
-cosh = _unop("cosh", jnp.cosh)
-asinh = _unop("asinh", jnp.arcsinh)
-acosh = _unop("acosh", jnp.arccosh)
-atanh = _unop("atanh", jnp.arctanh)
-tanh = _unop("tanh", jnp.tanh)
-floor = _unop("floor", jnp.floor)
-ceil = _unop("ceil", jnp.ceil)
-round = _unop("round", jnp.round)
-trunc = _unop("trunc", jnp.trunc)
-frac = _unop("frac", lambda x: x - jnp.trunc(x))
-sign = _unop("sign", jnp.sign)
-sgn = sign
-reciprocal = _unop("reciprocal", jnp.reciprocal)
-erf = _unop("erf", jax.scipy.special.erf)
-erfinv = _unop("erfinv", jax.scipy.special.erfinv)
-isnan = _unop("isnan", jnp.isnan)
-isinf = _unop("isinf", jnp.isinf)
-isfinite = _unop("isfinite", jnp.isfinite)
-isposinf = _unop("isposinf", jnp.isposinf)
-isneginf = _unop("isneginf", jnp.isneginf)
-isreal = _unop("isreal", jnp.isreal)
-signbit = _unop("signbit", jnp.signbit)
-deg2rad = _unop("deg2rad", jnp.deg2rad)
-rad2deg = _unop("rad2deg", jnp.rad2deg)
-angle = _unop("angle", jnp.angle)
-conj = _unop("conj", jnp.conj)
-real = _unop("real", jnp.real)
-imag = _unop("imag", jnp.imag)
-i0 = _unop("i0", jnp.i0)
-i1 = _unop("i1", lambda x: jax.scipy.special.i1(x))
-digamma = _unop("digamma", jax.scipy.special.digamma)
-lgamma = _unop("lgamma", jax.scipy.special.gammaln)
-gammaln = lgamma
-logit_ = None
-
-
-def logit(x, eps=None, name=None):
-    def _logit(a, eps):
-        if eps is not None:
-            a = jnp.clip(a, eps, 1.0 - eps)
-        return jnp.log(a / (1.0 - a))
-    return D.apply("logit", _logit, (x,), {"eps": eps})
-
-
-def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
-    return D.apply("stanh", lambda a, sa, sb: sb * jnp.tanh(sa * a), (x,),
-                   {"sa": float(scale_a), "sb": float(scale_b)})
 
 
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
@@ -235,12 +75,6 @@ def clip(x, min=None, max=None, name=None):
     mn = min.item() if isinstance(min, Tensor) else min
     mx = max.item() if isinstance(max, Tensor) else max
     return D.apply("clip", _clip, (x,), {"mn": mn, "mx": mx})
-
-
-def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
-    return D.apply("nan_to_num",
-                   lambda a, nan, posinf, neginf: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
-                   (x,), {"nan": nan, "posinf": posinf, "neginf": neginf})
 
 
 def lerp(x, y, weight, name=None):
@@ -543,23 +377,6 @@ def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
                     "has_append": has_append})
 
 
-def trace(x, offset=0, axis1=0, axis2=1, name=None):
-    return D.apply("trace",
-                   lambda a, offset, axis1, axis2: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
-                   (x,), {"offset": int(offset), "axis1": int(axis1), "axis2": int(axis2)})
-
-
-def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
-    return D.apply("diagonal",
-                   lambda a, offset, axis1, axis2: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
-                   (x,), {"offset": int(offset), "axis1": int(axis1), "axis2": int(axis2)})
-
-
-def rot90(x, k=1, axes=(0, 1), name=None):
-    return D.apply("rot90", lambda a, k, axes: jnp.rot90(a, k=k, axes=axes),
-                   (x,), {"k": int(k), "axes": tuple(axes)})
-
-
 def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
     def _hist(a, bins, mn, mx, density):
         if mn == 0 and mx == 0:
@@ -571,14 +388,24 @@ def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=No
 
 
 def bincount(x, weights=None, minlength=0, name=None):
+    # Output length is data-dependent (reference bincount kernel sizes the
+    # result from max(x)); resolve it host-side so the compiled op has a
+    # static shape — jnp.bincount cannot trace a dynamic length.
+    import builtins
+    from ..core.tensor import Tensor
+    xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    # builtins.max: this module's `max` op shadows the builtin
+    length = builtins.max(int(xa.max()) + 1 if xa.size else 0,
+                          int(minlength))
     if weights is None:
         return D.apply("bincount",
-                       lambda a, minlength: jnp.bincount(a, minlength=minlength,
-                                                         length=None).astype(jnp.int64),
-                       (x,), {"minlength": int(minlength)})
+                       lambda a, length: jnp.bincount(
+                           a, length=length).astype(jnp.int64),
+                       (x,), {"length": length})
     return D.apply("bincount_w",
-                   lambda a, w, minlength: jnp.bincount(a, weights=w, minlength=minlength),
-                   (x, weights), {"minlength": int(minlength)})
+                   lambda a, w, length: jnp.bincount(a, weights=w,
+                                                     length=length),
+                   (x, weights), {"length": length})
 
 
 def broadcast_shape(x_shape, y_shape):
@@ -593,23 +420,6 @@ def renorm(x, p, axis, max_norm, name=None):
         return a * factor
     return D.apply("renorm", _renorm, (x,),
                    {"p": float(p), "axis": int(axis), "max_norm": float(max_norm)})
-
-
-def log_normalize(x, axis=-1, name=None):
-    return D.apply("log_normalize",
-                   lambda a, axis: a - jax.scipy.special.logsumexp(a, axis=axis, keepdims=True),
-                   (x,), {"axis": int(axis)})
-
-
-def reduce_as(x, target, name=None):
-    def _reduce_as(a, tgt):
-        extra = a.ndim - tgt.ndim
-        axes = tuple(range(extra)) + tuple(
-            i + extra for i, s in enumerate(tgt.shape) if s == 1 and a.shape[i + extra] != 1
-        )
-        out = jnp.sum(a, axis=axes, keepdims=False)
-        return out.reshape(tgt.shape)
-    return D.apply("reduce_as", _reduce_as, (x, target))
 
 
 def take(x, index, mode="raise", name=None):
@@ -643,3 +453,24 @@ def combinations(x, r=2, with_replacement=False, name=None):
     flat = index_select(x, Tensor(jnp.asarray(idx.ravel())), axis=0)
     from .manipulation import reshape
     return reshape(flat, [-1, r])
+
+
+# ---------------------------------------------------------------------------
+# Kernel-driven ops: the yaml schema is the source of truth; the wrappers are
+# generated (ops/generated/op_wrappers.py) from `kernel:` fields over
+# ops/kernels.py.  Re-exported here so `from paddle_tpu.ops.math import add`
+# keeps working for callers and the Tensor dunder bindings.
+# ---------------------------------------------------------------------------
+from .generated.op_wrappers import (  # noqa: E402,F401
+    abs, neg, exp, expm1, log, log2, log10, log1p, sqrt, rsqrt, square,
+    sin, cos, tan, asin, acos, atan, sinh, cosh, asinh, acosh, atanh, tanh,
+    floor, ceil, round, trunc, frac, sign, sgn, reciprocal, erf, erfinv,
+    isnan, isinf, isfinite, isposinf, isneginf, isreal, signbit, deg2rad,
+    rad2deg, angle, conj, real, imag, i0, i1, digamma, lgamma, gammaln,
+    add, subtract, multiply, divide, floor_divide, remainder, mod, pow,
+    maximum, minimum, fmax, fmin, atan2, logaddexp, hypot, copysign,
+    nextafter, heaviside, gcd, lcm, ldexp, bitwise_left_shift,
+    bitwise_right_shift, matmul, mm, bmm, dot, inner, outer, kron, addmm,
+    stanh, logit, nan_to_num, trace, diagonal, rot90, log_normalize,
+    reduce_as,
+)
