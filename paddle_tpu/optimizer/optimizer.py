@@ -55,6 +55,10 @@ class Optimizer:
         return ()
 
     def _init_slot(self, name, p):
+        # accumulators stay float32 even for low-precision params (the
+        # reference multi-precision contract; bf16 moments quantize badly)
+        if jnp.issubdtype(p._data.dtype, jnp.floating):
+            return jnp.zeros(p._data.shape, jnp.float32)
         return jnp.zeros_like(p._data)
 
     def _state_for(self, p):
@@ -82,15 +86,16 @@ class Optimizer:
         if cached is not None:
             return cached
         slot_names = tuple(self._slot_names())
-        _, param_lrs, wds, masked = key
+        _, param_lrs, wds, masked, low_dts = key
 
         def run(params, grads, states, lr, extra, *maybe_mask):
             # masked variant: skip_mask is a DEVICE bool (AMP found_inf) —
             # when true the whole update is an identity, so the found_inf
             # decision never forces a host sync inside step()
             mask = maybe_mask[0] if masked else None
-            new_params, new_states = [], []
-            for p, g, st, plr, wd in zip(params, grads, states, param_lrs, wds):
+            new_params, new_states, new_lows = [], [], []
+            for p, g, st, plr, wd, low in zip(params, grads, states,
+                                              param_lrs, wds, low_dts):
                 np_, nst = self._update_arrays(p, g, dict(zip(slot_names, st)),
                                               lr, plr, wd, extra)
                 if masked:
@@ -98,8 +103,11 @@ class Optimizer:
                     nst = {n: jnp.where(mask, st[i], nst[n])
                            for i, n in enumerate(slot_names)}
                 new_params.append(np_)
+                # AMP O2 master weights: update ran in f32 (p IS the master);
+                # emit the low-precision working copy in the same program
+                new_lows.append(np_.astype(low) if low is not None else None)
                 new_states.append(tuple(nst[n] for n in slot_names))
-            return new_params, new_states
+            return new_params, new_states, new_lows
 
         exe = jax.jit(run, donate_argnums=(0, 2))
         self._step_fn_cache[key] = exe
@@ -144,7 +152,14 @@ class Optimizer:
         lr = ovr if ovr is not None else jnp.asarray(self.get_lr(), jnp.float32)
         slot_names = tuple(self._slot_names())
 
-        params = [p._data for p, _ in params_grads]
+        # AMP O2: params decorated with a float32 master copy update in f32
+        # (reference optimizer.py multi-precision master-weight path); the
+        # low-precision working copy is recast inside the fused program
+        masters = [getattr(p, "_master_weight", None) for p, _ in params_grads]
+        params = [m if m is not None else p._data
+                  for (p, _), m in zip(params_grads, masters)]
+        low_dts = tuple(str(p._data.dtype) if m is not None else None
+                        for (p, _), m in zip(params_grads, masters))
         # L1 regularization: grad += coeff * sign(p) (reference
         # L1DecayRegularizer appends the same term pre-update)
         grads = []
@@ -179,14 +194,19 @@ class Optimizer:
 
         mask = getattr(self, "_skip_update_mask", None)
         key = (tuple((tuple(p.shape), str(p.dtype)) for p in params),
-               param_lrs, wds, mask is not None)
+               param_lrs, wds, mask is not None, low_dts)
         args = (params, grads, states, lr, extra)
         if mask is not None:
             args = args + (mask,)
-        new_params, new_states = self._compiled_step(key)(*args)
+        new_params, new_states, new_lows = self._compiled_step(key)(*args)
 
-        for (p, _), np_, nst in zip(params_grads, new_params, new_states):
-            p._data = np_
+        for (p, _), np_, nst, nl in zip(params_grads, new_params,
+                                        new_states, new_lows):
+            if nl is not None:
+                p._master_weight = np_
+                p._data = nl
+            else:
+                p._data = np_
             st = self._accumulators[id(p)]
             for n, v in zip(slot_names, nst):
                 st[n] = v
