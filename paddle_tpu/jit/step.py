@@ -246,6 +246,11 @@ class CapturedStep:
                 "traced value (float()/bool()/.numpy()/if-on-tensor). Keep "
                 "the step device-pure; read metrics from the returned "
                 "tensors instead.") from e
+        # host-side heartbeat: a stuck multichip program inside this step
+        # surfaces as the watchdog's CRITICAL dump instead of a silent hang
+        from ..distributed.watchdog import comm_task_manager, watch
+        if comm_task_manager._timeout() > 0 and new_state["params"]:
+            watch("jit.capture_step", (), new_state["params"][0])
         # write results back into the live objects
         for p, arr in zip(self._params, new_state["params"]):
             p._data = arr

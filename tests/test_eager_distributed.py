@@ -439,3 +439,44 @@ def test_multinode_elastic_restart_coordinated(tmp_path):
     # both launchers logged the coordinated restart
     assert any("restart 1/2" in o[1] for o in outs), \
         [o[1][-500:] for o in outs]
+
+
+def test_watch_step_heartbeat_dumps_on_stuck_step(caplog):
+    """watch_step: a compiled-step output that never becomes ready past the
+    timeout produces the watchdog CRITICAL dump (captured-program hang
+    coverage — collectives inside jitted programs are XLA-owned)."""
+    import logging
+    import time as _time
+
+    import paddle_tpu  # noqa: F401
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.distributed import watchdog as wd
+
+    class _NeverReady:
+        shape = (2,)
+
+        def is_ready(self):
+            return False
+
+    def fake_step(x):
+        return {"loss": _NeverReady()}
+
+    mgr = wd.CommTaskManager(poll_interval=0.05)
+    set_flags({"comm_watchdog_timeout": 0.1})
+    pkg_log = logging.getLogger("paddle_tpu")
+    pkg_log.propagate = True
+    saved = wd.comm_task_manager
+    wd.comm_task_manager = mgr
+    try:
+        stepped = wd.watch_step(fake_step, "hybrid_step")
+        with caplog.at_level(logging.CRITICAL,
+                             logger="paddle_tpu.distributed.watchdog"):
+            out = stepped(1)
+            assert isinstance(out["loss"], _NeverReady)  # passthrough
+            _time.sleep(0.5)
+        assert any("hybrid_step" in r.message for r in caplog.records)
+    finally:
+        wd.comm_task_manager = saved
+        pkg_log.propagate = False
+        set_flags({"comm_watchdog_timeout": 0.0})
+        mgr.shutdown()

@@ -1,0 +1,85 @@
+"""Measure pipeline-bubble wall-clock: 1F1B vs interleaved VPP (VERDICT r3
+item 8).  Runs the COMPILED hybrid trainer on the virtual CPU mesh at
+pp in {2,4} x schedule in {1f1b, vpp2, vpp4} and compares median step time
+against the analytic model in parallel/transformer.py
+pipeline_schedule_stats (relative_time = M + (pp-1)/vpp ticks).
+
+Run:  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python tools/perf/pp_bubble.py
+"""
+import json
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np       # noqa: E402
+
+from paddle_tpu.models.llama import LlamaConfig                  # noqa: E402
+from paddle_tpu.parallel import (                                # noqa: E402
+    HybridParallelConfig, build_mesh, build_train_step, init_opt_state,
+    init_params, shard_opt_state, shard_params)
+from paddle_tpu.parallel.transformer import (                    # noqa: E402
+    pipeline_schedule_stats)
+
+
+def measure(pp, schedule, vpp, M=8, reps=3, steps=2):
+    # L=16 divides every pp*vpp combo here; sized so per-tick compute
+    # dominates dispatch on the CPU mesh
+    cfg = LlamaConfig(vocab_size=512, hidden_size=256,
+                      intermediate_size=512, num_hidden_layers=16,
+                      num_attention_heads=4, num_key_value_heads=4,
+                      max_position_embeddings=256)
+    hp = HybridParallelConfig(dp=1, pp=pp, tp=1, num_microbatches=M,
+                              pp_schedule=schedule, vpp=vpp, remat=False,
+                              dtype=jnp.float32)
+    mesh = build_mesh(hp)
+    params = shard_params(init_params(cfg, hp, seed=0), hp, mesh)
+    opt = shard_opt_state(init_opt_state(params), hp, mesh)
+    step = build_train_step(cfg, hp, mesh)
+    tok = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (M * 2, 256)), jnp.int32)
+    params, opt, loss = step(params, opt, tok)     # compile
+    float(loss)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt, loss = step(params, opt, tok)
+        float(loss)
+        times.append((time.perf_counter() - t0) / steps)
+    times.sort()
+    stats = pipeline_schedule_stats(hp, M)
+    return {"pp": pp, "schedule": f"{schedule}" + (f"{vpp}" if vpp > 1
+                                                   else ""),
+            "step_s": round(times[len(times) // 2], 4),
+            "spread": [round(times[0], 4), round(times[-1], 4)],
+            "analytic_rel_time": round(stats["relative_time"], 2),
+            "analytic_bubble": round(stats["bubble_fraction"], 4)}
+
+
+def main():
+    rows = []
+    for pp in (2, 4):
+        for schedule, vpp in (("1f1b", 1), ("vpp", 2), ("vpp", 4)):
+            rows.append(measure(pp, schedule, vpp))
+            print(json.dumps(rows[-1]), flush=True)
+    # measured speedup vs analytic prediction, per pp group
+    out = {"rows": rows, "verdict": {}}
+    for pp in (2, 4):
+        grp = [r for r in rows if r["pp"] == pp]
+        base = grp[0]
+        for r in grp[1:]:
+            pred = base["analytic_rel_time"] / r["analytic_rel_time"]
+            meas = base["step_s"] / r["step_s"]
+            out["verdict"][f"pp{pp}:{r['schedule']}"] = {
+                "predicted_speedup_vs_1f1b": round(pred, 3),
+                "measured_speedup_vs_1f1b": round(meas, 3)}
+    print(json.dumps(out["verdict"]))
+    return out
+
+
+if __name__ == "__main__":
+    main()
