@@ -480,3 +480,124 @@ def test_watch_step_heartbeat_dumps_on_stuck_step(caplog):
         pkg_log.propagate = False
         set_flags({"comm_watchdog_timeout": 0.0})
         mgr.shutdown()
+
+
+def test_p2p_pipeline_parallel_cross_process(tmp_path):
+    """Eager cross-process pipeline (P2PPipelineParallel): two processes
+    each own one stage, exchange activations/input-grads over send/recv,
+    and after one train_batch the stage parameters match a single-process
+    reference run to fp32 tolerance."""
+    r = _launch(tmp_path, """
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel \\
+            import P2PPipelineParallel
+
+        dist.init_parallel_env()
+        rank = dist.get_rank()
+        M, B = 4, 8
+
+        paddle.seed(3)
+        s0 = nn.Sequential(nn.Linear(8, 16), nn.ReLU())
+        s1 = nn.Sequential(nn.Linear(16, 4))
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(B, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(B, 4).astype(np.float32))
+
+        # single-process reference (both stages, same init)
+        ref0 = nn.Sequential(nn.Linear(8, 16), nn.ReLU())
+        ref1 = nn.Sequential(nn.Linear(16, 4))
+        ref0.set_state_dict(s0.state_dict())
+        ref1.set_state_dict(s1.state_dict())
+        ropt = paddle.optimizer.SGD(
+            learning_rate=0.1,
+            parameters=list(ref0.parameters()) + list(ref1.parameters()))
+        losses = []
+        for i in range(M):
+            xb = x[i*2:(i+1)*2]; yb = y[i*2:(i+1)*2]
+            loss = F.mse_loss(ref1(ref0(xb)), yb)
+            (loss / M).backward()
+            losses.append(float(loss.numpy()))
+        ropt.step(); ropt.clear_grad()
+
+        local = s0 if rank == 0 else s1
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=local.parameters())
+        pipe = P2PPipelineParallel(
+            local, stage_id=rank, num_stages=2,
+            loss_fn=(lambda out, y: F.mse_loss(out, y)),
+            acc_steps=M, recv_shape=(2, 16) if rank == 1 else None)
+        loss = pipe.train_batch((x if rank == 0 else None,
+                                 y if rank == 1 else None), opt)
+        if rank == 1:
+            np.testing.assert_allclose(loss, np.mean(losses), rtol=1e-5)
+
+        ref = ref0 if rank == 0 else ref1
+        for (k, pr), (_, pl) in zip(ref.named_parameters(),
+                                    local.named_parameters()):
+            np.testing.assert_allclose(pl.numpy(), pr.numpy(), rtol=1e-5,
+                                       atol=1e-6, err_msg=f"r{rank}:{k}")
+        with open(f"ok_{rank}", "w") as f:
+            f.write("pass")
+    """)
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert (tmp_path / "ok_0").exists() and (tmp_path / "ok_1").exists()
+
+
+def test_p2p_pipeline_scaler_found_inf_agrees_across_stages(tmp_path):
+    """Dynamic loss scaling over the p2p pipeline: an overflow visible only
+    on the last stage must make EVERY stage skip the step and halve its
+    scale (found_inf is all-reduced across the pipeline group)."""
+    r = _launch(tmp_path, """
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel \\
+            import P2PPipelineParallel
+
+        dist.init_parallel_env()
+        rank = dist.get_rank()
+        paddle.seed(5)
+        local = (nn.Sequential(nn.Linear(8, 16), nn.ReLU()) if rank == 0
+                 else nn.Sequential(nn.Linear(16, 4)))
+        before = {k: p.numpy().copy()
+                  for k, p in local.named_parameters()}
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=local.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0,
+                                       decr_every_n_nan_or_inf=1)
+
+        # loss_fn that overflows ONLY on the last stage
+        def bad_loss(out, y):
+            return F.mse_loss(out, y) * 1e38 * 1e38
+
+        pipe = P2PPipelineParallel(
+            local, stage_id=rank, num_stages=2, loss_fn=bad_loss,
+            acc_steps=2, recv_shape=(2, 16) if rank == 1 else None)
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(4, 4).astype(np.float32))
+        pipe.train_batch((x if rank == 0 else None,
+                          y if rank == 1 else None), opt, scaler=scaler)
+        for k, p in local.named_parameters():
+            np.testing.assert_array_equal(p.numpy(), before[k],
+                                          err_msg=f"r{rank}:{k} stepped")
+        assert float(scaler.get_loss_scaling().numpy()) == 512.0, rank
+        with open(f"ok_{rank}", "w") as f:
+            f.write("pass")
+    """)
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert (tmp_path / "ok_0").exists() and (tmp_path / "ok_1").exists()
