@@ -236,6 +236,13 @@ def main():
     except Exception as e:
         record["resnet50"] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
+    # BERT-base SQuAD fine-tune step (BASELINE.json config 3: dygraph AMP
+    # O2): the USER-API model driven through jit.capture_step.
+    try:
+        record["bert"] = _bert_bench(on_tpu)
+    except Exception as e:
+        record["bert"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     # Product-surface bench (VERDICT r2 item 10): the same architecture
     # driven through the USER API — nn.Layer (LlamaForCausalLM) + AdamW +
     # amp auto_cast/GradScaler, eager dygraph loop — so the eager stack's
@@ -325,6 +332,62 @@ def _resnet_bench(on_tpu):
     return {"images_per_sec": round(rates[len(rates) // 2], 1),
             "reps": [round(r, 1) for r in rates],
             "batch": batch, "image_hw": hw, "loss": float(loss)}
+
+
+def _bert_bench(on_tpu):
+    """BERT fine-tune step sequences/sec: BertForQuestionAnswering +
+    AdamW + GradScaler under amp O2, compiled via jit.capture_step."""
+    import time as _t
+
+    import numpy as np
+
+    import paddle_tpu as pd
+    from paddle_tpu.models.bert import BertConfig, BertForQuestionAnswering
+
+    if on_tpu:
+        cfg = BertConfig.bert_base()
+        batch, seq, steps, reps = 16, 384, 4, 3
+    else:
+        cfg = BertConfig.tiny()
+        batch, seq, steps, reps = 2, 64, 2, 3
+
+    model = BertForQuestionAnswering(cfg)
+    if on_tpu:
+        model = pd.amp.decorate(model, level="O2", dtype="bfloat16")
+    opt = pd.optimizer.AdamW(learning_rate=3e-5,
+                             parameters=model.parameters())
+    scaler = pd.amp.GradScaler(enable=not on_tpu)   # bf16 needs no scaling
+    rng = np.random.RandomState(0)
+    ids = pd.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)),
+                       dtype="int64")
+    sp = pd.to_tensor(rng.randint(0, seq, (batch,)), dtype="int64")
+    ep = pd.to_tensor(rng.randint(0, seq, (batch,)), dtype="int64")
+
+    def step(ids, sp, ep):
+        with pd.amp.auto_cast(level="O2" if on_tpu else "O1"):
+            _, _, loss = model(ids, start_positions=sp, end_positions=ep)
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        scaler.update()
+        opt.clear_grad()
+        return loss
+
+    cap = pd.jit.capture_step(step, models=model, optimizers=opt,
+                              scalers=scaler)
+    loss = cap(ids, sp, ep)
+    float(loss.numpy())
+    rates = []
+    for _ in range(reps):
+        t0 = _t.perf_counter()
+        for _ in range(steps):
+            loss = cap(ids, sp, ep)
+        float(loss.numpy())
+        rates.append(batch * steps / (_t.perf_counter() - t0))
+    rates.sort()
+    return {"sequences_per_sec": round(rates[len(rates) // 2], 1),
+            "reps": [round(r, 1) for r in rates], "batch": batch,
+            "seq": seq, "loss": float(loss.numpy()),
+            "path": "BertForQuestionAnswering via jit.capture_step (O2)"}
 
 
 def _product_bench(on_tpu):

@@ -1,0 +1,159 @@
+"""BERT model family over the framework's own nn stack.
+
+The reference keeps BERT in PaddleNLP (paddlenlp/transformers/bert), built on
+python/paddle/nn MultiHeadAttention / TransformerEncoder; this is the same
+composition over paddle_tpu.nn — embeddings (word + position + token type)
+-> LayerNorm/dropout -> TransformerEncoder -> task heads — so BASELINE.json
+config 2 ("BERT-base SQuAD fine-tune, dygraph AMP O2") runs on in-repo code.
+
+TPU notes: post-norm encoder blocks run in bf16 under amp O1/O2; the
+sequence dim should be a multiple of 128 for MXU-friendly attention tiles.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+from ..core import dispatch
+from ..core.tensor import Tensor
+from ..nn import functional as F
+
+__all__ = ["BertConfig", "BertModel", "BertForSequenceClassification",
+           "BertForQuestionAnswering", "BertPooler"]
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, hidden_act="gelu",
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 layer_norm_eps=1e-12):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.hidden_act = hidden_act
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.layer_norm_eps = layer_norm_eps
+
+    @staticmethod
+    def bert_base():
+        return BertConfig()
+
+    @staticmethod
+    def tiny(vocab=128, hidden=32, layers=2, heads=4, ffn=64, seq=64):
+        return BertConfig(vocab_size=vocab, hidden_size=hidden,
+                          num_hidden_layers=layers, num_attention_heads=heads,
+                          intermediate_size=ffn,
+                          max_position_embeddings=seq)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(config.vocab_size,
+                                            config.hidden_size)
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, config.hidden_size)
+        self.token_type_embeddings = nn.Embedding(config.type_vocab_size,
+                                                  config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        from ..ops.creation import arange, zeros_like
+        from ..ops.manipulation import unsqueeze
+
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = unsqueeze(arange(s, dtype="int64"), 0)
+        if token_type_ids is None:
+            token_type_ids = zeros_like(input_ids)
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class BertPooler(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.dense = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, hidden_states):
+        return F.tanh(self.dense(hidden_states[:, 0]))
+
+
+class BertModel(nn.Layer):
+    """Embeddings + post-norm TransformerEncoder + pooler (the PaddleNLP
+    BertModel topology over paddle_tpu.nn building blocks)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        layer = nn.TransformerEncoderLayer(
+            config.hidden_size, config.num_attention_heads,
+            config.intermediate_size, dropout=config.hidden_dropout_prob,
+            activation=config.hidden_act,
+            attn_dropout=config.attention_probs_dropout_prob,
+            layer_norm_eps=config.layer_norm_eps)
+        self.encoder = nn.TransformerEncoder(layer,
+                                             config.num_hidden_layers)
+        self.pooler = BertPooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        if attention_mask is not None:
+            # [B, S] 1/0 -> additive [B, 1, 1, S] mask
+            from ..ops.manipulation import unsqueeze
+            m = unsqueeze(unsqueeze(attention_mask, 1), 1)
+            attention_mask = (1.0 - m.astype(x.dtype)) * -1e4
+        seq = self.encoder(x, attention_mask)
+        return seq, self.pooler(seq)
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, config: BertConfig, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is None:
+            return logits
+        return logits, F.cross_entropy(logits, labels)
+
+
+class BertForQuestionAnswering(nn.Layer):
+    """SQuAD span head (start/end logits) — BASELINE config 2's model."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.qa_outputs = nn.Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                start_positions=None, end_positions=None):
+        seq, _ = self.bert(input_ids, token_type_ids,
+                           attention_mask=attention_mask)
+        logits = self.qa_outputs(seq)                      # [B, S, 2]
+        start_logits = logits[:, :, 0]
+        end_logits = logits[:, :, 1]
+        if start_positions is None:
+            return start_logits, end_logits
+        loss = (F.cross_entropy(start_logits, start_positions)
+                + F.cross_entropy(end_logits, end_positions)) * 0.5
+        return start_logits, end_logits, loss

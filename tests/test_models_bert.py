@@ -1,0 +1,72 @@
+"""BERT model family (BASELINE config 3's model, built on paddle_tpu.nn —
+the reference keeps BERT in PaddleNLP over the same nn primitives)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.models.bert import (BertConfig, BertForQuestionAnswering,
+                                    BertForSequenceClassification, BertModel)
+
+
+def _data(cfg, b=2, s=32, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (b, s)),
+                           dtype="int64")
+    mask = paddle.to_tensor(np.ones((b, s), np.float32))
+    return ids, mask
+
+
+def test_bert_model_shapes_and_mask():
+    cfg = BertConfig.tiny()
+    m = BertModel(cfg)
+    m.eval()
+    ids, mask = _data(cfg)
+    seq, pooled = m(ids, attention_mask=mask)
+    assert tuple(seq.shape) == (2, 32, cfg.hidden_size)
+    assert tuple(pooled.shape) == (2, cfg.hidden_size)
+    # masking out the tail changes the pooled output
+    mask2 = paddle.to_tensor(
+        np.concatenate([np.ones((2, 16), np.float32),
+                        np.zeros((2, 16), np.float32)], axis=1))
+    _, pooled2 = m(ids, attention_mask=mask2)
+    assert not np.allclose(pooled.numpy(), pooled2.numpy())
+
+
+def test_bert_qa_finetune_converges_captured():
+    """A few captured fine-tune steps on a fixed batch must drive the span
+    loss down (the BASELINE config-3 loop in miniature)."""
+    cfg = BertConfig.tiny()
+    paddle.seed(0)
+    m = BertForQuestionAnswering(cfg)
+    rng = np.random.RandomState(1)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (4, 32)),
+                           dtype="int64")
+    sp = paddle.to_tensor(rng.randint(0, 32, (4,)), dtype="int64")
+    ep = paddle.to_tensor(rng.randint(0, 32, (4,)), dtype="int64")
+    opt = paddle.optimizer.AdamW(learning_rate=5e-3,
+                                 parameters=m.parameters())
+
+    def step(ids, sp, ep):
+        _, _, loss = m(ids, start_positions=sp, end_positions=ep)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    cap = paddle.jit.capture_step(step, models=m, optimizers=opt)
+    first = float(cap(ids, sp, ep).numpy())
+    for _ in range(12):
+        last = float(cap(ids, sp, ep).numpy())
+    assert last < first * 0.7, (first, last)
+
+
+def test_bert_sequence_classification_loss():
+    cfg = BertConfig.tiny()
+    m = BertForSequenceClassification(cfg, num_classes=5)
+    ids, mask = _data(cfg)
+    labels = paddle.to_tensor(np.asarray([1, 3]), dtype="int64")
+    logits, loss = m(ids, attention_mask=mask, labels=labels)
+    assert tuple(logits.shape) == (2, 5)
+    ref = F.cross_entropy(logits, labels)
+    np.testing.assert_allclose(float(loss.numpy()), float(ref.numpy()),
+                               rtol=1e-6)
