@@ -1,0 +1,125 @@
+"""MFU ablation trail (VERDICT r4 item 2): run the lever grid on the real
+chip, append tagged records to bench_history.json, and write
+MFU_ABLATION_r04.json.
+
+Each lever runs in a SUBPROCESS (own backend init) so an OOM or lowering
+failure in one variant cannot take down the trail, and env-var levers
+(FA block sizes) apply cleanly.
+
+Run on a live tunnel:  python tools/perf/mfu_ablation.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+WORKER = r"""
+import json, sys, time
+import jax, jax.numpy as jnp, numpy as np
+from paddle_tpu.models.llama import LlamaConfig
+from paddle_tpu.parallel import (HybridParallelConfig, build_mesh,
+                                 build_train_step, init_opt_state,
+                                 init_params, shard_opt_state, shard_params)
+
+spec = json.loads(sys.argv[1])
+if not spec.get("flash", True):
+    from paddle_tpu.core.flags import set_flags
+    set_flags({"use_pallas_kernels": False})
+cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                  intermediate_size=2816, num_hidden_layers=24,
+                  num_attention_heads=16, num_key_value_heads=4,
+                  max_position_embeddings=2048)
+hp = HybridParallelConfig(dp=1, pp=1, tp=1, num_microbatches=1,
+                          remat=spec.get("remat", True),
+                          remat_policy=spec.get("remat_policy", "full"),
+                          xent_chunk=spec.get("xent_chunk", 0),
+                          dtype=jnp.bfloat16)
+mesh = build_mesh(hp)
+params = shard_params(init_params(cfg, hp, seed=0), hp, mesh)
+opt = shard_opt_state(init_opt_state(params), hp, mesh)
+step = build_train_step(cfg, hp, mesh)
+b, s, steps = spec.get("batch", 8), 2048, 6
+tok = jnp.asarray(np.random.RandomState(0).randint(
+    0, cfg.vocab_size, (b, s)), jnp.int32)
+params, opt, loss = step(params, opt, tok); float(loss)
+reps = []
+for _ in range(3):
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt, loss = step(params, opt, tok)
+    float(loss)
+    reps.append(b * s * steps / (time.perf_counter() - t0))
+reps.sort()
+n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+tokps = reps[1]
+print(json.dumps({"tokens_per_sec": round(tokps, 1),
+                  "reps": [round(r, 1) for r in reps],
+                  "mfu": round(6.0 * n * tokps / 197e12, 4),
+                  "n_params": n}))
+"""
+
+LEVERS = [
+    ("baseline_b8_remat_full", {}),
+    ("no_remat_b2", {"remat": False, "batch": 2}),
+    ("no_remat_b4", {"remat": False, "batch": 4}),
+    ("remat_attn_b8", {"remat_policy": "attn"}),
+    ("xent_chunk512_b8", {"xent_chunk": 512}),
+    ("batch16_remat_full", {"batch": 16}),
+    ("fa_block256", {"env": {"PADDLE_TPU_FA_BLOCK_Q": "256",
+                             "PADDLE_TPU_FA_BLOCK_K": "256"}}),
+    ("fa_block1024", {"env": {"PADDLE_TPU_FA_BLOCK_Q": "1024",
+                              "PADDLE_TPU_FA_BLOCK_K": "1024"}}),
+    ("xla_fallback_no_flash", {"flash": False, "batch": 4}),
+]
+
+
+def main():
+    results = {}
+    for tag, spec in LEVERS:
+        env = dict(os.environ)
+        env.update(spec.pop("env", {}))
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        t0 = time.time()
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", WORKER, json.dumps(spec)],
+                capture_output=True, text=True, timeout=900, env=env,
+                cwd=REPO)
+            if out.returncode == 0:
+                results[tag] = json.loads(out.stdout.strip().splitlines()[-1])
+            else:
+                results[tag] = {"error": out.stderr[-400:]}
+        except subprocess.TimeoutExpired:
+            results[tag] = {"error": "timeout (> 900s)"}
+        results[tag]["wall_s"] = round(time.time() - t0, 1)
+        print(tag, json.dumps(results[tag]), flush=True)
+
+    # append the trail to bench_history.json (tagged ablation records)
+    hist_path = os.path.join(REPO, "bench_history.json")
+    try:
+        history = json.load(open(hist_path))
+    except Exception:
+        history = []
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%S")
+    for tag, rec in results.items():
+        if "tokens_per_sec" in rec:
+            history.append({"tokens_per_sec": rec["tokens_per_sec"],
+                            "reps": rec["reps"], "mfu": rec["mfu"],
+                            "backend": "tpu", "config": f"ablation:{tag}",
+                            "n_params": rec.get("n_params"),
+                            "time": stamp})
+    json.dump(history, open(hist_path, "w"), indent=1)
+    json.dump({"round": 4, "time": stamp, "levers": results},
+              open(os.path.join(REPO, "MFU_ABLATION_r04.json"), "w"),
+              indent=1)
+    print("written MFU_ABLATION_r04.json")
+
+
+if __name__ == "__main__":
+    main()
