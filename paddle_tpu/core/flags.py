@@ -127,6 +127,8 @@ def flag_names():
 
 
 # Core flags (subset of the reference's 183; grows as subsystems land).
+define_flag("v", 0, "GLOG-style verbosity for framework vlog messages "
+            "(higher = chattier; GLOG_v env also honored).")
 define_flag("check_nan_inf", False, "Check outputs of every op for NaN/Inf (debug).")
 define_flag("check_nan_inf_level", 0, "0: error on nan/inf; >0 softer reporting levels.")
 define_flag("eager_compile_cache_size", 4096, "Max cached compiled single-op executables.")

@@ -18,9 +18,13 @@ __all__ = ["set_device", "get_device", "get_all_device_type",
            "get_available_custom_device", "synchronize", "device_count",
            "Stream", "Event", "current_stream", "set_stream", "stream_guard",
            "get_cudnn_version", "is_compiled_with_cinn", "IS_WINDOWS", "cuda",
-           "custom"]
+           "custom", "memory", "live_buffers", "live_buffer_bytes",
+           "memory_summary"]
 
 from . import custom  # noqa: E402,F401
+from . import memory  # noqa: E402,F401
+from .memory import (  # noqa: E402,F401
+    live_buffer_bytes, live_buffers, memory_summary)
 
 IS_WINDOWS = False
 

@@ -9,7 +9,7 @@ from __future__ import annotations
 import logging
 import os
 
-__all__ = ["get_logger", "set_level", "logger"]
+__all__ = ["get_logger", "set_level", "logger", "vlog"]
 
 _FMT = "%(asctime)s %(levelname)s [%(name)s] %(message)s"
 
@@ -35,3 +35,27 @@ def set_level(level, name="paddle_tpu"):
 
 
 logger = get_logger()
+
+
+def _verbosity() -> int:
+    """GLOG-style verbosity: FLAGS_v (falls back to the GLOG_v env the
+    reference honors, paddle/base GLOG plumbing)."""
+    try:
+        from ..core.flags import get_flag
+        v = int(get_flag("v"))
+        if v:
+            return v
+    except Exception:
+        pass
+    try:
+        return int(os.environ.get("GLOG_v", "0"))
+    except ValueError:
+        return 0
+
+
+def vlog(level: int, msg, *args, name="paddle_tpu"):
+    """VLOG(level): emit when level <= current verbosity (GLOG semantic —
+    higher FLAGS_v / GLOG_v shows chattier messages)."""
+    if level <= _verbosity():
+        text = (str(msg) % args) if args else str(msg)
+        get_logger(name).info("[v%d] %s", level, text)

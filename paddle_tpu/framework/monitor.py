@@ -11,7 +11,7 @@ from __future__ import annotations
 import threading
 
 __all__ = ["stat_update", "stat_current", "stat_peak", "stat_reset_peak",
-           "StatGauge"]
+           "StatGauge", "report", "start_periodic_report"]
 
 _py_stats: dict = {}
 _py_lock = threading.Lock()
@@ -24,6 +24,8 @@ def _native():
 
 def stat_update(name: str, delta: int, device_id: int = 0) -> int:
     """Add delta to gauge `name`; returns the new current value."""
+    with _seen_lock:
+        _seen_names.add((name, device_id))
     lib = _native()
     if lib is not None:
         return int(lib.ptcore_stat_update(name.encode(), device_id,
@@ -84,3 +86,49 @@ class StatGauge:
 
     def reset_peak(self):
         stat_reset_peak(self.name, self.device_id)
+
+
+# ---------------------------------------------------------------------------
+# Registry enumeration + periodic reporting (reference platform/monitor.h
+# StatRegistry::publish + the trainer monitor thread).  The native table has
+# no listing call, so names seen through this module are tracked host-side;
+# values always read from the authoritative store.
+# ---------------------------------------------------------------------------
+_seen_names: set = set()
+_seen_lock = threading.Lock()
+
+
+def report() -> dict:
+    """Snapshot every gauge touched in this process:
+    {(name, device_id): {"current": int, "peak": int}}."""
+    with _seen_lock:
+        keys = sorted(_seen_names)
+    return {f"{n}:{d}": {"current": stat_current(n, d),
+                         "peak": stat_peak(n, d)} for n, d in keys}
+
+
+def start_periodic_report(interval: float = 30.0, logger=None):
+    """Log the gauge table every `interval` seconds from a daemon thread
+    (the reference trainer's monitor loop).  Returns a stop() callable."""
+    import logging
+
+    from .log_helper import get_logger
+
+    log = logger or get_logger("paddle_tpu.monitor")
+    stop = threading.Event()
+
+    def loop():
+        while not stop.wait(interval):
+            snap = report()
+            if snap:
+                log.log(logging.INFO, "monitor: %s", snap)
+
+    t = threading.Thread(target=loop, daemon=True,
+                         name="paddle_tpu-monitor")
+    t.start()
+
+    def stopper():
+        stop.set()
+        t.join(timeout=2.0)
+
+    return stopper

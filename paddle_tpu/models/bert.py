@@ -4,18 +4,14 @@ The reference keeps BERT in PaddleNLP (paddlenlp/transformers/bert), built on
 python/paddle/nn MultiHeadAttention / TransformerEncoder; this is the same
 composition over paddle_tpu.nn — embeddings (word + position + token type)
 -> LayerNorm/dropout -> TransformerEncoder -> task heads — so BASELINE.json
-config 2 ("BERT-base SQuAD fine-tune, dygraph AMP O2") runs on in-repo code.
+config 3 ("BERT-base SQuAD fine-tune, dygraph AMP O2") runs on in-repo code.
 
 TPU notes: post-norm encoder blocks run in bf16 under amp O1/O2; the
 sequence dim should be a multiple of 128 for MXU-friendly attention tiles.
 """
 from __future__ import annotations
 
-import numpy as np
-
 from .. import nn
-from ..core import dispatch
-from ..core.tensor import Tensor
 from ..nn import functional as F
 
 __all__ = ["BertConfig", "BertModel", "BertForSequenceClassification",
@@ -138,7 +134,7 @@ class BertForSequenceClassification(nn.Layer):
 
 
 class BertForQuestionAnswering(nn.Layer):
-    """SQuAD span head (start/end logits) — BASELINE config 2's model."""
+    """SQuAD span head (start/end logits) — BASELINE config 3's model."""
 
     def __init__(self, config: BertConfig):
         super().__init__()

@@ -209,3 +209,75 @@ def test_log_helper_rank_prefix(monkeypatch):
                                 level=logging.INFO)
     handler = log.handlers[0]
     assert "[rank 3]" in handler.formatter._fmt
+
+
+def test_live_buffer_accounting():
+    """device.memory: live-buffer enumeration over the XLA client's exact
+    live set (the allocator-facade view, VERDICT r3 row 17)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.device import (live_buffer_bytes, live_buffers,
+                                   memory_summary)
+
+    before = live_buffer_bytes()
+    keep = paddle.to_tensor(np.ones((256, 1024), np.float32))
+    bufs = live_buffers()
+    assert any(shape == (256, 1024) and dt == "float32" and b == 256 * 1024 * 4
+               for shape, dt, b in bufs), bufs[:5]
+    assert live_buffer_bytes() >= before + 1024 * 1024
+    s = memory_summary()
+    assert "live buffers" in s and "float32" in s
+    del keep
+    import gc
+    gc.collect()
+    bufs2 = live_buffers()
+    assert sum(1 for sh, _, _ in bufs2 if sh == (256, 1024)) <= \
+        sum(1 for sh, _, _ in bufs if sh == (256, 1024)) - 1
+
+
+def test_monitor_report_and_vlog(caplog):
+    """Monitor registry enumeration + periodic reporter + GLOG-style vlog
+    (VERDICT r3 row 62 monitor/log-level infrastructure)."""
+    import logging
+    import time as _time
+
+    import paddle_tpu  # noqa: F401
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.framework import log_helper, monitor
+
+    monitor.stat_update("test_gauge_r4", 5)
+    monitor.stat_update("test_gauge_r4", -2)
+    snap = monitor.report()
+    assert snap["test_gauge_r4:0"]["current"] == 3
+    assert snap["test_gauge_r4:0"]["peak"] == 5
+
+    log = logging.getLogger("paddle_tpu.monitor.test")
+    pkg = logging.getLogger("paddle_tpu")
+    pkg.propagate = True          # package logger stops propagation by policy
+    try:
+        stop = monitor.start_periodic_report(interval=0.05, logger=log)
+        with caplog.at_level(logging.INFO,
+                             logger="paddle_tpu.monitor.test"):
+            _time.sleep(0.2)
+        stop()
+    finally:
+        pkg.propagate = False
+    assert any("test_gauge_r4" in r.getMessage() for r in caplog.records)
+
+    # vlog gating on FLAGS_v
+    pkg = logging.getLogger("paddle_tpu")
+    pkg.propagate = True
+    try:
+        caplog.clear()
+        with caplog.at_level(logging.INFO, logger="paddle_tpu"):
+            set_flags({"v": 0})
+            log_helper.vlog(2, "hidden %s", "msg")
+            set_flags({"v": 3})
+            log_helper.vlog(2, "shown %s", "msg")
+        msgs = [r.getMessage() for r in caplog.records]
+        assert not any("hidden" in m for m in msgs), msgs
+        assert any("shown msg" in m for m in msgs), msgs
+    finally:
+        set_flags({"v": 0})
+        pkg.propagate = False

@@ -97,6 +97,8 @@ def main():
                 results[tag] = {"error": out.stderr[-400:]}
         except subprocess.TimeoutExpired:
             results[tag] = {"error": "timeout (> 900s)"}
+        except Exception as e:   # bad stdout etc. — keep the trail alive
+            results[tag] = {"error": f"{type(e).__name__}: {e}"[:400]}
         results[tag]["wall_s"] = round(time.time() - t0, 1)
         print(tag, json.dumps(results[tag]), flush=True)
 
@@ -114,10 +116,17 @@ def main():
                             "backend": "tpu", "config": f"ablation:{tag}",
                             "n_params": rec.get("n_params"),
                             "time": stamp})
-    json.dump(history, open(hist_path, "w"), indent=1)
-    json.dump({"round": 4, "time": stamp, "levers": results},
-              open(os.path.join(REPO, "MFU_ABLATION_r04.json"), "w"),
-              indent=1)
+    # atomic replace: a mid-write tunnel death must not truncate the
+    # committed evidence file
+    tmp = hist_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(history, f, indent=1)
+    os.replace(tmp, hist_path)
+    abl = os.path.join(REPO, "MFU_ABLATION_r04.json")
+    with open(abl + ".tmp", "w") as f:
+        json.dump({"round": 4, "time": stamp, "levers": results}, f,
+                  indent=1)
+    os.replace(abl + ".tmp", abl)
     print("written MFU_ABLATION_r04.json")
 
 
