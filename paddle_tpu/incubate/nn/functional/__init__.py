@@ -223,12 +223,107 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens,
 
 
 def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
-                               **kwargs):
-    raise NotImplementedError(
-        "masked_multihead_attention is the reference's CUDA decode "
-        "megakernel (one token per step over a cache); this build's decode "
-        "path is the compiled KV-cache loop in "
-        "paddle_tpu.models.llama.LlamaForCausalLM.generate")
+                               cum_offsets=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               qkv_out_scale=None, out_shift=None,
+                               out_smooth=None, seq_len=1, rotary_emb_dims=1,
+                               use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0, name=None):
+    """Single-token decode attention over a KV cache (reference
+    incubate/nn/functional/masked_multihead_attention.py over the CUDA
+    decode megakernel).  One jittable XLA step: split the fused qkv row,
+    append k/v at each sequence's current position, attend over the cache.
+
+    x [B, 3*H*D]; cache_kv [2, B, H, M, D]; bias [3, H, D];
+    src_mask [B, 1, 1, S] additive over the first S cache positions;
+    sequence_lengths [B, 1] = tokens already in the cache (defaults to
+    S-1 from src_mask, else seq_len-1).  Returns (out [B, H*D],
+    updated cache).  The int8-quant epilogues and beam-search cache
+    reordering remain serving-engine deferrals.
+    """
+    if qkv_out_scale is not None or out_shift is not None \
+            or out_smooth is not None or out_scale > 0:
+        raise NotImplementedError(
+            "masked_multihead_attention int8-quant epilogue is a serving "
+            "deferral; run the float path (see quantization/ for PTQ/QAT)")
+    if beam_cache_offset is not None:
+        raise NotImplementedError(
+            "beam_cache_offset reordering is a serving deferral; "
+            "LlamaForCausalLM.generate covers sampled decode")
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention requires cache_kv")
+
+    import jax
+    import jax.numpy as jnp
+
+    from ....core import dispatch as D
+
+    def impl(xa, cache, *opt, has_bias, has_mask, has_len, has_rope,
+             neox, rot_dims):
+        it = iter(opt)
+        ba = next(it) if has_bias else None
+        mask = next(it) if has_mask else None
+        slen = next(it) if has_len else None
+        rope = next(it) if has_rope else None
+        _, B, H, M, D = cache.shape
+        qkv = xa.reshape(B, 3, H, D)
+        if ba is not None:
+            qkv = qkv + ba[None].astype(qkv.dtype)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]       # [B, H, D]
+        if slen is not None:
+            t = slen.reshape(B).astype(jnp.int32)        # per-seq position
+        elif mask is not None:
+            t = jnp.full((B,), mask.shape[-1] - 1, jnp.int32)
+        else:
+            t = jnp.full((B,), seq_len - 1, jnp.int32)
+        if rope is not None:
+            # rotary_tensor [B, 1, 1, S, D]: cos in d<D/2, sin mirrored
+            # (non-neox interleaved style folded to half layout)
+            rot = rope.reshape(B, -1, D)                 # [B, S, D]
+            cur = jnp.take_along_axis(
+                rot, t[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+            cos, sin = cur[..., :D // 2], cur[..., D // 2:]
+
+            def rot_half(u):
+                u1, u2 = u[..., :D // 2], u[..., D // 2:]
+                return jnp.concatenate(
+                    [u1 * cos[:, None] - u2 * sin[:, None],
+                     u2 * cos[:, None] + u1 * sin[:, None]], axis=-1)
+            q, k = rot_half(q), rot_half(k)
+        # scatter k/v into each sequence's slot t[b]
+        bidx = jnp.arange(B)
+        cache = cache.at[0, bidx, :, t, :].set(k.astype(cache.dtype))
+        cache = cache.at[1, bidx, :, t, :].set(v.astype(cache.dtype))
+        kc = cache[0].astype(jnp.float32)                # [B, H, M, D]
+        vc = cache[1].astype(jnp.float32)
+        scores = jnp.einsum("bhd,bhmd->bhm", q.astype(jnp.float32),
+                            kc) / jnp.sqrt(jnp.float32(D))
+        pos = jnp.arange(M)[None, None, :]
+        valid = pos <= t[:, None, None]
+        if mask is not None:
+            S = mask.shape[-1]
+            add = jnp.zeros((B, 1, M), jnp.float32)
+            add = add.at[:, :, :S].set(
+                mask.reshape(B, 1, S).astype(jnp.float32))
+            scores = scores + add
+        scores = jnp.where(valid, scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhm,bhmd->bhd", probs, vc)
+        return out.reshape(B, H * D).astype(xa.dtype), cache
+
+    opt_ts, flags = [], {}
+    for key, tval in (("has_bias", bias), ("has_mask", src_mask),
+                      ("has_len", sequence_lengths),
+                      ("has_rope", rotary_tensor)):
+        flags[key] = tval is not None
+        if tval is not None:
+            opt_ts.append(tval)
+    return D.apply("masked_multihead_attention", impl,
+                   (x, cache_kv, *opt_ts),
+                   {**flags, "neox": bool(use_neox_rotary_style),
+                    "rot_dims": int(rotary_emb_dims)}, num_outputs=2)
 
 
 def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size):

@@ -153,3 +153,58 @@ def test_fused_bias_dropout_residual_layer_norm():
     mu, var = s.mean(-1, keepdims=True), s.var(-1, keepdims=True)
     np.testing.assert_allclose(out.numpy(), (s - mu) / np.sqrt(var + 1e-5),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_masked_multihead_attention_decode_step():
+    """incubate masked_multihead_attention: one decode step over a KV cache
+    matches a numpy reference (append at sequence_lengths, masked softmax
+    over valid cache positions)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.incubate.nn.functional as IF
+
+    B, H, M, D = 2, 3, 8, 4
+    rng = np.random.RandomState(0)
+    x = rng.randn(B, 3 * H * D).astype(np.float32)
+    cache = rng.randn(2, B, H, M, D).astype(np.float32)
+    slen = np.array([[3], [5]], np.int64)          # tokens already cached
+    smask = (rng.randn(B, 1, 1, 6) * 0.1).astype(np.float32)
+
+    out, new_cache = IF.masked_multihead_attention(
+        paddle.to_tensor(x), paddle.to_tensor(cache.copy()),
+        src_mask=paddle.to_tensor(smask),
+        sequence_lengths=paddle.to_tensor(slen))
+
+    qkv = x.reshape(B, 3, H, D)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    ref_cache = cache.copy()
+    for b in range(B):
+        t = int(slen[b, 0])
+        ref_cache[0, b, :, t] = k[b]
+        ref_cache[1, b, :, t] = v[b]
+    np.testing.assert_allclose(new_cache.numpy(), ref_cache, rtol=1e-6)
+    ref_out = np.zeros((B, H, D), np.float32)
+    for b in range(B):
+        t = int(slen[b, 0])
+        sc = np.einsum("hd,hmd->hm", q[b], ref_cache[0, b]) / np.sqrt(D)
+        sc[:, :6] += smask[b, 0, 0]
+        sc[:, t + 1:] = -np.inf
+        p = np.exp(sc - sc.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref_out[b] = np.einsum("hm,hmd->hd", p, ref_cache[1, b])
+    np.testing.assert_allclose(out.numpy(), ref_out.reshape(B, H * D),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_masked_multihead_attention_quant_defers():
+    import numpy as np
+    import pytest
+
+    import paddle_tpu as paddle
+    import paddle_tpu.incubate.nn.functional as IF
+
+    x = paddle.to_tensor(np.zeros((1, 3 * 2 * 4), np.float32))
+    c = paddle.to_tensor(np.zeros((2, 1, 2, 4, 4), np.float32))
+    with pytest.raises(NotImplementedError, match="quant"):
+        IF.masked_multihead_attention(x, c, out_scale=0.5)
