@@ -338,8 +338,143 @@ def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size):
     return Tensor(jnp.max(e).reshape(1)), Tensor(jnp.max(d).reshape(1))
 
 
-def block_multihead_attention(*args, **kwargs):
-    raise NotImplementedError(
-        "block_multihead_attention (paged-KV inference attention) is a "
-        "serving-engine special; use LlamaForCausalLM.generate or register "
-        "a Pallas paged-attention kernel via utils.cpp_extension")
+def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
+                              seq_lens_decoder, seq_lens_this_time,
+                              padding_offsets=None, cum_offsets=None,
+                              cu_seqlens_q=None, cu_seqlens_k=None,
+                              block_tables=None, pre_key_cache=None,
+                              pre_value_cache=None,
+                              cache_k_quant_scales=None,
+                              cache_v_quant_scales=None,
+                              cache_k_dequant_scales=None,
+                              cache_v_dequant_scales=None,
+                              qkv_out_scale=None, qkv_bias=None,
+                              out_shift=None, out_smooth=None,
+                              max_enc_len_this_time=None,
+                              max_dec_len_this_time=None, rope_emb=None,
+                              mask=None, tgt_mask=None, max_seq_len=-1,
+                              block_size=64, use_neox_style=False, **kwargs):
+    """Paged-KV attention (reference blha over the paged CUDA kernels).
+
+    Implemented modes (jittable XLA):
+    - DECODE: every sequence contributes one token
+      (seq_lens_this_time == 1); k/v scatter into the page given by
+      block_tables[b, pos // block_size] and attention runs over the
+      sequence's gathered pages.
+    - PREFILL: sequences run causal self-attention over their own fresh
+      tokens (seq_lens_decoder == 0) and their k/v fill the pages.
+
+    qkv [token_num, 3*H*D]; {key,value}_cache [max_blocks, H, bs, D];
+    block_tables [B, blocks_per_seq].  Returns (out [token_num, H*D],
+    qkv, updated key_cache, updated value_cache) like the reference's
+    (fmha_out, qkv_out, cache_k_out, cache_v_out).  int8/fp8 cache quant,
+    pre-caches and speculative verify remain serving deferrals.
+    """
+    if any(t is not None for t in (cache_k_quant_scales,
+                                   cache_v_quant_scales,
+                                   cache_k_dequant_scales,
+                                   cache_v_dequant_scales, qkv_out_scale,
+                                   out_shift, out_smooth)):
+        raise NotImplementedError(
+            "block_multihead_attention quantized-cache paths are serving "
+            "deferrals; run the float cache")
+    if pre_key_cache is not None or pre_value_cache is not None:
+        raise NotImplementedError(
+            "block_multihead_attention pre-cache (system prompt cache) is "
+            "a serving deferral")
+    if block_tables is None:
+        raise ValueError("block_multihead_attention requires block_tables")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ....core import dispatch as D_
+    from ....core.tensor import Tensor as T_
+
+    def _arr(t):
+        return t._data if isinstance(t, T_) else jnp.asarray(t)
+
+    enc = np.asarray(_arr(seq_lens_encoder)).reshape(-1)
+    dec = np.asarray(_arr(seq_lens_decoder)).reshape(-1)
+    this = np.asarray(_arr(seq_lens_this_time)).reshape(-1)
+    B = this.shape[0]
+    decode_mode = bool((this == 1).all() and (dec > 0).any())
+    prefill_mode = bool((dec == 0).all() and (this == enc).all())
+    if not (decode_mode or prefill_mode):
+        raise NotImplementedError(
+            "mixed prefill+decode batches are a serving-engine special; "
+            "split the batch into a prefill call and a decode call")
+
+    Hc = _arr(key_cache).shape[1]
+    Dh = _arr(key_cache).shape[3]
+    bs = int(_arr(key_cache).shape[2])
+
+    def decode_impl(xa, kc, vc, bt, dec_t, *maybe_bias, has_bias):
+        qkv_ = xa.reshape(B, 3, Hc, Dh)
+        if has_bias:
+            qkv_ = qkv_ + maybe_bias[0].reshape(3, Hc, Dh)[None]
+        q, k, v = qkv_[:, 0], qkv_[:, 1], qkv_[:, 2]
+        t = dec_t.reshape(B).astype(jnp.int32)
+        blk = jnp.take_along_axis(bt, (t // bs)[:, None], axis=1)[:, 0]
+        slot = t % bs
+        kc = kc.at[blk, :, slot, :].set(k.astype(kc.dtype))
+        vc = vc.at[blk, :, slot, :].set(v.astype(vc.dtype))
+        # gather each sequence's pages -> [B, H, blocks*bs, D]
+        kpages = kc[bt]                  # [B, nblk, H, bs, D]
+        vpages = vc[bt]
+        ks = jnp.moveaxis(kpages, 2, 1).reshape(B, Hc, -1, Dh)
+        vs = jnp.moveaxis(vpages, 2, 1).reshape(B, Hc, -1, Dh)
+        scores = jnp.einsum("bhd,bhmd->bhm", q.astype(jnp.float32),
+                            ks.astype(jnp.float32)) / jnp.sqrt(
+                                jnp.float32(Dh))
+        pos = jnp.arange(ks.shape[2])[None, None, :]
+        scores = jnp.where(pos <= t[:, None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhm,bhmd->bhd", probs, vs.astype(jnp.float32))
+        return out.reshape(B, Hc * Dh).astype(xa.dtype), kc, vc
+
+    def prefill_impl(xa, kc, vc, bt, lens, *maybe_bias, has_bias,
+                     starts):
+        qkv_ = xa.reshape(-1, 3, Hc, Dh)
+        if has_bias:
+            qkv_ = qkv_ + maybe_bias[0].reshape(3, Hc, Dh)[None]
+        q, k, v = qkv_[:, 0], qkv_[:, 1], qkv_[:, 2]   # [T, H, D]
+        Ttot = q.shape[0]
+        pos_g = jnp.arange(Ttot)
+        starts_a = jnp.asarray(starts)
+        seg = jnp.searchsorted(starts_a, pos_g, side="right") - 1
+        rel = pos_g - starts_a[seg]
+        # causal varlen attention within each sequence
+        same = seg[:, None] == seg[None, :]
+        causal = rel[:, None] >= rel[None, :]
+        m = same & causal
+        scores = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) / jnp.sqrt(
+                                jnp.float32(Dh))
+        scores = jnp.where(m[None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        probs = jnp.where(m[None], probs, 0.0)
+        out = jnp.einsum("hqk,khd->qhd", probs, v.astype(jnp.float32))
+        # scatter fresh k/v into pages: token (seg b, rel r) -> block
+        # bt[b, r // bs], slot r % bs
+        blk = bt[seg, rel // bs]
+        kc = kc.at[blk, :, rel % bs, :].set(k.astype(kc.dtype))
+        vc = vc.at[blk, :, rel % bs, :].set(v.astype(vc.dtype))
+        return (out.reshape(Ttot, Hc * Dh).astype(xa.dtype), kc, vc)
+
+    opt = (qkv_bias,) if qkv_bias is not None else ()
+    if decode_mode:
+        out, kc2, vc2 = D_.apply(
+            "block_multihead_attention_decode", decode_impl,
+            (qkv, key_cache, value_cache, block_tables, seq_lens_decoder,
+             *opt), {"has_bias": qkv_bias is not None}, num_outputs=3)
+    else:
+        starts = tuple(int(s) for s in np.concatenate([[0],
+                                                       np.cumsum(this)[:-1]]))
+        out, kc2, vc2 = D_.apply(
+            "block_multihead_attention_prefill", prefill_impl,
+            (qkv, key_cache, value_cache, block_tables, seq_lens_this_time,
+             *opt), {"has_bias": qkv_bias is not None, "starts": starts},
+            num_outputs=3)
+    return out, qkv, kc2, vc2

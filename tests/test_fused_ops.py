@@ -208,3 +208,96 @@ def test_masked_multihead_attention_quant_defers():
     c = paddle.to_tensor(np.zeros((2, 1, 2, 4, 4), np.float32))
     with pytest.raises(NotImplementedError, match="quant"):
         IF.masked_multihead_attention(x, c, out_scale=0.5)
+
+
+def test_block_multihead_attention_decode_paged():
+    """blha decode mode: per-sequence k/v land in the right page/slot and
+    attention over gathered pages matches a numpy reference."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.incubate.nn.functional as IF
+
+    B, H, D, bs, nblk = 2, 2, 4, 4, 6     # block_size 4, 6 pages total
+    rng = np.random.RandomState(0)
+    qkv = rng.randn(B, 3 * H * D).astype(np.float32)
+    kc = np.zeros((nblk, H, bs, D), np.float32)
+    vc = np.zeros((nblk, H, bs, D), np.float32)
+    # seq 0 has 5 cached tokens (pages 0,1), seq 1 has 2 (page 3)
+    bt = np.array([[0, 1, 2], [3, 4, 5]], np.int32)
+    dec = np.array([[5], [2]], np.int64)
+    # pre-fill the cached tokens
+    cached = {}
+    for b, n in ((0, 5), (1, 2)):
+        for p in range(n):
+            kk = rng.randn(H, D).astype(np.float32)
+            vv = rng.randn(H, D).astype(np.float32)
+            kc[bt[b, p // bs], :, p % bs] = kk
+            vc[bt[b, p // bs], :, p % bs] = vv
+            cached[(b, p)] = (kk, vv)
+
+    out, _, kc2, vc2 = IF.block_multihead_attention(
+        paddle.to_tensor(qkv), paddle.to_tensor(kc.copy()),
+        paddle.to_tensor(vc.copy()),
+        paddle.to_tensor(np.zeros((B, 1), np.int64)),
+        paddle.to_tensor(dec),
+        paddle.to_tensor(np.ones((B, 1), np.int64)),
+        block_tables=paddle.to_tensor(bt), block_size=bs)
+
+    q3 = qkv.reshape(B, 3, H, D)
+    for b in range(B):
+        t = int(dec[b, 0])
+        # new k/v written at page t//bs slot t%bs
+        np.testing.assert_allclose(
+            kc2.numpy()[bt[b, t // bs], :, t % bs], q3[b, 1], rtol=1e-6)
+        # reference attention over the t+1 tokens
+        ks = np.stack([cached[(b, p)][0] for p in range(t)] + [q3[b, 1]])
+        vs = np.stack([cached[(b, p)][1] for p in range(t)] + [q3[b, 2]])
+        sc = np.einsum("hd,mhd->hm", q3[b, 0], ks) / np.sqrt(D)
+        p_ = np.exp(sc - sc.max(-1, keepdims=True))
+        p_ /= p_.sum(-1, keepdims=True)
+        ref = np.einsum("hm,mhd->hd", p_, vs).reshape(H * D)
+        np.testing.assert_allclose(out.numpy()[b], ref, rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_block_multihead_attention_prefill_fills_pages():
+    """blha prefill mode: ragged causal self-attention + page scatter."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.incubate.nn.functional as IF
+
+    H, D, bs = 2, 4, 4
+    lens = [5, 3]
+    T = sum(lens)
+    rng = np.random.RandomState(1)
+    qkv = rng.randn(T, 3 * H * D).astype(np.float32)
+    kc = np.zeros((4, H, bs, D), np.float32)
+    vc = np.zeros((4, H, bs, D), np.float32)
+    bt = np.array([[0, 1], [2, 3]], np.int32)
+
+    out, _, kc2, vc2 = IF.block_multihead_attention(
+        paddle.to_tensor(qkv), paddle.to_tensor(kc), paddle.to_tensor(vc),
+        paddle.to_tensor(np.asarray(lens).reshape(2, 1)),
+        paddle.to_tensor(np.zeros((2, 1), np.int64)),
+        paddle.to_tensor(np.asarray(lens).reshape(2, 1)),
+        block_tables=paddle.to_tensor(bt), block_size=bs)
+
+    q3 = qkv.reshape(T, 3, H, D)
+    off = 0
+    for b, n in enumerate(lens):
+        q, k, v = (q3[off:off + n, i] for i in range(3))
+        for r in range(n):
+            np.testing.assert_allclose(
+                kc2.numpy()[bt[b, r // bs], :, r % bs], k[r], rtol=1e-6,
+                err_msg=f"b{b} r{r}")
+        sc = np.einsum("qhd,khd->hqk", q, k) / np.sqrt(D)
+        for i in range(n):
+            sc[:, i, i + 1:] = -np.inf
+        p_ = np.exp(sc - sc.max(-1, keepdims=True))
+        p_ /= p_.sum(-1, keepdims=True)
+        ref = np.einsum("hqk,khd->qhd", p_, v).reshape(n, H * D)
+        np.testing.assert_allclose(out.numpy()[off:off + n], ref,
+                                   rtol=1e-5, atol=1e-6, err_msg=f"b{b}")
+        off += n
