@@ -1,5 +1,6 @@
 """nn namespace.  Parity with /root/reference/python/paddle/nn/__init__.py."""
 from . import functional  # noqa: F401
+from . import quant  # noqa: F401
 from . import initializer  # noqa: F401
 from . import utils  # noqa: F401
 from .clip import (  # noqa: F401

@@ -1,0 +1,159 @@
+"""Weight-only quantization surface (reference python/paddle/nn/quant/
+quantized_linear.py over the weight_quantize / weight_only_linear CUDA
+kernels).
+
+TPU-native formulation: quantization is pure jnp (absmax per-channel or
+per-group int8/int4 with packed nibbles); weight_only_linear dequantizes
+into the matmul's preferred dtype inside ONE dispatched program, so XLA
+fuses dequant into the MXU matmul epilogue — the same "keep weights int8
+in HBM, compute in bf16" economics as the reference's fast kernels.
+llm.int8's outlier decomposition splits columns whose activation absmax
+exceeds the threshold into a small fp matmul.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core import dispatch as D
+from ...core.tensor import Tensor
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
+           "llm_int8_linear"]
+
+_ALGOS = ("weight_only_int8", "weight_only_int4", "llm.int8")
+
+
+def _check(algo, group_size):
+    if algo not in _ALGOS:
+        raise ValueError(f"algo must be one of {_ALGOS}, got {algo!r}")
+    if group_size not in (-1, 64, 128):
+        raise ValueError(f"group_size must be -1/64/128, got {group_size}")
+
+
+def _wq_impl(x, algo, group_size):
+    # x [K, N] -> out int8 [N, K] (transposed, reference contract),
+    # scale [N] f32 (per-channel) or [K/group, N] (grouped)
+    xf = x.astype(jnp.float32)
+    qmax = 7.0 if algo == "weight_only_int4" else 127.0
+    if group_size == -1:
+        scale = jnp.max(jnp.abs(xf), axis=0) / qmax          # [N]
+        q = jnp.round(xf / scale[None, :])
+    else:
+        K = xf.shape[0]
+        g = xf.reshape(K // group_size, group_size, -1)
+        scale = jnp.max(jnp.abs(g), axis=1) / qmax           # [K/gs, N]
+        q = jnp.round(g / scale[:, None, :]).reshape(xf.shape)
+    q = jnp.clip(q, -qmax, qmax).astype(jnp.int8).T          # [N, K]
+    if algo == "weight_only_int4":
+        # pack two nibbles per byte along K -> [N, K//2]
+        lo = q[:, 0::2].astype(jnp.int32) & 0xF
+        hi = (q[:, 1::2].astype(jnp.int32) & 0xF) << 4
+        q = (lo | hi).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
+    """Quantize a [K, N] fp weight; returns (int8 [N, K] — packed [N, K//2]
+    for int4 — and per-channel/grouped scales)."""
+    _check(algo, group_size)
+    return D.apply("weight_quantize", _wq_impl, (x,),
+                   {"algo": algo, "group_size": int(group_size)},
+                   num_outputs=2)
+
+
+def _unpack_int4(q):
+    lo = (q.astype(jnp.int32) & 0xF)
+    lo = jnp.where(lo >= 8, lo - 16, lo)                      # sign extend
+    hi = (q.astype(jnp.int32) >> 4) & 0xF
+    hi = jnp.where(hi >= 8, hi - 16, hi)
+    out = jnp.stack([lo, hi], axis=-1).reshape(q.shape[0], -1)
+    return out                                                # [N, K]
+
+
+def _dequant(qw, scale, algo, group_size, dtype):
+    q = _unpack_int4(qw) if algo == "weight_only_int4" \
+        else qw.astype(jnp.int32)                             # [N, K]
+    qf = q.astype(jnp.float32).T                              # [K, N]
+    if scale.ndim == 1:
+        w = qf * scale[None, :]
+    else:                                                     # [K/gs, N]
+        K = qf.shape[0]
+        gs = K // scale.shape[0]
+        w = (qf.reshape(-1, gs, qf.shape[1])
+             * scale[:, None, :]).reshape(qf.shape)
+    return w.astype(dtype)
+
+
+def _wdq_impl(qw, scale, algo, group_size, out_dtype):
+    return _dequant(qw, scale, algo, group_size, jnp.dtype(out_dtype))
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16",
+                      group_size=-1):
+    """Inverse of weight_quantize: int8/int4-packed [N, K] -> fp [K, N]."""
+    _check(algo, group_size)
+    return D.apply("weight_dequantize", _wdq_impl, (x, scale),
+                   {"algo": algo, "group_size": int(group_size),
+                    "out_dtype": str(out_dtype)})
+
+
+def _wol_impl(x, qw, scale, *maybe_bias, algo, group_size, has_bias):
+    w = _dequant(qw, scale, algo, group_size, x.dtype)        # [K, N]
+    y = jnp.matmul(x, w)
+    if has_bias:
+        y = y + maybe_bias[0].astype(y.dtype)
+    return y
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """y = x @ dequant(weight)^T' + bias with int8/int4 weights kept
+    quantized in HBM; dequant fuses into the matmul program."""
+    algo = "weight_only_int4" if str(weight_dtype) == "int4" \
+        else "weight_only_int8"
+    _check(algo, group_size)
+    if weight_scale is None:
+        raise ValueError("weight_only_linear requires weight_scale")
+    args = (x, weight, weight_scale) + ((bias,) if bias is not None else ())
+    return D.apply("weight_only_linear", _wol_impl, args,
+                   {"algo": algo, "group_size": int(group_size),
+                    "has_bias": bias is not None})
+
+
+def _llm_int8_impl(x, qw, scale, *maybe_bias, threshold, has_bias):
+    # outlier decomposition (LLM.int8()): activation columns whose absmax
+    # exceeds threshold run against the fp weight; the rest stay int8
+    w = _dequant(qw, scale, "weight_only_int8", -1, jnp.float32)  # [K, N]
+    xf = x.astype(jnp.float32)
+    col_amax = jnp.max(jnp.abs(xf), axis=tuple(range(xf.ndim - 1)))
+    outlier = col_amax > threshold                            # [K]
+    x_in = jnp.where(outlier[None, :], 0.0, xf.reshape(-1, xf.shape[-1]))
+    x_out = jnp.where(outlier[None, :], xf.reshape(-1, xf.shape[-1]), 0.0)
+    # inlier path: requantize activations to int8 per-row (absmax)
+    row_s = jnp.max(jnp.abs(x_in), axis=1, keepdims=True) / 127.0
+    row_s = jnp.where(row_s == 0, 1.0, row_s)
+    xq = jnp.round(x_in / row_s).astype(jnp.int8)
+    y_in = (jnp.matmul(xq.astype(jnp.int32),
+                       jnp.round(w / jnp.where(
+                           jnp.max(jnp.abs(w), 0, keepdims=True) == 0, 1.0,
+                           jnp.max(jnp.abs(w), 0, keepdims=True) / 127.0)
+                       ).astype(jnp.int32))
+            .astype(jnp.float32)
+            * row_s * (jnp.max(jnp.abs(w), 0) / 127.0)[None, :])
+    y = y_in + jnp.matmul(x_out, w)
+    if has_bias:
+        y = y + maybe_bias[0].astype(jnp.float32)
+    return y.reshape(x.shape[:-1] + (w.shape[1],)).astype(x.dtype)
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0):
+    """LLM.int8() linear: int8 matmul for inlier activation columns,
+    fp path for outlier columns above `threshold` (reference
+    llm_int8_linear over the cuBLAS int8 kernels)."""
+    if weight_scale is None:
+        raise ValueError("llm_int8_linear requires weight_scale")
+    args = (x, weight, weight_scale) + ((bias,) if bias is not None else ())
+    return D.apply("llm_int8_linear", _llm_int8_impl, args,
+                   {"threshold": float(threshold),
+                    "has_bias": bias is not None})

@@ -1,0 +1,62 @@
+"""Weight-only quantization (reference nn/quant/quantized_linear.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.nn.quant import (llm_int8_linear, weight_dequantize,
+                                 weight_only_linear, weight_quantize)
+
+
+def test_weight_quantize_roundtrip_int8():
+    rng = np.random.RandomState(0)
+    w = rng.randn(64, 32).astype(np.float32)
+    q, scale = weight_quantize(paddle.to_tensor(w))
+    assert tuple(q.shape) == (32, 64) and q.numpy().dtype == np.int8
+    assert tuple(scale.shape) == (32,)
+    back = weight_dequantize(q, scale, out_dtype="float32")
+    # int8 absmax per-channel: max error = scale/2 per channel
+    err = np.abs(back.numpy() - w)
+    assert (err <= scale.numpy()[None, :] * 0.5 + 1e-6).all()
+
+
+def test_weight_quantize_int4_packed():
+    rng = np.random.RandomState(1)
+    w = rng.randn(64, 16).astype(np.float32)
+    q, scale = weight_quantize(paddle.to_tensor(w), algo="weight_only_int4")
+    assert tuple(q.shape) == (16, 32)          # two nibbles per byte
+    back = weight_dequantize(q, scale, algo="weight_only_int4",
+                             out_dtype="float32")
+    err = np.abs(back.numpy() - w)
+    assert (err <= scale.numpy()[None, :] * 0.5 + 1e-6).all()
+
+
+def test_weight_quantize_grouped():
+    rng = np.random.RandomState(2)
+    w = rng.randn(128, 8).astype(np.float32)
+    q, scale = weight_quantize(paddle.to_tensor(w), group_size=64)
+    assert tuple(scale.shape) == (2, 8)
+    back = weight_dequantize(q, scale, group_size=64, out_dtype="float32")
+    assert np.abs(back.numpy() - w).max() < 0.1
+
+
+def test_weight_only_linear_matches_fp():
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 64).astype(np.float32)
+    w = (rng.randn(64, 32) * 0.1).astype(np.float32)
+    b = rng.randn(32).astype(np.float32)
+    q, scale = weight_quantize(paddle.to_tensor(w))
+    y = weight_only_linear(paddle.to_tensor(x), q, paddle.to_tensor(b),
+                           scale)
+    ref = x @ w + b
+    np.testing.assert_allclose(y.numpy(), ref, rtol=0.05, atol=0.05)
+
+
+def test_llm_int8_linear_outlier_decomposition():
+    rng = np.random.RandomState(4)
+    x = (rng.randn(4, 64) * 0.5).astype(np.float32)
+    x[:, 7] *= 40.0                       # outlier column
+    w = (rng.randn(64, 32) * 0.1).astype(np.float32)
+    q, scale = weight_quantize(paddle.to_tensor(w), algo="llm.int8")
+    y = llm_int8_linear(paddle.to_tensor(x), q, weight_scale=scale,
+                        threshold=6.0)
+    ref = x @ w
+    np.testing.assert_allclose(y.numpy(), ref, rtol=0.1, atol=0.15)
