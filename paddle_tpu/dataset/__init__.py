@@ -1,0 +1,106 @@
+"""Legacy dataset package (reference python/paddle/dataset/): the
+reader-style API (``<module>.train()``/``test()`` return zero-arg reader
+callables) that pre-DataLoader user code imports.
+
+Each submodule delegates to the modern map-style Dataset implementations
+(paddle_tpu.vision.datasets / paddle_tpu.text) — one dataset codebase,
+two API generations, mirroring how the reference keeps both surfaces.
+Datasets whose archives are not present raise the same download-gated
+error as their modern counterparts.
+"""
+from __future__ import annotations
+
+from types import ModuleType as _Mod
+import sys as _sys
+
+__all__ = ["mnist", "cifar", "flowers", "voc2012", "imdb", "uci_housing",
+           "imikolov", "movielens", "conll05", "common", "image"]
+
+
+def _reader_over(dataset_factory):
+    def reader():
+        ds = dataset_factory()
+        for i in range(len(ds)):
+            yield tuple(ds[i]) if isinstance(ds[i], (tuple, list)) \
+                else (ds[i],)
+    return reader
+
+
+def _make(name, factories, extras=None):
+    m = _Mod(f"{__name__}.{name}")
+    for mode, fac in factories.items():
+        m.__dict__[mode] = (lambda f=fac: _reader_over(f))()
+    for k, v in (extras or {}).items():
+        m.__dict__[k] = v
+    _sys.modules[m.__name__] = m
+    globals()[name] = m
+    return m
+
+
+def _vd():
+    from ..vision import datasets as vd
+    return vd
+
+
+def _td():
+    from .. import text as td
+    return td
+
+
+mnist = _make("mnist", {
+    "train": lambda: _vd().MNIST(mode="train"),
+    "test": lambda: _vd().MNIST(mode="test"),
+})
+cifar = _make("cifar", {
+    "train10": lambda: _vd().Cifar10(mode="train"),
+    "test10": lambda: _vd().Cifar10(mode="test"),
+    "train100": lambda: _vd().Cifar100(mode="train"),
+    "test100": lambda: _vd().Cifar100(mode="test"),
+})
+flowers = _make("flowers", {
+    "train": lambda: _vd().Flowers(mode="train"),
+    "test": lambda: _vd().Flowers(mode="test"),
+    "valid": lambda: _vd().Flowers(mode="valid"),
+})
+voc2012 = _make("voc2012", {
+    "train": lambda: _vd().VOC2012(mode="train"),
+    "test": lambda: _vd().VOC2012(mode="test"),
+    "val": lambda: _vd().VOC2012(mode="valid"),
+})
+imdb = _make("imdb", {
+    "train": lambda: _td().Imdb(mode="train"),
+    "test": lambda: _td().Imdb(mode="test"),
+})
+uci_housing = _make("uci_housing", {
+    "train": lambda: _td().UCIHousing(mode="train"),
+    "test": lambda: _td().UCIHousing(mode="test"),
+})
+imikolov = _make("imikolov", {
+    "train": lambda: _td().Imikolov(mode="train"),
+    "test": lambda: _td().Imikolov(mode="test"),
+})
+movielens = _make("movielens", {
+    "train": lambda: _td().Movielens(mode="train"),
+    "test": lambda: _td().Movielens(mode="test"),
+})
+conll05 = _make("conll05", {
+    "test": lambda: _td().Conll05st(mode="test"),
+})
+
+
+def _simple_image_transform(im, resize=None, crop=None):
+    import numpy as np
+
+    from ..vision import transforms as T
+    out = im
+    if resize is not None:
+        out = T.Resize(resize)(out)
+    if crop is not None:
+        out = T.CenterCrop(crop)(out)
+    return np.asarray(out)
+
+
+common = _make("common", {}, extras={})
+image = _make("image", {}, extras={
+    "simple_transform": _simple_image_transform,
+})

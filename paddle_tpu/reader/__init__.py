@@ -1,0 +1,209 @@
+"""Reader decorators (reference python/paddle/reader/decorator.py).
+
+Pure-Python composition utilities over "reader" callables (a reader is a
+zero-arg callable returning an iterable) — the pre-DataLoader data API that
+legacy user code still imports.  Semantics match the reference; the
+threaded/multiprocess variants use the same queue protocols.
+"""
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+import queue as _queue
+
+__all__ = ["cache", "map_readers", "buffered", "compose", "chain",
+           "shuffle", "firstn", "xmap_readers", "multiprocess_reader"]
+
+
+def cache(reader):
+    """Cache the reader's full output in memory on first pass."""
+    all_data = tuple(reader())
+
+    def cached_reader():
+        yield from all_data
+
+    return cached_reader
+
+
+def map_readers(func, *readers):
+    """Yield func applied across the readers' parallel outputs."""
+    def reader():
+        rs = [r() for r in readers]
+        yield from map(func, *rs)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle (reference semantics: fill buf, shuffle, drain)."""
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            yield from buf
+
+    return data_reader
+
+
+def chain(*readers):
+    """Concatenate readers (reference chain: outputs in sequence)."""
+    def reader():
+        yield from itertools.chain(*[r() for r in readers])
+
+    return reader
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flattened tuples.  check_alignment=True (default)
+    raises ComposeNotAligned when readers run out unevenly."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        if isinstance(x, tuple):
+            return x
+        return (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum(map(make_tuple, outputs), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum(map(make_tuple, outputs), ())
+
+    return reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def buffered(reader, size):
+    """Read ahead into a bounded buffer on a daemon thread."""
+    class _End:
+        pass
+
+    def data_reader():
+        r = reader()
+        q = _queue.Queue(maxsize=size)
+
+        def fill():
+            for d in r:
+                q.put(d)
+            q.put(_End)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+
+    return data_reader
+
+
+def firstn(reader, n):
+    """Limit the reader to its first n items."""
+    def firstn_reader():
+        yield from itertools.islice(reader(), n)
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Apply `mapper` over the reader with `process_num` worker THREADS
+    through bounded queues (reference xmap_readers thread pool; XLA work
+    stays in the consumer)."""
+    end_flag = object()
+
+    def thread_reader():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+
+        def feed():
+            for i, d in enumerate(reader()):
+                in_q.put((i, d))
+            for _ in range(process_num):
+                in_q.put(end_flag)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end_flag:
+                    out_q.put(end_flag)
+                    return
+                i, d = item
+                out_q.put((i, mapper(d)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        if order:
+            pending = {}
+            want = 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is end_flag:
+                    finished += 1
+                    continue
+                i, d = item
+                pending[i] = d
+                while want in pending:
+                    yield pending.pop(want)
+                    want += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while finished < process_num:
+                item = out_q.get()
+                if item is end_flag:
+                    finished += 1
+                    continue
+                yield item[1]
+
+    return thread_reader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave multiple readers from worker processes (reference
+    multiprocess_reader).  Worker processes stream pickled samples back
+    over a multiprocessing queue."""
+    import multiprocessing as mp
+
+    def queue_reader():
+        q = mp.Queue(queue_size)
+
+        def worker(r):
+            for d in r():
+                q.put(d)
+            q.put(None)
+
+        procs = [mp.Process(target=worker, args=(r,), daemon=True)
+                 for r in readers]
+        for p in procs:
+            p.start()
+        finished = 0
+        while finished < len(readers):
+            d = q.get()
+            if d is None:
+                finished += 1
+            else:
+                yield d
+        for p in procs:
+            p.join()
+
+    return queue_reader

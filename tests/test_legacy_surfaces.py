@@ -1,0 +1,62 @@
+"""Legacy public surfaces: paddle.reader decorators, paddle.dataset
+reader API, paddle.cost_model (reference python/paddle/{reader,dataset,
+cost_model})."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_reader_decorators():
+    r = paddle.reader
+
+    def nums():
+        yield from range(10)
+
+    assert list(r.firstn(nums, 3)()) == [0, 1, 2]
+    assert list(r.chain(nums, nums)()) == list(range(10)) * 2
+    assert sorted(r.shuffle(nums, 4)()) == list(range(10))
+    assert list(r.map_readers(lambda a, b: a + b, nums, nums)()) == \
+        [2 * i for i in range(10)]
+    assert list(r.buffered(nums, 2)()) == list(range(10))
+    assert list(r.cache(nums)()) == list(range(10))
+    composed = list(r.compose(nums, nums)())
+    assert composed[3] == (3, 3)
+    out = sorted(r.xmap_readers(lambda x: x * 10, nums, 2, 4)())
+    assert out == [10 * i for i in range(10)]
+    ordered = list(r.xmap_readers(lambda x: x * 10, nums, 2, 4,
+                                  order=True)())
+    assert ordered == [10 * i for i in range(10)]
+
+    def misaligned():
+        yield from range(3)
+
+    with pytest.raises(r.ComposeNotAligned):
+        list(r.compose(nums, misaligned)())
+
+
+def test_dataset_reader_api():
+    # uci_housing ships with the repo (no download): the legacy reader
+    # must stream (feature, label) rows
+    rows = list(paddle.dataset.uci_housing.train())
+    assert len(rows) > 100
+    x, y = rows[0]
+    assert np.asarray(x).shape[-1] == 13
+
+
+def test_cost_model_profile_and_op_table(tmp_path, monkeypatch):
+    import jax.numpy as jnp
+
+    from paddle_tpu import cost_model as cm
+    monkeypatch.setattr(cm, "_CACHE", str(tmp_path / "tbl.json"))
+    m = cm.CostModel()
+    rec = m.profile_measure(lambda a, b: (a @ b).sum(),
+                            (jnp.ones((64, 64)), jnp.ones((64, 64))))
+    assert rec["time"] > 0 and rec["flops"] > 0
+    t1 = m.get_static_op_time("tanh", shape=(64, 64))
+    assert t1["op_time"] > 0
+    # second call reads the cache
+    m2 = cm.CostModel()
+    monkeypatch.setattr(cm, "_CACHE", str(tmp_path / "tbl.json"))
+    t2 = m2.get_static_op_time("tanh", shape=(64, 64))
+    assert t2["op_time"] == pytest.approx(t1["op_time"])
