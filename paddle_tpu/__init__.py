@@ -84,6 +84,7 @@ from . import quantization  # noqa: E402
 from . import reader  # noqa: E402
 from . import dataset  # noqa: E402
 from . import cost_model  # noqa: E402
+from . import inference  # noqa: E402
 from . import optimizer  # noqa: E402
 from . import hub  # noqa: E402
 from . import onnx  # noqa: E402

@@ -21,8 +21,9 @@ def _reader_over(dataset_factory):
     def reader():
         ds = dataset_factory()
         for i in range(len(ds)):
-            yield tuple(ds[i]) if isinstance(ds[i], (tuple, list)) \
-                else (ds[i],)
+            item = ds[i]
+            yield tuple(item) if isinstance(item, (tuple, list)) \
+                else (item,)
     return reader
 
 

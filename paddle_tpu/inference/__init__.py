@@ -1,0 +1,234 @@
+"""Inference API (reference python/paddle/inference/): Config /
+create_predictor / Predictor over the serving artifact.
+
+The reference's engine is a C++ runtime executing a translated program
+with TensorRT/oneDNN backends; this framework's serving artifact is the
+compiled StableHLO program saved by ``jit.save`` — already ahead-of-time
+traced, fused and portable — so the Predictor is a thin, zero-copy
+executor over ``jit.load`` with the familiar handle-based API
+(get_input_names / get_input_handle / run / get_output_handle).
+TensorRT/XPU/oneDNN knobs are accepted and recorded but are no-ops: XLA
+owns codegen on TPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "PredictorPool", "Tensor",
+           "create_predictor", "get_version", "DataType", "PlaceType",
+           "PrecisionType", "get_num_bytes_of_data_type",
+           "convert_to_mixed_precision"]
+
+
+class DataType:
+    FLOAT32 = "float32"
+    FLOAT16 = "float16"
+    BFLOAT16 = "bfloat16"
+    INT8 = "int8"
+    INT32 = "int32"
+    INT64 = "int64"
+    UINT8 = "uint8"
+    BOOL = "bool"
+
+
+class PlaceType:
+    CPU = "cpu"
+    GPU = "gpu"
+    XPU = "xpu"
+    CUSTOM = "custom"
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
+def get_num_bytes_of_data_type(dtype) -> int:
+    return int(np.dtype(str(dtype)).itemsize)
+
+
+def get_version() -> str:
+    from .. import __version__
+    return f"paddle_tpu {__version__} (StableHLO serving)"
+
+
+class Config:
+    """Predictor configuration (reference inference Config).  Model path
+    is the ``jit.save`` prefix; accelerator-specific switches are
+    recorded for API parity but XLA owns compilation."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        self._prefix = None
+        self._device = "tpu"
+        self._device_id = 0
+        self._flags = {}
+        if prog_file is not None:
+            self._set_prefix(prog_file)
+
+    # -- model location --
+    def _set_prefix(self, path):
+        # jit.save artifacts share one prefix; accept any artifact name
+        p = str(path)
+        for suffix in (".pdmodel", ".pdiparams.npz", ".pdiparams"):
+            if p.endswith(suffix):
+                p = p[: -len(suffix)]
+                break
+        self._prefix = p
+
+    def set_prog_file(self, path):
+        self._set_prefix(path)
+
+    def prog_file(self):
+        return None if self._prefix is None else self._prefix + ".pdmodel"
+
+    def params_file(self):
+        return None if self._prefix is None \
+            else self._prefix + ".pdiparams.npz"
+
+    def set_model(self, prog_file, params_file=None):
+        # params live beside the program under the shared prefix; an
+        # explicit params_file must agree with it
+        self._set_prefix(prog_file)
+        if params_file is not None:
+            want = self.params_file()
+            got = str(params_file)
+            if got not in (want, want[: -len(".npz")]):
+                raise ValueError(
+                    f"params_file {got!r} does not match the prefix "
+                    f"({want}); jit.save artifacts share one prefix")
+
+    # -- device selection --
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       precision=PrecisionType.Float32):
+        self._device, self._device_id = "tpu", device_id   # TPU build
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device != "cpu"
+
+    def gpu_device_id(self):
+        return self._device_id
+
+    # -- parity no-ops (recorded) --
+    def _noop(self, name):
+        def f(*a, **k):
+            self._flags[name] = (a, k)
+        return f
+
+    def __getattr__(self, name):
+        if name.startswith(("enable_", "disable_", "switch_", "set_")):
+            return self._noop(name)
+        raise AttributeError(name)
+
+    def summary(self):
+        return (f"Config(prefix={self._prefix!r}, device={self._device}, "
+                f"recorded_flags={sorted(self._flags)})")
+
+
+class Tensor:
+    """Handle over one predictor input/output slot (reference
+    inference Tensor): copy_from_cpu / copy_to_cpu / shape."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        self._value = np.ascontiguousarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(np.asarray(self._value).shape)
+
+    def reshape(self, shape):
+        self._value = np.asarray(self._value).reshape(shape)
+
+
+class Predictor:
+    """Executes the saved StableHLO program (reference Predictor over the
+    C++ engine).  Input arity/order come from the exported signature."""
+
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+
+        if config._prefix is None:
+            raise ValueError("Config has no model path (set_model)")
+        self._layer = jit_load(config._prefix)
+        n_in = len(self._layer._exported.in_avals) \
+            - len(self._layer._loaded_params) \
+            - len(self._layer._loaded_buffers)
+        self._inputs = [Tensor(f"x{i}") for i in range(max(n_in, 0))]
+        self._outputs = []
+        self.config = config
+
+    def get_input_names(self):
+        return [t.name for t in self._inputs]
+
+    def get_input_handle(self, name):
+        for t in self._inputs:
+            if t.name == name:
+                return t
+        raise KeyError(name)
+
+    def run(self, inputs=None):
+        """Handle-based (no args, copy_from_cpu beforehand) or direct
+        (list of arrays -> list of arrays) execution."""
+        direct = inputs is not None
+        feed = inputs if direct else [t._value for t in self._inputs]
+        if any(v is None for v in feed):
+            missing = [t.name for t in self._inputs if t._value is None]
+            raise ValueError(f"inputs not set: {missing}")
+        out = self._layer.forward(*feed)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        arrays = [np.asarray(o.numpy()) for o in outs]
+        self._outputs = []
+        for i, a in enumerate(arrays):
+            t = Tensor(f"out{i}")
+            t._value = a
+            self._outputs.append(t)
+        return arrays if direct else True
+
+    def get_output_names(self):
+        return [t.name for t in self._outputs]
+
+    def get_output_handle(self, name):
+        for t in self._outputs:
+            if t.name == name:
+                return t
+        raise KeyError(name)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+class PredictorPool:
+    """A pool of predictors over one model (reference PredictorPool);
+    under XLA the compiled program is shared, so pool members are cheap."""
+
+    def __init__(self, config: Config, size: int = 1):
+        first = Predictor(config)
+        self._preds = [first]
+        for _ in range(size - 1):
+            p = Predictor.__new__(Predictor)
+            p._layer = first._layer
+            p._inputs = [Tensor(t.name) for t in first._inputs]
+            p._outputs = []
+            p.config = config
+            self._preds.append(p)
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._preds[idx]
+
+
+def convert_to_mixed_precision(*args, **kwargs):
+    raise NotImplementedError(
+        "convert_to_mixed_precision rewrites a serialized fp32 program; "
+        "on this build export the model under amp (jit.save of an O1/O2 "
+        "model) — XLA compiles the precision the program was traced in")

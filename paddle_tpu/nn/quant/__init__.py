@@ -37,12 +37,14 @@ def _wq_impl(x, algo, group_size):
     qmax = 7.0 if algo == "weight_only_int4" else 127.0
     if group_size == -1:
         scale = jnp.max(jnp.abs(xf), axis=0) / qmax          # [N]
-        q = jnp.round(xf / scale[None, :])
+        safe = jnp.where(scale == 0, 1.0, scale)             # all-zero chans
+        q = jnp.round(xf / safe[None, :])
     else:
         K = xf.shape[0]
         g = xf.reshape(K // group_size, group_size, -1)
         scale = jnp.max(jnp.abs(g), axis=1) / qmax           # [K/gs, N]
-        q = jnp.round(g / scale[:, None, :]).reshape(xf.shape)
+        safe = jnp.where(scale == 0, 1.0, scale)
+        q = jnp.round(g / safe[:, None, :]).reshape(xf.shape)
     q = jnp.clip(q, -qmax, qmax).astype(jnp.int8).T          # [N, K]
     if algo == "weight_only_int4":
         # pack two nibbles per byte along K -> [N, K//2]
@@ -56,6 +58,10 @@ def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
     """Quantize a [K, N] fp weight; returns (int8 [N, K] — packed [N, K//2]
     for int4 — and per-channel/grouped scales)."""
     _check(algo, group_size)
+    if algo == "weight_only_int4" and int(x.shape[0]) % 2:
+        raise ValueError(
+            f"weight_only_int4 packs two rows per byte; K={x.shape[0]} "
+            "must be even")
     return D.apply("weight_quantize", _wq_impl, (x,),
                    {"algo": algo, "group_size": int(group_size)},
                    num_outputs=2)

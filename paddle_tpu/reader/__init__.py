@@ -99,9 +99,12 @@ def buffered(reader, size):
         q = _queue.Queue(maxsize=size)
 
         def fill():
-            for d in r:
-                q.put(d)
-            q.put(_End)
+            try:
+                for d in r:
+                    q.put(d)
+                q.put(_End)
+            except BaseException as e:        # surface, don't hang
+                q.put(("__reader_error__", e))
 
         t = threading.Thread(target=fill, daemon=True)
         t.start()
@@ -109,6 +112,9 @@ def buffered(reader, size):
             e = q.get()
             if e is _End:
                 break
+            if isinstance(e, tuple) and len(e) == 2 \
+                    and e[0] == "__reader_error__":
+                raise e[1]
             yield e
 
     return data_reader
@@ -133,10 +139,14 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         out_q = _queue.Queue(buffer_size)
 
         def feed():
-            for i, d in enumerate(reader()):
-                in_q.put((i, d))
-            for _ in range(process_num):
-                in_q.put(end_flag)
+            try:
+                for i, d in enumerate(reader()):
+                    in_q.put((i, d))
+            except BaseException as e:
+                out_q.put(("__reader_error__", e))
+            finally:
+                for _ in range(process_num):
+                    in_q.put(end_flag)
 
         def work():
             while True:
@@ -145,11 +155,22 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                     out_q.put(end_flag)
                     return
                 i, d = item
-                out_q.put((i, mapper(d)))
+                try:
+                    out_q.put((i, mapper(d)))
+                except BaseException as e:
+                    out_q.put(("__reader_error__", e))
+                    out_q.put(end_flag)
+                    return
 
         threading.Thread(target=feed, daemon=True).start()
         for _ in range(process_num):
             threading.Thread(target=work, daemon=True).start()
+
+        def check(item):
+            if isinstance(item, tuple) and len(item) == 2 \
+                    and item[0] == "__reader_error__":
+                raise item[1]
+            return item
 
         finished = 0
         if order:
@@ -160,7 +181,7 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 if item is end_flag:
                     finished += 1
                     continue
-                i, d = item
+                i, d = check(item)
                 pending[i] = d
                 while want in pending:
                     yield pending.pop(want)
@@ -173,7 +194,7 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 if item is end_flag:
                     finished += 1
                     continue
-                yield item[1]
+                yield check(item)[1]
 
     return thread_reader
 
@@ -185,21 +206,20 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
     import multiprocessing as mp
 
     def queue_reader():
-        q = mp.Queue(queue_size)
+        # fork context: the readers are closures (unpicklable under
+        # spawn/forkserver); a distinct sentinel type keeps readers that
+        # legitimately yield None intact
+        ctx = mp.get_context("fork")
+        q = ctx.Queue(queue_size)
 
-        def worker(r):
-            for d in r():
-                q.put(d)
-            q.put(None)
-
-        procs = [mp.Process(target=worker, args=(r,), daemon=True)
+        procs = [ctx.Process(target=_mp_worker, args=(r, q), daemon=True)
                  for r in readers]
         for p in procs:
             p.start()
         finished = 0
         while finished < len(readers):
             d = q.get()
-            if d is None:
+            if isinstance(d, _MPDone):
                 finished += 1
             else:
                 yield d
@@ -207,3 +227,13 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             p.join()
 
     return queue_reader
+
+
+class _MPDone:
+    pass
+
+
+def _mp_worker(r, q):
+    for d in r():
+        q.put(d)
+    q.put(_MPDone())

@@ -1,0 +1,42 @@
+"""paddle.inference Predictor over the StableHLO serving artifact
+(reference python/paddle/inference wrapper API)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _save_model(tmp_path):
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    net.eval()
+    spec = [paddle.jit.InputSpec([2, 8], "float32")]
+    prefix = str(tmp_path / "served")
+    paddle.jit.save(net, prefix, input_spec=spec)
+    return net, prefix
+
+
+def test_predictor_handle_api(tmp_path):
+    net, prefix = _save_model(tmp_path)
+    cfg = paddle.inference.Config(prefix + ".pdmodel")
+    cfg.enable_memory_optim()               # parity no-op, recorded
+    pred = paddle.inference.create_predictor(cfg)
+    names = pred.get_input_names()
+    assert len(names) == 1
+    x = np.random.RandomState(0).rand(2, 8).astype(np.float32)
+    pred.get_input_handle(names[0]).copy_from_cpu(x)
+    assert pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_direct_run_and_pool(tmp_path):
+    net, prefix = _save_model(tmp_path)
+    cfg = paddle.inference.Config(prefix)
+    pool = paddle.inference.PredictorPool(cfg, size=2)
+    x = np.random.RandomState(1).rand(2, 8).astype(np.float32)
+    outs0 = pool.retrieve(0).run([x])
+    outs1 = pool.retrieve(1).run([x])
+    np.testing.assert_allclose(outs0[0], outs1[0])
+    assert paddle.inference.get_num_bytes_of_data_type("float32") == 4
+    assert "StableHLO" in paddle.inference.get_version()
