@@ -205,11 +205,14 @@ class LlamaForCausalLM(nn.Layer):
                 "norm_f": self.model.norm.weight._data, "head": head}
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
-                 top_p=None, eos_token_id=None, seed=0):
-        """Greedy/top-p sampling with a compiled KV-cache decode loop.
+                 top_p=None, top_k=None, repetition_penalty=None,
+                 eos_token_id=None, seed=0):
+        """Greedy/top-p/top-k sampling with a compiled KV-cache decode loop.
 
         input_ids: [B, S0] int tensor/array.  Returns [B, S0+max_new_tokens]
         (generation frozen at eos when eos_token_id is given).
+        repetition_penalty follows the CTRL rule: logits of tokens already
+        seen divide by the penalty when positive, multiply when negative.
         """
         import jax
         import jax.numpy as jnp
@@ -226,20 +229,29 @@ class LlamaForCausalLM(nn.Layer):
 
         key_cache = (B, S0, int(max_new_tokens), float(temperature),
                      None if top_p is None else float(top_p),
+                     None if top_k is None else int(top_k),
+                     None if repetition_penalty is None
+                     else float(repetition_penalty),
                      eos_token_id)
         fn = getattr(self, "_gen_cache", {}).get(key_cache)
         if fn is None:
             fn = self._build_generate(B, S0, int(max_new_tokens),
                                       float(temperature),
                                       None if top_p is None else float(top_p),
-                                      eos_token_id)
+                                      eos_token_id,
+                                      top_k=None if top_k is None
+                                      else int(top_k),
+                                      repetition_penalty=None
+                                      if repetition_penalty is None
+                                      else float(repetition_penalty))
             if not hasattr(self, "_gen_cache"):
                 self._gen_cache = {}
             self._gen_cache[key_cache] = fn
         out = fn(params, ids, jax.random.PRNGKey(seed))
         return Tensor(out)
 
-    def _build_generate(self, B, S0, max_new, temperature, top_p, eos_id):
+    def _build_generate(self, B, S0, max_new, temperature, top_p, eos_id,
+                        top_k=None, repetition_penalty=None):
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -320,10 +332,19 @@ class LlamaForCausalLM(nn.Layer):
                       @ params["head"].astype(jnp.float32))
             return logits, ck, cv
 
-        def sample(logits, key):
+        def sample(logits, key, seen=None):
+            if repetition_penalty is not None and seen is not None:
+                # CTRL rule: divide positive logits of seen tokens by the
+                # penalty, multiply negative ones
+                pen = jnp.where(logits > 0, logits / repetition_penalty,
+                                logits * repetition_penalty)
+                logits = jnp.where(seen, pen, logits)
             if temperature == 0.0:
                 return jnp.argmax(logits, -1).astype(jnp.int32)
             lg = logits / max(temperature, 1e-6)
+            if top_k is not None:
+                kth = jax.lax.top_k(lg, top_k)[0][:, -1:]
+                lg = jnp.where(lg < kth, -jnp.inf, lg)
             if top_p is not None:
                 idx = jnp.argsort(-lg, axis=-1)
                 sp = jax.nn.softmax(jnp.take_along_axis(lg, idx, -1), -1)
@@ -344,12 +365,22 @@ class LlamaForCausalLM(nn.Layer):
             pos0 = jnp.arange(S0)
             mask0 = (jnp.arange(T)[None, :] <= pos0[:, None])
             logits, ck, cv = fwd(params, ids, ck, cv, pos0, mask0)
+            V = params["head"].shape[-1]
+            if repetition_penalty is not None:
+                seen = jnp.zeros((B, V), bool).at[
+                    jnp.arange(B)[:, None], ids].set(True)
+            else:
+                seen = None
             key, sub = jax.random.split(key)
-            tok = sample(logits, sub)
+            tok = sample(logits, sub, seen)
+            if seen is not None:
+                seen = seen.at[jnp.arange(B), tok].set(True)
             done = jnp.zeros((B,), bool) if eos_id is None else tok == eos_id
 
+            track = repetition_penalty is not None
+
             def step(carry, t):
-                ck, cv, tok, key, done = carry
+                ck, cv, tok, key, done, seen = carry
                 pos = S0 + t
                 if eos_id is not None:
                     tok = jnp.where(done, jnp.int32(eos_id), tok)
@@ -358,13 +389,18 @@ class LlamaForCausalLM(nn.Layer):
                 logits, ck, cv = fwd(params, tok[:, None], ck, cv,
                                      jnp.asarray([pos]), mask)
                 key, sub = jax.random.split(key)
-                nxt = sample(logits, sub)
+                nxt = sample(logits, sub, seen if track else None)
+                if track:
+                    seen = seen.at[jnp.arange(B), nxt].set(True)
                 if eos_id is not None:
                     done = done | (nxt == eos_id)
-                return (ck, cv, nxt, key, done), emit
+                return (ck, cv, nxt, key, done, seen), emit
 
-            (_, _, last, _, done), toks = lax.scan(
-                step, (ck, cv, tok, key, done), jnp.arange(max_new - 1))
+            if seen is None:
+                seen = jnp.zeros((B, 1), bool)   # carry placeholder
+            (_, _, last, _, done, _), toks = lax.scan(
+                step, (ck, cv, tok, key, done, seen),
+                jnp.arange(max_new - 1))
             if eos_id is not None:   # freeze the final token too
                 last = jnp.where(done, jnp.int32(eos_id), last)
             gen = jnp.concatenate([toks.T, last[:, None]], axis=1)

@@ -63,3 +63,29 @@ def test_generate_eos_freezes(model):
     out = model.generate(ids, max_new_tokens=8, temperature=0.0,
                          eos_token_id=eos).numpy()
     assert (out[0, 2:] == eos).all()
+
+
+def test_generate_top_k_and_repetition_penalty():
+    """top_k restricts sampling to the k best logits; repetition_penalty
+    (CTRL rule) discourages already-emitted tokens."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=64)
+    m = LlamaForCausalLM(cfg)
+    ids = paddle.to_tensor(np.asarray([[1, 2, 3, 4]]), dtype="int64")
+
+    # top_k=1 must equal greedy regardless of temperature
+    g = m.generate(ids, max_new_tokens=6, temperature=0.0).numpy()
+    k1 = m.generate(ids, max_new_tokens=6, temperature=1.0, top_k=1,
+                    seed=7).numpy()
+    np.testing.assert_array_equal(g, k1)
+
+    # strong repetition penalty: emitted tokens should not immediately
+    # repeat under greedy decoding
+    rp = m.generate(ids, max_new_tokens=8, temperature=0.0,
+                    repetition_penalty=1e9).numpy()[0, 4:]
+    assert len(set(rp.tolist())) == len(rp), rp
