@@ -257,80 +257,11 @@ class LlamaForCausalLM(nn.Layer):
         from jax import lax
 
         cfg = self.config
-        H = cfg.hidden_size
-        nh = cfg.num_attention_heads
-        kvh = cfg.num_key_value_heads
-        d = H // nh
         L = cfg.num_hidden_layers
+        kvh = cfg.num_key_value_heads
+        d = cfg.hidden_size // cfg.num_attention_heads
         T = S0 + max_new
-        eps = cfg.rms_norm_eps
-        theta = cfg.rope_theta
-
-        def rms(x, w):
-            xf = x.astype(jnp.float32)
-            o = xf * lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + eps)
-            return (o * w.astype(jnp.float32)).astype(x.dtype)
-
-        def rope(x, pos):
-            # x [B, s, h, d]; pos [s] absolute positions
-            inv = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
-            freqs = jnp.outer(pos.astype(jnp.float32), inv)
-            cos = jnp.cos(freqs)[None, :, None, :]
-            sin = jnp.sin(freqs)[None, :, None, :]
-            xf = x.astype(jnp.float32)
-            x1, x2 = xf[..., 0::2], xf[..., 1::2]
-            out = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
-            return out.reshape(x.shape).astype(x.dtype)
-
-        def qkv(x, p, pos):
-            b, s = x.shape[:2]
-            h = rms(x, p["ln1"])
-            q = (h @ p["wq"]).reshape(b, s, nh, d)
-            k = (h @ p["wk"]).reshape(b, s, kvh, d)
-            v = (h @ p["wv"]).reshape(b, s, kvh, d)
-            return rope(q, pos), rope(k, pos), v
-
-        def attend(q, kc, vc, mask):
-            # q [B, s, nh, d]; kc/vc [B, T, kvh, d]; mask [s, T] bool
-            if kvh != nh:
-                kc = jnp.repeat(kc, nh // kvh, axis=2)
-                vc = jnp.repeat(vc, nh // kvh, axis=2)
-            sc = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                            kc.astype(jnp.float32)) / (d ** 0.5)
-            sc = jnp.where(mask[None, None], sc, -jnp.inf)
-            pr = jax.nn.softmax(sc, axis=-1)
-            return jnp.einsum("bhqk,bkhd->bqhd", pr,
-                              vc.astype(jnp.float32)).astype(q.dtype)
-
-        def block(x, p, kc, vc, pos, mask):
-            b, s = x.shape[:2]
-            q, k, v = qkv(x, p, pos)
-            kc = lax.dynamic_update_slice_in_dim(kc, k.astype(kc.dtype),
-                                                 pos[0], axis=1)
-            vc = lax.dynamic_update_slice_in_dim(vc, v.astype(vc.dtype),
-                                                 pos[0], axis=1)
-            att = attend(q, kc, vc, mask).reshape(b, s, nh * d)
-            x = x + att @ p["wo"]
-            h2 = rms(x, p["ln2"])
-            a = jax.nn.silu((h2 @ p["gate"]).astype(jnp.float32)
-                            ).astype(h2.dtype) * (h2 @ p["up"])
-            return x + a @ p["down"], kc, vc
-
-        def fwd(params, toks, caches_k, caches_v, pos, mask):
-            x = jnp.take(params["embed"], toks, axis=0)
-
-            def body(carry, inp):
-                x = carry
-                p, kc, vc = inp
-                x, kc, vc = block(x, p, kc, vc, pos, mask)
-                return x, (kc, vc)
-
-            x, (ck, cv) = lax.scan(body, x,
-                                   (params["layers"], caches_k, caches_v))
-            h = rms(x, params["norm_f"])
-            logits = (h[:, -1].astype(jnp.float32)
-                      @ params["head"].astype(jnp.float32))
-            return logits, ck, cv
+        fwd = _make_decode_fwd(cfg)
 
         def sample(logits, key, seen=None):
             if repetition_penalty is not None and seen is not None:
@@ -407,6 +338,260 @@ class LlamaForCausalLM(nn.Layer):
             return jnp.concatenate([ids, gen], axis=1)
 
         return jax.jit(run)
+
+
+def speculative_generate(target, draft, input_ids, max_new_tokens=32,
+                         gamma=4, temperature=1.0, seed=0,
+                         eos_token_id=None):
+    """Speculative decoding (Leviathan et al.): the draft model proposes
+    ``gamma`` tokens per round; the target verifies them in ONE forward and
+    accepts a prefix, resampling the first rejection from the residual
+    distribution max(p - q, 0) — provably the target's own distribution,
+    so with temperature=0 the output EQUALS target-only greedy decoding.
+
+    TPU-native shape: the whole loop is one compiled program — a
+    lax.while_loop over rounds, each round a gamma-step draft scan plus a
+    single (gamma+1)-token target forward over static-size KV caches.
+    Batch 1 (latency-oriented decode).  Returns [1, S0 + max_new_tokens].
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..core.tensor import Tensor
+
+    ids = input_ids._data if isinstance(input_ids, Tensor) \
+        else jnp.asarray(input_ids)
+    ids = ids.astype(jnp.int32)
+    if ids.shape[0] != 1:
+        raise ValueError("speculative_generate is batch-1 (latency decode)")
+    S0 = ids.shape[1]
+    max_new = int(max_new_tokens)
+    gamma = int(gamma)
+    tcfg, dcfg = target.config, draft.config
+    if tcfg.vocab_size != dcfg.vocab_size:
+        raise ValueError("draft and target must share a vocabulary")
+
+    key_cache = (S0, max_new, gamma, float(temperature), eos_token_id)
+    cache = getattr(target, "_spec_cache", None)
+    if cache is None:
+        cache = target._spec_cache = {}
+    fn = cache.get((id(draft),) + key_cache)
+    if fn is None:
+        fn = _build_speculative(tcfg, dcfg, S0, max_new, gamma,
+                                float(temperature), eos_token_id)
+        cache[(id(draft),) + key_cache] = fn
+    out = fn(target._decode_params(), draft._decode_params(), ids,
+             jax.random.PRNGKey(seed))
+    return Tensor(out)
+
+
+def _build_speculative(tcfg, dcfg, S0, max_new, gamma, temperature, eos_id):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    T = S0 + max_new + gamma + 1          # static cache/buffer bound
+    t_fwd = _make_decode_fwd(tcfg, all_logits=True)
+    d_fwd = _make_decode_fwd(dcfg, all_logits=True)
+    V = tcfg.vocab_size
+    greedy = temperature == 0.0
+
+    def dist(logits):
+        # [*, V] logits -> sampling distribution at this temperature
+        if greedy:
+            return jax.nn.one_hot(jnp.argmax(logits, -1), V,
+                                  dtype=jnp.float32)
+        return jax.nn.softmax(logits / max(temperature, 1e-6), -1)
+
+    def caches(cfg, dt):
+        L = cfg.num_hidden_layers
+        kvh = cfg.num_key_value_heads
+        d = cfg.hidden_size // cfg.num_attention_heads
+        z = jnp.zeros((L, 1, T, kvh, d), dt)
+        return z, z
+
+    def mask_for(pos, s):
+        # window of s tokens at absolute positions pos..pos+s-1
+        q = pos + jnp.arange(s)
+        return jnp.arange(T)[None, :] <= q[:, None]
+
+    def run(tp, dp, ids, key):
+        t_ck, t_cv = caches(tcfg, tp["embed"].dtype)
+        d_ck, d_cv = caches(dcfg, dp["embed"].dtype)
+
+        # prefill BOTH models on the prompt minus nothing: caches hold the
+        # prompt; cur = first target-sampled token
+        pos0 = jnp.arange(S0)
+        m0 = mask_for(0, S0)
+        t_log, t_ck, t_cv = t_fwd(tp, ids, t_ck, t_cv, pos0, m0)
+        _, d_ck, d_cv = d_fwd(dp, ids, d_ck, d_cv, pos0, m0)
+        key, sub = jax.random.split(key)
+        cur = jax.random.categorical(
+            sub, jnp.log(dist(t_log[:, -1]) + 1e-30), axis=-1
+        ).astype(jnp.int32)[0]
+
+        buf = jnp.zeros((max_new + gamma + 1,), jnp.int32)
+        buf = buf.at[0].set(cur)
+        # n = emitted count; caches hold prompt + emitted[:n-1]; `cur` is
+        # emitted but not yet in either cache
+        def cond(c):
+            n, done = c[1], c[8]
+            return (n < max_new) & ~done
+
+        def body(c):
+            buf, n, cur, t_ck, t_cv, d_ck, d_cv, key, done = c
+            pos = S0 + n - 1                 # cur's absolute position
+
+            # -- draft proposes gamma tokens, recording q-dists
+            def dstep(carry, i):
+                tok, dk, dv, key = carry
+                m = mask_for(pos + i, 1)
+                lg, dk, dv = d_fwd(dp, tok[None, None], dk, dv,
+                                   jnp.asarray([pos + i]), m)
+                qd = dist(lg[0, -1])
+                key, sub = jax.random.split(key)
+                nxt = jax.random.categorical(
+                    sub, jnp.log(qd + 1e-30)).astype(jnp.int32)
+                return (nxt, dk, dv, key), (nxt, qd)
+
+            (last_d, d_ck, d_cv, key), (props, qds) = lax.scan(
+                dstep, (cur, d_ck, d_cv, key), jnp.arange(gamma))
+
+            # -- ONE target forward over [cur, props[:-1]] + bonus slot
+            window = jnp.concatenate([cur[None], props])      # [gamma+1]
+            m = mask_for(pos, gamma + 1)
+            t_log, t_ck, t_cv = t_fwd(tp, window[None], t_ck, t_cv,
+                                      pos + jnp.arange(gamma + 1), m)
+            pds = dist(t_log[0])             # [gamma+1, V]
+
+            # -- acceptance: props[i] vs p-dist at window position i
+            key, sub = jax.random.split(key)
+            us = jax.random.uniform(sub, (gamma,))
+            p_i = jnp.take_along_axis(pds[:gamma], props[:, None],
+                                      1)[:, 0]
+            q_i = jnp.take_along_axis(qds, props[:, None], 1)[:, 0]
+            ratio = jnp.where(q_i > 0, p_i / jnp.maximum(q_i, 1e-30), 0.0)
+            acc = us < jnp.minimum(ratio, 1.0)
+            a = jnp.argmin(jnp.cumprod(acc.astype(jnp.int32)))
+            a = jnp.where(acc.all(), gamma, a)   # accepted count
+
+            # -- corrective / bonus token
+            resid = jnp.maximum(pds[a] - jnp.where(a < gamma, 1.0, 0.0)
+                                * qds[jnp.minimum(a, gamma - 1)], 0.0)
+            resid_sum = resid.sum()
+            corr_dist = jnp.where(resid_sum > 0, resid / resid_sum, pds[a])
+            key, sub = jax.random.split(key)
+            nxt = jax.random.categorical(
+                sub, jnp.log(corr_dist + 1e-30)).astype(jnp.int32)
+
+            # -- emit: a accepted proposals then nxt
+            emit = jnp.concatenate([props, jnp.zeros((1,), jnp.int32)])
+            emit = emit.at[a].set(nxt)
+            keepmask = jnp.arange(gamma + 1) <= a
+            emit = jnp.where(keepmask, emit, 0)
+            buf = lax.dynamic_update_slice(buf, emit, (n,))
+            # zero the tail we did not emit (keep stale writes out)
+            tailmask = jnp.arange(buf.shape[0]) < n + a + 1
+            buf = jnp.where(tailmask, buf, 0)
+
+            if eos_id is not None:
+                done = done | ((emit == eos_id) & keepmask).any()
+            n = n + a + 1
+            return (buf, n, nxt, t_ck, t_cv, d_ck, d_cv, key, done)
+
+        init = (buf, jnp.int32(1), cur, t_ck, t_cv, d_ck, d_cv, key,
+                jnp.asarray(False))
+        buf, n, cur, *_ = lax.while_loop(cond, body, init)
+        gen = buf[:max_new]
+        return jnp.concatenate([ids, gen[None]], axis=1)
+
+    return jax.jit(run)
+
+
+def _make_decode_fwd(cfg, all_logits=False):
+    """Build the KV-cache decode forward shared by generate() and
+    speculative decoding: fwd(params, toks, ck, cv, pos, mask) ->
+    (logits, ck, cv).  With all_logits, logits cover every window
+    position ([B, s, V]) instead of only the last."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    nh = cfg.num_attention_heads
+    kvh = cfg.num_key_value_heads
+    d = cfg.hidden_size // nh
+    eps = cfg.rms_norm_eps
+    theta = cfg.rope_theta
+
+    def rms(x, w):
+        xf = x.astype(jnp.float32)
+        o = xf * lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + eps)
+        return (o * w.astype(jnp.float32)).astype(x.dtype)
+
+    def rope(x, pos):
+        # x [B, s, h, d]; pos [s] absolute positions
+        inv = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+        freqs = jnp.outer(pos.astype(jnp.float32), inv)
+        cos = jnp.cos(freqs)[None, :, None, :]
+        sin = jnp.sin(freqs)[None, :, None, :]
+        xf = x.astype(jnp.float32)
+        x1, x2 = xf[..., 0::2], xf[..., 1::2]
+        out = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+        return out.reshape(x.shape).astype(x.dtype)
+
+    def qkv(x, p, pos):
+        b, s = x.shape[:2]
+        h = rms(x, p["ln1"])
+        q = (h @ p["wq"]).reshape(b, s, nh, d)
+        k = (h @ p["wk"]).reshape(b, s, kvh, d)
+        v = (h @ p["wv"]).reshape(b, s, kvh, d)
+        return rope(q, pos), rope(k, pos), v
+
+    def attend(q, kc, vc, mask):
+        # q [B, s, nh, d]; kc/vc [B, T, kvh, d]; mask [s, T] bool
+        if kvh != nh:
+            kc = jnp.repeat(kc, nh // kvh, axis=2)
+            vc = jnp.repeat(vc, nh // kvh, axis=2)
+        sc = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        kc.astype(jnp.float32)) / (d ** 0.5)
+        sc = jnp.where(mask[None, None], sc, -jnp.inf)
+        pr = jax.nn.softmax(sc, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", pr,
+                          vc.astype(jnp.float32)).astype(q.dtype)
+
+    def block(x, p, kc, vc, pos, mask):
+        b, s = x.shape[:2]
+        q, k, v = qkv(x, p, pos)
+        kc = lax.dynamic_update_slice_in_dim(kc, k.astype(kc.dtype),
+                                             pos[0], axis=1)
+        vc = lax.dynamic_update_slice_in_dim(vc, v.astype(vc.dtype),
+                                             pos[0], axis=1)
+        att = attend(q, kc, vc, mask).reshape(b, s, nh * d)
+        x = x + att @ p["wo"]
+        h2 = rms(x, p["ln2"])
+        a = jax.nn.silu((h2 @ p["gate"]).astype(jnp.float32)
+                        ).astype(h2.dtype) * (h2 @ p["up"])
+        return x + a @ p["down"], kc, vc
+
+    def fwd(params, toks, caches_k, caches_v, pos, mask):
+        x = jnp.take(params["embed"], toks, axis=0)
+
+        def body(carry, inp):
+            x = carry
+            p, kc, vc = inp
+            x, kc, vc = block(x, p, kc, vc, pos, mask)
+            return x, (kc, vc)
+
+        x, (ck, cv) = lax.scan(body, x,
+                               (params["layers"], caches_k, caches_v))
+        h = rms(x, params["norm_f"])
+        hsel = h if all_logits else h[:, -1]
+        logits = (hsel.astype(jnp.float32)
+                  @ params["head"].astype(jnp.float32))
+        return logits, ck, cv
+
+    return fwd
 
 
 # ---------------------------------------------------------------------------

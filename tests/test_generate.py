@@ -89,3 +89,47 @@ def test_generate_top_k_and_repetition_penalty():
     rp = m.generate(ids, max_new_tokens=8, temperature=0.0,
                     repetition_penalty=1e9).numpy()[0, 4:]
     assert len(set(rp.tolist())) == len(rp), rp
+
+
+def test_speculative_generate_matches_target_greedy():
+    """Speculative decoding is distribution-preserving; at temperature 0
+    the accept/resample rule reduces to exact target greedy, so the output
+    must EQUAL target-only greedy decoding — with a weak, differently
+    initialized draft model."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                         speculative_generate)
+
+    cfg = LlamaConfig.tiny(vocab=64)
+    paddle.seed(0)
+    target = LlamaForCausalLM(cfg)
+    paddle.seed(123)
+    draft = LlamaForCausalLM(
+        LlamaConfig.tiny(vocab=64, hidden=32, layers=1, heads=4, ffn=64))
+    ids = paddle.to_tensor(np.asarray([[5, 9, 2, 7]]), dtype="int64")
+
+    ref = target.generate(ids, max_new_tokens=12, temperature=0.0).numpy()
+    spec = speculative_generate(target, draft, ids, max_new_tokens=12,
+                                gamma=3, temperature=0.0).numpy()
+    np.testing.assert_array_equal(spec, ref)
+
+
+def test_speculative_generate_self_draft_accepts_everything():
+    """draft == target at temperature 0: every proposal is accepted, and
+    the output still equals plain greedy."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                         speculative_generate)
+
+    cfg = LlamaConfig.tiny(vocab=32)
+    paddle.seed(1)
+    m = LlamaForCausalLM(cfg)
+    ids = paddle.to_tensor(np.asarray([[3, 1, 4]]), dtype="int64")
+    ref = m.generate(ids, max_new_tokens=10, temperature=0.0).numpy()
+    spec = speculative_generate(m, m, ids, max_new_tokens=10, gamma=4,
+                                temperature=0.0).numpy()
+    np.testing.assert_array_equal(spec, ref)
