@@ -615,7 +615,7 @@ skip("inplace alias", "softmax_")
 
 # -- random (deterministic properties only -> skip value checks) ------------
 skip("stochastic output; determinism under paddle.seed + distribution "
-     "moments covered by test_random/test_distribution",
+     "moments covered by test_random_ops.py",
      "bernoulli", "binomial", "gaussian", "multinomial", "normal",
      "poisson", "rand", "randint", "randint_like", "randn", "randperm",
      "standard_gamma", "standard_normal", "uniform")

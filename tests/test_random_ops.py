@@ -1,0 +1,133 @@
+"""Random op coverage: seed determinism + distribution moments/support
+for every stochastic op in ops.yaml (the op-sweep skip list points here).
+
+Reference model: test/legacy_test's distribution checks for sampling ops —
+exact value comparison is meaningless, so the contracts ARE the tests:
+same seed -> same stream, different draws differ, moments within tolerance,
+support respected.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+N = 20000
+SEED = 1234
+
+
+def _drawn_twice(fn):
+    paddle.seed(SEED)
+    a = fn().numpy()
+    paddle.seed(SEED)
+    b = fn().numpy()
+    paddle.seed(SEED + 1)
+    c = fn().numpy()
+    return a, b, c
+
+
+CASES = {
+    "rand": (lambda: paddle.rand([N]),
+             lambda a: (abs(a.mean() - 0.5) < 0.02
+                        and (a >= 0).all() and (a < 1).all())),
+    "randn": (lambda: paddle.randn([N]),
+              lambda a: abs(a.mean()) < 0.05 and abs(a.std() - 1) < 0.05),
+    "standard_normal": (lambda: paddle.standard_normal([N]),
+                        lambda a: abs(a.mean()) < 0.05),
+    "normal": (lambda: paddle.normal(2.0, 3.0, [N]),
+               lambda a: (abs(a.mean() - 2.0) < 0.1
+                          and abs(a.std() - 3.0) < 0.1)),
+    "gaussian": (lambda: paddle.tensor.random.gaussian([N], mean=1.0,
+                                                       std=2.0)
+                 if hasattr(paddle, "tensor") else
+                 paddle.normal(1.0, 2.0, [N]),
+                 lambda a: abs(a.mean() - 1.0) < 0.1),
+    "uniform": (lambda: paddle.uniform([N], min=-2.0, max=4.0),
+                lambda a: ((a >= -2).all() and (a < 4).all()
+                           and abs(a.mean() - 1.0) < 0.1)),
+    "randint": (lambda: paddle.randint(3, 11, [N]),
+                lambda a: (a >= 3).all() and (a < 11).all()),
+    "randint_like": (lambda: paddle.randint_like(paddle.zeros([N]), 0, 5),
+                     lambda a: (a >= 0).all() and (a < 5).all()),
+    "bernoulli": (lambda: paddle.bernoulli(paddle.full([N], 0.3)),
+                  lambda a: (abs(a.mean() - 0.3) < 0.02
+                             and set(np.unique(a)) <= {0.0, 1.0})),
+    "poisson": (lambda: paddle.poisson(paddle.full([N], 4.0)),
+                lambda a: (abs(a.mean() - 4.0) < 0.15 and (a >= 0).all())),
+    "binomial": (lambda: paddle.binomial(paddle.full([N], 10.0),
+                                         paddle.full([N], 0.25)),
+                 lambda a: (abs(a.mean() - 2.5) < 0.1
+                            and (a >= 0).all() and (a <= 10).all())),
+    "standard_gamma": (lambda: paddle.standard_gamma(paddle.full([N], 3.0)),
+                       lambda a: (abs(a.mean() - 3.0) < 0.15
+                                  and (a > 0).all())),
+    "log_normal": (lambda: paddle.log_normal(mean=0.0, std=0.5,
+                                             shape=[N]),
+                   lambda a: (a > 0).all()),
+}
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_random_op(name):
+    fn, check = CASES[name]
+    a, b, c = _drawn_twice(fn)
+    np.testing.assert_array_equal(a, b,
+                                  err_msg=f"{name}: seed not deterministic")
+    assert not np.array_equal(a, c), f"{name}: different seed, same draw"
+    assert check(np.asarray(a, np.float64)), f"{name}: moment/support check"
+
+
+def test_randperm():
+    paddle.seed(SEED)
+    a = paddle.randperm(500).numpy()
+    assert sorted(a.tolist()) == list(range(500))
+    paddle.seed(SEED)
+    b = paddle.randperm(500).numpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_multinomial():
+    paddle.seed(SEED)
+    probs = paddle.to_tensor(np.asarray([0.1, 0.0, 0.6, 0.3], np.float32))
+    draws = paddle.multinomial(probs, num_samples=N,
+                               replacement=True).numpy()
+    counts = np.bincount(draws, minlength=4) / N
+    assert counts[1] == 0.0
+    assert abs(counts[2] - 0.6) < 0.03
+    assert abs(counts[3] - 0.3) < 0.03
+
+
+def test_inplace_random_mutators():
+    paddle.seed(SEED)
+    x = paddle.zeros([N])
+    x.uniform_(min=0.0, max=1.0)
+    a = x.numpy()
+    assert (a >= 0).all() and (a < 1).all() and a.std() > 0.2
+
+    x = paddle.zeros([N])
+    x.normal_(mean=1.0, std=2.0)
+    assert abs(x.numpy().mean() - 1.0) < 0.1
+
+    x = paddle.zeros([N])
+    x.exponential_(lam=2.0)
+    a = x.numpy()
+    assert (a >= 0).all() and abs(a.mean() - 0.5) < 0.05
+
+    x = paddle.zeros([N])
+    x.cauchy_()
+    assert np.isfinite(np.median(x.numpy()))
+
+    x = paddle.zeros([N])
+    x.geometric_(probs=0.25)
+    a = x.numpy()
+    # trials convention (reference example at p=0.3 centers near 1/p)
+    assert (a >= 1).all() and abs(a.mean() - 1 / 0.25) < 0.3
+
+
+def test_rng_state_roundtrip():
+    paddle.seed(77)
+    _ = paddle.randn([8]).numpy()
+    state = paddle.get_rng_state()
+    a = paddle.randn([8]).numpy()
+    paddle.set_rng_state(state)
+    b = paddle.randn([8]).numpy()
+    np.testing.assert_array_equal(a, b)
