@@ -372,15 +372,18 @@ def speculative_generate(target, draft, input_ids, max_new_tokens=32,
     if tcfg.vocab_size != dcfg.vocab_size:
         raise ValueError("draft and target must share a vocabulary")
 
-    key_cache = (S0, max_new, gamma, float(temperature), eos_token_id)
+    dkey = tuple(sorted((k, v) for k, v in vars(dcfg).items()
+                        if isinstance(v, (int, float, bool, str))))
+    key_cache = (dkey, S0, max_new, gamma, float(temperature),
+                 eos_token_id)
     cache = getattr(target, "_spec_cache", None)
     if cache is None:
         cache = target._spec_cache = {}
-    fn = cache.get((id(draft),) + key_cache)
+    fn = cache.get(key_cache)
     if fn is None:
         fn = _build_speculative(tcfg, dcfg, S0, max_new, gamma,
                                 float(temperature), eos_token_id)
-        cache[(id(draft),) + key_cache] = fn
+        cache[key_cache] = fn
     out = fn(target._decode_params(), draft._decode_params(), ids,
              jax.random.PRNGKey(seed))
     return Tensor(out)
@@ -394,6 +397,10 @@ def _build_speculative(tcfg, dcfg, S0, max_new, gamma, temperature, eos_id):
     T = S0 + max_new + gamma + 1          # static cache/buffer bound
     t_fwd = _make_decode_fwd(tcfg, all_logits=True)
     d_fwd = _make_decode_fwd(dcfg, all_logits=True)
+    # prefill variants project only the LAST position's logits (an S0 x V
+    # head matmul would be wasted work on a long prompt)
+    t_fwd_last = _make_decode_fwd(tcfg, all_logits=False)
+    d_fwd_last = _make_decode_fwd(dcfg, all_logits=False)
     V = tcfg.vocab_size
     greedy = temperature == 0.0
 
@@ -424,11 +431,11 @@ def _build_speculative(tcfg, dcfg, S0, max_new, gamma, temperature, eos_id):
         # prompt; cur = first target-sampled token
         pos0 = jnp.arange(S0)
         m0 = mask_for(0, S0)
-        t_log, t_ck, t_cv = t_fwd(tp, ids, t_ck, t_cv, pos0, m0)
-        _, d_ck, d_cv = d_fwd(dp, ids, d_ck, d_cv, pos0, m0)
+        t_log, t_ck, t_cv = t_fwd_last(tp, ids, t_ck, t_cv, pos0, m0)
+        _, d_ck, d_cv = d_fwd_last(dp, ids, d_ck, d_cv, pos0, m0)
         key, sub = jax.random.split(key)
         cur = jax.random.categorical(
-            sub, jnp.log(dist(t_log[:, -1]) + 1e-30), axis=-1
+            sub, jnp.log(dist(t_log) + 1e-30), axis=-1
         ).astype(jnp.int32)[0]
 
         buf = jnp.zeros((max_new + gamma + 1,), jnp.int32)
@@ -504,6 +511,15 @@ def _build_speculative(tcfg, dcfg, S0, max_new, gamma, temperature, eos_id):
                 jnp.asarray(False))
         buf, n, cur, *_ = lax.while_loop(cond, body, init)
         gen = buf[:max_new]
+        if eos_id is not None:
+            # generate()'s freeze contract: every position after (and
+            # including padding beyond) the first eos reads eos
+            hit = gen == eos_id
+            first = jnp.argmax(hit)
+            frozen = hit.any() & (jnp.arange(max_new) > first)
+            beyond = jnp.arange(max_new) >= n       # early-stop padding
+            gen = jnp.where(frozen | (hit.any() & beyond),
+                            jnp.int32(eos_id), gen)
         return jnp.concatenate([ids, gen[None]], axis=1)
 
     return jax.jit(run)

@@ -121,11 +121,13 @@ def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
 
 
 def randint_like(x, low=0, high=None, dtype=None, name=None):
-    # reference contract: output dtype follows x (may be FLOAT) — draw
-    # integers, then cast (jax randint rejects float dtypes)
-    dt = dtype or x.dtype.name
-    out = randint(low, high, x.shape, "int64")
-    return out if str(dt) in ("int32", "int64") else out.astype(dt)
+    # reference contract: output dtype follows x (may be FLOAT) — integer
+    # dtypes pass straight through; float targets draw ints then cast
+    # (jax randint rejects float dtypes)
+    dt = str(dtype or x.dtype.name)
+    if dt.startswith(("int", "uint")):
+        return randint(low, high, x.shape, dt)
+    return randint(low, high, x.shape, "int64").astype(dt)
 
 
 def randperm(n, dtype="int64", name=None):

@@ -133,3 +133,25 @@ def test_speculative_generate_self_draft_accepts_everything():
     spec = speculative_generate(m, m, ids, max_new_tokens=10, gamma=4,
                                 temperature=0.0).numpy()
     np.testing.assert_array_equal(spec, ref)
+
+
+def test_speculative_generate_eos_freeze_matches_generate():
+    """With eos_token_id set, speculative output must still equal plain
+    greedy including the post-eos freeze contract."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                         speculative_generate)
+
+    cfg = LlamaConfig.tiny(vocab=16)   # tiny vocab: eos fires quickly
+    paddle.seed(2)
+    m = LlamaForCausalLM(cfg)
+    ids = paddle.to_tensor(np.asarray([[3, 1]]), dtype="int64")
+    ref = m.generate(ids, max_new_tokens=12, temperature=0.0).numpy()
+    eos = int(ref[0, -1])              # a token greedy actually emits late
+    ref_eos = m.generate(ids, max_new_tokens=12, temperature=0.0,
+                         eos_token_id=eos).numpy()
+    spec = speculative_generate(m, m, ids, max_new_tokens=12, gamma=3,
+                                temperature=0.0, eos_token_id=eos).numpy()
+    np.testing.assert_array_equal(spec, ref_eos)

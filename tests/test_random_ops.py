@@ -36,11 +36,11 @@ CASES = {
     "normal": (lambda: paddle.normal(2.0, 3.0, [N]),
                lambda a: (abs(a.mean() - 2.0) < 0.1
                           and abs(a.std() - 3.0) < 0.1)),
-    "gaussian": (lambda: paddle.tensor.random.gaussian([N], mean=1.0,
-                                                       std=2.0)
-                 if hasattr(paddle, "tensor") else
-                 paddle.normal(1.0, 2.0, [N]),
-                 lambda a: abs(a.mean() - 1.0) < 0.1),
+    "gaussian": (lambda: __import__(
+        "paddle_tpu.ops.random", fromlist=["gaussian"]).gaussian(
+            [N], mean=1.0, std=2.0),
+                 lambda a: (abs(a.mean() - 1.0) < 0.1
+                            and abs(a.std() - 2.0) < 0.1)),
     "uniform": (lambda: paddle.uniform([N], min=-2.0, max=4.0),
                 lambda a: ((a >= -2).all() and (a < 4).all()
                            and abs(a.mean() - 1.0) < 0.1)),
@@ -48,6 +48,10 @@ CASES = {
                 lambda a: (a >= 3).all() and (a < 11).all()),
     "randint_like": (lambda: paddle.randint_like(paddle.zeros([N]), 0, 5),
                      lambda a: (a >= 0).all() and (a < 5).all()),
+    "randint_like_int32": (
+        lambda: paddle.randint_like(
+            paddle.zeros([N]).astype("int32"), 0, 5),
+        lambda a: (a >= 0).all() and (a < 5).all()),
     "bernoulli": (lambda: paddle.bernoulli(paddle.full([N], 0.3)),
                   lambda a: (abs(a.mean() - 0.3) < 0.02
                              and set(np.unique(a)) <= {0.0, 1.0})),
