@@ -213,3 +213,47 @@ def test_uncleared_grads_raise():
     cap = paddle.jit.capture_step(step, models=net, optimizers=opt)
     with pytest.raises(RuntimeError, match="clear_grad"):
         cap(x, y)
+
+
+def test_captured_step_with_o2_master_weights():
+    """capture_step over an amp.decorate(O2) model: bf16 working params,
+    f32 masters threaded through the compiled step, sub-bf16-resolution
+    updates accumulate in the master."""
+    import jax.numpy as jnp
+
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    net = paddle.amp.decorate(net, level="O2", dtype="bfloat16")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=net.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=256.0)
+    x, y = _data(11)
+
+    def step(x, y):
+        with paddle.amp.auto_cast(level="O2"):
+            loss = F.mse_loss(net(x).astype("float32"), y)
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        scaler.update()
+        opt.clear_grad()
+        return loss
+
+    cap = paddle.jit.capture_step(step, models=net, optimizers=opt,
+                                  scalers=scaler)
+    masters0 = {k: np.asarray(p._master_weight).copy()
+                for k, p in net.named_parameters()
+                if getattr(p, "_master_weight", None) is not None}
+    assert masters0, "O2 decorate must create masters"
+    l0 = float(cap(x, y).numpy())
+    for _ in range(4):
+        l1 = float(cap(x, y).numpy())
+    assert l1 < l0, (l0, l1)
+    for k, p in net.named_parameters():
+        m = getattr(p, "_master_weight", None)
+        if m is None:
+            continue
+        assert m.dtype == jnp.float32
+        assert not np.array_equal(np.asarray(m), masters0[k]), k
+        # working copy tracks the master's bf16 cast
+        np.testing.assert_array_equal(
+            np.asarray(p._data.astype(jnp.float32)),
+            np.asarray(m.astype(jnp.bfloat16).astype(jnp.float32)), k)
