@@ -37,7 +37,8 @@ from . import checkpoint  # noqa: E402,F401
 from . import fleet  # noqa: E402,F401
 from . import rpc  # noqa: E402,F401
 from . import sharding  # noqa: E402,F401
-from .checkpoint import load_state_dict, save_state_dict  # noqa: E402,F401
+from .checkpoint import (  # noqa: E402,F401
+    clear_async_save_task_queue, load_state_dict, save_state_dict)
 from .fleet.layers.mpu.mp_ops import split  # noqa: E402,F401
 from . import launch  # noqa: E402,F401
 from .auto_parallel.api import (  # noqa: E402,F401

@@ -12,6 +12,8 @@ sharding IS the shard plan — no per-strategy save logic), and load rebuilds
 arrays with jax.make_array_from_single_device_arrays, letting any target
 NamedSharding drive the re-layout.
 """
-from .api import load_state_dict, save_state_dict  # noqa: F401
+from .api import (  # noqa: F401
+    clear_async_save_task_queue, load_state_dict, save_state_dict)
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict",
+           "clear_async_save_task_queue"]

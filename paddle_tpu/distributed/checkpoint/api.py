@@ -18,7 +18,8 @@ import re
 import numpy as np
 import jax
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict",
+           "clear_async_save_task_queue"]
 
 
 def _flatten(state_dict, prefix=""):
@@ -66,7 +67,8 @@ def _offset_of(idx):
 
 
 def save_state_dict(state_dict, path, process_group=None,
-                    coordinator_rank=0, unique_id=None, keep=2):
+                    coordinator_rank=0, unique_id=None, keep=2,
+                    async_save=False):
     """Write every rank's local shards + a global metadata file.
 
     state_dict: (nested) dict of Tensor / jax.Array / numpy.  Works for
@@ -81,6 +83,11 @@ def save_state_dict(state_dict, path, process_group=None,
     number) because directory scans on skewed ranks can disagree — the
     reference solves the same problem by all_gather'ing the id
     (reference python/paddle/distributed/checkpoint/save_state_dict.py).
+
+    async_save=True (reference save_state_dict async_save): device->host
+    copies happen synchronously (training may mutate the arrays right
+    after this returns), then file writes run on a background task —
+    wait with clear_async_save_task_queue().
     """
     os.makedirs(path, exist_ok=True)
     rank = _process_rank()
@@ -90,11 +97,16 @@ def save_state_dict(state_dict, path, process_group=None,
                 "save_state_dict: multi-process saves must pass a shared "
                 "unique_id (e.g. the global step) — auto-assignment by "
                 "directory scan races across skewed ranks")
-        uids = _existing_uids(path)
+        uids = set(_existing_uids(path))
+        # in-flight async saves haven't written metadata yet: their uids
+        # must count too or back-to-back async saves collide on files
+        uids |= _issued_uids.get(os.path.abspath(path), set())
         unique_id = (max(uids) + 1) if uids else 0
+    _issued_uids.setdefault(os.path.abspath(path), set()).add(unique_id)
     flat = _flatten(state_dict)
     meta = {"tensors": {}}
     n_files = 0
+    pending_writes = []
     for name, val in flat.items():
         arr = _to_jax_array(val)
         shards_meta = []
@@ -126,7 +138,15 @@ def save_state_dict(state_dict, path, process_group=None,
                 # recorded tensor dtype restores the view on load)
                 local = local.view(np.uint16)
             fname = f"{unique_id}.{rank}_{n_files}.npy"
-            np.save(os.path.join(path, fname), local)
+            if async_save:
+                # force a real host copy: on the CPU backend np.asarray can
+                # alias the device buffer, which a donated train step would
+                # overwrite mid-write
+                pending_writes.append((fname, np.array(local, copy=True)))
+            else:
+                # sync path streams each shard straight to disk (buffering
+                # the whole checkpoint would double peak host memory)
+                np.save(os.path.join(path, fname), local)
             n_files += 1
             shards_meta.append({
                 "offset": list(offset),
@@ -138,16 +158,61 @@ def save_state_dict(state_dict, path, process_group=None,
             "dtype": str(arr.dtype),
             "shards": shards_meta,
         }
-    # each rank writes its OWN metadata file (no write races); load merges
-    # them all — the per-rank shard lists are disjoint by offset
-    tmp = os.path.join(path, f".metadata_{unique_id}.{rank}.json.tmp")
-    with open(tmp, "w") as f:
-        json.dump(meta, f)
-    os.replace(tmp,
-               os.path.join(path, f"metadata_{unique_id}.{rank}.json"))
-    if rank == coordinator_rank and keep is not None:
-        _prune_old_versions(path, unique_id, keep)
+    def _write():
+        for fname, local in pending_writes:
+            np.save(os.path.join(path, fname), local)
+        # metadata LAST: its presence marks the version complete for load
+        # (each rank writes its OWN file — no write races; load merges)
+        tmp = os.path.join(path, f".metadata_{unique_id}.{rank}.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp,
+                   os.path.join(path, f"metadata_{unique_id}.{rank}.json"))
+        if rank == coordinator_rank and keep is not None:
+            _prune_old_versions(path, unique_id, keep)
+
+    if async_save:
+        import threading
+
+        box = {"error": None}
+
+        def _guarded():
+            try:
+                _write()
+            except BaseException as e:   # surfaced by clear_...
+                box["error"] = e
+
+        t = threading.Thread(target=_guarded, daemon=True,
+                             name=f"ckpt-save-{unique_id}")
+        t._error_box = box
+        t.start()
+        _async_save_queue.append(t)
+        return unique_id
+    _write()
     return unique_id
+
+
+_async_save_queue = []
+_issued_uids: dict = {}
+
+
+def clear_async_save_task_queue(timeout=60.0):
+    """Wait until every in-flight async save finishes; a failed background
+    write re-raises HERE (reference clear_async_save_task_queue + its
+    exitcode check) so a broken checkpoint can never pass silently."""
+    while _async_save_queue:
+        t = _async_save_queue.pop()
+        if t.is_alive():
+            t.join(timeout=timeout)
+            if t.is_alive():
+                _async_save_queue.append(t)
+                raise TimeoutError(
+                    f"async checkpoint save {t.name} still running after "
+                    f"{timeout}s")
+        err = getattr(t, "_error_box", {}).get("error")
+        if err is not None:
+            raise RuntimeError(
+                f"async checkpoint save {t.name} failed") from err
 
 
 def _prune_old_versions(path, current_uid, keep):

@@ -143,3 +143,56 @@ def test_bfloat16_roundtrip(tmp_path):
     load_state_dict(tgt, str(tmp_path))
     np.testing.assert_array_equal(np.asarray(tgt["w"].astype(jnp.float32)),
                                   np.asarray(w.astype(jnp.float32)))
+
+
+def test_async_save_roundtrip(tmp_path):
+    """async_save: device->host copies are synchronous, writes land on a
+    background task; after clear_async_save_task_queue the checkpoint
+    loads bit-identically (reference async_save contract)."""
+    from paddle_tpu.distributed.checkpoint import (
+        clear_async_save_task_queue)
+
+    state = {"w": jnp.arange(512, dtype=jnp.float32).reshape(16, 32),
+             "b": jnp.ones((32,), jnp.bfloat16)}
+    uid = save_state_dict(dict(state), str(tmp_path), async_save=True)
+    clear_async_save_task_queue()
+    target = {"w": jnp.zeros((16, 32), jnp.float32),
+              "b": jnp.zeros((32,), jnp.bfloat16)}
+    load_state_dict(target, str(tmp_path))
+    np.testing.assert_array_equal(np.asarray(target["w"]),
+                                  np.asarray(state["w"]))
+    assert uid == 0
+
+
+def test_async_save_surfaces_write_errors_and_uid_race(tmp_path):
+    from paddle_tpu.distributed.checkpoint import (
+        clear_async_save_task_queue)
+
+    # back-to-back async saves without draining must get distinct uids
+    state = {"w": jnp.ones((8, 8), jnp.float32)}
+    u1 = save_state_dict(dict(state), str(tmp_path), async_save=True)
+    u2 = save_state_dict(dict(state), str(tmp_path), async_save=True)
+    assert u1 != u2
+    clear_async_save_task_queue()
+
+    # a failing background write re-raises at the drain point
+    import pytest
+
+    bad = tmp_path / "as_file"
+    bad.write_text("not a dir")
+    save_state_dict(dict(state), str(bad / "sub"), async_save=False) \
+        if False else None
+    # make the write fail after thread start: save into a path whose dir we
+    # replace with a file before the thread writes metadata is racy; instead
+    # patch np.save to raise
+    import numpy as _np
+
+    import paddle_tpu.distributed.checkpoint.api as api
+    orig = api.np.save
+    api.np.save = lambda *a, **k: (_ for _ in ()).throw(OSError("disk full"))
+    try:
+        save_state_dict(dict(state), str(tmp_path), async_save=True)
+        with pytest.raises(RuntimeError, match="failed"):
+            clear_async_save_task_queue()
+    finally:
+        api.np.save = orig
