@@ -178,10 +178,24 @@ def _bwd_exec(vjp_treedef):
         return jax.jit(lambda vjp_fn, cts: vjp_fn(cts))
 
 
-def run_backward_op(vjp_fn, cotangents):
-    """Run a cached compiled backward program for a recorded vjp closure."""
+def run_backward_op(vjp_fn, cotangents, cache_key=None):
+    """Run a cached compiled backward program for a recorded vjp closure.
+
+    cache_key: the forward executable's identity (stashed on the GradNode)
+    — same forward program => same vjp jaxpr, so the flatten-for-treedef
+    walk is skipped on the hot path."""
+    if cache_key is not None:
+        exe = _bwd_by_fwd_cache.get(cache_key)
+        if exe is None:
+            _, treedef = jax.tree_util.tree_flatten(vjp_fn)
+            exe = _bwd_exec(treedef)
+            _bwd_by_fwd_cache[cache_key] = exe
+        return exe(vjp_fn, cotangents)
     _, treedef = jax.tree_util.tree_flatten(vjp_fn)
     return _bwd_exec(treedef)(vjp_fn, cotangents)
+
+
+_bwd_by_fwd_cache: dict = {}
 
 
 def _is_tensor(x):
@@ -262,7 +276,9 @@ def apply(op_name: str, fn: Callable, tensor_args: Sequence[Any],
         if not grad_on:
             out = _plain_exec(fn, static_items)(*arrays)
             vjp_fn = None
+            fwd_key = None
         else:
+            fwd_key = (_fn_key(fn), static_items, mask)
             out, vjp_fn = _fwd_vjp_exec(fn, static_items, mask)(*arrays)
     except RuntimeError as e:
         # reference enforce.h policy: prefix the failing operator and append
@@ -290,6 +306,7 @@ def apply(op_name: str, fn: Callable, tensor_args: Sequence[Any],
 
     if grad_on:
         node = GradNode(op_name, vjp_fn, mask, parents, out_tensors)
+        node.bwd_key = fwd_key
         # functional-replay record for higher-order grad: parents feed their
         # positions at replay time; everything else is a baked constant
         node.replay = (

@@ -24,13 +24,14 @@ class GradNode:
 
     __slots__ = (
         "op_name", "vjp_fn", "mask", "parents", "out_meta", "_hooks",
-        "released", "replay", "__weakref__",
+        "released", "replay", "bwd_key", "__weakref__",
     )
 
     def __init__(self, op_name, vjp_fn, mask, parents, out_tensors):
         self.op_name = op_name
         self.vjp_fn = vjp_fn
         self.mask = mask                # which positional inputs are differentiable
+        self.bwd_key = None
         # Keep refs to differentiable parent tensors (leaf accumulation needs
         # identity); mirrors GradNodeBase edges + TensorWrapper retention.
         self.parents = [p if (p is not None and m) else None
@@ -217,7 +218,8 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
             in_grads = custom(cotangents)
         else:
             from .dispatch import run_backward_op
-            in_grads = run_backward_op(node.vjp_fn, cotangents)
+            in_grads = run_backward_op(node.vjp_fn, cotangents,
+                                       getattr(node, "bwd_key", None))
 
         for hook in node._hooks:
             res = hook(in_grads)

@@ -149,7 +149,7 @@ class Optimizer:
         # jit.capture_step threads the lr in as a dynamic input so schedulers
         # stepped between captured calls take effect without retracing
         ovr = getattr(self, "_lr_override", None)
-        lr = ovr if ovr is not None else jnp.asarray(self.get_lr(), jnp.float32)
+        lr = ovr if ovr is not None else np.float32(self.get_lr())
         slot_names = tuple(self._slot_names())
 
         # AMP O2: params decorated with a float32 master copy update in f32
