@@ -25,7 +25,10 @@ stepped BETWEEN captured calls.
 Contract (enforced with clear errors):
 - the step function must not materialize tensors (``.numpy()``, ``float()``,
   ``if tensor:``) — that is a host sync inside the compiled program;
-- gradients must be cleared inside the step (``opt.clear_grad()``);
+- gradients must be cleared inside the step (``opt.clear_grad()``) —
+  unless ``grad_accumulation=True``, which threads gradients through the
+  program so an accumulate-only fn and an update fn (two captured steps
+  over the same objects) implement the every-k pattern;
 - optimizers whose update depends on host-side per-step state (NAdam's
   mu-product, RAdam's rho branch) are rejected; the Adam/AdamW family,
   SGD, Momentum, Adamax, Lamb and ASGD are supported.
@@ -53,12 +56,13 @@ class CapturedStep:
     """A user train-step function compiled as one XLA program."""
 
     def __init__(self, fn, models=None, optimizers=None, scalers=None,
-                 donate=True):
+                 donate=True, grad_accumulation=False):
         self._fn = fn
         self._models = _as_list(models)
         self._optimizers = _as_list(optimizers)
         self._scalers = _as_list(scalers)
         self._donate = donate
+        self._grad_accum = bool(grad_accumulation)
         self._compiled = None
         self._rng_draws = 0
 
@@ -101,8 +105,15 @@ class CapturedStep:
 
     # -- state gather/scatter ------------------------------------------------
     def _gather_state(self):
+        import jax.numpy as jnp
         donated = {
             "params": [p._data for p in self._params],
+            # grad-accumulation mode threads gradients through the program
+            # (zeros when cleared) so `backward(); every k: step()` splits
+            # into two captured fns sharing the same accumulated state
+            "grads": [] if not self._grad_accum else [
+                p._grad._data if p._grad is not None
+                else jnp.zeros_like(p._data) for p in self._params],
             "masters": [p._master_weight for p in self._params
                         if getattr(p, "_master_weight", None) is not None],
             "buffers": [b._data for b in self._buffers],
@@ -139,6 +150,10 @@ class CapturedStep:
         }
         for p, arr in zip(self._params, donated["params"]):
             p._data = arr
+        if self._grad_accum:
+            from ..core.tensor import Tensor
+            for p, arr in zip(self._params, donated["grads"]):
+                p._grad = Tensor(arr)
         mi = 0
         for p in self._params:
             if getattr(p, "_master_weight", None) is not None:
@@ -158,8 +173,12 @@ class CapturedStep:
         return saved
 
     def _collect_new(self):
+        import jax.numpy as jnp
         new = {
             "params": [p._data for p in self._params],
+            "grads": [] if not self._grad_accum else [
+                p._grad._data if p._grad is not None
+                else jnp.zeros_like(p._data) for p in self._params],
             "masters": [p._master_weight for p in self._params
                         if getattr(p, "_master_weight", None) is not None],
             "buffers": [b._data for b in self._buffers],
@@ -167,7 +186,8 @@ class CapturedStep:
                       for oi, p, n in self._slot_index],
             "scalers": [list(s._end_capture()) for s in self._scalers],
         }
-        dirty = [p.name for p in self._params if p._grad is not None]
+        dirty = [] if self._grad_accum else [
+            p.name for p in self._params if p._grad is not None]
         if dirty:
             raise RuntimeError(
                 "capture_step: gradients still set after the step for "
@@ -254,6 +274,10 @@ class CapturedStep:
         # write results back into the live objects
         for p, arr in zip(self._params, new_state["params"]):
             p._data = arr
+        if self._grad_accum:
+            from ..core.tensor import Tensor
+            for p, arr in zip(self._params, new_state["grads"]):
+                p._grad = Tensor(arr)
         mi = 0
         for p in self._params:
             if getattr(p, "_master_weight", None) is not None:
@@ -272,7 +296,7 @@ class CapturedStep:
 
 
 def capture_step(fn=None, *, models=None, optimizers=None, scalers=None,
-                 donate=True):
+                 donate=True, grad_accumulation=False):
     """Compile a dygraph train-step function into one XLA program.
 
     Decorator or direct form::
@@ -284,6 +308,8 @@ def capture_step(fn=None, *, models=None, optimizers=None, scalers=None,
     """
     if fn is None:
         def deco(f):
-            return CapturedStep(f, models, optimizers, scalers, donate)
+            return CapturedStep(f, models, optimizers, scalers, donate,
+                                grad_accumulation)
         return deco
-    return CapturedStep(fn, models, optimizers, scalers, donate)
+    return CapturedStep(fn, models, optimizers, scalers, donate,
+                        grad_accumulation)

@@ -257,3 +257,51 @@ def test_captured_step_with_o2_master_weights():
         np.testing.assert_array_equal(
             np.asarray(p._data.astype(jnp.float32)),
             np.asarray(m.astype(jnp.bfloat16).astype(jnp.float32)), k)
+
+
+def test_grad_accumulation_two_captured_fns():
+    """grad_accumulation=True: `backward()`-only and `backward+step+clear`
+    compile as two captured fns sharing threaded gradient state, matching
+    the eager accumulate-every-k loop exactly."""
+    x, y = _data(13)
+    x2 = paddle.to_tensor(np.asarray(x.numpy()[::-1].copy()))
+
+    def make(seed):
+        net = _mlp(seed)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        return net, opt
+
+    # eager reference: accumulate over 2 batches then step
+    net_e, opt_e = make(21)
+    for xb in (x, x2):
+        loss = F.mse_loss(net_e(xb), y)
+        (loss * 0.5).backward()
+    opt_e.step()
+    opt_e.clear_grad()
+
+    net_c, opt_c = make(21)
+
+    def accum(xb, y):
+        loss = F.mse_loss(net_c(xb), y)
+        (loss * 0.5).backward()
+        return loss
+
+    def update(xb, y):
+        loss = F.mse_loss(net_c(xb), y)
+        (loss * 0.5).backward()
+        opt_c.step()
+        opt_c.clear_grad()
+        return loss
+
+    cap_a = paddle.jit.capture_step(accum, models=net_c, optimizers=opt_c,
+                                    grad_accumulation=True)
+    cap_u = paddle.jit.capture_step(update, models=net_c, optimizers=opt_c,
+                                    grad_accumulation=True)
+    cap_a(x, y)
+    cap_u(x2, y)
+
+    for (k, p1), (_, p2) in zip(net_e.named_parameters(),
+                                net_c.named_parameters()):
+        np.testing.assert_allclose(p2.numpy(), p1.numpy(), rtol=2e-5,
+                                   atol=1e-6, err_msg=k)
