@@ -1,8 +1,39 @@
-import sys
+"""CLI: python -m paddle_tpu.codegen [--check] [--bootstrap]
 
-from .generate import generate_all
+Loads the generator WITHOUT importing the paddle_tpu package __init__ —
+regeneration must work even when the committed generated artifacts are
+stale or missing (math.py re-imports them at package import time).
+"""
+import importlib.util
+import pathlib
+import sys
+import types
+
+
+def _load_generator():
+    root = pathlib.Path(__file__).resolve().parent.parent.parent
+    if "paddle_tpu" in sys.modules and hasattr(sys.modules["paddle_tpu"],
+                                               "__version__"):
+        from .generate import generate_all
+        return generate_all
+    pkg = types.ModuleType("paddle_tpu")
+    pkg.__path__ = [str(root / "paddle_tpu")]
+    sys.modules.setdefault("paddle_tpu", pkg)
+    sub = types.ModuleType("paddle_tpu.codegen")
+    sub.__path__ = [str(root / "paddle_tpu" / "codegen")]
+    sys.modules.setdefault("paddle_tpu.codegen", sub)
+    for name in ("schema", "generate"):
+        spec = importlib.util.spec_from_file_location(
+            f"paddle_tpu.codegen.{name}",
+            root / "paddle_tpu" / "codegen" / f"{name}.py")
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[f"paddle_tpu.codegen.{name}"] = mod
+        spec.loader.exec_module(mod)
+    return sys.modules["paddle_tpu.codegen.generate"].generate_all
+
 
 if __name__ == "__main__":
+    generate_all = _load_generator()
     if "--bootstrap" in sys.argv:
         from .bootstrap import main as bootstrap_main
         bootstrap_main()

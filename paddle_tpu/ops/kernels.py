@@ -242,3 +242,96 @@ def reduce_as(x, target):
         nlead + i for i, d in enumerate(target.shape)
         if x.shape[nlead + i] != d)
     return jnp.sum(x, axis=axes, keepdims=False).reshape(target.shape)
+
+
+# -- reductions / scans (tranche 2) -----------------------------------------
+# NOTE: several names shadow python builtins at THIS module's top level
+# (sum/max/min/all/any). Do not call bare builtins below — use builtins.*
+# (the shadowing bug class caught twice by the op sweep).
+
+def _axis_t(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    import numpy as _np
+    dt = _np.dtype(dtype) if dtype is not None else None
+    if dt is None and jnp.issubdtype(x.dtype, jnp.bool_):
+        dt = jnp.int64
+    return jnp.sum(x, axis=_axis_t(axis), keepdims=keepdim, dtype=dt)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.nansum(x, axis=_axis_t(axis), keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_axis_t(axis), keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_axis_t(axis), keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=_axis_t(axis), keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis_t(axis), keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis_t(axis), keepdims=keepdim)
+
+
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis_t(axis), keepdims=keepdim)
+
+
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis_t(axis), keepdims=keepdim)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    a = None if axis is None else int(axis)
+    return jnp.argmax(x, axis=a, keepdims=keepdim).astype(jnp.int64)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    a = None if axis is None else int(axis)
+    return jnp.argmin(x, axis=a, keepdims=keepdim).astype(jnp.int64)
+
+
+def all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=_axis_t(axis), keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=_axis_t(axis), keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_axis_t(axis),
+                                       keepdims=keepdim)
+
+
+def cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        return jnp.cumsum(x.ravel())
+    return jnp.cumsum(x, axis=int(axis))
+
+
+def cumprod(x, dim=None, dtype=None):
+    if dim is None:
+        return jnp.cumprod(x.ravel())
+    return jnp.cumprod(x, axis=int(dim))
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_axis_t(axis),
+                             keepdims=keepdim).astype(jnp.int64)

@@ -102,88 +102,6 @@ def multiply_(x, y, name=None):
 
 # ---------------- reductions ----------------
 
-def _sum_impl(x, axis, keepdim, dtype):
-    dt = np.dtype(dtype) if dtype is not None else None
-    if dt is None and jnp.issubdtype(x.dtype, jnp.bool_):
-        dt = jnp.int64
-    return jnp.sum(x, axis=axis, keepdims=keepdim, dtype=dt)
-
-
-def sum(x, axis=None, dtype=None, keepdim=False, name=None):
-    dt = str(to_jax_dtype(convert_dtype(dtype))) if dtype is not None else None
-    return D.apply("sum", _sum_impl, (x,),
-                   {"axis": _axis(axis), "keepdim": bool(keepdim), "dtype": dt})
-
-
-def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
-    return D.apply("nansum",
-                   lambda a, axis, keepdim: jnp.nansum(a, axis=axis, keepdims=keepdim),
-                   (x,), {"axis": _axis(axis), "keepdim": bool(keepdim)})
-
-
-def mean(x, axis=None, keepdim=False, name=None):
-    return D.apply("mean", lambda a, axis, keepdim: jnp.mean(a, axis=axis, keepdims=keepdim),
-                   (x,), {"axis": _axis(axis), "keepdim": bool(keepdim)})
-
-
-def nanmean(x, axis=None, keepdim=False, name=None):
-    return D.apply("nanmean", lambda a, axis, keepdim: jnp.nanmean(a, axis=axis, keepdims=keepdim),
-                   (x,), {"axis": _axis(axis), "keepdim": bool(keepdim)})
-
-
-def prod(x, axis=None, keepdim=False, dtype=None, name=None):
-    return D.apply("prod", lambda a, axis, keepdim: jnp.prod(a, axis=axis, keepdims=keepdim),
-                   (x,), {"axis": _axis(axis), "keepdim": bool(keepdim)})
-
-
-def max(x, axis=None, keepdim=False, name=None):
-    return D.apply("max", lambda a, axis, keepdim: jnp.max(a, axis=axis, keepdims=keepdim),
-                   (x,), {"axis": _axis(axis), "keepdim": bool(keepdim)})
-
-
-def min(x, axis=None, keepdim=False, name=None):
-    return D.apply("min", lambda a, axis, keepdim: jnp.min(a, axis=axis, keepdims=keepdim),
-                   (x,), {"axis": _axis(axis), "keepdim": bool(keepdim)})
-
-
-amax = max
-amin = min
-
-
-def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
-    return D.apply("argmax",
-                   lambda a, axis, keepdim: jnp.argmax(a, axis=axis, keepdims=keepdim).astype(jnp.int64),
-                   (x,), {"axis": None if axis is None else int(axis), "keepdim": bool(keepdim)})
-
-
-def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
-    return D.apply("argmin",
-                   lambda a, axis, keepdim: jnp.argmin(a, axis=axis, keepdims=keepdim).astype(jnp.int64),
-                   (x,), {"axis": None if axis is None else int(axis), "keepdim": bool(keepdim)})
-
-
-def all(x, axis=None, keepdim=False, name=None):
-    return D.apply("all", lambda a, axis, keepdim: jnp.all(a, axis=axis, keepdims=keepdim),
-                   (x,), {"axis": _axis(axis), "keepdim": bool(keepdim)})
-
-
-def any(x, axis=None, keepdim=False, name=None):
-    return D.apply("any", lambda a, axis, keepdim: jnp.any(a, axis=axis, keepdims=keepdim),
-                   (x,), {"axis": _axis(axis), "keepdim": bool(keepdim)})
-
-
-def logsumexp(x, axis=None, keepdim=False, name=None):
-    return D.apply("logsumexp",
-                   lambda a, axis, keepdim: jax.scipy.special.logsumexp(a, axis=axis, keepdims=keepdim),
-                   (x,), {"axis": _axis(axis), "keepdim": bool(keepdim)})
-
-
-def count_nonzero(x, axis=None, keepdim=False, name=None):
-    return D.apply("count_nonzero",
-                   lambda a, axis, keepdim: jnp.count_nonzero(a, axis=axis, keepdims=keepdim).astype(jnp.int64),
-                   (x,), {"axis": _axis(axis), "keepdim": bool(keepdim)})
-
-
 def std(x, axis=None, unbiased=True, keepdim=False, name=None):
     return D.apply("std",
                    lambda a, axis, ddof, keepdim: jnp.std(a, axis=axis, ddof=ddof, keepdims=keepdim),
@@ -280,22 +198,6 @@ def numel(x, name=None):
 
 
 # ---------------- scans ----------------
-
-def cumsum(x, axis=None, dtype=None, name=None):
-    def _cumsum(a, axis):
-        if axis is None:
-            return jnp.cumsum(a.ravel())
-        return jnp.cumsum(a, axis=axis)
-    return D.apply("cumsum", _cumsum, (x,), {"axis": None if axis is None else int(axis)})
-
-
-def cumprod(x, dim=None, dtype=None, name=None):
-    def _cumprod(a, axis):
-        if axis is None:
-            return jnp.cumprod(a.ravel())
-        return jnp.cumprod(a, axis=axis)
-    return D.apply("cumprod", _cumprod, (x,), {"axis": None if dim is None else int(dim)})
-
 
 def _cum_extreme(fn):
     def impl(a, axis):
@@ -462,6 +364,8 @@ def combinations(x, r=2, with_replacement=False, name=None):
 # keeps working for callers and the Tensor dunder bindings.
 # ---------------------------------------------------------------------------
 from .generated.op_wrappers import (  # noqa: E402,F401
+    sum, nansum, mean, nanmean, prod, max, min, amax, amin, argmax,
+    argmin, all, any, logsumexp, cumsum, cumprod, count_nonzero,
     abs, neg, exp, expm1, log, log2, log10, log1p, sqrt, rsqrt, square,
     sin, cos, tan, asin, acos, atan, sinh, cosh, asinh, acosh, atanh, tanh,
     floor, ceil, round, trunc, frac, sign, sgn, reciprocal, erf, erfinv,
