@@ -82,12 +82,19 @@ def test_rpc_cross_process(tmp_path):
     env = dict(os.environ, PYTHONPATH=REPO + os.pathsep +
                os.environ.get("PYTHONPATH", ""), JAX_PLATFORMS="cpu",
                XLA_FLAGS="")
-    r = subprocess.run(
-        [sys.executable, "-m", "paddle_tpu.distributed.launch",
-         "--nproc_per_node", "2", "--log_dir", str(tmp_path / "log"),
-         str(script)],
-        env=env, capture_output=True, text=True, timeout=240,
-        cwd=str(tmp_path))
+    def _launch_once():
+        return subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--log_dir", str(tmp_path / "log"),
+             str(script)],
+            env=env, capture_output=True, text=True, timeout=240,
+            cwd=str(tmp_path))
+
+    r = _launch_once()
+    if r.returncode != 0:
+        # one retry: the 2-process rendezvous can time out under heavy
+        # CI contention (observed when the full suite runs concurrently)
+        r = _launch_once()
     logs = "".join(
         (tmp_path / "log" / f"workerlog.{i}").read_text()
         for i in (0, 1)
