@@ -161,6 +161,7 @@ def save_state_dict(state_dict, path, process_group=None,
     def _write():
         for fname, local in pending_writes:
             np.save(os.path.join(path, fname), local)
+        _issued_uids.get(os.path.abspath(path), set()).discard(unique_id)
         # metadata LAST: its presence marks the version complete for load
         # (each rank writes its OWN file — no write races; load merges)
         tmp = os.path.join(path, f".metadata_{unique_id}.{rank}.json.tmp")
@@ -181,6 +182,10 @@ def save_state_dict(state_dict, path, process_group=None,
                 _write()
             except BaseException as e:   # surfaced by clear_...
                 box["error"] = e
+            finally:
+                # in-flight set holds only unwritten uids
+                _issued_uids.get(os.path.abspath(path),
+                                 set()).discard(unique_id)
 
         t = threading.Thread(target=_guarded, daemon=True,
                              name=f"ckpt-save-{unique_id}")

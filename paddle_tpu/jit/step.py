@@ -193,7 +193,8 @@ class CapturedStep:
                 "capture_step: gradients still set after the step for "
                 f"{dirty[:3]}{'...' if len(dirty) > 3 else ''} — call "
                 "optimizer.clear_grad() inside the captured function "
-                "(grad accumulation across captured steps is not supported)")
+                "— or pass grad_accumulation=True to capture_step to thread "
+                "accumulated gradients through the program")
         # slots created mid-trace (a param unfrozen after construction)
         # would be trace-local tracers invisible to the state threading
         n_slots = sum(len(st) for opt in self._optimizers

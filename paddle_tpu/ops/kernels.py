@@ -258,10 +258,14 @@ def _axis_t(axis):
 
 
 def sum(x, axis=None, dtype=None, keepdim=False):
-    import numpy as _np
-    dt = _np.dtype(dtype) if dtype is not None else None
-    if dt is None and jnp.issubdtype(x.dtype, jnp.bool_):
+    if dtype is not None:
+        # framework alias table ('float' -> float32, paddle dtype objects)
+        from ..core.dtype import convert_dtype, to_jax_dtype
+        dt = to_jax_dtype(convert_dtype(dtype))
+    elif jnp.issubdtype(x.dtype, jnp.bool_):
         dt = jnp.int64
+    else:
+        dt = None
     return jnp.sum(x, axis=_axis_t(axis), keepdims=keepdim, dtype=dt)
 
 
