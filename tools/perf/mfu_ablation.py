@@ -31,9 +31,12 @@ spec = json.loads(sys.argv[1])
 if not spec.get("flash", True):
     from paddle_tpu.core.flags import set_flags
     set_flags({"use_pallas_kernels": False})
-cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
-                  intermediate_size=2816, num_hidden_layers=24,
-                  num_attention_heads=16, num_key_value_heads=4,
+cfg = LlamaConfig(vocab_size=32000,
+                  hidden_size=spec.get("hidden", 1024),
+                  intermediate_size=spec.get("ffn", 2816),
+                  num_hidden_layers=24,
+                  num_attention_heads=spec.get("heads", 16),
+                  num_key_value_heads=spec.get("kv", 4),
                   max_position_embeddings=2048)
 hp = HybridParallelConfig(dp=1, pp=1, tp=1, num_microbatches=1,
                           remat=spec.get("remat", True),
@@ -76,12 +79,43 @@ LEVERS = [
     ("fa_block1024", {"env": {"PADDLE_TPU_FA_BLOCK_Q": "1024",
                               "PADDLE_TPU_FA_BLOCK_K": "1024"}}),
     ("xla_fallback_no_flash", {"flash": False, "batch": 4}),
+    # combination levers: xent chunking frees the f32 [b,s,32k] logits
+    # buffer, which is what OOMed no_remat_b4 in the first trail
+    ("no_remat_b4_xchunk512", {"remat": False, "batch": 4,
+                               "xent_chunk": 512}),
+    ("no_remat_b2_xchunk512", {"remat": False, "batch": 2,
+                               "xent_chunk": 512}),
+    ("remat_attn_b4", {"remat_policy": "attn", "batch": 4}),
+    ("remat_attn_b2", {"remat_policy": "attn", "batch": 2}),
+    # head_dim=128 config (~560M): the 350M config's d=64 contracts over
+    # half the MXU's 128 lanes inside the FA matmuls — this measures the
+    # MFU headroom from a lane-filling head layout (the 7B-class shape)
+    ("d128_560m_no_remat_b2", {"remat": False, "batch": 2, "hidden": 1280,
+                               "heads": 10, "kv": 5, "ffn": 3456}),
+    ("d128_560m_remat_attn_b4", {"remat_policy": "attn", "batch": 4,
+                                 "hidden": 1280, "heads": 10, "kv": 5,
+                                 "ffn": 3456}),
 ]
 
 
 def main():
+    # optional CLI lever subset: rerun only the named levers, merging into
+    # the existing MFU_ABLATION_r04.json instead of clobbering it
+    want = set(sys.argv[1:])
+    known = {t for t, _ in LEVERS}
+    if want - known:
+        sys.exit(f"unknown lever(s) {sorted(want - known)}; "
+                 f"choose from {sorted(known)}")
+    levers = [(t, s) for t, s in LEVERS if not want or t in want]
+    abl_path = os.path.join(REPO, "MFU_ABLATION_r04.json")
     results = {}
-    for tag, spec in LEVERS:
+    if want:
+        try:
+            results = json.load(open(abl_path)).get("levers", {})
+        except Exception:
+            pass
+    ran = []                       # only THESE get appended to the history
+    for tag, spec in levers:
         env = dict(os.environ)
         env.update(spec.pop("env", {}))
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -100,16 +134,19 @@ def main():
         except Exception as e:   # bad stdout etc. — keep the trail alive
             results[tag] = {"error": f"{type(e).__name__}: {e}"[:400]}
         results[tag]["wall_s"] = round(time.time() - t0, 1)
+        ran.append(tag)
         print(tag, json.dumps(results[tag]), flush=True)
 
-    # append the trail to bench_history.json (tagged ablation records)
+    # append ONLY this invocation's runs to bench_history.json: preloaded
+    # results from a prior grid must not reappear as fresh records
     hist_path = os.path.join(REPO, "bench_history.json")
     try:
         history = json.load(open(hist_path))
     except Exception:
         history = []
     stamp = time.strftime("%Y-%m-%dT%H:%M:%S")
-    for tag, rec in results.items():
+    for tag in ran:
+        rec = results[tag]
         if "tokens_per_sec" in rec:
             history.append({"tokens_per_sec": rec["tokens_per_sec"],
                             "reps": rec["reps"], "mfu": rec["mfu"],
@@ -122,11 +159,10 @@ def main():
     with open(tmp, "w") as f:
         json.dump(history, f, indent=1)
     os.replace(tmp, hist_path)
-    abl = os.path.join(REPO, "MFU_ABLATION_r04.json")
-    with open(abl + ".tmp", "w") as f:
+    with open(abl_path + ".tmp", "w") as f:
         json.dump({"round": 4, "time": stamp, "levers": results}, f,
                   indent=1)
-    os.replace(abl + ".tmp", abl)
+    os.replace(abl_path + ".tmp", abl_path)
     print("written MFU_ABLATION_r04.json")
 
 
