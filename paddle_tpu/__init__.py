@@ -18,6 +18,19 @@ import warnings as _warnings
 
 import jax as _jax  # noqa: F401
 
+# Honor a caller's JAX_PLATFORMS pin at the CONFIG level before any backend
+# init: a hardware-plugin sitecustomize can install a get_backend hook for
+# which the env var alone does not prevent plugin client init, and that init
+# hangs when the device service is unreachable.  Same pattern as
+# tests/conftest.py and distributed/launch/main.py — this makes it hold for
+# ANY subprocess that imports the framework with the env var set.
+if _os.environ.get("JAX_PLATFORMS"):
+    try:
+        # full comma-separated value: "tpu,cpu" keeps its cpu fallback
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    except Exception:
+        pass
+
 _warnings.filterwarnings(
     "ignore", message="Explicitly requested dtype.*truncated", category=UserWarning)
 

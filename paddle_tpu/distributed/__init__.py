@@ -36,6 +36,7 @@ from .auto_parallel.process_mesh import ProcessMesh  # noqa: E402,F401
 from . import checkpoint  # noqa: E402,F401
 from . import fleet  # noqa: E402,F401
 from . import rpc  # noqa: E402,F401
+from . import ps  # noqa: E402,F401
 from . import sharding  # noqa: E402,F401
 from .checkpoint import (  # noqa: E402,F401
     clear_async_save_task_queue, load_state_dict, save_state_dict)

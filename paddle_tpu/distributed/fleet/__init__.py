@@ -6,6 +6,8 @@ GroupSharded).  Populated incrementally — see paddle_tpu/distributed/fleet/
 submodules.
 """
 from .base import DistributedStrategy, Fleet, fleet  # noqa: F401
+from .role_maker import (  # noqa: F401
+    PaddleCloudRoleMaker, Role, RoleMakerBase, UserDefinedRoleMaker)
 from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
 from . import layers  # noqa: F401
 from . import utils  # noqa: F401

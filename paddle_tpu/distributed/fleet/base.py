@@ -43,9 +43,30 @@ class Fleet:
         self._hcg = None
         self._strategy = None
         self._user_defined_optimizer = None
+        self._role_maker = None
+        self._ps_ctx = None
 
     def init(self, role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
         self._strategy = strategy or DistributedStrategy()
+        # parameter-server mode (reference fleet.py:151: a non-collective
+        # role maker selects the PS runtime, the_one_ps.py).  The ROLE
+        # MAKER drives the mode — the canonical reference call is
+        # fleet.init(PaddleCloudRoleMaker(is_collective=False)) with no
+        # second argument, so the is_collective parameter is only the
+        # fallback when the role maker doesn't say.
+        ps_mode = role_maker is not None \
+            and not getattr(role_maker, "_is_collective", is_collective)
+        if ps_mode:
+            from ..ps import init_ps
+            self._role_maker = role_maker
+            self._ps_ctx = init_ps(
+                role="server" if role_maker.is_server() else "worker",
+                index=(role_maker.server_index() if role_maker.is_server()
+                       else role_maker.worker_index()),
+                num_servers=role_maker.server_num(),
+                num_workers=role_maker.worker_num())
+            self._is_initialized = True
+            return self
         init_parallel_env()
         hc = self._strategy.hybrid_configs
         topo = CommunicateTopology(
@@ -58,13 +79,55 @@ class Fleet:
         return self
 
     def is_first_worker(self):
+        if self._role_maker is not None:
+            return self._role_maker.is_first_worker()
         return get_rank() == 0
 
     def worker_index(self):
+        if self._role_maker is not None:
+            return self._role_maker.worker_index()
         return get_rank()
 
     def worker_num(self):
+        if self._role_maker is not None:
+            return self._role_maker.worker_num()
         return get_world_size()
+
+    # -- parameter-server mode (reference fleet.py is_server/init_server/
+    #    run_server/init_worker/stop_worker over the_one_ps runtime) -------
+    def is_server(self):
+        return self._role_maker is not None and self._role_maker.is_server()
+
+    def is_worker(self):
+        return self._role_maker is None or self._role_maker.is_worker()
+
+    def server_num(self):
+        return self._role_maker.server_num() if self._role_maker else 0
+
+    def server_index(self):
+        return self._role_maker.server_index() if self._role_maker else 0
+
+    def init_server(self, dirname=None, **kwargs):
+        """Tables materialize on worker broadcast; a checkpoint dirname
+        (reference fleet.init_server(model_dir)) is recorded so the load
+        happens right after that broadcast creates them."""
+        if dirname:
+            from ..ps import server as ps_server
+            ps_server.set_pending_load(dirname)
+
+    def run_server(self):
+        """Serve until a worker calls stop_worker (blocks)."""
+        from ..rpc import shutdown
+        self._ps_ctx.server.run()
+        shutdown()
+
+    def init_worker(self, table_specs=None):
+        if table_specs:
+            self._ps_ctx.client.create_tables(table_specs)
+
+    @property
+    def ps_client(self):
+        return self._ps_ctx.client if self._ps_ctx else None
 
     def get_hybrid_communicate_group(self):
         return self._hcg
@@ -87,7 +150,9 @@ class Fleet:
         barrier()
 
     def stop_worker(self):
-        pass
+        if self._ps_ctx is not None:
+            from ..ps import stop_workers_and_servers
+            stop_workers_and_servers(self._ps_ctx)
 
 
 fleet = Fleet()

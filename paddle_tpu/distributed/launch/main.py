@@ -227,7 +227,7 @@ def _run_auto_tuner(args) -> dict | None:
     if plat:
         try:
             import jax
-            jax.config.update("jax_platforms", plat.split(",")[0])
+            jax.config.update("jax_platforms", plat)
         except Exception:
             pass
 

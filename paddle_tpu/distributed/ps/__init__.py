@@ -1,0 +1,129 @@
+"""Parameter-server training (reference paddle/fluid/distributed/ps/ —
+brpc PSClient/PSServer + dense/sparse tables, ~40k C++; python surface
+python/paddle/incubate/distributed/fleet + the_one_ps.py).
+
+TPU-native decomposition:
+
+- **Tables live in server host RAM** (`table.py`): the PS pattern exists
+  exactly because embedding spaces exceed accelerator memory; on TPU the
+  dense compute path owns HBM and the sparse rows stay host-side.
+- **Transport is the framework's own RPC layer** (`distributed/rpc`),
+  not brpc/protobuf: handlers are module-level functions resolved on the
+  server process (`server.py`), keys sharded id % num_servers
+  (`client.py`) like the reference's key-sharded brpc channels.
+- **Roles ride the launch env contract** (TRAINING_ROLE /
+  PADDLE_PSERVERS_IP_PORT_LIST / PADDLE_TRAINERS_NUM — the reference
+  PaddleCloudRoleMaker env names), rendezvous on the native TCPStore.
+- **The worker's dense compute stays jax**: `sparse_embedding` pulls
+  rows into a leaf Tensor whose gradient hook pushes back to the
+  servers — the eager analog of the reference's distributed lookup-table
+  op pair (pull on forward, push on backward).
+
+Process topology: servers are RPC workers ``ps:<i>`` (ranks 0..S-1),
+trainers are ``trainer:<j>`` (ranks S..S+W-1), one rendezvous world.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .. import rpc
+from .client import PSClient
+from .server import PSServer
+
+__all__ = ["PSClient", "PSServer", "PSContext", "init_ps",
+           "sparse_embedding", "stop_workers_and_servers"]
+
+
+class PSContext:
+    """What init_ps hands back: role + (client | server) handles."""
+
+    def __init__(self, role, index, num_servers, num_workers,
+                 client=None, srv=None):
+        self.role = role                    # "server" | "worker"
+        self.index = index                  # index within the role
+        self.num_servers = num_servers
+        self.num_workers = num_workers
+        self.client = client
+        self.server = srv
+
+    @property
+    def is_server(self):
+        return self.role == "server"
+
+
+def _env(name, default=None):
+    v = os.environ.get(name, default)
+    if v is None:
+        raise RuntimeError(f"PS mode needs env {name} "
+                           "(reference PaddleCloudRoleMaker contract)")
+    return v
+
+
+def init_ps(role=None, index=None, num_servers=None, num_workers=None,
+            master_endpoint=None):
+    """Join the PS world.  With no arguments, reads the reference's
+    PaddleCloudRoleMaker env contract: TRAINING_ROLE=PSERVER|TRAINER,
+    PADDLE_PSERVERS_IP_PORT_LIST, PADDLE_TRAINERS_NUM,
+    PADDLE_TRAINER_ID / PADDLE_PSERVER_ID."""
+    if role is None:
+        training_role = _env("TRAINING_ROLE").upper()
+        role = "server" if training_role == "PSERVER" else "worker"
+    if num_servers is None:
+        num_servers = len(_env("PADDLE_PSERVERS_IP_PORT_LIST").split(","))
+    if num_workers is None:
+        num_workers = int(_env("PADDLE_TRAINERS_NUM"))
+    if index is None:
+        index = int(_env("PADDLE_PSERVER_ID") if role == "server"
+                    else _env("PADDLE_TRAINER_ID"))
+    if master_endpoint is None:
+        master_endpoint = os.environ.get("PADDLE_MASTER_ENDPOINT") or \
+            _env("PADDLE_PSERVERS_IP_PORT_LIST").split(",")[0]
+
+    world = num_servers + num_workers
+    if role == "server":
+        name, rank = f"ps:{index}", index
+    else:
+        name, rank = f"trainer:{index}", num_servers + index
+    rpc.init_rpc(name, rank=rank, world_size=world,
+                 master_endpoint=master_endpoint)
+    if role == "server":
+        return PSContext(role, index, num_servers, num_workers,
+                         srv=PSServer(index))
+    return PSContext(role, index, num_servers, num_workers,
+                     client=PSClient(num_servers))
+
+
+def stop_workers_and_servers(ctx):
+    """Coordinated teardown (reference fleet.stop_worker +
+    STOP_SERVER message): workers barrier, worker 0 stops the servers,
+    then the whole world leaves through rpc.shutdown's barrier."""
+    from ..store import barrier_via_store
+
+    agent = rpc._require_agent()
+    barrier_via_store(agent.store, "ps/stop_workers", ctx.index,
+                      ctx.num_workers)
+    if ctx.index == 0:
+        ctx.client.stop_servers()
+    rpc.shutdown()
+
+
+def sparse_embedding(client, table_name, ids, stop_gradient=False):
+    """Distributed lookup: pull rows for ``ids`` into a leaf Tensor whose
+    gradient hook pushes the update back (reference
+    static.nn.sparse_embedding's pull/push op pair, eager form)."""
+    import jax.numpy as jnp
+
+    from ...core.tensor import Tensor
+
+    ids_np = np.asarray(ids._data if isinstance(ids, Tensor) else ids,
+                        np.int64).ravel()
+    rows = client.pull_sparse(table_name, ids_np)
+    t = Tensor(jnp.asarray(rows), stop_gradient=stop_gradient)
+    if not stop_gradient:
+        def _push(g):
+            client.push_sparse(table_name, ids_np,
+                               np.asarray(g._data, np.float32))
+        t.register_hook(_push)
+    return t

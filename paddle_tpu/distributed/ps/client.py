@@ -1,0 +1,128 @@
+"""PS client: sharded push/pull against the server fleet.
+
+Reference shape: paddle/fluid/distributed/ps/service/brpc_ps_client.{h,cc}
+— per-server channels, sparse keys sharded by id across servers, dense
+params assigned whole to one server, async push futures.  Same layout
+here: dense table -> server (stable hash of name), sparse row ->
+server (id % num_servers); async pushes ride rpc_async.
+"""
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+from .. import rpc
+from . import server as _srv
+
+__all__ = ["PSClient"]
+
+
+def server_name(i):
+    return f"ps:{i}"
+
+
+class PSClient:
+    def __init__(self, num_servers):
+        self.num_servers = int(num_servers)
+        if self.num_servers <= 0:
+            raise ValueError("PSClient needs >= 1 server")
+        self._specs = {}
+
+    # -- setup --------------------------------------------------------------
+    def create_tables(self, specs):
+        specs = list(specs)
+        for s in specs:
+            self._specs[s["name"]] = dict(s)
+        for i in range(self.num_servers):
+            rpc.rpc_sync(server_name(i), _srv._srv_create_tables, (specs,))
+
+    def _dense_home(self, name):
+        return zlib.crc32(name.encode()) % self.num_servers
+
+    # -- dense --------------------------------------------------------------
+    def pull_dense(self, name):
+        return rpc.rpc_sync(server_name(self._dense_home(name)),
+                            _srv._srv_pull_dense, (name,))
+
+    def push_dense(self, name, grad, blocking=True):
+        fut = rpc.rpc_async(server_name(self._dense_home(name)),
+                            _srv._srv_push_dense,
+                            (name, np.asarray(grad, np.float32)))
+        if blocking:
+            fut.wait()
+        return fut
+
+    def set_dense(self, name, value):
+        rpc.rpc_sync(server_name(self._dense_home(name)),
+                     _srv._srv_set_dense,
+                     (name, np.asarray(value, np.float32)))
+
+    # -- sparse -------------------------------------------------------------
+    def _shard(self, ids):
+        ids = np.asarray(ids, np.int64).ravel()
+        home = ids % self.num_servers
+        return ids, home
+
+    def _dim(self, name):
+        """Table dim, fetched from a server when THIS client didn't issue
+        create_tables (legal: creation is idempotent, one worker may
+        configure for all)."""
+        spec = self._specs.get(name)
+        if spec is None:
+            spec = rpc.rpc_sync(server_name(0), _srv._srv_table_spec,
+                                (name,))
+            self._specs[name] = dict(spec)
+        return spec.get("dim")
+
+    def pull_sparse(self, name, ids):
+        """Rows come back in input order regardless of sharding."""
+        ids, home = self._shard(ids)
+        dim = self._dim(name)
+        out = None
+        for s in range(self.num_servers):
+            sel = np.nonzero(home == s)[0]
+            if not sel.size:
+                continue
+            rows = rpc.rpc_sync(server_name(s), _srv._srv_pull_sparse,
+                                (name, ids[sel]))
+            if out is None:
+                out = np.zeros((ids.size, rows.shape[1] if rows.size
+                                else dim), np.float32)
+            out[sel] = rows
+        if out is None:
+            out = np.zeros((0, dim or 0), np.float32)
+        return out
+
+    def push_sparse(self, name, ids, grads, blocking=True):
+        ids, home = self._shard(ids)
+        grads = np.asarray(grads, np.float32).reshape(ids.size, -1)
+        futs = []
+        for s in range(self.num_servers):
+            sel = np.nonzero(home == s)[0]
+            if sel.size:
+                futs.append(rpc.rpc_async(
+                    server_name(s), _srv._srv_push_sparse,
+                    (name, ids[sel], grads[sel])))
+        if blocking:
+            for f in futs:
+                f.wait()
+        return futs
+
+    def sparse_table_size(self, name):
+        return sum(rpc.rpc_sync(server_name(s), _srv._srv_table_stats,
+                                (name,))["size"]
+                   for s in range(self.num_servers))
+
+    # -- lifecycle ----------------------------------------------------------
+    def save(self, dirname):
+        for s in range(self.num_servers):
+            rpc.rpc_sync(server_name(s), _srv._srv_save, (dirname,))
+
+    def load(self, dirname):
+        for s in range(self.num_servers):
+            rpc.rpc_sync(server_name(s), _srv._srv_load, (dirname,))
+
+    def stop_servers(self):
+        for s in range(self.num_servers):
+            rpc.rpc_sync(server_name(s), _srv._srv_stop)

@@ -1,0 +1,104 @@
+"""PS server process: a process-global table registry served over the RPC
+agent.
+
+Reference shape: paddle/fluid/distributed/ps/service/brpc_ps_server.{h,cc}
+— a brpc service dispatching PULL_DENSE / PUSH_DENSE / PULL_SPARSE /
+PUSH_SPARSE / SAVE / LOAD / STOP_SERVER messages to tables.  Here the
+transport is the framework's own RPC layer (distributed/rpc — pickled
+module-level handlers over length-prefixed frames), so the handler
+functions below resolve by module path on the server process and operate
+on ITS registry; no protobuf service definition is needed.
+
+Handlers run on the RPC server thread pool; tables carry their own locks.
+"""
+from __future__ import annotations
+
+import threading
+
+from .table import load_tables, make_table, save_tables
+
+_TABLES: dict = {}
+_SPECS: dict = {}
+_STOP = threading.Event()
+_SERVER_INDEX = 0
+_PENDING_LOAD: list = []           # dirname set by fleet.init_server(dirname)
+
+
+def set_pending_load(dirname):
+    """Record a checkpoint to restore once the worker broadcast has
+    created the tables (reference fleet.init_server(model_dir) resume)."""
+    _PENDING_LOAD[:] = [dirname]
+
+
+def _srv_create_tables(specs):
+    """Idempotent: every worker broadcasts the same specs at init_worker
+    (reference workers all issue the same the_one_ps config)."""
+    for spec in specs:
+        if spec["name"] not in _TABLES:
+            _TABLES[spec["name"]] = make_table(spec)
+            _SPECS[spec["name"]] = dict(spec)
+    if _PENDING_LOAD:
+        load_tables(_TABLES, _PENDING_LOAD[0], _SERVER_INDEX)
+        del _PENDING_LOAD[:]
+    return sorted(_TABLES)
+
+
+def _srv_table_spec(name):
+    return _SPECS[name]
+
+
+def _srv_pull_dense(name):
+    return _TABLES[name].pull()
+
+
+def _srv_push_dense(name, grad):
+    _TABLES[name].push(grad)
+
+
+def _srv_set_dense(name, value):
+    _TABLES[name].set(value)
+
+
+def _srv_pull_sparse(name, ids):
+    return _TABLES[name].pull(ids)
+
+
+def _srv_push_sparse(name, ids, grads):
+    _TABLES[name].push(ids, grads)
+
+
+def _srv_table_stats(name):
+    t = _TABLES[name]
+    return {"kind": type(t).__name__,
+            "size": len(t) if hasattr(t, "__len__") else None}
+
+
+def _srv_save(dirname):
+    save_tables(_TABLES, dirname, _SERVER_INDEX)
+
+
+def _srv_load(dirname):
+    load_tables(_TABLES, dirname, _SERVER_INDEX)
+
+
+def _srv_stop():
+    _STOP.set()
+
+
+class PSServer:
+    """Lifecycle holder for one server process (reference PSServer:
+    init → run(blocks) → stop via a worker's STOP_SERVER message)."""
+
+    def __init__(self, server_index):
+        global _SERVER_INDEX
+        _SERVER_INDEX = int(server_index)
+        self.server_index = int(server_index)
+        _STOP.clear()
+
+    def run(self):
+        """Block until a worker sends stop (fleet.run_server contract)."""
+        _STOP.wait()
+
+    @property
+    def tables(self):
+        return dict(_TABLES)

@@ -1,0 +1,224 @@
+"""Parameter-server tables: host-memory dense and sparse stores with
+server-side optimizers.
+
+Reference shape: paddle/fluid/distributed/ps/table/ — MemoryDenseTable
+(dense_table.cc, per-param optimizer applied on push) and
+MemorySparseTable (memory_sparse_table.cc, shard-of-dict rows created on
+first pull, accessor applies the update on push).  The reference keeps
+tables in server host RAM (or SSD) precisely because the embedding space
+doesn't fit accelerator memory — the same reasoning holds on TPU: HBM is
+for the dense compute path, the PS rows live in host memory and move over
+the control-plane network.
+
+TPU-native scope: numpy rows + a small server-side optimizer set
+(sgd / adagrad / adam — the reference accessors' core rules, minus the
+CTR click/show decay machinery which is rec-sys policy, not storage).
+Thread-safe per-table locks: the RPC server executes handlers on a pool.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+
+import numpy as np
+
+__all__ = ["DenseTable", "SparseTable", "make_table"]
+
+
+class _SGDRule:
+    def __init__(self, lr):
+        self.lr = lr
+
+    def init_state(self, shape):
+        return ()
+
+    def apply(self, value, grad, state):
+        value -= self.lr * grad
+        return state
+
+
+class _AdagradRule:
+    """G += g^2; w -= lr * g / (sqrt(G) + eps) — the reference sparse
+    accessor's default (ctr_common_accessor adagrad path)."""
+
+    def __init__(self, lr, eps=1e-8):
+        self.lr = lr
+        self.eps = eps
+
+    def init_state(self, shape):
+        return (np.zeros(shape, np.float32),)
+
+    def apply(self, value, grad, state):
+        (g2,) = state
+        g2 += grad * grad
+        value -= self.lr * grad / (np.sqrt(g2) + self.eps)
+        return (g2,)
+
+
+class _AdamRule:
+    def __init__(self, lr, beta1=0.9, beta2=0.999, eps=1e-8):
+        self.lr, self.b1, self.b2, self.eps = lr, beta1, beta2, eps
+
+    def init_state(self, shape):
+        return (np.zeros(shape, np.float32), np.zeros(shape, np.float32),
+                np.zeros((), np.int64))
+
+    def apply(self, value, grad, state):
+        m, v, t = state
+        t += 1
+        m *= self.b1
+        m += (1 - self.b1) * grad
+        v *= self.b2
+        v += (1 - self.b2) * grad * grad
+        mhat = m / (1 - self.b1 ** int(t))
+        vhat = v / (1 - self.b2 ** int(t))
+        value -= self.lr * mhat / (np.sqrt(vhat) + self.eps)
+        return (m, v, t)
+
+
+_RULES = {"sgd": _SGDRule, "adagrad": _AdagradRule, "adam": _AdamRule}
+
+
+def _make_rule(optimizer, lr):
+    try:
+        return _RULES[optimizer](lr)
+    except KeyError:
+        raise ValueError(f"unknown PS optimizer {optimizer!r}; "
+                         f"choose from {sorted(_RULES)}") from None
+
+
+class DenseTable:
+    """One dense parameter blob, updated in place on push
+    (reference MemoryDenseTable: pull_dense/push_dense + dense optimizer)."""
+
+    def __init__(self, name, shape, optimizer="sgd", lr=0.01, init=None):
+        self.name = name
+        self.shape = tuple(shape)
+        self.rule = _make_rule(optimizer, lr)
+        self.value = (np.zeros(self.shape, np.float32) if init is None
+                      else np.array(init, np.float32).reshape(self.shape))
+        self.state = self.rule.init_state(self.shape)
+        self.lock = threading.Lock()
+
+    def pull(self):
+        with self.lock:
+            return self.value.copy()
+
+    def push(self, grad):
+        grad = np.asarray(grad, np.float32).reshape(self.shape)
+        with self.lock:
+            self.state = self.rule.apply(self.value, grad, self.state)
+
+    def set(self, value):
+        with self.lock:
+            self.value[...] = np.asarray(value, np.float32)
+
+    def save(self):
+        with self.lock:
+            return {"value": self.value.copy(),
+                    "state": tuple(np.copy(s) for s in self.state)}
+
+    def load(self, blob):
+        with self.lock:
+            self.value[...] = blob["value"]
+            self.state = tuple(np.copy(s) for s in blob["state"])
+
+
+class SparseTable:
+    """id -> row store; rows materialize on first pull
+    (reference MemorySparseTable shards + accessor Create-on-pull)."""
+
+    def __init__(self, name, dim, optimizer="adagrad", lr=0.01,
+                 init_scale=0.01, seed=0):
+        self.name = name
+        self.dim = int(dim)
+        self.rule = _make_rule(optimizer, lr)
+        self.init_scale = float(init_scale)
+        self.seed = int(seed)
+        self.rows: dict[int, np.ndarray] = {}
+        self.states: dict[int, tuple] = {}
+        self.lock = threading.Lock()
+
+    def _row(self, fid):
+        row = self.rows.get(fid)
+        if row is None:
+            # deterministic per-id init: the same id materializes the same
+            # row on any server and across restarts
+            rng = np.random.RandomState((self.seed * 0x9E3779B1 + fid)
+                                        & 0x7FFFFFFF)
+            row = rng.uniform(-self.init_scale, self.init_scale,
+                              self.dim).astype(np.float32)
+            self.rows[fid] = row
+            self.states[fid] = self.rule.init_state((self.dim,))
+        return row
+
+    def pull(self, ids):
+        ids = np.asarray(ids, np.int64).ravel()
+        with self.lock:
+            return np.stack([self._row(int(i)) for i in ids]) \
+                if ids.size else np.zeros((0, self.dim), np.float32)
+
+    def push(self, ids, grads):
+        """Duplicate ids in one push are accumulated before the single
+        optimizer step (the reference merges gradients per key in the
+        accessor before update)."""
+        ids = np.asarray(ids, np.int64).ravel()
+        grads = np.asarray(grads, np.float32).reshape(ids.size, self.dim)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        merged = np.zeros((uniq.size, self.dim), np.float32)
+        np.add.at(merged, inv, grads)
+        with self.lock:
+            for k, fid in enumerate(uniq):
+                fid = int(fid)
+                row = self._row(fid)
+                self.states[fid] = self.rule.apply(row, merged[k],
+                                                   self.states[fid])
+
+    def __len__(self):
+        with self.lock:
+            return len(self.rows)
+
+    def save(self):
+        with self.lock:
+            return {"rows": {k: v.copy() for k, v in self.rows.items()},
+                    "states": {k: tuple(np.copy(x) for x in s)
+                               for k, s in self.states.items()}}
+
+    def load(self, blob):
+        with self.lock:
+            self.rows = {int(k): np.asarray(v, np.float32)
+                         for k, v in blob["rows"].items()}
+            self.states = {int(k): tuple(np.copy(x) for x in s)
+                           for k, s in blob["states"].items()}
+
+
+def make_table(spec):
+    """Build a table from a plain-dict spec (what the client ships over
+    RPC): {"kind": "dense"|"sparse", "name": ..., ...ctor kwargs}."""
+    spec = dict(spec)
+    kind = spec.pop("kind")
+    if kind == "dense":
+        return DenseTable(**spec)
+    if kind == "sparse":
+        return SparseTable(**spec)
+    raise ValueError(f"unknown table kind {kind!r}")
+
+
+def save_tables(tables, dirname, server_index):
+    os.makedirs(dirname, exist_ok=True)
+    blob = {name: {"spec_kind": type(t).__name__, "data": t.save()}
+            for name, t in tables.items()}
+    path = os.path.join(dirname, f"ps_shard_{server_index}.pkl")
+    with open(path + ".tmp", "wb") as f:
+        pickle.dump(blob, f, protocol=4)
+    os.replace(path + ".tmp", path)
+
+
+def load_tables(tables, dirname, server_index):
+    path = os.path.join(dirname, f"ps_shard_{server_index}.pkl")
+    with open(path, "rb") as f:
+        blob = pickle.load(f)
+    for name, entry in blob.items():
+        if name in tables:
+            tables[name].load(entry["data"])

@@ -11,6 +11,13 @@ _HW = os.environ.get("PADDLE_TPU_HW_TESTS", "").lower() not in (
 
 if not _HW:
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # ALSO drop the TPU-plugin trigger: the environment's sitecustomize
+    # registers the axon PJRT plugin whenever PALLAS_AXON_POOL_IPS is set,
+    # and its get_backend hook initializes the plugin client even under a
+    # cpu env pin — which HANGS every descendant test subprocess whenever
+    # the device tunnel is down (observed r4).  Popping it here means no
+    # child of this pytest process ever registers the plugin.
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
