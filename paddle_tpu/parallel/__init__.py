@@ -1,6 +1,7 @@
 """Hybrid-parallel SPMD training engines (the Fleet compute path)."""
 from .transformer import (  # noqa: F401
-    HybridParallelConfig, build_mesh, build_train_step, init_opt_state,
+    HybridParallelConfig, build_hybrid_mesh, build_mesh, build_train_step,
+    init_opt_state,
     init_params, param_specs, shard_opt_state, shard_params,
 )
 from .ring_attention import (  # noqa: F401

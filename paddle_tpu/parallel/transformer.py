@@ -125,6 +125,75 @@ def build_mesh(hp: HybridParallelConfig, devices=None) -> Mesh:
     return Mesh(arr, ("pp", "dp", "cp", "tp"))
 
 
+def build_hybrid_mesh(hp: HybridParallelConfig, devices=None,
+                      num_slices=None, dcn_axis="dp") -> Mesh:
+    """Mesh for multi-slice (multi-host pod) topologies: the ``dcn_axis``
+    spans SLICES (data-center network) while every other axis stays inside
+    a slice (ICI).
+
+    The reference reaches the same goal through rank-order convention —
+    `fleet/base/topology.py` orders axes pp->mp->sep->sharding->dp over
+    ranks laid out node-major, so mp lands on intra-node NVLink and dp
+    crosses nodes.  On TPU the slice boundary is explicit: collectives
+    inside a slice ride ICI, cross-slice traffic rides DCN, so the
+    low-frequency axis (dp or pp: one gradient-sized or boundary-sized
+    transfer per step) must be the ONLY one crossing slices.  TP/CP
+    collectives fire per layer and would be catastrophic over DCN.
+
+    Slice membership comes from ``device.slice_index`` when the runtime
+    exposes it (multislice TPU); ``num_slices`` overrides for explicit
+    layouts and virtual-device tests.
+    """
+    if dcn_axis not in ("dp", "pp"):
+        raise ValueError(f"dcn_axis must be 'dp' or 'pp' (low-frequency "
+                         f"axes); got {dcn_axis!r}")
+    devices = list(devices if devices is not None
+                   else jax.devices()[:hp.world])
+    if len(devices) < hp.world:
+        raise RuntimeError(f"need {hp.world} devices, have {len(devices)}")
+    devices = devices[:hp.world]
+    if num_slices is None:
+        idx = {getattr(d, "slice_index", 0) for d in devices}
+        num_slices = len(idx)
+    if num_slices <= 1:
+        return build_mesh(hp, devices)
+    dcn_degree = getattr(hp, dcn_axis)
+    if dcn_degree % num_slices != 0:
+        raise ValueError(
+            f"{dcn_axis} degree {dcn_degree} must be a multiple of "
+            f"num_slices {num_slices} so only {dcn_axis} crosses DCN")
+    per_slice = hp.world // num_slices
+    # group devices by slice (stable order), then lay out so that the dcn
+    # axis's major dimension walks slices and everything else stays within
+    # one slice's contiguous ICI block
+    by_slice: dict = {}
+    for d in devices:
+        by_slice.setdefault(getattr(d, "slice_index", 0), []).append(d)
+    if len(by_slice) == 1:      # virtual devices: carve equal slices
+        flat = by_slice.popitem()[1]
+        by_slice = {i: flat[i * per_slice:(i + 1) * per_slice]
+                    for i in range(num_slices)}
+    groups = [by_slice[k] for k in sorted(by_slice)]
+    if any(len(g) != per_slice for g in groups):
+        raise ValueError(f"uneven slices: {[len(g) for g in groups]}")
+    shard = {ax: getattr(hp, ax) for ax in ("pp", "dp", "cp", "tp")}
+    shard[dcn_axis] //= num_slices
+    # within-slice layout in canonical axis order, slice axis prepended
+    arrs = [np.asarray(g).reshape(shard["pp"], shard["dp"], shard["cp"],
+                                  shard["tp"]) for g in groups]
+    stacked = np.stack(arrs)                       # [slice, pp, dp, cp, tp]
+    # put the slice dim on the MAJOR side of the dcn axis and merge, so
+    # dcn-axis index i lives on slice i // local_degree: contiguous
+    # local_degree-sized blocks stay intra-slice, only the outer stride
+    # crosses DCN
+    pos = ("pp", "dp", "cp", "tp").index(dcn_axis)
+    stacked = np.moveaxis(stacked, 0, pos)     # [..., slice, dcn_local, ...]
+    new_shape = [shard["pp"], shard["dp"], shard["cp"], shard["tp"]]
+    new_shape[pos] *= num_slices
+    arr = stacked.reshape(new_shape)
+    return Mesh(arr, ("pp", "dp", "cp", "tp"))
+
+
 # ---------------------------------------------------------------------------
 # Parameters.  Layer weights are stacked on a leading L axis sharded over pp;
 # TP shardings follow Megatron: qkv/gate/up column (out-dim), o/down row

@@ -338,3 +338,68 @@ def test_xent_chunking_reduces_temp_memory():
     base = temp_bytes(0)
     chunked = temp_bytes(32)
     assert 0 < chunked < base, (chunked, base)
+
+
+# ---------------------------------------------------------------------------
+# DCN/ICI hybrid mesh (multi-slice topology; VERDICT r3 item 7)
+# ---------------------------------------------------------------------------
+def test_hybrid_mesh_dp_crosses_slices_tp_stays_inside():
+    """With 2 virtual slices of 4 devices, the dp axis must walk slices
+    (DCN) while tp varies within one slice's contiguous ICI block."""
+    from paddle_tpu.parallel import build_hybrid_mesh
+
+    hp = HybridParallelConfig(dp=2, pp=1, tp=4, num_microbatches=1)
+    devs = jax.devices()[:8]
+    mesh = build_hybrid_mesh(hp, devices=devs, num_slices=2, dcn_axis="dp")
+    arr = mesh.devices                                # [pp, dp, cp, tp]
+    slice_of = {id(d): i // 4 for i, d in enumerate(devs)}
+    # tp neighbors co-sliced; dp=0 vs dp=1 on different slices
+    for dp in range(2):
+        slices = {slice_of[id(d)] for d in arr[0, dp, 0, :]}
+        assert len(slices) == 1, f"tp group spans slices: {slices}"
+    assert {slice_of[id(d)] for d in arr[0, :, 0, 0]} == {0, 1}
+
+
+def test_hybrid_mesh_pp_as_dcn_axis():
+    from paddle_tpu.parallel import build_hybrid_mesh
+
+    hp = HybridParallelConfig(dp=1, pp=2, tp=4, num_microbatches=2)
+    devs = jax.devices()[:8]
+    mesh = build_hybrid_mesh(hp, devices=devs, num_slices=2, dcn_axis="pp")
+    slice_of = {id(d): i // 4 for i, d in enumerate(devs)}
+    arr = mesh.devices
+    for pp in range(2):
+        assert len({slice_of[id(d)] for d in arr[pp, 0, 0, :]}) == 1
+    assert {slice_of[id(d)] for d in arr[:, 0, 0, 0]} == {0, 1}
+
+
+def test_hybrid_mesh_rejects_bad_factorization():
+    from paddle_tpu.parallel import build_hybrid_mesh
+
+    hp = HybridParallelConfig(dp=1, pp=1, tp=8, num_microbatches=1)
+    with pytest.raises(ValueError, match="multiple of"):
+        build_hybrid_mesh(hp, devices=jax.devices()[:8], num_slices=2,
+                          dcn_axis="dp")
+    with pytest.raises(ValueError, match="dcn_axis"):
+        build_hybrid_mesh(hp, devices=jax.devices()[:8], num_slices=2,
+                          dcn_axis="tp")
+
+
+def test_hybrid_mesh_trains_end_to_end():
+    """The slice-aware mesh is a drop-in: the full train step compiles and
+    learns on it (2 slices x (dp2 x tp2))."""
+    from paddle_tpu.parallel import build_hybrid_mesh
+
+    hp = HybridParallelConfig(dp=4, pp=1, tp=2, num_microbatches=1)
+    mesh = build_hybrid_mesh(hp, devices=jax.devices()[:8], num_slices=2,
+                             dcn_axis="dp")
+    params = shard_params(init_params(CFG, hp, seed=0), hp, mesh)
+    opt = shard_opt_state(init_opt_state(params), hp, mesh)
+    step_fn = build_train_step(CFG, hp, mesh)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, CFG.vocab_size, (8, 16)), jnp.int32)
+    losses = []
+    for _ in range(6):
+        params, opt, loss = step_fn(params, opt, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
