@@ -339,3 +339,236 @@ def cumprod(x, dim=None, dtype=None):
 def count_nonzero(x, axis=None, keepdim=False):
     return jnp.count_nonzero(x, axis=_axis_t(axis),
                              keepdims=keepdim).astype(jnp.int64)
+
+
+# -- manipulation (third tranche: shape/axis/indexing ops; attr
+#    normalization — Tensor shapes to host ints, lists to tuples — happens
+#    in the generated wrapper's _hashable, so kernels see plain values) ----
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def transpose(x, perm=None):
+    if perm is None:
+        perm = tuple(reversed(range(x.ndim)))
+    return jnp.transpose(x, tuple(int(p) for p in perm))
+
+
+def moveaxis(x, source, destination):
+    s = tuple(source) if isinstance(source, tuple) else (int(source),)
+    d = tuple(destination) if isinstance(destination, tuple) \
+        else (int(destination),)
+    return jnp.moveaxis(x, s, d)
+
+
+def swapaxes(x, axis1, axis2):
+    return jnp.swapaxes(x, int(axis1), int(axis2))
+
+
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    ax = axis if isinstance(axis, tuple) else (axis,)
+    ax = tuple(int(a) for a in ax if x.shape[int(a)] == 1)
+    if not ax:
+        # no squeezable dim: identity that still records on the tape
+        return x * 1 if jnp.issubdtype(x.dtype, jnp.number) else x
+    return jnp.squeeze(x, axis=ax)
+
+
+def unsqueeze(x, axis):
+    ax = axis if isinstance(axis, tuple) else (int(axis),)
+    return jnp.expand_dims(x, axis=tuple(int(a) for a in ax))
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    if x.ndim == 0:
+        return jnp.reshape(x, (1,))
+    start, stop = start_axis % x.ndim, stop_axis % x.ndim
+    shape = tuple(x.shape)
+    return jnp.reshape(x, shape[:start] + (-1,) + shape[stop + 1:])
+
+
+def unflatten(x, axis, shape):
+    axis = int(axis) % x.ndim
+    cur = tuple(x.shape)
+    return jnp.reshape(x, cur[:axis] + tuple(shape) + cur[axis + 1:])
+
+
+def flip(x, axis):
+    ax = axis if isinstance(axis, tuple) else (int(axis),)
+    return jnp.flip(x, axis=tuple(int(a) for a in ax))
+
+
+def fliplr(x):
+    return jnp.flip(x, axis=1)
+
+
+def flipud(x):
+    return jnp.flip(x, axis=0)
+
+
+def roll(x, shifts, axis=None):
+    sh = shifts if isinstance(shifts, tuple) else int(shifts)
+    ax = axis if (axis is None or isinstance(axis, tuple)) else int(axis)
+    return jnp.roll(x, sh, axis=ax)
+
+
+def tile(x, repeat_times):
+    return jnp.tile(x, tuple(int(r) for r in repeat_times))
+
+
+def _expand_shape(cur, tgt):
+    full, pad = [], len(tgt) - len(cur)
+    for i, s in enumerate(tgt):
+        if s == -1:
+            full.append(cur[i - pad] if i >= pad else 1)
+        else:
+            full.append(int(s))
+    return tuple(full)
+
+
+def expand(x, shape):
+    return jnp.broadcast_to(x, _expand_shape(tuple(x.shape), shape))
+
+
+def broadcast_to(x, shape):
+    return expand(x, shape)
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def gather(x, index, axis=0):
+    if index.ndim == 0:
+        index = index[None]
+    return jnp.take(x, index, axis=int(axis))
+
+
+def gather_nd(x, index):
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=int(axis))
+
+
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def index_add(x, index, value, axis):
+    a_m = jnp.moveaxis(x, int(axis), 0)
+    v_m = jnp.moveaxis(value, int(axis), 0)
+    return jnp.moveaxis(a_m.at[index].add(v_m), 0, int(axis))
+
+
+def index_fill(x, index, value, axis):
+    a_m = jnp.moveaxis(x, int(axis), 0)
+    out = a_m.at[index].set(jnp.asarray(value).astype(x.dtype))
+    return jnp.moveaxis(out, 0, int(axis))
+
+
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value).astype(x.dtype), x)
+
+
+def masked_scatter(x, mask, value):
+    flat_m = mask.ravel()
+    pos = jnp.cumsum(flat_m.astype(jnp.int32)) - 1
+    gathered = value.ravel()[jnp.clip(pos, 0, value.size - 1)]
+    return jnp.where(flat_m, gathered, x.ravel()).reshape(x.shape)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True):
+    return jnp.take_along_axis(arr, indices, axis=int(axis))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True):
+    axis = int(axis)
+    if jnp.ndim(values) == 0:
+        values = jnp.broadcast_to(values, indices.shape)
+    moved = jnp.moveaxis(arr, axis, 0)
+    idx_m = jnp.moveaxis(indices, axis, 0)
+    v_m = jnp.moveaxis(
+        jnp.broadcast_to(values.astype(arr.dtype), indices.shape), axis, 0)
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in idx_m.shape],
+                         indexing="ij")
+    grids[0] = idx_m
+    at = moved.at[tuple(grids)]
+    if reduce == "assign":
+        out = at.set(v_m)
+    elif reduce in ("add", "sum"):
+        out = at.add(v_m)
+    elif reduce in ("mul", "multiply"):
+        out = at.multiply(v_m)
+    elif reduce == "amax":
+        out = at.max(v_m)
+    elif reduce == "amin":
+        out = at.min(v_m)
+    else:
+        raise ValueError(f"unknown reduce {reduce}")
+    return jnp.moveaxis(out, 0, axis)
+
+
+def scatter(x, index, updates, overwrite=True):
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = index[:, 0]
+    if overwrite:
+        return x.at[index].set(updates)
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+def scatter_nd(index, updates, shape):
+    zeros = jnp.zeros(shape, updates.dtype)
+    return zeros.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def repeat_interleave(x, repeats, axis=None):
+    ax = None if axis is None else int(axis)
+    if isinstance(repeats, tuple):
+        import numpy as _np
+        reps = _np.asarray(repeats, _np.int32)
+        return jnp.repeat(x, reps, axis=ax,
+                          total_repeat_length=int(reps.sum()))
+    return jnp.repeat(x, int(repeats), axis=ax)
+
+
+def sort(x, axis=-1, descending=False, stable=False):
+    out = jnp.sort(x, axis=int(axis), stable=True)
+    return jnp.flip(out, axis=int(axis)) if descending else out
+
+
+def argsort(x, axis=-1, descending=False, stable=False):
+    out = jnp.argsort(x, axis=int(axis), stable=True)
+    out = jnp.flip(out, axis=int(axis)) if descending else out
+    return out.astype(jnp.int64)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    k, axis = int(k), int(axis)
+    if largest:
+        vals, idx = jax.lax.top_k(jnp.moveaxis(x, axis, -1), k)
+    else:
+        vals, idx = jax.lax.top_k(-jnp.moveaxis(x, axis, -1), k)
+        vals = -vals
+    return (jnp.moveaxis(vals, -1, axis),
+            jnp.moveaxis(idx, -1, axis).astype(jnp.int64))
+
+
+def cross(x, y, axis=9):
+    ax = 9 if axis is None else int(axis)
+    if ax == 9:     # reference sentinel: first dim of size 3
+        ax = next((i for i, s in enumerate(x.shape) if s == 3), None)
+        if ax is None:
+            raise ValueError(
+                f"cross: no dimension of size 3 in shape {tuple(x.shape)}; "
+                "pass axis explicitly")
+    return jnp.cross(x, y, axis=ax)

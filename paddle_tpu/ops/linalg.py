@@ -217,12 +217,6 @@ def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, nam
                     "unit": bool(unitriangular)})
 
 
-def cross(x, y, axis=9, name=None):
-    def _impl(a, b, axis):
-        if axis == 9:
-            axis = next(i for i, s in enumerate(a.shape) if s == 3)
-        return jnp.cross(a, b, axis=axis)
-    return D.apply("cross", _impl, (x, y), {"axis": int(axis) if axis is not None else 9})
 
 
 def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
@@ -366,3 +360,7 @@ def svd_lowrank(x, q=6, niter=2, M=None, name=None):
     return D.apply("svd_lowrank", impl, args,
                    {"q": int(q), "niter": int(niter),
                     "seed": _r.randint(0, 2 ** 31 - 1)}, num_outputs=3)
+
+
+# kernel-driven (generated from ops.yaml `kernel:` over ops/kernels.py)
+from .generated.op_wrappers import cross  # noqa: E402,F401

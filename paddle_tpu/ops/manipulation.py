@@ -44,11 +44,6 @@ def _shape_static(shape):
     return tuple(out)
 
 
-def reshape(x, shape, name=None):
-    return D.apply("reshape", lambda a, shape: jnp.reshape(a, shape),
-                   (x,), {"shape": _shape_static(shape)})
-
-
 def reshape_(x, shape, name=None):
     out = reshape(x, shape)
     x._data, x._grad_node, x._output_index = out._data, out._grad_node, out._output_index
@@ -63,25 +58,6 @@ def view(x, shape_or_dtype, name=None):
 
 def view_as(x, other, name=None):
     return reshape(x, other.shape)
-
-
-def transpose(x, perm=None, name=None):
-    if perm is None:
-        perm = tuple(reversed(range(x.ndim)))
-    return D.apply("transpose", lambda a, perm: jnp.transpose(a, perm),
-                   (x,), {"perm": tuple(int(p) for p in perm)})
-
-
-def moveaxis(x, source, destination, name=None):
-    s = tuple(source) if isinstance(source, (list, tuple)) else (source,)
-    d = tuple(destination) if isinstance(destination, (list, tuple)) else (destination,)
-    return D.apply("moveaxis", lambda a, s, d: jnp.moveaxis(a, s, d),
-                   (x,), {"s": s, "d": d})
-
-
-def swapaxes(x, axis1, axis2, name=None):
-    return D.apply("swapaxes", lambda a, i, j: jnp.swapaxes(a, i, j),
-                   (x,), {"i": int(axis1), "j": int(axis2)})
 
 
 def concat(x, axis=0, name=None):
@@ -168,31 +144,10 @@ def chunk(x, chunks, axis=0, name=None):
     return split(x, chunks, axis)
 
 
-def squeeze(x, axis=None, name=None):
-    if axis is None:
-        ax = None
-    elif isinstance(axis, (list, tuple)):
-        ax = tuple(int(a) for a in axis if x.shape[int(a)] == 1)
-    else:
-        ax = (int(axis),) if x.shape[int(axis)] == 1 else ()
-        if ax == ():
-            return D.apply("identity", lambda a: a * 1 if jnp.issubdtype(a.dtype, jnp.number) else a, (x,))
-    return D.apply("squeeze", lambda a, axis: jnp.squeeze(a, axis=axis),
-                   (x,), {"axis": ax})
-
-
 def squeeze_(x, axis=None, name=None):
     out = squeeze(x, axis)
     x._data, x._grad_node, x._output_index = out._data, out._grad_node, out._output_index
     return x
-
-
-def unsqueeze(x, axis, name=None):
-    if isinstance(axis, Tensor):
-        axis = axis.tolist()
-    ax = tuple(int(a) for a in axis) if isinstance(axis, (list, tuple)) else (int(axis),)
-    return D.apply("unsqueeze", lambda a, axis: jnp.expand_dims(a, axis=axis),
-                   (x,), {"axis": ax})
 
 
 def unsqueeze_(x, axis, name=None):
@@ -201,80 +156,13 @@ def unsqueeze_(x, axis, name=None):
     return x
 
 
-def flatten(x, start_axis=0, stop_axis=-1, name=None):
-    nd = x.ndim
-    if nd == 0:
-        return reshape(x, [1])
-    start = start_axis % nd
-    stop = stop_axis % nd
-    shape = tuple(x.shape)
-    new_shape = shape[:start] + (-1,) + shape[stop + 1:]
-    return reshape(x, new_shape)
-
-
 def flatten_(x, start_axis=0, stop_axis=-1, name=None):
     out = flatten(x, start_axis, stop_axis)
     x._data, x._grad_node, x._output_index = out._data, out._grad_node, out._output_index
     return x
 
 
-def unflatten(x, axis, shape, name=None):
-    axis = axis % x.ndim
-    cur = tuple(x.shape)
-    return reshape(x, cur[:axis] + tuple(shape) + cur[axis + 1:])
-
-
-def flip(x, axis, name=None):
-    ax = tuple(int(a) for a in axis) if isinstance(axis, (list, tuple)) else (int(axis),)
-    return D.apply("flip", lambda a, axis: jnp.flip(a, axis=axis), (x,), {"axis": ax})
-
-
-def fliplr(x, name=None):
-    return flip(x, 1)
-
-
-def flipud(x, name=None):
-    return flip(x, 0)
-
-
 rotate90 = None  # placeholder; rot90 lives in math
-
-
-def roll(x, shifts, axis=None, name=None):
-    sh = tuple(int(s) for s in shifts) if isinstance(shifts, (list, tuple)) else int(shifts)
-    ax = (tuple(int(a) for a in axis) if isinstance(axis, (list, tuple))
-          else (None if axis is None else int(axis)))
-    return D.apply("roll", lambda a, shifts, axis: jnp.roll(a, shifts, axis=axis),
-                   (x,), {"shifts": sh, "axis": ax})
-
-
-def tile(x, repeat_times, name=None):
-    if isinstance(repeat_times, Tensor):
-        repeat_times = repeat_times.tolist()
-    return D.apply("tile", lambda a, reps: jnp.tile(a, reps),
-                   (x,), {"reps": tuple(int(r) for r in repeat_times)})
-
-
-def expand(x, shape, name=None):
-    tgt = _shape_static(shape)
-    cur = tuple(x.shape)
-    full = []
-    pad = len(tgt) - len(cur)
-    for i, s in enumerate(tgt):
-        if s == -1:
-            full.append(cur[i - pad] if i >= pad else 1)
-        else:
-            full.append(s)
-    return D.apply("expand", lambda a, shape: jnp.broadcast_to(a, shape),
-                   (x,), {"shape": tuple(full)})
-
-
-def expand_as(x, y, name=None):
-    return expand(x, y.shape)
-
-
-def broadcast_to(x, shape, name=None):
-    return expand(x, shape)
 
 
 def broadcast_tensors(inputs, name=None):
@@ -282,77 +170,10 @@ def broadcast_tensors(inputs, name=None):
     return [broadcast_to(t, shape) for t in inputs]
 
 
-def gather(x, index, axis=0, name=None):
-    if isinstance(axis, Tensor):
-        axis = int(axis.item())
-
-    def _gather(a, idx, axis):
-        if idx.ndim == 0:
-            idx = idx[None]
-        return jnp.take(a, idx, axis=axis)
-    return D.apply("gather", _gather, (x, index), {"axis": int(axis)})
-
-
-def gather_nd(x, index, name=None):
-    def _gather_nd(a, idx):
-        nd = idx.shape[-1]
-        out = a[tuple(jnp.moveaxis(idx, -1, 0))]
-        return out
-    return D.apply("gather_nd", _gather_nd, (x, index))
-
-
-def scatter(x, index, updates, overwrite=True, name=None):
-    def _scatter(a, idx, upd, overwrite):
-        if idx.ndim == 2 and idx.shape[1] == 1:
-            idx = idx[:, 0]
-        if overwrite:
-            return a.at[idx].set(upd)
-        zeroed = a.at[idx].set(jnp.zeros_like(upd))
-        return zeroed.at[idx].add(upd)
-    return D.apply("scatter", _scatter, (x, index, updates),
-                   {"overwrite": bool(overwrite)})
-
-
 def scatter_(x, index, updates, overwrite=True, name=None):
     out = scatter(x, index, updates, overwrite)
     x._data, x._grad_node, x._output_index = out._data, out._grad_node, out._output_index
     return x
-
-
-def scatter_nd(index, updates, shape, name=None):
-    def _scatter_nd(idx, upd, shape):
-        zeros = jnp.zeros(shape, upd.dtype)
-        return zeros.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
-    return D.apply("scatter_nd", _scatter_nd, (index, updates),
-                   {"shape": _shape_static(shape)})
-
-
-def scatter_nd_add(x, index, updates, name=None):
-    def _scatter_nd_add(a, idx, upd):
-        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
-    return D.apply("scatter_nd_add", _scatter_nd_add, (x, index, updates))
-
-
-def index_select(x, index, axis=0, name=None):
-    return D.apply("index_select", lambda a, idx, axis: jnp.take(a, idx, axis=axis),
-                   (x, index), {"axis": int(axis)})
-
-
-def index_sample(x, index, name=None):
-    return D.apply("index_sample",
-                   lambda a, idx: jnp.take_along_axis(a, idx, axis=1),
-                   (x, index))
-
-
-def index_add(x, index, axis, value, name=None):
-    def _index_add(a, idx, v, axis):
-        return jnp.apply_along_axis  # placeholder, replaced below
-    def _impl(a, idx, v, axis):
-        a_m = jnp.moveaxis(a, axis, 0)
-        v_m = jnp.moveaxis(v, axis, 0)
-        out = a_m.at[idx].add(v_m)
-        return jnp.moveaxis(out, 0, axis)
-    return D.apply("index_add", _impl, (x, index, value), {"axis": int(axis)})
 
 
 def index_put(x, indices, value, accumulate=False, name=None):
@@ -366,75 +187,10 @@ def index_put(x, indices, value, accumulate=False, name=None):
                    {"accumulate": bool(accumulate)})
 
 
-def index_fill(x, index, axis, value, name=None):
-    def _impl(a, idx, axis, value):
-        a_m = jnp.moveaxis(a, axis, 0)
-        out = a_m.at[idx].set(jnp.asarray(value, a.dtype))
-        return jnp.moveaxis(out, 0, axis)
-    if isinstance(value, Tensor):
-        value = value.item()
-    return D.apply("index_fill", _impl, (x, index), {"axis": int(axis), "value": value})
-
-
 def masked_select(x, mask, name=None):
     # Dynamic output size: host-sync path (same as reference GPU sync).
     a, m = np.asarray(_t(x)), np.asarray(_t(mask))
     return Tensor(jnp.asarray(a[m]))
-
-
-def masked_fill(x, mask, value, name=None):
-    if isinstance(value, Tensor):
-        return D.apply("masked_fill_t",
-                       lambda a, m, v: jnp.where(m, v.astype(a.dtype), a),
-                       (x, mask, value))
-    return D.apply("masked_fill",
-                   lambda a, m, value: jnp.where(m, jnp.asarray(value, a.dtype), a),
-                   (x, mask), {"value": value})
-
-
-def masked_scatter(x, mask, value, name=None):
-    def _ms(a, m, v):
-        flat_m = m.ravel()
-        pos = jnp.cumsum(flat_m.astype(jnp.int32)) - 1
-        gathered = v.ravel()[jnp.clip(pos, 0, v.size - 1)]
-        return jnp.where(flat_m, gathered, a.ravel()).reshape(a.shape)
-    return D.apply("masked_scatter", _ms, (x, mask, value))
-
-
-def take_along_axis(arr, indices, axis, broadcast=True, name=None):
-    def _tala(a, idx, axis):
-        return jnp.take_along_axis(a, idx, axis=axis)
-    return D.apply("take_along_axis", _tala, (arr, indices), {"axis": int(axis)})
-
-
-def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True,
-                   broadcast=True, name=None):
-    def _pala(a, idx, v, axis, reduce):
-        if jnp.ndim(v) == 0:
-            v = jnp.broadcast_to(v, idx.shape)
-        v = v.astype(a.dtype)
-        dims = [1] * a.ndim
-        moved = jnp.moveaxis(a, axis, 0)
-        idx_m = jnp.moveaxis(idx, axis, 0)
-        v_m = jnp.moveaxis(jnp.broadcast_to(v, idx.shape), axis, 0)
-        # build full index grids
-        grids = jnp.meshgrid(*[jnp.arange(s) for s in idx_m.shape], indexing="ij")
-        grids[0] = idx_m
-        if reduce == "assign":
-            out = moved.at[tuple(grids)].set(v_m)
-        elif reduce in ("add", "sum"):
-            out = moved.at[tuple(grids)].add(v_m)
-        elif reduce in ("mul", "multiply"):
-            out = moved.at[tuple(grids)].multiply(v_m)
-        elif reduce == "amax":
-            out = moved.at[tuple(grids)].max(v_m)
-        elif reduce == "amin":
-            out = moved.at[tuple(grids)].min(v_m)
-        else:
-            raise ValueError(f"unknown reduce {reduce}")
-        return jnp.moveaxis(out, 0, axis)
-    return D.apply("put_along_axis", _pala, (arr, indices, values),
-                   {"axis": int(axis), "reduce": reduce})
 
 
 def unbind(input, axis=0, name=None):
@@ -448,19 +204,6 @@ def unbind(input, axis=0, name=None):
 
 
 unstack = unbind
-
-
-def repeat_interleave(x, repeats, axis=None, name=None):
-    if isinstance(repeats, Tensor):
-        return D.apply("repeat_interleave_t",
-                       lambda a, r, axis, total: jnp.repeat(a, r, axis=axis,
-                                                            total_repeat_length=total),
-                       (x, repeats),
-                       {"axis": None if axis is None else int(axis),
-                        "total": int(np.asarray(repeats._data).sum())})
-    return D.apply("repeat_interleave",
-                   lambda a, repeats, axis: jnp.repeat(a, repeats, axis=axis),
-                   (x,), {"repeats": int(repeats), "axis": None if axis is None else int(axis)})
 
 
 def unique(x, return_index=False, return_inverse=False, return_counts=False,
@@ -500,35 +243,6 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
         counts = np.diff(np.concatenate([idx, [a.shape[axis]]]))
         outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
     return outs[0] if len(outs) == 1 else tuple(outs)
-
-
-def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
-    if isinstance(k, Tensor):
-        k = int(k.item())
-
-    def _topk(a, k, axis, largest):
-        if largest:
-            vals, idx = jax.lax.top_k(jnp.moveaxis(a, axis, -1), k)
-        else:
-            vals, idx = jax.lax.top_k(-jnp.moveaxis(a, axis, -1), k)
-            vals = -vals
-        return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis).astype(jnp.int64)
-    return D.apply("topk", _topk, (x,),
-                   {"k": int(k), "axis": int(axis), "largest": bool(largest)})
-
-
-def sort(x, axis=-1, descending=False, stable=False, name=None):
-    def _sort(a, axis, descending):
-        out = jnp.sort(a, axis=axis, stable=True)
-        return jnp.flip(out, axis=axis) if descending else out
-    return D.apply("sort", _sort, (x,), {"axis": int(axis), "descending": bool(descending)})
-
-
-def argsort(x, axis=-1, descending=False, stable=False, name=None):
-    def _argsort(a, axis, descending):
-        out = jnp.argsort(a, axis=axis, stable=True)
-        return (jnp.flip(out, axis=axis) if descending else out).astype(jnp.int64)
-    return D.apply("argsort", _argsort, (x,), {"axis": int(axis), "descending": bool(descending)})
 
 
 def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
@@ -714,3 +428,19 @@ builtins_min = min
 
 def tolist(x):
     return x.tolist()
+
+
+# ---------------------------------------------------------------------------
+# Kernel-driven ops (third tranche): the yaml schema is the source of truth;
+# wrappers are generated (ops/generated/op_wrappers.py) from `kernel:` fields
+# over ops/kernels.py.  Re-exported here so `from paddle_tpu.ops.manipulation
+# import reshape` and in-module callers (view, *_ inplace variants,
+# broadcast_tensors) keep resolving.
+# ---------------------------------------------------------------------------
+from .generated.op_wrappers import (  # noqa: E402,F401
+    argsort, broadcast_to, expand, expand_as, flatten, flip, fliplr, flipud,
+    gather, gather_nd, index_add, index_fill, index_sample, index_select,
+    masked_fill, masked_scatter, moveaxis, put_along_axis, repeat_interleave,
+    reshape, roll, scatter, scatter_nd, scatter_nd_add, sort, squeeze,
+    swapaxes, take_along_axis, tile, topk, transpose, unflatten, unsqueeze,
+)
