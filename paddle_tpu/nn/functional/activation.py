@@ -1,6 +1,13 @@
 """Activation functionals.
 
 Parity with /root/reference/python/paddle/nn/functional/activation.py.
+
+Most activations are kernel-driven schema ops (ops/ops.yaml `kernel:`
+entries over ops/kernels.py; wrappers generated into
+ops/generated/op_wrappers.py) and re-exported here.  What stays
+hand-written: the inplace variants (tape-splice semantics) and the
+random activations (rrelu, gumbel_softmax — they thread the framework
+RNG stream as an extra input).
 """
 from __future__ import annotations
 
@@ -8,6 +15,12 @@ import jax
 import jax.numpy as jnp
 
 from ...core import dispatch as D
+from ...ops.generated.op_wrappers import (  # noqa: F401
+    celu, elu, gelu, glu, hardshrink, hardsigmoid, hardswish, hardtanh,
+    leaky_relu, log_sigmoid, log_softmax, maxout, mish, prelu, relu, relu6,
+    selu, sigmoid, silu, softmax, softplus, softshrink, softsign, swish,
+    tanh, tanhshrink, thresholded_relu,
+)
 
 __all__ = [
     "relu", "relu_", "relu6", "elu", "selu", "celu", "gelu", "silu", "swish",
@@ -16,27 +29,6 @@ __all__ = [
     "softmax", "softmax_", "softplus", "softsign", "mish", "maxout", "prelu",
     "rrelu", "thresholded_relu", "glu", "gumbel_softmax", "tanh", "tanh_",
 ]
-
-
-def _un(name, jfn, **static):
-    def op(x, name=None, **kw):
-        s = dict(static)
-        s.update({k: v for k, v in kw.items() if k in static})
-        return D.apply(op_name, jfn, (x,), s) if s else D.apply(op_name, jfn, (x,))
-    op_name = name
-    op.__name__ = name
-    return op
-
-
-relu = _un("relu", jax.nn.relu)
-relu6 = _un("relu6", jax.nn.relu6)
-sigmoid = _un("sigmoid", jax.nn.sigmoid)
-silu = _un("silu", jax.nn.silu)
-softsign = _un("softsign", jax.nn.soft_sign)
-tanh = _un("tanh", jnp.tanh)
-log_sigmoid = _un("log_sigmoid", jax.nn.log_sigmoid)
-tanhshrink = _un("tanhshrink", lambda x: x - jnp.tanh(x))
-mish = _un("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
 
 
 def relu_(x, name=None):
@@ -51,112 +43,10 @@ def tanh_(x, name=None):
     return x
 
 
-def elu(x, alpha=1.0, name=None):
-    return D.apply("elu", lambda a, alpha: jax.nn.elu(a, alpha), (x,), {"alpha": float(alpha)})
-
-
-def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
-    return D.apply("selu",
-                   lambda a, scale, alpha: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)),
-                   (x,), {"scale": float(scale), "alpha": float(alpha)})
-
-
-def celu(x, alpha=1.0, name=None):
-    return D.apply("celu", lambda a, alpha: jax.nn.celu(a, alpha), (x,), {"alpha": float(alpha)})
-
-
-def gelu(x, approximate=False, name=None):
-    return D.apply("gelu", lambda a, approx: jax.nn.gelu(a, approximate=approx),
-                   (x,), {"approx": bool(approximate)})
-
-
-def swish(x, name=None):
-    return silu(x)
-
-
-def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
-    return D.apply("hardsigmoid",
-                   lambda a, slope, offset: jnp.clip(slope * a + offset, 0.0, 1.0),
-                   (x,), {"slope": float(slope), "offset": float(offset)})
-
-
-def hardswish(x, name=None):
-    return D.apply("hardswish", lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, (x,))
-
-
-def hardtanh(x, min=-1.0, max=1.0, name=None):
-    return D.apply("hardtanh", lambda a, mn, mx: jnp.clip(a, mn, mx),
-                   (x,), {"mn": float(min), "mx": float(max)})
-
-
-def hardshrink(x, threshold=0.5, name=None):
-    return D.apply("hardshrink",
-                   lambda a, t: jnp.where(jnp.abs(a) > t, a, jnp.zeros((), a.dtype)),
-                   (x,), {"t": float(threshold)})
-
-
-def softshrink(x, threshold=0.5, name=None):
-    return D.apply("softshrink",
-                   lambda a, t: jnp.where(a > t, a - t, jnp.where(a < -t, a + t, jnp.zeros((), a.dtype))),
-                   (x,), {"t": float(threshold)})
-
-
-def leaky_relu(x, negative_slope=0.01, name=None):
-    return D.apply("leaky_relu",
-                   lambda a, slope: jax.nn.leaky_relu(a, slope),
-                   (x,), {"slope": float(negative_slope)})
-
-
-def softmax(x, axis=-1, dtype=None, name=None):
-    return D.apply("softmax", lambda a, axis: jax.nn.softmax(a, axis=axis),
-                   (x,), {"axis": int(axis)})
-
-
 def softmax_(x, axis=-1, dtype=None, name=None):
     out = softmax(x, axis, dtype)
     x._data, x._grad_node, x._output_index = out._data, out._grad_node, out._output_index
     return x
-
-
-def log_softmax(x, axis=-1, dtype=None, name=None):
-    return D.apply("log_softmax", lambda a, axis: jax.nn.log_softmax(a, axis=axis),
-                   (x,), {"axis": int(axis)})
-
-
-def softplus(x, beta=1.0, threshold=20.0, name=None):
-    return D.apply("softplus",
-                   lambda a, beta, threshold: jnp.where(
-                       beta * a > threshold, a, jax.nn.softplus(beta * a) / beta),
-                   (x,), {"beta": float(beta), "threshold": float(threshold)})
-
-
-def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
-    return D.apply("thresholded_relu",
-                   lambda a, t, v: jnp.where(a > t, a, jnp.asarray(v, a.dtype)),
-                   (x,), {"t": float(threshold), "v": float(value)})
-
-
-def maxout(x, groups, axis=1, name=None):
-    def _maxout(a, groups, axis):
-        c = a.shape[axis]
-        new_shape = list(a.shape)
-        new_shape[axis] = c // groups
-        new_shape.insert(axis + 1, groups)
-        return jnp.max(a.reshape(new_shape), axis=axis + 1)
-    return D.apply("maxout", _maxout, (x,), {"groups": int(groups), "axis": int(axis)})
-
-
-def prelu(x, weight, data_format="NCHW", name=None):
-    def _prelu(a, w, data_format):
-        if w.size == 1:
-            w_b = w.reshape(())
-        else:
-            shape = [1] * a.ndim
-            ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
-            shape[ch_axis] = w.size
-            w_b = w.reshape(shape)
-        return jnp.where(a > 0, a, w_b * a)
-    return D.apply("prelu", _prelu, (x, weight), {"data_format": data_format})
 
 
 def rrelu(x, lower=0.125, upper=0.3333333, training=True, name=None):
@@ -169,12 +59,6 @@ def rrelu(x, lower=0.125, upper=0.3333333, training=True, name=None):
                        (key, x), {"lo": float(lower), "hi": float(upper)})
     mid = (lower + upper) / 2.0
     return leaky_relu(x, mid)
-
-
-def glu(x, axis=-1, name=None):
-    def _glu(a, axis):
-        return jax.nn.glu(a, axis=axis)
-    return D.apply("glu", _glu, (x,), {"axis": int(axis)})
 
 
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):

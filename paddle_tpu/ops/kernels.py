@@ -572,3 +572,399 @@ def cross(x, y, axis=9):
                 f"cross: no dimension of size 3 in shape {tuple(x.shape)}; "
                 "pass axis explicitly")
     return jnp.cross(x, y, axis=ax)
+
+
+# -- activations (fourth tranche; reference nn/functional/activation.py) ----
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def silu(x):
+    return jax.nn.silu(x)
+
+
+def swish(x):
+    return jax.nn.silu(x)
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, float(alpha))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def celu(x, alpha=1.0):
+    return jax.nn.celu(x, float(alpha))
+
+
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=bool(approximate))
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5):
+    return jnp.clip(float(slope) * x + float(offset), 0.0, 1.0)
+
+
+def hardswish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+def hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, float(min), float(max))
+
+
+def hardshrink(x, threshold=0.5):
+    t = float(threshold)
+    return jnp.where(jnp.abs(x) > t, x, jnp.zeros((), x.dtype))
+
+
+def softshrink(x, threshold=0.5):
+    t = float(threshold)
+    return jnp.where(x > t, x - t,
+                     jnp.where(x < -t, x + t, jnp.zeros((), x.dtype)))
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, float(negative_slope))
+
+
+def _maybe_cast(x, dtype):
+    if dtype is None:
+        return x
+    from ..core.dtype import convert_dtype, to_jax_dtype
+    return x.astype(to_jax_dtype(convert_dtype(dtype)))
+
+
+def softmax(x, axis=-1, dtype=None):
+    # reference softmax casts to `dtype` BEFORE the op when given
+    return jax.nn.softmax(_maybe_cast(x, dtype), axis=int(axis))
+
+
+def log_softmax(x, axis=-1, dtype=None):
+    return jax.nn.log_softmax(_maybe_cast(x, dtype), axis=int(axis))
+
+
+def softplus(x, beta=1.0, threshold=20.0):
+    beta, threshold = float(beta), float(threshold)
+    return jnp.where(beta * x > threshold, x,
+                     jax.nn.softplus(beta * x) / beta)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0):
+    return jnp.where(x > float(threshold), x,
+                     jnp.asarray(float(value), x.dtype))
+
+
+def maxout(x, groups, axis=1):
+    groups, axis = int(groups), int(axis)
+    c = x.shape[axis]
+    new_shape = list(x.shape)
+    new_shape[axis] = c // groups
+    new_shape.insert(axis + 1, groups)
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+def prelu(x, weight, data_format="NCHW"):
+    if weight.size == 1:
+        w_b = weight.reshape(())
+    else:
+        shape = [1] * x.ndim
+        ch_axis = 1 if data_format[1] == "C" else x.ndim - 1
+        shape[ch_axis] = weight.size
+        w_b = weight.reshape(shape)
+    return jnp.where(x > 0, x, w_b * x)
+
+
+def glu(x, axis=-1):
+    return jax.nn.glu(x, axis=int(axis))
+
+
+# -- linalg (fifth tranche; jnp.linalg / lax.linalg lower natively on XLA) --
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+
+def cholesky_solve(x, y, upper=False):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+def cholesky_inverse(x, upper=False):
+    Lf = x.astype(jnp.float32)
+    eye = jnp.eye(Lf.shape[-1], dtype=jnp.float32)
+    # cho_solve's tuple is (c, LOWER): paddle's upper flag is inverted
+    return jax.scipy.linalg.cho_solve((Lf, not upper), eye)
+
+
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=bool(rowvar))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    # fweights/aweights accepted for signature parity, unused (hand parity)
+    return jnp.cov(x, rowvar=bool(rowvar), ddof=1 if ddof else 0)
+
+
+def det(x):
+    return jnp.linalg.det(x)
+
+
+def dist(x, y, p=2):
+    d = jnp.abs(x - y)
+    import math as _math
+    if p == _math.inf:
+        return jnp.max(d)
+    if p == -_math.inf:
+        return jnp.min(d)
+    if p == 0:
+        return jnp.sum((d != 0).astype(d.dtype))
+    p = float(p)
+    return jnp.sum(d ** p) ** (1.0 / p)
+
+
+def eigh(x, UPLO="L"):
+    return tuple(jnp.linalg.eigh(x, symmetrize_input=True))
+
+
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x)
+
+
+def inv(x):
+    return jnp.linalg.inv(x)
+
+
+def matrix_exp(x):
+    return jax.scipy.linalg.expm(x)
+
+
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, int(n))
+
+
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=float(rcond), hermitian=bool(hermitian))
+
+
+def slogdet(x):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logabs])
+
+
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=bool(full_matrices))
+
+
+def svdvals(x):
+    return jnp.linalg.svd(x, compute_uv=False)
+
+
+def qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, trans=1 if transpose else 0, lower=not upper,
+        unit_diagonal=bool(unitriangular))
+
+
+def tensordot(x, y, axes=2):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary"):
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    if float(p) == 2.0:
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 0.0)
+    return jnp.sum(jnp.abs(diff) ** float(p), axis=-1) ** (1.0 / float(p))
+
+
+# -- logic ------------------------------------------------------------------
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=float(rtol), atol=float(atol),
+                       equal_nan=bool(equal_nan))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=float(rtol), atol=float(atol),
+                        equal_nan=bool(equal_nan))
+
+
+def equal_all(x, y):
+    if x.shape != y.shape:       # static at trace time
+        return jnp.asarray(False)
+    return jnp.all(x == y)
+
+
+# -- math (fifth tranche) ---------------------------------------------------
+def float_power(x, y):
+    return jnp.power(x.astype(jnp.float64), y)
+
+
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x, axis = x.ravel(), 0
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=int(axis))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0,
+                   keepdims=bool(keepdim))
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0,
+                   keepdims=bool(keepdim))
+
+
+def numel(x):
+    return jnp.asarray(x.size, jnp.int64)
+
+
+def take(x, index, mode="raise"):
+    flat = x.ravel()
+    n = flat.shape[0]
+    if mode == "wrap":
+        index = jnp.mod(index, n)
+    elif mode == "clip":
+        index = jnp.clip(index, -n, n - 1)
+    index = jnp.where(index < 0, index + n, index)
+    return flat[index]
+
+
+def vander(x, n=None, increasing=False):
+    return jnp.vander(x, N=None if n is None else int(n),
+                      increasing=bool(increasing))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    s, b = float(scale), float(bias)
+    return x * s + b if bias_after_scale else (x + b) * s
+
+
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=bool(keepdim),
+                        method=interpolation)
+
+
+def nanquantile(x, q, axis=None, keepdim=False):
+    return jnp.nanquantile(x, jnp.asarray(q), axis=axis,
+                           keepdims=bool(keepdim))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    k, axis = int(k), int(axis)
+    sorted_a = jnp.sort(x, axis=axis)
+    idx_a = jnp.argsort(x, axis=axis)
+    sel = jnp.asarray([k - 1])
+    vals = jnp.take(sorted_a, sel, axis=axis)
+    idxs = jnp.take(idx_a, sel, axis=axis)
+    if not keepdim:
+        vals, idxs = vals.squeeze(axis), idxs.squeeze(axis)
+    return vals, idxs.astype(jnp.int64)
+
+
+def _cum_extreme(x, axis, op):
+    if axis is None:
+        x, axis = x.ravel(), 0
+    axis = int(axis)
+    vals = jax.lax.associative_scan(op, x, axis=axis)
+    n = x.shape[axis]
+    ar = jnp.arange(n).reshape(
+        [-1 if i == (axis % x.ndim) else 1 for i in range(x.ndim)])
+    idx = jax.lax.associative_scan(jnp.maximum,
+                                   jnp.where(x == vals, ar, -1), axis=axis)
+    return vals, idx.astype(jnp.int64)
+
+
+def cummax(x, axis=None, dtype="int64"):
+    return _cum_extreme(x, axis, jnp.maximum)
+
+
+def cummin(x, axis=None, dtype="int64"):
+    return _cum_extreme(x, axis, jnp.minimum)
+
+
+def renorm(x, p, axis, max_norm):
+    p, max_norm = float(p), float(max_norm)
+    axis = int(axis) % x.ndim          # normalize negative axis
+    dims = tuple(i for i in range(x.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+def combinations(x, r=2, with_replacement=False):
+    import itertools
+    import numpy as _np
+    n = x.shape[0]
+    idx = (itertools.combinations_with_replacement(range(n), int(r))
+           if with_replacement else itertools.combinations(range(n), int(r)))
+    idx = _np.asarray(list(idx), dtype=_np.int64)
+    if idx.size == 0:
+        return jnp.zeros((0, int(r)), x.dtype)
+    return jnp.take(x, jnp.asarray(idx.ravel()), axis=0).reshape(-1, int(r))
+
+
+# -- variadic tensor-list ops (Tensor[] codegen support) --------------------
+def concat(*xs, axis=0):
+    return jnp.concatenate(xs, axis=int(axis))
+
+
+def stack(*xs, axis=0):
+    return jnp.stack(xs, axis=int(axis))
+
+
+def vstack(*xs):
+    return jnp.vstack(xs)
+
+
+def hstack(*xs):
+    return jnp.hstack(xs)
+
+
+def dstack(*xs):
+    return jnp.dstack(xs)
+
+
+def multi_dot(*xs):
+    return jnp.linalg.multi_dot(xs)

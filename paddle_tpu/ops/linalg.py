@@ -64,53 +64,12 @@ def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
                    (x,), {"p": p, "axis": tuple(axis), "keepdim": bool(keepdim)})
 
 
-def dist(x, y, p=2, name=None):
-    from . import math as _m
-    return norm(_m.subtract(x, y), p)
-
-
 def _simple(name, jfn, n_out=1):
     def op(x, *args, **kwargs):
         ts = (x,) + tuple(a for a in args if isinstance(a, Tensor))
         return D.apply(name, jfn, ts)
     op.__name__ = name
     return op
-
-
-cholesky_impl = lambda a, upper: jnp.linalg.cholesky(a) if not upper else jnp.swapaxes(jnp.linalg.cholesky(a), -1, -2).conj()
-
-
-def cholesky(x, upper=False, name=None):
-    return D.apply("cholesky", cholesky_impl, (x,), {"upper": bool(upper)})
-
-
-def cholesky_solve(x, y, upper=False, name=None):
-    def _impl(b, chol, upper):
-        return jax.scipy.linalg.cho_solve((chol, not upper), b)
-    return D.apply("cholesky_solve", _impl, (x, y), {"upper": bool(upper)})
-
-
-def qr(x, mode="reduced", name=None):
-    out = D.apply("qr", lambda a, mode: jnp.linalg.qr(a, mode=mode), (x,), {"mode": mode})
-    return out
-
-
-def svd(x, full_matrices=False, name=None):
-    return D.apply("svd",
-                   lambda a, fm: jnp.linalg.svd(a, full_matrices=fm),
-                   (x,), {"fm": bool(full_matrices)})
-
-
-def svdvals(x, name=None):
-    return D.apply("svdvals", lambda a: jnp.linalg.svd(a, compute_uv=False), (x,))
-
-
-def inv(x, name=None):
-    return D.apply("inv", jnp.linalg.inv, (x,))
-
-
-def solve(x, y, name=None):
-    return D.apply("solve", jnp.linalg.solve, (x, y))
 
 
 def lstsq(x, y, rcond=None, driver=None, name=None):
@@ -163,20 +122,6 @@ def eigvals(x, name=None):
     return Tensor(jnp.asarray(np.linalg.eigvals(a)))
 
 
-def eigh(x, UPLO="L", name=None):
-    return D.apply("eigh", lambda a, lower: tuple(jnp.linalg.eigh(a, symmetrize_input=True)),
-                   (x,), {"lower": UPLO == "L"})
-
-
-def eigvalsh(x, UPLO="L", name=None):
-    return D.apply("eigvalsh", lambda a: jnp.linalg.eigvalsh(a), (x,))
-
-
-def matrix_power(x, n, name=None):
-    return D.apply("matrix_power", lambda a, n: jnp.linalg.matrix_power(a, n),
-                   (x,), {"n": int(n)})
-
-
 def matrix_rank(x, tol=None, hermitian=False, name=None):
     def _impl(a, tol, hermitian):
         sv = jnp.abs(jnp.linalg.eigvalsh(a)) if hermitian else jnp.linalg.svd(a, compute_uv=False)
@@ -191,45 +136,6 @@ def matrix_rank(x, tol=None, hermitian=False, name=None):
 builtins_max = max
 
 
-def pinv(x, rcond=1e-15, hermitian=False, name=None):
-    return D.apply("pinv", lambda a, rcond, hermitian: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian),
-                   (x,), {"rcond": float(rcond) if not isinstance(rcond, Tensor) else rcond.item(),
-                          "hermitian": bool(hermitian)})
-
-
-def det(x, name=None):
-    return D.apply("det", jnp.linalg.det, (x,))
-
-
-def slogdet(x, name=None):
-    def _impl(a):
-        sign, logabs = jnp.linalg.slogdet(a)
-        return jnp.stack([sign, logabs])
-    return D.apply("slogdet", _impl, (x,))
-
-
-def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
-    def _impl(a, b, upper, transpose, unit):
-        return jax.scipy.linalg.solve_triangular(a, b, trans=1 if transpose else 0,
-                                                 lower=not upper, unit_diagonal=unit)
-    return D.apply("triangular_solve", _impl, (x, y),
-                   {"upper": bool(upper), "transpose": bool(transpose),
-                    "unit": bool(unitriangular)})
-
-
-
-
-def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
-    def _impl(a, rowvar, ddof):
-        return jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0)
-    return D.apply("cov", _impl, (x,), {"rowvar": bool(rowvar), "ddof": bool(ddof)})
-
-
-def corrcoef(x, rowvar=True, name=None):
-    return D.apply("corrcoef", lambda a, rowvar: jnp.corrcoef(a, rowvar=rowvar),
-                   (x,), {"rowvar": bool(rowvar)})
-
-
 def householder_product(x, tau, name=None):
     def _impl(a, tau):
         m, n = a.shape[-2], a.shape[-1]
@@ -240,33 +146,6 @@ def householder_product(x, tau, name=None):
             out = out @ H
         return out[:, :n]
     return D.apply("householder_product", _impl, (x, tau))
-
-
-def matrix_exp(x, name=None):
-    return D.apply("matrix_exp", jax.scipy.linalg.expm, (x,))
-
-
-def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
-    def _impl(a, b, p):
-        diff = a[..., :, None, :] - b[..., None, :, :]
-        if p == 2.0:
-            return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 0.0)
-        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
-    return D.apply("cdist", _impl, (x, y), {"p": float(p)})
-
-
-def multi_dot(x, name=None):
-    def _impl(*arrs):
-        return jnp.linalg.multi_dot(arrs)
-    return D.apply("multi_dot", _impl, tuple(x))
-
-
-def tensordot(x, y, axes=2, name=None):
-    if isinstance(axes, Tensor):
-        axes = axes.tolist()
-    ax = axes if isinstance(axes, int) else tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in axes)
-    return D.apply("tensordot", lambda a, b, axes: jnp.tensordot(a, b, axes=axes),
-                   (x, y), {"axes": ax})
 
 
 def pca_lowrank(x, q=None, center=True, niter=2, name=None):
@@ -295,18 +174,6 @@ def cond(x, p=None, name=None):
     if isinstance(pk, float) and pk in (2.0, -2.0):
         pk = int(pk)
     return D.apply("cond", impl, (x,), {"p": pk})
-
-
-def cholesky_inverse(x, upper=False, name=None):
-    """Inverse from a Cholesky factor (reference cholesky_inverse)."""
-    def impl(L, upper):
-        Lf = L.astype(jnp.float32)
-        import jax.scipy.linalg as jsl
-        eye = jnp.eye(Lf.shape[-1], dtype=jnp.float32)
-        # cho_solve's tuple is (c, LOWER): paddle's upper flag is inverted
-        return jsl.cho_solve((Lf, not upper), eye)
-
-    return D.apply("cholesky_inverse", impl, (x,), {"upper": bool(upper)})
 
 
 def ormqr(x, tau, other, left=True, transpose=False, name=None):
@@ -363,4 +230,8 @@ def svd_lowrank(x, q=6, niter=2, M=None, name=None):
 
 
 # kernel-driven (generated from ops.yaml `kernel:` over ops/kernels.py)
-from .generated.op_wrappers import cross  # noqa: E402,F401
+from .generated.op_wrappers import (  # noqa: E402,F401
+    cdist, cholesky, cholesky_inverse, cholesky_solve, corrcoef, cov, cross,
+    det, dist, eigh, eigvalsh, inv, matrix_exp, matrix_power, multi_dot,
+    pinv, qr, slogdet, solve, svd, svdvals, tensordot, triangular_solve,
+)

@@ -18,28 +18,6 @@ __all__ = [
 ]
 
 
-
-
-def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
-    return D.apply("isclose",
-                   lambda a, b, rtol, atol, equal_nan: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
-                   (x, y), {"rtol": float(rtol), "atol": float(atol), "equal_nan": bool(equal_nan)})
-
-
-def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
-    return D.apply("allclose",
-                   lambda a, b, rtol, atol, equal_nan: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
-                   (x, y), {"rtol": float(rtol), "atol": float(atol), "equal_nan": bool(equal_nan)})
-
-
-def equal_all(x, y, name=None):
-    return D.apply("equal_all",
-                   lambda a, b: jnp.asarray(a.shape == b.shape and bool(jnp.all(a == b))
-                                            if a.shape == b.shape else False)
-                   if a.shape != b.shape else jnp.all(a == b),
-                   (x, y))
-
-
 def is_tensor(x):
     return isinstance(x, Tensor)
 
@@ -68,3 +46,9 @@ from .generated.op_wrappers import (  # noqa: E402,F401
 )
 
 bitwise_invert = bitwise_not
+
+
+# kernel-driven (generated from ops.yaml `kernel:` over ops/kernels.py)
+from .generated.op_wrappers import (  # noqa: E402,F401
+    allclose, equal_all, isclose,
+)

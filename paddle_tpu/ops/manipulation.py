@@ -60,30 +60,6 @@ def view_as(x, other, name=None):
     return reshape(x, other.shape)
 
 
-def concat(x, axis=0, name=None):
-    if isinstance(axis, Tensor):
-        axis = int(axis.item())
-    return D.apply("concat", lambda *arrs, axis: jnp.concatenate(arrs, axis=axis),
-                   tuple(x), {"axis": int(axis)})
-
-
-def stack(x, axis=0, name=None):
-    return D.apply("stack", lambda *arrs, axis: jnp.stack(arrs, axis=axis),
-                   tuple(x), {"axis": int(axis)})
-
-
-def vstack(x, name=None):
-    return D.apply("vstack", lambda *arrs: jnp.vstack(arrs), tuple(x))
-
-
-def hstack(x, name=None):
-    return D.apply("hstack", lambda *arrs: jnp.hstack(arrs), tuple(x))
-
-
-def dstack(x, name=None):
-    return D.apply("dstack", lambda *arrs: jnp.dstack(arrs), tuple(x))
-
-
 def _split_sections(x_shape, num_or_sections, axis):
     axis = axis % len(x_shape)
     n = x_shape[axis]
@@ -443,4 +419,8 @@ from .generated.op_wrappers import (  # noqa: E402,F401
     masked_fill, masked_scatter, moveaxis, put_along_axis, repeat_interleave,
     reshape, roll, scatter, scatter_nd, scatter_nd_add, sort, squeeze,
     swapaxes, take_along_axis, tile, topk, transpose, unflatten, unsqueeze,
+)
+
+from .generated.op_wrappers import (  # noqa: E402,F401
+    concat, dstack, hstack, stack, vstack,
 )

@@ -47,41 +47,12 @@ def _axis(axis):
     return int(axis)
 
 
-def float_power(x, y, name=None):
-    return D.apply("float_power", lambda a, b: jnp.power(a.astype(jnp.float64), b), (x, y))
-
-
 # ---------------- matmul family ----------------
 
 def einsum(equation, *operands):
     ops = operands[0] if len(operands) == 1 and isinstance(operands[0], (list, tuple)) else operands
     return D.apply("einsum", lambda *arrs, equation: jnp.einsum(equation, *arrs),
                    tuple(ops), {"equation": equation})
-
-
-def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
-    def _scale(a, s, b, after):
-        return a * s + b if after else (a + b) * s
-    if isinstance(scale, Tensor):
-        scale = scale.item()
-    out = D.apply("scale", _scale, (x,),
-                  {"s": float(scale), "b": float(bias), "after": bool(bias_after_scale)})
-    return out
-
-
-def clip(x, min=None, max=None, name=None):
-    def _clip(a, mn, mx):
-        return jnp.clip(a, mn, mx)
-    mn = min.item() if isinstance(min, Tensor) else min
-    mx = max.item() if isinstance(max, Tensor) else max
-    return D.apply("clip", _clip, (x,), {"mn": mn, "mx": mx})
-
-
-def lerp(x, y, weight, name=None):
-    if isinstance(weight, (int, float)):
-        return D.apply("lerp", lambda a, b, w: a + w * (b - a), (x, y),
-                       {"w": float(weight)})
-    return D.apply("lerp3", lambda a, b, w: a + w * (b - a), (x, y, weight))
 
 
 def increment(x, value=1.0, name=None):
@@ -101,17 +72,6 @@ def multiply_(x, y, name=None):
 
 
 # ---------------- reductions ----------------
-
-def std(x, axis=None, unbiased=True, keepdim=False, name=None):
-    return D.apply("std",
-                   lambda a, axis, ddof, keepdim: jnp.std(a, axis=axis, ddof=ddof, keepdims=keepdim),
-                   (x,), {"axis": _axis(axis), "ddof": 1 if unbiased else 0, "keepdim": bool(keepdim)})
-
-
-def var(x, axis=None, unbiased=True, keepdim=False, name=None):
-    return D.apply("var",
-                   lambda a, axis, ddof, keepdim: jnp.var(a, axis=axis, ddof=ddof, keepdims=keepdim),
-                   (x,), {"axis": _axis(axis), "ddof": 1 if unbiased else 0, "keepdim": bool(keepdim)})
 
 
 def median(x, axis=None, keepdim=False, mode="avg", name=None):
@@ -135,23 +95,6 @@ def nanmedian(x, axis=None, keepdim=False, name=None):
     return D.apply("nanmedian",
                    lambda a, axis, keepdim: jnp.nanmedian(a, axis=axis, keepdims=keepdim),
                    (x,), {"axis": _axis(axis), "keepdim": bool(keepdim)})
-
-
-def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
-    def _q(a, q, axis, keepdim, interpolation):
-        return jnp.quantile(a, jnp.asarray(q), axis=axis, keepdims=keepdim,
-                            method=interpolation)
-    qq = tuple(q) if isinstance(q, (list, tuple)) else float(q)
-    return D.apply("quantile", _q, (x,),
-                   {"q": qq, "axis": _axis(axis), "keepdim": bool(keepdim),
-                    "interpolation": interpolation})
-
-
-def nanquantile(x, q, axis=None, keepdim=False, name=None):
-    qq = tuple(q) if isinstance(q, (list, tuple)) else float(q)
-    return D.apply("nanquantile",
-                   lambda a, q, axis, keepdim: jnp.nanquantile(a, jnp.asarray(q), axis=axis, keepdims=keepdim),
-                   (x,), {"q": qq, "axis": _axis(axis), "keepdim": bool(keepdim)})
 
 
 def mode(x, axis=-1, keepdim=False, name=None):
@@ -180,23 +123,6 @@ def mode(x, axis=-1, keepdim=False, name=None):
     return D.apply("mode", _mode, (x,), {"axis": int(axis), "keepdim": bool(keepdim)})
 
 
-def kthvalue(x, k, axis=-1, keepdim=False, name=None):
-    def _kth(a, k, axis, keepdim):
-        sorted_a = jnp.sort(a, axis=axis)
-        idx_a = jnp.argsort(a, axis=axis)
-        sel = jnp.asarray([k - 1])
-        vals = jnp.take(sorted_a, sel, axis=axis)
-        idxs = jnp.take(idx_a, sel, axis=axis)
-        if not keepdim:
-            vals, idxs = vals.squeeze(axis), idxs.squeeze(axis)
-        return vals, idxs.astype(jnp.int64)
-    return D.apply("kthvalue", _kth, (x,), {"k": int(k), "axis": int(axis), "keepdim": bool(keepdim)})
-
-
-def numel(x, name=None):
-    return Tensor(jnp.asarray(x.size, jnp.int64))
-
-
 # ---------------- scans ----------------
 
 def _cum_extreme(fn):
@@ -204,43 +130,6 @@ def _cum_extreme(fn):
         vals = fn.accumulate(a, axis)
         return vals
     return impl
-
-
-def cummax(x, axis=None, dtype="int64", name=None):
-    def _cummax(a, axis):
-        if axis is None:
-            a = a.ravel()
-            axis = 0
-        vals = jax.lax.associative_scan(jnp.maximum, a, axis=axis)
-        n = a.shape[axis]
-        ar = jnp.arange(n).reshape([-1 if i == (axis % a.ndim) else 1 for i in range(a.ndim)])
-        eq = a == vals
-        idx = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, ar, -1), axis=axis)
-        return vals, idx.astype(jnp.int64)
-    return D.apply("cummax", _cummax, (x,), {"axis": None if axis is None else int(axis)})
-
-
-def cummin(x, axis=None, dtype="int64", name=None):
-    def _cummin(a, axis):
-        if axis is None:
-            a = a.ravel()
-            axis = 0
-        vals = jax.lax.associative_scan(jnp.minimum, a, axis=axis)
-        n = a.shape[axis]
-        ar = jnp.arange(n).reshape([-1 if i == (axis % a.ndim) else 1 for i in range(a.ndim)])
-        eq = a == vals
-        idx = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, ar, -1), axis=axis)
-        return vals, idx.astype(jnp.int64)
-    return D.apply("cummin", _cummin, (x,), {"axis": None if axis is None else int(axis)})
-
-
-def logcumsumexp(x, axis=None, name=None):
-    def _lcse(a, axis):
-        if axis is None:
-            a = a.ravel()
-            axis = 0
-        return jax.lax.associative_scan(jnp.logaddexp, a, axis=axis)
-    return D.apply("logcumsumexp", _lcse, (x,), {"axis": None if axis is None else int(axis)})
 
 
 # ---------------- misc ----------------
@@ -314,49 +203,6 @@ def broadcast_shape(x_shape, y_shape):
     return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
 
 
-def renorm(x, p, axis, max_norm, name=None):
-    def _renorm(a, p, axis, max_norm):
-        dims = tuple(i for i in range(a.ndim) if i != axis)
-        norms = jnp.sum(jnp.abs(a) ** p, axis=dims, keepdims=True) ** (1.0 / p)
-        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
-        return a * factor
-    return D.apply("renorm", _renorm, (x,),
-                   {"p": float(p), "axis": int(axis), "max_norm": float(max_norm)})
-
-
-def take(x, index, mode="raise", name=None):
-    def _take(a, idx, mode):
-        flat = a.ravel()
-        n = flat.shape[0]
-        if mode == "wrap":
-            idx = jnp.mod(idx, n)
-        elif mode == "clip":
-            idx = jnp.clip(idx, -n, n - 1)
-        idx = jnp.where(idx < 0, idx + n, idx)
-        return flat[idx]
-    return D.apply("take", _take, (x, index), {"mode": mode})
-
-
-def vander(x, n=None, increasing=False, name=None):
-    return D.apply("vander",
-                   lambda a, n, increasing: jnp.vander(a, N=n, increasing=increasing),
-                   (x,), {"n": None if n is None else int(n), "increasing": bool(increasing)})
-
-
-def combinations(x, r=2, with_replacement=False, name=None):
-    import itertools
-    n = x.shape[0]
-    idx = (itertools.combinations_with_replacement(range(n), r) if with_replacement
-           else itertools.combinations(range(n), r))
-    idx = np.asarray(list(idx), dtype=np.int64)
-    if idx.size == 0:
-        return Tensor(jnp.zeros((0, r), x._data.dtype))
-    from .manipulation import index_select
-    flat = index_select(x, Tensor(jnp.asarray(idx.ravel())), axis=0)
-    from .manipulation import reshape
-    return reshape(flat, [-1, r])
-
-
 # ---------------------------------------------------------------------------
 # Kernel-driven ops: the yaml schema is the source of truth; the wrappers are
 # generated (ops/generated/op_wrappers.py) from `kernel:` fields over
@@ -377,4 +223,10 @@ from .generated.op_wrappers import (  # noqa: E402,F401
     bitwise_right_shift, matmul, mm, bmm, dot, inner, outer, kron, addmm,
     stanh, logit, nan_to_num, trace, diagonal, rot90, log_normalize,
     reduce_as,
+)
+
+
+# kernel-driven (generated from ops.yaml `kernel:` over ops/kernels.py)
+from .generated.op_wrappers import (  # noqa: E402,F401
+    clip, combinations, cummax, cummin, float_power, kthvalue, lerp, logcumsumexp, nanquantile, numel, quantile, renorm, scale, std, take, vander, var,
 )
