@@ -140,17 +140,76 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
             scale_factor = [scale_factor] * nd
         out_size = tuple(int(s * f) for s, f in zip(spatial, scale_factor))
 
-    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
-             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    if mode == "area":
+        # area interpolation == adaptive average pooling (reference maps it
+        # the same way; torch 'area' likewise)
+        from .pooling import (adaptive_avg_pool1d, adaptive_avg_pool2d,
+                              adaptive_avg_pool3d)
+        pool = {1: adaptive_avg_pool1d, 2: adaptive_avg_pool2d,
+                3: adaptive_avg_pool3d}[nd]
+        if data_format.endswith("C"):
+            raise NotImplementedError("area interpolate with channels-last; "
+                                      "transpose to NC* first")
+        return pool(x, out_size)
 
-    def _interp(a, out_size, jmode, channels_last):
-        if channels_last:
-            full = (a.shape[0],) + out_size + (a.shape[-1],)
-        else:
-            full = a.shape[:2] + out_size
-        return jax.image.resize(a, full, method=jmode).astype(a.dtype)
-    return D.apply("interpolate", _interp, (x,),
-                   {"out_size": out_size, "jmode": jmode,
+    # nearest / linear / cubic: gather-based separable resample honoring
+    # the reference's align_corners / align_mode conventions
+    # (reference interpolate kernels: align_corners=True ->
+    # src = d*(in-1)/(out-1); align_mode 0 -> half-pixel; align_mode 1 ->
+    # src = d*scale; nearest w/o corners -> floor(d*in/out), the legacy
+    # asymmetric map; cubic uses the Keys kernel A=-0.75)
+    def _resample(a, out_size, mode, align_corners, align_mode,
+                  channels_last):
+        nd_ = len(out_size)
+        axes = (tuple(range(1, 1 + nd_)) if channels_last
+                else tuple(range(2, 2 + nd_)))
+        out_dtype = a.dtype
+        if jnp.issubdtype(a.dtype, jnp.floating) and a.dtype != jnp.float32:
+            a = a.astype(jnp.float32)   # half dtypes blend in f32 (reference)
+        for ax, out_s in zip(axes, out_size):
+            in_s = a.shape[ax]
+            d = jnp.arange(out_s, dtype=jnp.float32)
+            if mode == "nearest":
+                if align_corners:
+                    idx = jnp.round(d * (in_s - 1) / max(out_s - 1, 1))
+                else:
+                    idx = jnp.floor(d * in_s / out_s)
+                a = jnp.take(a, jnp.clip(idx, 0, in_s - 1).astype(jnp.int32),
+                             axis=ax)
+                continue
+            if align_corners:
+                src = d * (in_s - 1) / max(out_s - 1, 1)
+            elif align_mode == 1 and mode != "bicubic":
+                src = d * in_s / out_s
+            else:                        # half-pixel centers
+                src = (d + 0.5) * in_s / out_s - 0.5
+            wshape = [1] * a.ndim
+            wshape[ax] = out_s
+
+            def _tap(idx):
+                return jnp.take(a, jnp.clip(idx, 0, in_s - 1), axis=ax)
+            if mode == "bicubic":
+                lo = jnp.floor(src).astype(jnp.int32)
+                t_ = (src - lo).reshape(wshape)
+                A = -0.75                 # Keys kernel (reference + torch)
+
+                def k1(t):               # |t| <= 1
+                    return ((A + 2) * t - (A + 3)) * t * t + 1
+
+                def k2(t):               # 1 < |t| < 2
+                    return ((A * t - 5 * A) * t + 8 * A) * t - 4 * A
+                a = (_tap(lo - 1) * k2(t_ + 1) + _tap(lo) * k1(t_)
+                     + _tap(lo + 1) * k1(1 - t_) + _tap(lo + 2) * k2(2 - t_))
+                continue
+            src = jnp.clip(src, 0.0, in_s - 1)
+            lo = jnp.floor(src).astype(jnp.int32)
+            w = (src - lo).reshape(wshape)
+            a = _tap(lo) * (1 - w) + _tap(lo + 1) * w
+        return a.astype(out_dtype)
+    return D.apply("interpolate", _resample, (x,),
+                   {"out_size": out_size, "mode": mode,
+                    "align_corners": bool(align_corners),
+                    "align_mode": int(align_mode),
                     "channels_last": data_format.endswith("C")})
 
 

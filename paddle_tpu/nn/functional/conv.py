@@ -97,6 +97,18 @@ def _conv_transpose(x, w, b, strides, padding, out_padding, dilation, groups, nd
             (d_ * (k - 1) - p[0], d_ * (k - 1) - p[1] + op_)
             for k, p, d_, op_ in zip(k_spatial, padding, dilation, out_padding)
         )
+    # transposed conv is the gradient of forward conv: correlation with the
+    # SPATIALLY FLIPPED kernel (conv_general_dilated computes correlation,
+    # so an asymmetric kernel needs the explicit flip)
+    w = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+    if groups > 1:
+        # paddle weight [in_c, out_c/g, *k]; the IO-spec grouped call wants
+        # rhs (in_c/g, out_c, *k) with the group blocks laid out along O
+        in_c, out_per_g = w.shape[0], w.shape[1]
+        spatial = w.shape[2:]
+        w = w.reshape(groups, in_c // groups, out_per_g, *spatial)
+        w = jnp.swapaxes(w, 0, 1).reshape(in_c // groups,
+                                          groups * out_per_g, *spatial)
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=(1,) * nd, padding=pad, lhs_dilation=strides,
         rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups)

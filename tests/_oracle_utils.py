@@ -1,0 +1,45 @@
+"""Shared helpers for the torch/numpy oracle test files
+(test_loss_oracle.py, test_conv_pool_oracle.py)."""
+import numpy as np
+import torch
+
+import paddle_tpu as paddle
+
+
+def make_rng(name):
+    """Per-test deterministic stream: failures reproduce in isolation."""
+    import zlib
+    return np.random.RandomState(zlib.crc32(name.encode()) & 0x7FFFFFFF)
+
+
+def t(a, grad=False):
+    x = paddle.to_tensor(np.asarray(a))
+    if grad:
+        x.stop_gradient = False
+    return x
+
+
+def tt(a, grad=False):
+    x = torch.tensor(np.asarray(a))
+    if grad and x.dtype.is_floating_point:
+        x.requires_grad_(True)
+    return x
+
+
+def cmp_with_grads(p_out, t_out, p_in=(), t_in=(), tol=1e-4, gtol=5e-4):
+    """Forward allclose + (when inputs given) gradient allclose via a
+    sum-scalarized backward on both sides."""
+    np.testing.assert_allclose(np.asarray(p_out.numpy(), np.float64),
+                               t_out.detach().numpy().astype(np.float64),
+                               rtol=tol, atol=tol)
+    if not p_in:
+        return
+    p_out.sum().backward()
+    t_out.sum().backward()
+    for pi, ti in zip(p_in, t_in):
+        if ti.grad is None:
+            continue
+        assert pi.grad is not None
+        np.testing.assert_allclose(
+            np.asarray(pi.grad.numpy(), np.float64),
+            ti.grad.numpy().astype(np.float64), rtol=gtol, atol=gtol)

@@ -1,0 +1,321 @@
+"""Conv / pooling / norm / shaping functionals vs the torch oracle.
+
+Padding, stride, dilation, groups, data_format and count-include-pad
+semantics are where ports quietly diverge; this file pins them against
+an independent implementation, forward and gradient.
+Reference surfaces: python/paddle/nn/functional/{conv,pooling,norm,
+common}.py.
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+from _oracle_utils import make_rng, t, tt
+from _oracle_utils import cmp_with_grads as _cmp_shared
+
+
+@pytest.fixture
+def rng(request):
+    return make_rng(request.node.name)
+
+
+def _cmp(p_out, t_out, p_in=(), t_in=(), tol=1e-4, gtol=5e-4):
+    _cmp_shared(p_out, t_out, p_in, t_in, tol=tol, gtol=gtol)
+
+
+
+
+
+
+
+# ---------------------------------------------------------------------------
+# convolutions
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("stride,padding,dilation,groups", [
+    (1, 0, 1, 1), (2, 1, 1, 1), (1, 2, 2, 1), (1, 1, 1, 2),
+])
+def test_conv2d(rng, stride, padding, dilation, groups):
+    x = rng.randn(2, 4, 9, 9).astype("float32")
+    w = rng.randn(6, 4 // groups, 3, 3).astype("float32")
+    b = rng.randn(6).astype("float32")
+    px, tx = t(x, True), tt(x, True)
+    pw, tw = t(w, True), tt(w, True)
+    _cmp(F.conv2d(px, pw, t(b), stride=stride, padding=padding,
+                  dilation=dilation, groups=groups),
+         torch.nn.functional.conv2d(tx, tw, tt(b), stride=stride,
+                                    padding=padding, dilation=dilation,
+                                    groups=groups),
+         [px, pw], [tx, tw])
+
+
+def test_conv2d_nhwc(rng):
+    x = rng.randn(2, 8, 8, 3).astype("float32")        # NHWC
+    w = rng.randn(5, 3, 3, 3).astype("float32")        # OIHW (paddle layout)
+    out = F.conv2d(t(x), t(w), padding=1, data_format="NHWC")
+    ref = torch.nn.functional.conv2d(
+        tt(np.transpose(x, (0, 3, 1, 2))), tt(w), padding=1)
+    np.testing.assert_allclose(
+        out.numpy(), np.transpose(ref.numpy(), (0, 2, 3, 1)),
+        rtol=1e-4, atol=1e-4)
+
+
+def test_conv1d_conv3d(rng):
+    x1 = rng.randn(2, 3, 12).astype("float32")
+    w1 = rng.randn(4, 3, 3).astype("float32")
+    px, tx = t(x1, True), tt(x1, True)
+    _cmp(F.conv1d(px, t(w1), stride=2, padding=1),
+         torch.nn.functional.conv1d(tx, tt(w1), stride=2, padding=1),
+         [px], [tx])
+    x3 = rng.randn(1, 2, 5, 5, 5).astype("float32")
+    w3 = rng.randn(3, 2, 3, 3, 3).astype("float32")
+    _cmp(F.conv3d(t(x3), t(w3), padding=1),
+         torch.nn.functional.conv3d(tt(x3), tt(w3), padding=1))
+
+
+@pytest.mark.parametrize("stride,padding,output_padding,groups", [
+    (2, 0, 0, 1), (2, 1, 1, 1), (3, 1, 0, 1), (2, 1, 0, 2),
+])
+def test_conv2d_transpose(rng, stride, padding, output_padding, groups):
+    x = rng.randn(2, 4, 6, 6).astype("float32")
+    w = rng.randn(4, 6 // groups, 3, 3).astype("float32")  # [in, out/g, kh, kw]
+    px, tx = t(x, True), tt(x, True)
+    _cmp(F.conv2d_transpose(px, t(w), stride=stride, padding=padding,
+                            output_padding=output_padding, groups=groups),
+         torch.nn.functional.conv_transpose2d(
+             tx, tt(w), stride=stride, padding=padding,
+             output_padding=output_padding, groups=groups),
+         [px], [tx])
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("ks,stride,padding,ceil", [
+    (2, 2, 0, False), (3, 2, 1, False), (3, 2, 1, True),
+])
+def test_max_pool2d(rng, ks, stride, padding, ceil):
+    x = rng.randn(2, 3, 9, 9).astype("float32")
+    px, tx = t(x, True), tt(x, True)
+    _cmp(F.max_pool2d(px, ks, stride=stride, padding=padding,
+                      ceil_mode=ceil),
+         torch.nn.functional.max_pool2d(tx, ks, stride=stride,
+                                        padding=padding, ceil_mode=ceil),
+         [px], [tx])
+
+
+@pytest.mark.parametrize("exclusive", (True, False))
+def test_avg_pool2d_count_include_pad(rng, exclusive):
+    # paddle exclusive=True == torch count_include_pad=False
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    px, tx = t(x, True), tt(x, True)
+    _cmp(F.avg_pool2d(px, 3, stride=2, padding=1, exclusive=exclusive),
+         torch.nn.functional.avg_pool2d(
+             tx, 3, stride=2, padding=1,
+             count_include_pad=not exclusive),
+         [px], [tx])
+
+
+def test_pool_1d_3d(rng):
+    x1 = rng.randn(2, 3, 10).astype("float32")
+    _cmp(F.max_pool1d(t(x1), 2, stride=2),
+         torch.nn.functional.max_pool1d(tt(x1), 2, stride=2))
+    _cmp(F.avg_pool1d(t(x1), 2, stride=2),
+         torch.nn.functional.avg_pool1d(tt(x1), 2, stride=2))
+    x3 = rng.randn(1, 2, 6, 6, 6).astype("float32")
+    _cmp(F.max_pool3d(t(x3), 2, stride=2),
+         torch.nn.functional.max_pool3d(tt(x3), 2, stride=2))
+    _cmp(F.avg_pool3d(t(x3), 2, stride=2),
+         torch.nn.functional.avg_pool3d(tt(x3), 2, stride=2))
+
+
+@pytest.mark.parametrize("osize", (1, 3, (2, 4)))
+def test_adaptive_avg_pool2d(rng, osize):
+    x = rng.randn(2, 3, 8, 12).astype("float32")
+    px, tx = t(x, True), tt(x, True)
+    _cmp(F.adaptive_avg_pool2d(px, osize),
+         torch.nn.functional.adaptive_avg_pool2d(tx, osize),
+         [px], [tx])
+
+
+def test_adaptive_max_pool2d(rng):
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    _cmp(F.adaptive_max_pool2d(t(x), 2),
+         torch.nn.functional.adaptive_max_pool2d(tt(x), 2))
+
+
+# ---------------------------------------------------------------------------
+# norms
+# ---------------------------------------------------------------------------
+def test_layer_norm_affine(rng):
+    x = rng.randn(4, 6, 8).astype("float32")
+    w = rng.randn(8).astype("float32")
+    b = rng.randn(8).astype("float32")
+    px, tx = t(x, True), tt(x, True)
+    _cmp(F.layer_norm(px, 8, weight=t(w), bias=t(b)),
+         torch.nn.functional.layer_norm(tx, (8,), tt(w), tt(b)),
+         [px], [tx])
+
+
+def test_group_norm(rng):
+    x = rng.randn(2, 6, 4, 4).astype("float32")
+    w = rng.randn(6).astype("float32")
+    b = rng.randn(6).astype("float32")
+    px, tx = t(x, True), tt(x, True)
+    _cmp(F.group_norm(px, 3, weight=t(w), bias=t(b)),
+         torch.nn.functional.group_norm(tx, 3, tt(w), tt(b)),
+         [px], [tx])
+
+
+def test_batch_norm_training_stats(rng):
+    x = rng.randn(8, 4, 5, 5).astype("float32")
+    w = (rng.rand(4).astype("float32") + 0.5)
+    b = rng.randn(4).astype("float32")
+    rm_p, rv_p = np.zeros(4, "float32"), np.ones(4, "float32")
+    rm_t = torch.zeros(4)
+    rv_t = torch.ones(4)
+    prm, prv = t(rm_p.copy()), t(rv_p.copy())
+    out = F.batch_norm(t(x), prm, prv, weight=t(w), bias=t(b),
+                       training=True, momentum=0.9)
+    ref = torch.nn.functional.batch_norm(
+        tt(x), rm_t, rv_t, tt(w), tt(b), training=True, momentum=0.1)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+    # running stats update: paddle momentum m keeps m*old + (1-m)*new ==
+    # torch momentum (1-m)
+    np.testing.assert_allclose(prm.numpy(), rm_t.numpy(), rtol=1e-4,
+                               atol=1e-4)
+    # running VARIANCE: the reference uses the BIASED batch variance
+    # (batch_norm_kernel.cc:143 `/= N*sample_size`, no Bessel), unlike
+    # torch's unbiased running update — so compare against the formula,
+    # not the torch buffer
+    var_b = x.transpose(1, 0, 2, 3).reshape(4, -1).var(axis=1)
+    np.testing.assert_allclose(prv.numpy(), 0.9 * 1.0 + 0.1 * var_b,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_instance_norm(rng):
+    x = rng.randn(3, 4, 6, 6).astype("float32")
+    px, tx = t(x, True), tt(x, True)
+    _cmp(F.instance_norm(px),
+         torch.nn.functional.instance_norm(tx),
+         [px], [tx])
+
+
+def test_local_response_norm(rng):
+    x = rng.randn(2, 6, 5, 5).astype("float32")
+    _cmp(F.local_response_norm(t(x), size=3, alpha=1e-4, beta=0.75, k=1.0),
+         torch.nn.functional.local_response_norm(tt(x), 3, alpha=1e-4,
+                                                 beta=0.75, k=1.0))
+
+
+# ---------------------------------------------------------------------------
+# common shaping / embedding
+# ---------------------------------------------------------------------------
+def test_unfold_fold(rng):
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    pu = F.unfold(t(x), 3, strides=2, paddings=1)
+    tu = torch.nn.functional.unfold(tt(x), 3, stride=2, padding=1)
+    np.testing.assert_allclose(pu.numpy(), tu.numpy(), rtol=1e-5, atol=1e-5)
+    y = rng.randn(1, 3 * 9, 16).astype("float32")
+    pf = F.fold(t(y), output_sizes=8, kernel_sizes=3, strides=2, paddings=1)
+    tf_ = torch.nn.functional.fold(tt(y), 8, 3, stride=2, padding=1)
+    np.testing.assert_allclose(pf.numpy(), tf_.numpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_embedding_padding_idx(rng):
+    w = rng.randn(10, 4).astype("float32")
+    ids = np.array([[1, 2, 3], [3, 9, 0]], np.int64)
+    pw, tw = t(w, True), tt(w, True)
+    _cmp(F.embedding(t(ids), pw, padding_idx=3),
+         torch.nn.functional.embedding(tt(ids), tw, padding_idx=3),
+         [pw], [tw])
+
+
+def test_bilinear(rng):
+    x1 = rng.randn(4, 5).astype("float32")
+    x2 = rng.randn(4, 6).astype("float32")
+    w = rng.randn(3, 5, 6).astype("float32")
+    b = rng.randn(3).astype("float32")
+    p1, t1 = t(x1, True), tt(x1, True)
+    _cmp(F.bilinear(p1, t(x2), t(w), t(b)),
+         torch.nn.functional.bilinear(t1, tt(x2), tt(w), tt(b)),
+         [p1], [t1])
+
+
+def test_pixel_shuffle_unshuffle(rng):
+    x = rng.randn(2, 8, 3, 3).astype("float32")
+    _cmp(F.pixel_shuffle(t(x), 2),
+         torch.nn.functional.pixel_shuffle(tt(x), 2))
+    y = rng.randn(2, 2, 6, 6).astype("float32")
+    _cmp(F.pixel_unshuffle(t(y), 2),
+         torch.nn.functional.pixel_unshuffle(tt(y), 2))
+
+
+def test_channel_shuffle(rng):
+    x = rng.randn(2, 6, 4, 4).astype("float32")
+    _cmp(F.channel_shuffle(t(x), 3),
+         torch.nn.functional.channel_shuffle(tt(x), 3))
+
+
+@pytest.mark.parametrize("mode,align", [("nearest", False),
+                                        ("bilinear", False),
+                                        ("bilinear", True),
+                                        ("bicubic", False),
+                                        ("bicubic", True)])
+def test_interpolate(rng, mode, align):
+    x = rng.randn(2, 3, 6, 6).astype("float32")
+    kwargs = {} if mode == "nearest" else {"align_corners": align}
+    out = F.interpolate(t(x), size=(9, 9), mode=mode,
+                        align_corners=align if mode != "nearest" else False)
+    ref = torch.nn.functional.interpolate(tt(x), size=(9, 9), mode=mode,
+                                          **kwargs)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+@pytest.mark.parametrize("pmode", ("constant", "reflect", "replicate"))
+def test_pad_modes(rng, pmode):
+    x = rng.randn(2, 3, 5, 5).astype("float32")
+    px, tx = t(x, True), tt(x, True)
+    _cmp(F.pad(px, [1, 2, 1, 2], mode=pmode),
+         torch.nn.functional.pad(tx, (1, 2, 1, 2), mode=pmode),
+         [px], [tx])
+
+
+def test_dropout_eval_identity(rng):
+    x = rng.randn(4, 5).astype("float32")
+    np.testing.assert_array_equal(
+        F.dropout(t(x), p=0.5, training=False).numpy(), x)
+    np.testing.assert_array_equal(
+        F.dropout2d(t(x).reshape([1, 4, 5, 1]), p=0.5,
+                    training=False).numpy().reshape(4, 5), x)
+
+
+def test_label_smooth(rng):
+    y = np.eye(4, dtype="float32")[np.array([0, 2, 3])]
+    out = F.label_smooth(t(y), epsilon=0.1)
+    ref = y * (1 - 0.1) + 0.1 / 4
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_interpolate_area_matches_adaptive(rng):
+    x = rng.randn(2, 3, 6, 6).astype("float32")
+    out = F.interpolate(t(x), size=(3, 3), mode="area")
+    ref = torch.nn.functional.interpolate(tt(x), size=(3, 3), mode="area")
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_interpolate_bf16_blends_in_f32(rng):
+    x = rng.randn(1, 2, 5, 5).astype("float32")
+    lo = F.interpolate(paddle.to_tensor(x).astype("bfloat16"),
+                       size=(8, 8), mode="bilinear")
+    hi = F.interpolate(t(x), size=(8, 8), mode="bilinear")
+    assert str(lo.dtype).endswith("bfloat16")
+    # bf16 output quantization only: blend itself happened in f32
+    np.testing.assert_allclose(lo.astype("float32").numpy(), hi.numpy(),
+                               rtol=2e-2, atol=2e-2)

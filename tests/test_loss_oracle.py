@@ -15,47 +15,24 @@ import torch
 import paddle_tpu as paddle
 import paddle_tpu.nn.functional as F
 
-REDUCTIONS = ("mean", "sum", "none")
+from _oracle_utils import make_rng, t, tt
+from _oracle_utils import cmp_with_grads as _cmp_shared
 
 
 @pytest.fixture
 def rng(request):
-    """Per-test deterministic stream: failures reproduce in isolation
-    (a shared module-level RandomState would make each test's data depend
-    on which tests ran before it)."""
-    import zlib
-    return np.random.RandomState(zlib.crc32(request.node.name.encode())
-                                 & 0x7FFFFFFF)
+    return make_rng(request.node.name)
 
 
-def t(a, grad=False):
-    x = paddle.to_tensor(np.asarray(a))
-    if grad:
-        x.stop_gradient = False
-    return x
+def _cmp(p_out, t_out, p_in=(), t_in=(), tol=1e-5, gtol=1e-4):
+    _cmp_shared(p_out, t_out, p_in, t_in, tol=tol, gtol=gtol)
 
 
-def tt(a, grad=False):
-    x = torch.tensor(np.asarray(a))
-    if grad and x.dtype.is_floating_point:
-        x.requires_grad_(True)
-    return x
+REDUCTIONS = ("mean", "sum", "none")
 
 
-def _cmp(p_out, t_out, p_in, t_in, tol=1e-5, gtol=1e-4):
-    np.testing.assert_allclose(np.asarray(p_out.numpy(), np.float64),
-                               t_out.detach().numpy().astype(np.float64),
-                               rtol=tol, atol=tol)
-    ps, ts = p_out.sum(), t_out.sum()
-    ps.backward()
-    ts.backward()
-    for pi, ti in zip(p_in, t_in):
-        if ti.grad is None:
-            continue
-        assert pi.grad is not None
-        np.testing.assert_allclose(
-            np.asarray(pi.grad.numpy(), np.float64),
-            ti.grad.numpy().astype(np.float64), rtol=gtol, atol=gtol)
+
+
 
 
 @pytest.mark.parametrize("reduction", REDUCTIONS)
