@@ -400,6 +400,17 @@ class StudentT(Distribution):
                 - 0.5 * _m.log(df * math.pi) - _m.log(self.scale)
                 - ((df + 1.0) / 2.0) * _m.log1p(z * z / df))
 
+    def entropy(self):
+        # reference student_t.py:215: H = log(Γ(ν/2)Γ(1/2)σ√ν / Γ((1+ν)/2))
+        #   + (1+ν)/2 · (ψ((1+ν)/2) − ψ(ν/2)).  loc contributes no entropy
+        # but DOES contribute batch shape (the reference broadcasts all
+        # params at __init__), so broadcast the result over it.
+        df = self.df + self.loc * 0.0
+        half = (df + 1.0) / 2.0
+        return (_m.lgamma(df / 2.0) + 0.5 * math.log(math.pi)
+                + _m.log(self.scale) + 0.5 * _m.log(df) - _m.lgamma(half)
+                + half * (_m.digamma(half) - _m.digamma(df / 2.0)))
+
 
 class Dirichlet(ExponentialFamily):
     def __init__(self, concentration, name=None):
