@@ -404,12 +404,16 @@ class StudentT(Distribution):
         # reference student_t.py:215: H = log(Γ(ν/2)Γ(1/2)σ√ν / Γ((1+ν)/2))
         #   + (1+ν)/2 · (ψ((1+ν)/2) − ψ(ν/2)).  loc contributes no entropy
         # but DOES contribute batch shape (the reference broadcasts all
-        # params at __init__), so broadcast the result over it.
-        df = self.df + self.loc * 0.0
-        half = (df + 1.0) / 2.0
-        return (_m.lgamma(df / 2.0) + 0.5 * math.log(math.pi)
-                + _m.log(self.scale) + 0.5 * _m.log(df) - _m.lgamma(half)
-                + half * (_m.digamma(half) - _m.digamma(df / 2.0)))
+        # params at __init__) — broadcast the RESULT shape-wise, not via
+        # arithmetic (inf*0 would NaN-poison it).
+        from ..ops.manipulation import broadcast_to
+        df, half = self.df, (self.df + 1.0) / 2.0
+        out = (_m.lgamma(df / 2.0) + 0.5 * math.log(math.pi)
+               + _m.log(self.scale) + 0.5 * _m.log(df) - _m.lgamma(half)
+               + half * (_m.digamma(half) - _m.digamma(df / 2.0)))
+        if tuple(out.shape) != tuple(self.batch_shape):
+            out = broadcast_to(out, list(self.batch_shape))
+        return out
 
 
 class Dirichlet(ExponentialFamily):
