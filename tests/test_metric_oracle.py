@@ -1,0 +1,99 @@
+"""paddle.metric streaming metrics vs independent numpy computations.
+
+Reference: python/paddle/metric/metrics.py — Accuracy (top-k, streaming),
+Precision/Recall (binary, threshold 0.5), Auc (ROC, bucketed trapezoid).
+Each test streams MULTIPLE batches so accumulator state is exercised,
+and compares against a from-scratch whole-dataset computation.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import metric as M
+
+
+from _oracle_utils import make_rng
+
+
+@pytest.fixture
+def rng(request):
+    return make_rng(request.node.name)
+
+
+def _batches(rng, n_batches=4, bs=16, classes=5):
+    for _ in range(n_batches):
+        logits = rng.randn(bs, classes).astype("float32")
+        labels = rng.randint(0, classes, (bs, 1)).astype("int64")
+        yield logits, labels
+
+
+@pytest.mark.parametrize("k", (1, 2))
+def test_accuracy_topk_streaming(rng, k):
+    m = M.Accuracy(topk=(k,))
+    m.reset()
+    hits, total = 0, 0
+    for logits, labels in _batches(rng):
+        corr = m.compute(paddle.to_tensor(logits), paddle.to_tensor(labels))
+        m.update(corr)
+        topk = np.argsort(-logits, axis=-1)[:, :k]
+        hits += (topk == labels).any(-1).sum()
+        total += len(labels)
+    assert abs(float(np.asarray(m.accumulate())) - hits / total) < 1e-6
+
+
+def test_precision_recall_streaming(rng):
+    p, r = M.Precision(), M.Recall()
+    p.reset()
+    r.reset()
+    tp = fp = fn = 0
+    for _ in range(4):
+        preds = rng.rand(20).astype("float32")
+        labels = (rng.rand(20) > 0.6).astype("int64")
+        p.update(preds, labels)
+        r.update(preds, labels)
+        hard = preds > 0.5
+        tp += int(np.sum(hard & (labels == 1)))
+        fp += int(np.sum(hard & (labels == 0)))
+        fn += int(np.sum(~hard & (labels == 1)))
+    assert abs(float(p.accumulate()) - tp / max(tp + fp, 1)) < 1e-6
+    assert abs(float(r.accumulate()) - tp / max(tp + fn, 1)) < 1e-6
+
+
+def test_auc_matches_rank_statistic(rng):
+    """Bucketed-trapezoid AUC converges to the exact Mann-Whitney rank
+    statistic as num_thresholds grows."""
+    m = M.Auc(num_thresholds=4095)
+    m.reset()
+    all_p, all_l = [], []
+    for _ in range(4):
+        preds = rng.rand(50).astype("float32")
+        labels = (rng.rand(50) < preds).astype("int64")  # informative preds
+        m.update(np.stack([1 - preds, preds], -1), labels)
+        all_p.append(preds)
+        all_l.append(labels)
+    p = np.concatenate(all_p)
+    y = np.concatenate(all_l)
+    pos, neg = p[y == 1], p[y == 0]
+    # exact AUC: P(pos > neg) + 0.5 P(pos == neg)
+    gt = (pos[:, None] > neg[None, :]).mean()
+    eq = (pos[:, None] == neg[None, :]).mean()
+    exact = gt + 0.5 * eq
+    assert abs(float(m.accumulate()) - exact) < 2e-3
+
+
+def test_auc_degenerate_single_class(rng):
+    m = M.Auc()
+    m.reset()
+    preds = rng.rand(10).astype("float32")
+    m.update(np.stack([1 - preds, preds], -1), np.ones(10, "int64"))
+    assert float(m.accumulate()) == 0.0   # reference returns 0 w/o negatives
+
+
+def test_functional_accuracy(rng):
+    logits = rng.randn(12, 4).astype("float32")
+    labels = rng.randint(0, 4, (12, 1)).astype("int64")
+    acc = paddle.metric.accuracy(paddle.to_tensor(logits),
+                                 paddle.to_tensor(labels), k=2)
+    topk = np.argsort(-logits, axis=-1)[:, :2]
+    ref = (topk == labels).any(-1).mean()
+    assert abs(float(acc) - ref) < 1e-6
