@@ -1,0 +1,81 @@
+"""paddle.sparse vs the scipy.sparse oracle: conversions, arithmetic,
+matmul and SDDMM on random sparsity patterns (reference
+python/paddle/sparse over phi sparse kernels)."""
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse as psp
+
+from _oracle_utils import make_rng
+
+
+@pytest.fixture
+def rng(request):
+    return make_rng(request.node.name)
+
+
+def _rand_coo(rng, m, n, density=0.3):
+    mat = sp.random(m, n, density=density, random_state=rng,
+                    dtype="float32", format="coo")
+    idx = np.stack([mat.row, mat.col]).astype("int64")
+    return mat, psp.sparse_coo_tensor(paddle.to_tensor(idx),
+                                      paddle.to_tensor(mat.data),
+                                      shape=[m, n])
+
+
+def test_coo_to_dense_matches_scipy(rng):
+    mat, pt = _rand_coo(rng, 6, 5)
+    np.testing.assert_allclose(pt.to_dense().numpy(), mat.toarray(),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_csr_conversion_matches_scipy(rng):
+    mat, pt = _rand_coo(rng, 7, 4)
+    csr = pt.to_sparse_csr()
+    ref = mat.tocsr()
+    np.testing.assert_array_equal(np.asarray(csr.crows().numpy()),
+                                  ref.indptr)
+    np.testing.assert_array_equal(np.asarray(csr.cols().numpy()),
+                                  ref.indices)
+    np.testing.assert_allclose(csr.values().numpy(), ref.data,
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_add_multiply_matmul(rng):
+    a_s, a_p = _rand_coo(rng, 5, 6)
+    b_s, b_p = _rand_coo(rng, 5, 6)
+    np.testing.assert_allclose(psp.add(a_p, b_p).to_dense().numpy(),
+                               (a_s + b_s).toarray(), rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(
+        psp.multiply(a_p, b_p).to_dense().numpy(),
+        (a_s.multiply(b_s)).toarray(), rtol=1e-6, atol=1e-6)
+    dense = rng.randn(6, 3).astype("float32")
+    np.testing.assert_allclose(
+        psp.matmul(a_p, paddle.to_tensor(dense)).numpy(),
+        a_s @ dense, rtol=1e-5, atol=1e-5)
+
+
+def test_sddmm_masked_matmul(rng):
+    mask_s, mask_p = _rand_coo(rng, 5, 5, density=0.4)
+    x = rng.randn(5, 4).astype("float32")
+    y = rng.randn(4, 5).astype("float32")
+    out = psp.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y),
+                            mask_p)
+    full = x @ y
+    ref = sp.coo_matrix(((full * (mask_s.toarray() != 0))),
+                        shape=(5, 5)).toarray()
+    np.testing.assert_allclose(out.to_dense().numpy(), ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_unary_on_values_only(rng):
+    mat, pt = _rand_coo(rng, 6, 6)
+    # sparse relu/sin act on stored values; zeros stay zero
+    np.testing.assert_allclose(psp.relu(pt).to_dense().numpy(),
+                               np.maximum(mat.toarray(), 0),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(psp.sin(pt).to_dense().numpy(),
+                               np.sin(mat.toarray()),
+                               rtol=1e-6, atol=1e-6)
