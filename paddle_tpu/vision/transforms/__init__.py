@@ -89,7 +89,7 @@ class Normalize(BaseTransform):
 
 def resize(img, size, interpolation="bilinear"):
     arr = np.asarray(img)
-    chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4) and arr.shape[-1] not in (1, 3, 4)
+    chw = not _is_hwc(arr) and arr.ndim == 3
     if isinstance(size, int):
         h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
         if h < w:
@@ -119,7 +119,7 @@ class Resize(BaseTransform):
 
 
 def _crop(arr, top, left, h, w):
-    chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4) and arr.shape[-1] not in (1, 3, 4)
+    chw = not _is_hwc(arr) and arr.ndim == 3
     if chw:
         return arr[:, top:top + h, left:left + w]
     return arr[top:top + h, left:left + w]
@@ -131,7 +131,7 @@ class CenterCrop(BaseTransform):
 
     def _apply_image(self, img):
         arr = np.asarray(img)
-        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4) and arr.shape[-1] not in (1, 3, 4)
+        chw = not _is_hwc(arr) and arr.ndim == 3
         h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
         th, tw = self.size
         top = max((h - th) // 2, 0)
@@ -146,7 +146,7 @@ class RandomCrop(BaseTransform):
 
     def _apply_image(self, img):
         arr = np.asarray(img)
-        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4) and arr.shape[-1] not in (1, 3, 4)
+        chw = not _is_hwc(arr) and arr.ndim == 3
         h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
         th, tw = self.size
         top = np.random.randint(0, max(h - th, 0) + 1)
@@ -164,7 +164,7 @@ class RandomResizedCrop(BaseTransform):
 
     def _apply_image(self, img):
         arr = np.asarray(img)
-        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4) and arr.shape[-1] not in (1, 3, 4)
+        chw = not _is_hwc(arr) and arr.ndim == 3
         h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
         area = h * w
         for _ in range(10):
@@ -182,12 +182,14 @@ class RandomResizedCrop(BaseTransform):
 
 def hflip(img):
     arr = np.asarray(img)
-    return arr[..., ::-1].copy()
+    if _is_hwc(arr):                         # HWC: width is axis 1
+        return arr[:, ::-1].copy()
+    return arr[..., ::-1].copy()             # CHW / 2-D: width is last
 
 
 def vflip(img):
     arr = np.asarray(img)
-    chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4) and arr.shape[-1] not in (1, 3, 4)
+    chw = not _is_hwc(arr) and arr.ndim == 3
     if chw:
         return arr[:, ::-1].copy()
     return arr[::-1].copy()
@@ -236,7 +238,7 @@ class Pad(BaseTransform):
     def _apply_image(self, img):
         arr = np.asarray(img)
         l, t, r, b = self.padding
-        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4) and arr.shape[-1] not in (1, 3, 4)
+        chw = not _is_hwc(arr) and arr.ndim == 3
         if chw:
             pad = ((0, 0), (t, b), (l, r))
         elif arr.ndim == 3:
@@ -267,6 +269,32 @@ def _chw(img):
     return np.asarray(img, dtype=np.float32)
 
 
+def _is_chw(arr):
+    """Channels-first iff the leading dim looks like channels and the
+    trailing one does not."""
+    return (arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+            and arr.shape[-1] not in (1, 3, 4))
+
+
+def _is_hwc(arr):
+    """Channels-LAST only when positively identified; ambiguous layouts
+    (e.g. 2-channel flow fields, multispectral bands) default to CHW,
+    the framework's tensor convention."""
+    return (arr.ndim == 3 and arr.shape[-1] in (1, 3, 4)
+            and arr.shape[0] not in (1, 3, 4))
+
+
+def _to_chw(arr):
+    """Normalize a 3-d array to CHW; returns (chw_array, was_hwc)."""
+    if _is_hwc(arr):
+        return np.ascontiguousarray(np.moveaxis(arr, -1, 0)), True
+    return arr, False
+
+
+def _from_chw(arr, was_hwc):
+    return np.ascontiguousarray(np.moveaxis(arr, 0, -1)) if was_hwc else arr
+
+
 def _scale_max(arr):
     return 255.0 if arr.max() > 1 else 1.0
 
@@ -284,17 +312,17 @@ def adjust_contrast(img, contrast_factor):
 
 
 def adjust_saturation(img, saturation_factor):
-    arr = _chw(img)
+    arr, hwc = _to_chw(_chw(img))
     gray = (0.299 * arr[0] + 0.587 * arr[1] + 0.114 * arr[2])[None]
-    return np.clip(gray + saturation_factor * (arr - gray), 0,
-                   _scale_max(arr))
+    return _from_chw(np.clip(gray + saturation_factor * (arr - gray), 0,
+                             _scale_max(arr)), hwc)
 
 
 def adjust_hue(img, hue_factor):
     """Hue rotation in YIQ space (matrix form; reference adjust_hue)."""
     if not -0.5 <= hue_factor <= 0.5:
         raise ValueError("hue_factor must be in [-0.5, 0.5]")
-    arr = _chw(img)
+    arr, hwc = _to_chw(_chw(img))
     scale = _scale_max(arr)
     x = arr / scale
     theta = hue_factor * 2.0 * np.pi
@@ -307,13 +335,13 @@ def adjust_hue(img, hue_factor):
     t_rgb = np.linalg.inv(t_yiq)
     m = t_rgb @ rot @ t_yiq
     out = np.einsum("ij,jhw->ihw", m, x)
-    return np.clip(out, 0, 1.0) * scale
+    return _from_chw(np.clip(out, 0, 1.0) * scale, hwc)
 
 
 def to_grayscale(img, num_output_channels=1):
-    arr = _chw(img)
+    arr, hwc = _to_chw(_chw(img))
     gray = (0.299 * arr[0] + 0.587 * arr[1] + 0.114 * arr[2])[None]
-    return np.repeat(gray, num_output_channels, axis=0)
+    return _from_chw(np.repeat(gray, num_output_channels, axis=0), hwc)
 
 
 def crop(img, top, left, height, width):
@@ -324,7 +352,7 @@ def center_crop(img, output_size):
     arr = _chw(img)
     oh, ow = (output_size, output_size) if isinstance(output_size, int) \
         else output_size
-    h, w = arr.shape[-2:]
+    h, w = arr.shape[:2] if _is_hwc(arr) else arr.shape[-2:]
     return _crop(arr, (h - oh) // 2, (w - ow) // 2, oh, ow)
 
 
@@ -340,13 +368,20 @@ def pad(img, padding, fill=0, padding_mode="constant"):
     mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
             "symmetric": "symmetric"}[padding_mode]
     kw = {"constant_values": fill} if mode == "constant" else {}
+    if arr.ndim == 2:
+        return np.pad(arr, ((t, b), (l, r)), mode=mode, **kw)
+    if _is_hwc(arr):
+        return np.pad(arr, ((t, b), (l, r), (0, 0)), mode=mode, **kw)
     return np.pad(arr, ((0, 0), (t, b), (l, r)), mode=mode, **kw)
 
 
 def erase(img, i, j, h, w, v, inplace=False):
     arr = _chw(img) if not inplace else np.asarray(img)
     out = arr if inplace else arr.copy()
-    out[..., i:i + h, j:j + w] = v
+    if _is_hwc(out):
+        out[i:i + h, j:j + w, ...] = v
+    else:
+        out[..., i:i + h, j:j + w] = v
     return out
 
 
@@ -395,15 +430,19 @@ def _affine_matrix(angle, translate, scale, shear, center):
 
 def affine(img, angle, translate, scale, shear, interpolation="nearest",
            fill=0, center=None):
-    arr = _chw(img)
+    arr, hwc = _to_chw(_chw(img))
     h, w = arr.shape[-2:]
     ctr = center or ((w - 1) * 0.5, (h - 1) * 0.5)
-    return _warp(arr, _affine_matrix(angle, translate, scale, shear, ctr))
+    out = _warp(arr, _affine_matrix(angle, translate, scale, shear, ctr))
+    return _from_chw(out, hwc)
 
 
 def rotate(img, angle, interpolation="nearest", expand=False, center=None,
            fill=0):
-    return affine(img, angle, (0, 0), 1.0, (0.0, 0.0), interpolation, fill,
+    # rotate() is COUNTER-clockwise for positive angles (PIL rotate, the
+    # reference's backend), while affine()'s angle is clockwise-positive
+    # (torchvision convention the reference's affine follows) — negate.
+    return affine(img, -angle, (0, 0), 1.0, (0.0, 0.0), interpolation, fill,
                   center)
 
 
@@ -411,7 +450,7 @@ def perspective(img, startpoints, endpoints, interpolation="nearest",
                 fill=0):
     """Projective warp from 4 point pairs (reference functional
     perspective): solve the homography, inverse-warp."""
-    arr = _chw(img)
+    arr, hwc = _to_chw(_chw(img))
     A = []
     bvec = []
     for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
@@ -422,7 +461,7 @@ def perspective(img, startpoints, endpoints, interpolation="nearest",
     coeff = np.linalg.solve(np.asarray(A, np.float32),
                             np.asarray(bvec, np.float32))
     fwd = np.append(coeff, 1.0).reshape(3, 3)
-    return _warp(arr, np.linalg.inv(fwd))
+    return _from_chw(_warp(arr, np.linalg.inv(fwd)), hwc)
 
 
 class SaturationTransform(BaseTransform):
@@ -555,7 +594,9 @@ class RandomErasing(BaseTransform):
         arr = _chw(img)
         if np.random.rand() >= self.prob:
             return arr
-        c, h, w = arr.shape
+        hwc = _is_hwc(arr)
+        h, w, c = arr.shape if hwc else (arr.shape[1], arr.shape[2],
+                                         arr.shape[0])
         area = h * w
         for _ in range(10):
             target = np.random.uniform(*self.scale) * area
@@ -566,8 +607,11 @@ class RandomErasing(BaseTransform):
             if eh < h and ew < w:
                 i = np.random.randint(0, h - eh)
                 j_ = np.random.randint(0, w - ew)
-                v = (np.random.rand(c, eh, ew).astype(np.float32)
-                     if self.value == "random" else self.value)
+                if self.value == "random":
+                    shape = (eh, ew, c) if hwc else (c, eh, ew)
+                    v = np.random.rand(*shape).astype(np.float32)
+                else:
+                    v = self.value
                 return erase(arr, i, j_, eh, ew, v)
         return arr
 

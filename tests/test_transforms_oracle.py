@@ -1,0 +1,125 @@
+"""Vision transforms vs the PIL oracle on HWC uint8 images — the layout
+datasets actually yield (reference python/paddle/vision/transforms is
+PIL/cv2-backed, so PIL behavior IS the reference convention for the
+core geometric/photometric set)."""
+import numpy as np
+import pytest
+from PIL import Image, ImageEnhance
+
+import paddle_tpu.vision.transforms as T
+
+from _oracle_utils import make_rng
+
+
+@pytest.fixture
+def rng(request):
+    return make_rng(request.node.name)
+
+
+def _img(rng, h=8, w=10):
+    return (rng.rand(h, w, 3) * 255).astype("uint8")
+
+
+def test_hflip_vflip_exact(rng):
+    img = _img(rng)
+    pil = Image.fromarray(img)
+    np.testing.assert_array_equal(
+        np.asarray(T.hflip(img)),
+        np.asarray(pil.transpose(Image.FLIP_LEFT_RIGHT)))
+    np.testing.assert_array_equal(
+        np.asarray(T.vflip(img)),
+        np.asarray(pil.transpose(Image.FLIP_TOP_BOTTOM)))
+    # CHW float input flips width too, not channels
+    chw = img.transpose(2, 0, 1).astype("float32")
+    np.testing.assert_array_equal(T.hflip(chw), chw[:, :, ::-1])
+
+
+def test_center_crop_exact(rng):
+    img = _img(rng)
+    out = np.asarray(T.center_crop(img, (4, 6)))
+    top, left = (8 - 4) // 2, (10 - 6) // 2
+    np.testing.assert_allclose(out, img[top:top + 4, left:left + 6],
+                               rtol=0, atol=0)
+
+
+def test_crop_exact(rng):
+    img = _img(rng)
+    out = np.asarray(T.crop(img, 1, 2, 5, 6))
+    np.testing.assert_allclose(out, img[1:6, 2:8], rtol=0, atol=0)
+
+
+@pytest.mark.parametrize("mode", ("constant", "edge", "reflect"))
+def test_pad_layout(rng, mode):
+    img = _img(rng)
+    out = np.asarray(T.pad(img, (1, 2), padding_mode=mode))
+    assert out.shape == (8 + 4, 10 + 2, 3)          # (t+b, l+r, C intact)
+    np_mode = {"constant": "constant", "edge": "edge",
+               "reflect": "reflect"}[mode]
+    kw = {"constant_values": 0} if mode == "constant" else {}
+    ref = np.pad(img.astype("float32"), ((2, 2), (1, 1), (0, 0)),
+                 mode=np_mode, **kw)
+    np.testing.assert_allclose(out, ref, rtol=0, atol=0)
+
+
+@pytest.mark.parametrize("target", ((16, 20), (4, 5)))
+def test_resize_bilinear_close_to_pil(rng, target):
+    img = _img(rng)
+    pil = Image.fromarray(img)
+    ours = np.asarray(T.resize(img, target, interpolation="bilinear"))
+    ref = np.asarray(pil.resize((target[1], target[0]), Image.BILINEAR))
+    # integer rounding differences only
+    assert np.abs(ours.astype(int) - ref.astype(int)).max() <= 2
+
+
+def test_to_grayscale_matches_pil(rng):
+    img = _img(rng)
+    ours = np.asarray(T.to_grayscale(img))
+    assert ours.shape == (8, 10, 1)                 # HWC preserved
+    ref = np.asarray(Image.fromarray(img).convert("L"))
+    # same ITU-R 601-2 luma; PIL truncates to uint8
+    np.testing.assert_allclose(ours[..., 0], ref, rtol=0, atol=1.0)
+
+
+def test_adjust_brightness_matches_pil(rng):
+    img = _img(rng)
+    ours = np.asarray(T.adjust_brightness(img, 0.6))
+    ref = np.asarray(ImageEnhance.Brightness(
+        Image.fromarray(img)).enhance(0.6))
+    np.testing.assert_allclose(ours, ref, rtol=0, atol=1.0)
+
+
+def test_adjust_saturation_layout_and_value(rng):
+    img = _img(rng)
+    out = np.asarray(T.adjust_saturation(img, 0.0))   # fully desaturated
+    assert out.shape == img.shape                     # HWC preserved
+    luma = (0.299 * img[..., 0] + 0.587 * img[..., 1]
+            + 0.114 * img[..., 2]).astype("float32")
+    for c in range(3):
+        np.testing.assert_allclose(out[..., c], luma, rtol=1e-5, atol=1e-3)
+
+
+def test_adjust_hue_identity_and_layout(rng):
+    img = _img(rng)
+    out = np.asarray(T.adjust_hue(img, 0.0))
+    assert out.shape == img.shape
+    np.testing.assert_allclose(out, img.astype("float32"), rtol=0, atol=0.5)
+
+
+def test_erase_hwc(rng):
+    img = _img(rng)
+    out = np.asarray(T.erase(img, 2, 3, 4, 5, 0.0))
+    assert out.shape == img.shape
+    assert np.all(out[2:6, 3:8] == 0)
+    np.testing.assert_allclose(out[:2], img[:2].astype("float32"))
+
+
+def test_rotate_90_hwc(rng):
+    img = _img(rng, h=9, w=9)
+    out = np.asarray(T.rotate(img, 90))
+    assert out.shape == img.shape                     # HWC preserved
+    ref = np.asarray(Image.fromarray(img).rotate(90))
+    # nearest-ish warp vs PIL nearest: interior should broadly agree
+    interior = (slice(2, -2), slice(2, -2))
+    match = np.mean(np.abs(out[interior] - ref[interior].astype("float32"))
+                    < 16)
+    assert match > 0.8, match
