@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+_ONES_CACHE: dict = {}   # (shape, dtype) -> immutable ones cotangent
+
 __all__ = ["GradNode", "backward", "grad"]
 
 
@@ -157,8 +159,20 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
             continue
         if g is None:
             # reference semantics: initial gradient is ones for ANY shape
-            # (tensor_patch_methods.py backward docstring)
-            g_arr = jnp.ones(t._data.shape, t._data.dtype)
+            # (tensor_patch_methods.py backward docstring).  Cached per
+            # (shape, dtype): rebuilding it cost ~15% of a small eager
+            # step's host time (r4 profile), and ones are immutable.
+            key = (t._data.shape, str(t._data.dtype))
+            g_arr = _ONES_CACHE.get(key)
+            if g_arr is None:
+                g_arr = jnp.ones(t._data.shape, t._data.dtype)
+                # cache only SMALL concrete arrays: the hot path is the
+                # scalar loss root.  Large shapes would pin HBM for the
+                # process lifetime, and tracers (backward under
+                # capture_step's trace) must never leak into the cache.
+                if (t._data.size <= 1024 and len(_ONES_CACHE) < 256
+                        and not isinstance(g_arr, jax.core.Tracer)):
+                    _ONES_CACHE[key] = g_arr
         else:
             g_arr = g._data if isinstance(g, Tensor) else jnp.asarray(g)
         tap(t, g_arr)
