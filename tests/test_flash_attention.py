@@ -114,3 +114,36 @@ def test_flash_attn_unpadded_segments():
     want = np.concatenate([ref_seg(qn[:s1], kn[:s1], vn[:s1]),
                            ref_seg(qn[s1:], kn[s1:], vn[s1:])])
     np.testing.assert_allclose(np.asarray(out), want, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_head_dim_128_forward_backward(causal):
+    """head_dim=128 (the MXU lane-filling shape the d128 ablation levers
+    and 7B-class configs use) — fwd + bwd vs the reference composition."""
+    q, k, v = (_rand((1, 128, 2, 128), i) for i in range(3))
+    out = FA._flash_attention(causal, q, k, v)
+    ref = FA._ref_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=3e-5, rtol=3e-5)
+    g = _rand((1, 128, 2, 128), 9)
+    _, vjp = jax.vjp(lambda q, k, v: FA._flash_attention(causal, q, k, v),
+                     q, k, v)
+    _, ref_vjp = jax.vjp(lambda q, k, v: FA._ref_attention(q, k, v, causal),
+                         q, k, v)
+    for got, want in zip(vjp(g), ref_vjp(g)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=3e-4, rtol=3e-4)
+
+
+def test_head_dim_128_gqa_group2():
+    """The exact d128_560m lever layout: 10 q-heads over 5 kv-heads at
+    head_dim 128 (group size 2), causal."""
+    q = _rand((1, 128, 10, 128), 0)
+    k = _rand((1, 128, 5, 128), 1)
+    v = _rand((1, 128, 5, 128), 2)
+    out = FA._flash_attention(True, q, k, v)
+    kr = jnp.repeat(k, 2, axis=2)
+    vr = jnp.repeat(v, 2, axis=2)
+    ref = FA._ref_attention(q, kr, vr, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=3e-5, rtol=3e-5)
