@@ -123,3 +123,20 @@ def test_functional_api_routes_to_kernel():
     s = out.sum()
     s.backward()
     assert q.grad is not None and np.isfinite(q.grad.numpy()).all()
+
+
+def test_varlen_head_dim_128():
+    """head_dim=128 (7B-class shape) through the varlen kernel."""
+    rng = np.random.RandomState(5)
+    lens = [40, 88]
+    cu = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+    T, H, D = int(cu[-1]), 2, 128
+    q = jnp.asarray(rng.randn(T, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(T, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(T, H, D), jnp.float32)
+    sm = 1.0 / math.sqrt(D)
+    out = favl._varlen_attention(True, sm, q, k, v,
+                                 jnp.asarray(cu), jnp.asarray(cu))
+    ref = _oracle(q, k, v, cu, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=3e-5, atol=3e-5)
