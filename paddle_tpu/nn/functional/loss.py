@@ -31,65 +31,6 @@ def _reduce(out, reduction):
     return out
 
 
-def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
-                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
-                  name=None):
-    def _ce(logits, lbl, *w, ignore_index, reduction, soft_label, axis, use_softmax,
-            label_smoothing, has_w):
-        if use_softmax:
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
-        else:
-            logp = jnp.log(jnp.clip(logits.astype(jnp.float32), 1e-12, None))
-        n_class = logits.shape[axis]
-        if soft_label or (lbl.ndim == logits.ndim and lbl.shape[axis] == n_class
-                          and jnp.issubdtype(lbl.dtype, jnp.floating)):
-            soft = lbl.astype(logp.dtype)
-            if label_smoothing > 0:
-                soft = soft * (1 - label_smoothing) + label_smoothing / n_class
-            loss = -jnp.sum(soft * logp, axis=axis)
-            if has_w:
-                wvec = w[0].astype(logp.dtype)
-                shape = [1] * logp.ndim
-                shape[axis] = n_class
-                loss = loss * jnp.sum(soft * wvec.reshape(shape), axis=axis)
-            return _reduce(loss, reduction)
-        lbl_i = lbl
-        if lbl_i.ndim == logits.ndim:
-            lbl_i = jnp.squeeze(lbl_i, axis=axis)
-        lbl_i = lbl_i.astype(jnp.int32)
-        valid = lbl_i != ignore_index
-        safe = jnp.where(valid, lbl_i, 0)
-        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, axis), axis=axis)
-        loss = -jnp.squeeze(picked, axis)
-        if label_smoothing > 0:
-            smooth_loss = -jnp.mean(logp, axis=axis)
-            loss = (1 - label_smoothing) * loss + label_smoothing * smooth_loss
-        if has_w:
-            wvec = w[0].astype(logp.dtype)
-            sample_w = wvec[safe]
-            loss = loss * sample_w
-            loss = jnp.where(valid, loss, 0.0)
-            if reduction == "mean":
-                denom = jnp.sum(jnp.where(valid, sample_w, 0.0))
-                return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
-            return _reduce(loss, reduction)
-        loss = jnp.where(valid, loss, 0.0)
-        if reduction == "mean":
-            denom = jnp.maximum(jnp.sum(valid.astype(logp.dtype)), 1.0)
-            return jnp.sum(loss) / denom
-        return _reduce(loss, reduction)
-
-    args = [input, label]
-    if weight is not None:
-        args.append(weight)
-    return D.apply("cross_entropy", _ce, tuple(args),
-                   {"ignore_index": int(ignore_index), "reduction": reduction,
-                    "soft_label": bool(soft_label), "axis": int(axis),
-                    "use_softmax": bool(use_softmax),
-                    "label_smoothing": float(label_smoothing),
-                    "has_w": weight is not None})
-
-
 def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
                                numeric_stable_mode=True, return_softmax=False,
                                axis=-1, name=None):
@@ -101,161 +42,6 @@ def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-10
     if return_softmax:
         return loss, _softmax(logits, axis=axis)
     return loss
-
-
-def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
-    def _bce(p, l, *w, reduction, has_w):
-        p = jnp.clip(p, 1e-12, 1.0 - 1e-7)
-        loss = -(l * jnp.log(p) + (1 - l) * jnp.log(1 - p))
-        if has_w:
-            loss = loss * w[0]
-        return _reduce(loss, reduction)
-    args = [input, label] + ([weight] if weight is not None else [])
-    return D.apply("binary_cross_entropy", _bce, tuple(args),
-                   {"reduction": reduction, "has_w": weight is not None})
-
-
-def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
-                                     pos_weight=None, name=None):
-    def _bcel(z, l, *extra, reduction, has_w, has_pw):
-        i = 0
-        w = pw = None
-        if has_w:
-            w = extra[i]; i += 1
-        if has_pw:
-            pw = extra[i]
-        max_val = jnp.clip(-z, 0, None)
-        if pw is not None:
-            log_w = (pw - 1.0) * l + 1.0
-            loss = (1.0 - l) * z + log_w * (jnp.log1p(jnp.exp(-jnp.abs(z))) + max_val)
-        else:
-            loss = jnp.clip(z, 0, None) - z * l + jnp.log1p(jnp.exp(-jnp.abs(z)))
-        if w is not None:
-            loss = loss * w
-        return _reduce(loss, reduction)
-    args = [logit, label]
-    if weight is not None:
-        args.append(weight)
-    if pos_weight is not None:
-        args.append(pos_weight)
-    return D.apply("bce_with_logits", _bcel, tuple(args),
-                   {"reduction": reduction, "has_w": weight is not None,
-                    "has_pw": pos_weight is not None})
-
-
-def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
-    def _nll(logp, l, *w, ignore_index, reduction, has_w):
-        l = l.astype(jnp.int32)
-        valid = l != ignore_index
-        safe = jnp.where(valid, l, 0)
-        if logp.ndim > 2:
-            # [N, C, d1...] -> move C last
-            lp = jnp.moveaxis(logp, 1, -1)
-        else:
-            lp = logp
-        picked = jnp.take_along_axis(lp, safe[..., None], axis=-1)[..., 0]
-        loss = -picked
-        if has_w:
-            sw = w[0][safe]
-            loss = loss * sw
-            loss = jnp.where(valid, loss, 0.0)
-            if reduction == "mean":
-                return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(valid, sw, 0.0)), 1e-12)
-            return _reduce(loss, reduction)
-        loss = jnp.where(valid, loss, 0.0)
-        if reduction == "mean":
-            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
-        return _reduce(loss, reduction)
-    args = [input, label] + ([weight] if weight is not None else [])
-    return D.apply("nll_loss", _nll, tuple(args),
-                   {"ignore_index": int(ignore_index), "reduction": reduction,
-                    "has_w": weight is not None})
-
-
-def l1_loss(input, label, reduction="mean", name=None):
-    return D.apply("l1_loss",
-                   lambda a, b, reduction: _reduce(jnp.abs(a - b), reduction),
-                   (input, label), {"reduction": reduction})
-
-
-def mse_loss(input, label, reduction="mean", name=None):
-    return D.apply("mse_loss",
-                   lambda a, b, reduction: _reduce(jnp.square(a - b), reduction),
-                   (input, label), {"reduction": reduction})
-
-
-def square_error_cost(input, label):
-    return D.apply("square_error_cost", lambda a, b: jnp.square(a - b), (input, label))
-
-
-def log_loss(input, label, epsilon=1e-4, name=None):
-    return D.apply("log_loss",
-                   lambda p, l, eps: -l * jnp.log(p + eps) - (1 - l) * jnp.log(1 - p + eps),
-                   (input, label), {"eps": float(epsilon)})
-
-
-def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
-    def _sl1(a, b, reduction, delta):
-        d = a - b
-        abs_d = jnp.abs(d)
-        loss = jnp.where(abs_d < delta, 0.5 * d * d / delta, abs_d - 0.5 * delta)
-        # paddle's smooth_l1_loss multiplies by delta
-        loss = loss * delta
-        return _reduce(loss, reduction)
-    return D.apply("smooth_l1_loss", _sl1, (input, label),
-                   {"reduction": reduction, "delta": float(delta)})
-
-
-def kl_div(input, label, reduction="mean", log_target=False, name=None):
-    def _kl(logp, t, reduction, log_target):
-        if log_target:
-            loss = jnp.exp(t) * (t - logp)
-        else:
-            loss = t * (jnp.log(jnp.clip(t, 1e-12, None)) - logp)
-        if reduction == "batchmean":
-            return jnp.sum(loss) / logp.shape[0]
-        return _reduce(loss, reduction)
-    return D.apply("kl_div", _kl, (input, label),
-                   {"reduction": reduction, "log_target": bool(log_target)})
-
-
-def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
-    return D.apply("margin_ranking_loss",
-                   lambda a, b, l, margin, reduction: _reduce(
-                       jnp.clip(-l * (a - b) + margin, 0, None), reduction),
-                   (input, other, label),
-                   {"margin": float(margin), "reduction": reduction})
-
-
-def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
-    return D.apply("hinge_embedding_loss",
-                   lambda a, l, margin, reduction: _reduce(
-                       jnp.where(l == 1, a, jnp.clip(margin - a, 0, None)), reduction),
-                   (input, label), {"margin": float(margin), "reduction": reduction})
-
-
-def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean", name=None):
-    def _cel(a, b, l, margin, reduction):
-        cos = jnp.sum(a * b, -1) / (jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1) + 1e-12)
-        loss = jnp.where(l == 1, 1 - cos, jnp.clip(cos - margin, 0, None))
-        return _reduce(loss, reduction)
-    return D.apply("cosine_embedding_loss", _cel, (input1, input2, label),
-                   {"margin": float(margin), "reduction": reduction})
-
-
-def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
-                        swap=False, reduction="mean", name=None):
-    def _tml(a, pos, neg, margin, p, eps, swap, reduction):
-        def dist(u, v):
-            return jnp.sum(jnp.abs(u - v + eps) ** p, axis=-1) ** (1.0 / p)
-        d_pos = dist(a, pos)
-        d_neg = dist(a, neg)
-        if swap:
-            d_neg = jnp.minimum(d_neg, dist(pos, neg))
-        return _reduce(jnp.clip(d_pos - d_neg + margin, 0, None), reduction)
-    return D.apply("triplet_margin_loss", _tml, (input, positive, negative),
-                   {"margin": float(margin), "p": float(p), "eps": float(epsilon),
-                    "swap": bool(swap), "reduction": reduction})
 
 
 def triplet_margin_with_distance_loss(input, positive, negative, distance_function=None,
@@ -281,88 +67,6 @@ def triplet_margin_with_distance_loss(input, positive, negative, distance_functi
     return diff
 
 
-def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", name=None):
-    def _ml(z, l, *w, reduction, has_w):
-        loss = -(l * jax.nn.log_sigmoid(z) + (1 - l) * jax.nn.log_sigmoid(-z))
-        if has_w:
-            loss = loss * w[0]
-        loss = jnp.mean(loss, axis=-1)
-        return _reduce(loss, reduction)
-    args = [input, label] + ([weight] if weight is not None else [])
-    return D.apply("multi_label_soft_margin_loss", _ml, tuple(args),
-                   {"reduction": reduction, "has_w": weight is not None})
-
-
-def soft_margin_loss(input, label, reduction="mean", name=None):
-    return D.apply("soft_margin_loss",
-                   lambda z, l, reduction: _reduce(jnp.log1p(jnp.exp(-l * z)), reduction),
-                   (input, label), {"reduction": reduction})
-
-
-def multi_margin_loss(input, label, p=1, margin=1.0, weight=None, reduction="mean",
-                      name=None):
-    def _mm(z, l, *w, p, margin, reduction, has_w):
-        n, c = z.shape
-        correct = jnp.take_along_axis(z, l[:, None].astype(jnp.int32), axis=1)
-        diff = jnp.clip(margin - correct + z, 0, None) ** p
-        if has_w:
-            diff = diff * w[0][l.astype(jnp.int32)][:, None]
-        mask = 1.0 - jax.nn.one_hot(l.astype(jnp.int32), c, dtype=z.dtype)
-        loss = jnp.sum(diff * mask, axis=1) / c
-        return _reduce(loss, reduction)
-    args = [input, label] + ([weight] if weight is not None else [])
-    return D.apply("multi_margin_loss", _mm, tuple(args),
-                   {"p": int(p), "margin": float(margin), "reduction": reduction,
-                    "has_w": weight is not None})
-
-
-def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
-                       reduction="sum", name=None):
-    def _sfl(z, l, *n, alpha, gamma, reduction, has_n):
-        p = jax.nn.sigmoid(z)
-        ce = jnp.clip(z, 0, None) - z * l + jnp.log1p(jnp.exp(-jnp.abs(z)))
-        p_t = p * l + (1 - p) * (1 - l)
-        loss = ce * ((1 - p_t) ** gamma)
-        if alpha >= 0:
-            alpha_t = alpha * l + (1 - alpha) * (1 - l)
-            loss = alpha_t * loss
-        if has_n:
-            loss = loss / n[0]
-        return _reduce(loss, reduction)
-    args = [logit, label] + ([normalizer] if normalizer is not None else [])
-    return D.apply("sigmoid_focal_loss", _sfl, tuple(args),
-                   {"alpha": float(alpha), "gamma": float(gamma),
-                    "reduction": reduction, "has_n": normalizer is not None})
-
-
-def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
-                     reduction="mean", name=None):
-    def _pnl(z, t, log_input, full, eps, reduction):
-        if log_input:
-            loss = jnp.exp(z) - t * z
-        else:
-            loss = z - t * jnp.log(z + eps)
-        if full:
-            stirling = t * jnp.log(t) - t + 0.5 * jnp.log(2 * np.pi * t)
-            loss = loss + jnp.where(t > 1, stirling, 0.0)
-        return _reduce(loss, reduction)
-    return D.apply("poisson_nll_loss", _pnl, (input, label),
-                   {"log_input": bool(log_input), "full": bool(full),
-                    "eps": float(epsilon), "reduction": reduction})
-
-
-def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
-                      reduction="mean", name=None):
-    def _gnl(mu, t, var, full, eps, reduction):
-        var = jnp.clip(var, eps, None)
-        loss = 0.5 * (jnp.log(var) + jnp.square(mu - t) / var)
-        if full:
-            loss = loss + 0.5 * np.log(2 * np.pi)
-        return _reduce(loss, reduction)
-    return D.apply("gaussian_nll_loss", _gnl, (input, label, variance),
-                   {"full": bool(full), "eps": float(epsilon), "reduction": reduction})
-
-
 def npair_loss(anchor, positive, labels, l2_reg=0.002):
     def _np(a, p, l, l2_reg):
         batch = a.shape[0]
@@ -374,16 +78,6 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
         reg = l2_reg * (jnp.mean(jnp.sum(a * a, 1)) + jnp.mean(jnp.sum(p * p, 1))) * 0.25
         return ce + reg
     return D.apply("npair_loss", _np, (anchor, positive, labels), {"l2_reg": float(l2_reg)})
-
-
-def dice_loss(input, label, epsilon=1e-5, name=None):
-    def _dice(p, l, eps):
-        l_oh = jax.nn.one_hot(jnp.squeeze(l, -1).astype(jnp.int32), p.shape[-1], dtype=p.dtype)
-        reduce_dims = tuple(range(1, p.ndim))
-        inter = jnp.sum(p * l_oh, axis=reduce_dims)
-        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(l_oh, axis=reduce_dims)
-        return jnp.mean(1 - (2 * inter + eps) / (union + eps))
-    return D.apply("dice_loss", _dice, (input, label), {"eps": float(epsilon)})
 
 
 def _hs(x, lab, w, b, pt, pc, num_classes):
@@ -471,3 +165,30 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         return _reduce(loss, reduction)
     return D.apply("ctc_loss", _ctc, (log_probs, labels, input_lengths, label_lengths),
                    {"blank": int(blank), "reduction": reduction})
+
+
+# kernel-driven (generated from ops.yaml `kernel:` over ops/kernels.py;
+# oracle-checked by tests/test_loss_oracle.py)
+from ...ops.generated.op_wrappers import (  # noqa: E402,F401
+    binary_cross_entropy,
+    binary_cross_entropy_with_logits,
+    cosine_embedding_loss,
+    cross_entropy,
+    dice_loss,
+    gaussian_nll_loss,
+    hinge_embedding_loss,
+    kl_div,
+    l1_loss,
+    log_loss,
+    margin_ranking_loss,
+    mse_loss,
+    multi_label_soft_margin_loss,
+    multi_margin_loss,
+    nll_loss,
+    poisson_nll_loss,
+    sigmoid_focal_loss,
+    smooth_l1_loss,
+    soft_margin_loss,
+    square_error_cost,
+    triplet_margin_loss,
+)

@@ -968,3 +968,255 @@ def dstack(*xs):
 
 def multi_dot(*xs):
     return jnp.linalg.multi_dot(xs)
+
+
+# -- losses (sixth tranche; bodies transcribed from the hand wrappers,
+#    protected by tests/test_loss_oracle.py's 68 torch/numpy checks.
+#    Optional-weight losses use the generated wrapper's opt-tensor
+#    convention: trailing *maybe tensors + _has_<name> attrs) ------------
+def _reduce_loss(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def mse_loss(input, label, reduction="mean"):
+    return _reduce_loss(jnp.square(input - label), reduction)
+
+
+def l1_loss(input, label, reduction="mean"):
+    return _reduce_loss(jnp.abs(input - label), reduction)
+
+
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+def log_loss(input, label, epsilon=1e-4):
+    return (-label * jnp.log(input + epsilon)
+            - (1 - label) * jnp.log(1 - input + epsilon))
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    d = input - label
+    abs_d = jnp.abs(d)
+    loss = jnp.where(abs_d < delta, 0.5 * d * d / delta,
+                     abs_d - 0.5 * delta)
+    return _reduce_loss(loss * delta, reduction)   # paddle scales by delta
+
+
+def kl_div(input, label, reduction="mean", log_target=False):
+    if log_target:
+        loss = jnp.exp(label) * (label - input)
+    else:
+        loss = label * (jnp.log(jnp.clip(label, 1e-12, None)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce_loss(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    return _reduce_loss(
+        jnp.clip(-label * (input - other) + margin, 0, None), reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    return _reduce_loss(
+        jnp.where(label == 1, input, jnp.clip(margin - input, 0, None)),
+        reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean"):
+    cos = jnp.sum(input1 * input2, -1) / (
+        jnp.linalg.norm(input1, axis=-1)
+        * jnp.linalg.norm(input2, axis=-1) + 1e-12)
+    loss = jnp.where(label == 1, 1 - cos, jnp.clip(cos - margin, 0, None))
+    return _reduce_loss(loss, reduction)
+
+
+def soft_margin_loss(input, label, reduction="mean"):
+    return _reduce_loss(jnp.log1p(jnp.exp(-label * input)), reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    def dist(u, v):
+        return jnp.sum(jnp.abs(u - v + epsilon) ** p, axis=-1) ** (1.0 / p)
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(positive, negative))
+    return _reduce_loss(jnp.clip(d_pos - d_neg + margin, 0, None),
+                        reduction)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean"):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        stirling = (label * jnp.log(label) - label
+                    + 0.5 * jnp.log(2 * jnp.pi * label))
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce_loss(loss, reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):
+    var = jnp.clip(variance, epsilon, None)
+    loss = 0.5 * (jnp.log(var) + jnp.square(input - label) / var)
+    if full:
+        loss = loss + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, input.dtype))
+    return _reduce_loss(loss, reduction)
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    # per-SAMPLE dice averaged over the batch (reference loss.py reduces
+    # over axes 1..k then means) — NOT one global dice
+    oh = jax.nn.one_hot(jnp.squeeze(label, -1).astype(jnp.int32),
+                        input.shape[-1], dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * oh, axis=reduce_dims)
+    union = jnp.sum(input, axis=reduce_dims) + jnp.sum(oh, axis=reduce_dims)
+    return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+
+def multi_label_soft_margin_loss(input, label, *maybe_w, reduction="mean",
+                                 _has_weight=False):
+    loss = -(label * jax.nn.log_sigmoid(input)
+             + (1 - label) * jax.nn.log_sigmoid(-input))
+    if _has_weight:
+        loss = loss * maybe_w[0]
+    return _reduce_loss(jnp.mean(loss, axis=-1), reduction)
+
+
+def binary_cross_entropy(input, label, *maybe_w, reduction="mean",
+                         _has_weight=False):
+    p = jnp.clip(input, 1e-12, 1.0 - 1e-7)
+    loss = -(label * jnp.log(p) + (1 - label) * jnp.log(1 - p))
+    if _has_weight:
+        loss = loss * maybe_w[0]
+    return _reduce_loss(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, *maybe, reduction="mean",
+                                     _has_weight=False,
+                                     _has_pos_weight=False):
+    i = 0
+    w = pw = None
+    if _has_weight:
+        w = maybe[i]; i += 1
+    if _has_pos_weight:
+        pw = maybe[i]
+    max_val = jnp.clip(-logit, 0, None)
+    if pw is not None:
+        log_w = (pw - 1.0) * label + 1.0
+        loss = ((1.0 - label) * logit
+                + log_w * (jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val))
+    else:
+        loss = (jnp.clip(logit, 0, None) - logit * label
+                + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+    if w is not None:
+        loss = loss * w
+    return _reduce_loss(loss, reduction)
+
+
+def nll_loss(input, label, *maybe_w, ignore_index=-100, reduction="mean",
+             _has_weight=False):
+    l = label.astype(jnp.int32)
+    valid = l != ignore_index
+    safe = jnp.where(valid, l, 0)
+    lp = jnp.moveaxis(input, 1, -1) if input.ndim > 2 else input
+    picked = jnp.take_along_axis(lp, safe[..., None], axis=-1)[..., 0]
+    loss = -picked
+    if _has_weight:
+        sw = maybe_w[0][safe]
+        loss = jnp.where(valid, loss * sw, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(jnp.where(valid, sw, 0.0)), 1e-12)
+        return _reduce_loss(loss, reduction)
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(
+            jnp.sum(valid.astype(loss.dtype)), 1.0)
+    return _reduce_loss(loss, reduction)
+
+
+def cross_entropy(input, label, *maybe_w, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, _has_weight=False):
+    axis = int(axis)
+    if use_softmax:
+        logp = jax.nn.log_softmax(input.astype(jnp.float32), axis=axis)
+    else:
+        logp = jnp.log(jnp.clip(input.astype(jnp.float32), 1e-12, None))
+    n_class = input.shape[axis]
+    if soft_label or (label.ndim == input.ndim
+                      and label.shape[axis] == n_class
+                      and jnp.issubdtype(label.dtype, jnp.floating)):
+        soft = label.astype(logp.dtype)
+        if label_smoothing > 0:
+            soft = soft * (1 - label_smoothing) + label_smoothing / n_class
+        loss = -jnp.sum(soft * logp, axis=axis)
+        if _has_weight:
+            wvec = maybe_w[0].astype(logp.dtype)
+            shape = [1] * logp.ndim
+            shape[axis] = n_class
+            loss = loss * jnp.sum(soft * wvec.reshape(shape), axis=axis)
+        return _reduce_loss(loss, reduction)
+    lbl_i = label
+    if lbl_i.ndim == input.ndim:
+        lbl_i = jnp.squeeze(lbl_i, axis=axis)
+    lbl_i = lbl_i.astype(jnp.int32)
+    valid = lbl_i != ignore_index
+    safe = jnp.where(valid, lbl_i, 0)
+    picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, axis),
+                                 axis=axis)
+    loss = -jnp.squeeze(picked, axis)
+    if label_smoothing > 0:
+        smooth_loss = -jnp.mean(logp, axis=axis)
+        loss = (1 - label_smoothing) * loss + label_smoothing * smooth_loss
+    if _has_weight:
+        wvec = maybe_w[0].astype(logp.dtype)
+        sample_w = wvec[safe]
+        loss = jnp.where(valid, loss * sample_w, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(jnp.where(valid, sample_w, 0.0)), 1e-12)
+        return _reduce_loss(loss, reduction)
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(
+            jnp.sum(valid.astype(logp.dtype)), 1.0)
+    return _reduce_loss(loss, reduction)
+
+
+def multi_margin_loss(input, label, *maybe_w, p=1, margin=1.0,
+                      reduction="mean", _has_weight=False):
+    n, c = input.shape
+    l = label.astype(jnp.int32)
+    correct = jnp.take_along_axis(input, l[:, None], axis=1)
+    diff = jnp.clip(margin - correct + input, 0, None) ** p
+    if _has_weight:
+        diff = diff * maybe_w[0][l][:, None]
+    mask = 1.0 - jax.nn.one_hot(l, c, dtype=input.dtype)
+    loss = jnp.sum(diff * mask, axis=1) / c
+    return _reduce_loss(loss, reduction)
+
+
+def sigmoid_focal_loss(logit, label, *maybe_norm, alpha=0.25, gamma=2.0,
+                       reduction="sum", _has_normalizer=False):
+    p = jax.nn.sigmoid(logit)
+    ce = -(label * jax.nn.log_sigmoid(logit)
+           + (1 - label) * jax.nn.log_sigmoid(-logit))
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    p_t = p * label + (1 - p) * (1 - label)
+    loss = a_t * (1 - p_t) ** gamma * ce
+    if _has_normalizer:
+        loss = loss / maybe_norm[0]
+    return _reduce_loss(loss, reduction)

@@ -281,17 +281,19 @@ def test_square_error_cost(rng):
 
 
 def test_dice_loss(rng):
-    # reference dice_loss: 1 - 2*sum(p*y)/(sum(p)+sum(y)) per row-sample
-    p = torch.softmax(torch.tensor(rng.randn(4, 3).astype("float32")),
-                      -1).numpy()
+    # reference dice_loss: PER-SAMPLE dice (reduce axes 1..k) averaged over
+    # the batch.  Use sigmoid-style inputs with very different per-sample
+    # mass so the per-sample and global formulas DIVERGE (softmax rows
+    # would make them coincide and hide a global-reduction bug).
+    p = (rng.rand(4, 3) * np.array([[0.05], [1.0], [0.3], [0.9]])) \
+        .astype("float32")
     y = rng.randint(0, 3, (4, 1)).astype("int64")
     out = float(F.dice_loss(t(p), t(y), epsilon=1e-5))
     oh = np.eye(3, dtype="float32")[y[:, 0]]
-    inter = (p * oh).sum()
-    ref = 1.0 - (2 * inter + 1e-5) / (p.sum() + oh.sum() + 1e-5)
-    # reference uses label_one_hot over flattened samples; allow the
-    # epsilon-placement variant
-    assert abs(out - ref) < 2e-3, (out, ref)
+    inter = (p * oh).sum(axis=1)
+    union = p.sum(axis=1) + oh.sum(axis=1)
+    ref = float(np.mean(1.0 - (2 * inter + 1e-5) / (union + 1e-5)))
+    assert abs(out - ref) < 1e-5, (out, ref)
 
 
 def test_sigmoid_focal_loss(rng):
