@@ -4,9 +4,18 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
 
 import jax
+
+# honor a JAX_PLATFORMS env pin at the CONFIG level (env alone does not
+# stop a registered hardware plugin's get_backend hook; a dead tunnel
+# then hangs the first op) — same pattern as paddle_tpu/__init__.py
+if os.environ.get("JAX_PLATFORMS"):
+    try:
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    except Exception:
+        pass
 import jax.numpy as jnp
 import numpy as np
 
