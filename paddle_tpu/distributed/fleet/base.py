@@ -59,12 +59,16 @@ class Fleet:
         if ps_mode:
             from ..ps import init_ps
             self._role_maker = role_maker
+            eps = role_maker.get_pserver_endpoints()
             self._ps_ctx = init_ps(
                 role="server" if role_maker.is_server() else "worker",
                 index=(role_maker.server_index() if role_maker.is_server()
                        else role_maker.worker_index()),
                 num_servers=role_maker.server_num(),
-                num_workers=role_maker.worker_num())
+                num_workers=role_maker.worker_num(),
+                # an explicit-args role maker carries the endpoints itself;
+                # only fall back to the env contract when it has none
+                master_endpoint=eps[0] if eps else None)
             self._is_initialized = True
             return self
         init_parallel_env()

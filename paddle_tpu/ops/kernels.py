@@ -497,6 +497,21 @@ def put_along_axis(arr, indices, values, axis, reduce="assign",
     grids = jnp.meshgrid(*[jnp.arange(s) for s in idx_m.shape],
                          indexing="ij")
     grids[0] = idx_m
+    if not include_self and reduce != "assign":
+        # reference semantics (put_along_axis include_self=False): the
+        # original values at targeted positions are excluded from the
+        # reduction — reset them to the reduce identity first
+        if jnp.issubdtype(arr.dtype, jnp.floating):
+            lo, hi = -jnp.inf, jnp.inf
+        else:
+            info = jnp.iinfo(arr.dtype)
+            lo, hi = info.min, info.max
+        ident = {"add": 0, "sum": 0, "mul": 1, "multiply": 1,
+                 "amax": lo, "amin": hi, "mean": 0}.get(reduce)
+        if ident is None:
+            raise ValueError(f"unknown reduce {reduce}")
+        moved = moved.at[tuple(grids)].set(
+            jnp.asarray(ident, arr.dtype))
     at = moved.at[tuple(grids)]
     if reduce == "assign":
         out = at.set(v_m)
@@ -508,6 +523,13 @@ def put_along_axis(arr, indices, values, axis, reduce="assign",
         out = at.max(v_m)
     elif reduce == "amin":
         out = at.min(v_m)
+    elif reduce == "mean":
+        cnt = jnp.zeros(moved.shape, jnp.float32).at[tuple(grids)].add(1.0)
+        summed = at.add(v_m)
+        denom = cnt + (1.0 if include_self else 0.0)
+        out = jnp.where(cnt > 0,
+                        (summed / jnp.maximum(denom, 1.0)).astype(arr.dtype),
+                        summed)
     else:
         raise ValueError(f"unknown reduce {reduce}")
     return jnp.moveaxis(out, 0, axis)
@@ -541,14 +563,36 @@ def repeat_interleave(x, repeats, axis=None):
     return jnp.repeat(x, int(repeats), axis=ax)
 
 
+def _sort_desc_stable(x, axis):
+    """Stable descending sort -> (values, indices).
+
+    Ascending lax.sort keyed by (x, reversed-iota) then flipped: equal
+    keys tie-break on DESCENDING original index before the flip, so the
+    flipped result lists equal elements in original order (the stable
+    contract flip-of-ascending violates), while NaN placement still
+    matches flip-of-ascending (reference semantics)."""
+    ax = int(axis) % x.ndim
+    n = x.shape[ax]
+    rev = (n - 1) - jax.lax.broadcasted_iota(jnp.int32, x.shape, ax)
+    sv, srev = jax.lax.sort((x, rev), dimension=ax, num_keys=2,
+                            is_stable=True)
+    return (jnp.flip(sv, axis=ax),
+            jnp.flip((n - 1) - srev, axis=ax))
+
+
 def sort(x, axis=-1, descending=False, stable=False):
+    # values-only output: equal elements are indistinguishable, so the
+    # cheap flip is already "stable" — only argsort needs the index
+    # tie-break machinery
     out = jnp.sort(x, axis=int(axis), stable=True)
     return jnp.flip(out, axis=int(axis)) if descending else out
 
 
 def argsort(x, axis=-1, descending=False, stable=False):
-    out = jnp.argsort(x, axis=int(axis), stable=True)
-    out = jnp.flip(out, axis=int(axis)) if descending else out
+    if descending:
+        out = _sort_desc_stable(x, axis)[1]
+    else:
+        out = jnp.argsort(x, axis=int(axis), stable=True)
     return out.astype(jnp.int64)
 
 
@@ -726,9 +770,17 @@ def corrcoef(x, rowvar=True):
     return jnp.corrcoef(x, rowvar=bool(rowvar))
 
 
-def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
-    # fweights/aweights accepted for signature parity, unused (hand parity)
-    return jnp.cov(x, rowvar=bool(rowvar), ddof=1 if ddof else 0)
+def cov(x, *maybe_w, rowvar=True, ddof=True,
+        _has_fweights=False, _has_aweights=False):
+    it = iter(maybe_w)
+    fw = next(it) if _has_fweights else None
+    aw = next(it) if _has_aweights else None
+    # jnp.cov requires integer fweights; arrays arrive as the default
+    # float machine dtype through dispatch
+    if fw is not None:
+        fw = fw.astype(jnp.int32)
+    return jnp.cov(x, rowvar=bool(rowvar), ddof=1 if ddof else 0,
+                   fweights=fw, aweights=aw)
 
 
 def det(x):

@@ -1,0 +1,115 @@
+"""Regression tests for the round-4 advisor findings (ADVICE.md).
+
+Oracles: torch (CPU) for sort stability / scatter-reduce semantics,
+numpy for weighted covariance.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_sort_descending_is_stable():
+    # advisor case: [1,1,0,1] descending+stable must keep equal elements
+    # in original order -> indices [0,1,3,2], not the flip's [3,1,0,2]
+    x = paddle.to_tensor([1, 1, 0, 1])
+    idx = paddle.argsort(x, descending=True, stable=True)
+    assert idx.numpy().tolist() == [0, 1, 3, 2]
+    vals = paddle.sort(x, descending=True)
+    assert vals.numpy().tolist() == [1, 1, 1, 0]
+
+
+def test_sort_descending_stable_matches_torch_2d():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 4, size=(5, 16)).astype(np.float32)
+    for axis in (0, 1, -1):
+        got = paddle.argsort(paddle.to_tensor(x), axis=axis,
+                             descending=True, stable=True).numpy()
+        want = torch.sort(torch.tensor(x), dim=axis, descending=True,
+                          stable=True).indices.numpy()
+        np.testing.assert_array_equal(got, want)
+
+
+def test_sort_descending_nan_placement_unchanged():
+    # NaNs lead the descending order (flip-of-ascending semantics)
+    x = paddle.to_tensor([1.0, float("nan"), 3.0])
+    out = paddle.sort(x, descending=True).numpy()
+    assert np.isnan(out[0]) and out[1:].tolist() == [3.0, 1.0]
+
+
+def test_cov_fweights_aweights_match_numpy():
+    rng = np.random.RandomState(1)
+    x = rng.randn(3, 8).astype(np.float64)
+    fw = rng.randint(1, 5, size=8)
+    aw = rng.rand(8)
+    got = paddle.linalg.cov(paddle.to_tensor(x), fweights=fw,
+                            aweights=aw).numpy()
+    want = np.cov(x, fweights=fw, aweights=aw)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+@pytest.mark.parametrize("reduce,torch_reduce", [
+    ("add", "sum"), ("mul", "prod"), ("amax", "amax"), ("amin", "amin"),
+    ("mean", "mean"),
+])
+def test_put_along_axis_include_self_false(reduce, torch_reduce):
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(2)
+    arr = rng.randint(1, 5, size=(4, 6)).astype(np.float32)
+    idx = rng.randint(0, 4, size=(3, 6)).astype(np.int64)
+    val = rng.randint(1, 5, size=(3, 6)).astype(np.float32)
+    got = paddle.put_along_axis(
+        paddle.to_tensor(arr), paddle.to_tensor(idx), paddle.to_tensor(val),
+        axis=0, reduce=reduce, include_self=False).numpy()
+    want = torch.tensor(arr).scatter_reduce(
+        0, torch.tensor(idx), torch.tensor(val), reduce=torch_reduce,
+        include_self=False).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_put_along_axis_include_self_true_unchanged():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(3)
+    arr = rng.randn(4, 6).astype(np.float32)
+    idx = rng.randint(0, 4, size=(3, 6)).astype(np.int64)
+    val = rng.randn(3, 6).astype(np.float32)
+    for reduce, tr in [("add", "sum"), ("amax", "amax"), ("mean", "mean")]:
+        got = paddle.put_along_axis(
+            paddle.to_tensor(arr), paddle.to_tensor(idx),
+            paddle.to_tensor(val), axis=0, reduce=reduce,
+            include_self=True).numpy()
+        want = torch.tensor(arr).scatter_reduce(
+            0, torch.tensor(idx), torch.tensor(val), reduce=tr,
+            include_self=True).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_user_defined_role_maker_explicit_endpoints_no_env(monkeypatch):
+    """Fleet.init(UserDefinedRoleMaker(server_endpoints=[...])) must derive
+    the master endpoint from the role maker, not PADDLE_PSERVERS_IP_PORT_LIST
+    (the explicit-args role maker exists for the no-env case)."""
+    from paddle_tpu.distributed.fleet.role_maker import UserDefinedRoleMaker
+    for var in ("PADDLE_PSERVERS_IP_PORT_LIST", "PADDLE_MASTER_ENDPOINT",
+                "TRAINING_ROLE", "PADDLE_TRAINERS_NUM", "PADDLE_TRAINER_ID",
+                "PADDLE_PSERVER_ID"):
+        monkeypatch.delenv(var, raising=False)
+    from paddle_tpu.distributed.fleet.role_maker import Role
+    rm = UserDefinedRoleMaker(current_id=0, role=Role.WORKER, worker_num=1,
+                              server_endpoints=["127.0.0.1:39217"])
+    captured = {}
+
+    def fake_init_ps(role=None, index=None, num_servers=None,
+                     num_workers=None, master_endpoint=None):
+        captured.update(role=role, index=index, num_servers=num_servers,
+                        num_workers=num_workers,
+                        master_endpoint=master_endpoint)
+        return object()
+
+    import paddle_tpu.distributed.ps as ps_mod
+    monkeypatch.setattr(ps_mod, "init_ps", fake_init_ps)
+    from paddle_tpu.distributed.fleet.base import Fleet
+    f = Fleet()
+    f.init(role_maker=rm)
+    assert captured["master_endpoint"] == "127.0.0.1:39217"
+    assert captured["role"] == "worker"
