@@ -775,9 +775,11 @@ def cov(x, *maybe_w, rowvar=True, ddof=True,
     it = iter(maybe_w)
     fw = next(it) if _has_fweights else None
     aw = next(it) if _has_aweights else None
-    # jnp.cov requires integer fweights; arrays arrive as the default
-    # float machine dtype through dispatch
     if fw is not None:
+        # reference contract: fweights must be integral (np.cov raises
+        # TypeError); dtype is static under tracing so this raises eagerly
+        if not jnp.issubdtype(fw.dtype, jnp.integer):
+            raise TypeError("cov: fweights must be an integer tensor")
         fw = fw.astype(jnp.int32)
     return jnp.cov(x, rowvar=bool(rowvar), ddof=1 if ddof else 0,
                    fweights=fw, aweights=aw)
