@@ -59,21 +59,17 @@ class Fleet:
         if ps_mode:
             from ..ps import init_ps
             self._role_maker = role_maker
-            import os
-            # an explicit-args role maker carries the endpoints itself, but
-            # the PADDLE_MASTER_ENDPOINT env override (a dedicated
-            # rendezvous host every other rank honors) still wins — pass
-            # None so init_ps consults it first
+            # an explicit-args role maker carries the endpoints itself;
+            # init_ps applies the PADDLE_MASTER_ENDPOINT-over-argument
+            # precedence for every caller
             eps = role_maker.get_pserver_endpoints()
-            master = None if os.environ.get("PADDLE_MASTER_ENDPOINT") \
-                else (eps[0] if eps else None)
             self._ps_ctx = init_ps(
                 role="server" if role_maker.is_server() else "worker",
                 index=(role_maker.server_index() if role_maker.is_server()
                        else role_maker.worker_index()),
                 num_servers=role_maker.server_num(),
                 num_workers=role_maker.worker_num(),
-                master_endpoint=master)
+                master_endpoint=eps[0] if eps else None)
             self._is_initialized = True
             return self
         init_parallel_env()

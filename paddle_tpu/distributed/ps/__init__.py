@@ -77,9 +77,14 @@ def init_ps(role=None, index=None, num_servers=None, num_workers=None,
     if index is None:
         index = int(_env("PADDLE_PSERVER_ID") if role == "server"
                     else _env("PADDLE_TRAINER_ID"))
-    if master_endpoint is None:
-        master_endpoint = os.environ.get("PADDLE_MASTER_ENDPOINT") or \
-            _env("PADDLE_PSERVERS_IP_PORT_LIST").split(",")[0]
+    # precedence: PADDLE_MASTER_ENDPOINT (a dedicated rendezvous host that
+    # every rank must honor, however it was initialized) > explicit arg >
+    # first pserver from the env contract
+    env_master = os.environ.get("PADDLE_MASTER_ENDPOINT")
+    if env_master:
+        master_endpoint = env_master
+    elif master_endpoint is None:
+        master_endpoint = _env("PADDLE_PSERVERS_IP_PORT_LIST").split(",")[0]
 
     world = num_servers + num_workers
     if role == "server":

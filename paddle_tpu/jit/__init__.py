@@ -233,11 +233,33 @@ def save(layer, path, input_spec=None, **configs):
                                                 *in_structs)
     with open(path + ".pdmodel", "wb") as f:
         f.write(exported.serialize())
-    np.savez(path + ".pdiparams",
-             **{f"param::{k}": np.asarray(p._data)
-                for k, p in named_params.items()},
-             **{f"buffer::{k}": np.asarray(b._data)
-                for k, b in named_buffers.items()})
+    save_params_npz(path,
+                    {k: p._data for k, p in named_params.items()},
+                    {k: b._data for k, b in named_buffers.items()})
+
+
+def save_params_npz(prefix, params, buffers):
+    """Write the <prefix>.pdiparams.npz artifact (jit.load's counterpart).
+
+    ml_dtypes arrays (bf16 etc.) cannot be represented in npz natively —
+    they are stored as integer bit patterns plus a ``meta::dtypes``
+    manifest that load() uses to view them back.
+    """
+    import json
+
+    import numpy as np
+    payload, manifest = {}, {}
+    for kind, items in (("param", params), ("buffer", buffers)):
+        for k, v in items.items():
+            key = f"{kind}::{k}"
+            a = np.asarray(v)
+            if a.dtype.kind == "V":
+                manifest[key] = str(v.dtype)
+                a = a.view(np.uint16 if a.dtype.itemsize == 2 else np.uint8)
+            payload[key] = a
+    if manifest:
+        payload["meta::dtypes"] = np.asarray(json.dumps(manifest))
+    np.savez(prefix + ".pdiparams", **payload)
 
 
 class TranslatedLayer(Layer):
@@ -266,15 +288,28 @@ class TranslatedLayer(Layer):
 
 
 def load(path, **configs):
+    import json
+
+    import ml_dtypes  # noqa: F401  (registers bfloat16 et al. with numpy)
     import numpy as np
     from jax import export as jax_export
     with open(path + ".pdmodel", "rb") as f:
         exported = jax_export.deserialize(f.read())
     params, buffers = {}, {}
+    dtypes = {}
     with np.load(path + ".pdiparams.npz") as z:
+        if "meta::dtypes" in z.files:
+            # npz can't represent ml_dtypes (bf16 saves as raw V2): such
+            # arrays are stored as uint16 bit patterns plus this manifest
+            dtypes = json.loads(str(z["meta::dtypes"]))
         for key in z.files:
+            if key == "meta::dtypes":
+                continue
             kind, name = key.split("::", 1)
-            (params if kind == "param" else buffers)[name] = z[key]
+            arr = z[key]
+            if key in dtypes:
+                arr = arr.view(np.dtype(dtypes[key]))
+            (params if kind == "param" else buffers)[name] = arr
     return TranslatedLayer(exported, params, buffers)
 
 

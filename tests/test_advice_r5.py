@@ -121,25 +121,19 @@ def test_cov_rejects_float_fweights():
         paddle.linalg.cov(x, fweights=np.array([1.5, 2.0, 1.0, 1.0, 1.0]))
 
 
-def test_fleet_ps_env_master_endpoint_still_wins(monkeypatch):
-    """PADDLE_MASTER_ENDPOINT (dedicated rendezvous host) must override the
-    role maker's first pserver endpoint, or env-contract ranks and
-    Fleet-initialized ranks rendezvous at different addresses."""
-    from paddle_tpu.distributed.fleet.role_maker import (
-        Role, UserDefinedRoleMaker)
+def test_init_ps_env_master_endpoint_wins_over_argument(monkeypatch):
+    """PADDLE_MASTER_ENDPOINT (dedicated rendezvous host) must override an
+    explicit master_endpoint argument in init_ps itself, or env-contract
+    ranks and explicit-args ranks rendezvous at different addresses."""
+    import paddle_tpu.distributed.ps as ps_mod
     monkeypatch.setenv("PADDLE_MASTER_ENDPOINT", "10.0.0.5:6170")
-    rm = UserDefinedRoleMaker(current_id=0, role=Role.WORKER, worker_num=1,
-                              server_endpoints=["127.0.0.1:39218"])
     captured = {}
 
-    def fake_init_ps(role=None, index=None, num_servers=None,
-                     num_workers=None, master_endpoint=None):
+    def fake_init_rpc(name, rank, world_size, master_endpoint):
         captured["master_endpoint"] = master_endpoint
-        return object()
 
-    import paddle_tpu.distributed.ps as ps_mod
-    monkeypatch.setattr(ps_mod, "init_ps", fake_init_ps)
-    from paddle_tpu.distributed.fleet.base import Fleet
-    Fleet().init(role_maker=rm)
-    # None -> init_ps consults PADDLE_MASTER_ENDPOINT itself
-    assert captured["master_endpoint"] is None
+    monkeypatch.setattr(ps_mod.rpc, "init_rpc", fake_init_rpc)
+    monkeypatch.setattr(ps_mod, "PSClient", lambda n: object())
+    ps_mod.init_ps(role="worker", index=0, num_servers=1, num_workers=1,
+                   master_endpoint="127.0.0.1:39218")
+    assert captured["master_endpoint"] == "10.0.0.5:6170"
