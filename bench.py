@@ -164,7 +164,7 @@ def main():
     }
     if backend_err:
         record["backend_probe_error"] = backend_err
-    _arm_watchdog(record, 2400.0 if on_tpu else 900.0)
+    _arm_watchdog(record, 2700.0 if on_tpu else 900.0)
 
     hp = HybridParallelConfig(dp=1, pp=1, tp=1, num_microbatches=1,
                               remat=remat, dtype=dtype)
@@ -260,7 +260,17 @@ def main():
         "reps": [round(r, 1) for r in reps],
         "spread_pct": round(spread_pct, 3),
     })
-    if vs_raw is not None and within_noise:
+    if not on_tpu:
+        # CPU tokens/sec phases are a smoke check, not a trend signal: the
+        # shared-host noise band (±30% observed across rounds) swamps any
+        # real regression.  vs_baseline is pinned; the raw ratio is kept
+        # for the curious (VERDICT r4 item 10).
+        record["role"] = "cpu_smoke"
+        record["trend_signal"] = False
+        if vs_raw is not None:
+            record["vs_prev_raw"] = round(vs_raw, 3)
+        record["vs_baseline"] = 1.0
+    elif vs_raw is not None and within_noise:
         record["vs_prev_raw_within_noise"] = round(vs_raw, 3)
     if mfu is not None:
         record["mfu"] = round(mfu, 4)
@@ -453,7 +463,7 @@ def _product_bench(on_tpu):
         batch, seq, steps = 2, 2048, 2
     else:
         cfg = LlamaConfig.tiny()
-        batch, seq, steps = 2, 128, 2
+        batch, seq, steps = 2, 128, 10
 
     model = LlamaForCausalLM(cfg)
     opt = pd.optimizer.AdamW(learning_rate=1e-4,
@@ -491,20 +501,32 @@ def _product_bench(on_tpu):
                        "loss": float(loss.numpy()),
                        "path": "nn.Layer+AdamW+GradScaler via jit.capture_step"}
 
-    # per-op eager dygraph (skipped on TPU: per-op remote dispatch makes a
-    # 24-layer warmup exceed any sane budget — that measurement IS the
-    # motivation for capture_step; the CPU number tracks the dispatcher)
-    if not on_tpu:
+    # per-op eager dygraph.  Measured on TPU too since r5: the fused
+    # eager block ops (fused_llama_attention / fused_llama_mlp, one
+    # dispatch per block half) cut per-step dispatches ~4x, making the
+    # remote-RTT cost of a 24-layer eager step benchable.  Set
+    # PADDLE_TPU_BENCH_EAGER_STEPS=0 to skip on a fragile tunnel.
+    eager_steps = steps if not on_tpu else \
+        int(os.environ.get("PADDLE_TPU_BENCH_EAGER_STEPS", "2"))
+    if eager_steps > 0:
+        t_w = _t.perf_counter()
         loss = one_step(tok, lab)           # warmup/compile
         float(loss.numpy())
+        warmup_s = _t.perf_counter() - t_w
         t0 = _t.perf_counter()
-        for _ in range(steps):
+        for _ in range(eager_steps):
             loss = one_step(tok, lab)
         float(loss.numpy())
         dt = _t.perf_counter() - t0
-        out["eager"] = {"tokens_per_sec": round(batch * seq * steps / dt, 1),
-                        "loss": float(loss.numpy()),
-                        "path": "nn.Layer+AdamW+GradScaler eager dygraph"}
+        out["eager"] = {
+            "tokens_per_sec": round(batch * seq * eager_steps / dt, 1),
+            "loss": float(loss.numpy()),
+            "warmup_sec": round(warmup_s, 1),
+            "path": "nn.Layer+AdamW+GradScaler eager dygraph"}
+    if "eager" in out and "captured" in out:
+        out["eager_vs_captured"] = round(
+            out["eager"]["tokens_per_sec"]
+            / out["captured"]["tokens_per_sec"], 3)
     return out
 
 

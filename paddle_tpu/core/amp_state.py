@@ -16,6 +16,7 @@ _tls = threading.local()
 WHITE_LIST = {
     "matmul", "conv2d", "conv1d", "conv3d", "conv2d_transpose", "mm", "bmm",
     "einsum", "linear", "addmm", "attention", "flash_attention",
+    "fused_llama_attention", "fused_llama_mlp",
 }
 # Ops that must stay in float32.
 BLACK_LIST = {

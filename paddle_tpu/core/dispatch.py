@@ -129,26 +129,56 @@ def set_tensor_checker(cb):
     _tensor_checker = cb
 
 
-def _plain_exec(fn: Callable, static_items: tuple):
-    key = (_fn_key(fn), static_items)
+_dtype_kind_cache: dict = {}
+
+
+def _dtype_kind(dt):
+    """(is_floating, is_inexact), cached — jnp.issubdtype costs ~0.4us and
+    the eager hot loop asks several times per op."""
+    k = _dtype_kind_cache.get(dt)
+    if k is None:
+        k = (jnp.issubdtype(dt, jnp.floating),
+             jnp.issubdtype(dt, jnp.inexact))
+        _dtype_kind_cache[dt] = k
+    return k
+
+
+def _plain_exec(fn: Callable, static_items: tuple, cast_spec: tuple = None):
+    key = (_fn_key(fn), static_items, cast_spec)
     exe = _plain_cache.get(key)
     if exe is None:
         kwargs = dict(static_items)
 
         def run(*arrays):
+            if cast_spec is not None:
+                # AMP input casts live INSIDE the compiled program: XLA
+                # fuses them into the first consumer, and the host loop
+                # skips one eager convert launch per cast input (~40us
+                # each — the dominant eager-AMP overhead, r5 profile)
+                arrays = tuple(
+                    a.astype(c) if c is not None else a
+                    for a, c in zip(arrays, cast_spec))
             return fn(*arrays, **kwargs)
 
         exe = _plain_cache[key] = jax.jit(run)
     return exe
 
 
-def _fwd_vjp_exec(fn: Callable, static_items: tuple, mask: tuple):
-    key = (_fn_key(fn), static_items, mask)
+def _fwd_vjp_exec(fn: Callable, static_items: tuple, mask: tuple,
+                  cast_spec: tuple = None):
+    key = (_fn_key(fn), static_items, mask, cast_spec)
     exe = _fwd_vjp_cache.get(key)
     if exe is None:
         kwargs = dict(static_items)
 
         def run(*arrays):
+            if cast_spec is not None:
+                # cast before the diff/nondiff split so the vjp is taken
+                # w.r.t. the CAST inputs (cotangents arrive in the compute
+                # dtype — identical semantics to the old eager pre-cast)
+                arrays = tuple(
+                    a.astype(c) if c is not None else a
+                    for a, c in zip(arrays, cast_spec))
             diff_args = tuple(a for a, m in zip(arrays, mask) if m)
             nondiff_args = tuple(a for a, m in zip(arrays, mask) if not m)
 
@@ -251,19 +281,22 @@ def apply(op_name: str, fn: Callable, tensor_args: Sequence[Any],
             requires.append(False)
             parents.append(None)
 
-    # AMP autocast: promote/demote float inputs per op lists.
+    # AMP autocast: promote/demote float inputs per op lists.  The cast is
+    # folded into the compiled executable (cast_spec keys the cache), not
+    # launched eagerly per input.
     cast_to = amp_state.autocast_dtype_for(op_name)
+    cast_spec = None
     if cast_to is not None:
-        arrays = [
-            a.astype(cast_to)
-            if jnp.issubdtype(a.dtype, jnp.floating) and a.dtype != cast_to
-            else a
-            for a in arrays
-        ]
+        spec = tuple(
+            cast_to if (_dtype_kind(a.dtype)[0] and a.dtype != cast_to)
+            else None
+            for a in arrays)
+        if any(c is not None for c in spec):
+            cast_spec = spec
 
     grad_on = is_grad_enabled() and any(requires)
     mask = tuple(
-        r and jnp.issubdtype(a.dtype, jnp.inexact)
+        r and _dtype_kind(a.dtype)[1]
         for r, a in zip(requires, arrays)
     )
     grad_on = grad_on and any(mask)
@@ -274,12 +307,13 @@ def apply(op_name: str, fn: Callable, tensor_args: Sequence[Any],
         t0 = _time.perf_counter_ns()
     try:
         if not grad_on:
-            out = _plain_exec(fn, static_items)(*arrays)
+            out = _plain_exec(fn, static_items, cast_spec)(*arrays)
             vjp_fn = None
             fwd_key = None
         else:
-            fwd_key = (_fn_key(fn), static_items, mask)
-            out, vjp_fn = _fwd_vjp_exec(fn, static_items, mask)(*arrays)
+            fwd_key = (_fn_key(fn), static_items, mask, cast_spec)
+            out, vjp_fn = _fwd_vjp_exec(fn, static_items, mask,
+                                        cast_spec)(*arrays)
     except RuntimeError as e:
         # reference enforce.h policy: prefix the failing operator and append
         # the decoded backend-status hint (external_error-table analog)

@@ -46,32 +46,69 @@ class LlamaConfig:
                            max_position_embeddings=seq)
 
 
+def _rope_math(q, k, theta):
+    """Pure-jnp rotary body on [b, s, h, d] — shared by the standalone rope
+    op and the fused attention block."""
+    import jax.numpy as jnp
+
+    b, s, h, d = q.shape
+    pos = jnp.arange(s, dtype=jnp.float32)
+    inv = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    freqs = jnp.outer(pos, inv)                       # [s, d/2]
+    cos = jnp.cos(freqs)[None, :, None, :]
+    sin = jnp.sin(freqs)[None, :, None, :]
+
+    def rot(x):
+        x1, x2 = x[..., 0::2], x[..., 1::2]
+        xr1 = x1 * cos - x2 * sin
+        xr2 = x2 * cos + x1 * sin
+        out = jnp.stack([xr1, xr2], axis=-1)
+        return out.reshape(x.shape)
+
+    return rot(q.astype(jnp.float32)).astype(q.dtype), \
+        rot(k.astype(jnp.float32)).astype(k.dtype)
+
+
 def apply_rope(q, k, theta=10000.0):
     """Rotary embeddings on [b, s, h, d] (paddle fused_rotary_position_embedding
     parity: /root/reference/python/paddle/incubate/nn/functional/fused_rotary_position_embedding.py)."""
-    import jax.numpy as jnp
-
     from ..core import dispatch as D
 
-    def _rope(q, k, theta):
-        b, s, h, d = q.shape
-        pos = jnp.arange(s, dtype=jnp.float32)
-        inv = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
-        freqs = jnp.outer(pos, inv)                       # [s, d/2]
-        cos = jnp.cos(freqs)[None, :, None, :]
-        sin = jnp.sin(freqs)[None, :, None, :]
+    return D.apply("rope", _rope_math, (q, k), {"theta": float(theta)})
 
-        def rot(x):
-            x1, x2 = x[..., 0::2], x[..., 1::2]
-            xr1 = x1 * cos - x2 * sin
-            xr2 = x2 * cos + x1 * sin
-            out = jnp.stack([xr1, xr2], axis=-1)
-            return out.reshape(x.shape)
 
-        return rot(q.astype(jnp.float32)).astype(q.dtype), \
-            rot(k.astype(jnp.float32)).astype(k.dtype)
+def _fused_attention_body(x, wq, wk, wv, wo, *, num_heads, num_kv_heads,
+                          head_dim, theta, causal, use_pallas):
+    """One dispatched program for the whole attention block (the eager
+    analog of the reference's fused_attention op, fused_attention_op.cu:
+    qkv projections + rope + GQA flash/ref attention + output projection
+    in a single XLA program — one dispatch instead of ~9)."""
+    import jax.numpy as jnp
 
-    return D.apply("rope", _rope, (q, k), {"theta": float(theta)})
+    from ..ops.pallas.flash_attention import (
+        _flash_attention, _ref_attention)
+
+    b, s = x.shape[0], x.shape[1]
+    q = jnp.matmul(x, wq).reshape(b, s, num_heads, head_dim)
+    k = jnp.matmul(x, wk).reshape(b, s, num_kv_heads, head_dim)
+    v = jnp.matmul(x, wv).reshape(b, s, num_kv_heads, head_dim)
+    q, k = _rope_math(q, k, theta)
+    if use_pallas:
+        out = _flash_attention(bool(causal), q, k, v)
+    else:
+        out = _ref_attention(q, k, v, causal)
+    out = out.reshape(b, s, num_heads * head_dim)
+    return jnp.matmul(out, wo)
+
+
+def _fused_mlp_body(x, wg, wu, wd):
+    """SwiGLU MLP as one dispatched program (reference fused_feedforward
+    analog, fused_feedforward_op.cu)."""
+    import jax
+    import jax.numpy as jnp
+
+    h = jax.nn.silu(jnp.matmul(x, wg)) * jnp.matmul(x, wu)
+    return jnp.matmul(h, wd)
 
 
 class LlamaAttention(nn.Layer):
@@ -88,6 +125,34 @@ class LlamaAttention(nn.Layer):
         self.o_proj = nn.Linear(self.num_heads * self.head_dim, h, bias_attr=False)
 
     def forward(self, x, attn_mask=None):
+        if attn_mask is None:
+            # fused single-dispatch block; the pallas-vs-XLA choice is made
+            # here (static under tracing) with the dtype AMP will cast to
+            from ..core import amp_state, dispatch as D
+            from ..core.flags import get_flag
+            from ..ops.pallas.flash_attention import flash_attention_fwd
+
+            b, s = x.shape[0], x.shape[1]
+            cast_to = amp_state.autocast_dtype_for("fused_llama_attention")
+            import jax.numpy as jnp
+            dt = jnp.dtype(cast_to) if cast_to is not None \
+                else jnp.dtype(x._data.dtype)
+            q_shape = (b, s, self.num_heads, self.head_dim)
+            kv_shape = (b, s, self.num_kv_heads, self.head_dim)
+            use_pallas = bool(
+                get_flag("use_pallas_kernels")
+                and flash_attention_fwd.supports(q_shape, dt.name, kv_shape,
+                                                 True))
+            return D.apply(
+                "fused_llama_attention", _fused_attention_body,
+                (x, self.q_proj.weight, self.k_proj.weight,
+                 self.v_proj.weight, self.o_proj.weight),
+                {"num_heads": self.num_heads,
+                 "num_kv_heads": self.num_kv_heads,
+                 "head_dim": self.head_dim,
+                 "theta": float(self.config.rope_theta),
+                 "causal": True, "use_pallas": use_pallas})
+
         from ..ops.manipulation import reshape, tile
 
         b, s = x.shape[0], x.shape[1]
@@ -115,7 +180,11 @@ class LlamaMLP(nn.Layer):
         self.down_proj = nn.Linear(f, h, bias_attr=False)
 
     def forward(self, x):
-        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+        from ..core import dispatch as D
+
+        return D.apply("fused_llama_mlp", _fused_mlp_body,
+                       (x, self.gate_proj.weight, self.up_proj.weight,
+                        self.down_proj.weight))
 
 
 class LlamaDecoderLayer(nn.Layer):
