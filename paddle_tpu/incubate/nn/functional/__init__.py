@@ -402,15 +402,49 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
     decode_mode = bool((this == 1).all() and (dec > 0).any())
     prefill_mode = bool((dec == 0).all() and (this == enc).all())
     if not (decode_mode or prefill_mode):
-        raise NotImplementedError(
-            "mixed prefill+decode batches are a serving-engine special; "
-            "split the batch into a prefill call and a decode call")
+        # MIXED batch (continuous batching): split by sequence kind, run
+        # the prefill tokens then the decode tokens over the threaded
+        # caches, and merge outputs back into original token order.
+        is_dec = (this == 1) & (dec > 0)
+        if not ((is_dec) | ((dec == 0) & (this == enc))).all():
+            raise NotImplementedError(
+                "sequences must be pure prefill (dec==0, this==enc) or "
+                "single-token decode (this==1, dec>0)")
+        starts = np.concatenate([[0], np.cumsum(this)])
+        pre_sel = np.where(~is_dec)[0]
+        dec_sel = np.where(is_dec)[0]
+        idx_pre = np.concatenate(
+            [np.arange(starts[b], starts[b + 1]) for b in pre_sel])
+        idx_dec = starts[dec_sel]
+        qkv_a = _arr(qkv)
+        bt_a = _arr(block_tables)
+        bias_kw = {"qkv_bias": qkv_bias}
+        out_p, _, kc1, vc1 = block_multihead_attention(
+            jnp.take(qkv_a, jnp.asarray(idx_pre), axis=0), key_cache,
+            value_cache, enc[pre_sel], dec[pre_sel], this[pre_sel],
+            block_tables=bt_a[np.asarray(pre_sel)], block_size=block_size,
+            max_seq_len=max_seq_len, use_neox_style=use_neox_style,
+            **bias_kw)
+        out_d, _, kc2, vc2 = block_multihead_attention(
+            jnp.take(qkv_a, jnp.asarray(idx_dec), axis=0), kc1, vc1,
+            enc[dec_sel], dec[dec_sel], this[dec_sel],
+            block_tables=bt_a[np.asarray(dec_sel)], block_size=block_size,
+            max_seq_len=max_seq_len, use_neox_style=use_neox_style,
+            **bias_kw)
+        merged = jnp.zeros((qkv_a.shape[0], _arr(out_p).shape[1]),
+                           _arr(out_p).dtype)
+        merged = merged.at[jnp.asarray(idx_pre)].set(_arr(out_p))
+        merged = merged.at[jnp.asarray(idx_dec)].set(_arr(out_d))
+        return T_(merged), qkv, kc2, vc2
 
     Hc = _arr(key_cache).shape[1]
     Dh = _arr(key_cache).shape[3]
     bs = int(_arr(key_cache).shape[2])
 
-    def decode_impl(xa, kc, vc, bt, dec_t, *maybe_bias, has_bias):
+    def decode_impl(xa, kc, vc, bt, dec_t, *maybe_bias, has_bias,
+                    use_pallas):
+        from ....ops.pallas import paged_attention as _pa
+
         qkv_ = xa.reshape(B, 3, Hc, Dh)
         if has_bias:
             qkv_ = qkv_ + maybe_bias[0].reshape(3, Hc, Dh)[None]
@@ -420,18 +454,24 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
         slot = t % bs
         kc = kc.at[blk, :, slot, :].set(k.astype(kc.dtype))
         vc = vc.at[blk, :, slot, :].set(v.astype(vc.dtype))
-        # gather each sequence's pages -> [B, H, blocks*bs, D]
-        kpages = kc[bt]                  # [B, nblk, H, bs, D]
-        vpages = vc[bt]
-        ks = jnp.moveaxis(kpages, 2, 1).reshape(B, Hc, -1, Dh)
-        vs = jnp.moveaxis(vpages, 2, 1).reshape(B, Hc, -1, Dh)
-        scores = jnp.einsum("bhd,bhmd->bhm", q.astype(jnp.float32),
-                            ks.astype(jnp.float32)) / jnp.sqrt(
-                                jnp.float32(Dh))
-        pos = jnp.arange(ks.shape[2])[None, None, :]
-        scores = jnp.where(pos <= t[:, None, None], scores, -jnp.inf)
-        probs = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("bhm,bhmd->bhd", probs, vs.astype(jnp.float32))
+        if use_pallas:
+            # walk the block table page-by-page (scalar prefetch) — no
+            # dense [B, nblk*bs] gather materializes
+            out = _pa.paged_decode_attention(q, kc, vc, bt, t + 1)
+        else:
+            # gather each sequence's pages -> [B, H, blocks*bs, D]
+            kpages = kc[bt]                  # [B, nblk, H, bs, D]
+            vpages = vc[bt]
+            ks = jnp.moveaxis(kpages, 2, 1).reshape(B, Hc, -1, Dh)
+            vs = jnp.moveaxis(vpages, 2, 1).reshape(B, Hc, -1, Dh)
+            scores = jnp.einsum("bhd,bhmd->bhm", q.astype(jnp.float32),
+                                ks.astype(jnp.float32)) / jnp.sqrt(
+                                    jnp.float32(Dh))
+            pos = jnp.arange(ks.shape[2])[None, None, :]
+            scores = jnp.where(pos <= t[:, None, None], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1)
+            out = jnp.einsum("bhm,bhmd->bhd", probs,
+                             vs.astype(jnp.float32))
         return out.reshape(B, Hc * Dh).astype(xa.dtype), kc, vc
 
     def prefill_impl(xa, kc, vc, bt, lens, *maybe_bias, has_bias,
@@ -465,10 +505,19 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
 
     opt = (qkv_bias,) if qkv_bias is not None else ()
     if decode_mode:
+        from ....core.flags import get_flag
+        from ....ops.pallas import paged_attention as _pa
+        use_pallas = bool(
+            get_flag("use_pallas_kernels")
+            and (_pa.INTERPRET or jax.default_backend() == "tpu")
+            and _pa.supports(B, Hc, Hc, Dh, bs,
+                             nblk=int(_arr(block_tables).shape[1]),
+                             dtype=_arr(qkv).dtype))
         out, kc2, vc2 = D_.apply(
             "block_multihead_attention_decode", decode_impl,
             (qkv, key_cache, value_cache, block_tables, seq_lens_decoder,
-             *opt), {"has_bias": qkv_bias is not None}, num_outputs=3)
+             *opt), {"has_bias": qkv_bias is not None,
+                     "use_pallas": use_pallas}, num_outputs=3)
     else:
         starts = tuple(int(s) for s in np.concatenate([[0],
                                                        np.cumsum(this)[:-1]]))

@@ -1,0 +1,196 @@
+"""Pallas paged-KV decode attention (TPU).
+
+The serving decode step attends one fresh query token per sequence against
+that sequence's KV cache, which lives in non-contiguous fixed-size pages
+addressed by a block table (the reference's paged CUDA decode kernel,
+/root/reference/paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu
+-> block_attn.h).  The XLA composition must first GATHER every sequence's
+pages into a dense [B, nblk*bs] buffer — O(B * max_len) HBM traffic twice
+(gather + read).  This kernel instead walks the block table with Pallas
+scalar prefetch: the grid's page dimension indexes `block_tables[b, i]`
+directly in each page's BlockSpec index map, so pages stream from HBM to
+VMEM exactly once, with no dense intermediate.
+
+Layout: caches are [num_blocks, H_kv, bs, D] (blha cache layout), the
+query is [B, H, D], block table [B, nblk] int32, lengths [B] int32 (count
+of valid positions per sequence AFTER the current token's k/v insert).
+GQA is native: grid runs over kv heads, each kernel instance carries the
+q-head group [G, D] so the [G, bs] score tile keeps the MXU busy.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+INTERPRET = False
+
+
+def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref, *, bs, sm_scale):
+    """grid (B, H_kv, nblk); refs: q [G, D], k/v [bs, D] (one page of one
+    kv head), o [G, D]; scratch m/l [G, 1] f32, acc [G, D] f32."""
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+    nblk = pl.num_programs(2)
+    seq_len = len_ref[b]                      # valid positions this seq
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    base = i * bs
+
+    @pl.when(base < seq_len)
+    def _tile():
+        q = (q_ref[...].astype(jnp.float32) * sm_scale).astype(q_ref.dtype)
+        k = k_ref[...]                         # [bs, D]
+        v = v_ref[...]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [G, bs]
+        pos = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < seq_len, s, -jnp.inf)
+        m_prev = m_ref[...]                    # [G, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                 # [G, bs]
+        alpha = jnp.exp(m_prev - m_new)        # [G, 1]
+        l_ref[...] = alpha * l_ref[...] + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    @pl.when(i == nblk - 1)
+    def _finalize():
+        o_ref[...] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, key_cache, value_cache, block_tables,
+                           lengths):
+    """One-token-per-sequence decode over paged KV.
+
+    q [B, H, D]; caches [num_blocks, H_kv, bs, D]; block_tables [B, nblk]
+    int32; lengths [B] int32 (valid positions incl. the fresh token).
+    Returns [B, H, D].
+    """
+    B, H, D = q.shape
+    _, Hkv, bs, _ = key_cache.shape
+    G = H // Hkv
+    nblk = block_tables.shape[1]
+    sm_scale = 1.0 / (D ** 0.5)
+
+    kernel = functools.partial(_decode_kernel, bs=bs, sm_scale=sm_scale)
+    # q rows for kv head h are h*G..(h+1)*G: block (1, G, D) at index (b, h)
+    qr = q.reshape(B, Hkv, G, D)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,             # block_tables, lengths
+            grid=(B, Hkv, nblk),
+            in_specs=[
+                pl.BlockSpec((None, None, G, D),
+                             lambda b, h, i, bt, ln: (b, h, 0, 0)),
+                pl.BlockSpec((None, None, bs, D),
+                             lambda b, h, i, bt, ln: (bt[b, i], h, 0, 0)),
+                pl.BlockSpec((None, None, bs, D),
+                             lambda b, h, i, bt, ln: (bt[b, i], h, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((None, None, G, D),
+                                   lambda b, h, i, bt, ln: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((G, 1), jnp.float32),
+                pltpu.VMEM((G, 1), jnp.float32),
+                pltpu.VMEM((G, D), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
+        interpret=INTERPRET,
+    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      qr, key_cache, value_cache)
+    return out.reshape(B, H, D)
+
+
+def paged_decode_reference(q, key_cache, value_cache, block_tables,
+                           lengths):
+    """Dense-gather XLA oracle (the pre-r5 decode path's math)."""
+    B, H, D = q.shape
+    _, Hkv, bs, _ = key_cache.shape
+    kpages = key_cache[block_tables]           # [B, nblk, Hkv, bs, D]
+    vpages = value_cache[block_tables]
+    ks = jnp.moveaxis(kpages, 2, 1).reshape(B, Hkv, -1, D)
+    vs = jnp.moveaxis(vpages, 2, 1).reshape(B, Hkv, -1, D)
+    if Hkv != H:
+        g = H // Hkv
+        ks = jnp.repeat(ks, g, axis=1)
+        vs = jnp.repeat(vs, g, axis=1)
+    scores = jnp.einsum("bhd,bhmd->bhm", q.astype(jnp.float32),
+                        ks.astype(jnp.float32)) / jnp.sqrt(jnp.float32(D))
+    pos = jnp.arange(ks.shape[2])[None, None, :]
+    scores = jnp.where(pos < lengths[:, None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhm,bhmd->bhd", probs, vs.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+_PROBE_CACHE: dict = {}
+_PROBE_LOGGED = False
+
+
+def _probe_lowering(B, H, Hkv, D, bs, nblk, dtype) -> bool:
+    """Compile-probe the decode kernel for these shapes.
+
+    The authoritative eligibility check is an actual lowering (the r2
+    bench died on a heuristic yes / Mosaic no — flash_attention.py:453);
+    returns False on any failure so callers degrade to the dense-gather
+    XLA path instead of crashing every serving decode step.
+    """
+    global _PROBE_LOGGED
+    key = (B, H, Hkv, D, bs, nblk, str(dtype), jax.default_backend())
+    hit = _PROBE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    if INTERPRET:  # interpreter enforces no TPU tiling rules
+        _PROBE_CACHE[key] = True
+        return True
+    num_blocks = max(nblk * B, 1)
+    try:
+        jax.jit(paged_decode_attention).lower(
+            jax.ShapeDtypeStruct((B, H, D), dtype),
+            jax.ShapeDtypeStruct((num_blocks, Hkv, bs, D), dtype),
+            jax.ShapeDtypeStruct((num_blocks, Hkv, bs, D), dtype),
+            jax.ShapeDtypeStruct((B, nblk), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+        ).compile()
+        ok = True
+    except Exception as e:
+        ok = False
+        if not _PROBE_LOGGED:
+            _PROBE_LOGGED = True
+            import logging
+            logging.getLogger("paddle_tpu.pallas").warning(
+                "paged decode kernel does not lower for "
+                f"B={B} H={H} Hkv={Hkv} D={D} bs={bs}: "
+                f"{type(e).__name__}; falling back to dense gather")
+    _PROBE_CACHE[key] = ok
+    return ok
+
+
+def supports(B, H, Hkv, D, bs, nblk=None, dtype=jnp.float32) -> bool:
+    """Eligibility for the pallas decode kernel: shape heuristic, then an
+    actual lowering probe (cached)."""
+    if H % Hkv != 0:
+        return False
+    if D % 128 != 0 and D not in (64,):
+        return False
+    if bs % 8 != 0:
+        return False
+    if nblk is None:
+        return True     # shape-only query (no probe possible yet)
+    return _probe_lowering(B, H, Hkv, D, bs, nblk, dtype)

@@ -1,0 +1,124 @@
+"""Pallas paged-KV decode kernel + blha mixed batches.
+
+Kernel numerics are pinned against the dense-gather XLA composition
+(the pre-r5 decode path), reference
+block_multi_head_attention_kernel.cu / block_attn.h semantics.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.ops.pallas.paged_attention as pa
+from paddle_tpu.incubate.nn import functional as IF
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    old = pa.INTERPRET
+    pa.INTERPRET = True
+    yield
+    pa.INTERPRET = old
+
+
+@pytest.mark.parametrize("H,Hkv,D,bs,nblk", [
+    (8, 4, 64, 16, 5),     # GQA
+    (4, 4, 64, 8, 3),      # MHA
+    (10, 5, 128, 16, 4),   # the d128 GQA lever layout
+])
+def test_paged_decode_kernel_matches_dense(H, Hkv, D, bs, nblk):
+    rng = np.random.RandomState(0)
+    B, num_blocks = 3, 64
+    q = jnp.asarray(rng.randn(B, H, D), jnp.float32)
+    kc = jnp.asarray(rng.randn(num_blocks, Hkv, bs, D), jnp.float32)
+    vc = jnp.asarray(rng.randn(num_blocks, Hkv, bs, D), jnp.float32)
+    bt = jnp.asarray(rng.choice(num_blocks, B * nblk,
+                                replace=False).reshape(B, nblk), jnp.int32)
+    max_len = nblk * bs
+    lengths = jnp.asarray(rng.randint(1, max_len + 1, B), jnp.int32)
+    out = pa.paged_decode_attention(q, kc, vc, bt, lengths)
+    ref = pa.paged_decode_reference(q, kc, vc, bt, lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def _mk_caches(rng, num_blocks, H, bs, D):
+    kc = paddle.to_tensor(rng.randn(num_blocks, H, bs, D).astype(np.float32))
+    vc = paddle.to_tensor(rng.randn(num_blocks, H, bs, D).astype(np.float32))
+    return kc, vc
+
+
+def test_blha_decode_pallas_path_matches_dense():
+    """The flag-gated pallas decode inside block_multihead_attention must
+    reproduce the dense-gather path bit-for-bit at f32 tolerance."""
+    rng = np.random.RandomState(1)
+    B, H, D, bs, nblk = 2, 4, 64, 8, 3
+    num_blocks = 16
+    dec = np.array([5, 9])              # tokens already cached
+    qkv = paddle.to_tensor(rng.randn(B, 3 * H * D).astype(np.float32))
+    bt = paddle.to_tensor(
+        rng.choice(num_blocks, B * nblk, replace=False)
+        .reshape(B, nblk).astype(np.int32))
+
+    outs = {}
+    for flag in (False, True):
+        paddle.set_flags({"use_pallas_kernels": flag})
+        kc, vc = _mk_caches(np.random.RandomState(2), num_blocks, H, bs, D)
+        out, _, kc2, vc2 = IF.block_multihead_attention(
+            qkv, kc, vc,
+            seq_lens_encoder=np.zeros(B, np.int32),
+            seq_lens_decoder=dec.astype(np.int32),
+            seq_lens_this_time=np.ones(B, np.int32),
+            block_tables=bt, block_size=bs)
+        outs[flag] = (out.numpy(), kc2.numpy(), vc2.numpy())
+    paddle.set_flags({"use_pallas_kernels": True})
+    np.testing.assert_allclose(outs[True][0], outs[False][0], atol=2e-5)
+    np.testing.assert_allclose(outs[True][1], outs[False][1])
+    np.testing.assert_allclose(outs[True][2], outs[False][2])
+
+
+def test_blha_mixed_prefill_decode_batch():
+    """Mixed continuous-batching step: seq0 prefills 6 tokens, seq1
+    decodes its 4th token.  Outputs must match running the two pure-mode
+    calls separately, in original token order."""
+    rng = np.random.RandomState(3)
+    H, D, bs, nblk = 4, 64, 8, 3
+    num_blocks = 16
+    n_pre, dec_len = 6, 3
+    tok = n_pre + 1
+    qkv = rng.randn(tok, 3 * H * D).astype(np.float32)
+    bt = rng.choice(num_blocks, 2 * nblk, replace=False) \
+        .reshape(2, nblk).astype(np.int32)
+    kc0 = rng.randn(num_blocks, H, bs, D).astype(np.float32)
+    vc0 = rng.randn(num_blocks, H, bs, D).astype(np.float32)
+
+    # mixed call
+    out_m, _, kc_m, vc_m = IF.block_multihead_attention(
+        paddle.to_tensor(qkv), paddle.to_tensor(kc0.copy()),
+        paddle.to_tensor(vc0.copy()),
+        seq_lens_encoder=np.array([n_pre, 0], np.int32),
+        seq_lens_decoder=np.array([0, dec_len], np.int32),
+        seq_lens_this_time=np.array([n_pre, 1], np.int32),
+        block_tables=paddle.to_tensor(bt), block_size=bs)
+
+    # separate pure calls (prefill seq0, then decode seq1 over the
+    # prefill-updated caches)
+    out_p, _, kc_p, vc_p = IF.block_multihead_attention(
+        paddle.to_tensor(qkv[:n_pre]), paddle.to_tensor(kc0.copy()),
+        paddle.to_tensor(vc0.copy()),
+        seq_lens_encoder=np.array([n_pre], np.int32),
+        seq_lens_decoder=np.array([0], np.int32),
+        seq_lens_this_time=np.array([n_pre], np.int32),
+        block_tables=paddle.to_tensor(bt[:1]), block_size=bs)
+    out_d, _, kc_d, vc_d = IF.block_multihead_attention(
+        paddle.to_tensor(qkv[n_pre:]), kc_p, vc_p,
+        seq_lens_encoder=np.array([0], np.int32),
+        seq_lens_decoder=np.array([dec_len], np.int32),
+        seq_lens_this_time=np.array([1], np.int32),
+        block_tables=paddle.to_tensor(bt[1:]), block_size=bs)
+
+    np.testing.assert_allclose(out_m.numpy()[:n_pre], out_p.numpy(),
+                               atol=1e-5)
+    np.testing.assert_allclose(out_m.numpy()[n_pre:], out_d.numpy(),
+                               atol=1e-5)
+    np.testing.assert_allclose(kc_m.numpy(), kc_d.numpy(), atol=1e-6)
+    np.testing.assert_allclose(vc_m.numpy(), vc_d.numpy(), atol=1e-6)
