@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import importlib
+import json
 from pathlib import Path
 
 import yaml
@@ -73,6 +74,11 @@ class OpSpec:
     # ops.yaml:8-18 kernel/backward fields)
     kernel: str | None = None
     backward: str | None = None
+    # hand-written exemption: why this op does NOT ride the kernel path
+    # (e.g. data-dependent output shape, host-side op, inplace alias).
+    # Policy (tests/test_codegen_policy.py): every op carries kernel: or
+    # composite: — nothing is silently hand-written.
+    composite: str | None = None
 
     def resolve(self):
         """Import and return the implementing callable."""
@@ -95,6 +101,8 @@ class OpSpec:
             d["kernel"] = self.kernel
         if self.backward:
             d["backward"] = self.backward
+        if self.composite:
+            d["composite"] = self.composite
         return d
 
     @classmethod
@@ -109,6 +117,7 @@ class OpSpec:
             differentiable=bool(d.get("differentiable", True)),
             kernel=d.get("kernel"),
             backward=d.get("backward"),
+            composite=d.get("composite"),
         )
 
 
@@ -150,6 +159,8 @@ def dump_schema(specs: list[OpSpec], path: Path | None = None):
             lines.append(f"  kernel: {s.kernel}")
         if s.backward:
             lines.append(f"  backward: {s.backward}")
+        if s.composite:
+            lines.append(f"  composite: {json.dumps(s.composite)}")
         lines.append("")
     path.write_text("\n".join(lines))
     return path

@@ -115,9 +115,10 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
     return D.apply("label_smooth", _ls, (label,), {"epsilon": float(epsilon)})
 
 
-def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW",
+        pad_from_left_axis=True, name=None):
     from ...ops.manipulation import pad as _pad
-    return _pad(x, pad, mode, value, data_format)
+    return _pad(x, pad, mode, value, data_format, pad_from_left_axis)
 
 
 def zeropad2d(x, padding, data_format="NCHW", name=None):

@@ -1274,3 +1274,300 @@ def sigmoid_focal_loss(logit, label, *maybe_norm, alpha=0.25, gamma=2.0,
     if _has_normalizer:
         loss = loss / maybe_norm[0]
     return _reduce_loss(loss, reduction)
+
+
+# -- r5 tranche: manipulation / misc / math singles migrated from hand
+#    wrappers (VERDICT r4 item 5; reference ops.yaml kernel entries)
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, int(num_classes), dtype=jnp.float32)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    odt = jnp.int32 if out_int32 else jnp.int64
+    if sorted_sequence.ndim == 1:
+        return jnp.searchsorted(sorted_sequence, values, side=side) \
+            .astype(odt)
+    return jax.vmap(lambda s, vv: jnp.searchsorted(s, vv, side=side))(
+        sorted_sequence.reshape(-1, sorted_sequence.shape[-1]),
+        values.reshape(-1, values.shape[-1])
+    ).reshape(values.shape).astype(odt)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def crop(x, shape=None, offsets=None):
+    shape = tuple(x.shape) if shape is None else tuple(int(s) for s in shape)
+    full = tuple(x.shape[i] if s == -1 else s for i, s in enumerate(shape))
+    offs = (0,) * x.ndim if offsets is None \
+        else tuple(int(o) for o in offsets)
+    return jax.lax.dynamic_slice(x, offs, full)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW",
+        pad_from_left_axis=True):
+    p = tuple(int(v) for v in pad)
+    nd = x.ndim
+    if len(p) == 2 * nd:
+        # full-rank pairs: dim order given by pad_from_left_axis
+        # (reference tensor/manipulation.py pad: False = last-dim-first)
+        width = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+        if not pad_from_left_axis:
+            width = width[::-1]
+    else:
+        # conv-style: pairs are LAST-SPATIAL-dim-first (left, right, top,
+        # bottom, front, back); the spatial dims depend on data_format
+        # (reference nn/functional/common.py pad contract)
+        k = len(p) // 2
+        width = [(0, 0)] * nd
+        if data_format.endswith("C"):   # NHWC / NLC / NDHWC
+            spatial = list(range(1, 1 + k))
+        else:                           # NCHW / NCL / NCDHW
+            spatial = list(range(nd - k, nd))
+        for i, dim in enumerate(reversed(spatial)):
+            width[dim] = (p[2 * i], p[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, width, mode=jmode,
+                       constant_values=jnp.asarray(value, x.dtype))
+    return jnp.pad(x, width, mode=jmode)
+
+
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
+    import builtins
+    i = jnp.arange(y.shape[-1])
+    rows = i + (0 if offset >= 0 else -offset)
+    cols = i + (offset if offset >= 0 else 0)
+    a_m = jnp.moveaxis(jnp.moveaxis(x, axis1, 0),
+                       axis2 if axis2 > axis1 else axis2 + 1, 1)
+    out = a_m.at[rows, cols].set(jnp.moveaxis(y, -1, 0))
+    return jnp.moveaxis(
+        jnp.moveaxis(out, 1, axis2 if axis2 > axis1 else axis2 + 1),
+        0, axis1)
+
+
+def select_scatter(x, values, axis, index):
+    moved = jnp.moveaxis(x, int(axis), 0)
+    out = moved.at[int(index)].set(values.astype(x.dtype))
+    return jnp.moveaxis(out, 0, int(axis))
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    import builtins
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[int(ax)] = builtins.slice(int(st), int(en), int(sd))
+    return x[tuple(idx)]
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    size = (int(index_num) + int(nshards) - 1) // int(nshards)
+    lo, hi = int(shard_id) * size, (int(shard_id) + 1) * size
+    in_range = (input >= lo) & (input < hi)
+    return jnp.where(in_range, input - lo, ignore_value)
+
+
+def cast(x, dtype):
+    from ..core.dtype import convert_dtype
+    return x.astype(convert_dtype(dtype).np_dtype)
+
+
+def clip_by_norm(x, max_norm):
+    norm = jnp.sqrt(jnp.sum(x.astype(jnp.float32) ** 2))
+    scale = jnp.where(norm > max_norm,
+                      max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return (x.astype(jnp.float32) * scale).astype(x.dtype)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    import builtins
+    n = input.shape[-1] + builtins.abs(int(offset))
+    base = jnp.zeros(input.shape[:-1] + (n, n), input.dtype)
+    di = jnp.arange(input.shape[-1])
+    rows = di + builtins.max(0, -int(offset))
+    cols = di + builtins.max(0, int(offset))
+    out = base.at[..., rows, cols].set(input)
+    nd = out.ndim
+    d1, d2 = int(dim1) % nd, int(dim2) % nd
+    perm = list(range(nd - 2))
+    order = sorted([d1, d2])
+    for pos, d in zip(order, (nd - 2, nd - 1)):
+        perm.insert(pos, d)
+    return jnp.transpose(out, perm)
+
+
+def fill_diagonal(x, value, offset=0, wrap=False):
+    n, m = x.shape[-2], x.shape[-1]
+    i = jnp.arange(n)[:, None]
+    j = jnp.arange(m)[None, :]
+    diag = (j - i) == int(offset)
+    if wrap and n > m:
+        period = m + 1
+        diag = ((i * m + j) % period == int(offset) % period) \
+            if offset == 0 else diag
+    return jnp.where(diag, jnp.asarray(value, x.dtype), x)
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1):
+    import numpy as _np
+    nd = x.ndim
+    d1, d2 = int(dim1) % nd, int(dim2) % nd
+    perm = [d for d in range(nd) if d not in (d1, d2)] + [d1, d2]
+    ap = jnp.transpose(x, perm)
+    n, m = ap.shape[-2], ap.shape[-1]
+    i = jnp.arange(n)[:, None]
+    j = jnp.arange(m)[None, :]
+    mask = (j - i) == int(offset)
+    import builtins
+    dlen = builtins.min(n, m - offset) if offset >= 0 \
+        else builtins.min(n + offset, m)
+    di = jnp.arange(dlen)
+    rows = di if offset >= 0 else di - int(offset)
+    cols = di + builtins.max(0, int(offset))
+    carrier = jnp.zeros_like(ap).at[..., rows, cols].set(y.astype(x.dtype))
+    out = jnp.where(mask, carrier, ap)
+    return jnp.transpose(out, _np.argsort(perm))
+
+
+def frobenius_norm(x, axis=None, keepdim=False):
+    ax = tuple(int(a) for a in axis) if isinstance(axis, (tuple, list)) \
+        else (None if axis is None else int(axis))
+    af = x.astype(jnp.float32)
+    return jnp.sqrt(jnp.sum(af * af, axis=ax, keepdims=keepdim)) \
+        .astype(x.dtype)
+
+
+def gammainc(x, y):
+    return jax.scipy.special.gammainc(x.astype(jnp.float32),
+                                      y.astype(jnp.float32))
+
+
+def gammaincc(x, y):
+    return jax.scipy.special.gammaincc(x.astype(jnp.float32),
+                                       y.astype(jnp.float32))
+
+
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+def mean_all(x):
+    return jnp.mean(x)
+
+
+def multigammaln(x, p):
+    af = x.astype(jnp.float32)
+    import builtins
+    const = int(p) * (int(p) - 1) / 4.0 * jnp.log(jnp.pi).astype(jnp.float32)
+    return const + builtins.sum(jax.scipy.special.gammaln(af - i / 2.0)
+                                for i in range(int(p)))
+
+
+def mv(x, vec):
+    return x @ vec
+
+
+def reverse(x, axis):
+    return flip(x, tuple(axis) if isinstance(axis, (tuple, list)) else axis)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides):
+    import builtins
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[int(ax)] = builtins.slice(int(s), int(e), int(st))
+    return x.at[tuple(idx)].set(value.astype(x.dtype))
+
+
+def squared_l2_norm(x):
+    return jnp.sum(x.astype(jnp.float32) ** 2)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    a = x
+    if data_format == "NHWC":
+        a = jnp.transpose(a, (0, 3, 1, 2))
+    nt, c, h, w = a.shape
+    n = nt // int(seg_num)
+    v = a.reshape(n, int(seg_num), c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    back = jnp.pad(v[:, 1:, :c1], ((0, 0), (0, 1), (0, 0), (0, 0), (0, 0)))
+    fwd = jnp.pad(v[:, :-1, c1:c2],
+                  ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
+    out = jnp.concatenate([back, fwd, v[:, :, c2:]], axis=2).reshape(
+        nt, c, h, w)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+def histogram(input, *maybe_w, bins=100, min=0, max=0, density=False,
+              _has_weight=False):
+    w = maybe_w[0] if _has_weight else None
+    mn, mx = min, max
+    if mn == 0 and mx == 0:
+        mn, mx = jnp.min(input), jnp.max(input)
+    h, _ = jnp.histogram(input, bins=int(bins), range=(mn, mx),
+                         weights=w, density=density)
+    return h if (density or _has_weight) else h.astype(jnp.int64)
+
+
+def median(x, axis=None, keepdim=False, mode="avg"):
+    ax = None if axis is None else int(axis)
+    if mode == "avg":
+        return jnp.median(x, axis=ax, keepdims=keepdim)
+    n = x.shape[ax] if ax is not None else x.size
+    k = (n - 1) // 2
+    sorted_a = jnp.sort(x, axis=ax) if ax is not None \
+        else jnp.sort(x.ravel())
+    out = jnp.take(sorted_a, jnp.asarray([k]),
+                   axis=ax if ax is not None else 0)
+    if not keepdim or ax is None:
+        out = jnp.squeeze(out, axis=ax if ax is not None else 0)
+    return out
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg"):
+    ax = axis if axis is None or isinstance(axis, int) else tuple(axis)
+    return jnp.nanmedian(x, axis=ax, keepdims=keepdim)
+
+
+def mode(x, axis=-1, keepdim=False):
+    sorted_a = jnp.sort(x, axis=int(axis))
+    idx_a = jnp.argsort(x, axis=int(axis))
+    n = x.shape[int(axis)]
+    ax = int(axis) % x.ndim
+    shape = [n if i == ax else 1 for i in range(x.ndim)]
+    pos = jnp.arange(n).reshape(shape)
+    first = jnp.take(sorted_a, jnp.asarray([0]), axis=ax)
+    is_start = jnp.concatenate(
+        [jnp.ones_like(first, dtype=bool),
+         jnp.diff(sorted_a, axis=ax) != 0], axis=ax)
+    last_start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(is_start, pos, -1), axis=ax)
+    run_len = pos - last_start + 1
+    best = jnp.argmax(run_len, axis=ax, keepdims=True)
+    vals = jnp.take_along_axis(sorted_a, best, axis=ax)
+    idxs = jnp.take_along_axis(idx_a, best, axis=ax)
+    if not keepdim:
+        vals, idxs = vals.squeeze(ax), idxs.squeeze(ax)
+    return vals, idxs.astype(jnp.int64)
+
+
+def diff(x, *maybe, n=1, axis=-1, _has_prepend=False, _has_append=False):
+    it = iter(maybe)
+    pre = next(it) if _has_prepend else None
+    app = next(it) if _has_append else None
+    return jnp.diff(x, n=int(n), axis=int(axis), prepend=pre, append=app)

@@ -221,19 +221,8 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
     return outs[0] if len(outs) == 1 else tuple(outs)
 
 
-def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
-    def _ss(seq, v, right):
-        side = "right" if right else "left"
-        if seq.ndim == 1:
-            return jnp.searchsorted(seq, v, side=side).astype(jnp.int64)
-        return jax.vmap(lambda s, vv: jnp.searchsorted(s, vv, side=side))(
-            seq.reshape(-1, seq.shape[-1]), v.reshape(-1, v.shape[-1])
-        ).reshape(v.shape).astype(jnp.int64)
-    return D.apply("searchsorted", _ss, (sorted_sequence, values), {"right": bool(right)})
 
 
-def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
-    return searchsorted(sorted_sequence, x, out_int32, right)
 
 
 def where(condition, x=None, y=None, name=None):
@@ -250,10 +239,6 @@ def nonzero(x, as_tuple=False, name=None):
     return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
 
 
-def one_hot(x, num_classes, name=None):
-    return D.apply("one_hot",
-                   lambda a, n: jax.nn.one_hot(a, n, dtype=jnp.float32),
-                   (x,), {"n": int(num_classes)})
 
 
 def slice(input, axes, starts, ends, name=None):
@@ -276,80 +261,16 @@ import builtins as _builtins
 builtins_slice = _builtins.slice
 
 
-def strided_slice(x, axes, starts, ends, strides, name=None):
-    def norm(v):
-        return tuple(int(i.item()) if isinstance(i, Tensor) else int(i) for i in v)
-
-    def _ss(a, axes, starts, ends, strides):
-        idx = [builtins_slice(None)] * a.ndim
-        for ax, st, en, sd in zip(axes, starts, ends, strides):
-            idx[ax] = builtins_slice(st, en, sd)
-        return a[tuple(idx)]
-    return D.apply("strided_slice", _ss, (x,),
-                   {"axes": tuple(int(a) for a in axes), "starts": norm(starts),
-                    "ends": norm(ends), "strides": norm(strides)})
 
 
-def crop(x, shape=None, offsets=None, name=None):
-    shape = _shape_static(shape)
-    if offsets is None:
-        offsets = [0] * x.ndim
-    offsets = tuple(int(o.item()) if isinstance(o, Tensor) else int(o) for o in offsets)
-    full_shape = tuple(x.shape[i] if s == -1 else s for i, s in enumerate(shape))
-
-    def _crop(a, shape, offsets):
-        return jax.lax.dynamic_slice(a, offsets, shape)
-    return D.apply("crop", _crop, (x,), {"shape": full_shape, "offsets": offsets})
 
 
-def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
-    if isinstance(pad, Tensor):
-        pad = pad.tolist()
-    pad = [int(p) for p in pad]
-    nd = x.ndim
-    if len(pad) == 2 * nd:
-        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
-    else:
-        # paddle conv-style: pad pairs are LAST-dim-first — (left, right,
-        # top, bottom, front, back): pair 0 pads W, pair 1 pads H, pair 2
-        # pads D (reference nn/functional/common.py pad contract)
-        k = len(pad) // 2
-        width = [(0, 0)] * nd
-        if data_format.endswith("C"):  # NHWC / NLC / NDHWC: spatial dims start at 1
-            spatial = list(range(1, 1 + k))
-        else:  # NCHW / NCL / NCDHW: spatial dims after channel
-            spatial = list(range(nd - k, nd))
-        for i, dim in enumerate(reversed(spatial)):
-            width[dim] = (pad[2 * i], pad[2 * i + 1])
-
-    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
-             "circular": "wrap"}[mode]
-
-    def _pad(a, width, jmode, value):
-        if jmode == "constant":
-            return jnp.pad(a, width, mode=jmode, constant_values=value)
-        return jnp.pad(a, width, mode=jmode)
-    return D.apply("pad", _pad, (x,),
-                   {"width": tuple(width), "jmode": jmode, "value": value})
 
 
-def shard_index(input, index_num, nshards, shard_id, ignore_value=-1, name=None):
-    def _shard(a, index_num, nshards, shard_id, ignore_value):
-        size = (index_num + nshards - 1) // nshards
-        lo, hi = shard_id * size, (shard_id + 1) * size
-        in_range = (a >= lo) & (a < hi)
-        return jnp.where(in_range, a - lo, ignore_value)
-    return D.apply("shard_index", _shard, (input,),
-                   {"index_num": int(index_num), "nshards": int(nshards),
-                    "shard_id": int(shard_id), "ignore_value": int(ignore_value)})
 
 
-def as_complex(x, name=None):
-    return D.apply("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), (x,))
 
 
-def as_real(x, name=None):
-    return D.apply("as_real", lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), (x,))
 
 
 def atleast_1d(*inputs, name=None):
@@ -377,26 +298,8 @@ def atleast_3d(*inputs, name=None):
     return outs[0] if len(outs) == 1 else outs
 
 
-def select_scatter(x, values, axis, index, name=None):
-    def _impl(a, v, axis, index):
-        moved = jnp.moveaxis(a, axis, 0)
-        out = moved.at[index].set(v.astype(a.dtype))
-        return jnp.moveaxis(out, 0, axis)
-    return D.apply("select_scatter", _impl, (x, values), {"axis": int(axis), "index": int(index)})
 
 
-def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
-    def _impl(a, b, offset, axis1, axis2):
-        n = builtins_min(a.shape[axis1], a.shape[axis2])
-        i = jnp.arange(b.shape[-1])
-        rows = i - builtins_min(offset, 0) * 0 + (0 if offset >= 0 else -offset)
-        cols = i + (offset if offset >= 0 else 0)
-        a_m = jnp.moveaxis(jnp.moveaxis(a, axis1, 0), axis2 if axis2 > axis1 else axis2 + 1, 1)
-        out = a_m.at[rows, cols].set(jnp.moveaxis(b, -1, 0))
-        out = jnp.moveaxis(jnp.moveaxis(out, 1, axis2 if axis2 > axis1 else axis2 + 1), 0, axis1)
-        return out
-    return D.apply("diagonal_scatter", _impl, (x, y),
-                   {"offset": int(offset), "axis1": int(axis1), "axis2": int(axis2)})
 
 
 builtins_min = min
@@ -423,4 +326,21 @@ from .generated.op_wrappers import (  # noqa: E402,F401
 
 from .generated.op_wrappers import (  # noqa: E402,F401
     concat, dstack, hstack, stack, vstack,
+)
+
+
+# kernel-driven since r5 (generated from ops.yaml `kernel:` over
+# ops/kernels.py); re-exported here so intra-repo imports keep working
+from .generated.op_wrappers import (  # noqa: E402,F401
+    as_complex,
+    as_real,
+    bucketize,
+    crop,
+    diagonal_scatter,
+    one_hot,
+    pad,
+    searchsorted,
+    select_scatter,
+    shard_index,
+    strided_slice,
 )

@@ -74,53 +74,10 @@ def multiply_(x, y, name=None):
 # ---------------- reductions ----------------
 
 
-def median(x, axis=None, keepdim=False, mode="avg", name=None):
-    def _median(a, axis, keepdim, mode):
-        if mode == "avg":
-            return jnp.median(a, axis=axis, keepdims=keepdim)
-        n = a.shape[axis] if axis is not None else a.size
-        k = (n - 1) // 2
-        sorted_a = jnp.sort(a, axis=axis) if axis is not None else jnp.sort(a.ravel())
-        out = jnp.take(sorted_a, jnp.asarray([k]),
-                       axis=axis if axis is not None else 0)
-        if not keepdim or axis is None:
-            out = jnp.squeeze(out, axis=axis if axis is not None else 0)
-        return out
-    return D.apply("median", _median, (x,),
-                   {"axis": None if axis is None else int(axis), "keepdim": bool(keepdim),
-                    "mode": mode})
 
 
-def nanmedian(x, axis=None, keepdim=False, name=None):
-    return D.apply("nanmedian",
-                   lambda a, axis, keepdim: jnp.nanmedian(a, axis=axis, keepdims=keepdim),
-                   (x,), {"axis": _axis(axis), "keepdim": bool(keepdim)})
 
 
-def mode(x, axis=-1, keepdim=False, name=None):
-    def _mode(a, axis, keepdim):
-        sorted_a = jnp.sort(a, axis=axis)
-        idx_a = jnp.argsort(a, axis=axis)
-        n = a.shape[axis]
-        ax = axis % a.ndim
-        shape = [n if i == ax else 1 for i in range(a.ndim)]
-        pos = jnp.arange(n).reshape(shape)
-        # run-start positions: first element of each run of equal values
-        first = jnp.take(sorted_a, jnp.asarray([0]), axis=ax)
-        is_start = jnp.concatenate(
-            [jnp.ones_like(first, dtype=bool),
-             jnp.diff(sorted_a, axis=ax) != 0], axis=ax)
-        # segmented run length: position - position of containing run's start + 1
-        last_start = jax.lax.associative_scan(
-            jnp.maximum, jnp.where(is_start, pos, -1), axis=ax)
-        run_len = pos - last_start + 1
-        best = jnp.argmax(run_len, axis=ax, keepdims=True)
-        vals = jnp.take_along_axis(sorted_a, best, axis=ax)
-        idxs = jnp.take_along_axis(idx_a, best, axis=ax)
-        if not keepdim:
-            vals, idxs = vals.squeeze(ax), idxs.squeeze(ax)
-        return vals, idxs.astype(jnp.int64)
-    return D.apply("mode", _mode, (x,), {"axis": int(axis), "keepdim": bool(keepdim)})
 
 
 # ---------------- scans ----------------
@@ -145,37 +102,8 @@ def add_n(inputs, name=None):
     return D.apply("add_n", _add_n, tuple(inputs))
 
 
-def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
-    args = [x]
-    has_prepend = prepend is not None
-    has_append = append is not None
-    if has_prepend:
-        args.append(prepend)
-    if has_append:
-        args.append(append)
-
-    def _diff(*arrs, n, axis, has_prepend, has_append):
-        a = arrs[0]
-        i = 1
-        pre = app = None
-        if has_prepend:
-            pre = arrs[i]; i += 1
-        if has_append:
-            app = arrs[i]
-        return jnp.diff(a, n=n, axis=axis, prepend=pre, append=app)
-    return D.apply("diff", _diff, tuple(args),
-                   {"n": int(n), "axis": int(axis), "has_prepend": has_prepend,
-                    "has_append": has_append})
 
 
-def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
-    def _hist(a, bins, mn, mx, density):
-        if mn == 0 and mx == 0:
-            mn, mx = jnp.min(a), jnp.max(a)
-        h, _ = jnp.histogram(a, bins=bins, range=(mn, mx), density=density)
-        return h if density else h.astype(jnp.int64)
-    return D.apply("histogram", _hist, (input,),
-                   {"bins": int(bins), "mn": min, "mx": max, "density": bool(density)})
 
 
 def bincount(x, weights=None, minlength=0, name=None):
@@ -229,4 +157,15 @@ from .generated.op_wrappers import (  # noqa: E402,F401
 # kernel-driven (generated from ops.yaml `kernel:` over ops/kernels.py)
 from .generated.op_wrappers import (  # noqa: E402,F401
     clip, combinations, cummax, cummin, float_power, kthvalue, lerp, logcumsumexp, nanquantile, numel, quantile, renorm, scale, std, take, vander, var,
+)
+
+
+# kernel-driven since r5 (generated from ops.yaml `kernel:` over
+# ops/kernels.py); re-exported here so intra-repo imports keep working
+from .generated.op_wrappers import (  # noqa: E402,F401
+    diff,
+    histogram,
+    median,
+    mode,
+    nanmedian,
 )

@@ -30,10 +30,6 @@ def _t(x):
     return x._data if isinstance(x, Tensor) else jnp.asarray(x)
 
 
-def cast(x, dtype):
-    """paddle.cast (reference tensor/manipulation.py cast -> _C_ops.cast)."""
-    return D.apply("cast", lambda a, dt: a.astype(dt), (x,),
-                   {"dt": to_jax_dtype(dtype)})
 
 
 def shape(x, name=None):
@@ -41,14 +37,8 @@ def shape(x, name=None):
     return Tensor(jnp.asarray(tuple(_t(x).shape), jnp.int32))
 
 
-def mv(x, vec, name=None):
-    """Matrix-vector product (reference tensor/linalg.py mv)."""
-    return D.apply("mv", lambda a, b: a @ b, (x, vec))
 
 
-def inverse(x, name=None):
-    """Matrix inverse (reference tensor/math.py inverse)."""
-    return D.apply("inverse", jnp.linalg.inv, (x,))
 
 
 def multiplex(inputs, index, name=None):
@@ -63,10 +53,6 @@ def multiplex(inputs, index, name=None):
     return D.apply("multiplex", impl, (index, *inputs))
 
 
-def reverse(x, axis, name=None):
-    """Alias of flip (reference legacy `reverse` op)."""
-    from .manipulation import flip
-    return flip(x, axis)
 
 
 def fill_(x, value):
@@ -76,112 +62,18 @@ def fill_(x, value):
     return x
 
 
-def fill_diagonal(x, value, offset=0, wrap=False, name=None):
-    """Fill the main diagonal (reference Tensor.fill_diagonal_;
-    wrap continues the diagonal in tall matrices like the reference)."""
-    def impl(a, value, offset, wrap):
-        n, m = a.shape[-2], a.shape[-1]
-        i = jnp.arange(n)[:, None]
-        j = jnp.arange(m)[None, :]
-        diag = (j - i) == offset
-        if wrap and n > m:
-            period = m + 1
-            diag = ((i * m + j) % period == offset % period) if offset == 0 \
-                else diag
-        return jnp.where(diag, jnp.asarray(value, a.dtype), a)
-
-    return D.apply("fill_diagonal", impl, (x,),
-                   {"value": float(value), "offset": int(offset),
-                    "wrap": bool(wrap)})
 
 
-def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
-    """Write tensor y along the (dim1, dim2) diagonal of x
-    (reference Tensor.fill_diagonal_tensor)."""
-    def impl(a, b, offset, dim1, dim2):
-        nd = a.ndim
-        d1, d2 = dim1 % nd, dim2 % nd
-        perm = [d for d in range(nd) if d not in (d1, d2)] + [d1, d2]
-        ap = jnp.transpose(a, perm)
-        n, m = ap.shape[-2], ap.shape[-1]
-        i = jnp.arange(n)[:, None]
-        j = jnp.arange(m)[None, :]
-        mask = (j - i) == offset
-        # scatter b (last dim runs along the diagonal) into a carrier
-        dlen = min(n, m - offset) if offset >= 0 else min(n + offset, m)
-        di = jnp.arange(dlen)
-        rows = di if offset >= 0 else di - offset
-        cols = di + max(0, offset)
-        carrier = jnp.zeros_like(ap).at[..., rows, cols].set(
-            b.astype(a.dtype))
-        out = jnp.where(mask, carrier, ap)
-        inv = np.argsort(perm)
-        return jnp.transpose(out, inv)
-
-    return D.apply("fill_diagonal_tensor", impl, (x, y),
-                   {"offset": int(offset), "dim1": int(dim1),
-                    "dim2": int(dim2)})
 
 
-def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
-    """Batched diagonal embedding (reference tensor/creation.py
-    diag_embed)."""
-    def impl(a, offset, dim1, dim2):
-        n = a.shape[-1] + abs(offset)
-        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
-        di = jnp.arange(a.shape[-1])
-        rows = di + max(0, -offset)
-        cols = di + max(0, offset)
-        out = base.at[..., rows, cols].set(a)
-        nd = out.ndim
-        d1, d2 = dim1 % nd, dim2 % nd
-        # currently the two new dims are the last two; move them
-        perm = list(range(nd - 2))
-        order = sorted([d1, d2])
-        for pos, d in zip(order, (nd - 2, nd - 1)):
-            perm.insert(pos, d)
-        return jnp.transpose(out, perm)
-
-    return D.apply("diag_embed", impl, (x,),
-                   {"offset": int(offset), "dim1": int(dim1),
-                    "dim2": int(dim2)})
 
 
-def clip_by_norm(x, max_norm, name=None):
-    """Scale down to L2 norm <= max_norm (reference ops.yaml
-    clip_by_norm; nn/clip.py ClipGradByNorm semantics)."""
-    def impl(a, max_norm):
-        norm = jnp.sqrt(jnp.sum(a.astype(jnp.float32) ** 2))
-        scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12),
-                          1.0)
-        return (a.astype(jnp.float32) * scale).astype(a.dtype)
-
-    return D.apply("clip_by_norm", impl, (x,), {"max_norm": float(max_norm)})
 
 
-def mean_all(x, name=None):
-    """Scalar mean over every element (reference ops.yaml mean_all)."""
-    return D.apply("mean_all", lambda a: jnp.mean(a), (x,))
 
 
-def frobenius_norm(x, axis=None, keepdim=False, name=None):
-    """(reference tensor/linalg.py frobenius_norm branch of norm)."""
-    def impl(a, axis, keepdim):
-        af = a.astype(jnp.float32)
-        out = jnp.sqrt(jnp.sum(af * af, axis=axis, keepdims=keepdim))
-        return out.astype(a.dtype)
-
-    ax = tuple(int(a) for a in axis) if isinstance(axis, (tuple, list)) \
-        else (None if axis is None else int(axis))
-    return D.apply("frobenius_norm", impl, (x,),
-                   {"axis": ax, "keepdim": bool(keepdim)})
 
 
-def squared_l2_norm(x, name=None):
-    """sum(x^2) as a scalar (reference ops.yaml squared_l2_norm — the grad
-    -clip helper kernel)."""
-    return D.apply("squared_l2_norm",
-                   lambda a: jnp.sum(a.astype(jnp.float32) ** 2), (x,))
 
 
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):
@@ -249,32 +141,6 @@ def top_p_sampling(x, ps, threshold=None, seed=-1, name=None):
                    num_outputs=2)
 
 
-def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
-                   name=None):
-    """Shift a fraction of channels one step along the segment (time) dim
-    (reference nn/functional/extension.py temporal_shift)."""
-    def impl(a, seg_num, shift_ratio, data_format):
-        if data_format == "NHWC":
-            a = jnp.transpose(a, (0, 3, 1, 2))
-        nt, c, h, w = a.shape
-        n = nt // seg_num
-        v = a.reshape(n, seg_num, c, h, w)
-        c1 = int(c * shift_ratio)
-        c2 = int(c * 2 * shift_ratio)
-        back = jnp.pad(v[:, 1:, :c1], ((0, 0), (0, 1), (0, 0), (0, 0),
-                                       (0, 0)))
-        fwd = jnp.pad(v[:, :-1, c1:c2], ((0, 0), (1, 0), (0, 0), (0, 0),
-                                         (0, 0)))
-        out = jnp.concatenate([back, fwd, v[:, :, c2:]], axis=2)
-        out = out.reshape(nt, c, h, w)
-        if data_format == "NHWC":
-            out = jnp.transpose(out, (0, 2, 3, 1))
-        return out
-
-    return D.apply("temporal_shift", impl, (x,),
-                   {"seg_num": int(seg_num),
-                    "shift_ratio": float(shift_ratio),
-                    "data_format": str(data_format)})
 
 
 def edit_distance(input, label, normalized=True, ignored_tokens=None,
@@ -384,43 +250,23 @@ def as_strided(x, shape, stride, offset=0, name=None):
                     "offset": int(offset)})
 
 
-def slice_scatter(x, value, axes, starts, ends, strides, name=None):
-    """Write `value` into strided slices of x (reference
-    tensor/manipulation.py slice_scatter)."""
-    def impl(a, v, axes, starts, ends, strides):
-        idx = [slice(None)] * a.ndim
-        for ax, s, e, st in zip(axes, starts, ends, strides):
-            idx[ax] = slice(s, e, st)
-        return a.at[tuple(idx)].set(v.astype(a.dtype))
-
-    return D.apply("slice_scatter", impl, (x, value),
-                   {"axes": tuple(int(a) for a in axes),
-                    "starts": tuple(int(s) for s in starts),
-                    "ends": tuple(int(e) for e in ends),
-                    "strides": tuple(int(s) for s in strides)})
-
-
-def gammainc(x, y, name=None):
-    """Regularized lower incomplete gamma P(x, y) (reference gammainc)."""
-    return D.apply("gammainc",
-                   lambda a, b: jax.scipy.special.gammainc(
-                       a.astype(jnp.float32), b.astype(jnp.float32)), (x, y))
-
-
-def gammaincc(x, y, name=None):
-    """Regularized upper incomplete gamma Q(x, y) (reference gammaincc)."""
-    return D.apply("gammaincc",
-                   lambda a, b: jax.scipy.special.gammaincc(
-                       a.astype(jnp.float32), b.astype(jnp.float32)), (x, y))
-
-
-def multigammaln(x, p, name=None):
-    """Log multivariate gamma (reference tensor/math.py multigammaln)."""
-    def impl(a, p):
-        af = a.astype(jnp.float32)
-        const = p * (p - 1) / 4.0 * jnp.log(jnp.pi).astype(jnp.float32)
-        terms = sum(jax.scipy.special.gammaln(af - i / 2.0)
-                    for i in range(p))
-        return const + terms
-
-    return D.apply("multigammaln", impl, (x,), {"p": int(p)})
+# kernel-driven since r5 (generated from ops.yaml `kernel:` over
+# ops/kernels.py); re-exported here so intra-repo imports keep working
+from .generated.op_wrappers import (  # noqa: E402,F401
+    cast,
+    clip_by_norm,
+    diag_embed,
+    fill_diagonal,
+    fill_diagonal_tensor,
+    frobenius_norm,
+    gammainc,
+    gammaincc,
+    inverse,
+    mean_all,
+    multigammaln,
+    mv,
+    reverse,
+    slice_scatter,
+    squared_l2_norm,
+    temporal_shift,
+)
