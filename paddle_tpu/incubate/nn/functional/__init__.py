@@ -456,22 +456,12 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
         vc = vc.at[blk, :, slot, :].set(v.astype(vc.dtype))
         if use_pallas:
             # walk the block table page-by-page (scalar prefetch) — no
-            # dense [B, nblk*bs] gather materializes
-            out = _pa.paged_decode_attention(q, kc, vc, bt, t + 1)
+            # dense [B, nblk*bs] gather materializes; q joins the cache
+            # dtype (the probe compiled for that combination)
+            out = _pa.paged_decode_attention(q.astype(kc.dtype), kc, vc,
+                                             bt, t + 1)
         else:
-            # gather each sequence's pages -> [B, H, blocks*bs, D]
-            kpages = kc[bt]                  # [B, nblk, H, bs, D]
-            vpages = vc[bt]
-            ks = jnp.moveaxis(kpages, 2, 1).reshape(B, Hc, -1, Dh)
-            vs = jnp.moveaxis(vpages, 2, 1).reshape(B, Hc, -1, Dh)
-            scores = jnp.einsum("bhd,bhmd->bhm", q.astype(jnp.float32),
-                                ks.astype(jnp.float32)) / jnp.sqrt(
-                                    jnp.float32(Dh))
-            pos = jnp.arange(ks.shape[2])[None, None, :]
-            scores = jnp.where(pos <= t[:, None, None], scores, -jnp.inf)
-            probs = jax.nn.softmax(scores, axis=-1)
-            out = jnp.einsum("bhm,bhmd->bhd", probs,
-                             vs.astype(jnp.float32))
+            out = _pa.paged_decode_reference(q, kc, vc, bt, t + 1)
         return out.reshape(B, Hc * Dh).astype(xa.dtype), kc, vc
 
     def prefill_impl(xa, kc, vc, bt, lens, *maybe_bias, has_bias,
@@ -512,7 +502,7 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
             and (_pa.INTERPRET or jax.default_backend() == "tpu")
             and _pa.supports(B, Hc, Hc, Dh, bs,
                              nblk=int(_arr(block_tables).shape[1]),
-                             dtype=_arr(qkv).dtype))
+                             dtype=_arr(key_cache).dtype))
         out, kc2, vc2 = D_.apply(
             "block_multihead_attention_decode", decode_impl,
             (qkv, key_cache, value_cache, block_tables, seq_lens_decoder,

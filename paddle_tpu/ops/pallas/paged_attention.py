@@ -88,6 +88,10 @@ def paged_decode_attention(q, key_cache, value_cache, block_tables,
     kernel = functools.partial(_decode_kernel, bs=bs, sm_scale=sm_scale)
     # q rows for kv head h are h*G..(h+1)*G: block (1, G, D) at index (b, h)
     qr = q.reshape(B, Hkv, G, D)
+    # the grid DMAs a page per table entry even past each sequence's
+    # length (compute is skipped, the copy is not): clamp the reference
+    # blha convention's -1 padding entries to a valid block index
+    block_tables = jnp.clip(block_tables, 0, key_cache.shape[0] - 1)
 
     out = pl.pallas_call(
         kernel,

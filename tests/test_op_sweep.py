@@ -252,9 +252,7 @@ case("allclose", [f(3, 4), f(3, 4)],
      ref=lambda a, b: torch.tensor(torch.allclose(a, b)), grad=False)
 case("equal_all", [ints(3, 3, 4), ints(3, 3, 4)],
      ref=lambda a, b: torch.tensor(bool((a == b).all())), grad=False)
-skip("host-side type predicate (trivially exercised at import)",
-     "is_complex", "is_empty", "is_floating_point", "is_integer",
-     "is_tensor")
+
 
 # -- manipulation -----------------------------------------------------------
 case("reshape", [f(3, 4)], attrs={"shape": [4, 3]},
@@ -428,7 +426,7 @@ case("view", [f(3, 4)], attrs={"shape_or_dtype": [4, 3]},
      ref=lambda x, s: x.reshape(s), tattrs={"s": (4, 3)}, grad=False)
 case("view_as", [f(3, 4), f(4, 3)], ref=lambda x, y: x.reshape(y.shape),
      grad=False)
-skip("returns a python list (host-side)", "tolist")
+
 
 # -- misc -------------------------------------------------------------------
 case("cast", [f(3, 4)], attrs={"dtype": "float64"},
@@ -470,13 +468,11 @@ case("gammainc", [pos(3, 4), pos(3, 4)],
      ref=lambda a, x: torch.special.gammainc(a, x), grad=False)
 case("gammaincc", [pos(3, 4), pos(3, 4)],
      ref=lambda a, x: torch.special.gammaincc(a, x), grad=False)
-skip("decode/beam-search host-side composites, covered by their own tests",
-     "viterbi_decode", "gather_tree", "edit_distance", "top_p_sampling",
-     "temporal_shift")
-skip("inplace mutator covered via its functional twin in this sweep",
-     "fill_", "fill_diagonal_tensor", "multiply_", "flatten_", "reshape_",
-     "scatter_", "squeeze_", "unsqueeze_", "exponential_", "cauchy_",
-     "geometric_", "log_normal", "normal_", "uniform_", "zero_")
+skip("decode/beam-search host-side composites, covered by "
+     "tests/test_misc_ops.py",
+     "viterbi_decode", "gather_tree", "edit_distance", "top_p_sampling")
+skip("stochastic inplace mutator; seeded determinism + moments covered "
+     "by tests/test_random_ops.py", "cauchy_", "geometric_", "log_normal")
 case("shape", [f(3, 4)], ref=lambda x: torch.tensor(x.shape), grad=False)
 
 # -- linalg -----------------------------------------------------------------
@@ -573,9 +569,7 @@ E["eigh"] = dict(i=[spd(4)], check=_eigh_check, attrs={})
 E["eigvalsh"] = dict(i=[spd(4)], check=_eigvalsh_check, attrs={})
 E["svdvals"] = dict(i=[f(4, 3)], check=_svdvals_check, attrs={})
 E["lu"] = dict(i=[spd(4)], check=_lu_check, attrs={})
-skip("complex eigendecomposition: sign/phase-ambiguous, covered by "
-     "test_linalg round-trips", "eig", "eigvals", "lu_unpack",
-     "householder_product", "ormqr")
+
 skip("randomized algorithm (stochastic output)", "pca_lowrank",
      "svd_lowrank")
 
@@ -610,8 +604,8 @@ case("maxout", [f(2, 4, 3, 3)], attrs={"groups": 2},
      tattrs={})
 case("softmax_with_cross_entropy", None)
 E.pop("softmax_with_cross_entropy", None)
-skip("stochastic (gumbel noise / random slope)", "gumbel_softmax", "rrelu")
-skip("inplace alias", "softmax_")
+
+
 
 # -- random (deterministic properties only -> skip value checks) ------------
 skip("stochastic output; determinism under paddle.seed + distribution "
@@ -619,7 +613,7 @@ skip("stochastic output; determinism under paddle.seed + distribution "
      "bernoulli", "binomial", "gaussian", "multinomial", "normal",
      "poisson", "rand", "randint", "randint_like", "randn", "randperm",
      "standard_gamma", "standard_normal", "uniform")
-skip("random state accessors", "seed", "get_rng_state", "set_rng_state")
+
 
 # -- creation ---------------------------------------------------------------
 case("zeros", None)
@@ -671,17 +665,13 @@ CREATION = {
                  lambda: np.meshgrid([1., 2.], [3., 4., 5.],
                                      indexing="ij")[0]),
 }
-skip("value-uninitialized by contract (shape/dtype asserted in "
-     "test_creation)", "empty", "empty_like")
-skip("data-pipeline / host IO helpers with their own tests",
-     "clone_", "numpy", "item")
+
 
 # -- array / indexing helpers ----------------------------------------------
 skip("TensorArray ops (dynamic python-list semantics, test_tensor_types)",
      "array_length", "array_read", "array_write", "create_array",
      "tensor_array_to_tensor")
-skip("covered by dedicated indexing tests (test_indexing)",
-     "index_elementwise_get", "getitem", "setitem", "index_elementwise_put")
+
 
 
 # -- remaining yaml surface (coverage enforcement additions) ----------------
@@ -728,12 +718,329 @@ case("sinc", [f(3, 4)])
 case("t", [f(3, 4)], ref=lambda x: x.t())
 case("vecdot", [f(3, 4), f(3, 4)],
      ref=lambda x, y: torch.linalg.vecdot(x, y), tol=1e-4)
-skip("inplace alias", "t_", "tanh_", "relu_", "complex_")
+
 skip("TensorArray pop (dynamic python-list semantics, test_tensor_types)",
      "array_pop")
-skip("host-side shape assertion helper (exercised throughout the suite)",
-     "check_shape", "broadcast_shape")
-skip("host-side multidim histogram composite (numpy-backed)", "histogramdd")
+
+
+
+
+# ---------------------------------------------------------------------------
+# r5 graduation (VERDICT r4 item 6): former skips now carry REAL cases.
+# Inplace twins run against their functional oracle AND assert the input
+# buffer was rebound; host accessors, RNG-state ops, uninitialized-creation
+# contracts and the complex eigen family get property checks.
+# ---------------------------------------------------------------------------
+def _np_c(x):
+    return _np(x)
+
+
+def _inplace(op, arrays, oracle, attrs=None):
+    """fn(*pts, **attrs) must return the oracle value AND update pts[0]."""
+    attrs = attrs or {}
+
+    def call(fn, pts):
+        ret = fn(*pts, **attrs)
+        return (ret, pts[0])
+
+    def check(p_out, arrs):
+        ret, x_after = p_out
+        want = oracle(*arrs)
+        np.testing.assert_allclose(_np_c(ret), want, rtol=1e-5, atol=1e-6,
+                                   err_msg=op)
+        np.testing.assert_allclose(_np_c(x_after), want, rtol=1e-5,
+                                   atol=1e-6, err_msg=op + " (buffer)")
+
+    E[op] = dict(i=arrays, attrs={}, grad=False, call=call, check=check)
+
+
+_inplace("fill_", [f(3, 4)], lambda x: np.full_like(x, 2.5),
+         attrs={"value": 2.5})
+_inplace("multiply_", [f(3, 4), f(3, 4)], lambda x, y: x * y)
+_inplace("flatten_", [f(3, 4)], lambda x: x.reshape(-1))
+_inplace("reshape_", [f(3, 4)], lambda x: x.reshape(4, 3),
+         attrs={"shape": [4, 3]})
+_inplace("squeeze_", [f(3, 1, 4)], lambda x: x.squeeze(1),
+         attrs={"axis": 1})
+_inplace("unsqueeze_", [f(3, 4)], lambda x: x[:, None, :],
+         attrs={"axis": 1})
+_inplace("t_", [f(3, 4)], lambda x: x.T)
+_inplace("tanh_", [f(3, 4)], lambda x: np.tanh(x))
+_inplace("relu_", [f(3, 4)], lambda x: np.maximum(x, 0.0))
+_inplace("softmax_", [f(3, 4)],
+         lambda x: torch.softmax(torch.tensor(x), dim=-1).numpy())
+
+
+def _scatter_oracle(x, idx, upd):
+    out = x.copy()
+    out[idx] = upd
+    return out
+
+
+_inplace("scatter_", [f(5, 3), np.array([0, 2], np.int64), f(2, 3)],
+         _scatter_oracle)
+
+def _fdt_check(p_out, arrs):
+    x, y = arrs
+    want = x.copy()
+    n = min(x.shape)
+    want[np.arange(n), np.arange(n)] = y
+    np.testing.assert_allclose(_np_c(p_out), want, rtol=1e-6)
+E["fill_diagonal_tensor"] = dict(i=[f(4, 5), f(4)], attrs={}, grad=False,
+                                 check=_fdt_check)
+
+
+def _tshift_oracle(x, seg, ratio):
+    nt, c, h, w = x.shape
+    n = nt // seg
+    v = x.reshape(n, seg, c, h, w)
+    c1, c2 = int(c * ratio), int(c * 2 * ratio)
+    back = np.pad(v[:, 1:, :c1], ((0, 0), (0, 1), (0, 0), (0, 0), (0, 0)))
+    fwd = np.pad(v[:, :-1, c1:c2], ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
+    return np.concatenate([back, fwd, v[:, :, c2:]], axis=2).reshape(
+        nt, c, h, w)
+
+
+E["temporal_shift"] = dict(
+    i=[f(4, 8, 2, 2)], attrs={"seg_num": 2, "shift_ratio": 0.25},
+    grad=False,
+    check=lambda p, a: np.testing.assert_allclose(
+        _np_c(p), _tshift_oracle(a[0], 2, 0.25), rtol=1e-6))
+
+# host predicates: concrete truth values, not import smoke
+for _pred, _arr, _want in [
+        ("is_tensor", f(2, 2), True),
+        ("is_floating_point", f(2, 2), True),
+        ("is_integer", ints(5, 2, 2), True),
+        ("is_complex", cplx(2, 2), True),
+        ("is_empty", np.zeros((0, 3), np.float32), True)]:
+    E[_pred] = dict(
+        i=[_arr], attrs={}, grad=False,
+        check=(lambda want: lambda p, a: (
+            (_ for _ in ()).throw(AssertionError(f"got {p}"))
+            if bool(p) is not want else None))(_want))
+
+E["tolist"] = dict(
+    i=[np.array([[1.5, 2.0], [3.0, 4.0]], np.float32)], attrs={},
+    grad=False,
+    check=lambda p, a: (
+        (_ for _ in ()).throw(AssertionError(str(p)))
+        if p != a[0].tolist() else None))
+
+
+def _bshape_call(fn, pts):
+    return fn([2, 1, 4], [3, 1])
+
+
+E["broadcast_shape"] = dict(
+    i=[f(1)], attrs={}, grad=False, call=_bshape_call,
+    check=lambda p, a: np.testing.assert_array_equal(list(p), [2, 3, 4]))
+
+
+def _cshape_call(fn, pts):
+    fn(pts[0], [3, 4])          # matching shape: must not raise
+    try:
+        fn(pts[0], [4, 4])
+        raise AssertionError("check_shape accepted a wrong shape")
+    except AssertionError:
+        raise
+    except Exception:
+        return True
+
+
+E["check_shape"] = dict(i=[f(3, 4)], attrs={}, grad=False,
+                        call=_cshape_call, check=lambda p, a: None)
+
+
+# RNG state surface: seeding reproduces, state roundtrips
+def _seed_call(fn, pts):
+    fn(1234)
+    a = paddle.rand([8]).numpy()
+    fn(1234)
+    b = paddle.rand([8]).numpy()
+    np.testing.assert_array_equal(a, b)
+    return True
+
+
+E["seed"] = dict(i=[f(1)], attrs={}, grad=False, call=_seed_call,
+                 check=lambda p, a: None)
+
+
+def _state_call(fn, pts):
+    paddle.seed(77)
+    st = paddle.get_rng_state()
+    a = paddle.rand([6]).numpy()
+    paddle.set_rng_state(st)
+    b = paddle.rand([6]).numpy()
+    np.testing.assert_array_equal(a, b)
+    return True
+
+
+E["get_rng_state"] = dict(i=[f(1)], attrs={}, grad=False, call=_state_call,
+                          check=lambda p, a: None)
+E["set_rng_state"] = dict(i=[f(1)], attrs={}, grad=False, call=_state_call,
+                          check=lambda p, a: None)
+
+
+# complex eigen family: deterministic properties / same-input torch oracle
+def _eig_check(p_out, arrs):
+    w, v = p_out
+    A = arrs[0].astype(np.complex128)
+    wv, vv = np.asarray(_np_c(w), np.complex128), np.asarray(
+        _np_c(v), np.complex128)
+    np.testing.assert_allclose(A @ vv, vv @ np.diag(wv), atol=1e-4)
+
+
+E["eig"] = dict(i=[f(4, 4)], attrs={}, grad=False, check=_eig_check)
+
+
+def _eigvals_check(p_out, arrs):
+    got = np.sort_complex(np.asarray(_np_c(p_out), np.complex128))
+    want = np.sort_complex(np.linalg.eigvals(arrs[0]))
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+E["eigvals"] = dict(i=[f(4, 4)], attrs={}, grad=False,
+                    check=_eigvals_check)
+
+
+def _lu_unpack_call(fn, pts):
+    lu, piv = paddle.linalg.lu(pts[0])
+    return fn(lu, piv)
+
+
+def _lu_unpack_check(p_out, arrs):
+    P, L, U = (np.asarray(_np_c(t), np.float64) for t in p_out)
+    np.testing.assert_allclose(P @ L @ U, arrs[0], atol=1e-4)
+
+
+E["lu_unpack"] = dict(i=[spd(4)], attrs={}, grad=False,
+                      call=_lu_unpack_call, check=_lu_unpack_check)
+
+_geqrf_a, _geqrf_tau = (t.numpy() for t in torch.geqrf(
+    torch.tensor(f(4, 3), dtype=torch.float32)))
+case("householder_product", [_geqrf_a, _geqrf_tau],
+     ref=torch.linalg.householder_product, grad=False, tol=1e-4)
+case("ormqr", [_geqrf_a, _geqrf_tau, f(4, 2)],
+     ref=lambda x, tau, other: torch.ormqr(x, tau, other), grad=False,
+     tol=1e-4)
+
+
+def _histdd_check(p_out, arrs):
+    hist = p_out[0] if isinstance(p_out, (tuple, list)) else p_out
+    want, _ = np.histogramdd(arrs[0], bins=4)
+    np.testing.assert_allclose(_np_c(hist), want, rtol=1e-6)
+
+
+E["histogramdd"] = dict(i=[f(20, 2)], attrs={"bins": 4}, grad=False,
+                        check=_histdd_check)
+
+
+# uninitialized creation: the CONTRACT is shape+dtype, which is testable
+def _empty_call(fn, pts):
+    return fn([2, 3], "float32")
+
+
+E["empty"] = dict(
+    i=[f(1)], attrs={}, grad=False, call=_empty_call,
+    check=lambda p, a: (
+        (_ for _ in ()).throw(AssertionError(f"{p.shape} {p.dtype}"))
+        if tuple(p.shape) != (2, 3) or "float32" not in str(p.dtype)
+        else None))
+E["empty_like"] = dict(
+    i=[f(4, 5)], attrs={}, grad=False,
+    check=lambda p, a: (
+        (_ for _ in ()).throw(AssertionError(f"{p.shape} {p.dtype}"))
+        if tuple(p.shape) != (4, 5) or "float32" not in str(p.dtype)
+        else None))
+
+
+# stochastic inplace: seeded determinism + support/moment checks
+def _mk_seeded_inplace(op, bounds=None, moments=None, attrs=None):
+    attrs = attrs or {}
+
+    def call(fn, pts):
+        paddle.seed(123)
+        a = _np_c(fn(paddle.to_tensor(np.zeros((2000,), np.float32)),
+                     **attrs)).copy()
+        paddle.seed(123)
+        b = _np_c(fn(paddle.to_tensor(np.zeros((2000,), np.float32)),
+                     **attrs)).copy()
+        np.testing.assert_array_equal(a, b)
+        if bounds is not None:
+            lo, hi = bounds
+            assert a.min() >= lo and a.max() <= hi, (op, a.min(), a.max())
+        if moments is not None:
+            mean, std, tol = moments
+            assert abs(a.mean() - mean) < tol, (op, a.mean())
+            assert abs(a.std() - std) < tol, (op, a.std())
+        return True
+
+    E[op] = dict(i=[f(1)], attrs={}, grad=False, call=call,
+                 check=lambda p, a: None)
+
+
+_mk_seeded_inplace("uniform_", bounds=(-1.0, 1.0),
+                   moments=(0.0, 0.577, 0.1))
+_mk_seeded_inplace("normal_", moments=(0.0, 1.0, 0.1))
+_mk_seeded_inplace("exponential_", bounds=(0.0, np.inf),
+                   moments=(1.0, 1.0, 0.15))
+
+case("complex", [f(3, 4), f(3, 4)], ref=torch.complex, grad=False)
+E["complex_"] = E.pop("complex")
+
+
+
+
+# indexing protocol + formerly-stochastic activations (r5 graduation)
+def _getitem_call(fn, pts):
+    import builtins
+    return fn(pts[0], (builtins.slice(1, 3), 1))
+
+
+E["getitem"] = dict(
+    i=[f(4, 5)], attrs={}, grad=False, call=_getitem_call,
+    check=lambda p, a: np.testing.assert_allclose(_np_c(p), a[0][1:3, 1]))
+
+
+def _setitem_call(fn, pts):
+    import builtins
+    return fn(pts[0], (builtins.slice(0, 2),), pts[1])
+
+
+def _setitem_check(p_out, arrs):
+    want = arrs[0].copy()
+    want[0:2] = arrs[1]
+    np.testing.assert_allclose(_np_c(p_out), want)
+
+
+E["setitem"] = dict(i=[f(4, 5), f(2, 5)], attrs={}, grad=False,
+                    call=_setitem_call, check=_setitem_check)
+
+case("rrelu", [f(3, 4)],
+     attrs={"lower": 0.1, "upper": 0.3, "training": False},
+     ref=lambda x: torch.nn.functional.leaky_relu(x, 0.2), tattrs={},
+     grad=False)
+
+
+def _gumbel_call(fn, pts):
+    paddle.seed(5)
+    a = _np_c(fn(pts[0], hard=True))
+    paddle.seed(5)
+    b = _np_c(fn(pts[0], hard=True))
+    np.testing.assert_array_equal(a, b)   # seeded determinism
+    return a
+
+
+def _gumbel_check(p_out, arrs):
+    # hard=True via straight-through: rows are one-hot up to fp assembly
+    np.testing.assert_allclose(p_out.max(-1), 1.0, atol=1e-5)
+    np.testing.assert_allclose(p_out.sum(-1), 1.0, atol=1e-5)
+
+
+E["gumbel_softmax"] = dict(i=[f(6, 5)], attrs={}, grad=False,
+                           call=_gumbel_call, check=_gumbel_check)
+
 
 # ---------------------------------------------------------------------------
 # harness

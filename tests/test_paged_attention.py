@@ -122,3 +122,51 @@ def test_blha_mixed_prefill_decode_batch():
                                atol=1e-5)
     np.testing.assert_allclose(kc_m.numpy(), kc_d.numpy(), atol=1e-6)
     np.testing.assert_allclose(vc_m.numpy(), vc_d.numpy(), atol=1e-6)
+
+
+def test_paged_decode_minus_one_padded_block_tables():
+    """Reference blha convention pads block_tables with -1 past each
+    sequence's allocated pages; the kernel must not read a negative HBM
+    offset (entries are clamped; compute is masked by length anyway)."""
+    rng = np.random.RandomState(7)
+    B, H, Hkv, D, bs, nblk = 2, 4, 4, 64, 8, 4
+    num_blocks = 16
+    q = jnp.asarray(rng.randn(B, H, D), jnp.float32)
+    kc = jnp.asarray(rng.randn(num_blocks, Hkv, bs, D), jnp.float32)
+    vc = jnp.asarray(rng.randn(num_blocks, Hkv, bs, D), jnp.float32)
+    bt = np.full((B, nblk), -1, np.int32)
+    bt[0, :2] = [3, 7]
+    bt[1, :1] = [5]
+    lengths = jnp.asarray([11, 6], jnp.int32)
+    out = pa.paged_decode_attention(q, kc, vc, jnp.asarray(bt), lengths)
+    # oracle over only the VALID pages
+    bt_valid = np.where(bt < 0, 0, bt)
+    ref = pa.paged_decode_reference(q, kc, vc, jnp.asarray(bt_valid),
+                                    lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_blha_decode_pallas_mixed_dtype_cache():
+    """bf16 KV cache + f32 qkv must work on the pallas path (q joins the
+    cache dtype; the probe compiles that combination)."""
+    rng = np.random.RandomState(8)
+    B, H, D, bs, nblk = 2, 4, 64, 8, 3
+    num_blocks = 16
+    dec = np.array([5, 9])
+    qkv = paddle.to_tensor(rng.randn(B, 3 * H * D).astype(np.float32))
+    bt = paddle.to_tensor(
+        rng.choice(num_blocks, B * nblk, replace=False)
+        .reshape(B, nblk).astype(np.int32))
+    paddle.set_flags({"use_pallas_kernels": True})
+    kc = paddle.to_tensor(
+        jnp.asarray(rng.randn(num_blocks, H, bs, D), jnp.bfloat16))
+    vc = paddle.to_tensor(
+        jnp.asarray(rng.randn(num_blocks, H, bs, D), jnp.bfloat16))
+    out, _, kc2, vc2 = IF.block_multihead_attention(
+        qkv, kc, vc,
+        seq_lens_encoder=np.zeros(B, np.int32),
+        seq_lens_decoder=dec.astype(np.int32),
+        seq_lens_this_time=np.ones(B, np.int32),
+        block_tables=bt, block_size=bs)
+    assert np.isfinite(out.numpy()).all()
+    assert "bfloat16" in str(kc2._data.dtype)
