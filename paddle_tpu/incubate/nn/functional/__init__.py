@@ -465,7 +465,9 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
         return out.reshape(B, Hc * Dh).astype(xa.dtype), kc, vc
 
     def prefill_impl(xa, kc, vc, bt, lens, *maybe_bias, has_bias,
-                     starts):
+                     starts, use_varlen):
+        import math as _math
+
         qkv_ = xa.reshape(-1, 3, Hc, Dh)
         if has_bias:
             qkv_ = qkv_ + maybe_bias[0].reshape(3, Hc, Dh)[None]
@@ -475,17 +477,27 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
         starts_a = jnp.asarray(starts)
         seg = jnp.searchsorted(starts_a, pos_g, side="right") - 1
         rel = pos_g - starts_a[seg]
-        # causal varlen attention within each sequence
-        same = seg[:, None] == seg[None, :]
-        causal = rel[:, None] >= rel[None, :]
-        m = same & causal
-        scores = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
-                            k.astype(jnp.float32)) / jnp.sqrt(
-                                jnp.float32(Dh))
-        scores = jnp.where(m[None], scores, -jnp.inf)
-        probs = jax.nn.softmax(scores, axis=-1)
-        probs = jnp.where(m[None], probs, 0.0)
-        out = jnp.einsum("hqk,khd->qhd", probs, v.astype(jnp.float32))
+        if use_varlen:
+            # the prefill IS varlen causal attention: ride the segment-
+            # aware pallas flash kernel (flash_attention_varlen.py) — no
+            # dense [H, T_total, T_total] score matrix materializes
+            from ....ops.pallas.flash_attention_varlen import (
+                _varlen_attention)
+            cu = jnp.asarray(tuple(starts) + (int(Ttot),), jnp.int32)
+            out = _varlen_attention(True, 1.0 / _math.sqrt(Dh),
+                                    q, k, v, cu, cu)
+        else:
+            # segment-masked XLA composition
+            same = seg[:, None] == seg[None, :]
+            causal = rel[:, None] >= rel[None, :]
+            m = same & causal
+            scores = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                                k.astype(jnp.float32)) / jnp.sqrt(
+                                    jnp.float32(Dh))
+            scores = jnp.where(m[None], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1)
+            probs = jnp.where(m[None], probs, 0.0)
+            out = jnp.einsum("hqk,khd->qhd", probs, v.astype(jnp.float32))
         # scatter fresh k/v into pages: token (seg b, rel r) -> block
         # bt[b, r // bs], slot r % bs
         blk = bt[seg, rel // bs]
@@ -511,9 +523,22 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
     else:
         starts = tuple(int(s) for s in np.concatenate([[0],
                                                        np.cumsum(this)[:-1]]))
+        from ....core import amp_state
+        from ....ops.pallas.flash_attention_varlen import use_varlen_flash
+        # probe with the dtype the kernel ACTUALLY runs in (AMP autocasts
+        # inside dispatch — attention.py:133 rationale), and a CANONICAL
+        # token count: eligibility doesn't depend on T_total, and serving
+        # varies it per request mix — probing per T would pay a throwaway
+        # fwd+bwd compile on the request path
+        cast_to = amp_state.autocast_dtype_for(
+            "block_multihead_attention_prefill")
+        eff_dtype = cast_to if cast_to is not None else _arr(qkv).dtype
+        q_sds = jax.ShapeDtypeStruct((256, Hc, Dh), eff_dtype)
+        use_varlen = bool(use_varlen_flash(q_sds, q_sds, True))
         out, kc2, vc2 = D_.apply(
             "block_multihead_attention_prefill", prefill_impl,
             (qkv, key_cache, value_cache, block_tables, seq_lens_this_time,
-             *opt), {"has_bias": qkv_bias is not None, "starts": starts},
+             *opt), {"has_bias": qkv_bias is not None, "starts": starts,
+                     "use_varlen": use_varlen},
             num_outputs=3)
     return out, qkv, kc2, vc2

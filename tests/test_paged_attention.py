@@ -170,3 +170,39 @@ def test_blha_decode_pallas_mixed_dtype_cache():
         block_tables=bt, block_size=bs)
     assert np.isfinite(out.numpy()).all()
     assert "bfloat16" in str(kc2._data.dtype)
+
+
+def test_blha_prefill_varlen_pallas_matches_dense():
+    """The prefill path riding the varlen flash kernel must match the
+    segment-masked dense composition."""
+    import paddle_tpu.ops.pallas.flash_attention as fa
+    rng = np.random.RandomState(9)
+    H, D, bs, nblk = 4, 64, 8, 4
+    num_blocks = 16
+    lens = np.array([6, 3], np.int32)
+    tok = int(lens.sum())
+    qkv = rng.randn(tok, 3 * H * D).astype(np.float32)
+    bt = rng.choice(num_blocks, 2 * nblk, replace=False) \
+        .reshape(2, nblk).astype(np.int32)
+    kc0 = rng.randn(num_blocks, H, bs, D).astype(np.float32)
+    vc0 = rng.randn(num_blocks, H, bs, D).astype(np.float32)
+
+    outs = {}
+    old = fa.INTERPRET
+    try:
+        for flag, interp in ((False, False), (True, True)):
+            fa.INTERPRET = interp     # varlen eligibility honors _fa.INTERPRET
+            paddle.set_flags({"use_pallas_kernels": flag})
+            out, _, kc2, vc2 = IF.block_multihead_attention(
+                paddle.to_tensor(qkv), paddle.to_tensor(kc0.copy()),
+                paddle.to_tensor(vc0.copy()),
+                seq_lens_encoder=lens, seq_lens_decoder=np.zeros(2, np.int32),
+                seq_lens_this_time=lens,
+                block_tables=paddle.to_tensor(bt), block_size=bs)
+            outs[flag] = (out.numpy(), kc2.numpy())
+    finally:
+        fa.INTERPRET = old
+        paddle.set_flags({"use_pallas_kernels": True})
+    np.testing.assert_allclose(outs[True][0], outs[False][0],
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(outs[True][1], outs[False][1])
