@@ -38,7 +38,10 @@ count.  MEASURED (PPBUBBLE_r04.json, 8-dev CPU mesh, M=8, median-of-3):
 VPP's wall-clock speedup over 1F1B meets or exceeds the analytic
 prediction at every grid point — pp2: vpp2 1.03x (pred 1.06), vpp4 1.22x
 (pred 1.09); pp4: vpp2 1.32x (pred 1.16), vpp4 1.58x (pred 1.26) — so the
-deferral stands on data, not only on the argument above.
+deferral stands on data, not only on the argument above.  Caveat
+(r4 review): the pp2 rows overlap within their own rep spread
+(1f1b 14.31s [13.09,17.15] vs vpp2 13.86s [12.49,16.92]); the cleanly
+separated pp4 rows carry the conclusion.
 """
 from __future__ import annotations
 
