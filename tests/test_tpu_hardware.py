@@ -181,3 +181,65 @@ def test_speculative_decode_on_tpu():
     spec = speculative_generate(target, draft, ids, max_new_tokens=8,
                                 gamma=3, temperature=0.0).numpy()
     np.testing.assert_array_equal(spec, ref)
+
+
+@pytest.mark.parametrize("H,Hkv,D,bs,nblk", [
+    (16, 16, 128, 64, 8),    # the serving-decode bench shape family
+    (8, 4, 64, 16, 5),       # GQA
+])
+def test_paged_decode_on_tpu(H, Hkv, D, bs, nblk):
+    """The r5 paged-KV decode kernel must lower and match the dense
+    composition on real hardware (interpret mode cannot enforce Mosaic
+    tiling — the module's founding lesson)."""
+    from paddle_tpu.ops.pallas import paged_attention as PA
+
+    rng = np.random.RandomState(3)
+    B = 2
+    num_blocks = B * nblk
+    q = jnp.asarray(rng.randn(B, H, D), jnp.bfloat16)
+    kc = jnp.asarray(rng.randn(num_blocks, Hkv, bs, D), jnp.bfloat16)
+    vc = jnp.asarray(rng.randn(num_blocks, Hkv, bs, D), jnp.bfloat16)
+    bt = jnp.asarray(rng.permutation(num_blocks).reshape(B, nblk),
+                     jnp.int32)
+    lengths = jnp.asarray([nblk * bs - 7, bs + 3], jnp.int32)
+    assert PA.supports(B, H, Hkv, D, bs, nblk=nblk,
+                       dtype=jnp.bfloat16), "lowering probe must accept"
+    out = jax.jit(PA.paged_decode_attention)(q, kc, vc, bt, lengths)
+    ref = PA.paged_decode_reference(q, kc, vc, bt, lengths)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32)
+                                - ref.astype(jnp.float32))))
+    assert err < 0.06, err
+
+
+def test_varlen_prefill_blha_on_tpu():
+    """blha prefill riding the varlen flash kernel, on-chip."""
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import functional as IF
+
+    rng = np.random.RandomState(4)
+    H, D, bs, nblk = 8, 128, 64, 4
+    num_blocks = 16
+    lens = np.array([130, 70], np.int32)
+    tok = int(lens.sum())
+    qkv = paddle.to_tensor(
+        jnp.asarray(rng.randn(tok, 3 * H * D), jnp.bfloat16))
+    bt = paddle.to_tensor(rng.choice(num_blocks, 2 * nblk, replace=False)
+                          .reshape(2, nblk).astype(np.int32))
+    kc = paddle.to_tensor(
+        jnp.asarray(rng.randn(num_blocks, H, bs, D), jnp.bfloat16))
+    vc = paddle.to_tensor(
+        jnp.asarray(rng.randn(num_blocks, H, bs, D), jnp.bfloat16))
+    paddle.set_flags({"use_pallas_kernels": True})
+    out, _, _, _ = IF.block_multihead_attention(
+        qkv, kc, vc, seq_lens_encoder=lens,
+        seq_lens_decoder=np.zeros(2, np.int32), seq_lens_this_time=lens,
+        block_tables=bt, block_size=bs)
+    paddle.set_flags({"use_pallas_kernels": False})
+    ref, _, _, _ = IF.block_multihead_attention(
+        qkv, paddle.to_tensor(kc._data), paddle.to_tensor(vc._data),
+        seq_lens_encoder=lens, seq_lens_decoder=np.zeros(2, np.int32),
+        seq_lens_this_time=lens, block_tables=bt, block_size=bs)
+    paddle.set_flags({"use_pallas_kernels": True})
+    err = float(np.max(np.abs(out.numpy().astype(np.float32)
+                              - ref.numpy().astype(np.float32))))
+    assert err < 0.06, err
