@@ -95,6 +95,15 @@ LEVERS = [
     ("d128_560m_remat_attn_b4", {"remat_policy": "attn", "batch": 4,
                                  "hidden": 1280, "heads": 10, "kv": 5,
                                  "ffn": 3456}),
+    # FA block retune at d128 (512 was tuned at d64; VERDICT r4 next-2)
+    ("d128_560m_no_remat_b2_fablk256",
+     {"remat": False, "batch": 2, "hidden": 1280, "heads": 10, "kv": 5,
+      "ffn": 3456, "env": {"PADDLE_TPU_FA_BLOCK_Q": "256",
+                           "PADDLE_TPU_FA_BLOCK_K": "256"}}),
+    ("d128_560m_no_remat_b2_fablk1024",
+     {"remat": False, "batch": 2, "hidden": 1280, "heads": 10, "kv": 5,
+      "ffn": 3456, "env": {"PADDLE_TPU_FA_BLOCK_Q": "1024",
+                           "PADDLE_TPU_FA_BLOCK_K": "1024"}}),
 ]
 
 
