@@ -355,7 +355,7 @@ def _serving_decode_bench(on_tpu):
            "context": ctx, "dtype": str(jnp.dtype(dtype))}
     paths = {}
     fns = {"dense_xla": jax.jit(pa.paged_decode_reference)}
-    use_pallas = pa.INTERPRET or (on_tpu and pa.supports(
+    use_pallas = pa.interpret_mode() or (on_tpu and pa.supports(
         B, H, Hkv, D, bs, nblk=nblk, dtype=jnp.dtype(dtype)))
     if use_pallas:
         fns["pallas_paged"] = jax.jit(pa.paged_decode_attention)

@@ -140,7 +140,7 @@ def x64_scope(*dtype_likes):
     """
     import contextlib
 
-    import jax
+    from .jaxcompat import enable_x64
 
     for d in dtype_likes:
         if d is None:
@@ -150,5 +150,5 @@ def x64_scope(*dtype_likes):
         except TypeError:
             continue
         if name in _X64_NAMES:
-            return jax.enable_x64(True)
+            return enable_x64(True)
     return contextlib.nullcontext()

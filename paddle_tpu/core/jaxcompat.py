@@ -1,0 +1,55 @@
+"""Version-compat shims over jax APIs that moved between releases.
+
+``shard_map`` and ``enable_x64`` graduated from ``jax.experimental`` to
+the top-level ``jax`` namespace (shard_map renamed its replication-check
+kwarg ``check_rep`` -> ``check_vma`` on the way).  Every in-tree caller
+imports them from here so the framework runs on both sides of the move;
+shard_map callers may pass either kwarg spelling and it is translated to
+whatever the resident jax accepts.
+"""
+from __future__ import annotations
+
+try:  # jax >= 0.6: top-level export, kwarg named check_vma
+    from jax import shard_map as _shard_map_impl
+    _REP_KWARG = "check_vma"
+except ImportError:  # jax 0.4.x: experimental module, kwarg named check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+    _REP_KWARG = "check_rep"
+
+try:  # jax >= 0.5: top-level context manager
+    from jax import enable_x64
+except ImportError:  # jax 0.4.x
+    from jax.experimental import enable_x64
+
+try:  # jax >= 0.7: marks values as varying over manual mesh axes
+    from jax.lax import pcast
+except ImportError:
+    def pcast(x, axis_name=None, *, to=None):
+        """Old-style shard_map has no varying-manual-axes tracking, so
+        the vma pre-marking new-style scan carries need is an identity."""
+        return x
+
+try:  # jax >= 0.6: static size of a manual mesh axis
+    from jax.lax import axis_size
+except ImportError:
+    def axis_size(axis_name):
+        """psum of a Python literal constant-folds to the axis size
+        (a static int) on every jax that predates lax.axis_size."""
+        from jax import lax
+        return lax.psum(1, axis_name)
+
+__all__ = ["shard_map", "enable_x64", "pcast", "axis_size"]
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None,
+              check_rep=None, **kwargs):
+    """``jax.shard_map`` with the replication-check kwarg normalized.
+
+    ``check_vma`` (new spelling) wins over ``check_rep`` (old spelling)
+    when both are given; omitting both keeps the resident jax's default.
+    """
+    flag = check_vma if check_vma is not None else check_rep
+    if flag is not None:
+        kwargs[_REP_KWARG] = flag
+    return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, **kwargs)

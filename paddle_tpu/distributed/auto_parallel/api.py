@@ -159,8 +159,9 @@ def _resolve_partial(dist_tensor, target_placements):
             reduce_axes.append(mesh.dim_names[i])
     if not reduce_axes:
         return dist_tensor._data
-    from jax import shard_map
     from jax import lax
+
+    from ...core.jaxcompat import shard_map
     jm = mesh.jax_mesh()
     spec = _to_partition_spec(mesh, src_attr.placements, dist_tensor.ndim)
     # check_vma=False: the "replicated" input really carries per-device

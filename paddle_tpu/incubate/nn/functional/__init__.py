@@ -511,7 +511,7 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
         from ....ops.pallas import paged_attention as _pa
         use_pallas = bool(
             get_flag("use_pallas_kernels")
-            and (_pa.INTERPRET or jax.default_backend() == "tpu")
+            and (_pa.interpret_mode() or jax.default_backend() == "tpu")
             and _pa.supports(B, Hc, Hc, Dh, bs,
                              nblk=int(_arr(block_tables).shape[1]),
                              dtype=_arr(key_cache).dtype))

@@ -17,7 +17,21 @@ import numpy as np
 __all__ = ["Config", "Predictor", "PredictorPool", "Tensor",
            "create_predictor", "get_version", "DataType", "PlaceType",
            "PrecisionType", "get_num_bytes_of_data_type",
-           "convert_to_mixed_precision"]
+           "convert_to_mixed_precision",
+           "BlockManager", "LLMEngine", "Request", "RequestOutput"]
+
+
+def __getattr__(name):
+    # serving engine loads lazily: importing paddle_tpu.inference must not
+    # pull jax/model code for Predictor-only users
+    if name in ("LLMEngine", "Request", "RequestOutput"):
+        from .serving import LLMEngine, Request, RequestOutput
+        return {"LLMEngine": LLMEngine, "Request": Request,
+                "RequestOutput": RequestOutput}[name]
+    if name == "BlockManager":
+        from .kv_cache import BlockManager
+        return BlockManager
+    raise AttributeError(name)
 
 
 class DataType:

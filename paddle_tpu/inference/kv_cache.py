@@ -1,0 +1,160 @@
+"""Paged KV-cache block management for the serving engine.
+
+The device caches are a fixed pool of ``num_blocks`` pages of
+``block_size`` token slots each (layout ``[L, num_blocks, H_kv, bs, D]``,
+the blha cache layout per layer).  This module owns the HOST side of that
+pool: which pages belong to which sequence, in order — the per-sequence
+block table the paged-attention kernel walks via scalar prefetch
+(ops/pallas/paged_attention.py).  Mirrors the reference serving stack's
+block manager around block_multi_head_attention (and vLLM's BlockManager
+shape): alloc on admission, grow one page at a time during decode, free on
+retirement, and report occupancy/fragmentation so the scheduler can decide
+when to stop admitting and when to preempt.
+
+Block id 0 is reserved as the NULL page: padded scheduler slots point
+every block-table entry at it, so their (masked) cache writes land in a
+page no live sequence owns.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["BlockManager", "NULL_BLOCK"]
+
+NULL_BLOCK = 0
+
+
+class BlockManager:
+    """Fixed-size page pool with per-sequence block tables.
+
+    Invariants (asserted by tests/test_llm_engine.py):
+    - a block is owned by at most one sequence at a time;
+    - block 0 (the null page) is never handed out;
+    - free() returns every block of a sequence to the pool;
+    - num_free + num_allocated == num_blocks - 1 at all times.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (one is the reserved null page)")
+        if block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # LIFO free list (ids 1..num_blocks-1); id 0 stays reserved
+        self._free = list(range(self.num_blocks - 1, NULL_BLOCK, -1))
+        self._tables: dict = {}          # seq id -> [block ids, in order]
+        self._tokens: dict = {}          # seq id -> token count covered
+        # counters for the scheduler stats surface
+        self.alloc_count = 0
+        self.free_count = 0
+        self.peak_used = 0
+
+    # -- capacity queries ---------------------------------------------------
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Pages needed to hold n_tokens."""
+        return max(0, -(-int(n_tokens) // self.block_size))
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return (self.num_blocks - 1) - len(self._free)
+
+    def can_allocate(self, n_blocks: int) -> bool:
+        return n_blocks <= len(self._free)
+
+    # -- alloc / grow / free ------------------------------------------------
+
+    def allocate(self, seq_id, n_tokens: int) -> bool:
+        """Claim pages covering n_tokens for a new sequence.  False (and no
+        state change) when the pool cannot cover the request."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already has a block table")
+        need = self.blocks_for(n_tokens)
+        if not self.can_allocate(need):
+            return False
+        self._tables[seq_id] = [self._free.pop() for _ in range(need)]
+        self._tokens[seq_id] = int(n_tokens)
+        self.alloc_count += need
+        self.peak_used = max(self.peak_used, self.num_used)
+        return True
+
+    def ensure(self, seq_id, n_tokens: int) -> bool:
+        """Grow seq_id's table until it covers n_tokens (decode appends one
+        token per step; this allocates the next page on a boundary).  False
+        when the pool is exhausted — the scheduler's preemption trigger."""
+        table = self._tables[seq_id]
+        need = self.blocks_for(n_tokens)
+        grow = need - len(table)
+        if grow > 0:
+            if not self.can_allocate(grow):
+                return False
+            table.extend(self._free.pop() for _ in range(grow))
+            self.alloc_count += grow
+            self.peak_used = max(self.peak_used, self.num_used)
+        self._tokens[seq_id] = max(self._tokens.get(seq_id, 0), int(n_tokens))
+        return True
+
+    def free(self, seq_id) -> None:
+        """Return every page of seq_id to the pool (retirement/preemption)."""
+        table = self._tables.pop(seq_id)
+        self._tokens.pop(seq_id, None)
+        self.free_count += len(table)
+        self._free.extend(reversed(table))
+
+    def has(self, seq_id) -> bool:
+        return seq_id in self._tables
+
+    # -- table export -------------------------------------------------------
+
+    def block_table(self, seq_id) -> list:
+        return list(self._tables[seq_id])
+
+    def padded_table(self, seq_id, width: int) -> np.ndarray:
+        """int32 [width] block table padded with the null page (the kernel
+        clamps/never reads past `lengths`, and padded entries DMA the null
+        page rather than a live one)."""
+        table = self._tables[seq_id]
+        if len(table) > width:
+            raise ValueError(
+                f"sequence {seq_id!r} holds {len(table)} pages > table "
+                f"width {width}")
+        out = np.full((width,), NULL_BLOCK, np.int32)
+        out[:len(table)] = table
+        return out
+
+    # -- stats --------------------------------------------------------------
+
+    def occupancy(self) -> float:
+        """Fraction of the usable pool currently owned by sequences."""
+        usable = self.num_blocks - 1
+        return self.num_used / usable if usable else 0.0
+
+    def fragmentation(self) -> float:
+        """Internal fragmentation: fraction of allocated slots not backing
+        a token (tail-of-last-page waste; paging trades this bounded waste
+        for the dense [B, max_len] cache's unbounded padding waste)."""
+        slots = self.num_used * self.block_size
+        if slots == 0:
+            return 0.0
+        used_tokens = sum(min(self._tokens.get(s, 0),
+                              len(t) * self.block_size)
+                          for s, t in self._tables.items())
+        return 1.0 - used_tokens / slots
+
+    def stats(self) -> dict:
+        return {
+            "num_blocks": self.num_blocks,
+            "block_size": self.block_size,
+            "used_blocks": self.num_used,
+            "free_blocks": self.num_free,
+            "peak_used_blocks": self.peak_used,
+            "occupancy": round(self.occupancy(), 4),
+            "fragmentation": round(self.fragmentation(), 4),
+            "alloc_count": self.alloc_count,
+            "free_count": self.free_count,
+        }

@@ -1,0 +1,542 @@
+"""Continuous-batching decode engine over the paged-KV Pallas kernel.
+
+The r5 kernel work (ops/pallas/paged_attention.py) gave single-token
+decode over paged KV; what was missing is the ENGINE that serves a stream
+of requests through it (the reference's serving stack around
+block_multi_head_attention; vLLM's engine shape).  Three pieces:
+
+- ``BlockManager`` (inference/kv_cache.py): a fixed page pool with
+  per-sequence block tables — admission claims pages, decode grows them
+  one page at a time, retirement/preemption returns them.
+
+- A continuous-batching scheduler: every ``step()`` admits waiting
+  requests into the running batch (no waiting for the batch to drain),
+  retires sequences on eos/max-tokens, and — when the page pool is
+  exhausted mid-decode — preempts the youngest sequence, returning its
+  pages and requeuing it for full recomputation.
+
+- Exactly two bucketed compiled programs instead of per-request
+  recompiles:
+    * a varlen PREFILL step: admitted prompts are packed into one flat
+      token buffer (sequence-id + in-sequence-position per token, the
+      flash_attention_varlen segment idiom), padded to a token-count
+      bucket, so any mix of prompt lengths reuses one program;
+    * a single-token batched DECODE step driving the paged-attention
+      kernel, padded to the max-batch bucket, so any running-set size
+      reuses one program.
+  Both thread the KV caches through with buffer donation, so the
+  [L, num_blocks, H_kv, bs, D] pool is updated in place on TPU instead
+  of copied per step.
+
+The decode math is term-for-term the math of ``_make_decode_fwd``
+(models/llama.py), so greedy engine output is token-identical to
+``LlamaForCausalLM.generate`` — the e2e equivalence test in
+tests/test_llm_engine.py holds the two paths together.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..models.llama import _rms_weight, _rope_positions
+from ..ops.pallas import paged_attention as _pa
+from ..ops.pallas import flash_attention_varlen as _fav
+from ..profiler import RecordEvent, ServingStats
+from .kv_cache import NULL_BLOCK, BlockManager
+
+__all__ = ["LLMEngine", "Request", "RequestOutput"]
+
+
+@dataclass
+class Request:
+    """One generation request in the engine's queues."""
+    rid: int
+    prompt: list                      # original prompt token ids
+    max_new_tokens: int
+    temperature: float
+    eos_token_id: object              # int | None
+    seed: int
+    # scheduler state
+    tokens: list = field(default_factory=list)   # tokens to (re)prefill
+    generated: list = field(default_factory=list)
+    cached: int = 0                   # positions whose KV is in the pool
+    arrival: int = 0                  # admission priority (FCFS)
+
+
+@dataclass
+class RequestOutput:
+    rid: int
+    prompt: list
+    generated: list                   # includes the eos token when hit
+    finish_reason: str                # "eos" | "length"
+
+    @property
+    def token_ids(self):
+        return list(self.prompt) + list(self.generated)
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _sample_tokens(logits, temps, keys):
+    """Per-sequence sampling: argmax at temperature<=0 (byte-compatible
+    with generate()'s greedy branch), else temperature categorical."""
+    greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+
+    def one(key, lg, t):
+        return jax.random.categorical(key, lg / jnp.maximum(t, 1e-6))
+
+    sampled = jax.vmap(one)(keys, logits, temps).astype(jnp.int32)
+    return jnp.where(temps <= 0.0, greedy, sampled)
+
+
+class LLMEngine:
+    """Continuous-batching serving loop over one LlamaForCausalLM.
+
+    Parameters
+    ----------
+    model: LlamaForCausalLM (weights are snapshot via decode_params()).
+    max_num_seqs: decode-batch capacity (the padded decode batch size).
+    block_size: KV page size in tokens (must satisfy the paged kernel's
+        bs % 8 == 0 to be kernel-eligible on TPU).
+    num_blocks: page-pool size.  Default sizes the pool so every batch
+        slot can reach max_model_len (no preemption under the default).
+    max_model_len: longest prompt+generation the engine accepts; fixes
+        the static block-table width of the decode program.
+    max_prefill_tokens: per-step prompt-token admission budget.
+    prefill_token_bucket: flat prefill buffers are padded up to a
+        multiple of this, bounding the number of prefill programs by
+        max_prefill_tokens / bucket (x the few batch buckets).
+    """
+
+    def __init__(self, model, *, max_num_seqs: int = 8, block_size: int = 16,
+                 num_blocks: int | None = None, max_model_len: int | None = None,
+                 max_prefill_tokens: int = 512,
+                 prefill_token_bucket: int = 64):
+        cfg = model.config
+        self.config = cfg
+        self.params = model.decode_params()
+        self.max_num_seqs = int(max_num_seqs)
+        self.block_size = int(block_size)
+        self.max_model_len = int(max_model_len or cfg.max_position_embeddings)
+        self.max_prefill_tokens = int(max_prefill_tokens)
+        self.prefill_token_bucket = int(prefill_token_bucket)
+
+        # static block-table width: pages needed by a max-length sequence
+        self.nblk = -(-self.max_model_len // self.block_size)
+        if num_blocks is None:
+            num_blocks = 1 + self.max_num_seqs * self.nblk
+        self.blocks = BlockManager(num_blocks, self.block_size)
+        if self.blocks.num_free < self.nblk:
+            raise ValueError(
+                f"num_blocks={num_blocks} cannot hold even one "
+                f"max_model_len={self.max_model_len} sequence "
+                f"({self.nblk} pages needed)")
+
+        self._nh = cfg.num_attention_heads
+        self._kvh = cfg.num_key_value_heads
+        self._hd = cfg.hidden_size // self._nh
+        L = cfg.num_hidden_layers
+        dt = self.params["embed"].dtype
+        self._kc = jnp.zeros((L, num_blocks, self._kvh, self.block_size,
+                              self._hd), dt)
+        self._vc = jnp.zeros_like(self._kc)
+
+        self._waiting: deque = deque()
+        self._running: list = []
+        self._finished: dict = {}
+        self._next_rid = 0
+        self._arrival = 0
+
+        # program caches: compile counts == len() of these
+        self._decode_progs: dict = {}
+        self._prefill_progs: dict = {}
+        self.stats = ServingStats()
+
+    # ------------------------------------------------------------------
+    # request API
+    # ------------------------------------------------------------------
+
+    def add_request(self, prompt, max_new_tokens: int = 32,
+                    temperature: float = 0.0, eos_token_id=None,
+                    seed: int = 0) -> int:
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) + int(max_new_tokens) > self.max_model_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_model_len "
+                f"({self.max_model_len})")
+        if len(prompt) > self.max_prefill_tokens:
+            raise ValueError(
+                f"prompt ({len(prompt)}) exceeds max_prefill_tokens "
+                f"({self.max_prefill_tokens})")
+        rid = self._next_rid
+        self._next_rid += 1
+        req = Request(rid=rid, prompt=prompt, tokens=list(prompt),
+                      max_new_tokens=int(max_new_tokens),
+                      temperature=float(temperature),
+                      eos_token_id=eos_token_id, seed=int(seed))
+        self._waiting.append(req)
+        return rid
+
+    def has_unfinished(self) -> bool:
+        return bool(self._waiting or self._running)
+
+    @property
+    def num_decode_programs(self) -> int:
+        return len(self._decode_progs)
+
+    @property
+    def num_prefill_programs(self) -> int:
+        return len(self._prefill_progs)
+
+    def run(self) -> dict:
+        """Drive step() until every queued request finishes."""
+        while self.has_unfinished():
+            self.step()
+        return dict(self._finished)
+
+    # ------------------------------------------------------------------
+    # scheduler
+    # ------------------------------------------------------------------
+
+    def step(self) -> list:
+        """One engine iteration: admit -> prefill -> decode -> retire.
+        Returns the requests that finished during this step."""
+        finished = []
+
+        admitted = self._admit()
+        if admitted:
+            self.stats.record_admission(len(admitted))
+            t0 = time.perf_counter()
+            with RecordEvent("llm_engine.prefill"):
+                first = self._run_prefill(admitted)
+            dur = time.perf_counter() - t0
+            self.stats.record_prefill(
+                dur, sum(len(r.tokens) for r in admitted), len(admitted))
+            for req, tok in zip(admitted, first):
+                req.cached = len(req.tokens)
+                req.generated.append(int(tok))
+                self._maybe_retire(req, finished)
+
+        # decode everyone already in the batch (sequences prefilled THIS
+        # step already produced their token above)
+        batch = [r for r in self._running if r not in admitted]
+        batch = self._reserve_decode_pages(batch)
+        if batch:
+            t0 = time.perf_counter()
+            with RecordEvent("llm_engine.decode"):
+                toks = self._run_decode(batch)
+            dur = time.perf_counter() - t0
+            self.stats.record_decode(
+                dur, len(batch), len(self._running) / self.max_num_seqs)
+            for req, tok in zip(batch, toks):
+                req.cached += 1
+                req.generated.append(int(tok))
+                self._maybe_retire(req, finished)
+
+        return finished
+
+    def _admit(self) -> list:
+        """Pull waiting requests into the running set while batch slots,
+        pool pages and the prefill-token budget allow."""
+        admitted = []
+        budget = self.max_prefill_tokens
+        while self._waiting and len(self._running) < self.max_num_seqs:
+            req = self._waiting[0]
+            need_tokens = len(req.tokens)
+            if need_tokens > budget:
+                break
+            if not self.blocks.allocate(req.rid, need_tokens):
+                break
+            self._waiting.popleft()
+            req.arrival = self._arrival
+            self._arrival += 1
+            self._running.append(req)
+            admitted.append(req)
+            budget -= need_tokens
+        return admitted
+
+    def _reserve_decode_pages(self, batch: list) -> list:
+        """Grow each sequence's table for the token this step will write;
+        preempt the youngest runner whenever the pool comes up short."""
+        ok = []
+        for req in sorted(batch, key=lambda r: r.arrival):
+            if req not in self._running:   # evicted as a victim earlier
+                continue
+            while not self.blocks.ensure(req.rid, req.cached + 1):
+                victim = self._pick_victim(exclude=req)
+                if victim is None:
+                    # nothing younger to evict: preempt THIS sequence
+                    self._preempt(req)
+                    req = None
+                    break
+                self._preempt(victim)
+                ok = [r for r in ok if r is not victim]
+            if req is not None:
+                ok.append(req)
+        return ok
+
+    def _pick_victim(self, exclude):
+        """Youngest-arrival running sequence other than ``exclude``."""
+        cands = [r for r in self._running if r is not exclude]
+        if not cands:
+            return None
+        return max(cands, key=lambda r: r.arrival)
+
+    def _preempt(self, req) -> None:
+        """Return req's pages and requeue it (front of the line) for full
+        recomputation: its next prefill covers prompt + tokens generated
+        so far, which rebuilds the exact KV state — greedy decoding
+        resumes token-identically."""
+        self.blocks.free(req.rid)
+        self._running.remove(req)
+        req.tokens = list(req.prompt) + list(req.generated)
+        req.cached = 0
+        self._waiting.appendleft(req)
+        self.stats.record_preemption()
+
+    def _maybe_retire(self, req, finished: list) -> None:
+        eos = req.eos_token_id
+        if eos is not None and req.generated[-1] == int(eos):
+            reason = "eos"
+        elif len(req.generated) >= req.max_new_tokens:
+            reason = "length"
+        else:
+            return
+        self.blocks.free(req.rid)
+        self._running.remove(req)
+        out = RequestOutput(rid=req.rid, prompt=list(req.prompt),
+                            generated=list(req.generated),
+                            finish_reason=reason)
+        self._finished[req.rid] = out
+        finished.append(out)
+        self.stats.record_retirement()
+
+    # ------------------------------------------------------------------
+    # compiled decode step
+    # ------------------------------------------------------------------
+
+    def _decode_bucket(self, n: int) -> int:
+        # one bucket: the full batch width.  Padding decode to max_num_seqs
+        # costs little (one token per slot) and pins the compile count at 1.
+        return self.max_num_seqs
+
+    def _get_decode_prog(self, Bb: int):
+        key = (Bb, self.nblk)
+        prog = self._decode_progs.get(key)
+        if prog is None:
+            prog = self._build_decode(Bb)
+            self._decode_progs[key] = prog
+        return prog
+
+    def _build_decode(self, Bb: int):
+        nh, kvh, d = self._nh, self._kvh, self._hd
+        bs = self.block_size
+        eps = self.config.rms_norm_eps
+        theta = self.config.rope_theta
+        dt = self.params["embed"].dtype
+        use_pallas = _pa.interpret_mode() or (
+            jax.default_backend() == "tpu"
+            and _pa.supports(Bb, nh, kvh, d, bs, self.nblk, dt))
+
+        def run(params, kc, vc, toks, pos, bt, temps, keys):
+            # toks/pos [Bb] int32; bt [Bb, nblk] int32; temps [Bb] f32;
+            # keys [Bb, 2] uint32.  pos is the cache position the fresh
+            # token's K/V lands in; attention covers pos+1 entries.
+            x = jnp.take(params["embed"], toks, axis=0)       # [Bb, H]
+
+            def body(x, inp):
+                p, kcl, vcl = inp
+                h = _rms_weight(x, p["ln1"], eps)
+                q = (h @ p["wq"]).reshape(Bb, nh, d)
+                k = (h @ p["wk"]).reshape(Bb, kvh, d)
+                v = (h @ p["wv"]).reshape(Bb, kvh, d)
+                q = _rope_positions(q, pos, theta)
+                k = _rope_positions(k, pos, theta)
+                blk = jnp.take_along_axis(bt, (pos // bs)[:, None],
+                                          axis=1)[:, 0]
+                slot = pos % bs
+                kcl = kcl.at[blk, :, slot, :].set(k.astype(kcl.dtype))
+                vcl = vcl.at[blk, :, slot, :].set(v.astype(vcl.dtype))
+                if use_pallas:
+                    att = _pa.paged_decode_attention(q, kcl, vcl, bt,
+                                                     pos + 1)
+                else:
+                    att = _pa.paged_decode_reference(q, kcl, vcl, bt,
+                                                     pos + 1)
+                x = x + att.reshape(Bb, nh * d) @ p["wo"]
+                h2 = _rms_weight(x, p["ln2"], eps)
+                a = jax.nn.silu((h2 @ p["gate"]).astype(jnp.float32)
+                                ).astype(h2.dtype) * (h2 @ p["up"])
+                return x + a @ p["down"], (kcl, vcl)
+
+            x, (kc, vc) = lax.scan(body, x, (params["layers"], kc, vc))
+            h = _rms_weight(x, params["norm_f"], eps)
+            logits = (h.astype(jnp.float32)
+                      @ params["head"].astype(jnp.float32))
+            return _sample_tokens(logits, temps, keys), kc, vc
+
+        # donation reuses the pool buffers in place; CPU's runtime cannot
+        # donate (it would warn every call), so only donate on device
+        donate = (1, 2) if jax.default_backend() != "cpu" else ()
+        return jax.jit(run, donate_argnums=donate)
+
+    def _run_decode(self, batch: list):
+        Bb = self._decode_bucket(len(batch))
+        prog = self._get_decode_prog(Bb)
+        toks = np.zeros((Bb,), np.int32)
+        pos = np.zeros((Bb,), np.int32)
+        bt = np.full((Bb, self.nblk), NULL_BLOCK, np.int32)  # pads -> null
+        temps = np.zeros((Bb,), np.float32)
+        keys = np.zeros((Bb, 2), np.uint32)
+        for i, req in enumerate(batch):
+            toks[i] = req.generated[-1]
+            pos[i] = req.cached
+            bt[i] = self.blocks.padded_table(req.rid, self.nblk)
+            temps[i] = req.temperature
+            keys[i] = self._req_key(req)
+        out, self._kc, self._vc = prog(self.params, self._kc, self._vc,
+                                       toks, pos, bt, temps, keys)
+        out = np.asarray(out)
+        return [out[i] for i in range(len(batch))]
+
+    def _req_key(self, req):
+        # key for token i of request r depends only on (seed, i): sampling
+        # is reproducible across scheduling orders and preemptions
+        key = jax.random.fold_in(jax.random.PRNGKey(req.seed),
+                                 len(req.generated))
+        return np.asarray(key, np.uint32)
+
+    # ------------------------------------------------------------------
+    # compiled prefill step
+    # ------------------------------------------------------------------
+
+    def _prefill_buckets(self, n_tokens: int, n_seqs: int):
+        tb = self.prefill_token_bucket
+        Tp = max(tb, -(-n_tokens // tb) * tb)
+        Bp = min(_next_pow2(max(n_seqs, 1)), self.max_num_seqs)
+        Bp = max(Bp, 1)
+        return Tp, Bp
+
+    def _get_prefill_prog(self, Tp: int, Bp: int):
+        key = (Tp, Bp)
+        prog = self._prefill_progs.get(key)
+        if prog is None:
+            prog = self._build_prefill(Tp, Bp)
+            self._prefill_progs[key] = prog
+        return prog
+
+    def _build_prefill(self, Tp: int, Bp: int):
+        nh, kvh, d = self._nh, self._kvh, self._hd
+        bs = self.block_size
+        eps = self.config.rms_norm_eps
+        theta = self.config.rope_theta
+        sm_scale = 1.0 / (d ** 0.5)
+        # the varlen flash kernel wants TPU (or its own interpret flag),
+        # packed MHA [T, H, D]; otherwise a dense segment-masked f32
+        # composition computes the same masked softmax
+        probe = jnp.zeros((Tp, nh, d), self.params["embed"].dtype)
+        probe_k = jnp.zeros((Tp, kvh, d), self.params["embed"].dtype)
+        use_varlen = bool(_fav.use_varlen_flash(probe, probe_k, True))
+
+        def attend(q, k, v, seg, rel, cu):
+            if use_varlen:
+                return _fav._varlen_attention(True, sm_scale, q, k, v,
+                                              cu, cu)
+            if kvh != nh:
+                k = jnp.repeat(k, nh // kvh, axis=1)
+                v = jnp.repeat(v, nh // kvh, axis=1)
+            sc = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * sm_scale
+            mask = (seg[None, :, None] == seg[None, None, :]) \
+                & (rel[None, None, :] <= rel[None, :, None])
+            sc = jnp.where(mask, sc, -jnp.inf)
+            pr = jax.nn.softmax(sc, axis=-1)
+            out = jnp.einsum("hqk,khd->qhd", pr, v.astype(jnp.float32))
+            return out.astype(q.dtype)
+
+        def run(params, kc, vc, toks, seg, rel, bt, cu, last_idx, temps,
+                keys):
+            # toks/seg/rel [Tp] int32 (pads carry seg == Bp, a row of the
+            # null page in bt); bt [Bp+1, nblk]; cu [Bp+1] varlen offsets;
+            # last_idx [Bp] flat index of each sequence's final token.
+            x = jnp.take(params["embed"], toks, axis=0)       # [Tp, H]
+
+            def body(x, inp):
+                p, kcl, vcl = inp
+                h = _rms_weight(x, p["ln1"], eps)
+                q = (h @ p["wq"]).reshape(Tp, nh, d)
+                k = (h @ p["wk"]).reshape(Tp, kvh, d)
+                v = (h @ p["wv"]).reshape(Tp, kvh, d)
+                q = _rope_positions(q, rel, theta)
+                k = _rope_positions(k, rel, theta)
+                blk = bt[seg, rel // bs]                      # [Tp]
+                slot = rel % bs
+                kcl = kcl.at[blk, :, slot, :].set(k.astype(kcl.dtype))
+                vcl = vcl.at[blk, :, slot, :].set(v.astype(vcl.dtype))
+                att = attend(q, k, v, seg, rel, cu)
+                x = x + att.reshape(Tp, nh * d) @ p["wo"]
+                h2 = _rms_weight(x, p["ln2"], eps)
+                a = jax.nn.silu((h2 @ p["gate"]).astype(jnp.float32)
+                                ).astype(h2.dtype) * (h2 @ p["up"])
+                return x + a @ p["down"], (kcl, vcl)
+
+            x, (kc, vc) = lax.scan(body, x, (params["layers"], kc, vc))
+            h = _rms_weight(x, params["norm_f"], eps)
+            hsel = h[last_idx]                                # [Bp, H]
+            logits = (hsel.astype(jnp.float32)
+                      @ params["head"].astype(jnp.float32))
+            return _sample_tokens(logits, temps, keys), kc, vc
+
+        donate = (1, 2) if jax.default_backend() != "cpu" else ()
+        return jax.jit(run, donate_argnums=donate)
+
+    def _run_prefill(self, admitted: list):
+        total = sum(len(r.tokens) for r in admitted)
+        Tp, Bp = self._prefill_buckets(total, len(admitted))
+        prog = self._get_prefill_prog(Tp, Bp)
+
+        toks = np.zeros((Tp,), np.int32)
+        seg = np.full((Tp,), Bp, np.int32)            # pads -> sentinel
+        rel = np.zeros((Tp,), np.int32)
+        bt = np.full((Bp + 1, self.nblk), NULL_BLOCK,
+                     np.int32)                        # sentinel row: null
+        cu = np.zeros((Bp + 1,), np.int32)
+        last_idx = np.zeros((Bp,), np.int32)
+        temps = np.zeros((Bp,), np.float32)
+        keys = np.zeros((Bp, 2), np.uint32)
+
+        off = 0
+        for i, req in enumerate(admitted):
+            n = len(req.tokens)
+            toks[off:off + n] = req.tokens
+            seg[off:off + n] = i
+            rel[off:off + n] = np.arange(n)
+            bt[i] = self.blocks.padded_table(req.rid, self.nblk)
+            last_idx[i] = off + n - 1
+            temps[i] = req.temperature
+            keys[i] = self._req_key(req)
+            off += n
+            cu[i + 1] = off
+        # empty trailing batch slots: zero-length sequences whose
+        # last_idx points at token 0; their sampled token is discarded
+        cu[len(admitted) + 1:] = off
+
+        out, self._kc, self._vc = prog(self.params, self._kc, self._vc,
+                                       toks, seg, rel, bt, cu, last_idx,
+                                       temps, keys)
+        out = np.asarray(out)
+        return [out[i] for i in range(len(admitted))]

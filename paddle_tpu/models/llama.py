@@ -273,6 +273,12 @@ class LlamaForCausalLM(nn.Layer):
         return {"layers": stacked, "embed": embed,
                 "norm_f": self.model.norm.weight._data, "head": head}
 
+    def decode_params(self):
+        """Public decode-parameter export for serving engines
+        (paddle_tpu/inference/serving.py): layer-stacked weight pytree in
+        the exact layout ``_make_decode_fwd`` consumes."""
+        return self._decode_params()
+
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
                  top_p=None, top_k=None, repetition_penalty=None,
                  eos_token_id=None, seed=0):
@@ -592,6 +598,41 @@ def _build_speculative(tcfg, dcfg, S0, max_new, gamma, temperature, eos_id):
         return jnp.concatenate([ids, gen[None]], axis=1)
 
     return jax.jit(run)
+
+
+# Decode-math building blocks shared with the serving engine
+# (inference/serving.py).  The engine's continuous batches carry a
+# DIFFERENT absolute position per sequence, so these take per-token
+# position arrays; the float math is term-for-term the same as
+# _make_decode_fwd's rms/rope closures, which keeps the engine's greedy
+# decode token-identical to generate().
+
+def _rms_weight(x, w, eps):
+    """RMSNorm in f32 with a learned scale, cast back to x.dtype."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    xf = x.astype(jnp.float32)
+    o = xf * lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + eps)
+    return (o * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope_positions(x, pos, theta):
+    """Interleaved rotary embedding at per-token absolute positions.
+
+    x [..., h, d]; pos [...] (matching x.shape[:-2]) int/float positions.
+    """
+    import jax.numpy as jnp
+
+    d = x.shape[-1]
+    inv = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    freqs = pos.astype(jnp.float32)[..., None, None] * inv  # [..., 1, d/2]
+    cos = jnp.cos(freqs)
+    sin = jnp.sin(freqs)
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., 0::2], xf[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+    return out.reshape(x.shape).astype(x.dtype)
 
 
 def _make_decode_fwd(cfg, all_logits=False):

@@ -26,7 +26,19 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-INTERPRET = False
+# Tri-state interpret override.  None (default) resolves per-backend:
+# interpret everywhere except a real TPU, so the serving engine and its
+# tests run the kernel on CPU without mutating this global.  Tests that
+# need a forced mode (the fixture in tests/test_paged_attention.py) may
+# still assign True/False here and restore the old value after.
+INTERPRET = None
+
+
+def interpret_mode() -> bool:
+    """Resolved interpret flag: the module override wins when set."""
+    if INTERPRET is None:
+        return jax.default_backend() != "tpu"
+    return bool(INTERPRET)
 
 
 def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
@@ -115,7 +127,7 @@ def paged_decode_attention(q, key_cache, value_cache, block_tables,
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
-        interpret=INTERPRET,
+        interpret=interpret_mode(),
     )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
       qr, key_cache, value_cache)
     return out.reshape(B, H, D)
@@ -160,7 +172,7 @@ def _probe_lowering(B, H, Hkv, D, bs, nblk, dtype) -> bool:
     hit = _PROBE_CACHE.get(key)
     if hit is not None:
         return hit
-    if INTERPRET:  # interpreter enforces no TPU tiling rules
+    if interpret_mode():  # interpreter enforces no TPU tiling rules
         _PROBE_CACHE[key] = True
         return True
     num_blocks = max(nblk * B, 1)

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..core.jaxcompat import axis_size as _axis_size
 from ..incubate.distributed.models.moe.gating import (
     capacity_for, combine_output, expert_silu_ffn, gate_dispatch)
 
@@ -46,7 +47,7 @@ def moe_ffn(x, params, ep_axis: str | None = "ep", top_k: int = 2,
     expert shards [E_loc, H, F] / [E_loc, F, H] (E = ep * E_loc).
     Returns (y [T_loc, H], aux_loss scalar — already pmean'd over ep).
     """
-    ep = lax.axis_size(ep_axis) if ep_axis else 1
+    ep = _axis_size(ep_axis) if ep_axis else 1
     E_loc = params["w_in"].shape[0]
     E = ep * E_loc
     T_loc, H = x.shape

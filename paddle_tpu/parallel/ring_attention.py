@@ -21,7 +21,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from ..core.jaxcompat import axis_size as _axis_size, pcast as _pcast, \
+    shard_map
 
 __all__ = ["ring_attention", "ring_self_attention", "zigzag_permutation",
            "zigzag_inverse_permutation"]
@@ -63,7 +65,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
     global token positions held by each shard (for zigzag/load-balanced
     layouts).  Default: contiguous — shard i holds [i*S_local, (i+1)*S_local).
     """
-    cp = lax.axis_size(axis_name)
+    cp = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, S, H, D = q.shape
     in_dtype = q.dtype
@@ -87,9 +89,9 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
 
     # scan needs carry-in vma == carry-out vma: mark the fresh accumulators
     # as varying over the ring axis (kf/vf/qf already are).
-    m0 = lax.pcast(jnp.full((B, H, S), _NEG_INF, jnp.float32),
-                   axis_name, to="varying")
-    l0 = lax.pcast(jnp.zeros((B, H, S), jnp.float32), axis_name, to="varying")
+    m0 = _pcast(jnp.full((B, H, S), _NEG_INF, jnp.float32),
+                axis_name, to="varying")
+    l0 = _pcast(jnp.zeros((B, H, S), jnp.float32), axis_name, to="varying")
     acc0 = jnp.zeros_like(qf)  # zeros_like inherits qf's varying vma
 
     # Block 0 (own KV) is computed outside the loop; each remaining step

@@ -56,7 +56,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from ..core.jaxcompat import pcast as _pcast_compat, shard_map
 
 from ..models.llama import LlamaConfig
 from .ring_attention import ring_attention
@@ -722,7 +723,7 @@ def _pcast_all(x):
     # new-style shard_map tracks which mesh axes a value varies over; scan
     # needs carry-in vma == carry-out vma, so pre-mark zero carries as
     # varying over every mesh axis the body's outputs vary over.
-    return lax.pcast(x, ("pp", "dp", "cp", "tp"), to="varying")
+    return _pcast_compat(x, ("pp", "dp", "cp", "tp"), to="varying")
 
 
 def _forward_loss(params, tokens, cfg, hp):

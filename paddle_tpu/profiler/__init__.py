@@ -13,12 +13,13 @@ from .profiler import (  # noqa: F401
     Profiler, ProfilerState, ProfilerTarget, RecordEvent,
     export_chrome_tracing, load_profiler_result, make_scheduler,
 )
+from .serving import ServingStats  # noqa: F401
 from .timer import benchmark  # noqa: F401
 
 __all__ = [
     "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
     "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-    "benchmark",
+    "benchmark", "ServingStats",
 ]
 
 

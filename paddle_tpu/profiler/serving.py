@@ -1,0 +1,104 @@
+"""Serving-side profiling: per-step timing + scheduler counters.
+
+The LLM engine (paddle_tpu/inference/serving.py) is a host loop around two
+compiled programs; what matters for serving perf is not one op's latency
+but the shape of the whole stream — per-token latency percentiles, how
+full the decode batch ran, how often the page pool forced a preemption,
+and how many distinct programs XLA had to build.  ``ServingStats``
+aggregates exactly that, and the engine additionally brackets each phase
+in ``profiler.RecordEvent`` so engine steps land in chrome traces next to
+model ops when a Profiler is active.
+"""
+from __future__ import annotations
+
+__all__ = ["ServingStats"]
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class ServingStats:
+    """Aggregates one serving run's step timings and scheduler events.
+
+    Times arrive from the engine as wall-clock seconds per STEP together
+    with how many sequences' tokens that step produced; per-token latency
+    is the step duration each of those tokens observed (every sequence in
+    a batched step waits for the whole step).
+    """
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.prefill_steps = 0
+        self.prefill_tokens = 0          # prompt tokens processed
+        self.prefill_time = 0.0
+        self.decode_steps = 0
+        self.decode_tokens = 0           # tokens emitted by decode steps
+        self.decode_time = 0.0
+        self._token_lat = []             # per emitted token: its step's dur
+        self._occupancy = []             # running/max_num_seqs per decode step
+        self.preemptions = 0
+        self.admitted = 0
+        self.retired = 0
+
+    # -- recording (engine-facing) ------------------------------------------
+
+    def record_prefill(self, duration_s: float, n_prompt_tokens: int,
+                       n_seqs: int) -> None:
+        self.prefill_steps += 1
+        self.prefill_tokens += int(n_prompt_tokens)
+        self.prefill_time += float(duration_s)
+        # each sequence's first token comes out of the prefill step
+        self._token_lat.extend([float(duration_s)] * int(n_seqs))
+
+    def record_decode(self, duration_s: float, n_tokens: int,
+                      occupancy: float) -> None:
+        self.decode_steps += 1
+        self.decode_tokens += int(n_tokens)
+        self.decode_time += float(duration_s)
+        self._token_lat.extend([float(duration_s)] * int(n_tokens))
+        self._occupancy.append(float(occupancy))
+
+    def record_admission(self, n: int = 1) -> None:
+        self.admitted += int(n)
+
+    def record_retirement(self, n: int = 1) -> None:
+        self.retired += int(n)
+
+    def record_preemption(self, n: int = 1) -> None:
+        self.preemptions += int(n)
+
+    # -- derived metrics ----------------------------------------------------
+
+    def decode_tokens_per_s(self) -> float:
+        return self.decode_tokens / self.decode_time if self.decode_time \
+            else 0.0
+
+    def token_latency_ms(self, q: float) -> float:
+        return 1e3 * _percentile(sorted(self._token_lat), q)
+
+    def mean_occupancy(self) -> float:
+        return sum(self._occupancy) / len(self._occupancy) \
+            if self._occupancy else 0.0
+
+    def summary(self) -> dict:
+        return {
+            "prefill_steps": self.prefill_steps,
+            "prefill_tokens": self.prefill_tokens,
+            "decode_steps": self.decode_steps,
+            "decode_tokens": self.decode_tokens,
+            "decode_tokens_per_s": round(self.decode_tokens_per_s(), 2),
+            "p50_token_ms": round(self.token_latency_ms(50), 3),
+            "p99_token_ms": round(self.token_latency_ms(99), 3),
+            "mean_batch_occupancy": round(self.mean_occupancy(), 4),
+            "admitted": self.admitted,
+            "retired": self.retired,
+            "preemptions": self.preemptions,
+        }

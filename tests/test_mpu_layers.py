@@ -82,9 +82,9 @@ def test_c_softmax_with_cross_entropy_sharded_matches_serial():
     """ParallelCrossEntropy inside shard_map over an mp axis == serial."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
+    from paddle_tpu.core.jaxcompat import shard_map
     from paddle_tpu.distributed import collective as C
     from paddle_tpu.distributed.fleet.layers.mpu.mp_ops import (
         _c_softmax_with_cross_entropy,
@@ -127,8 +127,10 @@ def test_sequence_parallel_ops_traced_roundtrip():
     shard_map (the actual TP execution regime)."""
     import jax
     import jax.numpy as jnp
-    from jax import lax, shard_map
+    from jax import lax
     from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.core.jaxcompat import shard_map
 
     mesh = Mesh(np.array(jax.devices()[:4]), ("mp",))
     x = np.random.RandomState(4).randn(8, 4).astype(np.float32)

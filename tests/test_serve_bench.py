@@ -1,0 +1,27 @@
+"""serve_bench hardening contract: the one-line JSON record always prints,
+on whatever backend the test host resolves (CPU fallback included)."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "tools", "perf", "serve_bench.py")
+
+
+def test_serve_bench_smoke_emits_json_line():
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "--smoke", "--requests", "4"],
+        capture_output=True, text=True, timeout=540,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.strip().splitlines() if ln.strip()]
+    assert lines, f"no stdout; stderr: {out.stderr[-2000:]}"
+    record = json.loads(lines[-1])
+    assert record["metric"] == "serve_decode_tokens_per_s"
+    assert record["unit"] == "tok/s"
+    assert "backend" in record
+    assert "error" not in record, record
+    assert record["value"] > 0
+    assert record["decode_compiles"] <= 2
+    assert record["p99_token_ms"] >= record["p50_token_ms"] > 0

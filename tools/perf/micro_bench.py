@@ -122,7 +122,7 @@ fwd_flops = 2 * n_params * T + att_flops * 24
 
 ps = TR.param_specs(hp, False)
 sm_kw = dict(mesh=mesh, check_vma=False)
-from jax import shard_map as _shard_map
+from paddle_tpu.core.jaxcompat import shard_map as _shard_map
 
 fwd = jax.jit(_shard_map(lambda p, t: TR._forward_loss(p, t, cfg, hp),
                          in_specs=(ps, P(None, "dp", None)), out_specs=P(),
