@@ -18,7 +18,8 @@ __all__ = ["Config", "Predictor", "PredictorPool", "Tensor",
            "create_predictor", "get_version", "DataType", "PlaceType",
            "PrecisionType", "get_num_bytes_of_data_type",
            "convert_to_mixed_precision",
-           "BlockManager", "LLMEngine", "Request", "RequestOutput"]
+           "BlockManager", "BlockPoolExhausted", "LLMEngine", "Request",
+           "RequestOutput"]
 
 
 def __getattr__(name):
@@ -28,9 +29,10 @@ def __getattr__(name):
         from .serving import LLMEngine, Request, RequestOutput
         return {"LLMEngine": LLMEngine, "Request": Request,
                 "RequestOutput": RequestOutput}[name]
-    if name == "BlockManager":
-        from .kv_cache import BlockManager
-        return BlockManager
+    if name in ("BlockManager", "BlockPoolExhausted"):
+        from .kv_cache import BlockManager, BlockPoolExhausted
+        return {"BlockManager": BlockManager,
+                "BlockPoolExhausted": BlockPoolExhausted}[name]
     raise AttributeError(name)
 
 

@@ -11,44 +11,97 @@ shape): alloc on admission, grow one page at a time during decode, free on
 retirement, and report occupancy/fragmentation so the scheduler can decide
 when to stop admitting and when to preempt.
 
+Prefix caching (``enable_prefix_caching=True``) turns the pool into a
+content-addressed cache: every FULL page is identified by a rolling chain
+hash of all prompt/generated tokens up to and including that page, and a
+hash → block map lets a new sequence whose token prefix matches reuse the
+page instead of recomputing its KV.  Reuse is refcounted — a page may back
+several live sequences at once — and any write into a page with
+refcount > 1 first COPIES it (copy-on-write), so divergence after a shared
+partial page never corrupts a neighbour.  Freed pages whose content is
+registered are not returned to the free list; they park in an LRU of
+refcount-0 "cached" pages and are only evicted (unregistered) when the
+free list is empty — eviction is the last resort, so a hot system prompt
+stays resident.  Page lifecycle:
+
+    free → allocated (refcount 1) → shared (refcount n)
+                  │                      │
+                  └──── freed, hashed ───┘
+                            ↓
+                    cached (refcount 0, LRU) ── evicted ──→ free
+
 Block id 0 is reserved as the NULL page: padded scheduler slots point
 every block-table entry at it, so their (masked) cache writes land in a
 page no live sequence owns.
 """
 from __future__ import annotations
 
+from collections import OrderedDict
+
 import numpy as np
 
-__all__ = ["BlockManager", "NULL_BLOCK"]
+__all__ = ["BlockManager", "BlockPoolExhausted", "NULL_BLOCK"]
 
 NULL_BLOCK = 0
+
+
+class BlockPoolExhausted(RuntimeError):
+    """No free or evictable page is left — the caller must preempt."""
+
+
+def _page_hash(prev, tokens):
+    """Rolling chain hash: a page's identity is its OWN tokens plus the
+    hash chain of every page before it, so identical pages at different
+    prefix positions never alias."""
+    return hash((prev, tuple(tokens)))
 
 
 class BlockManager:
     """Fixed-size page pool with per-sequence block tables.
 
-    Invariants (asserted by tests/test_llm_engine.py):
-    - a block is owned by at most one sequence at a time;
+    Invariants (asserted by tests/test_llm_engine.py and
+    tests/test_prefix_cache.py via ``check_invariants``):
     - block 0 (the null page) is never handed out;
-    - free() returns every block of a sequence to the pool;
-    - num_free + num_allocated == num_blocks - 1 at all times.
+    - every block is exactly one of: free, cached (refcount 0, hashed),
+      or live (refcount >= 1);
+    - a live block's refcount equals the number of block tables holding
+      it (sharing only via the prefix cache);
+    - num_used + num_free + num_cached == num_blocks - 1 at all times;
+    - free() of an unknown/already-freed sequence raises instead of
+      corrupting the free list.
     """
 
-    def __init__(self, num_blocks: int, block_size: int):
+    def __init__(self, num_blocks: int, block_size: int,
+                 enable_prefix_caching: bool = False):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (one is the reserved null page)")
         if block_size < 1:
             raise ValueError("block_size must be >= 1")
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
+        self.enable_prefix_caching = bool(enable_prefix_caching)
         # LIFO free list (ids 1..num_blocks-1); id 0 stays reserved
         self._free = list(range(self.num_blocks - 1, NULL_BLOCK, -1))
         self._tables: dict = {}          # seq id -> [block ids, in order]
         self._tokens: dict = {}          # seq id -> token count covered
+        self._ref: dict = {}             # block id -> refcount (>= 1)
+        # prefix-cache state
+        self._cached: OrderedDict = OrderedDict()   # refcount-0 LRU
+        self._hash_to_block: dict = {}   # chain hash -> block id
+        self._block_hashes: dict = {}    # block id -> set of chain hashes
+        self._ids: dict = {}             # seq id -> token ids (or None)
+        self._valid: dict = {}           # seq id -> positions with valid KV
+        self._chain: dict = {}           # seq id -> per-full-page chain hashes
+        self._version: dict = {}         # seq id -> table mutation counter
+        self._freed: set = set()         # for clear double-free errors
         # counters for the scheduler stats surface
         self.alloc_count = 0
         self.free_count = 0
         self.peak_used = 0
+        self.cache_hit_tokens = 0
+        self.cache_miss_tokens = 0
+        self.cow_count = 0
+        self.eviction_count = 0
 
     # -- capacity queries ---------------------------------------------------
 
@@ -61,27 +114,163 @@ class BlockManager:
         return len(self._free)
 
     @property
+    def num_cached(self) -> int:
+        return len(self._cached)
+
+    @property
     def num_used(self) -> int:
-        return (self.num_blocks - 1) - len(self._free)
+        return (self.num_blocks - 1) - len(self._free) - len(self._cached)
 
     def can_allocate(self, n_blocks: int) -> bool:
-        return n_blocks <= len(self._free)
+        # cached pages are evictable, so they count as available
+        return n_blocks <= len(self._free) + len(self._cached)
+
+    # -- pool primitives ----------------------------------------------------
+
+    def _take_block(self) -> int:
+        """One fresh page: free list first, else evict the LRU cached page
+        (the only moment a cached page loses its registered content)."""
+        if self._free:
+            return self._free.pop()
+        if self._cached:
+            blk, _ = self._cached.popitem(last=False)     # oldest first
+            self._unregister(blk)
+            self.eviction_count += 1
+            return blk
+        raise BlockPoolExhausted("no free or evictable page left")
+
+    def _unregister(self, blk: int) -> None:
+        for h in self._block_hashes.pop(blk, ()):
+            if self._hash_to_block.get(h) == blk:
+                del self._hash_to_block[h]
+
+    def _register(self, blk: int, h) -> None:
+        # first content wins: a hash already mapping to another live/cached
+        # block keeps pointing there (dedup happens at match time)
+        if self._hash_to_block.setdefault(h, blk) == blk:
+            self._block_hashes.setdefault(blk, set()).add(h)
+
+    def _incref(self, blk: int) -> None:
+        self._ref[blk] = self._ref.get(blk, 0) + 1
+        self._cached.pop(blk, None)
+
+    def _decref(self, blk: int) -> None:
+        r = self._ref.get(blk, 0)
+        if r <= 0:
+            raise AssertionError(
+                f"refcount underflow on block {blk} (double free?)")
+        if r == 1:
+            del self._ref[blk]
+            if self._block_hashes.get(blk):
+                self._cached[blk] = None      # park, content stays valid
+            else:
+                self._free.append(blk)
+        else:
+            self._ref[blk] = r - 1
 
     # -- alloc / grow / free ------------------------------------------------
 
     def allocate(self, seq_id, n_tokens: int) -> bool:
-        """Claim pages covering n_tokens for a new sequence.  False (and no
-        state change) when the pool cannot cover the request."""
+        """Claim fresh pages covering n_tokens for a new sequence (no
+        prefix matching — token ids unknown).  False (and no state change)
+        when the pool cannot cover the request."""
         if seq_id in self._tables:
             raise ValueError(f"sequence {seq_id!r} already has a block table")
         need = self.blocks_for(n_tokens)
         if not self.can_allocate(need):
             return False
-        self._tables[seq_id] = [self._free.pop() for _ in range(need)]
+        table = [self._take_block() for _ in range(need)]
+        for b in table:
+            self._incref(b)
+        self._tables[seq_id] = table
         self._tokens[seq_id] = int(n_tokens)
+        self._ids[seq_id] = None
+        self._valid[seq_id] = 0
+        self._chain[seq_id] = []
+        self._version[seq_id] = 0
+        self._freed.discard(seq_id)
         self.alloc_count += need
         self.peak_used = max(self.peak_used, self.num_used)
         return True
+
+    def match_prefix(self, token_ids) -> int:
+        """Longest cached prefix (in tokens) for token_ids, capped at
+        len(token_ids) - 1 so at least one token is always (re)computed
+        for logits.  Read-only: no refcounts change."""
+        hits, partial, n_hit = self._match(list(token_ids))
+        return n_hit
+
+    def _match(self, ids):
+        """(full_hit_blocks, partial_hit_block_or_None, n_hit_tokens)."""
+        if not self.enable_prefix_caching:
+            return [], None, 0
+        bs = self.block_size
+        n = len(ids)
+        hits, prev = [], None
+        for p in range(n // bs):
+            h = _page_hash(prev, ids[p * bs:(p + 1) * bs])
+            blk = self._hash_to_block.get(h)
+            if blk is None or blk in hits:
+                break
+            hits.append(blk)
+            prev = h
+        while len(hits) * bs >= n:        # keep >= 1 token to compute
+            hits.pop()
+            prev = None if not hits else _page_hash_chain(ids, len(hits), bs)
+        n_hit = len(hits) * bs
+        partial = None
+        rem = ids[n_hit:]
+        for k in range(min(bs - 1, n - 1 - n_hit), 0, -1):
+            h = _page_hash(prev, rem[:k])
+            blk = self._hash_to_block.get(h)
+            if blk is not None and blk not in hits:
+                partial = blk
+                n_hit += k
+                break
+        return hits, partial, n_hit
+
+    def acquire(self, seq_id, token_ids):
+        """Prefix-cached admission: match token_ids against the cache,
+        take refcounted references on every hit page, claim fresh pages
+        for the miss suffix.  Returns the number of prefix tokens whose
+        KV is already valid (0 on a clean miss), or None when the pool
+        cannot cover the miss suffix."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already has a block table")
+        ids = [int(t) for t in token_ids]
+        if not ids:
+            raise ValueError("empty token_ids")
+        if not self.enable_prefix_caching:
+            return 0 if self.allocate(seq_id, len(ids)) else None
+        hits, partial, n_hit = self._match(ids)
+        hit_blocks = hits + ([partial] if partial is not None else [])
+        fresh = self.blocks_for(len(ids)) - len(hit_blocks)
+        evictable_hits = sum(1 for b in hit_blocks if b in self._cached)
+        if fresh > len(self._free) + len(self._cached) - evictable_hits:
+            return None
+        for b in hit_blocks:
+            self._incref(b)
+        table = hit_blocks + [self._take_block() for _ in range(fresh)]
+        for b in table[len(hit_blocks):]:
+            self._incref(b)
+        self._tables[seq_id] = table
+        self._tokens[seq_id] = len(ids)
+        self._ids[seq_id] = ids
+        self._valid[seq_id] = n_hit
+        # chain hashes for the full hit pages (prefix of the table)
+        chain, prev = [], None
+        for p in range(len(hits)):
+            prev = _page_hash(prev, ids[p * self.block_size:
+                                        (p + 1) * self.block_size])
+            chain.append(prev)
+        self._chain[seq_id] = chain
+        self._version[seq_id] = 0
+        self._freed.discard(seq_id)
+        self.alloc_count += fresh
+        self.cache_hit_tokens += n_hit
+        self.cache_miss_tokens += len(ids) - n_hit
+        self.peak_used = max(self.peak_used, self.num_used)
+        return n_hit
 
     def ensure(self, seq_id, n_tokens: int) -> bool:
         """Grow seq_id's table until it covers n_tokens (decode appends one
@@ -93,18 +282,110 @@ class BlockManager:
         if grow > 0:
             if not self.can_allocate(grow):
                 return False
-            table.extend(self._free.pop() for _ in range(grow))
+            for _ in range(grow):
+                b = self._take_block()
+                self._incref(b)
+                table.append(b)
             self.alloc_count += grow
+            self._version[seq_id] += 1
             self.peak_used = max(self.peak_used, self.num_used)
         self._tokens[seq_id] = max(self._tokens.get(seq_id, 0), int(n_tokens))
         return True
 
+    def cow_if_shared(self, seq_id, pos: int):
+        """Call before writing token position ``pos``: when the page
+        holding pos is shared (refcount > 1) the writer gets a private
+        copy — the table entry is swapped and (src, dst) returned so the
+        engine can copy the page device-side.  None when the page is
+        already private.  Raises BlockPoolExhausted when no page is
+        available for the copy (preemption trigger)."""
+        table = self._tables[seq_id]
+        idx = int(pos) // self.block_size
+        src = table[idx]
+        if self._ref.get(src, 0) <= 1:
+            return None
+        dst = self._take_block()          # may raise BlockPoolExhausted
+        self._incref(dst)
+        table[idx] = dst
+        self._decref(src)                 # others keep the original
+        self._version[seq_id] += 1
+        self.cow_count += 1
+        self.alloc_count += 1
+        self.peak_used = max(self.peak_used, self.num_used)
+        return src, dst
+
+    def commit_prefill(self, seq_id, n_new: int) -> None:
+        """Mark n_new more positions as device-valid (their KV writes are
+        dispatched) and register every page this fills in the hash map."""
+        if self._ids.get(seq_id) is None:
+            self._valid[seq_id] = self._valid.get(seq_id, 0) + int(n_new)
+            return
+        v = self._valid[seq_id] + int(n_new)
+        if v > len(self._ids[seq_id]):
+            raise AssertionError(
+                f"commit past known tokens for {seq_id!r}: {v} > "
+                f"{len(self._ids[seq_id])}")
+        self._valid[seq_id] = v
+        self._register_full_pages(seq_id)
+
+    def commit_decode_token(self, seq_id, token) -> None:
+        """One decode step wrote `token`'s KV at the next position."""
+        ids = self._ids.get(seq_id)
+        if ids is None:
+            self._valid[seq_id] = self._valid.get(seq_id, 0) + 1
+            return
+        if len(ids) != self._valid[seq_id]:
+            raise AssertionError(
+                f"decode commit for {seq_id!r} before prefill finished "
+                f"({self._valid[seq_id]}/{len(ids)} valid)")
+        ids.append(int(token))
+        self._tokens[seq_id] = max(self._tokens.get(seq_id, 0), len(ids))
+        self._valid[seq_id] = len(ids)
+        self._register_full_pages(seq_id)
+
+    def _register_full_pages(self, seq_id) -> None:
+        if not self.enable_prefix_caching:
+            return
+        bs = self.block_size
+        ids = self._ids[seq_id]
+        chain = self._chain[seq_id]
+        table = self._tables[seq_id]
+        full = self._valid[seq_id] // bs
+        while len(chain) < full:
+            p = len(chain)
+            prev = chain[-1] if chain else None
+            h = _page_hash(prev, ids[p * bs:(p + 1) * bs])
+            chain.append(h)
+            self._register(table[p], h)
+
     def free(self, seq_id) -> None:
-        """Return every page of seq_id to the pool (retirement/preemption)."""
+        """Return every page of seq_id (retirement/preemption): refcounts
+        drop by one; pages with registered content park in the cached LRU,
+        the rest rejoin the free list.  A written partial tail page is
+        registered on the way out so a recompute/follow-up can hit it.
+        Double-free raises a clear error instead of corrupting the pool."""
+        if seq_id not in self._tables:
+            if seq_id in self._freed:
+                raise ValueError(
+                    f"double free: sequence {seq_id!r} was already freed")
+            raise ValueError(f"free of unknown sequence {seq_id!r}")
         table = self._tables.pop(seq_id)
+        ids = self._ids.pop(seq_id, None)
+        valid = self._valid.pop(seq_id, 0)
+        chain = self._chain.pop(seq_id, [])
         self._tokens.pop(seq_id, None)
+        self._version.pop(seq_id, None)
+        if self.enable_prefix_caching and ids is not None:
+            bs = self.block_size
+            p, k = valid // bs, valid % bs
+            if k and len(chain) >= p:
+                prev = chain[p - 1] if p else None
+                self._register(table[p],
+                               _page_hash(prev, ids[p * bs:p * bs + k]))
         self.free_count += len(table)
-        self._free.extend(reversed(table))
+        for b in reversed(table):
+            self._decref(b)
+        self._freed.add(seq_id)
 
     def has(self, seq_id) -> bool:
         return seq_id in self._tables
@@ -113,6 +394,11 @@ class BlockManager:
 
     def block_table(self, seq_id) -> list:
         return list(self._tables[seq_id])
+
+    def table_version(self, seq_id) -> int:
+        """Bumped on every table mutation (grow / CoW swap) — lets the
+        engine cache padded host rows and rebuild only on change."""
+        return self._version[seq_id]
 
     def padded_table(self, seq_id, width: int) -> np.ndarray:
         """int32 [width] block table padded with the null page (the kernel
@@ -144,7 +430,7 @@ class BlockManager:
         used_tokens = sum(min(self._tokens.get(s, 0),
                               len(t) * self.block_size)
                           for s, t in self._tables.items())
-        return 1.0 - used_tokens / slots
+        return max(0.0, 1.0 - used_tokens / slots)
 
     def stats(self) -> dict:
         return {
@@ -152,9 +438,55 @@ class BlockManager:
             "block_size": self.block_size,
             "used_blocks": self.num_used,
             "free_blocks": self.num_free,
+            "cached_blocks": self.num_cached,
             "peak_used_blocks": self.peak_used,
             "occupancy": round(self.occupancy(), 4),
             "fragmentation": round(self.fragmentation(), 4),
             "alloc_count": self.alloc_count,
             "free_count": self.free_count,
+            "prefix_caching": self.enable_prefix_caching,
+            "cache_hit_tokens": self.cache_hit_tokens,
+            "cache_miss_tokens": self.cache_miss_tokens,
+            "cow_count": self.cow_count,
+            "eviction_count": self.eviction_count,
         }
+
+    # -- invariants (test surface) ------------------------------------------
+
+    def check_invariants(self) -> None:
+        """Raise AssertionError on any pool-accounting violation."""
+        usable = self.num_blocks - 1
+        free, cached, live = set(self._free), set(self._cached), \
+            set(self._ref)
+        assert len(self._free) == len(free), "duplicate ids on free list"
+        assert not (free & cached), "block both free and cached"
+        assert not (free & live), "block both free and live"
+        assert not (cached & live), "block both cached and live"
+        assert len(free) + len(cached) + len(live) == usable, (
+            f"pool accounting broken: {len(free)} free + {len(cached)} "
+            f"cached + {len(live)} live != {usable}")
+        assert NULL_BLOCK not in free | cached | live, "null page leaked"
+        counts: dict = {}
+        for seq, table in self._tables.items():
+            assert len(table) == len(set(table)), \
+                f"sequence {seq!r} holds a page twice"
+            for b in table:
+                counts[b] = counts.get(b, 0) + 1
+        assert counts.keys() == live, "live set != union of tables"
+        for b, n in counts.items():
+            assert self._ref[b] == n, (
+                f"block {b} refcount {self._ref[b]} != {n} table refs")
+            assert self._ref[b] >= 1, f"block {b} refcount < 1"
+        for h, b in self._hash_to_block.items():
+            assert b in live or b in cached, \
+                f"hash map points at free block {b}"
+            assert h in self._block_hashes.get(b, ()), \
+                f"hash map / block hash mismatch on {b}"
+
+
+def _page_hash_chain(ids, n_pages, bs):
+    """Chain hash after n_pages full pages of ids."""
+    prev = None
+    for p in range(n_pages):
+        prev = _page_hash(prev, ids[p * bs:(p + 1) * bs])
+    return prev

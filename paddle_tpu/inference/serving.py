@@ -7,31 +7,44 @@ block_multi_head_attention; vLLM's engine shape).  Three pieces:
 
 - ``BlockManager`` (inference/kv_cache.py): a fixed page pool with
   per-sequence block tables — admission claims pages, decode grows them
-  one page at a time, retirement/preemption returns them.
+  one page at a time, retirement/preemption returns them.  With prefix
+  caching on (the default) the pool is content-addressed: admission
+  matches each prompt's token chain against pages other sequences
+  already computed, takes refcounted references on the hits, and only
+  the MISS SUFFIX is allocated and prefilled.  Writes into a shared
+  page copy it first (copy-on-write), and freed pages park in an LRU so
+  a hot system prompt stays resident until the pool truly needs the
+  space.
 
 - A continuous-batching scheduler: every ``step()`` admits waiting
   requests into the running batch (no waiting for the batch to drain),
   retires sequences on eos/max-tokens, and — when the page pool is
   exhausted mid-decode — preempts the youngest sequence, returning its
-  pages and requeuing it for full recomputation.
+  pages and requeuing it for recomputation (which now hits the prefix
+  cache its own freed pages just populated).  Prefill is CHUNKED: each
+  step packs at most ``max_prefill_tokens`` pending prompt tokens —
+  partially-prefilled requests resume across steps at their absolute
+  positions — so a long prompt never stalls running decodes; every
+  step still runs one decode for the whole running set.
 
-- Exactly two bucketed compiled programs instead of per-request
-  recompiles:
-    * a varlen PREFILL step: admitted prompts are packed into one flat
-      token buffer (sequence-id + in-sequence-position per token, the
-      flash_attention_varlen segment idiom), padded to a token-count
-      bucket, so any mix of prompt lengths reuses one program;
+- Bucketed compiled programs instead of per-request recompiles:
+    * a varlen PREFILL step for whole-prompt-from-zero batches (the
+      flash_attention_varlen segment idiom, padded to a token bucket);
+    * a CHUNKED PREFILL step for resumed/cache-hit chunks — the chunk's
+      K/V land in the paged cache first, then attention gathers each
+      sequence's pages back densely, so chunk tokens attend to the
+      cached prefix they never computed;
     * a single-token batched DECODE step driving the paged-attention
-      kernel, padded to the max-batch bucket, so any running-set size
-      reuses one program.
-  Both thread the KV caches through with buffer donation, so the
+      kernel, padded to the max-batch bucket.
+  All thread the KV caches through with buffer donation, so the
   [L, num_blocks, H_kv, bs, D] pool is updated in place on TPU instead
   of copied per step.
 
 The decode math is term-for-term the math of ``_make_decode_fwd``
 (models/llama.py), so greedy engine output is token-identical to
-``LlamaForCausalLM.generate`` — the e2e equivalence test in
-tests/test_llm_engine.py holds the two paths together.
+``LlamaForCausalLM.generate`` — with the prefix cache ON or OFF — and
+tests/test_llm_engine.py + tests/test_prefix_cache.py hold the paths
+together.
 """
 from __future__ import annotations
 
@@ -48,7 +61,7 @@ from ..models.llama import _rms_weight, _rope_positions
 from ..ops.pallas import paged_attention as _pa
 from ..ops.pallas import flash_attention_varlen as _fav
 from ..profiler import RecordEvent, ServingStats
-from .kv_cache import NULL_BLOCK, BlockManager
+from .kv_cache import NULL_BLOCK, BlockManager, BlockPoolExhausted
 
 __all__ = ["LLMEngine", "Request", "RequestOutput"]
 
@@ -67,6 +80,9 @@ class Request:
     generated: list = field(default_factory=list)
     cached: int = 0                   # positions whose KV is in the pool
     arrival: int = 0                  # admission priority (FCFS)
+    slot: int = -1                    # stable decode-batch slot
+    t_arrival: float = 0.0            # wall clock at add_request (TTFT)
+    bt_version: int = -1              # last block-table version packed
 
 
 @dataclass
@@ -113,16 +129,23 @@ class LLMEngine:
         slot can reach max_model_len (no preemption under the default).
     max_model_len: longest prompt+generation the engine accepts; fixes
         the static block-table width of the decode program.
-    max_prefill_tokens: per-step prompt-token admission budget.
+    max_prefill_tokens: per-STEP prompt-token budget.  Prompts longer
+        than this are prefilled in chunks across steps (decode of the
+        running set proceeds every step regardless).
     prefill_token_bucket: flat prefill buffers are padded up to a
         multiple of this, bounding the number of prefill programs by
         max_prefill_tokens / bucket (x the few batch buckets).
+    enable_prefix_caching: content-hash full KV pages and reuse them
+        across requests sharing a token prefix (BlockManager docstring
+        has the page lifecycle).  Greedy output is byte-identical on
+        or off.
     """
 
     def __init__(self, model, *, max_num_seqs: int = 8, block_size: int = 16,
                  num_blocks: int | None = None, max_model_len: int | None = None,
                  max_prefill_tokens: int = 512,
-                 prefill_token_bucket: int = 64):
+                 prefill_token_bucket: int = 64,
+                 enable_prefix_caching: bool = True):
         cfg = model.config
         self.config = cfg
         self.params = model.decode_params()
@@ -131,12 +154,15 @@ class LLMEngine:
         self.max_model_len = int(max_model_len or cfg.max_position_embeddings)
         self.max_prefill_tokens = int(max_prefill_tokens)
         self.prefill_token_bucket = int(prefill_token_bucket)
+        self.enable_prefix_caching = bool(enable_prefix_caching)
 
         # static block-table width: pages needed by a max-length sequence
         self.nblk = -(-self.max_model_len // self.block_size)
         if num_blocks is None:
             num_blocks = 1 + self.max_num_seqs * self.nblk
-        self.blocks = BlockManager(num_blocks, self.block_size)
+        self.blocks = BlockManager(
+            num_blocks, self.block_size,
+            enable_prefix_caching=self.enable_prefix_caching)
         if self.blocks.num_free < self.nblk:
             raise ValueError(
                 f"num_blocks={num_blocks} cannot hold even one "
@@ -158,9 +184,24 @@ class LLMEngine:
         self._next_rid = 0
         self._arrival = 0
 
+        # stable decode slots + persistent host-side decode buffers: rows
+        # are updated incrementally (grow/retire/CoW bump the table
+        # version) instead of rebuilt from scratch every token
+        B = self.max_num_seqs
+        self._slot_used = [False] * B
+        self._d_toks = np.zeros((B,), np.int32)
+        self._d_pos = np.zeros((B,), np.int32)
+        self._d_bt = np.full((B, self.nblk), NULL_BLOCK, np.int32)
+        self._d_temps = np.zeros((B,), np.float32)
+        self._d_keys = np.zeros((B, 2), np.uint32)
+        self._d_owner = [None] * B        # rid currently packed in each row
+
         # program caches: compile counts == len() of these
         self._decode_progs: dict = {}
         self._prefill_progs: dict = {}
+        self._chunked_progs: dict = {}
+        self._cow_prog = None
+        self._evictions_seen = 0
         self.stats = ServingStats()
 
     # ------------------------------------------------------------------
@@ -178,16 +219,13 @@ class LLMEngine:
                 f"prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_model_len "
                 f"({self.max_model_len})")
-        if len(prompt) > self.max_prefill_tokens:
-            raise ValueError(
-                f"prompt ({len(prompt)}) exceeds max_prefill_tokens "
-                f"({self.max_prefill_tokens})")
         rid = self._next_rid
         self._next_rid += 1
         req = Request(rid=rid, prompt=prompt, tokens=list(prompt),
                       max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature),
-                      eos_token_id=eos_token_id, seed=int(seed))
+                      eos_token_id=eos_token_id, seed=int(seed),
+                      t_arrival=time.perf_counter())
         self._waiting.append(req)
         return rid
 
@@ -200,40 +238,72 @@ class LLMEngine:
 
     @property
     def num_prefill_programs(self) -> int:
-        return len(self._prefill_progs)
+        return len(self._prefill_progs) + len(self._chunked_progs)
 
     def run(self) -> dict:
-        """Drive step() until every queued request finishes."""
+        """Drive step() until every queued request finishes.  Outputs by
+        rid; the run's metrics (incl. cache hits/misses, CoW copies,
+        evictions, chunked-prefill queue depth) are in ``summary()``."""
         while self.has_unfinished():
             self.step()
         return dict(self._finished)
+
+    def summary(self) -> dict:
+        """One dict of serving metrics + block-pool state for this run."""
+        out = self.stats.summary()
+        out["block_pool"] = self.blocks.stats()
+        return out
 
     # ------------------------------------------------------------------
     # scheduler
     # ------------------------------------------------------------------
 
+    def _decode_ready(self, req) -> bool:
+        """Prefill complete and exactly the last generated token's KV is
+        still unwritten (the decode step writes it and samples the next)."""
+        return (req.cached >= len(req.tokens)
+                and req.cached == len(req.prompt) + len(req.generated) - 1)
+
     def step(self) -> list:
-        """One engine iteration: admit -> prefill -> decode -> retire.
-        Returns the requests that finished during this step."""
+        """One engine iteration: admit -> chunked prefill -> decode ->
+        retire.  Returns the requests that finished during this step."""
         finished = []
 
         admitted = self._admit()
         if admitted:
             self.stats.record_admission(len(admitted))
+        self.stats.record_prefill_queue(
+            sum(1 for r in self._running if r.cached < len(r.tokens))
+            + len(self._waiting))
+
+        chunks = self._schedule_prefill_chunks()
+        emitted_now = set()
+        if chunks:
             t0 = time.perf_counter()
             with RecordEvent("llm_engine.prefill"):
-                first = self._run_prefill(admitted)
+                first = self._run_prefill(chunks)
             dur = time.perf_counter() - t0
+            done = [(req, tok) for (req, n), tok in zip(chunks, first)
+                    if req.cached + n == len(req.tokens)]
             self.stats.record_prefill(
-                dur, sum(len(r.tokens) for r in admitted), len(admitted))
-            for req, tok in zip(admitted, first):
-                req.cached = len(req.tokens)
+                dur, sum(n for _, n in chunks), len(done))
+            for req, n in chunks:
+                req.cached += n
+                if self.enable_prefix_caching:
+                    self.blocks.commit_prefill(req.rid, n)
+            for req, tok in done:
                 req.generated.append(int(tok))
+                emitted_now.add(id(req))
+                if len(req.generated) == 1:
+                    self.stats.record_ttft(
+                        time.perf_counter() - req.t_arrival)
                 self._maybe_retire(req, finished)
 
-        # decode everyone already in the batch (sequences prefilled THIS
-        # step already produced their token above)
-        batch = [r for r in self._running if r not in admitted]
+        # decode everyone already in the batch (sequences that finished
+        # prefill THIS step already produced their token above; sequences
+        # still mid-prefill are not decode-ready yet)
+        batch = [r for r in self._running
+                 if id(r) not in emitted_now and self._decode_ready(r)]
         batch = self._reserve_decode_pages(batch)
         if batch:
             t0 = time.perf_counter()
@@ -243,48 +313,124 @@ class LLMEngine:
             self.stats.record_decode(
                 dur, len(batch), len(self._running) / self.max_num_seqs)
             for req, tok in zip(batch, toks):
+                if self.enable_prefix_caching:
+                    self.blocks.commit_decode_token(req.rid,
+                                                    req.generated[-1])
                 req.cached += 1
                 req.generated.append(int(tok))
                 self._maybe_retire(req, finished)
 
+        ev = self.blocks.eviction_count
+        if ev != self._evictions_seen:
+            self.stats.record_evictions(ev - self._evictions_seen)
+            self._evictions_seen = ev
         return finished
 
+    def _claim_slot(self, req) -> None:
+        req.slot = self._slot_used.index(False)
+        self._slot_used[req.slot] = True
+
+    def _release_slot(self, req) -> None:
+        if req.slot >= 0:
+            self._slot_used[req.slot] = False
+            req.slot = -1
+
     def _admit(self) -> list:
-        """Pull waiting requests into the running set while batch slots,
-        pool pages and the prefill-token budget allow."""
+        """Pull waiting requests into the running set while batch slots
+        and pool pages allow.  With prefix caching, admission matches the
+        prompt's token chain against the cache and allocates only the
+        miss suffix; chunked prefill means admission is no longer gated
+        on the per-step token budget."""
         admitted = []
-        budget = self.max_prefill_tokens
         while self._waiting and len(self._running) < self.max_num_seqs:
             req = self._waiting[0]
-            need_tokens = len(req.tokens)
-            if need_tokens > budget:
-                break
-            if not self.blocks.allocate(req.rid, need_tokens):
-                break
+            if self.enable_prefix_caching:
+                hit = self.blocks.acquire(req.rid, req.tokens)
+                if hit is None:
+                    break
+                req.cached = hit
+                self.stats.record_cache_lookup(hit, len(req.tokens) - hit)
+            else:
+                if not self.blocks.allocate(req.rid, len(req.tokens)):
+                    break
+                req.cached = 0
             self._waiting.popleft()
             req.arrival = self._arrival
             self._arrival += 1
+            req.bt_version = -1
+            self._claim_slot(req)
             self._running.append(req)
             admitted.append(req)
-            budget -= need_tokens
         return admitted
 
+    def _schedule_prefill_chunks(self) -> list:
+        """Pack at most max_prefill_tokens pending prompt tokens into this
+        step, FCFS, resuming partially-prefilled requests first.  Resolves
+        copy-on-write for each chunk's first write position (the only spot
+        a chunk can touch a shared page) before the program runs."""
+        budget = self.max_prefill_tokens
+        chunks = []
+        for req in sorted(list(self._running), key=lambda r: r.arrival):
+            if budget <= 0:
+                break
+            rem = len(req.tokens) - req.cached
+            if rem <= 0 or req not in self._running:
+                continue
+            if self.enable_prefix_caching:
+                if not self._resolve_cow(req, req.cached,
+                                         drop_from=chunks):
+                    continue                     # req itself was preempted
+            chunks.append((req, min(rem, budget)))
+            budget -= min(rem, budget)
+        return chunks
+
+    def _resolve_cow(self, req, pos: int, drop_from: list | None = None) \
+            -> bool:
+        """Privatize the page holding ``pos`` if it is shared, preempting
+        victims while the pool has no page for the copy.  False when req
+        itself had to be preempted."""
+        while True:
+            try:
+                cw = self.blocks.cow_if_shared(req.rid, pos)
+            except BlockPoolExhausted:
+                victim = self._pick_victim(exclude=req)
+                if victim is None:
+                    self._preempt(req)
+                    return False
+                self._preempt(victim)
+                if drop_from is not None:
+                    drop_from[:] = [c for c in drop_from
+                                    if c[0] is not victim]
+                continue
+            if cw is not None:
+                self._apply_cow(*cw)
+                self.stats.record_cow()
+            return True
+
     def _reserve_decode_pages(self, batch: list) -> list:
-        """Grow each sequence's table for the token this step will write;
-        preempt the youngest runner whenever the pool comes up short."""
+        """Grow each sequence's table for the token this step will write
+        (plus a private copy of a still-shared tail page); preempt the
+        youngest runner whenever the pool comes up short."""
         ok = []
         for req in sorted(batch, key=lambda r: r.arrival):
             if req not in self._running:   # evicted as a victim earlier
                 continue
-            while not self.blocks.ensure(req.rid, req.cached + 1):
-                victim = self._pick_victim(exclude=req)
-                if victim is None:
-                    # nothing younger to evict: preempt THIS sequence
-                    self._preempt(req)
-                    req = None
-                    break
-                self._preempt(victim)
-                ok = [r for r in ok if r is not victim]
+            while req is not None:
+                if not self.blocks.ensure(req.rid, req.cached + 1):
+                    victim = self._pick_victim(exclude=req)
+                    if victim is None:
+                        self._preempt(req)
+                        req = None
+                        break
+                    self._preempt(victim)
+                    ok = [r for r in ok if r is not victim]
+                    continue
+                if self.enable_prefix_caching:
+                    if not self._resolve_cow(req, req.cached):
+                        req = None
+                        break
+                    ok = [r for r in ok if r in self._running]
+                break
             if req is not None:
                 ok.append(req)
         return ok
@@ -297,14 +443,19 @@ class LLMEngine:
         return max(cands, key=lambda r: r.arrival)
 
     def _preempt(self, req) -> None:
-        """Return req's pages and requeue it (front of the line) for full
+        """Return req's pages and requeue it (front of the line) for
         recomputation: its next prefill covers prompt + tokens generated
         so far, which rebuilds the exact KV state — greedy decoding
-        resumes token-identically."""
+        resumes token-identically.  With prefix caching the freed full
+        pages park in the cache, so the recompute's admission hits the
+        very pages this preemption returned and re-prefills only the
+        tail."""
         self.blocks.free(req.rid)
         self._running.remove(req)
+        self._release_slot(req)
         req.tokens = list(req.prompt) + list(req.generated)
         req.cached = 0
+        req.bt_version = -1
         self._waiting.appendleft(req)
         self.stats.record_preemption()
 
@@ -318,12 +469,32 @@ class LLMEngine:
             return
         self.blocks.free(req.rid)
         self._running.remove(req)
+        self._release_slot(req)
         out = RequestOutput(rid=req.rid, prompt=list(req.prompt),
                             generated=list(req.generated),
                             finish_reason=reason)
         self._finished[req.rid] = out
         finished.append(out)
         self.stats.record_retirement()
+
+    # ------------------------------------------------------------------
+    # copy-on-write page copy (device side)
+    # ------------------------------------------------------------------
+
+    def _apply_cow(self, src: int, dst: int) -> None:
+        """Copy page src -> dst across every layer's K and V cache.  The
+        copy is dispatched immediately so device program order keeps it
+        ahead of any later prefill/decode write into dst."""
+        if self._cow_prog is None:
+            def run(kc, vc, s, d):
+                kc = kc.at[:, d].set(kc[:, s])
+                vc = vc.at[:, d].set(vc[:, s])
+                return kc, vc
+
+            donate = (0, 1) if jax.default_backend() != "cpu" else ()
+            self._cow_prog = jax.jit(run, donate_argnums=donate)
+        self._kc, self._vc = self._cow_prog(
+            self._kc, self._vc, np.int32(src), np.int32(dst))
 
     # ------------------------------------------------------------------
     # compiled decode step
@@ -348,7 +519,10 @@ class LLMEngine:
         eps = self.config.rms_norm_eps
         theta = self.config.rope_theta
         dt = self.params["embed"].dtype
-        use_pallas = _pa.interpret_mode() or (
+        # the interpreted kernel costs a Python step per (B, H_kv, nblk)
+        # grid cell EVERY decode — serving on CPU uses the XLA reference
+        # path (term-identical math) unless a test forces the interpreter
+        use_pallas = _pa.INTERPRET is True or (
             jax.default_backend() == "tpu"
             and _pa.supports(Bb, nh, kvh, d, bs, self.nblk, dt))
 
@@ -397,21 +571,36 @@ class LLMEngine:
     def _run_decode(self, batch: list):
         Bb = self._decode_bucket(len(batch))
         prog = self._get_decode_prog(Bb)
-        toks = np.zeros((Bb,), np.int32)
-        pos = np.zeros((Bb,), np.int32)
-        bt = np.full((Bb, self.nblk), NULL_BLOCK, np.int32)  # pads -> null
-        temps = np.zeros((Bb,), np.float32)
-        keys = np.zeros((Bb, 2), np.uint32)
-        for i, req in enumerate(batch):
-            toks[i] = req.generated[-1]
-            pos[i] = req.cached
-            bt[i] = self.blocks.padded_table(req.rid, self.nblk)
-            temps[i] = req.temperature
-            keys[i] = self._req_key(req)
+        # incremental host-side batch assembly over stable slots: only
+        # rows whose sequence grew/CoW'd (table version bump) repack the
+        # [nblk] block table; empty slots are nulled once on transition
+        cur = {req.slot: req for req in batch}
+        for s in range(Bb):
+            if self._d_owner[s] is not None and s not in cur:
+                self._d_bt[s].fill(NULL_BLOCK)
+                self._d_toks[s] = 0
+                self._d_pos[s] = 0
+                self._d_temps[s] = 0.0
+                self._d_owner[s] = None
+        for s, req in cur.items():
+            if self._d_owner[s] != req.rid:
+                self._d_owner[s] = req.rid
+                self._d_temps[s] = req.temperature
+                req.bt_version = -1          # force a row repack
+            self._d_toks[s] = req.generated[-1]
+            self._d_pos[s] = req.cached
+            ver = self.blocks.table_version(req.rid)
+            if req.bt_version != ver:
+                self._d_bt[s] = self.blocks.padded_table(req.rid, self.nblk)
+                req.bt_version = ver
+            if req.temperature > 0.0:
+                self._d_keys[s] = self._req_key(req)
         out, self._kc, self._vc = prog(self.params, self._kc, self._vc,
-                                       toks, pos, bt, temps, keys)
+                                       self._d_toks, self._d_pos,
+                                       self._d_bt, self._d_temps,
+                                       self._d_keys)
         out = np.asarray(out)
-        return [out[i] for i in range(len(batch))]
+        return [out[req.slot] for req in batch]
 
     def _req_key(self, req):
         # key for token i of request r depends only on (seed, i): sampling
@@ -421,7 +610,7 @@ class LLMEngine:
         return np.asarray(key, np.uint32)
 
     # ------------------------------------------------------------------
-    # compiled prefill step
+    # compiled prefill steps
     # ------------------------------------------------------------------
 
     def _prefill_buckets(self, n_tokens: int, n_seqs: int):
@@ -437,6 +626,14 @@ class LLMEngine:
         if prog is None:
             prog = self._build_prefill(Tp, Bp)
             self._prefill_progs[key] = prog
+        return prog
+
+    def _get_chunked_prog(self, Tp: int, Bp: int):
+        key = (Tp, Bp)
+        prog = self._chunked_progs.get(key)
+        if prog is None:
+            prog = self._build_prefill_chunked(Tp, Bp)
+            self._chunked_progs[key] = prog
         return prog
 
     def _build_prefill(self, Tp: int, Bp: int):
@@ -504,27 +701,97 @@ class LLMEngine:
         donate = (1, 2) if jax.default_backend() != "cpu" else ()
         return jax.jit(run, donate_argnums=donate)
 
-    def _run_prefill(self, admitted: list):
-        total = sum(len(r.tokens) for r in admitted)
-        Tp, Bp = self._prefill_buckets(total, len(admitted))
-        prog = self._get_prefill_prog(Tp, Bp)
+    def _build_prefill_chunked(self, Tp: int, Bp: int):
+        """Chunk prefill: tokens enter at ABSOLUTE positions (a resumed
+        chunk or a cache-hit suffix starts mid-sequence).  Each layer
+        writes the chunk's K/V into the paged cache first, then gathers
+        every sequence's pages back densely — so chunk tokens attend to
+        cached-prefix positions this program never computed (the prefix
+        pages carry KV written by an earlier chunk/request)."""
+        nh, kvh, d = self._nh, self._kvh, self._hd
+        bs = self.block_size
+        nblk = self.nblk
+        S = nblk * bs
+        eps = self.config.rms_norm_eps
+        theta = self.config.rope_theta
+        sm_scale = 1.0 / (d ** 0.5)
+
+        def run(params, kc, vc, toks, seg, rel, bt, last_idx, temps, keys):
+            # toks/seg/rel [Tp] int32 (pads: seg == Bp -> the null row of
+            # bt); rel is each token's absolute position; bt [Bp+1, nblk];
+            # last_idx [Bp] flat index of each chunk's final token.
+            x = jnp.take(params["embed"], toks, axis=0)       # [Tp, H]
+            keypos = jnp.arange(S, dtype=jnp.int32)
+
+            def body(x, inp):
+                p, kcl, vcl = inp
+                h = _rms_weight(x, p["ln1"], eps)
+                q = (h @ p["wq"]).reshape(Tp, nh, d)
+                k = (h @ p["wk"]).reshape(Tp, kvh, d)
+                v = (h @ p["wv"]).reshape(Tp, kvh, d)
+                q = _rope_positions(q, rel, theta)
+                k = _rope_positions(k, rel, theta)
+                blk = bt[seg, rel // bs]                      # [Tp]
+                slot = rel % bs
+                kcl = kcl.at[blk, :, slot, :].set(k.astype(kcl.dtype))
+                vcl = vcl.at[blk, :, slot, :].set(v.astype(vcl.dtype))
+                # gather each sequence's pages to [Bp+1, S, kvh, d]
+                kg = kcl[bt].transpose(0, 1, 3, 2, 4) \
+                    .reshape(Bp + 1, S, kvh, d)
+                vg = vcl[bt].transpose(0, 1, 3, 2, 4) \
+                    .reshape(Bp + 1, S, kvh, d)
+                kq = kg[seg]                                  # [Tp, S, kvh, d]
+                vq = vg[seg]
+                if kvh != nh:
+                    kq = jnp.repeat(kq, nh // kvh, axis=2)
+                    vq = jnp.repeat(vq, nh // kvh, axis=2)
+                sc = jnp.einsum("qhd,qshd->qhs", q.astype(jnp.float32),
+                                kq.astype(jnp.float32)) * sm_scale
+                mask = keypos[None, None, :] <= rel[:, None, None]
+                sc = jnp.where(mask, sc, -jnp.inf)
+                pr = jax.nn.softmax(sc, axis=-1)
+                att = jnp.einsum("qhs,qshd->qhd", pr,
+                                 vq.astype(jnp.float32)).astype(x.dtype)
+                x = x + att.reshape(Tp, nh * d) @ p["wo"]
+                h2 = _rms_weight(x, p["ln2"], eps)
+                a = jax.nn.silu((h2 @ p["gate"]).astype(jnp.float32)
+                                ).astype(h2.dtype) * (h2 @ p["up"])
+                return x + a @ p["down"], (kcl, vcl)
+
+            x, (kc, vc) = lax.scan(body, x, (params["layers"], kc, vc))
+            h = _rms_weight(x, params["norm_f"], eps)
+            hsel = h[last_idx]                                # [Bp, H]
+            logits = (hsel.astype(jnp.float32)
+                      @ params["head"].astype(jnp.float32))
+            return _sample_tokens(logits, temps, keys), kc, vc
+
+        donate = (1, 2) if jax.default_backend() != "cpu" else ()
+        return jax.jit(run, donate_argnums=donate)
+
+    def _run_prefill(self, chunks: list):
+        """chunks: [(req, n_chunk)].  Whole-prompt-from-zero batches ride
+        the varlen program (PR-1 fast path, kernel-eligible on TPU);
+        resumed chunks / cache-hit suffixes ride the chunked program."""
+        classic = all(req.cached == 0 and n == len(req.tokens)
+                      for req, n in chunks)
+        total = sum(n for _, n in chunks)
+        Tp, Bp = self._prefill_buckets(total, len(chunks))
 
         toks = np.zeros((Tp,), np.int32)
         seg = np.full((Tp,), Bp, np.int32)            # pads -> sentinel
         rel = np.zeros((Tp,), np.int32)
         bt = np.full((Bp + 1, self.nblk), NULL_BLOCK,
                      np.int32)                        # sentinel row: null
-        cu = np.zeros((Bp + 1,), np.int32)
         last_idx = np.zeros((Bp,), np.int32)
         temps = np.zeros((Bp,), np.float32)
         keys = np.zeros((Bp, 2), np.uint32)
+        cu = np.zeros((Bp + 1,), np.int32)
 
         off = 0
-        for i, req in enumerate(admitted):
-            n = len(req.tokens)
-            toks[off:off + n] = req.tokens
+        for i, (req, n) in enumerate(chunks):
+            toks[off:off + n] = req.tokens[req.cached:req.cached + n]
             seg[off:off + n] = i
-            rel[off:off + n] = np.arange(n)
+            rel[off:off + n] = np.arange(req.cached, req.cached + n)
             bt[i] = self.blocks.padded_table(req.rid, self.nblk)
             last_idx[i] = off + n - 1
             temps[i] = req.temperature
@@ -533,10 +800,17 @@ class LLMEngine:
             cu[i + 1] = off
         # empty trailing batch slots: zero-length sequences whose
         # last_idx points at token 0; their sampled token is discarded
-        cu[len(admitted) + 1:] = off
+        cu[len(chunks) + 1:] = off
 
-        out, self._kc, self._vc = prog(self.params, self._kc, self._vc,
-                                       toks, seg, rel, bt, cu, last_idx,
-                                       temps, keys)
+        if classic:
+            prog = self._get_prefill_prog(Tp, Bp)
+            out, self._kc, self._vc = prog(self.params, self._kc, self._vc,
+                                           toks, seg, rel, bt, cu,
+                                           last_idx, temps, keys)
+        else:
+            prog = self._get_chunked_prog(Tp, Bp)
+            out, self._kc, self._vc = prog(self.params, self._kc, self._vc,
+                                           toks, seg, rel, bt,
+                                           last_idx, temps, keys)
         out = np.asarray(out)
-        return [out[i] for i in range(len(admitted))]
+        return [out[i] for i in range(len(chunks))]

@@ -27,10 +27,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 # Tri-state interpret override.  None (default) resolves per-backend:
-# interpret everywhere except a real TPU, so the serving engine and its
-# tests run the kernel on CPU without mutating this global.  Tests that
-# need a forced mode (the fixture in tests/test_paged_attention.py) may
-# still assign True/False here and restore the old value after.
+# interpret everywhere except a real TPU, so kernel entry points work on
+# CPU without mutating this global.  Tests that need a forced mode (the
+# fixture in tests/test_paged_attention.py) may still assign True/False
+# here and restore the old value after.  NOTE the serving engine does
+# NOT ride the auto-resolved interpret mode: interpreted decode costs a
+# Python step per (B, H_kv, nblk) grid cell, so LLMEngine uses the XLA
+# reference path off-TPU unless INTERPRET is explicitly True.
 INTERPRET = None
 
 

@@ -47,6 +47,13 @@ class ServingStats:
         self.preemptions = 0
         self.admitted = 0
         self.retired = 0
+        # prefix-cache + chunked-prefill surface (PR 2)
+        self.cache_hit_tokens = 0        # prompt tokens served from cache
+        self.cache_miss_tokens = 0       # prompt tokens prefilled fresh
+        self.cow_copies = 0              # copy-on-write page copies
+        self.cache_evictions = 0         # cached pages reclaimed under pressure
+        self._prefill_queue = []         # per step: requests with pending prefill
+        self._ttft = []                  # per request: arrival -> first token (s)
 
     # -- recording (engine-facing) ------------------------------------------
 
@@ -75,6 +82,26 @@ class ServingStats:
     def record_preemption(self, n: int = 1) -> None:
         self.preemptions += int(n)
 
+    def record_cache_lookup(self, hit_tokens: int, miss_tokens: int) -> None:
+        """One admission's prefix-cache match: how many prompt tokens the
+        cache already held vs how many must be prefilled."""
+        self.cache_hit_tokens += int(hit_tokens)
+        self.cache_miss_tokens += int(miss_tokens)
+
+    def record_cow(self, n: int = 1) -> None:
+        self.cow_copies += int(n)
+
+    def record_evictions(self, n: int = 1) -> None:
+        self.cache_evictions += int(n)
+
+    def record_prefill_queue(self, depth: int) -> None:
+        """Requests (running or waiting) with prompt tokens still to
+        prefill at this step — the chunked-prefill backlog."""
+        self._prefill_queue.append(int(depth))
+
+    def record_ttft(self, duration_s: float) -> None:
+        self._ttft.append(float(duration_s))
+
     # -- derived metrics ----------------------------------------------------
 
     def decode_tokens_per_s(self) -> float:
@@ -87,6 +114,13 @@ class ServingStats:
     def mean_occupancy(self) -> float:
         return sum(self._occupancy) / len(self._occupancy) \
             if self._occupancy else 0.0
+
+    def prefix_hit_rate(self) -> float:
+        total = self.cache_hit_tokens + self.cache_miss_tokens
+        return self.cache_hit_tokens / total if total else 0.0
+
+    def ttft_ms(self, q: float) -> float:
+        return 1e3 * _percentile(sorted(self._ttft), q)
 
     def summary(self) -> dict:
         return {
@@ -101,4 +135,16 @@ class ServingStats:
             "admitted": self.admitted,
             "retired": self.retired,
             "preemptions": self.preemptions,
+            "cache_hit_tokens": self.cache_hit_tokens,
+            "cache_miss_tokens": self.cache_miss_tokens,
+            "prefix_hit_rate": round(self.prefix_hit_rate(), 4),
+            "prefill_tokens_saved": self.cache_hit_tokens,
+            "cow_copies": self.cow_copies,
+            "cache_evictions": self.cache_evictions,
+            "mean_prefill_queue_depth": round(
+                sum(self._prefill_queue) / len(self._prefill_queue), 3)
+            if self._prefill_queue else 0.0,
+            "max_prefill_queue_depth": max(self._prefill_queue, default=0),
+            "ttft_p50_ms": round(self.ttft_ms(50), 3),
+            "ttft_p99_ms": round(self.ttft_ms(99), 3),
         }

@@ -25,3 +25,26 @@ def test_serve_bench_smoke_emits_json_line():
     assert record["value"] > 0
     assert record["decode_compiles"] <= 2
     assert record["p99_token_ms"] >= record["p50_token_ms"] > 0
+
+
+def test_serve_bench_prefix_share_emits_cache_surface():
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "--smoke", "--prefix-share", "2",
+         "--requests", "6"],
+        capture_output=True, text=True, timeout=540,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.strip().splitlines() if ln.strip()]
+    assert lines, f"no stdout; stderr: {out.stderr[-2000:]}"
+    record = json.loads(lines[-1])
+    assert record["metric"] == "serve_prefix_tokens_per_s"
+    assert "error" not in record, record
+    assert record["value"] > 0
+    assert record["baseline_tokens_per_s"] > 0
+    assert record["share_ways"] == 2
+    # the cache must actually fire on a shared-prefix stream
+    assert record["prefill_tokens_saved"] > 0
+    assert 0.0 < record["prefix_hit_rate"] <= 1.0
+    assert record["prefill_tokens"] < record["baseline_prefill_tokens"]
+    assert record["ttft_p99_ms"] >= record["ttft_p50_ms"] > 0
+    assert record["baseline_ttft_p50_ms"] > 0

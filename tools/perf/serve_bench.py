@@ -8,6 +8,16 @@ stream (step-indexed Poisson-ish arrivals) and prints ONE JSON line:
    "batch_occupancy": ..., "decode_compiles": ..., "prefill_compiles": ...,
    "requests": ..., "preempted": ...}
 
+With ``--prefix-share K`` the stream instead shares K system prompts
+across the requests and the same workload runs twice — prefix caching OFF
+(the PR-1 engine behavior) then ON — reporting end-to-end throughput for
+both plus the cache's own surface:
+
+  {"metric": "serve_prefix_tokens_per_s", "value": ..., "unit": "tok/s",
+   "baseline_tokens_per_s": ..., "speedup": ..., "prefix_hit_rate": ...,
+   "prefill_tokens_saved": ..., "ttft_p50_ms": ..., "ttft_p99_ms": ...,
+   "baseline_ttft_p50_ms": ..., "baseline_ttft_p99_ms": ..., ...}
+
 Hardening contract (same as bench.py): the JSON line ALWAYS prints.  The
 backend is probed in a subprocess with a hard timeout before this process
 initializes jax; TPU-plugin failure/hang degrades to a CPU run (the paged
@@ -15,6 +25,7 @@ kernel runs in interpret mode there) with the fallback recorded in
 "backend".  Any engine failure prints the line with an "error" field.
 
   python tools/perf/serve_bench.py [--smoke] [--requests N] [--seed S]
+                                   [--prefix-share K]
 """
 from __future__ import annotations
 
@@ -70,6 +81,111 @@ def _request_stream(rng, n_requests, vocab, max_len):
         prompt = rng.randint(0, vocab, n).tolist()
         stream.append((step, prompt, max(4, max_new)))
     return stream
+
+
+def _prefix_stream(rng, n_requests, share_ways, vocab, max_len):
+    """Shared-prefix stream: each request is one of ``share_ways`` system
+    prompts (a few KV pages long) plus a short unique user suffix."""
+    sys_len = max(3 * (max_len // 8), 8)
+    sys_prompts = [rng.randint(0, vocab, sys_len).tolist()
+                   for _ in range(share_ways)]
+    stream, step = [], 0
+    for i in range(n_requests):
+        step += int(rng.poisson(1.0))
+        prompt = sys_prompts[i % share_ways] \
+            + rng.randint(0, vocab, int(rng.randint(2, 6))).tolist()
+        stream.append((step, prompt, 8))
+    return stream
+
+
+def _drive(engine, stream):
+    """Run the arrival-scheduled stream to completion; wall seconds."""
+    import time
+
+    t0 = time.perf_counter()
+    step_no = 0
+    pending = list(stream)
+    while pending or engine.has_unfinished():
+        while pending and pending[0][0] <= step_no:
+            _, prompt, max_new = pending.pop(0)
+            engine.add_request(prompt, max_new_tokens=max_new)
+        engine.step()
+        step_no += 1
+    return time.perf_counter() - t0
+
+
+def run_prefix_bench(smoke: bool, n_requests: int, share_ways: int,
+                     seed: int, backend: str):
+    """Same shared-prefix workload with prefix caching OFF then ON.  Each
+    engine gets one untimed pass (compiles every program bucket and, for
+    the cached engine, populates the pool) and one timed steady-state
+    pass; value is emitted tokens per wall second of the timed pass."""
+    import numpy as np
+
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if smoke or backend == "cpu":
+        # longer context than the plain bench: the shared system prompt is
+        # most of the prompt, so the workload is prefill-heavy and the
+        # cache's savings are visible in end-to-end throughput
+        cfg = LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                               ffn=128, seq=512)
+        engine_kw = dict(max_num_seqs=4, block_size=8, max_model_len=512,
+                         max_prefill_tokens=256, prefill_token_bucket=64)
+    else:
+        cfg = LlamaConfig(vocab_size=8192, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=4,
+                          num_attention_heads=8, num_key_value_heads=8,
+                          max_position_embeddings=1024)
+        engine_kw = dict(max_num_seqs=16, block_size=16, max_model_len=1024,
+                         max_prefill_tokens=2048, prefill_token_bucket=256)
+
+    model = LlamaForCausalLM(cfg)
+    total_new = None
+    runs = {}
+    for caching in (False, True):
+        engine = LLMEngine(model, enable_prefix_caching=caching,
+                           **engine_kw)
+        rng = np.random.RandomState(seed)
+        stream = _prefix_stream(rng, n_requests, share_ways,
+                                cfg.vocab_size, engine_kw["max_model_len"])
+        total_new = sum(mn for _, _, mn in stream)
+        _drive(engine, stream)           # warm pass: compile + populate
+        engine.stats.reset()
+        elapsed = _drive(engine, stream)  # timed steady-state pass
+        s = engine.stats.summary()
+        s["tokens_per_s"] = total_new / elapsed if elapsed else 0.0
+        s["decode_compiles"] = engine.num_decode_programs
+        s["prefill_compiles"] = engine.num_prefill_programs
+        runs[caching] = s
+
+    on, off = runs[True], runs[False]
+    return {
+        "metric": "serve_prefix_tokens_per_s",
+        "value": round(on["tokens_per_s"], 2),
+        "unit": "tok/s",
+        "backend": backend,
+        "share_ways": share_ways,
+        "requests": n_requests,
+        "new_tokens": total_new,
+        "baseline_tokens_per_s": round(off["tokens_per_s"], 2),
+        "speedup": round(on["tokens_per_s"] / off["tokens_per_s"], 3)
+        if off["tokens_per_s"] else 0.0,
+        "prefix_hit_rate": on["prefix_hit_rate"],
+        "prefill_tokens_saved": on["prefill_tokens_saved"],
+        "baseline_prefill_tokens": off["prefill_tokens"],
+        "prefill_tokens": on["prefill_tokens"],
+        "ttft_p50_ms": on["ttft_p50_ms"],
+        "ttft_p99_ms": on["ttft_p99_ms"],
+        "baseline_ttft_p50_ms": off["ttft_p50_ms"],
+        "baseline_ttft_p99_ms": off["ttft_p99_ms"],
+        "cow_copies": on["cow_copies"],
+        "cache_evictions": on["cache_evictions"],
+        "decode_compiles": on["decode_compiles"],
+        "prefill_compiles": on["prefill_compiles"],
+        "preempted": on["preemptions"],
+    }
 
 
 def run_bench(smoke: bool, n_requests: int, seed: int, backend: str):
@@ -135,17 +251,33 @@ def main(argv=None):
                     help="tiny model + short stream (CI / CPU)")
     ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--prefix-share", type=int, default=None, metavar="K",
+                    help="shared-prefix workload with K distinct system "
+                         "prompts; runs cache off vs on and reports the "
+                         "speedup + cache surface")
     args = ap.parse_args(argv)
 
     backend, probe_err = _probe_backend()
-    n_requests = args.requests or (8 if (args.smoke or backend == "cpu")
-                                   else 64)
-    record = {"metric": "serve_decode_tokens_per_s", "value": 0.0,
-              "unit": "tok/s", "backend": backend}
+    if args.prefix_share:
+        n_requests = args.requests or (16 if (args.smoke
+                                              or backend == "cpu") else 64)
+        record = {"metric": "serve_prefix_tokens_per_s", "value": 0.0,
+                  "unit": "tok/s", "backend": backend}
+    else:
+        n_requests = args.requests or (8 if (args.smoke or backend == "cpu")
+                                       else 64)
+        record = {"metric": "serve_decode_tokens_per_s", "value": 0.0,
+                  "unit": "tok/s", "backend": backend}
     if probe_err:
         record["backend_note"] = f"cpu fallback: {probe_err}"
     try:
-        record.update(run_bench(args.smoke, n_requests, args.seed, backend))
+        if args.prefix_share:
+            record.update(run_prefix_bench(args.smoke, n_requests,
+                                           args.prefix_share, args.seed,
+                                           backend))
+        else:
+            record.update(run_bench(args.smoke, n_requests, args.seed,
+                                    backend))
         if probe_err:
             record["backend_note"] = f"cpu fallback: {probe_err}"
     except Exception as e:  # the line must still print
