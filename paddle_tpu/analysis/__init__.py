@@ -1,0 +1,121 @@
+"""graft-lint: static analysis for the programs this framework compiles.
+
+Two front ends over one ``Finding`` model:
+
+- ``analyze_program(ProgramSpec)`` (jaxpr_passes): abstract-trace any
+  function the repo jits — the serving prefill/chunked/decode steps,
+  the captured train step — and detect undonated large buffers, host
+  callbacks, silent f32 upcasts in bf16 programs, and dead code/inputs.
+- ``lint_paths([...])`` (ast_rules): Python-source rules for tracer
+  misuse (numpy in jit bodies, host syncs, branching on tracers,
+  mutable defaults in compiled paths, per-call ``jax.jit``).
+
+CLI: ``tools/analysis/graftlint.py paddle_tpu [--format json|text]``.
+Enforcement: ``PT_ANALYSIS=strict`` (or FLAGS_analysis_mode=strict /
+``set_flags({'analysis_mode': 'strict'})``) makes ``enforce_import``
+raise ``AnalysisError`` at import-of-engine time on ERROR findings;
+``warn`` downgrades to a warning; default ``off`` costs nothing.
+
+This module (and the AST front end) imports only the stdlib, so the
+pytest plugin and import-time hooks never pay for — or mis-order — a
+jax import; the jaxpr front end loads lazily on first use.
+"""
+from __future__ import annotations
+
+import os
+import warnings
+
+from .findings import (  # noqa: F401
+    ERROR, INFO, RULES, SEVERITIES, WARNING, Finding, Location,
+    filter_baseline, findings_to_json, format_text, load_baseline,
+    rule_severity, save_baseline,
+)
+from .ast_rules import (  # noqa: F401
+    collect_py_files, lint_file, lint_paths, lint_source,
+)
+
+__all__ = [
+    "ERROR", "WARNING", "INFO", "RULES", "Finding", "Location",
+    "ProgramSpec", "analyze_program", "analyze_programs", "lint_file",
+    "lint_paths", "lint_source", "load_baseline", "save_baseline",
+    "filter_baseline", "findings_to_json", "format_text", "mode",
+    "enforce", "enforce_import", "default_baseline_path",
+    "audit_engine", "audit_captured_step", "audit_specs",
+]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def default_baseline_path() -> str:
+    return os.path.join(_REPO_ROOT, "tools", "analysis",
+                        "graftlint_baseline.json")
+
+
+def __getattr__(name):
+    # jaxpr front end needs jax; load it only when actually used
+    if name in ("ProgramSpec", "analyze_program", "analyze_programs"):
+        from . import jaxpr_passes
+        return getattr(jaxpr_passes, name)
+    if name in ("audit_engine", "audit_captured_step", "audit_specs"):
+        from . import serving_audit
+        return getattr(serving_audit, name)
+    raise AttributeError(name)
+
+
+def mode() -> str:
+    """Current analysis mode: 'off' | 'warn' | 'strict'.
+
+    Read from FLAGS_analysis_mode when the flag registry is up (its
+    default comes from the PT_ANALYSIS env var); falls back to the env
+    var directly so ``enforce_import`` also works before/without the
+    core package (e.g. from the stdlib-only pytest plugin).
+    """
+    try:
+        from ..core.flags import get_flag
+        return str(get_flag("analysis_mode")).lower()
+    except Exception:
+        return os.environ.get("PT_ANALYSIS", "off").lower()
+
+
+def enforce(findings, source: str = "graft-lint",
+            baseline: set | None = None):
+    """Apply the analysis mode to ``findings``.
+
+    strict: raise ``core.enforce.AnalysisError`` when any ERROR-severity
+    finding survives the baseline; warn: emit a UserWarning; off: no-op.
+    Returns the surviving ERROR findings either way so callers can log.
+    """
+    m = mode()
+    if baseline:
+        findings = filter_baseline(findings, baseline)
+    errors = [f for f in findings if f.severity == ERROR]
+    if not errors or m == "off":
+        return errors
+    text = format_text(errors)
+    if m == "strict":
+        try:
+            from ..core.enforce import AnalysisError
+        except Exception:                      # plugin/standalone use
+            AnalysisError = RuntimeError
+        raise AnalysisError(
+            f"{source}: {len(errors)} ERROR-severity graft-lint "
+            f"finding(s) under PT_ANALYSIS=strict:\n{text}")
+    if m == "warn":
+        warnings.warn(f"{source}: graft-lint findings:\n{text}",
+                      UserWarning, stacklevel=2)
+    return errors
+
+
+def enforce_import(module_name: str, file: str | None):
+    """Import-of-engine hook: AST-lint ``file`` under the current mode.
+
+    Placed at the bottom of compiled-path modules (inference/serving.py,
+    jit/step.py).  'off' (the default) returns before touching the
+    filesystem, so normal imports pay only a flag read.
+    """
+    if mode() == "off" or not file:
+        return []
+    findings = lint_file(file, root=_REPO_ROOT)
+    return enforce(findings, source=f"import {module_name}",
+                   baseline=load_baseline(default_baseline_path()))

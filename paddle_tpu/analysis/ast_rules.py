@@ -1,0 +1,392 @@
+"""Python-AST front end: tracer-misuse lint over framework source.
+
+The jaxpr analyzer sees programs the repo actually compiles; this pass
+sees the SOURCE, so it catches hazards that never survive to a jaxpr —
+code that would fail on a tracer at runtime (numpy calls, ``float()`` on
+a traced argument, ``if`` on a tracer) or that silently recompiles
+(``jax.jit`` rebuilt per call).  Pure stdlib: importable without jax, so
+the pytest plugin and import-time enforce stay cheap.
+
+What counts as a COMPILED body is resolved per file, conservatively, by
+fixpoint:
+
+  roots:  ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorated defs; any
+          FunctionDef whose name is passed to ``jax.jit`` or to a traced
+          transform (``lax.scan``/``cond``/``while_loop``/``fori_loop``,
+          ``vmap``/``pmap``/``grad``/``value_and_grad``/``checkpoint``/
+          ``remat``/``custom_vjp``...)
+  close:  defs nested inside a compiled def, and defs CALLED by name
+          from a compiled body (tracing executes them), join the set.
+
+Rule scope is deliberately two-tier.  Rules about OPERATIONS that never
+belong in a trace (numpy calls, ``.item()``/``.tolist()``/``.numpy()``)
+apply to the whole fixpoint set.  Rules about ARGUMENTS being tracers
+(``if`` on a param, ``float(param)``) apply only to the ROOTS — a root's
+parameters are definitely traced (minus ``static_argnums``), while a
+closure-called helper's parameters are routinely static Python config
+(``causal`` flags, padded sizes), and flagging those would drown the
+signal.  ``is None`` / ``isinstance`` / ``hasattr`` / ``len`` tests are
+structure checks, legal on tracers, and never count as branching.
+
+Suppression: ``# graftlint: disable=rule[,rule]`` on the finding's line
+or on its enclosing ``def`` line; ``# graftlint: skip-file`` near the
+top of a file (fixture trees use this to stay out of the repo lint).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .findings import ERROR, WARNING, Finding, Location, rule_severity
+
+__all__ = ["lint_file", "lint_source", "lint_paths", "collect_py_files"]
+
+_JIT_NAMES = {("jax", "jit"), ("jit",)}
+_TRANSFORM_NAMES = {
+    ("jax", "vmap"), ("vmap",), ("jax", "pmap"), ("pmap",),
+    ("jax", "grad"), ("grad",), ("jax", "value_and_grad"),
+    ("value_and_grad",), ("jax", "checkpoint"), ("jax", "remat"),
+    ("jax", "custom_vjp"), ("jax", "custom_jvp"),
+    ("jax", "lax", "scan"), ("lax", "scan"), ("jax", "lax", "map"),
+    ("lax", "map"), ("jax", "lax", "cond"), ("lax", "cond"),
+    ("jax", "lax", "switch"), ("lax", "switch"),
+    ("jax", "lax", "while_loop"), ("lax", "while_loop"),
+    ("jax", "lax", "fori_loop"), ("lax", "fori_loop"),
+}
+_HOST_SYNC_ATTRS = {"item", "tolist", "numpy", "block_until_ready"}
+_COERCIONS = {"float", "int", "bool"}
+
+_DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([\w\-, ]+)")
+_DISABLE_NEXT_RE = re.compile(r"#\s*graftlint:\s*disable-next=([\w\-, ]+)")
+_SKIP_RE = re.compile(r"#\s*graftlint:\s*skip-file")
+
+
+def _dotted(node):
+    """('jax','lax','scan') for jax.lax.scan; None for anything fancier."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+class _FileCtx:
+    def __init__(self, path, text):
+        self.path = path
+        self.tree = ast.parse(text)
+        self.lines = text.splitlines()
+        self.parents = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[id(child)] = node
+        # numpy import aliases in this file ("np", "numpy", ...); jnp is jax
+        self.np_aliases = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "numpy":
+                        self.np_aliases.add(a.asname or "numpy")
+        self.disabled = {}            # line -> set of rule names
+        for i, line in enumerate(self.lines, 1):
+            m = _DISABLE_NEXT_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                self.disabled.setdefault(i + 1, set()).update(rules)
+                continue
+            m = _DISABLE_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                self.disabled.setdefault(i, set()).update(rules)
+        self.defs = [n for n in ast.walk(self.tree)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]
+        self.by_name = {}
+        for d in self.defs:
+            self.by_name.setdefault(d.name, []).append(d)
+
+    def ancestors(self, node):
+        n = self.parents.get(id(node))
+        while n is not None:
+            yield n
+            n = self.parents.get(id(n))
+
+    def qualname(self, node) -> str:
+        parts = [node.name] if hasattr(node, "name") else []
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(anc.name)
+        return ".".join(reversed(parts))
+
+    def suppressed(self, rule, node) -> bool:
+        lines = {getattr(node, "lineno", 0)}
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                lines.add(anc.lineno)
+                break
+        for ln in lines:
+            rules = self.disabled.get(ln)
+            if rules and (rule in rules or "all" in rules):
+                return True
+        return False
+
+
+def _is_jit_ref(node) -> bool:
+    d = _dotted(node)
+    return d in _JIT_NAMES if d else False
+
+
+def _static_params(call, fn) -> set:
+    """Param names a jit call pins static (static_argnums/static_argnames
+    with literal values); best-effort — non-literal specs pin nothing."""
+    names = []
+    a = fn.args
+    ordered = [p.arg for p in a.posonlyargs + a.args]
+    for kw in call.keywords:
+        try:
+            val = ast.literal_eval(kw.value)
+        except (ValueError, SyntaxError):
+            continue
+        items = val if isinstance(val, (tuple, list)) else [val]
+        if kw.arg == "static_argnums":
+            names.extend(ordered[i] for i in items if isinstance(i, int)
+                         and i < len(ordered))
+        elif kw.arg == "static_argnames":
+            names.extend(str(i) for i in items)
+    return set(names)
+
+
+def _compiled_defs(ctx: _FileCtx):
+    """(fixpoint set of compiled FunctionDefs, {root def: static params}).
+
+    Roots are defs handed directly to jit/a transform — their params are
+    certainly traced.  The fixpoint closure adds nested defs and defs
+    called by name from compiled bodies (tracing executes them), whose
+    params may well be static — tracer-ARGUMENT rules skip those.
+    """
+    compiled = set()
+    roots = {}
+
+    def seed_name(name, statics=frozenset()):
+        for d in ctx.by_name.get(name, ()):
+            compiled.add(d)
+            roots.setdefault(d, set()).update(statics)
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_ref(dec):
+                    compiled.add(node)
+                    roots.setdefault(node, set())
+                elif isinstance(dec, ast.Call):
+                    if _is_jit_ref(dec.func):
+                        compiled.add(node)
+                        roots.setdefault(node, set()).update(
+                            _static_params(dec, node))
+                    elif (_dotted(dec.func) or ())[-1:] == ("partial",) \
+                            and dec.args and _is_jit_ref(dec.args[0]):
+                        compiled.add(node)
+                        roots.setdefault(node, set()).update(
+                            _static_params(dec, node))
+        elif isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d in _JIT_NAMES:
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        for fd in ctx.by_name.get(arg.id, ()):
+                            seed_name(arg.id, _static_params(node, fd))
+            elif d in _TRANSFORM_NAMES:
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        seed_name(arg.id)
+
+    changed = True
+    while changed:
+        changed = False
+        for d in list(compiled):
+            for node in ast.walk(d):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and node is not d and node not in compiled:
+                    compiled.add(node)
+                    changed = True
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Name):
+                    for callee in ctx.by_name.get(node.func.id, ()):
+                        if callee not in compiled:
+                            compiled.add(callee)
+                            changed = True
+    return compiled, roots
+
+
+def _params_of(fn) -> set:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    for extra in (a.vararg, a.kwarg):
+        if extra is not None:
+            names.append(extra.arg)
+    return {n for n in names if n != "self"}
+
+
+def _mutable_default(node) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        return True
+    return (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id in ("list", "dict", "set"))
+
+
+def _walk_own(fn):
+    """Walk fn's subtree, stopping at nested def boundaries (nested defs
+    are linted on their own visit)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+_STRUCTURE_FNS = {"isinstance", "hasattr", "len", "getattr", "callable",
+                  "type"}
+
+
+def _dynamic_names(test) -> set:
+    """Names in a test expression that would concretize a tracer —
+    skipping structure checks (`x is None`, isinstance/hasattr/len) that
+    are legal on tracers."""
+    names = set()
+
+    def walk(n):
+        if isinstance(n, ast.Compare) \
+                and all(isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops):
+            return
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id in _STRUCTURE_FNS:
+            return
+        if isinstance(n, ast.Name):
+            names.add(n.id)
+        for c in ast.iter_child_nodes(n):
+            walk(c)
+
+    walk(test)
+    return names
+
+
+def lint_source(text: str, path: str = "<string>") -> list:
+    if _SKIP_RE.search("\n".join(text.splitlines()[:5])):
+        return []
+    ctx = _FileCtx(path, text)
+    compiled, roots = _compiled_defs(ctx)
+    findings = []
+
+    def emit(rule, node, message, severity=None):
+        if ctx.suppressed(rule, node):
+            return
+        fn = ""
+        for anc in [node] + list(ctx.ancestors(node)):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = ctx.qualname(anc)
+                break
+        findings.append(Finding(
+            rule, severity or rule_severity(rule),
+            Location(path, getattr(node, "lineno", 0), fn), message))
+
+    # ---- file-wide rules -------------------------------------------------
+    for d in ctx.defs:
+        in_jit = d in compiled
+        for default in list(d.args.defaults) + \
+                [k for k in d.args.kw_defaults if k is not None]:
+            if _mutable_default(default):
+                emit("mutable-default-arg", d,
+                     f"def {d.name}(...) has a mutable default argument"
+                     + (" inside a compiled path (hidden retrace key)"
+                        if in_jit else ""),
+                     severity=ERROR if in_jit else WARNING)
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and _is_jit_ref(node.func)):
+            continue
+        parent = ctx.parents.get(id(node))
+        if isinstance(parent, ast.Call) and parent.func is node:
+            emit("unkeyed-jit", node,
+                 "jax.jit(...) built and invoked in one expression — "
+                 "recompiles every call; hoist the jitted fn")
+            continue
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                break
+            if isinstance(anc, (ast.For, ast.While)):
+                emit("unkeyed-jit", node,
+                     "jax.jit(...) constructed inside a loop — one cache "
+                     "entry per iteration (recompile hazard)")
+                break
+
+    # ---- compiled-body rules ---------------------------------------------
+    for d in compiled:
+        # traced params: only certain for tracing ROOTS, minus statics
+        traced = (_params_of(d) - roots[d]) if d in roots else set()
+        for node in _walk_own(d):
+            if isinstance(node, ast.Call):
+                dd = _dotted(node.func)
+                if dd and dd[0] in ctx.np_aliases:
+                    emit("numpy-in-jit", node,
+                         f"numpy call `{'.'.join(dd)}(...)` inside "
+                         f"jit-compiled `{d.name}` — escapes the trace or "
+                         "fails on tracers")
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _HOST_SYNC_ATTRS:
+                    emit("host-sync-in-jit", node,
+                         f"`.{node.func.attr}()` inside jit-compiled "
+                         f"`{d.name}` forces a device->host sync")
+                elif isinstance(node.func, ast.Name) \
+                        and node.func.id in _COERCIONS and node.args:
+                    touched = _dynamic_names(node.args[0])
+                    if touched & traced:
+                        emit("host-sync-in-jit", node,
+                             f"`{node.func.id}()` coerces traced argument "
+                             f"{sorted(touched & traced)[0]!r} inside "
+                             f"jit-compiled `{d.name}` (concretization)")
+            elif isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                hit = sorted(_dynamic_names(node.test) & traced)
+                if hit:
+                    kind = ("while" if isinstance(node, ast.While) else "if")
+                    emit("tracer-branch", node,
+                         f"Python `{kind}` on traced argument {hit[0]!r} "
+                         f"inside jit-compiled `{d.name}` — use "
+                         "lax.cond/jnp.where")
+    return findings
+
+
+def lint_file(path: str, root: str | None = None) -> list:
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    rel = os.path.relpath(path, root) if root else path
+    try:
+        return lint_source(text, rel)
+    except SyntaxError as e:
+        return [Finding("parse", ERROR, Location(rel, e.lineno or 0, ""),
+                        f"syntax error: {e.msg}")]
+
+
+def collect_py_files(paths) -> list:
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__" and not d.startswith(".")]
+            out.extend(os.path.join(dirpath, f) for f in sorted(filenames)
+                       if f.endswith(".py"))
+    return out
+
+
+def lint_paths(paths, root: str | None = None) -> list:
+    findings = []
+    for f in collect_py_files(paths):
+        findings.extend(lint_file(f, root=root))
+    return findings

@@ -1,0 +1,192 @@
+"""Finding model shared by every graft-lint front end.
+
+Both front ends — the jaxpr analyzer (jaxpr_passes.py) and the Python
+AST linter (ast_rules.py) — report through one ``Finding`` record so the
+CLI, the baseline file, the pytest plugin, and ``enforce`` never care
+which analysis produced a result.  The shape mirrors what every mature
+linter converges on (rule id, severity, location, message) plus a
+``trail``: the jaxpr passes attach the equation's user-source frames so
+a per-equation dtype promotion points at the line of model code that
+wrote it, not at a lowering internal.
+
+Baselines: a committed JSON file of accepted-finding fingerprints (rule
++ file + function + message, intentionally NOT the line number, so pure
+line drift never resurrects an accepted finding).  ``filter_baseline``
+subtracts it; the CLI's exit code and the strict import-time enforce
+both look only at what survives.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import dataclass, field
+
+__all__ = [
+    "ERROR", "WARNING", "INFO", "SEVERITIES", "Location", "Finding",
+    "RULES", "rule_severity", "load_baseline", "save_baseline",
+    "filter_baseline", "findings_to_json", "format_text",
+]
+
+ERROR = "ERROR"
+WARNING = "WARNING"
+INFO = "INFO"
+SEVERITIES = (ERROR, WARNING, INFO)          # most severe first
+
+
+# ---------------------------------------------------------------------------
+# rule catalog: every rule either front end can emit, with its default
+# severity and the hazard it guards.  tests/test_graftlint.py asserts each
+# catalog rule is covered by at least one firing fixture.
+# ---------------------------------------------------------------------------
+
+RULES = {
+    # jaxpr front end
+    "undonated-buffer": (ERROR, "jaxpr", (
+        "a large input buffer (params/KV-cache scale) matches an output's "
+        "shape+dtype but is not in donate_argnums — every call copies it "
+        "instead of updating in place")),
+    "host-callback": (ERROR, "jaxpr", (
+        "a callback primitive (pure_callback/io_callback/debug_callback) "
+        "inside a compiled program — a device->host round-trip on every "
+        "execution")),
+    "dtype-promotion": (WARNING, "jaxpr", (
+        "an f32/f64 upcast of a low-precision value inside a "
+        "declared-bf16/f16 program — silent promotions quietly double "
+        "bandwidth; intentional ones (softmax, logits) belong in the "
+        "baseline")),
+    "dead-code": (WARNING, "jaxpr", (
+        "an equation whose outputs never reach a program output — wasted "
+        "FLOPs XLA may or may not DCE depending on effects")),
+    "dead-input": (WARNING, "jaxpr", (
+        "a program input no equation and no output ever reads — a wasted "
+        "transfer and a recompile key that does nothing")),
+    "passthrough-output": (INFO, "jaxpr", (
+        "an output that is an input forwarded untouched — usually a "
+        "threading convenience; flags a buffer that could be dropped from "
+        "the signature")),
+    # AST front end
+    "numpy-in-jit": (ERROR, "ast", (
+        "a numpy call inside a jit-compiled body — it either escapes the "
+        "trace (host sync) or fails on tracers at runtime")),
+    "host-sync-in-jit": (ERROR, "ast", (
+        ".item()/.tolist()/.numpy()/float()/int()/bool() on a traced value "
+        "inside a compiled body — forces a device->host transfer or a "
+        "ConcretizationTypeError")),
+    "tracer-branch": (ERROR, "ast", (
+        "`if`/`while` on a parameter of a jit-compiled function — Python "
+        "control flow on a tracer recompiles per value or raises; use "
+        "lax.cond/select")),
+    "mutable-default-arg": (WARNING, "ast", (
+        "a mutable default argument ([]/{}); inside a compiled path it is "
+        "also a hidden retrace key (severity ERROR there)")),
+    "unkeyed-jit": (ERROR, "ast", (
+        "jax.jit created per call (immediately invoked, or built inside a "
+        "loop) — a fresh cache entry every time, i.e. recompile hazard; "
+        "hoist it or key it in a cache dict")),
+}
+
+
+def rule_severity(rule: str) -> str:
+    return RULES[rule][0]
+
+
+@dataclass(frozen=True)
+class Location:
+    file: str                 # repo-relative path or program name
+    line: int = 0             # 1-based; 0 = whole file/program
+    func: str = ""            # enclosing function / program / equation
+
+    def __str__(self):
+        s = f"{self.file}:{self.line}" if self.line else self.file
+        return f"{s} ({self.func})" if self.func else s
+
+
+@dataclass
+class Finding:
+    rule: str
+    severity: str
+    location: Location
+    message: str
+    trail: tuple = field(default_factory=tuple)   # ((file, line, func), ...)
+
+    @property
+    def fingerprint(self) -> str:
+        # line-free so baselines survive unrelated edits above the finding
+        key = "|".join((self.rule, self.location.file, self.location.func,
+                        self.message))
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "file": self.location.file,
+            "line": self.location.line,
+            "func": self.location.func,
+            "message": self.message,
+            "trail": [list(t) for t in self.trail],
+            "fingerprint": self.fingerprint,
+        }
+
+
+# ---------------------------------------------------------------------------
+# baseline file
+# ---------------------------------------------------------------------------
+
+def load_baseline(path) -> set:
+    """Accepted-finding fingerprints, or an empty set when no file."""
+    if not path or not os.path.exists(path):
+        return set()
+    with open(path) as f:
+        data = json.load(f)
+    return {e["fingerprint"] for e in data.get("accepted", [])}
+
+def save_baseline(path, findings, reason: str = "accepted") -> None:
+    entries = [{
+        "fingerprint": f.fingerprint,
+        "rule": f.rule,
+        "location": str(f.location),
+        "message": f.message,
+        "reason": reason,
+    } for f in findings]
+    entries.sort(key=lambda e: (e["location"], e["rule"]))
+    with open(path, "w") as fp:
+        json.dump({"version": 1, "accepted": entries}, fp, indent=2)
+        fp.write("\n")
+
+
+def filter_baseline(findings, baseline: set):
+    return [f for f in findings if f.fingerprint not in baseline]
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+def _sort_key(f: Finding):
+    return (SEVERITIES.index(f.severity), f.location.file, f.location.line,
+            f.rule)
+
+
+def findings_to_json(findings, **extra) -> str:
+    counts = {s: sum(1 for f in findings if f.severity == s)
+              for s in SEVERITIES}
+    doc = {"counts": counts,
+           "findings": [f.to_dict() for f in sorted(findings, key=_sort_key)]}
+    doc.update(extra)
+    return json.dumps(doc, indent=2)
+
+
+def format_text(findings) -> str:
+    lines = []
+    for f in sorted(findings, key=_sort_key):
+        lines.append(f"{f.severity:7s} {f.rule:20s} {f.location}  "
+                     f"{f.message}")
+        for file, line, func in f.trail:
+            lines.append(f"        via {file}:{line} in {func}")
+    counts = {s: sum(1 for f in findings if f.severity == s)
+              for s in SEVERITIES}
+    lines.append(f"graft-lint: {counts[ERROR]} error(s), "
+                 f"{counts[WARNING]} warning(s), {counts[INFO]} info")
+    return "\n".join(lines)

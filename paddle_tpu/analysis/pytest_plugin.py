@@ -1,0 +1,63 @@
+"""pytest plugin: fail the suite on NEW graft-lint ERROR findings.
+
+Registered from tests/conftest.py (``pytest_configure``), deliberately
+NOT via ``addopts -p``: command-line plugins import before conftest.py
+pins JAX_PLATFORMS=cpu, and this repo's environment hangs if anything
+touches jax before that pin.  This module only needs the AST front end,
+which is stdlib-only — the lint itself never imports jax.
+
+Behavior: at session start, AST-lint the ``paddle_tpu`` tree; subtract
+the committed baseline; report survivors in the terminal summary; and
+if any ERROR-severity finding survives, flip the session exit status so
+tier-1 fails — no workflow changes needed.  Disable with
+``PT_ANALYSIS_PLUGIN=0`` (e.g. while iterating on a known-dirty tree).
+"""
+from __future__ import annotations
+
+import os
+
+from . import (ERROR, _REPO_ROOT, default_baseline_path, filter_baseline,
+               format_text, lint_paths, load_baseline)
+
+PLUGIN_NAME = "graftlint"
+
+
+def plugin_enabled() -> bool:
+    return os.environ.get("PT_ANALYSIS_PLUGIN", "1").lower() not in (
+        "0", "false", "no", "off")
+
+
+class GraftLintPlugin:
+    """Session-scoped source lint of paddle_tpu/ with baseline subtract."""
+
+    def __init__(self, paths=None, baseline_path=None):
+        self.paths = paths or [os.path.join(_REPO_ROOT, "paddle_tpu")]
+        self.baseline_path = baseline_path or default_baseline_path()
+        self.findings = []
+        self.errors = []
+
+    def pytest_sessionstart(self, session):
+        try:
+            findings = lint_paths(self.paths, root=_REPO_ROOT)
+        except Exception as e:                      # never break collection
+            import warnings
+            warnings.warn(f"graft-lint plugin failed to lint: {e!r}")
+            return
+        self.findings = filter_baseline(findings,
+                                        load_baseline(self.baseline_path))
+        self.errors = [f for f in self.findings if f.severity == ERROR]
+
+    def pytest_terminal_summary(self, terminalreporter):
+        if not self.findings:
+            return
+        terminalreporter.section("graft-lint")
+        terminalreporter.write_line(format_text(self.findings))
+        if self.errors:
+            terminalreporter.write_line(
+                f"graft-lint: {len(self.errors)} NEW ERROR finding(s) — "
+                f"fix them or (deliberately) accept into "
+                f"{os.path.relpath(self.baseline_path, _REPO_ROOT)}")
+
+    def pytest_sessionfinish(self, session, exitstatus):
+        if self.errors and exitstatus == 0:
+            session.exitstatus = 1

@@ -1,0 +1,68 @@
+"""Audit the programs the repo actually serves and trains with.
+
+Glue between the jaxpr front end and the two real compiled surfaces:
+
+- ``audit_engine(engine)`` — every program an ``LLMEngine`` compiles
+  (varlen/dense prefill, chunked prefill, paged decode, CoW page copy),
+  via ``engine.program_specs()``.  Nothing executes: the specs carry
+  ShapeDtypeStructs and the analyzer traces abstractly.
+- ``audit_captured_step(step, *args, **kwargs)`` — a ``CapturedStep``
+  (jit.to_static train/eval step) via its ``program_spec``.
+
+Both return the same report shape the CLI emits::
+
+    {"programs": [{"name": ..., "counts": {...}, "findings": [...]}],
+     "errors": <total ERROR findings>}
+
+so a committed report (docs/analysis/serving_report.json) diffs cleanly
+against a fresh run.
+"""
+from __future__ import annotations
+
+from .findings import ERROR, Finding
+from .jaxpr_passes import analyze_program
+
+__all__ = ["audit_engine", "audit_captured_step", "audit_specs",
+           "report_to_dict"]
+
+
+def audit_specs(specs, baseline=None) -> dict:
+    """Analyze every ProgramSpec; returns the report dict."""
+    from .findings import filter_baseline
+    programs = []
+    total_errors = 0
+    for spec in specs:
+        findings = analyze_program(spec)
+        if baseline:
+            findings = filter_baseline(findings, baseline)
+        counts: dict = {}
+        for f in findings:
+            counts[f.severity] = counts.get(f.severity, 0) + 1
+        total_errors += counts.get(ERROR, 0)
+        programs.append({
+            "name": spec.name,
+            "donate_argnums": list(spec.donate_argnums),
+            "declared_dtype": (str(spec.declared_dtype)
+                               if spec.declared_dtype is not None else None),
+            "counts": counts,
+            "findings": [f.to_dict() for f in findings],
+        })
+    return {"programs": programs, "errors": total_errors}
+
+
+def audit_engine(engine, *, large_bytes: int = 1 << 20,
+                 baseline=None) -> dict:
+    """Jaxpr-audit every program ``engine`` (an LLMEngine) compiles."""
+    return audit_specs(engine.program_specs(large_bytes=large_bytes),
+                       baseline=baseline)
+
+
+def audit_captured_step(step, *args, large_bytes: int = 1 << 20,
+                        baseline=None, **kwargs) -> dict:
+    """Jaxpr-audit a ``CapturedStep`` for the given example inputs."""
+    spec = step.program_spec(*args, large_bytes=large_bytes, **kwargs)
+    return audit_specs([spec], baseline=baseline)
+
+
+def report_to_dict(report: dict) -> dict:  # pragma: no cover - alias
+    return report

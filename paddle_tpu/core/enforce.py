@@ -17,8 +17,8 @@ import threading
 __all__ = [
     "EnforceError", "InvalidArgumentError", "NotFoundError", "OutOfRangeError",
     "AlreadyExistsError", "PreconditionNotMetError", "UnimplementedError",
-    "UnavailableError", "ExecutionTimeoutError", "enforce", "enforce_eq",
-    "enforce_shape", "error_context", "current_error_context",
+    "UnavailableError", "ExecutionTimeoutError", "AnalysisError", "enforce",
+    "enforce_eq", "enforce_shape", "error_context", "current_error_context",
     "explain_runtime_error",
 ]
 
@@ -56,6 +56,11 @@ class UnavailableError(EnforceError):
 
 
 class ExecutionTimeoutError(EnforceError, TimeoutError):
+    pass
+
+
+class AnalysisError(PreconditionNotMetError):
+    """graft-lint found ERROR-severity hazards under PT_ANALYSIS=strict."""
     pass
 
 

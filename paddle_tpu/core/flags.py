@@ -139,3 +139,9 @@ define_flag("comm_watchdog_timeout", 0.0,
             "Seconds before an in-flight eager collective is reported as "
             "hung by the comm watchdog (0 disables; reference "
             "comm_task_manager.h).")
+define_flag("analysis_mode", os.environ.get("PT_ANALYSIS", "off"),
+            "graft-lint static-analysis enforcement: 'off' (free), 'warn' "
+            "(UserWarning on ERROR findings), 'strict' (raise "
+            "AnalysisError at import-of-engine time on ERROR findings). "
+            "Default comes from the PT_ANALYSIS env var; "
+            "FLAGS_analysis_mode / set_flags override it.")

@@ -159,16 +159,33 @@ def _resolve_partial(dist_tensor, target_placements):
             reduce_axes.append(mesh.dim_names[i])
     if not reduce_axes:
         return dist_tensor._data
-    from jax import lax
-
-    from ...core.jaxcompat import shard_map
     jm = mesh.jax_mesh()
     spec = _to_partition_spec(mesh, src_attr.placements, dist_tensor.ndim)
-    # check_vma=False: the "replicated" input really carries per-device
-    # partial values; psum performs the pending reduction
-    fn = shard_map(lambda x: lax.psum(x, tuple(reduce_axes)),
-                   mesh=jm, in_specs=spec, out_specs=spec, check_vma=False)
-    return jax.jit(fn)(dist_tensor._data)
+    return _partial_sum_prog(jm, spec, tuple(reduce_axes))(
+        dist_tensor._data)
+
+
+# graft-lint caught the original inline `jax.jit(shard_map(...))(x)` here:
+# a fresh lambda per reshard meant a fresh jit cache entry — i.e. one XLA
+# compile per p->r/p->s reshard call.  Keyed on (mesh, spec, axes) the
+# psum program compiles once per distinct reshard shape.
+_PSUM_PROGS: dict = {}
+
+
+def _partial_sum_prog(jm, spec, reduce_axes):
+    key = (jm, spec, reduce_axes)
+    prog = _PSUM_PROGS.get(key)
+    if prog is None:
+        from jax import lax
+
+        from ...core.jaxcompat import shard_map
+        # check_vma=False: the "replicated" input really carries per-device
+        # partial values; psum performs the pending reduction
+        prog = jax.jit(shard_map(lambda x: lax.psum(x, reduce_axes),
+                                 mesh=jm, in_specs=spec, out_specs=spec,
+                                 check_vma=False))
+        _PSUM_PROGS[key] = prog
+    return prog
 
 
 def reshard(dist_tensor, mesh: ProcessMesh, placements):

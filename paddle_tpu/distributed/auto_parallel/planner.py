@@ -232,6 +232,8 @@ def plan_search(layer, sample_input, mesh: ProcessMesh, mesh_dim=0,
             sharding=jax.sharding.NamedSharding(
                 jm, jax.sharding.PartitionSpec()))
         try:
+            # one AOT compile per candidate plan is the whole point of the
+            # compile-probe pricer  # graftlint: disable-next=unkeyed-jit
             compiled = jax.jit(pure).lower(structs, xs).compile()
             ca = compiled.cost_analysis() or {}
             ma = compiled.memory_analysis()

@@ -196,11 +196,16 @@ class LLMEngine:
         self._d_keys = np.zeros((B, 2), np.uint32)
         self._d_owner = [None] * B        # rid currently packed in each row
 
-        # program caches: compile counts == len() of these
+        # program caches: compile counts == len() of these.  The counter
+        # dict is the test-visible compile-count regression guard: every
+        # program BUILD (not call) bumps its kind, so a mixed stream can
+        # assert "exactly N programs" without reaching into the caches.
         self._decode_progs: dict = {}
         self._prefill_progs: dict = {}
         self._chunked_progs: dict = {}
         self._cow_prog = None
+        self.compile_counts = {"decode": 0, "prefill": 0, "chunked": 0,
+                               "cow": 0}
         self._evictions_seen = 0
         self.stats = ServingStats()
 
@@ -253,6 +258,66 @@ class LLMEngine:
         out = self.stats.summary()
         out["block_pool"] = self.blocks.stats()
         return out
+
+    def program_specs(self, *, large_bytes: int = 1 << 20) -> list:
+        """Every program this engine compiles, as analysis ProgramSpecs.
+
+        Arguments are ShapeDtypeStructs (nothing allocates or runs) and
+        donate_argnums is the INTENDED device donation — the analyzer
+        audits the TPU contract even when the process runs on CPU, where
+        the builders drop donation.  ``graftlint --audit-serving`` and
+        tests/test_serving_audit.py consume this.
+        """
+        from ..analysis import ProgramSpec
+
+        sds = jax.ShapeDtypeStruct
+        i32, f32, u32 = jnp.int32, jnp.float32, jnp.uint32
+        params = jax.tree_util.tree_map(
+            lambda x: sds(np.shape(x), x.dtype), self.params)
+        kc = sds(self._kc.shape, self._kc.dtype)
+        vc = sds(self._vc.shape, self._vc.dtype)
+        dt = self.params["embed"].dtype
+        declared = dt if np.dtype(dt).name in ("bfloat16", "float16") \
+            else None
+        Bb = self.max_num_seqs
+        Tp, Bp = self.prefill_token_bucket, 1
+
+        dec_fn, dec_donate = self._make_decode_fn(Bb)
+        pre_fn, pre_donate = self._make_prefill_fn(Tp, Bp)
+        chk_fn, chk_donate = self._make_chunked_fn(Tp, Bp)
+        cow_fn, cow_donate = self._make_cow_fn()
+
+        def seqs(n):      # [n] i32 token/pos/index vectors
+            return sds((n,), i32)
+
+        bt = sds((Bp + 1, self.nblk), i32)
+        return [
+            ProgramSpec(
+                "serving.decode", dec_fn,
+                (params, kc, vc, seqs(Bb), seqs(Bb),
+                 sds((Bb, self.nblk), i32), sds((Bb,), f32),
+                 sds((Bb, 2), u32)),
+                donate_argnums=dec_donate, declared_dtype=declared,
+                large_bytes=large_bytes),
+            ProgramSpec(
+                "serving.prefill", pre_fn,
+                (params, kc, vc, seqs(Tp), seqs(Tp), seqs(Tp), bt,
+                 seqs(Bp + 1), seqs(Bp), sds((Bp,), f32),
+                 sds((Bp, 2), u32)),
+                donate_argnums=pre_donate, declared_dtype=declared,
+                large_bytes=large_bytes),
+            ProgramSpec(
+                "serving.chunked_prefill", chk_fn,
+                (params, kc, vc, seqs(Tp), seqs(Tp), seqs(Tp), bt,
+                 seqs(Bp), sds((Bp,), f32), sds((Bp, 2), u32)),
+                donate_argnums=chk_donate, declared_dtype=declared,
+                large_bytes=large_bytes),
+            ProgramSpec(
+                "serving.cow_copy", cow_fn,
+                (kc, vc, sds((), i32), sds((), i32)),
+                donate_argnums=cow_donate, declared_dtype=declared,
+                large_bytes=large_bytes),
+        ]
 
     # ------------------------------------------------------------------
     # scheduler
@@ -481,18 +546,27 @@ class LLMEngine:
     # copy-on-write page copy (device side)
     # ------------------------------------------------------------------
 
+    def _make_cow_fn(self):
+        """(unjitted page-copy fn, intended donate_argnums) — the spec the
+        analyzer sees; _apply_cow jits it (CPU drops donation: the CPU
+        runtime cannot alias and would warn every call)."""
+        def run(kc, vc, s, d):
+            kc = kc.at[:, d].set(kc[:, s])
+            vc = vc.at[:, d].set(vc[:, s])
+            return kc, vc
+
+        return run, (0, 1)
+
     def _apply_cow(self, src: int, dst: int) -> None:
         """Copy page src -> dst across every layer's K and V cache.  The
         copy is dispatched immediately so device program order keeps it
         ahead of any later prefill/decode write into dst."""
         if self._cow_prog is None:
-            def run(kc, vc, s, d):
-                kc = kc.at[:, d].set(kc[:, s])
-                vc = vc.at[:, d].set(vc[:, s])
-                return kc, vc
-
-            donate = (0, 1) if jax.default_backend() != "cpu" else ()
+            run, donate = self._make_cow_fn()
+            if jax.default_backend() == "cpu":
+                donate = ()
             self._cow_prog = jax.jit(run, donate_argnums=donate)
+            self.compile_counts["cow"] += 1
         self._kc, self._vc = self._cow_prog(
             self._kc, self._vc, np.int32(src), np.int32(dst))
 
@@ -511,9 +585,16 @@ class LLMEngine:
         if prog is None:
             prog = self._build_decode(Bb)
             self._decode_progs[key] = prog
+            self.compile_counts["decode"] += 1
         return prog
 
     def _build_decode(self, Bb: int):
+        run, donate = self._make_decode_fn(Bb)
+        if jax.default_backend() == "cpu":
+            donate = ()
+        return jax.jit(run, donate_argnums=donate)
+
+    def _make_decode_fn(self, Bb: int):
         nh, kvh, d = self._nh, self._kvh, self._hd
         bs = self.block_size
         eps = self.config.rms_norm_eps
@@ -563,10 +644,9 @@ class LLMEngine:
                       @ params["head"].astype(jnp.float32))
             return _sample_tokens(logits, temps, keys), kc, vc
 
-        # donation reuses the pool buffers in place; CPU's runtime cannot
-        # donate (it would warn every call), so only donate on device
-        donate = (1, 2) if jax.default_backend() != "cpu" else ()
-        return jax.jit(run, donate_argnums=donate)
+        # donation reuses the pool buffers in place; _build_decode drops
+        # it on CPU (that runtime cannot alias and would warn every call)
+        return run, (1, 2)
 
     def _run_decode(self, batch: list):
         Bb = self._decode_bucket(len(batch))
@@ -626,6 +706,7 @@ class LLMEngine:
         if prog is None:
             prog = self._build_prefill(Tp, Bp)
             self._prefill_progs[key] = prog
+            self.compile_counts["prefill"] += 1
         return prog
 
     def _get_chunked_prog(self, Tp: int, Bp: int):
@@ -634,9 +715,16 @@ class LLMEngine:
         if prog is None:
             prog = self._build_prefill_chunked(Tp, Bp)
             self._chunked_progs[key] = prog
+            self.compile_counts["chunked"] += 1
         return prog
 
     def _build_prefill(self, Tp: int, Bp: int):
+        run, donate = self._make_prefill_fn(Tp, Bp)
+        if jax.default_backend() == "cpu":
+            donate = ()
+        return jax.jit(run, donate_argnums=donate)
+
+    def _make_prefill_fn(self, Tp: int, Bp: int):
         nh, kvh, d = self._nh, self._kvh, self._hd
         bs = self.block_size
         eps = self.config.rms_norm_eps
@@ -698,10 +786,15 @@ class LLMEngine:
                       @ params["head"].astype(jnp.float32))
             return _sample_tokens(logits, temps, keys), kc, vc
 
-        donate = (1, 2) if jax.default_backend() != "cpu" else ()
-        return jax.jit(run, donate_argnums=donate)
+        return run, (1, 2)
 
     def _build_prefill_chunked(self, Tp: int, Bp: int):
+        run, donate = self._make_chunked_fn(Tp, Bp)
+        if jax.default_backend() == "cpu":
+            donate = ()
+        return jax.jit(run, donate_argnums=donate)
+
+    def _make_chunked_fn(self, Tp: int, Bp: int):
         """Chunk prefill: tokens enter at ABSOLUTE positions (a resumed
         chunk or a cache-hit suffix starts mid-sequence).  Each layer
         writes the chunk's K/V into the paged cache first, then gathers
@@ -765,8 +858,7 @@ class LLMEngine:
                       @ params["head"].astype(jnp.float32))
             return _sample_tokens(logits, temps, keys), kc, vc
 
-        donate = (1, 2) if jax.default_backend() != "cpu" else ()
-        return jax.jit(run, donate_argnums=donate)
+        return run, (1, 2)
 
     def _run_prefill(self, chunks: list):
         """chunks: [(req, n_chunk)].  Whole-prompt-from-zero batches ride
@@ -814,3 +906,11 @@ class LLMEngine:
                                            last_idx, temps, keys)
         out = np.asarray(out)
         return [out[i] for i in range(len(chunks))]
+
+
+# graft-lint import-of-engine hook: PT_ANALYSIS=strict refuses to import a
+# serving module whose source carries ERROR-severity tracer hazards (the
+# default 'off' mode is a single flag read).
+from ..analysis import enforce_import as _enforce_import  # noqa: E402
+
+_enforce_import(__name__, __file__)

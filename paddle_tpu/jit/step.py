@@ -241,8 +241,34 @@ class CapturedStep:
             finally:
                 self._restore(saved)
 
+        self._pure = pure
         self._compiled = jax.jit(
             pure, donate_argnums=(0,) if self._donate else ())
+
+    def program_spec(self, *args, large_bytes: int = 1 << 20, **kwargs):
+        """This captured step as an analysis ProgramSpec.
+
+        ``args``/``kwargs`` are one example batch (shapes only are used).
+        The spec carries the UNjitted ``pure`` body plus the donation the
+        wrapper declares, so ``analyze_program`` can audit the whole
+        train step — params/master-weights/optimizer-slot donation, host
+        callbacks, bf16 upcasts — without compiling or running it.
+        """
+        from ..analysis import ProgramSpec
+        from . import _tree_to_arrays
+
+        if self._compiled is None:
+            self._build()
+        donated, plain = self._gather_state()
+        dt = donated["params"][0].dtype if donated["params"] else None
+        declared = dt if dt is not None and \
+            jnp.dtype(dt).name in ("bfloat16", "float16") else None
+        return ProgramSpec(
+            "jit.capture_step", self._pure,
+            (donated, plain, _tree_to_arrays(args),
+             _tree_to_arrays(kwargs)),
+            donate_argnums=(0,) if self._donate else (),
+            declared_dtype=declared, large_bytes=large_bytes)
 
     # -- call ----------------------------------------------------------------
     def __call__(self, *args, **kwargs):
@@ -314,3 +340,9 @@ def capture_step(fn=None, *, models=None, optimizers=None, scalers=None,
         return deco
     return CapturedStep(fn, models, optimizers, scalers, donate,
                         grad_accumulation)
+
+
+# graft-lint import-time hook (PT_ANALYSIS=strict; 'off' is a flag read)
+from ..analysis import enforce_import as _enforce_import  # noqa: E402
+
+_enforce_import(__name__, __file__)

@@ -39,3 +39,17 @@ _cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+def pytest_configure(config):
+    """Register the graft-lint plugin HERE, not via addopts -p: a
+    command-line plugin imports before this conftest pins
+    JAX_PLATFORMS=cpu, and nothing may touch jax before that pin.  The
+    plugin AST-lints paddle_tpu/ once per session and fails the run on
+    ERROR findings not in the committed baseline."""
+    from paddle_tpu.analysis import pytest_plugin as _gl
+
+    if _gl.plugin_enabled() \
+            and not config.pluginmanager.has_plugin(_gl.PLUGIN_NAME):
+        config.pluginmanager.register(_gl.GraftLintPlugin(),
+                                      _gl.PLUGIN_NAME)

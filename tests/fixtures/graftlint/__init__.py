@@ -1,0 +1,3 @@
+# Fixture tree for tests/test_graftlint.py: each fix_*.py module seeds
+# EXACTLY ONE graft-lint violation (fix_clean.py seeds none).  The files
+# are linted as source only — nothing here is imported or executed.

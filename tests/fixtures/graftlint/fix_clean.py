@@ -1,0 +1,34 @@
+"""Zero-finding fixture: idioms that LOOK like violations but are legal.
+
+Exercises the two-tier scope: structure checks on tracers, static
+branching in closure-called helpers, hoisted jit, immutable defaults.
+"""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def root(x, flag=None):
+    if flag is None:                   # structure check: fine on tracers
+        flag = jnp.ones_like(x)
+    return jnp.where(x > 0, x, flag)
+
+
+def helper(x, causal=True):
+    if causal:                         # helper param: static Python config
+        return x
+    return -x
+
+
+@jax.jit
+def root2(x):
+    return helper(x, True)             # closure-called helper joins the
+                                       # compiled set, but only operation
+                                       # rules apply to it
+
+
+_hoisted = jax.jit(lambda v: v * 2)    # built once at module scope
+
+
+def call(x):
+    return _hoisted(x)

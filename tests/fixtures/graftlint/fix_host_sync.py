@@ -1,0 +1,7 @@
+"""Seeds host-sync-in-jit: .item() on a traced value."""
+import jax
+
+
+@jax.jit
+def root(x):
+    return x.item()           # line 7: device->host sync in the trace

@@ -1,0 +1,6 @@
+"""Seeds mutable-default-arg (plain function => WARNING severity)."""
+
+
+def helper(x, acc=[]):        # line 4: shared mutable default
+    acc.append(x)
+    return acc

@@ -1,0 +1,8 @@
+"""Seeds numpy-in-jit: host numpy inside a jit-compiled body."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def root(x):
+    return np.sum(x)          # line 8: numpy escapes the trace

@@ -1,0 +1,9 @@
+"""Seeds tracer-branch: Python `if` on a jit root's parameter."""
+import jax
+
+
+@jax.jit
+def root(x):
+    if x > 0:                 # line 7: concretizes the tracer
+        return x
+    return -x

@@ -1,0 +1,6 @@
+"""Seeds unkeyed-jit: jax.jit built and invoked in one expression."""
+import jax
+
+
+def call(x):
+    return jax.jit(lambda v: v + 1)(x)    # line 6: recompiles every call

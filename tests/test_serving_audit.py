@@ -1,0 +1,222 @@
+"""jaxpr audit of the REAL serving engine + captured train step.
+
+ISSUE acceptance: the analyzer runs against the actual prefill/decode
+programs the engine compiles (via ``LLMEngine.program_specs``), the JSON
+report is asserted in-tree (donation + transfer rules at minimum), and a
+mixed 16-request stream compiles exactly the documented number of
+programs (the compile-count regression guard)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.analysis import (ERROR, ProgramSpec, analyze_program,
+                                 audit_engine, audit_specs,
+                                 default_baseline_path, load_baseline)
+from paddle_tpu.inference import LLMEngine
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+VOCAB = 97
+CFG = LlamaConfig.tiny(vocab=VOCAB, hidden=32, layers=2, heads=4, ffn=64,
+                       seq=64)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return LlamaForCausalLM(CFG)
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_num_seqs", 4)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_model_len", 64)
+    kw.setdefault("max_prefill_tokens", 128)
+    kw.setdefault("prefill_token_bucket", 32)
+    return LLMEngine(model, **kw)
+
+
+# ---------------------------------------------------------------------------
+# jaxpr report over the engine's real programs (nothing executes)
+# ---------------------------------------------------------------------------
+
+def test_audit_engine_report_donation_and_transfer_clean(model):
+    eng = _engine(model)
+    report = audit_engine(eng, large_bytes=1 << 10)
+    doc = json.loads(json.dumps(report))           # JSON-serializable
+    names = [p["name"] for p in doc["programs"]]
+    assert names == ["serving.decode", "serving.prefill",
+                     "serving.chunked_prefill", "serving.cow_copy"]
+    all_findings = [f for p in doc["programs"] for f in p["findings"]]
+    rules = {f["rule"] for f in all_findings}
+    # donation rule: the KV pool + params donation contract holds on
+    # every program; transfer rule: no host callback anywhere
+    assert "undonated-buffer" not in rules
+    assert "host-callback" not in rules
+    assert doc["errors"] == 0
+    # the single known finding: cu_seqlens dead on the dense (CPU)
+    # prefill path — live on the TPU varlen path, accepted in baseline
+    assert [f["rule"] for f in all_findings] == ["dead-input"]
+    assert all_findings[0]["func"] == "arg7"
+
+
+def test_audit_engine_report_is_baseline_clean(model):
+    eng = _engine(model)
+    report = audit_engine(eng, large_bytes=1 << 10,
+                          baseline=load_baseline(default_baseline_path()))
+    assert sum(len(p["findings"]) for p in report["programs"]) == 0
+
+
+def test_committed_report_matches_fresh_audit(model):
+    """docs/analysis/serving_report.json is a real artifact of this
+    analyzer — program list and per-program counts must match a fresh
+    run (the CLI's --audit-serving uses this exact engine config)."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "analysis",
+        "serving_report.json")
+    committed = json.load(open(path))
+    fresh = audit_engine(_engine(model), large_bytes=1 << 10)
+    fresh_by_name = {p["name"]: p for p in fresh["programs"]}
+    for prog in committed["programs"]:
+        if prog["name"] == "jit.capture_step":     # CLI-only extra spec
+            continue
+        live = fresh_by_name[prog["name"]]
+        assert prog["counts"] == live["counts"], prog["name"]
+        assert prog["donate_argnums"] == live["donate_argnums"]
+    assert committed["errors"] == 0
+
+
+def test_donation_rule_fires_when_donation_stripped(model):
+    """Negative control: the same decode program with donate_argnums
+    removed must trip undonated-buffer on the KV pool halves."""
+    eng = _engine(model)
+    spec = eng.program_specs(large_bytes=1 << 10)[0]
+    assert spec.name == "serving.decode" and spec.donate_argnums == (1, 2)
+    stripped = ProgramSpec(spec.name, spec.fn, spec.args,
+                           donate_argnums=(),
+                           declared_dtype=spec.declared_dtype,
+                           large_bytes=spec.large_bytes)
+    findings = [f for f in analyze_program(stripped)
+                if f.rule == "undonated-buffer"]
+    assert len(findings) == 2                      # kc and vc
+    assert all(f.severity == ERROR for f in findings)
+    assert {f.location.func for f in findings} == {"arg1", "arg2"}
+
+
+def test_transfer_rule_fires_on_callback_variant(model):
+    """Negative control: inserting a host callback into the decode step
+    must trip host-callback with a source trail."""
+    eng = _engine(model)
+    spec = eng.program_specs(large_bytes=1 << 10)[0]
+
+    def with_callback(*args):
+        out, kc, vc = spec.fn(*args)
+        logged = jax.pure_callback(
+            lambda t: np.asarray(t), jax.ShapeDtypeStruct(out.shape,
+                                                          out.dtype), out)
+        return logged, kc, vc
+
+    cb_spec = ProgramSpec("serving.decode+cb", with_callback, spec.args,
+                          donate_argnums=spec.donate_argnums,
+                          large_bytes=spec.large_bytes)
+    findings = [f for f in analyze_program(cb_spec)
+                if f.rule == "host-callback"]
+    assert len(findings) == 1 and findings[0].severity == ERROR
+    assert findings[0].trail
+
+
+# ---------------------------------------------------------------------------
+# compile-count regression guard (satellite: test-visible counter)
+# ---------------------------------------------------------------------------
+
+def _mixed_stream(eng):
+    """16 requests, 4 ragged prompt lengths, 4 decode tokens each."""
+    rng = np.random.RandomState(3)
+    for i in range(16):
+        n = [4, 9, 13, 21][i % 4]
+        eng.add_request(rng.randint(0, VOCAB, n).tolist(),
+                        max_new_tokens=4)
+    eng.run()
+
+
+def test_compile_counts_mixed_stream_cache_on(model):
+    """Documented program budget with prefix caching ON:
+    - stream 1 (cold): 1 varlen prefill (all prompts bucket to one
+      (Tp, Bp)) + 1 decode (one padded batch bucket) = 2 programs;
+    - stream 2 (prefix-cache hits resume mid-sequence): +1 chunked
+      prefill, nothing else;
+    - stream 3: steady state, ZERO new compiles.
+    Any drift here is a recompile regression (or an intentional change
+    that must update these numbers)."""
+    eng = _engine(model, enable_prefix_caching=True)
+    _mixed_stream(eng)
+    assert eng.compile_counts == {"decode": 1, "prefill": 1, "chunked": 0,
+                                  "cow": 0}
+    _mixed_stream(eng)
+    assert eng.compile_counts == {"decode": 1, "prefill": 1, "chunked": 1,
+                                  "cow": 0}
+    _mixed_stream(eng)
+    assert eng.compile_counts == {"decode": 1, "prefill": 1, "chunked": 1,
+                                  "cow": 0}
+
+
+def test_compile_counts_mixed_stream_cache_off(model):
+    """Prefix caching OFF: every prompt prefills whole-from-zero, so the
+    chunked program never compiles; a repeat stream adds nothing."""
+    eng = _engine(model, enable_prefix_caching=False)
+    _mixed_stream(eng)
+    assert eng.compile_counts == {"decode": 1, "prefill": 1, "chunked": 0,
+                                  "cow": 0}
+    _mixed_stream(eng)
+    assert eng.compile_counts == {"decode": 1, "prefill": 1, "chunked": 0,
+                                  "cow": 0}
+
+
+# ---------------------------------------------------------------------------
+# captured train step
+# ---------------------------------------------------------------------------
+
+def _tiny_step(donate=True):
+    import paddle_tpu
+    from paddle_tpu.jit.step import capture_step
+
+    layer = paddle_tpu.nn.Linear(8, 8)
+    opt = paddle_tpu.optimizer.SGD(learning_rate=0.1,
+                                   parameters=layer.parameters())
+    loss_fn = paddle_tpu.nn.MSELoss()
+
+    def train_step(x, y):
+        loss = loss_fn(layer(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = capture_step(train_step, models=layer, optimizers=opt,
+                        donate=donate)
+    x = paddle_tpu.to_tensor(jnp.ones((4, 8), jnp.float32))
+    y = paddle_tpu.to_tensor(jnp.zeros((4, 8), jnp.float32))
+    return step, x, y
+
+
+def test_capture_step_audit_donation_clean():
+    step, x, y = _tiny_step(donate=True)
+    report = audit_specs([step.program_spec(x, y, large_bytes=128)],
+                         baseline=load_baseline(default_baseline_path()))
+    (prog,) = report["programs"]
+    assert prog["donate_argnums"] == [0]
+    rules = {f["rule"] for f in prog["findings"]}
+    assert "undonated-buffer" not in rules
+    assert "host-callback" not in rules
+    assert report["errors"] == 0
+
+
+def test_capture_step_audit_flags_undonated_state():
+    step, x, y = _tiny_step(donate=False)
+    findings = analyze_program(step.program_spec(x, y, large_bytes=128))
+    undonated = [f for f in findings if f.rule == "undonated-buffer"]
+    assert undonated, "8x8 weight (256B >= 128B floor) must be flagged"
+    assert any("params" in f.location.func for f in undonated)

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""graft-lint CLI: AST-lint source trees, jaxpr-audit serving programs.
+
+Usage:
+  python tools/analysis/graftlint.py [paths...] [--format json|text]
+        [--baseline FILE] [--write-baseline] [--audit-serving] [--no-default-baseline]
+
+Default path is ``paddle_tpu``.  Exit status: 0 when no ERROR-severity
+finding survives the baseline, 1 otherwise (2 on usage errors).
+
+``--audit-serving`` additionally builds a tiny CPU LLMEngine and a
+captured train step and runs the jaxpr passes over every program they
+compile — the donation/transfer/dtype/dead audit of what XLA is really
+handed.  This imports jax; plain source linting does not.
+
+``--write-baseline`` rewrites the baseline file to accept every finding
+of the current run (review the diff before committing it).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, _REPO)
+
+
+def _serving_findings(large_bytes: int):
+    """Jaxpr-audit a tiny engine + captured step; returns (findings, report)."""
+    # must be pinned before jax imports: the TPU plugin hangs probing pods
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+    import jax.numpy as jnp
+
+    import paddle_tpu
+    from paddle_tpu.analysis import audit_specs
+    from paddle_tpu.analysis.findings import Finding, Location, SEVERITIES
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4, ffn=64,
+                           seq=64)
+    model = LlamaForCausalLM(cfg)
+    engine = LLMEngine(model, max_num_seqs=4, block_size=8, max_model_len=64,
+                       max_prefill_tokens=128, prefill_token_bucket=32)
+    specs = engine.program_specs(large_bytes=large_bytes)
+
+    # captured train step: tiny linear regression, donated params
+    from paddle_tpu.jit.step import capture_step
+
+    layer = paddle_tpu.nn.Linear(8, 8)
+    opt = paddle_tpu.optimizer.SGD(learning_rate=0.1,
+                                   parameters=layer.parameters())
+    loss_fn = paddle_tpu.nn.MSELoss()
+
+    def train_step(x, y):
+        loss = loss_fn(layer(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = capture_step(train_step, models=layer, optimizers=opt)
+    x = paddle_tpu.to_tensor(jnp.ones((4, 8), jnp.float32))
+    y = paddle_tpu.to_tensor(jnp.zeros((4, 8), jnp.float32))
+    specs.append(step.program_spec(x, y, large_bytes=large_bytes))
+
+    report = audit_specs(specs)
+    findings = []
+    for prog in report["programs"]:
+        for d in prog["findings"]:
+            findings.append(Finding(
+                d["rule"], d["severity"],
+                Location(d["file"], d["line"], d["func"]), d["message"],
+                trail=tuple(tuple(t) for t in d["trail"])))
+    findings.sort(key=lambda f: (SEVERITIES.index(f.severity),
+                                 f.location.file, f.rule))
+    return findings, report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="graftlint", description=__doc__)
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to AST-lint (default: paddle_tpu)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON (default: tools/analysis/"
+                         "graftlint_baseline.json)")
+    ap.add_argument("--no-default-baseline", action="store_true",
+                    help="ignore the default baseline file")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept every current finding into the baseline")
+    ap.add_argument("--audit-serving", action="store_true",
+                    help="also jaxpr-audit a tiny serving engine + train "
+                         "step (imports jax)")
+    ap.add_argument("--report-out", default=None,
+                    help="with --audit-serving: write the program report "
+                         "JSON here")
+    ap.add_argument("--large-bytes", type=int, default=1 << 10,
+                    help="donation/dead-input 'large buffer' floor for "
+                         "--audit-serving (default 1KiB: tiny test model)")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.analysis import (default_baseline_path, filter_baseline,
+                                     findings_to_json, format_text,
+                                     lint_paths, load_baseline, save_baseline)
+    from paddle_tpu.analysis.findings import ERROR
+
+    paths = args.paths or [os.path.join(_REPO, "paddle_tpu")]
+    findings = lint_paths(paths, root=_REPO)
+
+    report = None
+    if args.audit_serving:
+        jf, report = _serving_findings(args.large_bytes)
+        findings = findings + jf
+        if args.report_out:
+            with open(args.report_out, "w") as fp:
+                json.dump(report, fp, indent=2)
+                fp.write("\n")
+
+    baseline_path = args.baseline or default_baseline_path()
+    if args.write_baseline:
+        save_baseline(baseline_path, findings)
+        print(f"baseline written: {baseline_path} "
+              f"({len(findings)} accepted)")
+        return 0
+    if not args.no_default_baseline:
+        findings = filter_baseline(findings, load_baseline(baseline_path))
+
+    if args.format == "json":
+        print(findings_to_json(findings, baseline=baseline_path))
+    else:
+        print(format_text(findings))
+    return 1 if any(f.severity == ERROR for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
