@@ -19,7 +19,7 @@ __all__ = ["Config", "Predictor", "PredictorPool", "Tensor",
            "PrecisionType", "get_num_bytes_of_data_type",
            "convert_to_mixed_precision",
            "BlockManager", "BlockPoolExhausted", "LLMEngine", "Request",
-           "RequestOutput"]
+           "RequestOutput", "Drafter", "NGramDrafter", "DraftModelDrafter"]
 
 
 def __getattr__(name):
@@ -33,6 +33,9 @@ def __getattr__(name):
         from .kv_cache import BlockManager, BlockPoolExhausted
         return {"BlockManager": BlockManager,
                 "BlockPoolExhausted": BlockPoolExhausted}[name]
+    if name in ("Drafter", "NGramDrafter", "DraftModelDrafter"):
+        from . import spec_decode
+        return getattr(spec_decode, name)
     raise AttributeError(name)
 
 

@@ -358,6 +358,83 @@ class BlockManager:
             chain.append(h)
             self._register(table[p], h)
 
+    def truncate(self, seq_id, n_tokens: int) -> int:
+        """Roll seq_id back to its first ``n_tokens`` tokens (speculative-
+        decode rejection: the verify step wrote K/V for draft tokens that
+        were not accepted).  Three effects:
+
+        - tail pages no longer needed by n_tokens are decommitted and
+          released (refcount drop: shared pages stay live for their other
+          owners, registered refcount-0 pages park in the cached LRU,
+          the rest rejoin the free list);
+        - content hashes registered by THIS sequence for pages at or past
+          the new boundary are un-registered when the page is private
+          (refcount 1): future writes will overwrite those slots, and the
+          prefix cache must never serve rolled-back K/V.  Shared pages
+          keep their registration — their content is still valid for the
+          other owners, and this sequence's future writes copy-on-write
+          first, so the registered bytes are never clobbered;
+        - the sequence's id/valid/chain bookkeeping shrinks to n_tokens.
+
+        Returns the number of pages released.  Truncating to a count the
+        table already satisfies (no page drop, no hash past the boundary)
+        is a cheap no-op that does not bump the table version.
+        """
+        if seq_id not in self._tables:
+            raise ValueError(f"truncate of unknown sequence {seq_id!r}")
+        n = int(n_tokens)
+        if n < 0:
+            raise ValueError(f"n_tokens must be >= 0, got {n}")
+        table = self._tables[seq_id]
+        bs = self.block_size
+        need = self.blocks_for(n)
+        if need > len(table):
+            raise ValueError(
+                f"truncate({seq_id!r}, {n}) needs {need} pages but the "
+                f"table holds {len(table)}")
+        dropped = len(table) - need
+        # un-register full-page hashes this sequence registered beyond the
+        # new boundary: those slots will be rewritten with different
+        # tokens, so a prefix match on the old content would serve
+        # rolled-back K/V.  Only private pages are scrubbed — a shared
+        # page's content survives (CoW guards future writes).
+        chain = self._chain.get(seq_id, [])
+        full_keep = n // bs
+        for p in range(full_keep, len(chain)):
+            if p < len(table):
+                blk = table[p]
+                if self._ref.get(blk, 0) == 1 \
+                        and self._hash_to_block.get(chain[p]) == blk:
+                    del self._hash_to_block[chain[p]]
+                    hs = self._block_hashes.get(blk)
+                    if hs is not None:
+                        hs.discard(chain[p])
+                        if not hs:
+                            del self._block_hashes[blk]
+        del chain[full_keep:]
+        if n % bs and full_keep < len(table) \
+                and self._ref.get(table[full_keep], 0) == 1:
+            # partial boundary page: slots >= n % bs will be rewritten, so
+            # partial-prefix hashes registered by earlier owners (free()
+            # registers written tails) could also serve rolled-back K/V.
+            # Conservatively scrub every hash on the private page.
+            self._unregister(table[full_keep])
+        # release the tail pages themselves
+        for blk in reversed(table[need:]):
+            self._decref(blk)
+        del table[need:]
+        ids = self._ids.get(seq_id)
+        if ids is not None and len(ids) > n:
+            del ids[n:]
+        if self._valid.get(seq_id, 0) > n:
+            self._valid[seq_id] = n
+        if self._tokens.get(seq_id, 0) > n:
+            self._tokens[seq_id] = n
+        if dropped:
+            self._version[seq_id] += 1
+            self.free_count += dropped
+        return dropped
+
     def free(self, seq_id) -> None:
         """Return every page of seq_id (retirement/preemption): refcounts
         drop by one; pages with registered content park in the cached LRU,

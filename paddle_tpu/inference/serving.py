@@ -45,6 +45,17 @@ The decode math is term-for-term the math of ``_make_decode_fwd``
 ``LlamaForCausalLM.generate`` — with the prefix cache ON or OFF — and
 tests/test_llm_engine.py + tests/test_prefix_cache.py hold the paths
 together.
+
+Speculative decoding (inference/spec_decode.py) rides the same cache: a
+host-side ``Drafter`` proposes K tokens per running sequence, a fourth
+bucketed program — VERIFY, the chunked-prefill gather math returning
+logits at EVERY position — scores all drafts in one pass, and host-side
+rejection sampling accepts a prefix (greedy output stays byte-identical
+to plain decode; sampled output follows the target distribution
+exactly).  Rejected tokens roll back via ``BlockManager.truncate``.
+Verify and plain-decode sequences share each step: per-request
+``spec_k`` opts in, and a low acceptance rate auto-disables speculation
+for that request.
 """
 from __future__ import annotations
 
@@ -62,6 +73,7 @@ from ..ops.pallas import paged_attention as _pa
 from ..ops.pallas import flash_attention_varlen as _fav
 from ..profiler import RecordEvent, ServingStats
 from .kv_cache import NULL_BLOCK, BlockManager, BlockPoolExhausted
+from .sampling import make_samp, samp_structs, sample_tokens
 
 __all__ = ["LLMEngine", "Request", "RequestOutput"]
 
@@ -75,6 +87,10 @@ class Request:
     temperature: float
     eos_token_id: object              # int | None
     seed: int
+    top_k: int = 0                    # 0 -> off
+    top_p: float = 1.0                # 1.0 -> off
+    repetition_penalty: float = 1.0   # 1.0 -> off
+    spec_k: int = 0                   # max draft tokens per verify round
     # scheduler state
     tokens: list = field(default_factory=list)   # tokens to (re)prefill
     generated: list = field(default_factory=list)
@@ -83,6 +99,10 @@ class Request:
     slot: int = -1                    # stable decode-batch slot
     t_arrival: float = 0.0            # wall clock at add_request (TTFT)
     bt_version: int = -1              # last block-table version packed
+    seen: object = None               # [V] bool penalty mask (lazy)
+    spec_proposed: int = 0            # drafts sent to verify (lifetime)
+    spec_accepted: int = 0            # drafts accepted (lifetime)
+    spec_disabled: bool = False       # acceptance fell below the floor
 
 
 @dataclass
@@ -102,18 +122,6 @@ def _next_pow2(n: int) -> int:
     while p < n:
         p *= 2
     return p
-
-
-def _sample_tokens(logits, temps, keys):
-    """Per-sequence sampling: argmax at temperature<=0 (byte-compatible
-    with generate()'s greedy branch), else temperature categorical."""
-    greedy = jnp.argmax(logits, -1).astype(jnp.int32)
-
-    def one(key, lg, t):
-        return jax.random.categorical(key, lg / jnp.maximum(t, 1e-6))
-
-    sampled = jax.vmap(one)(keys, logits, temps).astype(jnp.int32)
-    return jnp.where(temps <= 0.0, greedy, sampled)
 
 
 class LLMEngine:
@@ -139,13 +147,26 @@ class LLMEngine:
         across requests sharing a token prefix (BlockManager docstring
         has the page lifecycle).  Greedy output is byte-identical on
         or off.
+    drafter: a spec_decode.Drafter (or the string "ngram" for the
+        prompt-lookup drafter) proposing draft tokens; None disables
+        speculative decoding engine-wide.
+    spec_k: default per-request draft length (requests may override via
+        add_request(spec_k=); 0 means plain decode).
+    max_spec_k: hard per-round draft ceiling; fixes the verify program's
+        static token width max_num_seqs * (max_spec_k + 1).
+    spec_accept_floor / spec_window: once a request has sent spec_window
+        drafts to verify, speculation auto-disables for it if its
+        lifetime acceptance rate sits below the floor (the drafter is
+        not helping; stop paying the verify overhead).
     """
 
     def __init__(self, model, *, max_num_seqs: int = 8, block_size: int = 16,
                  num_blocks: int | None = None, max_model_len: int | None = None,
                  max_prefill_tokens: int = 512,
                  prefill_token_bucket: int = 64,
-                 enable_prefix_caching: bool = True):
+                 enable_prefix_caching: bool = True,
+                 drafter=None, spec_k: int = 0, max_spec_k: int = 8,
+                 spec_accept_floor: float = 0.35, spec_window: int = 32):
         cfg = model.config
         self.config = cfg
         self.params = model.decode_params()
@@ -192,9 +213,21 @@ class LLMEngine:
         self._d_toks = np.zeros((B,), np.int32)
         self._d_pos = np.zeros((B,), np.int32)
         self._d_bt = np.full((B, self.nblk), NULL_BLOCK, np.int32)
-        self._d_temps = np.zeros((B,), np.float32)
-        self._d_keys = np.zeros((B, 2), np.uint32)
+        self._d_samp = make_samp(B, cfg.vocab_size)
         self._d_owner = [None] * B        # rid currently packed in each row
+
+        # speculative decoding: a host-side drafter proposes up to
+        # max_spec_k tokens per decode-ready sequence; one fixed-shape
+        # verify program scores every (sequence, draft) pair per step
+        if drafter == "ngram":
+            from .spec_decode import NGramDrafter
+            drafter = NGramDrafter()
+        self.drafter = drafter
+        self.spec_k = int(spec_k)
+        self.max_spec_k = int(max_spec_k)
+        self.spec_accept_floor = float(spec_accept_floor)
+        self.spec_window = int(spec_window)
+        self._verify_Tq = B * (self.max_spec_k + 1)
 
         # program caches: compile counts == len() of these.  The counter
         # dict is the test-visible compile-count regression guard: every
@@ -203,9 +236,10 @@ class LLMEngine:
         self._decode_progs: dict = {}
         self._prefill_progs: dict = {}
         self._chunked_progs: dict = {}
+        self._verify_prog = None
         self._cow_prog = None
         self.compile_counts = {"decode": 0, "prefill": 0, "chunked": 0,
-                               "cow": 0}
+                               "verify": 0, "cow": 0}
         self._evictions_seen = 0
         self.stats = ServingStats()
 
@@ -215,7 +249,9 @@ class LLMEngine:
 
     def add_request(self, prompt, max_new_tokens: int = 32,
                     temperature: float = 0.0, eos_token_id=None,
-                    seed: int = 0) -> int:
+                    seed: int = 0, top_k: int = 0, top_p: float = 1.0,
+                    repetition_penalty: float = 1.0,
+                    spec_k: int | None = None) -> int:
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -224,13 +260,29 @@ class LLMEngine:
                 f"prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_model_len "
                 f"({self.max_model_len})")
+        if not 0.0 < float(top_p) <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if int(top_k) < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if float(repetition_penalty) <= 0.0:
+            raise ValueError(
+                f"repetition_penalty must be > 0, got {repetition_penalty}")
+        if spec_k is None:
+            spec_k = self.spec_k
+        spec_k = min(int(spec_k), self.max_spec_k) \
+            if self.drafter is not None else 0
         rid = self._next_rid
         self._next_rid += 1
         req = Request(rid=rid, prompt=prompt, tokens=list(prompt),
                       max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature),
                       eos_token_id=eos_token_id, seed=int(seed),
-                      t_arrival=time.perf_counter())
+                      top_k=int(top_k), top_p=float(top_p),
+                      repetition_penalty=float(repetition_penalty),
+                      spec_k=spec_k, t_arrival=time.perf_counter())
+        if req.repetition_penalty != 1.0:
+            req.seen = np.zeros((self.config.vocab_size,), bool)
+            req.seen[prompt] = True
         self._waiting.append(req)
         return rid
 
@@ -271,7 +323,7 @@ class LLMEngine:
         from ..analysis import ProgramSpec
 
         sds = jax.ShapeDtypeStruct
-        i32, f32, u32 = jnp.int32, jnp.float32, jnp.uint32
+        i32 = jnp.int32
         params = jax.tree_util.tree_map(
             lambda x: sds(np.shape(x), x.dtype), self.params)
         kc = sds(self._kc.shape, self._kc.dtype)
@@ -279,12 +331,15 @@ class LLMEngine:
         dt = self.params["embed"].dtype
         declared = dt if np.dtype(dt).name in ("bfloat16", "float16") \
             else None
+        V = self.config.vocab_size
         Bb = self.max_num_seqs
         Tp, Bp = self.prefill_token_bucket, 1
+        Tq, Bv = self._verify_Tq, self.max_num_seqs
 
         dec_fn, dec_donate = self._make_decode_fn(Bb)
         pre_fn, pre_donate = self._make_prefill_fn(Tp, Bp)
         chk_fn, chk_donate = self._make_chunked_fn(Tp, Bp)
+        ver_fn, ver_donate = self._make_verify_fn(Tq, Bv)
         cow_fn, cow_donate = self._make_cow_fn()
 
         def seqs(n):      # [n] i32 token/pos/index vectors
@@ -295,22 +350,26 @@ class LLMEngine:
             ProgramSpec(
                 "serving.decode", dec_fn,
                 (params, kc, vc, seqs(Bb), seqs(Bb),
-                 sds((Bb, self.nblk), i32), sds((Bb,), f32),
-                 sds((Bb, 2), u32)),
+                 sds((Bb, self.nblk), i32), samp_structs(Bb, V)),
                 donate_argnums=dec_donate, declared_dtype=declared,
                 large_bytes=large_bytes),
             ProgramSpec(
                 "serving.prefill", pre_fn,
                 (params, kc, vc, seqs(Tp), seqs(Tp), seqs(Tp), bt,
-                 seqs(Bp + 1), seqs(Bp), sds((Bp,), f32),
-                 sds((Bp, 2), u32)),
+                 seqs(Bp + 1), seqs(Bp), samp_structs(Bp, V)),
                 donate_argnums=pre_donate, declared_dtype=declared,
                 large_bytes=large_bytes),
             ProgramSpec(
                 "serving.chunked_prefill", chk_fn,
                 (params, kc, vc, seqs(Tp), seqs(Tp), seqs(Tp), bt,
-                 seqs(Bp), sds((Bp,), f32), sds((Bp, 2), u32)),
+                 seqs(Bp), samp_structs(Bp, V)),
                 donate_argnums=chk_donate, declared_dtype=declared,
+                large_bytes=large_bytes),
+            ProgramSpec(
+                "serving.verify", ver_fn,
+                (params, kc, vc, seqs(Tq), seqs(Tq), seqs(Tq),
+                 sds((Bv + 1, self.nblk), i32)),
+                donate_argnums=ver_donate, declared_dtype=declared,
                 large_bytes=large_bytes),
             ProgramSpec(
                 "serving.cow_copy", cow_fn,
@@ -358,6 +417,8 @@ class LLMEngine:
                     self.blocks.commit_prefill(req.rid, n)
             for req, tok in done:
                 req.generated.append(int(tok))
+                if req.seen is not None:
+                    req.seen[int(tok)] = True
                 emitted_now.add(id(req))
                 if len(req.generated) == 1:
                     self.stats.record_ttft(
@@ -369,6 +430,41 @@ class LLMEngine:
         # still mid-prefill are not decode-ready yet)
         batch = [r for r in self._running
                  if id(r) not in emitted_now and self._decode_ready(r)]
+
+        # speculative sequences verify first (the drafter proposed for
+        # them); everything else plain-decodes in the same step
+        spec, batch = self._split_spec(batch)
+        spec, demoted = self._reserve_verify_pages(spec)
+        batch.extend(demoted)
+        if spec:
+            # fold the non-speculating decode-ready sequences into the
+            # SAME verify launch as zero-draft rows (one packed token ->
+            # one emitted token): the step issues one program instead of
+            # a verify plus a decode, which is where speculation's
+            # launch-count savings actually land
+            batch = [r for r in batch
+                     if r in self._running and self._decode_ready(r)]
+            folded = self._reserve_decode_pages(batch)
+            # reserving the folded rows can preempt a verify member —
+            # drop any such casualty before packing the launch
+            spec = [(r, d, q) for (r, d, q) in spec if r in self._running]
+            spec.extend((r, [], None) for r in folded)
+            batch = []
+        if spec:
+            t0 = time.perf_counter()
+            with RecordEvent("llm_engine.verify"):
+                per_seq_logits = self._run_verify(spec)
+            dur = time.perf_counter() - t0
+            n_emitted = 0
+            for (req, drafts, qd), lg in zip(spec, per_seq_logits):
+                n_emitted += self._apply_spec_result(req, drafts, qd, lg,
+                                                     finished)
+            self.stats.record_verify(
+                dur, n_emitted, len(self._running) / self.max_num_seqs)
+
+        # verify reservation/CoW may have preempted plain-decode members
+        batch = [r for r in batch
+                 if r in self._running and self._decode_ready(r)]
         batch = self._reserve_decode_pages(batch)
         if batch:
             t0 = time.perf_counter()
@@ -383,6 +479,8 @@ class LLMEngine:
                                                     req.generated[-1])
                 req.cached += 1
                 req.generated.append(int(tok))
+                if req.seen is not None:
+                    req.seen[int(tok)] = True
                 self._maybe_retire(req, finished)
 
         ev = self.blocks.eviction_count
@@ -522,6 +620,8 @@ class LLMEngine:
         req.cached = 0
         req.bt_version = -1
         self._waiting.appendleft(req)
+        if self.drafter is not None:
+            self.drafter.release(req.rid)
         self.stats.record_preemption()
 
     def _maybe_retire(self, req, finished: list) -> None:
@@ -540,7 +640,246 @@ class LLMEngine:
                             finish_reason=reason)
         self._finished[req.rid] = out
         finished.append(out)
+        if self.drafter is not None:
+            self.drafter.release(req.rid)
         self.stats.record_retirement()
+
+    # ------------------------------------------------------------------
+    # speculative decoding: propose -> verify -> accept/rollback
+    # ------------------------------------------------------------------
+
+    def _split_spec(self, batch: list):
+        """Ask the drafter for up to spec_k tokens per eligible sequence.
+        Sequences with no proposal (or speculation off/disabled/cut to
+        zero by length limits) fall through to plain decode."""
+        if self.drafter is None:
+            return [], batch
+        spec, plain = [], []
+        for req in batch:
+            k = 0 if req.spec_disabled else req.spec_k
+            # the verify step writes K/V at cached..cached+k, so the
+            # sequence may hold at most max_model_len tokens afterwards;
+            # drafting past max_new_tokens (plus the bonus token) is waste
+            k = min(k,
+                    self.max_model_len - len(req.prompt) - len(req.generated),
+                    req.max_new_tokens - len(req.generated) - 1)
+            if k <= 0:
+                plain.append(req)
+                continue
+            context = list(req.prompt) + list(req.generated)
+            drafts, qd = self.drafter.propose(req.rid, context, k)
+            if not drafts:
+                plain.append(req)
+                continue
+            spec.append((req, [int(t) for t in drafts[:k]], qd))
+        return spec, plain
+
+    def _page_starts(self, a: int, b: int) -> list:
+        """First written position in each page the write window [a, b]
+        (inclusive) touches — the positions _resolve_cow must privatize."""
+        bs = self.block_size
+        out = [a]
+        p = (a // bs + 1) * bs
+        while p <= b:
+            out.append(p)
+            p += bs
+        return out
+
+    def _reserve_verify_pages(self, spec: list):
+        """Grow each speculative sequence's table for its K+1 writes and
+        privatize every shared page in the window.  The pool is never
+        preempted FOR speculation: when ensure() comes up short the draft
+        shrinks (k -> k-1 -> ... -> plain decode) instead.  CoW of the
+        first write position is required for plain decode too, so that
+        path keeps the usual victim-preemption behaviour."""
+        ok, demoted = [], []
+        for req, drafts, qd in spec:
+            if req not in self._running:
+                continue
+            k = len(drafts)
+            while k > 0 and not self.blocks.ensure(req.rid,
+                                                   req.cached + k + 1):
+                k -= 1
+            if k == 0:
+                demoted.append(req)
+                continue
+            drafts = drafts[:k]
+            if self.enable_prefix_caching:
+                alive = True
+                for pos in self._page_starts(req.cached, req.cached + k):
+                    if not self._resolve_cow(req, pos):
+                        alive = False           # req itself was preempted
+                        break
+                ok = [it for it in ok if it[0] in self._running]
+                if not alive:
+                    continue
+            ok.append((req, drafts, qd))
+        return ok, demoted
+
+    def _get_verify_prog(self):
+        if self._verify_prog is None:
+            run, donate = self._make_verify_fn(self._verify_Tq,
+                                               self.max_num_seqs)
+            if jax.default_backend() == "cpu":
+                donate = ()
+            self._verify_prog = jax.jit(run, donate_argnums=donate)
+            self.compile_counts["verify"] += 1
+        return self._verify_prog
+
+    def _make_verify_fn(self, Tq: int, Bv: int):
+        """The chunked-prefill gather math, returning raw f32 logits at
+        EVERY packed position instead of sampling the last token of each
+        sequence: row i scores the token AFTER packed token i, which is
+        exactly the target distribution the i-th draft must survive.
+        Sampling happens on host (spec_decode.verify_and_accept) because
+        acceptance is sequential in i — draft i conditions on drafts
+        < i being accepted.  One fixed (Tq, Bv) bucket keeps the compile
+        count at 1."""
+        nh, kvh, d = self._nh, self._kvh, self._hd
+        bs = self.block_size
+        nblk = self.nblk
+        S = nblk * bs
+        eps = self.config.rms_norm_eps
+        theta = self.config.rope_theta
+        sm_scale = 1.0 / (d ** 0.5)
+
+        def run(params, kc, vc, toks, seg, rel, bt):
+            # toks/seg/rel [Tq] int32 (pads: seg == Bv -> the null row of
+            # bt); rel is each token's absolute position; bt [Bv+1, nblk].
+            x = jnp.take(params["embed"], toks, axis=0)       # [Tq, H]
+            keypos = jnp.arange(S, dtype=jnp.int32)
+
+            def body(x, inp):
+                p, kcl, vcl = inp
+                h = _rms_weight(x, p["ln1"], eps)
+                q = (h @ p["wq"]).reshape(Tq, nh, d)
+                k = (h @ p["wk"]).reshape(Tq, kvh, d)
+                v = (h @ p["wv"]).reshape(Tq, kvh, d)
+                q = _rope_positions(q, rel, theta)
+                k = _rope_positions(k, rel, theta)
+                blk = bt[seg, rel // bs]                      # [Tq]
+                slot = rel % bs
+                kcl = kcl.at[blk, :, slot, :].set(k.astype(kcl.dtype))
+                vcl = vcl.at[blk, :, slot, :].set(v.astype(vcl.dtype))
+                kg = kcl[bt].transpose(0, 1, 3, 2, 4) \
+                    .reshape(Bv + 1, S, kvh, d)
+                vg = vcl[bt].transpose(0, 1, 3, 2, 4) \
+                    .reshape(Bv + 1, S, kvh, d)
+                kq = kg[seg]                                  # [Tq, S, kvh, d]
+                vq = vg[seg]
+                if kvh != nh:
+                    kq = jnp.repeat(kq, nh // kvh, axis=2)
+                    vq = jnp.repeat(vq, nh // kvh, axis=2)
+                sc = jnp.einsum("qhd,qshd->qhs", q.astype(jnp.float32),
+                                kq.astype(jnp.float32)) * sm_scale
+                mask = keypos[None, None, :] <= rel[:, None, None]
+                sc = jnp.where(mask, sc, -jnp.inf)
+                pr = jax.nn.softmax(sc, axis=-1)
+                att = jnp.einsum("qhs,qshd->qhd", pr,
+                                 vq.astype(jnp.float32)).astype(x.dtype)
+                x = x + att.reshape(Tq, nh * d) @ p["wo"]
+                h2 = _rms_weight(x, p["ln2"], eps)
+                a = jax.nn.silu((h2 @ p["gate"]).astype(jnp.float32)
+                                ).astype(h2.dtype) * (h2 @ p["up"])
+                return x + a @ p["down"], (kcl, vcl)
+
+            x, (kc, vc) = lax.scan(body, x, (params["layers"], kc, vc))
+            h = _rms_weight(x, params["norm_f"], eps)
+            logits = (h.astype(jnp.float32)
+                      @ params["head"].astype(jnp.float32))   # [Tq, V]
+            return logits, kc, vc
+
+        return run, (1, 2)
+
+    def _run_verify(self, spec: list):
+        """Pack every speculative sequence's [last_generated, d_1..d_k]
+        window into one verify call; returns each sequence's [k+1, V]
+        logits slice (position cached+i scores the token after draft i)."""
+        Tq, Bv = self._verify_Tq, self.max_num_seqs
+        toks = np.zeros((Tq,), np.int32)
+        seg = np.full((Tq,), Bv, np.int32)            # pads -> sentinel
+        rel = np.zeros((Tq,), np.int32)
+        bt = np.full((Bv + 1, self.nblk), NULL_BLOCK, np.int32)
+        slices = []
+        off = 0
+        for i, (req, drafts, _) in enumerate(spec):
+            w = [req.generated[-1]] + drafts
+            n = len(w)
+            toks[off:off + n] = w
+            seg[off:off + n] = i
+            rel[off:off + n] = np.arange(req.cached, req.cached + n)
+            bt[i] = self.blocks.padded_table(req.rid, self.nblk)
+            slices.append((off, n))
+            off += n
+        prog = self._get_verify_prog()
+        logits, self._kc, self._vc = prog(self.params, self._kc, self._vc,
+                                          toks, seg, rel, bt)
+        logits = np.asarray(logits)
+        # every sequence's table was (re)packed fresh above, and the
+        # post-verify truncate changes it again — force decode repacks
+        for req, _, _ in spec:
+            req.bt_version = -1
+        return [logits[o:o + n] for o, n in slices]
+
+    def _apply_spec_result(self, req, drafts, qd, lg, finished) -> int:
+        """Turn one sequence's verify logits into emitted tokens: run
+        rejection-sampling acceptance, commit the accepted prefix's K/V,
+        truncate the rejected tail out of the page table (scrubbing its
+        content hashes), and advance the request exactly as that many
+        plain decode steps would have.  Returns tokens emitted."""
+        from .spec_decode import verify_and_accept
+
+        k = len(drafts)
+        rng = None
+        if req.temperature > 0.0:
+            # keyed by (seed, position): reproducible across scheduling
+            # orders and preemptions, like _req_key on the device path
+            rng = np.random.Generator(np.random.Philox(
+                key=[req.seed & 0xFFFFFFFF, len(req.generated)]))
+        n_acc, emitted = verify_and_accept(
+            lg, drafts, q_dists=qd, temperature=req.temperature,
+            top_k=req.top_k, top_p=req.top_p,
+            penalty=req.repetition_penalty, seen=req.seen, rng=rng)
+        # cut to the generation budget, and at the first eos token
+        room = req.max_new_tokens - len(req.generated)
+        emitted = emitted[:room]
+        if req.eos_token_id is not None:
+            eos = int(req.eos_token_id)
+            if eos in emitted:
+                emitted = emitted[:emitted.index(eos) + 1]
+        m = len(emitted)                              # >= 1: room >= 1
+        # K/V validity: positions cached..cached+n_acc hold
+        # [generated[-1], accepted drafts]; m <= n_acc + 1 tokens advance
+        # the clock, and when the m-th is the bonus/resample its K/V is
+        # written by the NEXT step (decode invariant), not this one.
+        if self.enable_prefix_caching:
+            for tok in [req.generated[-1]] + emitted[:m - 1]:
+                self.blocks.commit_decode_token(req.rid, tok)
+        req.cached += m
+        # roll the speculative tail (rejected drafts + over-reserved
+        # pages) back out of the table; prefix-cache hashes covering
+        # rolled-back K/V are scrubbed inside truncate
+        rolled = self.blocks.truncate(req.rid, req.cached)
+        req.generated.extend(emitted)
+        if req.seen is not None:
+            req.seen[emitted] = True
+        j = m - 1 if m == n_acc + 1 else m            # emitted draft count
+        if k:                                         # zero-draft rows are
+            req.spec_proposed += k                    # plain decode riding
+            req.spec_accepted += min(j, n_acc)        # the verify launch
+            self.stats.record_spec(proposed=k, accepted=min(j, n_acc),
+                                   emitted=m, rollback=k - j,
+                                   pages_rolled=rolled)
+            if (not req.spec_disabled
+                    and req.spec_proposed >= self.spec_window
+                    and req.spec_accepted
+                    < self.spec_accept_floor * req.spec_proposed):
+                req.spec_disabled = True
+                self.stats.record_spec_disable()
+            self.drafter.commit(
+                req.rid, len(req.prompt) + len(req.generated) - (m - j))
+        self._maybe_retire(req, finished)
+        return m
 
     # ------------------------------------------------------------------
     # copy-on-write page copy (device side)
@@ -607,10 +946,11 @@ class LLMEngine:
             jax.default_backend() == "tpu"
             and _pa.supports(Bb, nh, kvh, d, bs, self.nblk, dt))
 
-        def run(params, kc, vc, toks, pos, bt, temps, keys):
-            # toks/pos [Bb] int32; bt [Bb, nblk] int32; temps [Bb] f32;
-            # keys [Bb, 2] uint32.  pos is the cache position the fresh
-            # token's K/V lands in; attention covers pos+1 entries.
+        def run(params, kc, vc, toks, pos, bt, samp):
+            # toks/pos [Bb] int32; bt [Bb, nblk] int32; samp is the
+            # sampling.make_samp pytree of per-row parameters.  pos is the
+            # cache position the fresh token's K/V lands in; attention
+            # covers pos+1 entries.
             x = jnp.take(params["embed"], toks, axis=0)       # [Bb, H]
 
             def body(x, inp):
@@ -642,7 +982,7 @@ class LLMEngine:
             h = _rms_weight(x, params["norm_f"], eps)
             logits = (h.astype(jnp.float32)
                       @ params["head"].astype(jnp.float32))
-            return _sample_tokens(logits, temps, keys), kc, vc
+            return sample_tokens(logits, samp), kc, vc
 
         # donation reuses the pool buffers in place; _build_decode drops
         # it on CPU (that runtime cannot alias and would warn every call)
@@ -655,17 +995,25 @@ class LLMEngine:
         # rows whose sequence grew/CoW'd (table version bump) repack the
         # [nblk] block table; empty slots are nulled once on transition
         cur = {req.slot: req for req in batch}
+        samp = self._d_samp
         for s in range(Bb):
             if self._d_owner[s] is not None and s not in cur:
                 self._d_bt[s].fill(NULL_BLOCK)
                 self._d_toks[s] = 0
                 self._d_pos[s] = 0
-                self._d_temps[s] = 0.0
+                samp["temps"][s] = 0.0
+                samp["top_k"][s] = 0
+                samp["top_p"][s] = 1.0
+                samp["penalty"][s] = 1.0
+                samp["seen"][s] = False
                 self._d_owner[s] = None
         for s, req in cur.items():
             if self._d_owner[s] != req.rid:
                 self._d_owner[s] = req.rid
-                self._d_temps[s] = req.temperature
+                samp["temps"][s] = req.temperature
+                samp["top_k"][s] = req.top_k
+                samp["top_p"][s] = req.top_p
+                samp["penalty"][s] = req.repetition_penalty
                 req.bt_version = -1          # force a row repack
             self._d_toks[s] = req.generated[-1]
             self._d_pos[s] = req.cached
@@ -673,12 +1021,15 @@ class LLMEngine:
             if req.bt_version != ver:
                 self._d_bt[s] = self.blocks.padded_table(req.rid, self.nblk)
                 req.bt_version = ver
+            if req.seen is not None:
+                np.copyto(samp["seen"][s], req.seen)
             if req.temperature > 0.0:
-                self._d_keys[s] = self._req_key(req)
+                # greedy rows never touch their key: an all-greedy batch
+                # skips per-step key derivation entirely
+                samp["keys"][s] = self._req_key(req)
         out, self._kc, self._vc = prog(self.params, self._kc, self._vc,
                                        self._d_toks, self._d_pos,
-                                       self._d_bt, self._d_temps,
-                                       self._d_keys)
+                                       self._d_bt, samp)
         out = np.asarray(out)
         return [out[req.slot] for req in batch]
 
@@ -753,11 +1104,11 @@ class LLMEngine:
             out = jnp.einsum("hqk,khd->qhd", pr, v.astype(jnp.float32))
             return out.astype(q.dtype)
 
-        def run(params, kc, vc, toks, seg, rel, bt, cu, last_idx, temps,
-                keys):
+        def run(params, kc, vc, toks, seg, rel, bt, cu, last_idx, samp):
             # toks/seg/rel [Tp] int32 (pads carry seg == Bp, a row of the
             # null page in bt); bt [Bp+1, nblk]; cu [Bp+1] varlen offsets;
-            # last_idx [Bp] flat index of each sequence's final token.
+            # last_idx [Bp] flat index of each sequence's final token;
+            # samp is the make_samp pytree, one row per sequence.
             x = jnp.take(params["embed"], toks, axis=0)       # [Tp, H]
 
             def body(x, inp):
@@ -784,7 +1135,7 @@ class LLMEngine:
             hsel = h[last_idx]                                # [Bp, H]
             logits = (hsel.astype(jnp.float32)
                       @ params["head"].astype(jnp.float32))
-            return _sample_tokens(logits, temps, keys), kc, vc
+            return sample_tokens(logits, samp), kc, vc
 
         return run, (1, 2)
 
@@ -809,7 +1160,7 @@ class LLMEngine:
         theta = self.config.rope_theta
         sm_scale = 1.0 / (d ** 0.5)
 
-        def run(params, kc, vc, toks, seg, rel, bt, last_idx, temps, keys):
+        def run(params, kc, vc, toks, seg, rel, bt, last_idx, samp):
             # toks/seg/rel [Tp] int32 (pads: seg == Bp -> the null row of
             # bt); rel is each token's absolute position; bt [Bp+1, nblk];
             # last_idx [Bp] flat index of each chunk's final token.
@@ -856,7 +1207,7 @@ class LLMEngine:
             hsel = h[last_idx]                                # [Bp, H]
             logits = (hsel.astype(jnp.float32)
                       @ params["head"].astype(jnp.float32))
-            return _sample_tokens(logits, temps, keys), kc, vc
+            return sample_tokens(logits, samp), kc, vc
 
         return run, (1, 2)
 
@@ -875,8 +1226,7 @@ class LLMEngine:
         bt = np.full((Bp + 1, self.nblk), NULL_BLOCK,
                      np.int32)                        # sentinel row: null
         last_idx = np.zeros((Bp,), np.int32)
-        temps = np.zeros((Bp,), np.float32)
-        keys = np.zeros((Bp, 2), np.uint32)
+        samp = make_samp(Bp, self.config.vocab_size)
         cu = np.zeros((Bp + 1,), np.int32)
 
         off = 0
@@ -886,8 +1236,16 @@ class LLMEngine:
             rel[off:off + n] = np.arange(req.cached, req.cached + n)
             bt[i] = self.blocks.padded_table(req.rid, self.nblk)
             last_idx[i] = off + n - 1
-            temps[i] = req.temperature
-            keys[i] = self._req_key(req)
+            samp["temps"][i] = req.temperature
+            samp["top_k"][i] = req.top_k
+            samp["top_p"][i] = req.top_p
+            samp["penalty"][i] = req.repetition_penalty
+            if req.seen is not None:
+                np.copyto(samp["seen"][i], req.seen)
+            if req.temperature > 0.0:
+                # only sampled rows need a key: all-greedy prefill steps
+                # skip the per-request PRNG fold-in altogether
+                samp["keys"][i] = self._req_key(req)
             off += n
             cu[i + 1] = off
         # empty trailing batch slots: zero-length sequences whose
@@ -898,12 +1256,12 @@ class LLMEngine:
             prog = self._get_prefill_prog(Tp, Bp)
             out, self._kc, self._vc = prog(self.params, self._kc, self._vc,
                                            toks, seg, rel, bt, cu,
-                                           last_idx, temps, keys)
+                                           last_idx, samp)
         else:
             prog = self._get_chunked_prog(Tp, Bp)
             out, self._kc, self._vc = prog(self.params, self._kc, self._vc,
                                            toks, seg, rel, bt,
-                                           last_idx, temps, keys)
+                                           last_idx, samp)
         out = np.asarray(out)
         return [out[i] for i in range(len(chunks))]
 

@@ -54,6 +54,16 @@ class ServingStats:
         self.cache_evictions = 0         # cached pages reclaimed under pressure
         self._prefill_queue = []         # per step: requests with pending prefill
         self._ttft = []                  # per request: arrival -> first token (s)
+        # speculative decoding surface (PR 4)
+        self.verify_steps = 0            # verify program launches
+        self.verify_time = 0.0
+        self.spec_rounds = 0             # (sequence, verify) acceptance rounds
+        self.draft_proposed = 0          # draft tokens sent to verify
+        self.draft_accepted = 0          # draft tokens that survived (hits)
+        self.spec_emitted_tokens = 0     # tokens emitted by verify steps
+        self.rollback_tokens = 0         # draft tokens rolled back
+        self.rollback_pages = 0          # pages released by truncate
+        self.spec_disables = 0           # requests whose speculation tripped off
 
     # -- recording (engine-facing) ------------------------------------------
 
@@ -102,6 +112,32 @@ class ServingStats:
     def record_ttft(self, duration_s: float) -> None:
         self._ttft.append(float(duration_s))
 
+    def record_verify(self, duration_s: float, n_tokens: int,
+                      occupancy: float) -> None:
+        """One verify-program launch that emitted n_tokens across its
+        speculative sequences.  The tokens count as decode output (that
+        is what they replace) so tok/s comparisons stay apples-to-apples
+        with speculation off."""
+        self.verify_steps += 1
+        self.verify_time += float(duration_s)
+        self.decode_tokens += int(n_tokens)
+        self.decode_time += float(duration_s)
+        self._token_lat.extend([float(duration_s)] * int(n_tokens))
+        self._occupancy.append(float(occupancy))
+
+    def record_spec(self, *, proposed: int, accepted: int, emitted: int,
+                    rollback: int, pages_rolled: int = 0) -> None:
+        """One sequence's acceptance round inside a verify step."""
+        self.spec_rounds += 1
+        self.draft_proposed += int(proposed)
+        self.draft_accepted += int(accepted)
+        self.spec_emitted_tokens += int(emitted)
+        self.rollback_tokens += int(rollback)
+        self.rollback_pages += int(pages_rolled)
+
+    def record_spec_disable(self, n: int = 1) -> None:
+        self.spec_disables += int(n)
+
     # -- derived metrics ----------------------------------------------------
 
     def decode_tokens_per_s(self) -> float:
@@ -121,6 +157,10 @@ class ServingStats:
 
     def ttft_ms(self, q: float) -> float:
         return 1e3 * _percentile(sorted(self._ttft), q)
+
+    def accept_rate(self) -> float:
+        return self.draft_accepted / self.draft_proposed \
+            if self.draft_proposed else 0.0
 
     def summary(self) -> dict:
         return {
@@ -147,4 +187,13 @@ class ServingStats:
             "max_prefill_queue_depth": max(self._prefill_queue, default=0),
             "ttft_p50_ms": round(self.ttft_ms(50), 3),
             "ttft_p99_ms": round(self.ttft_ms(99), 3),
+            "verify_steps": self.verify_steps,
+            "spec_rounds": self.spec_rounds,
+            "draft_proposed": self.draft_proposed,
+            "draft_accepted": self.draft_accepted,
+            "accept_rate": round(self.accept_rate(), 4),
+            "spec_emitted_tokens": self.spec_emitted_tokens,
+            "rollback_tokens": self.rollback_tokens,
+            "rollback_pages": self.rollback_pages,
+            "spec_disables": self.spec_disables,
         }

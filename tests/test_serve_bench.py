@@ -27,6 +27,33 @@ def test_serve_bench_smoke_emits_json_line():
     assert record["p99_token_ms"] >= record["p50_token_ms"] > 0
 
 
+def test_serve_bench_spec_emits_acceptance_surface():
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "--smoke", "--spec", "3",
+         "--requests", "8"],
+        capture_output=True, text=True, timeout=540,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.strip().splitlines() if ln.strip()]
+    assert lines, f"no stdout; stderr: {out.stderr[-2000:]}"
+    record = json.loads(lines[-1])
+    assert record["metric"] == "serve_spec_tokens_per_s"
+    assert "error" not in record, record
+    assert record["value"] > 0
+    assert record["baseline_tokens_per_s"] > 0
+    assert record["spec_k"] == 3
+    # speculation must actually fire on a repetitive stream: drafts
+    # proposed, some accepted, and the single-bucket verify program built
+    assert record["draft_proposed"] > 0
+    assert record["draft_accepted"] > 0
+    assert 0.0 < record["accept_rate"] <= 1.0
+    assert record["verify_steps"] > 0
+    assert record["verify_compiles"] == 1
+    assert record["speedup"] > 0
+    # rejections roll pages back through BlockManager.truncate
+    assert record["rollback_tokens"] >= 0
+
+
 def test_serve_bench_prefix_share_emits_cache_surface():
     out = subprocess.run(
         [sys.executable, SCRIPT, "--smoke", "--prefix-share", "2",

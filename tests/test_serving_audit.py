@@ -49,7 +49,8 @@ def test_audit_engine_report_donation_and_transfer_clean(model):
     doc = json.loads(json.dumps(report))           # JSON-serializable
     names = [p["name"] for p in doc["programs"]]
     assert names == ["serving.decode", "serving.prefill",
-                     "serving.chunked_prefill", "serving.cow_copy"]
+                     "serving.chunked_prefill", "serving.verify",
+                     "serving.cow_copy"]
     all_findings = [f for p in doc["programs"] for f in p["findings"]]
     rules = {f["rule"] for f in all_findings}
     # donation rule: the KV pool + params donation contract holds on
@@ -154,13 +155,13 @@ def test_compile_counts_mixed_stream_cache_on(model):
     eng = _engine(model, enable_prefix_caching=True)
     _mixed_stream(eng)
     assert eng.compile_counts == {"decode": 1, "prefill": 1, "chunked": 0,
-                                  "cow": 0}
+                                  "verify": 0, "cow": 0}
     _mixed_stream(eng)
     assert eng.compile_counts == {"decode": 1, "prefill": 1, "chunked": 1,
-                                  "cow": 0}
+                                  "verify": 0, "cow": 0}
     _mixed_stream(eng)
     assert eng.compile_counts == {"decode": 1, "prefill": 1, "chunked": 1,
-                                  "cow": 0}
+                                  "verify": 0, "cow": 0}
 
 
 def test_compile_counts_mixed_stream_cache_off(model):
@@ -169,10 +170,49 @@ def test_compile_counts_mixed_stream_cache_off(model):
     eng = _engine(model, enable_prefix_caching=False)
     _mixed_stream(eng)
     assert eng.compile_counts == {"decode": 1, "prefill": 1, "chunked": 0,
-                                  "cow": 0}
+                                  "verify": 0, "cow": 0}
     _mixed_stream(eng)
     assert eng.compile_counts == {"decode": 1, "prefill": 1, "chunked": 0,
-                                  "cow": 0}
+                                  "verify": 0, "cow": 0}
+
+
+def test_compile_counts_spec_stream(model):
+    """Speculation ON adds EXACTLY ONE program — the single-bucket verify
+    step — regardless of how many sequences speculate or how draft
+    lengths vary step to step (prefill/chunked buckets still vary with
+    admission raggedness, exactly as without speculation)."""
+    eng = _engine(model, enable_prefix_caching=True, drafter="ngram",
+                  spec_k=4)
+    _mixed_stream(eng)
+    assert eng.compile_counts["verify"] == 1
+    assert eng.compile_counts["decode"] == 1
+    assert eng.compile_counts["cow"] == 0
+    # spec-off requests on the same engine: the verify program is not
+    # touched and nothing else recompiles for the sampling params
+    verify_before = eng.compile_counts["verify"]
+    rng = np.random.RandomState(7)
+    for _ in range(8):
+        eng.add_request(rng.randint(0, VOCAB, 11).tolist(),
+                        max_new_tokens=4, spec_k=0)
+    eng.run()
+    assert eng.compile_counts["verify"] == verify_before
+    # another speculative stream: steady state, ZERO new programs of any
+    # kind — every (Tp, Bp) bucket and the one verify bucket are warm
+    before = dict(eng.compile_counts)
+    _mixed_stream(eng)
+    assert eng.compile_counts == before
+
+
+def test_spec_off_engine_never_compiles_verify(model):
+    """No drafter -> the verify program must never build, even when
+    requests ask for spec_k (the engine clamps it to 0)."""
+    eng = _engine(model)
+    rng = np.random.RandomState(11)
+    for _ in range(6):
+        eng.add_request(rng.randint(0, VOCAB, 9).tolist(),
+                        max_new_tokens=4, spec_k=4)
+    eng.run()
+    assert eng.compile_counts["verify"] == 0
 
 
 # ---------------------------------------------------------------------------
