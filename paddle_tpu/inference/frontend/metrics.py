@@ -1,0 +1,139 @@
+"""Prometheus text exposition for the serving frontend.
+
+One function renders everything a scrape needs: the engine's
+``ServingStats.snapshot()`` (latency quantiles, throughput, cache and
+speculation counters — reservoir-backed, so snapshotting from the HTTP
+thread is cheap and safe), the KV page pool gauges, and the frontend's
+own request-lifecycle counters.  Format is the Prometheus text
+exposition format v0.0.4: ``# HELP`` / ``# TYPE`` preambles, one sample
+per line, labels in ``{}``; quantiles are exported as gauges under the
+conventional ``{quantile="0.5"}`` labels (a true summary type needs
++Inf buckets we don't track).
+"""
+from __future__ import annotations
+
+__all__ = ["render_metrics"]
+
+_PREFIX = "paddle_tpu"
+
+
+def _esc(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class _Doc:
+    def __init__(self):
+        self.lines = []
+
+    def metric(self, name, kind, help_text, samples):
+        """samples: iterable of (labels-dict-or-None, value)."""
+        full = f"{_PREFIX}_{name}"
+        self.lines.append(f"# HELP {full} {help_text}")
+        self.lines.append(f"# TYPE {full} {kind}")
+        for labels, value in samples:
+            if value is None:
+                continue
+            lbl = ""
+            if labels:
+                inner = ",".join(f'{k}="{_esc(v)}"'
+                                 for k, v in sorted(labels.items()))
+                lbl = "{" + inner + "}"
+            v = float(value)
+            sval = repr(int(v)) if v == int(v) else repr(v)
+            self.lines.append(f"{full}{lbl} {sval}")
+
+    def render(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+def render_metrics(snapshot: dict, *, engine=None,
+                   frontend: dict | None = None) -> str:
+    """Render one /metrics scrape.
+
+    snapshot: ServingStats.snapshot() dict.
+    engine: the live LLMEngine for pool/queue gauges (optional so the
+        renderer stays unit-testable with a bare snapshot).
+    frontend: the frontend's own counters —
+        {"requests_total": {(route, code): n}, "shed_total": n,
+         "active_streams": n, "queue_depth": n, "draining": bool}.
+    """
+    d = _Doc()
+    s = snapshot
+    fe = frontend or {}
+
+    # -- request lifecycle ------------------------------------------------
+    d.metric("http_requests_total", "counter",
+             "HTTP requests served, by route and status code.",
+             [({"route": r, "code": str(c)}, n)
+              for (r, c), n in sorted(fe.get("requests_total", {}).items())])
+    d.metric("requests_admitted_total", "counter",
+             "Generation requests admitted into the engine.",
+             [(None, s.get("admitted"))])
+    d.metric("requests_finished_total", "counter",
+             "Generation requests retired by the engine.",
+             [(None, s.get("retired"))])
+    d.metric("aborts_total", "counter",
+             "Generation requests aborted, by reason.",
+             [({"reason": r}, n)
+              for r, n in sorted((s.get("abort_reasons") or {}).items())]
+             or [({"reason": "aborted"}, 0)])
+    d.metric("shed_total", "counter",
+             "Requests refused with 429 because the admission queue "
+             "was full.", [(None, fe.get("shed_total", 0))])
+    d.metric("active_streams", "gauge",
+             "HTTP connections currently streaming tokens.",
+             [(None, fe.get("active_streams", 0))])
+    d.metric("queue_depth", "gauge",
+             "Requests submitted to the runner and not yet finished.",
+             [(None, fe.get("queue_depth", 0))])
+    d.metric("draining", "gauge",
+             "1 while the server is draining (rejecting new work).",
+             [(None, 1 if fe.get("draining") else 0)])
+
+    # -- latency ----------------------------------------------------------
+    d.metric("ttft_seconds", "gauge",
+             "Time to first token (queue wait included).",
+             [({"quantile": "0.5"}, _ms(s.get("ttft_p50_ms"))),
+              ({"quantile": "0.99"}, _ms(s.get("ttft_p99_ms")))])
+    d.metric("itl_seconds", "gauge",
+             "Inter-token latency (per-token decode interval).",
+             [({"quantile": "0.5"}, _ms(s.get("itl_p50_ms"))),
+              ({"quantile": "0.99"}, _ms(s.get("itl_p99_ms")))])
+    d.metric("throughput_tokens_per_second", "gauge",
+             "Generated-token throughput over the stats window.",
+             [(None, s.get("decode_tokens_per_s"))])
+    d.metric("generated_tokens_total", "counter",
+             "Tokens emitted by the engine.",
+             [(None, s.get("decode_tokens"))])
+
+    # -- prefix cache and speculation ------------------------------------
+    d.metric("prefix_cache_hit_rate", "gauge",
+             "Fraction of prompt tokens served from cached KV pages.",
+             [(None, s.get("prefix_hit_rate"))])
+    d.metric("spec_accept_rate", "gauge",
+             "Fraction of speculated draft tokens accepted by verify.",
+             [(None, s.get("accept_rate"))])
+
+    # -- engine gauges ----------------------------------------------------
+    if engine is not None:
+        pool = engine.blocks
+        d.metric("kv_pages", "gauge",
+                 "KV page pool occupancy, by state.",
+                 [({"state": "used"}, pool.num_used),
+                  ({"state": "free"}, pool.num_free),
+                  ({"state": "cached"}, pool.num_cached)])
+        d.metric("engine_running_seqs", "gauge",
+                 "Sequences in the decode batch.",
+                 [(None, len(engine._running))])
+        d.metric("engine_waiting_seqs", "gauge",
+                 "Sequences queued inside the engine for admission.",
+                 [(None, len(engine._waiting))])
+        d.metric("engine_compiles_total", "counter",
+                 "XLA compiles triggered, by program kind.",
+                 [({"kind": k}, n)
+                  for k, n in sorted(engine.compile_counts.items())])
+    return d.render()
+
+
+def _ms(v):
+    return None if v is None else float(v) / 1000.0

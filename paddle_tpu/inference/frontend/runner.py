@@ -1,0 +1,298 @@
+"""EngineRunner: the thread bridge between the async frontend and the
+single-threaded LLMEngine.
+
+The engine (inference/serving.py) is deliberately single-threaded — its
+scheduler, page pool, and host-side batch buffers are mutated with no
+locks.  The frontend, meanwhile, is an asyncio event loop serving many
+sockets.  This module owns the seam: ONE dedicated thread steps the
+engine forever, and every cross-thread interaction goes through queues
+that the stepping thread drains at step boundaries (the only moments the
+engine's state is consistent):
+
+    HTTP thread                     engine thread
+    -----------                     -------------
+    submit()  ──▶ inbox deque  ──▶  engine.add_request(...)
+    abort()   ──▶ abort deque  ──▶  engine.abort(rid, reason)
+                                    engine.step()
+    deliver(ev) ◀── on_token/on_finish callbacks (engine thread) ◀──┘
+
+Tokens flow OUT through each request's ``deliver`` callable — invoked on
+the engine thread with ("token", tok) / ("finish", RequestOutput)
+events; the HTTP layer passes a closure that trampolines onto its event
+loop (``loop.call_soon_threadsafe``), a sync caller can pass
+``queue.Queue.put_nowait`` directly.  Backpressure is enforced HERE (not
+in the engine): ``submit`` refuses work past ``max_pending``
+(RunnerSaturated → the HTTP layer's 429) and while draining
+(RunnerDraining → 503).
+
+Deadlines are runner-owned: each handle carries an absolute monotonic
+deadline covering queue wait AND generation; the stepping thread sweeps
+expired handles every iteration and aborts them with reason
+``"deadline"`` — so a deadline fires even for a request still sitting in
+the admission queue.
+
+``drain()`` is the graceful-shutdown half: stop admitting (submit
+refuses), let the engine finish or deadline-out everything in flight,
+then park the thread.  ``close(abort_inflight=True)`` is the impatient
+variant that aborts the in-flight set instead of finishing it.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+__all__ = ["EngineRunner", "RunnerSaturated", "RunnerDraining",
+           "StreamHandle"]
+
+
+class RunnerSaturated(RuntimeError):
+    """Admission queue full — shed the request (HTTP 429)."""
+
+
+class RunnerDraining(RuntimeError):
+    """Server is draining — no new work (HTTP 503)."""
+
+
+@dataclass
+class StreamHandle:
+    """One submitted request as the frontend sees it."""
+    request_id: str                   # runner-scoped id (assigned here)
+    deliver: object                   # callable(event) on the engine thread
+    deadline: float | None            # absolute time.monotonic() deadline
+    params: dict                      # add_request kwargs
+    rid: int = -1                     # engine rid once admitted
+    done: bool = False
+    t_submit: float = field(default_factory=time.monotonic)
+
+
+class EngineRunner:
+    """Owns the engine's stepping thread and the cross-thread queues.
+
+    Parameters
+    ----------
+    engine: an LLMEngine (ideally built with ``retain_outputs=False`` so
+        a long-running server does not accumulate finished outputs).
+    max_pending: admission bound — submitted-but-unfinished requests the
+        runner will hold before shedding (queued + running).  Sized a
+        few times ``engine.max_num_seqs`` so a burst queues instead of
+        shedding, but an overload sheds instead of growing without
+        bound.
+    idle_wait_s: how long the stepping thread parks when there is no
+        work (woken early by submit/abort/drain).
+    """
+
+    def __init__(self, engine, *, max_pending: int | None = None,
+                 idle_wait_s: float = 0.05):
+        self.engine = engine
+        self.max_pending = int(max_pending
+                               if max_pending is not None
+                               else 4 * engine.max_num_seqs)
+        self.idle_wait_s = float(idle_wait_s)
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._inbox: deque = deque()          # StreamHandle, FIFO
+        self._aborts: deque = deque()         # (request_id, reason)
+        self._handles: dict = {}              # request_id -> StreamHandle
+        self._by_rid: dict = {}               # engine rid -> StreamHandle
+        self._inflight = 0                    # submitted, not yet finished
+        self._draining = False
+        self._stopped = False
+        self._seq = itertools.count()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="llm-engine", daemon=True)
+        self._started = False
+
+    # ------------------------------------------------------------------
+    # any-thread API
+    # ------------------------------------------------------------------
+
+    def start(self) -> "EngineRunner":
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        return self
+
+    def submit(self, prompt, *, deliver, deadline_s: float | None = None,
+               **params) -> str:
+        """Queue one generation request.  ``deliver`` receives
+        ("token", int) events and exactly one terminal
+        ("finish", RequestOutput) event, all on the engine thread.
+        ``deadline_s`` is a relative budget from now (queue wait
+        included).  Returns the runner request id (the abort() handle).
+        Raises RunnerSaturated / RunnerDraining instead of queuing."""
+        with self._lock:
+            if self._draining or self._stopped:
+                raise RunnerDraining("runner is draining")
+            if self._inflight >= self.max_pending:
+                raise RunnerSaturated(
+                    f"{self._inflight} requests in flight >= max_pending "
+                    f"{self.max_pending}")
+            request_id = f"req-{next(self._seq)}"
+            deadline = None if deadline_s is None \
+                else time.monotonic() + float(deadline_s)
+            h = StreamHandle(request_id=request_id, deliver=deliver,
+                             deadline=deadline, params=dict(params))
+            h.params["prompt"] = prompt
+            self._handles[request_id] = h
+            self._inbox.append(h)
+            self._inflight += 1
+        self._wake.set()
+        return request_id
+
+    def abort(self, request_id: str, reason: str = "aborted") -> None:
+        """Request cancellation; applied at the next step boundary.  The
+        stream still receives its terminal ("finish", output) event (with
+        the abort reason) unless it already finished — aborting a
+        finished/unknown id is a no-op."""
+        with self._lock:
+            self._aborts.append((request_id, reason))
+        self._wake.set()
+
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self, timeout_s: float | None = None) -> bool:
+        """Graceful shutdown: stop admitting, finish (or deadline-out)
+        everything in flight, park the thread.  True when the engine
+        drained fully inside the timeout."""
+        with self._lock:
+            self._draining = True
+        self._wake.set()
+        t0 = time.monotonic()
+        while True:
+            with self._lock:
+                if self._inflight == 0:
+                    break
+            if timeout_s is not None \
+                    and time.monotonic() - t0 > float(timeout_s):
+                break
+            time.sleep(0.005)
+        with self._lock:
+            drained = self._inflight == 0
+            self._stopped = True
+        self._wake.set()
+        if self._started:
+            self._thread.join(timeout=5.0)
+        return drained
+
+    def close(self, *, abort_inflight: bool = True) -> None:
+        """Impatient shutdown: abort whatever is still in flight (reason
+        "shutdown"), then stop the thread."""
+        if abort_inflight:
+            with self._lock:
+                ids = list(self._handles)
+                self._draining = True
+            for request_id in ids:
+                self.abort(request_id, reason="shutdown")
+        self.drain(timeout_s=30.0)
+
+    # ------------------------------------------------------------------
+    # engine thread
+    # ------------------------------------------------------------------
+
+    def _finish_handle(self, h, out) -> None:
+        # engine thread only; lock held by caller where required
+        if h.done:
+            return
+        h.done = True
+        with self._lock:
+            self._handles.pop(h.request_id, None)
+            if h.rid >= 0:
+                self._by_rid.pop(h.rid, None)
+            self._inflight -= 1
+        try:
+            h.deliver(("finish", out))
+        except Exception:
+            pass                      # a dead consumer must not kill the loop
+
+    def _admit_inbox(self) -> None:
+        eng = self.engine
+        while True:
+            with self._lock:
+                if not self._inbox:
+                    return
+                h = self._inbox.popleft()
+            if h.done:                # aborted while still queued
+                continue
+
+            def _on_token(rid, tok, h=h):
+                try:
+                    h.deliver(("token", tok))
+                except Exception:
+                    pass
+
+            def _on_finish(out, h=h):
+                self._finish_handle(h, out)
+
+            params = dict(h.params)
+            prompt = params.pop("prompt")
+            try:
+                rid = eng.add_request(prompt, on_token=_on_token,
+                                      on_finish=_on_finish, **params)
+            except Exception as e:
+                from ..serving import RequestOutput
+                self._finish_handle(h, RequestOutput(
+                    rid=-1, prompt=list(prompt), generated=[],
+                    finish_reason=f"error: {type(e).__name__}: {e}"))
+                continue
+            h.rid = rid
+            with self._lock:
+                self._by_rid[rid] = h
+
+    def _apply_aborts(self) -> None:
+        while True:
+            with self._lock:
+                if not self._aborts:
+                    return
+                request_id, reason = self._aborts.popleft()
+                h = self._handles.get(request_id)
+            if h is None or h.done:
+                continue
+            if h.rid >= 0:
+                # engine.abort fires on_finish -> _finish_handle
+                self.engine.abort(h.rid, finish_reason=reason)
+            else:
+                # never reached the engine: synthesize the terminal event
+                from ..serving import RequestOutput
+                self._finish_handle(h, RequestOutput(
+                    rid=-1, prompt=[], generated=[], finish_reason=reason))
+                self.engine.stats.record_abort(reason)
+
+    def _sweep_deadlines(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            expired = [h.request_id for h in self._handles.values()
+                       if h.deadline is not None and now > h.deadline
+                       and not h.done]
+        for request_id in expired:
+            with self._lock:
+                self._aborts.append((request_id, "deadline"))
+        if expired:
+            self._apply_aborts()
+
+    def _loop(self) -> None:
+        eng = self.engine
+        while True:
+            with self._lock:
+                if self._stopped:
+                    return
+            self._apply_aborts()
+            self._sweep_deadlines()
+            self._admit_inbox()
+            if eng.has_unfinished():
+                eng.step()
+                continue
+            with self._lock:
+                idle = not self._inbox and not self._aborts \
+                    and not self._stopped
+            if idle:
+                self._wake.wait(self.idle_wait_s)
+                self._wake.clear()

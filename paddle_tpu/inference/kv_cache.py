@@ -441,18 +441,54 @@ class BlockManager:
         the rest rejoin the free list.  A written partial tail page is
         registered on the way out so a recompute/follow-up can hit it.
         Double-free raises a clear error instead of corrupting the pool."""
+        self._drop(seq_id, register_tail=True, op="free")
+
+    def release(self, seq_id) -> None:
+        """Abort-path free: retire a sequence that may be MID-prefill,
+        mid-decode, or mid-spec-verify.  Differences from ``free``:
+
+        - the written partial tail page is NOT registered in the prefix
+          cache — an aborted request's trailing positions are the ones
+          the engine may have been about to overwrite, and an abort must
+          never widen the cache's reachable content;
+        - assertion-hardened for the shared-prefix case: a page this
+          sequence shares with live neighbours must only DECREF — its
+          chain-hash registrations stay exactly as they were (scrubbing
+          them would make a hot system prompt vanish from the cache the
+          moment one of its readers is cancelled), and the page itself
+          must remain live for the surviving owners.
+
+        Raises the same clear double-free/unknown errors as ``free``.
+        """
+        # snapshot shared pages + their registrations BEFORE the drop
+        table = self._tables.get(seq_id, ())
+        shared = {b: set(self._block_hashes.get(b, ()))
+                  for b in table if self._ref.get(b, 0) > 1}
+        self._drop(seq_id, register_tail=False, op="release")
+        for b, hashes in shared.items():
+            assert b in self._ref, (
+                f"abort of {seq_id!r} killed shared page {b} "
+                f"(refcount reached 0 with other owners alive)")
+            assert self._block_hashes.get(b, set()) == hashes, (
+                f"abort of {seq_id!r} scrubbed live chain hashes on "
+                f"shared page {b}")
+            for h in hashes:
+                assert self._hash_to_block.get(h) == b, \
+                    f"abort of {seq_id!r} redirected hash {h} off page {b}"
+
+    def _drop(self, seq_id, *, register_tail: bool, op: str) -> None:
         if seq_id not in self._tables:
             if seq_id in self._freed:
                 raise ValueError(
-                    f"double free: sequence {seq_id!r} was already freed")
-            raise ValueError(f"free of unknown sequence {seq_id!r}")
+                    f"double {op}: sequence {seq_id!r} was already freed")
+            raise ValueError(f"{op} of unknown sequence {seq_id!r}")
         table = self._tables.pop(seq_id)
         ids = self._ids.pop(seq_id, None)
         valid = self._valid.pop(seq_id, 0)
         chain = self._chain.pop(seq_id, [])
         self._tokens.pop(seq_id, None)
         self._version.pop(seq_id, None)
-        if self.enable_prefix_caching and ids is not None:
+        if register_tail and self.enable_prefix_caching and ids is not None:
             bs = self.block_size
             p, k = valid // bs, valid % bs
             if k and len(chain) >= p:

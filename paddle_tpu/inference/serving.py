@@ -103,6 +103,9 @@ class Request:
     spec_proposed: int = 0            # drafts sent to verify (lifetime)
     spec_accepted: int = 0            # drafts accepted (lifetime)
     spec_disabled: bool = False       # acceptance fell below the floor
+    # streaming hooks (both called from the engine's stepping thread)
+    on_token: object = None           # callable(rid, token) per emission
+    on_finish: object = None          # callable(RequestOutput) at the end
 
 
 @dataclass
@@ -110,7 +113,8 @@ class RequestOutput:
     rid: int
     prompt: list
     generated: list                   # includes the eos token when hit
-    finish_reason: str                # "eos" | "length"
+    finish_reason: str                # "eos" | "length" | abort reason
+                                      # ("aborted", "deadline", ...)
 
     @property
     def token_ids(self):
@@ -158,6 +162,17 @@ class LLMEngine:
         drafts to verify, speculation auto-disables for it if its
         lifetime acceptance rate sits below the floor (the drafter is
         not helping; stop paying the verify overhead).
+    retain_outputs: keep every finished RequestOutput in the dict that
+        ``run()`` returns.  A long-running server (the HTTP frontend)
+        passes False — outputs are delivered through each request's
+        ``on_finish`` callback instead, so finished requests cost no
+        memory once their stream closes.
+
+    The engine is SINGLE-THREADED by design: add_request/step/abort must
+    all be called from one thread (the frontend's EngineRunner owns that
+    thread and bridges other threads in via queues drained at step
+    boundaries).  abort() in particular relies on being between steps,
+    when pool state is consistent.
     """
 
     def __init__(self, model, *, max_num_seqs: int = 8, block_size: int = 16,
@@ -166,7 +181,8 @@ class LLMEngine:
                  prefill_token_bucket: int = 64,
                  enable_prefix_caching: bool = True,
                  drafter=None, spec_k: int = 0, max_spec_k: int = 8,
-                 spec_accept_floor: float = 0.35, spec_window: int = 32):
+                 spec_accept_floor: float = 0.35, spec_window: int = 32,
+                 retain_outputs: bool = True):
         cfg = model.config
         self.config = cfg
         self.params = model.decode_params()
@@ -204,6 +220,7 @@ class LLMEngine:
         self._finished: dict = {}
         self._next_rid = 0
         self._arrival = 0
+        self.retain_outputs = bool(retain_outputs)
 
         # stable decode slots + persistent host-side decode buffers: rows
         # are updated incrementally (grow/retire/CoW bump the table
@@ -251,7 +268,8 @@ class LLMEngine:
                     temperature: float = 0.0, eos_token_id=None,
                     seed: int = 0, top_k: int = 0, top_p: float = 1.0,
                     repetition_penalty: float = 1.0,
-                    spec_k: int | None = None) -> int:
+                    spec_k: int | None = None,
+                    on_token=None, on_finish=None) -> int:
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -279,7 +297,8 @@ class LLMEngine:
                       eos_token_id=eos_token_id, seed=int(seed),
                       top_k=int(top_k), top_p=float(top_p),
                       repetition_penalty=float(repetition_penalty),
-                      spec_k=spec_k, t_arrival=time.perf_counter())
+                      spec_k=spec_k, t_arrival=time.perf_counter(),
+                      on_token=on_token, on_finish=on_finish)
         if req.repetition_penalty != 1.0:
             req.seen = np.zeros((self.config.vocab_size,), bool)
             req.seen[prompt] = True
@@ -288,6 +307,64 @@ class LLMEngine:
 
     def has_unfinished(self) -> bool:
         return bool(self._waiting or self._running)
+
+    def abort(self, request_id: int, finish_reason: str = "aborted"):
+        """Retire a request before it finishes — the client disconnected,
+        its deadline passed, or the server is shedding it.
+
+        Works at ANY point of the request's lifetime as observed between
+        steps: still queued (nothing allocated), mid-chunked-prefill
+        (pages for the already-prefilled prefix are live, resume state in
+        ``req.cached``), mid-decode, or mid-speculation (the post-verify
+        ``truncate`` already rolled back rejected drafts, so pool state
+        is consistent at every step boundary).  Pages return through
+        ``BlockManager.release`` — the abort-hardened path that only
+        DECREFS pages shared with live neighbours (their chain hashes
+        survive, so aborting one reader of a hot system prompt never
+        evicts it) and never registers the aborted tail.
+
+        Returns the partial RequestOutput, or None when request_id is
+        unknown or already finished (an abort racing a natural finish is
+        a benign no-op).  Must be called from the engine's stepping
+        thread, between steps — the frontend's EngineRunner queues
+        cross-thread aborts and applies them at the next step boundary.
+        """
+        req = None
+        for r in self._running:
+            if r.rid == request_id:
+                req = r
+                self._running.remove(r)
+                self._release_slot(r)
+                break
+        else:
+            for r in self._waiting:
+                if r.rid == request_id:
+                    req = r
+                    self._waiting.remove(r)
+                    break
+        if req is None:
+            return None
+        # a waiting request normally holds no pages — unless it was
+        # preempted after generating (pages freed then) or never admitted
+        # (never allocated); release() covers the running/mid-prefill case
+        if self.blocks.has(req.rid):
+            self.blocks.release(req.rid)
+        if self.drafter is not None:
+            self.drafter.release(req.rid)
+        out = RequestOutput(rid=req.rid, prompt=list(req.prompt),
+                            generated=list(req.generated),
+                            finish_reason=finish_reason)
+        if self.retain_outputs:
+            self._finished[req.rid] = out
+        self.stats.record_abort(finish_reason)
+        if req.on_finish is not None:
+            req.on_finish(out)
+        return out
+
+    def _notify_tokens(self, req, toks) -> None:
+        if req.on_token is not None:
+            for t in toks:
+                req.on_token(req.rid, int(t))
 
     @property
     def num_decode_programs(self) -> int:
@@ -423,6 +500,7 @@ class LLMEngine:
                 if len(req.generated) == 1:
                     self.stats.record_ttft(
                         time.perf_counter() - req.t_arrival)
+                self._notify_tokens(req, (tok,))
                 self._maybe_retire(req, finished)
 
         # decode everyone already in the batch (sequences that finished
@@ -481,6 +559,7 @@ class LLMEngine:
                 req.generated.append(int(tok))
                 if req.seen is not None:
                     req.seen[int(tok)] = True
+                self._notify_tokens(req, (tok,))
                 self._maybe_retire(req, finished)
 
         ev = self.blocks.eviction_count
@@ -638,11 +717,14 @@ class LLMEngine:
         out = RequestOutput(rid=req.rid, prompt=list(req.prompt),
                             generated=list(req.generated),
                             finish_reason=reason)
-        self._finished[req.rid] = out
+        if self.retain_outputs:
+            self._finished[req.rid] = out
         finished.append(out)
         if self.drafter is not None:
             self.drafter.release(req.rid)
         self.stats.record_retirement()
+        if req.on_finish is not None:
+            req.on_finish(out)
 
     # ------------------------------------------------------------------
     # speculative decoding: propose -> verify -> accept/rollback
@@ -863,6 +945,7 @@ class LLMEngine:
         req.generated.extend(emitted)
         if req.seen is not None:
             req.seen[emitted] = True
+        self._notify_tokens(req, emitted)
         j = m - 1 if m == n_acc + 1 else m            # emitted draft count
         if k:                                         # zero-draft rows are
             req.spec_proposed += k                    # plain decode riding

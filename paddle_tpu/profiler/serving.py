@@ -1,15 +1,29 @@
 """Serving-side profiling: per-step timing + scheduler counters.
 
-The LLM engine (paddle_tpu/inference/serving.py) is a host loop around two
-compiled programs; what matters for serving perf is not one op's latency
-but the shape of the whole stream — per-token latency percentiles, how
-full the decode batch ran, how often the page pool forced a preemption,
-and how many distinct programs XLA had to build.  ``ServingStats``
-aggregates exactly that, and the engine additionally brackets each phase
-in ``profiler.RecordEvent`` so engine steps land in chrome traces next to
-model ops when a Profiler is active.
+The LLM engine (paddle_tpu/inference/serving.py) is a host loop around a
+handful of compiled programs; what matters for serving perf is not one
+op's latency but the shape of the whole stream — per-token latency
+percentiles, how full the decode batch ran, how often the page pool
+forced a preemption, and how many distinct programs XLA had to build.
+``ServingStats`` aggregates exactly that, and the engine additionally
+brackets each phase in ``profiler.RecordEvent`` so engine steps land in
+chrome traces next to model ops when a Profiler is active.
+
+A server that stays up for days must not let its stats surface grow with
+traffic: every distribution (per-token latency, TTFT, batch occupancy,
+prefill queue depth) lives in a bounded RESERVOIR — counters and sums are
+exact, percentiles are computed on demand from a uniform sample of fixed
+size (Vitter's Algorithm R, deterministic replacement) — so memory is
+O(reservoir) no matter how many requests pass through.
+``ServingStats.snapshot()`` is the one read surface: the HTTP frontend's
+``/metrics`` endpoint and ``tools/perf/serve_bench.py`` both render it.
+Reservoir mutation and sampling take a tiny per-reservoir lock, so the
+frontend thread can snapshot while the engine thread records.
 """
 from __future__ import annotations
+
+import random
+import threading
 
 __all__ = ["ServingStats"]
 
@@ -23,27 +37,91 @@ def _percentile(sorted_vals, q: float) -> float:
     return sorted_vals[idx]
 
 
+class _Reservoir:
+    """Bounded uniform sample of a value stream (Vitter's Algorithm R).
+
+    The first ``capacity`` values are kept verbatim (small runs — every
+    test and bench below capacity — get EXACT percentiles); after that
+    each new value replaces a uniformly-chosen slot with probability
+    capacity/n, keeping the sample uniform over the whole stream.  The
+    RNG is seeded per reservoir, so a rerun of the same stream reproduces
+    the same sample.  count/total/vmin/vmax stay exact regardless.
+    """
+
+    __slots__ = ("capacity", "count", "total", "vmin", "vmax",
+                 "_sample", "_rng", "_lock")
+
+    def __init__(self, capacity: int = 2048, seed: int = 0):
+        self.capacity = int(capacity)
+        self._rng = random.Random(0x5EED ^ seed)
+        self._lock = threading.Lock()
+        self.count = 0
+        self.total = 0.0
+        self.vmin = 0.0
+        self.vmax = 0.0
+        self._sample = []
+
+    def add(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            if self.count == 0:
+                self.vmin = self.vmax = v
+            else:
+                self.vmin = min(self.vmin, v)
+                self.vmax = max(self.vmax, v)
+            self.count += 1
+            self.total += v
+            if len(self._sample) < self.capacity:
+                self._sample.append(v)
+            else:
+                j = self._rng.randrange(self.count)
+                if j < self.capacity:
+                    self._sample[j] = v
+
+    def extend(self, value: float, n: int) -> None:
+        for _ in range(int(n)):
+            self.add(value)
+
+    def percentile(self, q: float) -> float:
+        with self._lock:
+            vals = sorted(self._sample)
+        return _percentile(vals, q)
+
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def __len__(self) -> int:
+        return self.count
+
+
 class ServingStats:
     """Aggregates one serving run's step timings and scheduler events.
 
     Times arrive from the engine as wall-clock seconds per STEP together
     with how many sequences' tokens that step produced; per-token latency
     is the step duration each of those tokens observed (every sequence in
-    a batched step waits for the whole step).
+    a batched step waits for the whole step) — the stream's inter-token
+    latency (ITL).  TTFT is recorded per request at its first emitted
+    token.  All distributions are reservoir-bounded; ``snapshot()``
+    (aliased ``summary()``) is the canonical read surface.
     """
 
-    def __init__(self):
+    RESERVOIR = 2048
+
+    def __init__(self, reservoir: int = RESERVOIR):
+        self._reservoir = int(reservoir)
         self.reset()
 
     def reset(self):
+        r = self._reservoir
         self.prefill_steps = 0
         self.prefill_tokens = 0          # prompt tokens processed
         self.prefill_time = 0.0
         self.decode_steps = 0
         self.decode_tokens = 0           # tokens emitted by decode steps
         self.decode_time = 0.0
-        self._token_lat = []             # per emitted token: its step's dur
-        self._occupancy = []             # running/max_num_seqs per decode step
+        self._token_lat = _Reservoir(r, seed=1)   # ITL: per-token step dur
+        self._occupancy = _Reservoir(r, seed=2)   # running/max per decode
         self.preemptions = 0
         self.admitted = 0
         self.retired = 0
@@ -52,8 +130,8 @@ class ServingStats:
         self.cache_miss_tokens = 0       # prompt tokens prefilled fresh
         self.cow_copies = 0              # copy-on-write page copies
         self.cache_evictions = 0         # cached pages reclaimed under pressure
-        self._prefill_queue = []         # per step: requests with pending prefill
-        self._ttft = []                  # per request: arrival -> first token (s)
+        self._prefill_queue = _Reservoir(r, seed=3)  # pending-prefill depth
+        self._ttft = _Reservoir(r, seed=4)   # arrival -> first token (s)
         # speculative decoding surface (PR 4)
         self.verify_steps = 0            # verify program launches
         self.verify_time = 0.0
@@ -64,6 +142,9 @@ class ServingStats:
         self.rollback_tokens = 0         # draft tokens rolled back
         self.rollback_pages = 0          # pages released by truncate
         self.spec_disables = 0           # requests whose speculation tripped off
+        # request-lifecycle surface (PR 5: the HTTP frontend)
+        self.aborts = 0                  # aborted before finishing (any reason)
+        self.abort_reasons: dict = {}    # finish_reason -> count
 
     # -- recording (engine-facing) ------------------------------------------
 
@@ -73,15 +154,15 @@ class ServingStats:
         self.prefill_tokens += int(n_prompt_tokens)
         self.prefill_time += float(duration_s)
         # each sequence's first token comes out of the prefill step
-        self._token_lat.extend([float(duration_s)] * int(n_seqs))
+        self._token_lat.extend(float(duration_s), int(n_seqs))
 
     def record_decode(self, duration_s: float, n_tokens: int,
                       occupancy: float) -> None:
         self.decode_steps += 1
         self.decode_tokens += int(n_tokens)
         self.decode_time += float(duration_s)
-        self._token_lat.extend([float(duration_s)] * int(n_tokens))
-        self._occupancy.append(float(occupancy))
+        self._token_lat.extend(float(duration_s), int(n_tokens))
+        self._occupancy.add(float(occupancy))
 
     def record_admission(self, n: int = 1) -> None:
         self.admitted += int(n)
@@ -91,6 +172,12 @@ class ServingStats:
 
     def record_preemption(self, n: int = 1) -> None:
         self.preemptions += int(n)
+
+    def record_abort(self, reason: str = "aborted") -> None:
+        """One request retired before finishing (client disconnect,
+        deadline, shutdown drain, explicit cancel)."""
+        self.aborts += 1
+        self.abort_reasons[reason] = self.abort_reasons.get(reason, 0) + 1
 
     def record_cache_lookup(self, hit_tokens: int, miss_tokens: int) -> None:
         """One admission's prefix-cache match: how many prompt tokens the
@@ -107,10 +194,10 @@ class ServingStats:
     def record_prefill_queue(self, depth: int) -> None:
         """Requests (running or waiting) with prompt tokens still to
         prefill at this step — the chunked-prefill backlog."""
-        self._prefill_queue.append(int(depth))
+        self._prefill_queue.add(int(depth))
 
     def record_ttft(self, duration_s: float) -> None:
-        self._ttft.append(float(duration_s))
+        self._ttft.add(float(duration_s))
 
     def record_verify(self, duration_s: float, n_tokens: int,
                       occupancy: float) -> None:
@@ -122,8 +209,8 @@ class ServingStats:
         self.verify_time += float(duration_s)
         self.decode_tokens += int(n_tokens)
         self.decode_time += float(duration_s)
-        self._token_lat.extend([float(duration_s)] * int(n_tokens))
-        self._occupancy.append(float(occupancy))
+        self._token_lat.extend(float(duration_s), int(n_tokens))
+        self._occupancy.add(float(occupancy))
 
     def record_spec(self, *, proposed: int, accepted: int, emitted: int,
                     rollback: int, pages_rolled: int = 0) -> None:
@@ -145,24 +232,28 @@ class ServingStats:
             else 0.0
 
     def token_latency_ms(self, q: float) -> float:
-        return 1e3 * _percentile(sorted(self._token_lat), q)
+        return 1e3 * self._token_lat.percentile(q)
 
     def mean_occupancy(self) -> float:
-        return sum(self._occupancy) / len(self._occupancy) \
-            if self._occupancy else 0.0
+        return self._occupancy.mean()
 
     def prefix_hit_rate(self) -> float:
         total = self.cache_hit_tokens + self.cache_miss_tokens
         return self.cache_hit_tokens / total if total else 0.0
 
     def ttft_ms(self, q: float) -> float:
-        return 1e3 * _percentile(sorted(self._ttft), q)
+        return 1e3 * self._ttft.percentile(q)
 
     def accept_rate(self) -> float:
         return self.draft_accepted / self.draft_proposed \
             if self.draft_proposed else 0.0
 
-    def summary(self) -> dict:
+    def snapshot(self) -> dict:
+        """Point-in-time view of every counter and on-demand percentile.
+        The ONE read surface: the frontend's ``/metrics`` endpoint and
+        serve_bench both render this dict.  Safe to call from a thread
+        other than the recording one (reservoirs lock internally;
+        counters are plain ints read atomically under the GIL)."""
         return {
             "prefill_steps": self.prefill_steps,
             "prefill_tokens": self.prefill_tokens,
@@ -171,20 +262,22 @@ class ServingStats:
             "decode_tokens_per_s": round(self.decode_tokens_per_s(), 2),
             "p50_token_ms": round(self.token_latency_ms(50), 3),
             "p99_token_ms": round(self.token_latency_ms(99), 3),
+            "itl_p50_ms": round(self.token_latency_ms(50), 3),
+            "itl_p99_ms": round(self.token_latency_ms(99), 3),
             "mean_batch_occupancy": round(self.mean_occupancy(), 4),
             "admitted": self.admitted,
             "retired": self.retired,
             "preemptions": self.preemptions,
+            "aborts": self.aborts,
+            "abort_reasons": dict(self.abort_reasons),
             "cache_hit_tokens": self.cache_hit_tokens,
             "cache_miss_tokens": self.cache_miss_tokens,
             "prefix_hit_rate": round(self.prefix_hit_rate(), 4),
             "prefill_tokens_saved": self.cache_hit_tokens,
             "cow_copies": self.cow_copies,
             "cache_evictions": self.cache_evictions,
-            "mean_prefill_queue_depth": round(
-                sum(self._prefill_queue) / len(self._prefill_queue), 3)
-            if self._prefill_queue else 0.0,
-            "max_prefill_queue_depth": max(self._prefill_queue, default=0),
+            "mean_prefill_queue_depth": round(self._prefill_queue.mean(), 3),
+            "max_prefill_queue_depth": int(self._prefill_queue.vmax),
             "ttft_p50_ms": round(self.ttft_ms(50), 3),
             "ttft_p99_ms": round(self.ttft_ms(99), 3),
             "verify_steps": self.verify_steps,
@@ -197,3 +290,7 @@ class ServingStats:
             "rollback_pages": self.rollback_pages,
             "spec_disables": self.spec_disables,
         }
+
+    # summary() predates snapshot() and is the name the engine/benches
+    # grew up with; both return the same dict
+    summary = snapshot

@@ -1,0 +1,645 @@
+"""HTTP serving frontend: lifecycle correctness end to end.
+
+The contract under test (CPU, tiny model, paged kernel in interpret
+mode):
+
+- greedy outputs through HTTP SSE streaming are BYTE-IDENTICAL to the
+  direct engine / generate() oracle, on a ragged concurrent stream,
+  speculation off and on;
+- aborts — client disconnect mid-stream, per-request deadlines, drain —
+  retire sequences and return every KV page (shared pages only decref),
+  without perturbing the engine's compile-count budget;
+- backpressure sheds with 429 past the admission bound and 503 while
+  draining;
+- /healthz and /metrics tell the truth;
+- the ISSUE acceptance scenario: 32 concurrent streams, 8 disconnected
+  mid-stream, 4 deadline-killed, the rest byte-identical, zero leaked
+  pages, metrics reporting the kills, clean drain.
+"""
+import json
+import http.client
+import queue
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.inference import BlockManager, LLMEngine
+from paddle_tpu.inference.frontend import (EngineRunner, RunnerDraining,
+                                           RunnerSaturated, serve_background)
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+VOCAB = 97
+CFG = LlamaConfig.tiny(vocab=VOCAB, hidden=32, layers=2, heads=4, ffn=64,
+                       seq=64)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return LlamaForCausalLM(CFG)
+
+
+def _oracle(model, prompt, max_new):
+    out = model.generate(jnp.asarray([prompt], jnp.int32),
+                         max_new_tokens=max_new, temperature=0.0)
+    return np.asarray(out._data)[0, len(prompt):].tolist()
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_num_seqs", 4)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_model_len", 64)
+    kw.setdefault("max_prefill_tokens", 128)
+    kw.setdefault("prefill_token_bucket", 32)
+    kw.setdefault("retain_outputs", False)
+    return LLMEngine(model, **kw)
+
+
+def _ragged_prompts(n, seed=3):
+    rng = np.random.RandomState(seed)
+    return [(rng.randint(0, VOCAB, [4, 9, 13, 21][i % 4]).tolist(),
+             int(rng.randint(4, 12))) for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# HTTP client helpers (stdlib http.client; chunked decode is built in)
+# ---------------------------------------------------------------------------
+
+def _post(port, obj, path="/v1/completions", timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", path, body=json.dumps(obj).encode(),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, (json.loads(body) if body else None)
+
+
+def _get(port, path, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    status, body = resp.status, resp.read()
+    conn.close()
+    return status, body
+
+
+def _stream(port, obj, timeout=300):
+    """One streaming completion; returns (status, tokens, finish)."""
+    obj = dict(obj, stream=True)
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", "/v1/completions", body=json.dumps(obj).encode(),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    if resp.status != 200:
+        body = resp.read()
+        conn.close()
+        return resp.status, [], json.loads(body)
+    toks, finish, buf, done = [], None, b"", False
+    while not done:
+        chunk = resp.read(64)
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n\n" in buf:
+            frame, buf = buf.split(b"\n\n", 1)
+            data = frame.partition(b"data: ")[2].decode()
+            if data == "[DONE]":
+                done = True
+                continue
+            ch = json.loads(data)["choices"][0]
+            if ch["finish_reason"] is None:
+                toks.append(ch["token"])
+            else:
+                finish = ch["finish_reason"]
+    conn.close()
+    return 200, toks, finish
+
+
+def _stream_then_disconnect(port, obj, n_tokens_then_close):
+    """Open a streaming request on a raw socket, read until
+    ``n_tokens_then_close`` data frames arrived, then DROP the socket
+    (no clean shutdown) — the mid-stream client disconnect."""
+    obj = dict(obj, stream=True)
+    body = json.dumps(obj).encode()
+    s = socket.create_connection(("127.0.0.1", port), timeout=60)
+    s.sendall(b"POST /v1/completions HTTP/1.1\r\n"
+              b"Host: x\r\nContent-Type: application/json\r\n"
+              + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+    seen, buf = 0, b""
+    while seen < n_tokens_then_close:
+        chunk = s.recv(4096)
+        if not chunk:
+            break
+        buf += chunk
+        seen = buf.count(b"data: ")
+    s.close()
+    return seen
+
+
+def _metric_value(text, name, labels=""):
+    """Value of one sample line in Prometheus exposition text."""
+    want = f"paddle_tpu_{name}{labels} "
+    for line in text.splitlines():
+        if line.startswith(want):
+            return float(line.rsplit(" ", 1)[1])
+    return None
+
+
+def _wait(pred, timeout_s=60.0, interval_s=0.01):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout_s:
+            return False
+        time.sleep(interval_s)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# engine-level abort (unit surface under the frontend)
+# ---------------------------------------------------------------------------
+
+def test_engine_abort_waiting_request(model):
+    eng = _engine(model, retain_outputs=True)
+    rid = eng.add_request([1, 2, 3, 4], max_new_tokens=8)
+    out = eng.abort(rid)
+    assert out.finish_reason == "aborted" and out.generated == []
+    assert not eng.has_unfinished()
+    assert eng.blocks.num_used == 0
+    eng.blocks.check_invariants()
+    assert eng.stats.aborts == 1
+
+
+def test_engine_abort_mid_decode_releases_pages(model):
+    eng = _engine(model, retain_outputs=True)
+    rng = np.random.RandomState(0)
+    rids = [eng.add_request(rng.randint(0, VOCAB, 12).tolist(),
+                            max_new_tokens=20) for _ in range(3)]
+    for _ in range(6):
+        eng.step()
+    assert eng.blocks.num_used > 0
+    out = eng.abort(rids[1], finish_reason="deadline")
+    assert out.finish_reason == "deadline"
+    assert 0 < len(out.generated) < 20
+    eng.blocks.check_invariants()
+    outs = eng.run()                     # the two survivors finish clean
+    assert set(outs) == set(rids)
+    assert outs[rids[0]].finish_reason in ("length", "eos")
+    assert eng.blocks.num_used == 0
+    assert eng.stats.abort_reasons == {"deadline": 1}
+
+
+def test_engine_abort_unknown_and_finished_is_noop(model):
+    eng = _engine(model, retain_outputs=True)
+    rid = eng.add_request([5, 6, 7], max_new_tokens=4)
+    eng.run()
+    assert eng.abort(rid) is None        # already finished
+    assert eng.abort(10_000) is None     # never existed
+    assert eng.stats.aborts == 0
+
+
+def test_engine_abort_shared_prefix_keeps_cache(model):
+    """Aborting one reader of a shared system prompt must not scrub the
+    pages the other reader (and the cache) still depend on."""
+    eng = _engine(model, retain_outputs=True)
+    rng = np.random.RandomState(1)
+    sys_prompt = rng.randint(0, VOCAB, 16).tolist()
+    ra = eng.add_request(sys_prompt + [7], max_new_tokens=10)
+    rb = eng.add_request(sys_prompt + [11], max_new_tokens=10)
+    for _ in range(4):
+        eng.step()
+    hits_before = eng.stats.cache_hit_tokens
+    eng.abort(ra)
+    eng.blocks.check_invariants()
+    outs = eng.run()
+    assert outs[rb].generated == _oracle(model, sys_prompt + [11], 10)
+    # a THIRD reader of the same prefix still hits the cache after the
+    # abort — released shared pages kept their chain hashes
+    rc = eng.add_request(sys_prompt + [13], max_new_tokens=6)
+    outs = eng.run()
+    assert eng.stats.cache_hit_tokens > hits_before
+    assert outs[rc].generated == _oracle(model, sys_prompt + [13], 6)
+    assert eng.blocks.num_used == 0
+
+
+def test_engine_abort_mid_spec_rolls_back(model):
+    eng = _engine(model, retain_outputs=True, drafter="ngram", spec_k=4)
+    motif = [3, 9, 3, 9, 3, 9, 3, 9, 3, 9]
+    rids = [eng.add_request(motif, max_new_tokens=24, spec_k=4)
+            for _ in range(2)]
+    for _ in range(5):
+        eng.step()
+    eng.abort(rids[0])
+    eng.blocks.check_invariants()
+    outs = eng.run()
+    assert outs[rids[1]].generated == _oracle(model, motif, 24)
+    assert eng.blocks.num_used == 0
+
+
+# ---------------------------------------------------------------------------
+# BlockManager.release fuzz (satellite: abort-path assertion hardening)
+# ---------------------------------------------------------------------------
+
+def test_release_fuzz_pool_returns_to_initial_state(model):
+    """Random interleaving of admissions, steps, aborts (release path)
+    and natural finishes (free path): after everything retires, the pool
+    is back to its initial free/parked accounting and every invariant
+    holds at every abort point."""
+    eng = _engine(model, retain_outputs=True, max_num_seqs=4)
+    rng = np.random.RandomState(1234)
+    free0 = eng.blocks.num_free + eng.blocks.num_cached  # parked = reusable
+    live, aborted, submitted = [], 0, 0
+    sys_prompt = rng.randint(0, VOCAB, 11).tolist()
+    for round_no in range(60):
+        if submitted < 24 and (rng.rand() < 0.5 or not live):
+            # half the prompts share a prefix so releases hit refcounted
+            # pages; raggedness varies chunked-prefill progress
+            n = int(rng.randint(2, 20))
+            prompt = (sys_prompt[:n] if rng.rand() < 0.5
+                      else rng.randint(0, VOCAB, n).tolist())
+            live.append(eng.add_request(prompt, max_new_tokens=int(
+                rng.randint(2, 16))))
+            submitted += 1
+        for _ in range(int(rng.randint(1, 3))):
+            eng.step()
+        live = [r for r in live if r not in eng._finished]
+        if live and rng.rand() < 0.35:
+            victim = live.pop(int(rng.randint(len(live))))
+            assert eng.abort(victim).finish_reason == "aborted"
+            aborted += 1
+            eng.blocks.check_invariants()
+    eng.run()
+    assert aborted >= 5                  # the fuzz actually aborted
+    assert eng.blocks.num_used == 0
+    assert eng.blocks.num_free + eng.blocks.num_cached == free0
+    eng.blocks.check_invariants()
+    assert eng.stats.aborts == aborted
+
+
+def test_release_asserts_on_shared_chain_integrity():
+    """Direct BlockManager surface: release() only decrefs pages shared
+    with a live sequence and never unregisters their hashes."""
+    bm = BlockManager(num_blocks=9, block_size=4, enable_prefix_caching=True)
+    toks = list(range(9))                # 2 full pages + 1 compute token
+    assert bm.acquire("a", toks) == 0    # cold cache
+    bm.commit_prefill("a", 9)            # registers both full pages
+    assert bm.acquire("b", toks) == 8    # shares them via the cache
+    shared = bm.block_table("a")[:2]
+    assert bm.block_table("b")[:2] == shared
+    bm.release("b")
+    assert not bm.has("b")
+    # pages still owned by a, still registered, still shareable
+    assert bm.block_table("a")[:2] == shared
+    assert bm.acquire("c", toks) == 8
+    assert bm.block_table("c")[:2] == shared
+    bm.release("c")
+    bm.free("a")
+    bm.check_invariants()
+    assert bm.num_used == 0
+
+
+# ---------------------------------------------------------------------------
+# EngineRunner (thread bridge, no HTTP)
+# ---------------------------------------------------------------------------
+
+def _collect(q):
+    toks = []
+    while True:
+        kind, payload = q.get(timeout=120)
+        if kind == "finish":
+            return toks, payload
+        toks.append(payload)
+
+
+def test_runner_submit_stream_and_drain(model):
+    eng = _engine(model)
+    runner = EngineRunner(eng).start()
+    prompts = _ragged_prompts(6)
+    qs = []
+    for prompt, max_new in prompts:
+        q = queue.Queue()
+        runner.submit(prompt, deliver=q.put_nowait, max_new_tokens=max_new)
+        qs.append((q, prompt, max_new))
+    for q, prompt, max_new in qs:
+        toks, out = _collect(q)
+        assert toks == out.generated == _oracle(model, prompt, max_new)
+    assert runner.drain(timeout_s=60)
+    assert eng.blocks.num_used == 0
+    with pytest.raises(RunnerDraining):
+        runner.submit([1, 2], deliver=lambda ev: None)
+
+
+def test_runner_saturation_and_abort(model):
+    eng = _engine(model)
+    runner = EngineRunner(eng, max_pending=2).start()
+    q1, q2 = queue.Queue(), queue.Queue()
+    r1 = runner.submit([1, 2, 3], deliver=q1.put_nowait, max_new_tokens=40)
+    runner.submit([4, 5, 6], deliver=q2.put_nowait, max_new_tokens=40)
+    with pytest.raises(RunnerSaturated):
+        runner.submit([7, 8], deliver=lambda ev: None)
+    runner.abort(r1, reason="aborted")
+    toks1, out1 = _collect(q1)
+    assert out1.finish_reason == "aborted"
+    assert toks1 == out1.generated        # stream saw exactly the partial
+    _toks2, out2 = _collect(q2)
+    assert out2.finish_reason == "length"
+    assert runner.drain(timeout_s=60)
+    assert eng.blocks.num_used == 0
+    assert eng.stats.abort_reasons.get("aborted") == 1
+
+
+def test_runner_deadline_covers_queue_wait(model):
+    """A deadline expires even while the request still sits in the
+    admission queue behind a full batch."""
+    eng = _engine(model, max_num_seqs=2)
+    runner = EngineRunner(eng).start()
+    blockers = []
+    for _ in range(2):
+        q = queue.Queue()
+        runner.submit([1, 2, 3, 4], deliver=q.put_nowait,
+                      max_new_tokens=48)
+        blockers.append(q)
+    qd = queue.Queue()
+    runner.submit([5, 6, 7], deliver=qd.put_nowait, max_new_tokens=4,
+                  deadline_s=0.001)
+    toks, out = _collect(qd)
+    assert out.finish_reason == "deadline"
+    for q in blockers:                    # blockers unaffected
+        _t, out = _collect(q)
+        assert out.finish_reason == "length"
+    assert runner.drain(timeout_s=60)
+    assert eng.blocks.num_used == 0
+    assert eng.stats.abort_reasons.get("deadline") == 1
+
+
+def test_runner_close_aborts_inflight(model):
+    eng = _engine(model)
+    runner = EngineRunner(eng).start()
+    qs = [queue.Queue() for _ in range(3)]
+    for q in qs:
+        runner.submit([2, 4, 6, 8], deliver=q.put_nowait,
+                      max_new_tokens=50)
+    runner.close(abort_inflight=True)
+    reasons = {_collect(q)[1].finish_reason for q in qs}
+    assert reasons <= {"shutdown"}
+    assert eng.blocks.num_used == 0
+    eng.blocks.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# HTTP byte-identity (the tentpole contract)
+# ---------------------------------------------------------------------------
+
+def _identity_over_http(model, engine_kw, prompts, spec=False):
+    eng = _engine(model, **engine_kw)
+    srv = serve_background(eng, model_name="tiny")
+    try:
+        results = [None] * len(prompts)
+
+        def one(i):
+            prompt, max_new = prompts[i]
+            results[i] = _stream(srv.port, {"prompt": prompt,
+                                            "max_tokens": max_new})
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for (prompt, max_new), (status, toks, finish) in zip(prompts,
+                                                             results):
+            assert status == 200
+            assert finish in ("length", "stop")
+            assert toks == _oracle(model, prompt, max_new), \
+                f"HTTP stream diverged for prompt {prompt}"
+    finally:
+        assert srv.stop()                 # graceful drain must succeed
+    assert eng.blocks.num_used == 0
+    eng.blocks.check_invariants()
+    return eng
+
+
+def test_http_stream_byte_identical_spec_off(model):
+    eng = _identity_over_http(model, {}, _ragged_prompts(16))
+    assert eng.stats.aborts == 0
+    assert eng.stats.retired == 16
+
+
+def test_http_stream_byte_identical_spec_on(model):
+    rng = np.random.RandomState(5)
+    prompts = []
+    for i in range(16):
+        motif = rng.randint(0, VOCAB, 3).tolist()
+        n = [6, 9, 12, 15][i % 4]
+        prompts.append(((motif * 8)[:n], int(rng.randint(4, 12))))
+    eng = _identity_over_http(model, {"drafter": "ngram", "spec_k": 4},
+                              prompts, spec=True)
+    assert eng.stats.draft_proposed > 0   # speculation actually ran
+
+
+def test_http_unary_matches_stream(model):
+    eng = _engine(model)
+    srv = serve_background(eng, model_name="tiny")
+    try:
+        prompt, max_new = [3, 1, 4, 1, 5], 9
+        status, body = _post(srv.port, {"prompt": prompt,
+                                        "max_tokens": max_new})
+        assert status == 200
+        assert body["choices"][0]["token_ids"] == _oracle(model, prompt,
+                                                          max_new)
+        assert body["usage"]["completion_tokens"] == max_new
+        _s, toks, _f = _stream(srv.port, {"prompt": prompt,
+                                          "max_tokens": max_new})
+        assert toks == body["choices"][0]["token_ids"]
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle over HTTP: deadlines, disconnects, backpressure, drain
+# ---------------------------------------------------------------------------
+
+def test_http_deadline_exceeded_compile_budget_unchanged(model):
+    eng = _engine(model)
+    srv = serve_background(eng, model_name="tiny")
+    try:
+        # warm every program bucket this test will touch
+        for prompt, max_new in _ragged_prompts(8, seed=9):
+            _stream(srv.port, {"prompt": prompt, "max_tokens": max_new})
+        budget = dict(eng.compile_counts)
+        status, toks, finish = _stream(
+            srv.port, {"prompt": [1, 2, 3], "max_tokens": 40,
+                       "deadline_ms": 1})
+        assert status == 200 and finish == "deadline"
+        assert _wait(lambda: not eng.has_unfinished())
+        assert eng.compile_counts == budget, \
+            "deadline abort must not force a recompile"
+        assert eng.stats.abort_reasons.get("deadline") == 1
+    finally:
+        assert srv.stop()
+    assert eng.blocks.num_used == 0
+
+
+def test_http_disconnect_mid_stream_aborts(model):
+    eng = _engine(model)
+    srv = serve_background(eng, model_name="tiny")
+    try:
+        for prompt, max_new in _ragged_prompts(8, seed=9):
+            _stream(srv.port, {"prompt": prompt, "max_tokens": max_new})
+        budget = dict(eng.compile_counts)
+        seen = _stream_then_disconnect(
+            srv.port, {"prompt": [2, 7, 1, 8], "max_tokens": 56}, 3)
+        assert seen >= 3
+        # the engine notices at the next step boundary and releases
+        assert _wait(lambda: eng.stats.abort_reasons.get("aborted", 0) >= 1)
+        assert _wait(lambda: not eng.has_unfinished())
+        assert eng.blocks.num_used == 0
+        eng.blocks.check_invariants()
+        assert eng.compile_counts == budget, \
+            "disconnect abort must not force a recompile"
+        # the server stays healthy for the next client
+        status, toks, finish = _stream(srv.port, {"prompt": [2, 7, 1, 8],
+                                                  "max_tokens": 6})
+        assert status == 200 and len(toks) == 6
+    finally:
+        assert srv.stop()
+
+
+def test_http_backpressure_429_and_drain_503(model):
+    eng = _engine(model, max_num_seqs=2)
+    srv = serve_background(eng, model_name="tiny", max_pending=2)
+    conns = []
+    try:
+        # saturate: two slow streams occupy the full admission bound
+        for _ in range(2):
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=120)
+            conn.request("POST", "/v1/completions",
+                         body=json.dumps({"prompt": [1, 2, 3],
+                                          "max_tokens": 50,
+                                          "stream": True}).encode(),
+                         headers={"Content-Type": "application/json"})
+            assert conn.getresponse().status == 200
+            conns.append(conn)
+        status, body = _post(srv.port, {"prompt": [9, 9], "max_tokens": 4})
+        assert status == 429
+        assert body["error"]["type"] == "overloaded"
+        _st, metrics = _get(srv.port, "/metrics")
+        assert _metric_value(metrics.decode(), "shed_total") == 1
+    finally:
+        for conn in conns:
+            conn.close()                  # disconnect-aborts the blockers
+        assert srv.stop()
+    assert eng.blocks.num_used == 0
+
+
+def test_http_healthz_and_metrics_shape(model):
+    eng = _engine(model)
+    srv = serve_background(eng, model_name="tiny")
+    try:
+        status, body = _get(srv.port, "/healthz")
+        assert status == 200 and json.loads(body)["status"] == "ok"
+        _stream(srv.port, {"prompt": [4, 4, 4], "max_tokens": 5})
+        status, metrics = _get(srv.port, "/metrics")
+        assert status == 200
+        text = metrics.decode()
+        assert "# TYPE paddle_tpu_ttft_seconds gauge" in text
+        assert _metric_value(text, "requests_finished_total") == 1
+        # first token is emitted by the prefill step; decode emits the
+        # other four
+        assert _metric_value(text, "generated_tokens_total") == 4
+        assert _metric_value(text, "kv_pages", '{state="used"}') == 0
+        assert _metric_value(
+            text, "http_requests_total",
+            '{code="200",route="/v1/completions"}') == 1
+        assert _metric_value(text, "draining") == 0
+        # 404 and 400 surfaces
+        status, _ = _get(srv.port, "/nope")
+        assert status == 404
+        status, body = _post(srv.port, {"prompt": []})
+        assert status == 400
+        assert "prompt" in body["error"]["message"]
+    finally:
+        assert srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# the ISSUE acceptance scenario
+# ---------------------------------------------------------------------------
+
+def test_acceptance_32_streams_8_disconnects_4_deadlines(model):
+    """32 concurrent streaming requests; 8 clients drop mid-stream; 4
+    carry deadlines they cannot meet while queued behind the rest; the
+    other 20 must be byte-identical to the greedy oracle.  Afterwards:
+    zero leaked KV pages, /metrics reports the 8 + 4 kills, and the
+    server drains clean."""
+    eng = _engine(model)
+    srv = serve_background(eng, model_name="tiny", max_pending=64)
+    rng = np.random.RandomState(42)
+    normal = [(rng.randint(0, VOCAB, [4, 9, 13, 21][i % 4]).tolist(),
+               int(rng.randint(4, 12))) for i in range(20)]
+    dropped = [(rng.randint(0, VOCAB, 8).tolist(), 48) for _ in range(8)]
+    doomed = [(rng.randint(0, VOCAB, 6).tolist(), 40) for _ in range(4)]
+
+    results = [None] * 20
+    drops_seen = [0] * 8
+
+    def run_normal(i):
+        prompt, max_new = normal[i]
+        results[i] = _stream(srv.port, {"prompt": prompt,
+                                        "max_tokens": max_new})
+
+    def run_drop(i):
+        drops_seen[i] = _stream_then_disconnect(
+            srv.port, {"prompt": dropped[i][0],
+                       "max_tokens": dropped[i][1]}, 2)
+
+    def run_doomed(i):
+        prompt, max_new = doomed[i]
+        # 32 submissions against a 4-slot batch: ~1 ms of budget cannot
+        # survive the queue, whatever this host's speed
+        _stream(srv.port, {"prompt": prompt, "max_tokens": max_new,
+                           "deadline_ms": 1})
+
+    threads = [threading.Thread(target=run_normal, args=(i,))
+               for i in range(20)]
+    threads += [threading.Thread(target=run_drop, args=(i,))
+                for i in range(8)]
+    threads += [threading.Thread(target=run_doomed, args=(i,))
+                for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    # every surviving output byte-identical to the oracle
+    for (prompt, max_new), (status, toks, finish) in zip(normal, results):
+        assert status == 200 and finish in ("length", "stop")
+        assert toks == _oracle(model, prompt, max_new)
+
+    assert _wait(lambda: not eng.has_unfinished())
+    assert _wait(lambda: eng.stats.aborts >= 12)
+    assert eng.stats.abort_reasons.get("aborted") == 8
+    assert eng.stats.abort_reasons.get("deadline") == 4
+
+    # zero leaked pages, invariants hold
+    assert eng.blocks.num_used == 0
+    eng.blocks.check_invariants()
+
+    # metrics report the kills
+    _st, metrics = _get(srv.port, "/metrics")
+    text = metrics.decode()
+    assert _metric_value(text, "aborts_total",
+                         '{reason="aborted"}') == 8
+    assert _metric_value(text, "aborts_total",
+                         '{reason="deadline"}') == 4
+    assert _metric_value(text, "kv_pages", '{state="used"}') == 0
+
+    # clean graceful drain
+    assert srv.stop()
+    assert eng.blocks.num_used == 0

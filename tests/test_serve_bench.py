@@ -27,6 +27,32 @@ def test_serve_bench_smoke_emits_json_line():
     assert record["p99_token_ms"] >= record["p50_token_ms"] > 0
 
 
+def test_serve_bench_http_emits_frontend_surface():
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "--smoke", "--http", "--requests", "4"],
+        capture_output=True, text=True, timeout=540,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.strip().splitlines() if ln.strip()]
+    assert lines, f"no stdout; stderr: {out.stderr[-2000:]}"
+    record = json.loads(lines[-1])
+    assert record["metric"] == "serve_http_tokens_per_s"
+    assert "error" not in record, record
+    assert record["value"] > 0
+    assert record["engine_tokens_per_s"] > 0
+    assert record["http_overhead"] > 0
+    # client-side latency surface: first token then steady-state ITL
+    assert record["ttft_p99_ms"] >= record["ttft_p50_ms"] > 0
+    assert record["itl_p99_ms"] >= record["itl_p50_ms"] > 0
+    # nothing shed or aborted on an in-budget stream, and the server
+    # must drain cleanly after the timed pass
+    assert record["aborts"] == 0
+    assert record["shed"] == 0
+    assert record["drained"] is True
+    # the protocol layer renames engine "eos" to OpenAI-style "stop"
+    assert set(record["finish_reasons"]) <= {"length", "stop"}
+
+
 def test_serve_bench_spec_emits_acceptance_surface():
     out = subprocess.run(
         [sys.executable, SCRIPT, "--smoke", "--spec", "3",
