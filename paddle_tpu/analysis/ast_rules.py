@@ -57,6 +57,8 @@ _TRANSFORM_NAMES = {
 _HOST_SYNC_ATTRS = {"item", "tolist", "numpy", "block_until_ready"}
 _COERCIONS = {"float", "int", "bool"}
 
+_ATTEN_RE = re.compile(r"atten", re.IGNORECASE)
+
 _DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([\w\-, ]+)")
 _DISABLE_NEXT_RE = re.compile(r"#\s*graftlint:\s*disable-next=([\w\-, ]+)")
 _SKIP_RE = re.compile(r"#\s*graftlint:\s*skip-file")
@@ -357,6 +359,43 @@ def lint_source(text: str, path: str = "<string>") -> list:
                          f"Python `{kind}` on traced argument {hit[0]!r} "
                          f"inside jit-compiled `{d.name}` — use "
                          "lax.cond/jnp.where")
+
+    # ---- attention-program-budget (serving tier only) --------------------
+    # The engine contract since the ragged refactor: ONE attention-bearing
+    # compiled program per engine (the ragged step).  A second jit root or
+    # pallas_call def that mentions attention in an `inference/` file is a
+    # phase-special kernel sneaking back in.
+    if "inference" in re.split(r"[\\/]", path):
+        progs = set(roots)
+        for d in ctx.defs:
+            if any(isinstance(n, ast.Call)
+                   and (_dotted(n.func) or ())[-1:] == ("pallas_call",)
+                   for n in ast.walk(d)):
+                progs.add(d)
+        # count outermost program defs only: a nested def (scan body,
+        # kernel closure) belongs to its enclosing program
+        tops = [d for d in progs
+                if not any(a in progs for a in ctx.ancestors(d))]
+
+        def _mentions_attention(d):
+            for n in ast.walk(d):
+                if isinstance(n, ast.Attribute) and _ATTEN_RE.search(n.attr):
+                    return True
+                if isinstance(n, ast.Name) and _ATTEN_RE.search(n.id):
+                    return True
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and _ATTEN_RE.search(n.name):
+                    return True
+            return False
+
+        att = sorted((d for d in tops if _mentions_attention(d)),
+                     key=lambda d: d.lineno)
+        for d in att[1:]:
+            emit("attention-program-budget", d,
+                 f"compiled def `{d.name}` is a second attention program "
+                 f"kind in the serving tier (first: `{att[0].name}`) — "
+                 "budget is 1 attention program per engine; route rows "
+                 "through the single ragged step instead")
     return findings
 
 

@@ -84,6 +84,12 @@ RULES = {
         "jax.jit created per call (immediately invoked, or built inside a "
         "loop) — a fresh cache entry every time, i.e. recompile hazard; "
         "hoist it or key it in a cache dict")),
+    "attention-program-budget": (ERROR, "ast", (
+        "a second attention-bearing compiled program (jax.jit or "
+        "pallas_call) in the inference tier — the serving engine budget "
+        "is ONE attention program kind (the ragged step); phase-special "
+        "attention kernels reintroduce bucket fragmentation and "
+        "recompiles")),
 }
 
 

@@ -27,16 +27,19 @@ block_multi_head_attention; vLLM's engine shape).  Three pieces:
   positions — so a long prompt never stalls running decodes; every
   step still runs one decode for the whole running set.
 
-- Bucketed compiled programs instead of per-request recompiles:
-    * a varlen PREFILL step for whole-prompt-from-zero batches (the
-      flash_attention_varlen segment idiom, padded to a token bucket);
-    * a CHUNKED PREFILL step for resumed/cache-hit chunks — the chunk's
-      K/V land in the paged cache first, then attention gathers each
-      sequence's pages back densely, so chunk tokens attend to the
-      cached prefix they never computed;
-    * a single-token batched DECODE step driving the paged-attention
-      kernel, padded to the max-batch bucket.
-  All thread the KV caches through with buffer donation, so the
+- ONE ragged compiled step program instead of per-phase programs
+  (arxiv 2604.15464's serving shape): every step packs its whole mix —
+  prefill chunks entering at absolute positions, resumed chunks,
+  cache-hit suffixes, single decode tokens, and k-draft verify windows
+  — as rows of flat query tokens described by ``(cu_seqlens, kv_lens,
+  block_tables)``, padded to one token bucket.  Each layer writes the
+  packed tokens' K/V into the paged cache, then one ragged
+  paged-attention launch (ops/pallas/paged_attention.py) lets every
+  token attend to its row's pages at its absolute position; a prefill
+  chunk, a decode token, and a verify window differ only in their
+  ``query_lens``.  On CPU the XLA dense-gather reference computes the
+  same masked softmax (the oracle the byte-identity tests pin).  The
+  caches thread through with buffer donation, so the
   [L, num_blocks, H_kv, bs, D] pool is updated in place on TPU instead
   of copied per step.
 
@@ -46,14 +49,16 @@ The decode math is term-for-term the math of ``_make_decode_fwd``
 tests/test_llm_engine.py + tests/test_prefix_cache.py hold the paths
 together.
 
-Speculative decoding (inference/spec_decode.py) rides the same cache: a
-host-side ``Drafter`` proposes K tokens per running sequence, a fourth
-bucketed program — VERIFY, the chunked-prefill gather math returning
-logits at EVERY position — scores all drafts in one pass, and host-side
-rejection sampling accepts a prefix (greedy output stays byte-identical
-to plain decode; sampled output follows the target distribution
-exactly).  Rejected tokens roll back via ``BlockManager.truncate``.
-Verify and plain-decode sequences share each step: per-request
+Speculative decoding (inference/spec_decode.py) rides the same cache
+and the same program: a host-side ``Drafter`` proposes K tokens per
+running sequence, the step packs each speculative sequence's
+[last_token, d_1..d_k] window as one ragged row (the program returns
+raw logits at every packed position alongside the sampled tokens), and
+host-side rejection sampling accepts a prefix (greedy output stays
+byte-identical to plain decode; sampled output follows the target
+distribution exactly).  Rejected tokens roll back via
+``BlockManager.truncate``.  Verify rows, prefill chunks, and
+plain-decode rows share each step's single launch: per-request
 ``spec_k`` opts in, and a low acceptance rate auto-disables speculation
 for that request.
 """
@@ -70,7 +75,6 @@ from jax import lax
 
 from ..models.llama import _rms_weight, _rope_positions
 from ..ops.pallas import paged_attention as _pa
-from ..ops.pallas import flash_attention_varlen as _fav
 from ..profiler import RecordEvent, ServingStats
 from .kv_cache import NULL_BLOCK, BlockManager, BlockPoolExhausted
 from .sampling import make_samp, samp_structs, sample_tokens
@@ -144,9 +148,10 @@ class LLMEngine:
     max_prefill_tokens: per-STEP prompt-token budget.  Prompts longer
         than this are prefilled in chunks across steps (decode of the
         running set proceeds every step regardless).
-    prefill_token_bucket: flat prefill buffers are padded up to a
-        multiple of this, bounding the number of prefill programs by
-        max_prefill_tokens / bucket (x the few batch buckets).
+    prefill_token_bucket: the ragged step's flat token buffer is padded
+        to max_num_seqs for decode-sized launches and to a multiple of
+        this above it, bounding the number of compiled step programs by
+        max_prefill_tokens / bucket + 1.
     enable_prefix_caching: content-hash full KV pages and reuse them
         across requests sharing a token prefix (BlockManager docstring
         has the page lifecycle).  Greedy output is byte-identical on
@@ -156,8 +161,8 @@ class LLMEngine:
         speculative decoding engine-wide.
     spec_k: default per-request draft length (requests may override via
         add_request(spec_k=); 0 means plain decode).
-    max_spec_k: hard per-round draft ceiling; fixes the verify program's
-        static token width max_num_seqs * (max_spec_k + 1).
+    max_spec_k: hard per-round draft ceiling; fixes the ragged program's
+        static logit-row width max_num_seqs * (max_spec_k + 1).
     spec_accept_floor / spec_window: once a request has sent spec_window
         drafts to verify, speculation auto-disables for it if its
         lifetime acceptance rate sits below the floor (the drafter is
@@ -222,20 +227,19 @@ class LLMEngine:
         self._arrival = 0
         self.retain_outputs = bool(retain_outputs)
 
-        # stable decode slots + persistent host-side decode buffers: rows
-        # are updated incrementally (grow/retire/CoW bump the table
-        # version) instead of rebuilt from scratch every token
+        # stable batch slots (pure-decode steps pack rows in slot order,
+        # so a steady batch keeps a stable layout) + persistent host-side
+        # buffers for the decode fast path: rows are updated
+        # incrementally (grow/retire/CoW bump the table version, any
+        # membership/order change breaks the layout signature) instead of
+        # rebuilt from scratch every token
         B = self.max_num_seqs
         self._slot_used = [False] * B
-        self._d_toks = np.zeros((B,), np.int32)
-        self._d_pos = np.zeros((B,), np.int32)
-        self._d_bt = np.full((B, self.nblk), NULL_BLOCK, np.int32)
-        self._d_samp = make_samp(B, cfg.vocab_size)
-        self._d_owner = [None] * B        # rid currently packed in each row
 
         # speculative decoding: a host-side drafter proposes up to
-        # max_spec_k tokens per decode-ready sequence; one fixed-shape
-        # verify program scores every (sequence, draft) pair per step
+        # max_spec_k tokens per decode-ready sequence; each speculative
+        # sequence rides the step's single ragged launch as one
+        # [last_token, drafts...] row
         if drafter == "ngram":
             from .spec_decode import NGramDrafter
             drafter = NGramDrafter()
@@ -244,19 +248,36 @@ class LLMEngine:
         self.max_spec_k = int(max_spec_k)
         self.spec_accept_floor = float(spec_accept_floor)
         self.spec_window = int(spec_window)
-        self._verify_Tq = B * (self.max_spec_k + 1)
+        # logit-row width of the ragged program: spec rows need k+1
+        # scored positions each; without a drafter one row == one logit.
+        # The program returns raw per-position logits (for host-side
+        # draft acceptance) only when a drafter exists.
+        self._with_logits = drafter is not None
+        self._Lq = B * (self.max_spec_k + 1) if self._with_logits else B
 
-        # program caches: compile counts == len() of these.  The counter
-        # dict is the test-visible compile-count regression guard: every
-        # program BUILD (not call) bumps its kind, so a mixed stream can
-        # assert "exactly N programs" without reaching into the caches.
-        self._decode_progs: dict = {}
-        self._prefill_progs: dict = {}
-        self._chunked_progs: dict = {}
-        self._verify_prog = None
+        # decode fast-path buffers (general mixed launches repack from
+        # scratch; steady pure-decode steps reuse these)
+        self._d_toks = np.zeros((B,), np.int32)
+        self._d_cu = np.zeros((B + 1,), np.int32)
+        self._d_kvl = np.zeros((B,), np.int32)
+        self._d_bt = np.full((B + 1, self.nblk), NULL_BLOCK, np.int32)
+        self._d_lidx = np.minimum(np.arange(self._Lq), B - 1) \
+            .astype(np.int32)
+        self._d_samp = make_samp(self._Lq, cfg.vocab_size)
+        self._d_layout: tuple = ()        # rid order last packed
+
+        # program cache: ONE attention program kind, keyed only by the
+        # flat-token bucket Tq.  The counter dict is the test-visible
+        # compile-count regression guard: every program BUILD (not call)
+        # bumps its kind, so a mixed stream can assert "exactly N
+        # programs" without reaching into the caches.
+        self._ragged_progs: dict = {}
         self._cow_prog = None
-        self.compile_counts = {"decode": 0, "prefill": 0, "chunked": 0,
-                               "verify": 0, "cow": 0}
+        self.compile_counts = {"ragged": 0, "cow": 0}
+        # padding accounting: real packed tokens vs bucket width, plus
+        # what the pre-ragged four-program engine would have padded to
+        # (serve_bench --mixed reports the two ratios side by side)
+        self.pad_stats = {"real": 0, "padded": 0, "legacy_padded": 0}
         self._evictions_seen = 0
         self.stats = ServingStats()
 
@@ -368,11 +389,15 @@ class LLMEngine:
 
     @property
     def num_decode_programs(self) -> int:
-        return len(self._decode_progs)
+        """Ragged programs at the decode-sized bucket (Tq == max_num_seqs)."""
+        return sum(1 for Tq in self._ragged_progs
+                   if Tq <= self.max_num_seqs)
 
     @property
     def num_prefill_programs(self) -> int:
-        return len(self._prefill_progs) + len(self._chunked_progs)
+        """Ragged programs at prefill-sized buckets (Tq > max_num_seqs)."""
+        return sum(1 for Tq in self._ragged_progs
+                   if Tq > self.max_num_seqs)
 
     def run(self) -> dict:
         """Drive step() until every queued request finishes.  Outputs by
@@ -409,44 +434,24 @@ class LLMEngine:
         declared = dt if np.dtype(dt).name in ("bfloat16", "float16") \
             else None
         V = self.config.vocab_size
-        Bb = self.max_num_seqs
-        Tp, Bp = self.prefill_token_bucket, 1
-        Tq, Bv = self._verify_Tq, self.max_num_seqs
+        B = self.max_num_seqs
+        # representative token bucket: the smallest prefill-sized launch
+        # (every other bucket traces the same fn at another Tq)
+        Tq = max(self.prefill_token_bucket, B)
 
-        dec_fn, dec_donate = self._make_decode_fn(Bb)
-        pre_fn, pre_donate = self._make_prefill_fn(Tp, Bp)
-        chk_fn, chk_donate = self._make_chunked_fn(Tp, Bp)
-        ver_fn, ver_donate = self._make_verify_fn(Tq, Bv)
+        rag_fn, rag_donate = self._make_ragged_fn(Tq)
         cow_fn, cow_donate = self._make_cow_fn()
 
         def seqs(n):      # [n] i32 token/pos/index vectors
             return sds((n,), i32)
 
-        bt = sds((Bp + 1, self.nblk), i32)
         return [
             ProgramSpec(
-                "serving.decode", dec_fn,
-                (params, kc, vc, seqs(Bb), seqs(Bb),
-                 sds((Bb, self.nblk), i32), samp_structs(Bb, V)),
-                donate_argnums=dec_donate, declared_dtype=declared,
-                large_bytes=large_bytes),
-            ProgramSpec(
-                "serving.prefill", pre_fn,
-                (params, kc, vc, seqs(Tp), seqs(Tp), seqs(Tp), bt,
-                 seqs(Bp + 1), seqs(Bp), samp_structs(Bp, V)),
-                donate_argnums=pre_donate, declared_dtype=declared,
-                large_bytes=large_bytes),
-            ProgramSpec(
-                "serving.chunked_prefill", chk_fn,
-                (params, kc, vc, seqs(Tp), seqs(Tp), seqs(Tp), bt,
-                 seqs(Bp), samp_structs(Bp, V)),
-                donate_argnums=chk_donate, declared_dtype=declared,
-                large_bytes=large_bytes),
-            ProgramSpec(
-                "serving.verify", ver_fn,
-                (params, kc, vc, seqs(Tq), seqs(Tq), seqs(Tq),
-                 sds((Bv + 1, self.nblk), i32)),
-                donate_argnums=ver_donate, declared_dtype=declared,
+                "serving.ragged_step", rag_fn,
+                (params, kc, vc, seqs(Tq), seqs(B + 1), seqs(B),
+                 sds((B + 1, self.nblk), i32), seqs(self._Lq),
+                 samp_structs(self._Lq, V)),
+                donate_argnums=rag_donate, declared_dtype=declared,
                 large_bytes=large_bytes),
             ProgramSpec(
                 "serving.cow_copy", cow_fn,
@@ -466,7 +471,8 @@ class LLMEngine:
                 and req.cached == len(req.prompt) + len(req.generated) - 1)
 
     def step(self) -> list:
-        """One engine iteration: admit -> chunked prefill -> decode ->
+        """One engine iteration: admit -> schedule (prefill chunks +
+        verify windows + decode tokens) -> ONE ragged launch -> apply ->
         retire.  Returns the requests that finished during this step."""
         finished = []
 
@@ -478,95 +484,96 @@ class LLMEngine:
             + len(self._waiting))
 
         chunks = self._schedule_prefill_chunks()
-        emitted_now = set()
-        if chunks:
-            t0 = time.perf_counter()
-            with RecordEvent("llm_engine.prefill"):
-                first = self._run_prefill(chunks)
-            dur = time.perf_counter() - t0
-            done = [(req, tok) for (req, n), tok in zip(chunks, first)
-                    if req.cached + n == len(req.tokens)]
-            self.stats.record_prefill(
-                dur, sum(n for _, n in chunks), len(done))
-            for req, n in chunks:
-                req.cached += n
-                if self.enable_prefix_caching:
-                    self.blocks.commit_prefill(req.rid, n)
-            for req, tok in done:
-                req.generated.append(int(tok))
-                if req.seen is not None:
-                    req.seen[int(tok)] = True
-                emitted_now.add(id(req))
-                if len(req.generated) == 1:
-                    self.stats.record_ttft(
-                        time.perf_counter() - req.t_arrival)
-                self._notify_tokens(req, (tok,))
-                self._maybe_retire(req, finished)
 
-        # decode everyone already in the batch (sequences that finished
-        # prefill THIS step already produced their token above; sequences
-        # still mid-prefill are not decode-ready yet)
-        batch = [r for r in self._running
-                 if id(r) not in emitted_now and self._decode_ready(r)]
-
-        # speculative sequences verify first (the drafter proposed for
-        # them); everything else plain-decodes in the same step
+        # decode-ready set (chunk owners are still mid-prefill, so the
+        # row classes are disjoint by construction)
+        batch = [r for r in self._running if self._decode_ready(r)]
+        # speculative sequences pack a [last_token, drafts...] window;
+        # everything else packs a single decode token in the same launch
         spec, batch = self._split_spec(batch)
         spec, demoted = self._reserve_verify_pages(spec)
         batch.extend(demoted)
-        if spec:
-            # fold the non-speculating decode-ready sequences into the
-            # SAME verify launch as zero-draft rows (one packed token ->
-            # one emitted token): the step issues one program instead of
-            # a verify plus a decode, which is where speculation's
-            # launch-count savings actually land
-            batch = [r for r in batch
-                     if r in self._running and self._decode_ready(r)]
-            folded = self._reserve_decode_pages(batch)
-            # reserving the folded rows can preempt a verify member —
-            # drop any such casualty before packing the launch
-            spec = [(r, d, q) for (r, d, q) in spec if r in self._running]
-            spec.extend((r, [], None) for r in folded)
-            batch = []
-        if spec:
-            t0 = time.perf_counter()
-            with RecordEvent("llm_engine.verify"):
-                per_seq_logits = self._run_verify(spec)
-            dur = time.perf_counter() - t0
-            n_emitted = 0
-            for (req, drafts, qd), lg in zip(spec, per_seq_logits):
-                n_emitted += self._apply_spec_result(req, drafts, qd, lg,
-                                                     finished)
-            self.stats.record_verify(
-                dur, n_emitted, len(self._running) / self.max_num_seqs)
-
         # verify reservation/CoW may have preempted plain-decode members
         batch = [r for r in batch
                  if r in self._running and self._decode_ready(r)]
         batch = self._reserve_decode_pages(batch)
-        if batch:
+        # every reservation above can preempt a chunk owner or an
+        # already-reserved row: re-filter each class against the
+        # surviving running set before packing the launch
+        chunks = [(r, n) for r, n in chunks if r in self._running]
+        spec = [(r, d, q) for r, d, q in spec if r in self._running]
+        batch = [r for r in batch if r in self._running]
+        batch.sort(key=lambda r: r.slot)
+
+        if chunks or spec or batch:
             t0 = time.perf_counter()
-            with RecordEvent("llm_engine.decode"):
-                toks = self._run_decode(batch)
+            with RecordEvent("llm_engine.ragged_step"):
+                sampled, spec_logits, chunk_slots, batch_slots = \
+                    self._run_ragged(chunks, spec, batch)
             dur = time.perf_counter() - t0
-            self.stats.record_decode(
-                dur, len(batch), len(self._running) / self.max_num_seqs)
-            for req, tok in zip(batch, toks):
-                if self.enable_prefix_caching:
-                    self.blocks.commit_decode_token(req.rid,
-                                                    req.generated[-1])
-                req.cached += 1
-                req.generated.append(int(tok))
-                if req.seen is not None:
-                    req.seen[int(tok)] = True
-                self._notify_tokens(req, (tok,))
-                self._maybe_retire(req, finished)
+            self._apply_ragged(chunks, spec, batch, sampled, spec_logits,
+                               chunk_slots, batch_slots, dur, finished)
 
         ev = self.blocks.eviction_count
         if ev != self._evictions_seen:
             self.stats.record_evictions(ev - self._evictions_seen)
             self._evictions_seen = ev
         return finished
+
+    def _apply_ragged(self, chunks, spec, batch, sampled, spec_logits,
+                      chunk_slots, batch_slots, dur, finished):
+        """Advance every packed row from the launch's outputs: chunk rows
+        commit their prefix (emitting a first token when the prompt
+        completes), spec rows run host-side draft acceptance, decode rows
+        emit one token.  The launch duration splits across the stats
+        channels pro-rata by packed tokens."""
+        chunk_tokens = sum(n for _, n in chunks)
+        spec_tokens = sum(len(d) + 1 for _, d, _ in spec)
+        total = max(chunk_tokens + spec_tokens + len(batch), 1)
+        occ = len(self._running) / self.max_num_seqs
+
+        done = 0
+        for (req, n), s in zip(chunks, chunk_slots):
+            req.cached += n
+            if self.enable_prefix_caching:
+                self.blocks.commit_prefill(req.rid, n)
+            if req.cached == len(req.tokens):
+                done += 1
+                tok = int(sampled[s])
+                req.generated.append(tok)
+                if req.seen is not None:
+                    req.seen[tok] = True
+                if len(req.generated) == 1:
+                    self.stats.record_ttft(
+                        time.perf_counter() - req.t_arrival)
+                self._notify_tokens(req, (tok,))
+                self._maybe_retire(req, finished)
+        if chunks:
+            self.stats.record_prefill(dur * chunk_tokens / total,
+                                      chunk_tokens, done)
+
+        if spec:
+            n_emitted = 0
+            for (req, drafts, qd), lg in zip(spec, spec_logits):
+                n_emitted += self._apply_spec_result(req, drafts, qd, lg,
+                                                     finished)
+            self.stats.record_verify(dur * spec_tokens / total,
+                                     n_emitted, occ)
+
+        for req, s in zip(batch, batch_slots):
+            if self.enable_prefix_caching:
+                self.blocks.commit_decode_token(req.rid,
+                                                req.generated[-1])
+            req.cached += 1
+            tok = int(sampled[s])
+            req.generated.append(tok)
+            if req.seen is not None:
+                req.seen[tok] = True
+            self._notify_tokens(req, (tok,))
+            self._maybe_retire(req, finished)
+        if batch:
+            self.stats.record_decode(dur * len(batch) / total,
+                                     len(batch), occ)
 
     def _claim_slot(self, req) -> None:
         req.slot = self._slot_used.index(False)
@@ -798,111 +805,6 @@ class LLMEngine:
             ok.append((req, drafts, qd))
         return ok, demoted
 
-    def _get_verify_prog(self):
-        if self._verify_prog is None:
-            run, donate = self._make_verify_fn(self._verify_Tq,
-                                               self.max_num_seqs)
-            if jax.default_backend() == "cpu":
-                donate = ()
-            self._verify_prog = jax.jit(run, donate_argnums=donate)
-            self.compile_counts["verify"] += 1
-        return self._verify_prog
-
-    def _make_verify_fn(self, Tq: int, Bv: int):
-        """The chunked-prefill gather math, returning raw f32 logits at
-        EVERY packed position instead of sampling the last token of each
-        sequence: row i scores the token AFTER packed token i, which is
-        exactly the target distribution the i-th draft must survive.
-        Sampling happens on host (spec_decode.verify_and_accept) because
-        acceptance is sequential in i — draft i conditions on drafts
-        < i being accepted.  One fixed (Tq, Bv) bucket keeps the compile
-        count at 1."""
-        nh, kvh, d = self._nh, self._kvh, self._hd
-        bs = self.block_size
-        nblk = self.nblk
-        S = nblk * bs
-        eps = self.config.rms_norm_eps
-        theta = self.config.rope_theta
-        sm_scale = 1.0 / (d ** 0.5)
-
-        def run(params, kc, vc, toks, seg, rel, bt):
-            # toks/seg/rel [Tq] int32 (pads: seg == Bv -> the null row of
-            # bt); rel is each token's absolute position; bt [Bv+1, nblk].
-            x = jnp.take(params["embed"], toks, axis=0)       # [Tq, H]
-            keypos = jnp.arange(S, dtype=jnp.int32)
-
-            def body(x, inp):
-                p, kcl, vcl = inp
-                h = _rms_weight(x, p["ln1"], eps)
-                q = (h @ p["wq"]).reshape(Tq, nh, d)
-                k = (h @ p["wk"]).reshape(Tq, kvh, d)
-                v = (h @ p["wv"]).reshape(Tq, kvh, d)
-                q = _rope_positions(q, rel, theta)
-                k = _rope_positions(k, rel, theta)
-                blk = bt[seg, rel // bs]                      # [Tq]
-                slot = rel % bs
-                kcl = kcl.at[blk, :, slot, :].set(k.astype(kcl.dtype))
-                vcl = vcl.at[blk, :, slot, :].set(v.astype(vcl.dtype))
-                kg = kcl[bt].transpose(0, 1, 3, 2, 4) \
-                    .reshape(Bv + 1, S, kvh, d)
-                vg = vcl[bt].transpose(0, 1, 3, 2, 4) \
-                    .reshape(Bv + 1, S, kvh, d)
-                kq = kg[seg]                                  # [Tq, S, kvh, d]
-                vq = vg[seg]
-                if kvh != nh:
-                    kq = jnp.repeat(kq, nh // kvh, axis=2)
-                    vq = jnp.repeat(vq, nh // kvh, axis=2)
-                sc = jnp.einsum("qhd,qshd->qhs", q.astype(jnp.float32),
-                                kq.astype(jnp.float32)) * sm_scale
-                mask = keypos[None, None, :] <= rel[:, None, None]
-                sc = jnp.where(mask, sc, -jnp.inf)
-                pr = jax.nn.softmax(sc, axis=-1)
-                att = jnp.einsum("qhs,qshd->qhd", pr,
-                                 vq.astype(jnp.float32)).astype(x.dtype)
-                x = x + att.reshape(Tq, nh * d) @ p["wo"]
-                h2 = _rms_weight(x, p["ln2"], eps)
-                a = jax.nn.silu((h2 @ p["gate"]).astype(jnp.float32)
-                                ).astype(h2.dtype) * (h2 @ p["up"])
-                return x + a @ p["down"], (kcl, vcl)
-
-            x, (kc, vc) = lax.scan(body, x, (params["layers"], kc, vc))
-            h = _rms_weight(x, params["norm_f"], eps)
-            logits = (h.astype(jnp.float32)
-                      @ params["head"].astype(jnp.float32))   # [Tq, V]
-            return logits, kc, vc
-
-        return run, (1, 2)
-
-    def _run_verify(self, spec: list):
-        """Pack every speculative sequence's [last_generated, d_1..d_k]
-        window into one verify call; returns each sequence's [k+1, V]
-        logits slice (position cached+i scores the token after draft i)."""
-        Tq, Bv = self._verify_Tq, self.max_num_seqs
-        toks = np.zeros((Tq,), np.int32)
-        seg = np.full((Tq,), Bv, np.int32)            # pads -> sentinel
-        rel = np.zeros((Tq,), np.int32)
-        bt = np.full((Bv + 1, self.nblk), NULL_BLOCK, np.int32)
-        slices = []
-        off = 0
-        for i, (req, drafts, _) in enumerate(spec):
-            w = [req.generated[-1]] + drafts
-            n = len(w)
-            toks[off:off + n] = w
-            seg[off:off + n] = i
-            rel[off:off + n] = np.arange(req.cached, req.cached + n)
-            bt[i] = self.blocks.padded_table(req.rid, self.nblk)
-            slices.append((off, n))
-            off += n
-        prog = self._get_verify_prog()
-        logits, self._kc, self._vc = prog(self.params, self._kc, self._vc,
-                                          toks, seg, rel, bt)
-        logits = np.asarray(logits)
-        # every sequence's table was (re)packed fresh above, and the
-        # post-verify truncate changes it again — force decode repacks
-        for req, _, _ in spec:
-            req.bt_version = -1
-        return [logits[o:o + n] for o, n in slices]
-
     def _apply_spec_result(self, req, drafts, qd, lg, finished) -> int:
         """Turn one sequence's verify logits into emitted tokens: run
         rejection-sampling acceptance, commit the accepted prefix's K/V,
@@ -993,69 +895,91 @@ class LLMEngine:
             self._kc, self._vc, np.int32(src), np.int32(dst))
 
     # ------------------------------------------------------------------
-    # compiled decode step
+    # the compiled ragged step
     # ------------------------------------------------------------------
 
-    def _decode_bucket(self, n: int) -> int:
-        # one bucket: the full batch width.  Padding decode to max_num_seqs
-        # costs little (one token per slot) and pins the compile count at 1.
-        return self.max_num_seqs
+    def _ragged_bucket(self, n_tokens: int) -> int:
+        """Flat-token bucket for a launch: pure-decode-sized launches pad
+        to max_num_seqs; with a drafter, speculation-sized launches (every
+        running row carrying a full draft) stop at the static logit-row
+        width max_num_seqs * (max_spec_k + 1) when that sits below the
+        prefill bucket — otherwise a verify round of B*(k+1) rows would
+        pad all the way up to prefill_token_bucket every step; anything
+        larger rounds up to a multiple of prefill_token_bucket.  The
+        tiers bound the program count at 2 + (max launch size) / bucket."""
+        if n_tokens <= self.max_num_seqs:
+            return self.max_num_seqs
+        if self._with_logits and \
+                n_tokens <= self._Lq < self.prefill_token_bucket:
+            return self._Lq
+        tb = self.prefill_token_bucket
+        return -(-n_tokens // tb) * tb
 
-    def _get_decode_prog(self, Bb: int):
-        key = (Bb, self.nblk)
-        prog = self._decode_progs.get(key)
+    def _get_ragged_prog(self, Tq: int):
+        prog = self._ragged_progs.get(Tq)
         if prog is None:
-            prog = self._build_decode(Bb)
-            self._decode_progs[key] = prog
-            self.compile_counts["decode"] += 1
+            run, donate = self._make_ragged_fn(Tq)
+            if jax.default_backend() == "cpu":
+                donate = ()
+            prog = jax.jit(run, donate_argnums=donate)
+            self._ragged_progs[Tq] = prog
+            self.compile_counts["ragged"] += 1
         return prog
 
-    def _build_decode(self, Bb: int):
-        run, donate = self._make_decode_fn(Bb)
-        if jax.default_backend() == "cpu":
-            donate = ()
-        return jax.jit(run, donate_argnums=donate)
-
-    def _make_decode_fn(self, Bb: int):
+    def _make_ragged_fn(self, Tq: int):
+        """The one serving step program: Tq flat query tokens from up to
+        max_num_seqs ragged rows.  A prefill chunk, a resumed chunk, a
+        decode token, and a k-draft verify window are all rows of the
+        same launch, differing only in query length — each layer writes
+        the packed tokens' K/V into the paged cache at their absolute
+        positions, then ragged paged attention lets every token attend
+        to its own row's pages causally.  Sampled tokens come back for
+        the logit rows in ``lidx``; with a drafter the raw [Lq, V]
+        logits ride along for host-side draft acceptance."""
         nh, kvh, d = self._nh, self._kvh, self._hd
         bs = self.block_size
+        B = self.max_num_seqs
+        with_logits = self._with_logits
         eps = self.config.rms_norm_eps
         theta = self.config.rope_theta
         dt = self.params["embed"].dtype
-        # the interpreted kernel costs a Python step per (B, H_kv, nblk)
-        # grid cell EVERY decode — serving on CPU uses the XLA reference
+        # the interpreted kernel costs a Python step per (Tq, H_kv, nblk)
+        # grid cell EVERY launch — serving on CPU uses the XLA reference
         # path (term-identical math) unless a test forces the interpreter
         use_pallas = _pa.INTERPRET is True or (
             jax.default_backend() == "tpu"
-            and _pa.supports(Bb, nh, kvh, d, bs, self.nblk, dt))
+            and _pa.ragged_supports(Tq, nh, kvh, d, bs, B + 1,
+                                    self.nblk, dt))
 
-        def run(params, kc, vc, toks, pos, bt, samp):
-            # toks/pos [Bb] int32; bt [Bb, nblk] int32; samp is the
-            # sampling.make_samp pytree of per-row parameters.  pos is the
-            # cache position the fresh token's K/V lands in; attention
-            # covers pos+1 entries.
-            x = jnp.take(params["embed"], toks, axis=0)       # [Bb, H]
+        def run(params, kc, vc, toks, cu, kvl, bt, lidx, samp):
+            # toks [Tq] i32, rows packed back-to-back (tail padding maps
+            # to the sentinel row); cu [B+1] i32 row offsets; kvl [B] i32
+            # valid KV per row AFTER this launch's writes; bt [B+1, nblk]
+            # i32 (row B: the null row pads resolve to); lidx [Lq] i32
+            # flat index of each logit row; samp the make_samp pytree,
+            # one row per logit row.
+            seg, rel = _pa.ragged_segments(cu, kvl, Tq)
+            x = jnp.take(params["embed"], toks, axis=0)       # [Tq, H]
 
             def body(x, inp):
                 p, kcl, vcl = inp
                 h = _rms_weight(x, p["ln1"], eps)
-                q = (h @ p["wq"]).reshape(Bb, nh, d)
-                k = (h @ p["wk"]).reshape(Bb, kvh, d)
-                v = (h @ p["wv"]).reshape(Bb, kvh, d)
-                q = _rope_positions(q, pos, theta)
-                k = _rope_positions(k, pos, theta)
-                blk = jnp.take_along_axis(bt, (pos // bs)[:, None],
-                                          axis=1)[:, 0]
-                slot = pos % bs
+                q = (h @ p["wq"]).reshape(Tq, nh, d)
+                k = (h @ p["wk"]).reshape(Tq, kvh, d)
+                v = (h @ p["wv"]).reshape(Tq, kvh, d)
+                q = _rope_positions(q, rel, theta)
+                k = _rope_positions(k, rel, theta)
+                blk = bt[seg, rel // bs]                      # [Tq]
+                slot = rel % bs
                 kcl = kcl.at[blk, :, slot, :].set(k.astype(kcl.dtype))
                 vcl = vcl.at[blk, :, slot, :].set(v.astype(vcl.dtype))
                 if use_pallas:
-                    att = _pa.paged_decode_attention(q, kcl, vcl, bt,
-                                                     pos + 1)
+                    att = _pa.ragged_paged_attention_segrel(
+                        q, kcl, vcl, bt, seg, rel)
                 else:
-                    att = _pa.paged_decode_reference(q, kcl, vcl, bt,
-                                                     pos + 1)
-                x = x + att.reshape(Bb, nh * d) @ p["wo"]
+                    att = _pa.ragged_paged_reference_segrel(
+                        q, kcl, vcl, bt, seg, rel)
+                x = x + att.reshape(Tq, nh * d) @ p["wo"]
                 h2 = _rms_weight(x, p["ln2"], eps)
                 a = jax.nn.silu((h2 @ p["gate"]).astype(jnp.float32)
                                 ).astype(h2.dtype) * (h2 @ p["up"])
@@ -1063,58 +987,173 @@ class LLMEngine:
 
             x, (kc, vc) = lax.scan(body, x, (params["layers"], kc, vc))
             h = _rms_weight(x, params["norm_f"], eps)
-            logits = (h.astype(jnp.float32)
-                      @ params["head"].astype(jnp.float32))
-            return sample_tokens(logits, samp), kc, vc
+            hsel = h[lidx]                                    # [Lq, H]
+            logits = (hsel.astype(jnp.float32)
+                      @ params["head"].astype(jnp.float32))   # [Lq, V]
+            sampled = sample_tokens(logits, samp)
+            if with_logits:
+                return sampled, logits, kc, vc
+            return sampled, kc, vc
 
-        # donation reuses the pool buffers in place; _build_decode drops
-        # it on CPU (that runtime cannot alias and would warn every call)
+        # donation reuses the pool buffers in place; _get_ragged_prog
+        # drops it on CPU (that runtime cannot alias and warns per call)
         return run, (1, 2)
 
-    def _run_decode(self, batch: list):
-        Bb = self._decode_bucket(len(batch))
-        prog = self._get_decode_prog(Bb)
-        # incremental host-side batch assembly over stable slots: only
-        # rows whose sequence grew/CoW'd (table version bump) repack the
-        # [nblk] block table; empty slots are nulled once on transition
-        cur = {req.slot: req for req in batch}
+    def _launch_ragged(self, Tq, toks, cu, kvl, bt, lidx, samp,
+                       real_tokens):
+        self.pad_stats["real"] += int(real_tokens)
+        self.pad_stats["padded"] += int(Tq)
+        prog = self._get_ragged_prog(Tq)
+        if self._with_logits:
+            sampled, logits, self._kc, self._vc = prog(
+                self.params, self._kc, self._vc, toks, cu, kvl, bt,
+                lidx, samp)
+        else:
+            sampled, self._kc, self._vc = prog(
+                self.params, self._kc, self._vc, toks, cu, kvl, bt,
+                lidx, samp)
+            logits = None
+        return sampled, logits
+
+    def _fill_samp(self, samp, s, req):
+        samp["temps"][s] = req.temperature
+        samp["top_k"][s] = req.top_k
+        samp["top_p"][s] = req.top_p
+        samp["penalty"][s] = req.repetition_penalty
+        if req.seen is not None:
+            np.copyto(samp["seen"][s], req.seen)
+        if req.temperature > 0.0:
+            # greedy rows never touch their key: an all-greedy launch
+            # skips per-step key derivation entirely
+            samp["keys"][s] = self._req_key(req)
+
+    def _run_ragged(self, chunks: list, spec: list, batch: list):
+        """Pack this step's whole mix as ONE ragged launch.
+
+        Row order: prefill chunks (scheduler order), speculative
+        [last_token, drafts...] windows, plain decode tokens (slot
+        order).  Returns (sampled tokens, per-spec-row logits, chunk
+        logit slots, decode logit slots)."""
+        total = sum(n for _, n in chunks) \
+            + sum(len(d) + 1 for _, d, _ in spec) + len(batch)
+        Tq = self._ragged_bucket(total)
+
+        # decode fast path: steady pure-decode steps reuse the
+        # persistent host buffers instead of repacking from scratch
+        if not chunks and not spec:
+            return self._run_ragged_decode(batch, Tq)
+
+        rows = [(req, req.tokens[req.cached:req.cached + n], "c")
+                for req, n in chunks]
+        rows += [(req, [req.generated[-1]] + list(d), "s")
+                 for req, d, _ in spec]
+        rows += [(req, [req.generated[-1]], "d") for req in batch]
+
+        B = self.max_num_seqs
+        toks = np.zeros((Tq,), np.int32)
+        cu = np.zeros((B + 1,), np.int32)
+        kvl = np.zeros((B,), np.int32)
+        bt = np.full((B + 1, self.nblk), NULL_BLOCK, np.int32)
+        lidx = np.zeros((self._Lq,), np.int32)
+        samp = make_samp(self._Lq, self.config.vocab_size)
+        spec_slices, chunk_slots, batch_slots = [], [], []
+
+        off = 0      # flat-token cursor
+        ls = 0       # logit-row cursor
+        for i, (req, window, kind) in enumerate(rows):
+            n = len(window)
+            toks[off:off + n] = window
+            cu[i + 1] = off + n
+            kvl[i] = req.cached + n
+            bt[i] = self.blocks.padded_table(req.rid, self.nblk)
+            if kind == "s":
+                # every window position is scored; acceptance is
+                # sequential on host, so the device-sampled rows for
+                # these slots go unused (samp defaults)
+                lidx[ls:ls + n] = np.arange(off, off + n)
+                spec_slices.append((ls, n))
+                ls += n
+            else:
+                lidx[ls] = off + n - 1
+                self._fill_samp(samp, ls, req)
+                (chunk_slots if kind == "c" else batch_slots).append(ls)
+                ls += 1
+            off += n
+        cu[len(rows) + 1:] = off
+
+        # padding a four-program step would have cost: a token-bucketed
+        # chunk launch, plus the full-width verify launch when anything
+        # speculates (folding decode rows), else the decode bucket
+        tb = self.prefill_token_bucket
+        ct = sum(n for _, n in chunks)
+        legacy = max(tb, -(-ct // tb) * tb) if ct else 0
+        if spec:
+            legacy += B * (self.max_spec_k + 1)
+        elif batch:
+            legacy += B
+        self.pad_stats["legacy_padded"] += legacy
+
+        # the launch (re)packed every row's table fresh, and post-verify
+        # truncate changes tables again — break the decode fast path's
+        # layout reuse and force per-row repacks next step
+        for req, _, _ in rows:
+            req.bt_version = -1
+        self._d_layout = ()
+
+        sampled, logits = self._launch_ragged(Tq, toks, cu, kvl, bt,
+                                              lidx, samp, total)
+        spec_logits = None
+        if spec:
+            logits = np.asarray(logits)
+            spec_logits = [logits[o:o + n] for o, n in spec_slices]
+        return np.asarray(sampled), spec_logits, chunk_slots, batch_slots
+
+    def _run_ragged_decode(self, batch: list, Tq: int):
+        """Pure-decode launch over the persistent host buffers.  Rows
+        repack incrementally ONLY while the layout signature — the rid
+        order of the packed rows — is unchanged since the last pure-
+        decode step; retirement, admission, preemption, or any mixed
+        launch in between changes the signature and forces a full
+        repack, so ragged packing never reuses a stale row order.
+        Within a stable layout, block-table rows still refresh whenever
+        the sequence's table version bumped (page growth/CoW)."""
+        n = len(batch)
         samp = self._d_samp
-        for s in range(Bb):
-            if self._d_owner[s] is not None and s not in cur:
-                self._d_bt[s].fill(NULL_BLOCK)
-                self._d_toks[s] = 0
-                self._d_pos[s] = 0
-                samp["temps"][s] = 0.0
-                samp["top_k"][s] = 0
-                samp["top_p"][s] = 1.0
-                samp["penalty"][s] = 1.0
-                samp["seen"][s] = False
-                self._d_owner[s] = None
-        for s, req in cur.items():
-            if self._d_owner[s] != req.rid:
-                self._d_owner[s] = req.rid
+        layout = tuple(r.rid for r in batch)
+        if layout != self._d_layout:
+            self._d_layout = layout
+            self._d_bt[:] = NULL_BLOCK
+            self._d_kvl[:] = 0
+            self._d_cu[:n + 1] = np.arange(n + 1)
+            self._d_cu[n + 1:] = n
+            samp["temps"][:] = 0.0
+            samp["top_k"][:] = 0
+            samp["top_p"][:] = 1.0
+            samp["penalty"][:] = 1.0
+            samp["seen"][:] = False
+            for s, req in enumerate(batch):
                 samp["temps"][s] = req.temperature
                 samp["top_k"][s] = req.top_k
                 samp["top_p"][s] = req.top_p
                 samp["penalty"][s] = req.repetition_penalty
-                req.bt_version = -1          # force a row repack
+                req.bt_version = -1          # force a table repack below
+        for s, req in enumerate(batch):
             self._d_toks[s] = req.generated[-1]
-            self._d_pos[s] = req.cached
+            self._d_kvl[s] = req.cached + 1
             ver = self.blocks.table_version(req.rid)
             if req.bt_version != ver:
-                self._d_bt[s] = self.blocks.padded_table(req.rid, self.nblk)
+                self._d_bt[s] = self.blocks.padded_table(req.rid,
+                                                         self.nblk)
                 req.bt_version = ver
             if req.seen is not None:
                 np.copyto(samp["seen"][s], req.seen)
             if req.temperature > 0.0:
-                # greedy rows never touch their key: an all-greedy batch
-                # skips per-step key derivation entirely
                 samp["keys"][s] = self._req_key(req)
-        out, self._kc, self._vc = prog(self.params, self._kc, self._vc,
-                                       self._d_toks, self._d_pos,
-                                       self._d_bt, samp)
-        out = np.asarray(out)
-        return [out[req.slot] for req in batch]
+        self.pad_stats["legacy_padded"] += self.max_num_seqs
+        sampled, _ = self._launch_ragged(Tq, self._d_toks, self._d_cu,
+                                         self._d_kvl, self._d_bt,
+                                         self._d_lidx, samp, n)
+        return np.asarray(sampled), None, [], list(range(n))
 
     def _req_key(self, req):
         # key for token i of request r depends only on (seed, i): sampling
@@ -1123,230 +1162,6 @@ class LLMEngine:
                                  len(req.generated))
         return np.asarray(key, np.uint32)
 
-    # ------------------------------------------------------------------
-    # compiled prefill steps
-    # ------------------------------------------------------------------
-
-    def _prefill_buckets(self, n_tokens: int, n_seqs: int):
-        tb = self.prefill_token_bucket
-        Tp = max(tb, -(-n_tokens // tb) * tb)
-        Bp = min(_next_pow2(max(n_seqs, 1)), self.max_num_seqs)
-        Bp = max(Bp, 1)
-        return Tp, Bp
-
-    def _get_prefill_prog(self, Tp: int, Bp: int):
-        key = (Tp, Bp)
-        prog = self._prefill_progs.get(key)
-        if prog is None:
-            prog = self._build_prefill(Tp, Bp)
-            self._prefill_progs[key] = prog
-            self.compile_counts["prefill"] += 1
-        return prog
-
-    def _get_chunked_prog(self, Tp: int, Bp: int):
-        key = (Tp, Bp)
-        prog = self._chunked_progs.get(key)
-        if prog is None:
-            prog = self._build_prefill_chunked(Tp, Bp)
-            self._chunked_progs[key] = prog
-            self.compile_counts["chunked"] += 1
-        return prog
-
-    def _build_prefill(self, Tp: int, Bp: int):
-        run, donate = self._make_prefill_fn(Tp, Bp)
-        if jax.default_backend() == "cpu":
-            donate = ()
-        return jax.jit(run, donate_argnums=donate)
-
-    def _make_prefill_fn(self, Tp: int, Bp: int):
-        nh, kvh, d = self._nh, self._kvh, self._hd
-        bs = self.block_size
-        eps = self.config.rms_norm_eps
-        theta = self.config.rope_theta
-        sm_scale = 1.0 / (d ** 0.5)
-        # the varlen flash kernel wants TPU (or its own interpret flag),
-        # packed MHA [T, H, D]; otherwise a dense segment-masked f32
-        # composition computes the same masked softmax
-        probe = jnp.zeros((Tp, nh, d), self.params["embed"].dtype)
-        probe_k = jnp.zeros((Tp, kvh, d), self.params["embed"].dtype)
-        use_varlen = bool(_fav.use_varlen_flash(probe, probe_k, True))
-
-        def attend(q, k, v, seg, rel, cu):
-            if use_varlen:
-                return _fav._varlen_attention(True, sm_scale, q, k, v,
-                                              cu, cu)
-            if kvh != nh:
-                k = jnp.repeat(k, nh // kvh, axis=1)
-                v = jnp.repeat(v, nh // kvh, axis=1)
-            sc = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
-                            k.astype(jnp.float32)) * sm_scale
-            mask = (seg[None, :, None] == seg[None, None, :]) \
-                & (rel[None, None, :] <= rel[None, :, None])
-            sc = jnp.where(mask, sc, -jnp.inf)
-            pr = jax.nn.softmax(sc, axis=-1)
-            out = jnp.einsum("hqk,khd->qhd", pr, v.astype(jnp.float32))
-            return out.astype(q.dtype)
-
-        def run(params, kc, vc, toks, seg, rel, bt, cu, last_idx, samp):
-            # toks/seg/rel [Tp] int32 (pads carry seg == Bp, a row of the
-            # null page in bt); bt [Bp+1, nblk]; cu [Bp+1] varlen offsets;
-            # last_idx [Bp] flat index of each sequence's final token;
-            # samp is the make_samp pytree, one row per sequence.
-            x = jnp.take(params["embed"], toks, axis=0)       # [Tp, H]
-
-            def body(x, inp):
-                p, kcl, vcl = inp
-                h = _rms_weight(x, p["ln1"], eps)
-                q = (h @ p["wq"]).reshape(Tp, nh, d)
-                k = (h @ p["wk"]).reshape(Tp, kvh, d)
-                v = (h @ p["wv"]).reshape(Tp, kvh, d)
-                q = _rope_positions(q, rel, theta)
-                k = _rope_positions(k, rel, theta)
-                blk = bt[seg, rel // bs]                      # [Tp]
-                slot = rel % bs
-                kcl = kcl.at[blk, :, slot, :].set(k.astype(kcl.dtype))
-                vcl = vcl.at[blk, :, slot, :].set(v.astype(vcl.dtype))
-                att = attend(q, k, v, seg, rel, cu)
-                x = x + att.reshape(Tp, nh * d) @ p["wo"]
-                h2 = _rms_weight(x, p["ln2"], eps)
-                a = jax.nn.silu((h2 @ p["gate"]).astype(jnp.float32)
-                                ).astype(h2.dtype) * (h2 @ p["up"])
-                return x + a @ p["down"], (kcl, vcl)
-
-            x, (kc, vc) = lax.scan(body, x, (params["layers"], kc, vc))
-            h = _rms_weight(x, params["norm_f"], eps)
-            hsel = h[last_idx]                                # [Bp, H]
-            logits = (hsel.astype(jnp.float32)
-                      @ params["head"].astype(jnp.float32))
-            return sample_tokens(logits, samp), kc, vc
-
-        return run, (1, 2)
-
-    def _build_prefill_chunked(self, Tp: int, Bp: int):
-        run, donate = self._make_chunked_fn(Tp, Bp)
-        if jax.default_backend() == "cpu":
-            donate = ()
-        return jax.jit(run, donate_argnums=donate)
-
-    def _make_chunked_fn(self, Tp: int, Bp: int):
-        """Chunk prefill: tokens enter at ABSOLUTE positions (a resumed
-        chunk or a cache-hit suffix starts mid-sequence).  Each layer
-        writes the chunk's K/V into the paged cache first, then gathers
-        every sequence's pages back densely — so chunk tokens attend to
-        cached-prefix positions this program never computed (the prefix
-        pages carry KV written by an earlier chunk/request)."""
-        nh, kvh, d = self._nh, self._kvh, self._hd
-        bs = self.block_size
-        nblk = self.nblk
-        S = nblk * bs
-        eps = self.config.rms_norm_eps
-        theta = self.config.rope_theta
-        sm_scale = 1.0 / (d ** 0.5)
-
-        def run(params, kc, vc, toks, seg, rel, bt, last_idx, samp):
-            # toks/seg/rel [Tp] int32 (pads: seg == Bp -> the null row of
-            # bt); rel is each token's absolute position; bt [Bp+1, nblk];
-            # last_idx [Bp] flat index of each chunk's final token.
-            x = jnp.take(params["embed"], toks, axis=0)       # [Tp, H]
-            keypos = jnp.arange(S, dtype=jnp.int32)
-
-            def body(x, inp):
-                p, kcl, vcl = inp
-                h = _rms_weight(x, p["ln1"], eps)
-                q = (h @ p["wq"]).reshape(Tp, nh, d)
-                k = (h @ p["wk"]).reshape(Tp, kvh, d)
-                v = (h @ p["wv"]).reshape(Tp, kvh, d)
-                q = _rope_positions(q, rel, theta)
-                k = _rope_positions(k, rel, theta)
-                blk = bt[seg, rel // bs]                      # [Tp]
-                slot = rel % bs
-                kcl = kcl.at[blk, :, slot, :].set(k.astype(kcl.dtype))
-                vcl = vcl.at[blk, :, slot, :].set(v.astype(vcl.dtype))
-                # gather each sequence's pages to [Bp+1, S, kvh, d]
-                kg = kcl[bt].transpose(0, 1, 3, 2, 4) \
-                    .reshape(Bp + 1, S, kvh, d)
-                vg = vcl[bt].transpose(0, 1, 3, 2, 4) \
-                    .reshape(Bp + 1, S, kvh, d)
-                kq = kg[seg]                                  # [Tp, S, kvh, d]
-                vq = vg[seg]
-                if kvh != nh:
-                    kq = jnp.repeat(kq, nh // kvh, axis=2)
-                    vq = jnp.repeat(vq, nh // kvh, axis=2)
-                sc = jnp.einsum("qhd,qshd->qhs", q.astype(jnp.float32),
-                                kq.astype(jnp.float32)) * sm_scale
-                mask = keypos[None, None, :] <= rel[:, None, None]
-                sc = jnp.where(mask, sc, -jnp.inf)
-                pr = jax.nn.softmax(sc, axis=-1)
-                att = jnp.einsum("qhs,qshd->qhd", pr,
-                                 vq.astype(jnp.float32)).astype(x.dtype)
-                x = x + att.reshape(Tp, nh * d) @ p["wo"]
-                h2 = _rms_weight(x, p["ln2"], eps)
-                a = jax.nn.silu((h2 @ p["gate"]).astype(jnp.float32)
-                                ).astype(h2.dtype) * (h2 @ p["up"])
-                return x + a @ p["down"], (kcl, vcl)
-
-            x, (kc, vc) = lax.scan(body, x, (params["layers"], kc, vc))
-            h = _rms_weight(x, params["norm_f"], eps)
-            hsel = h[last_idx]                                # [Bp, H]
-            logits = (hsel.astype(jnp.float32)
-                      @ params["head"].astype(jnp.float32))
-            return sample_tokens(logits, samp), kc, vc
-
-        return run, (1, 2)
-
-    def _run_prefill(self, chunks: list):
-        """chunks: [(req, n_chunk)].  Whole-prompt-from-zero batches ride
-        the varlen program (PR-1 fast path, kernel-eligible on TPU);
-        resumed chunks / cache-hit suffixes ride the chunked program."""
-        classic = all(req.cached == 0 and n == len(req.tokens)
-                      for req, n in chunks)
-        total = sum(n for _, n in chunks)
-        Tp, Bp = self._prefill_buckets(total, len(chunks))
-
-        toks = np.zeros((Tp,), np.int32)
-        seg = np.full((Tp,), Bp, np.int32)            # pads -> sentinel
-        rel = np.zeros((Tp,), np.int32)
-        bt = np.full((Bp + 1, self.nblk), NULL_BLOCK,
-                     np.int32)                        # sentinel row: null
-        last_idx = np.zeros((Bp,), np.int32)
-        samp = make_samp(Bp, self.config.vocab_size)
-        cu = np.zeros((Bp + 1,), np.int32)
-
-        off = 0
-        for i, (req, n) in enumerate(chunks):
-            toks[off:off + n] = req.tokens[req.cached:req.cached + n]
-            seg[off:off + n] = i
-            rel[off:off + n] = np.arange(req.cached, req.cached + n)
-            bt[i] = self.blocks.padded_table(req.rid, self.nblk)
-            last_idx[i] = off + n - 1
-            samp["temps"][i] = req.temperature
-            samp["top_k"][i] = req.top_k
-            samp["top_p"][i] = req.top_p
-            samp["penalty"][i] = req.repetition_penalty
-            if req.seen is not None:
-                np.copyto(samp["seen"][i], req.seen)
-            if req.temperature > 0.0:
-                # only sampled rows need a key: all-greedy prefill steps
-                # skip the per-request PRNG fold-in altogether
-                samp["keys"][i] = self._req_key(req)
-            off += n
-            cu[i + 1] = off
-        # empty trailing batch slots: zero-length sequences whose
-        # last_idx points at token 0; their sampled token is discarded
-        cu[len(chunks) + 1:] = off
-
-        if classic:
-            prog = self._get_prefill_prog(Tp, Bp)
-            out, self._kc, self._vc = prog(self.params, self._kc, self._vc,
-                                           toks, seg, rel, bt, cu,
-                                           last_idx, samp)
-        else:
-            prog = self._get_chunked_prog(Tp, Bp)
-            out, self._kc, self._vc = prog(self.params, self._kc, self._vc,
-                                           toks, seg, rel, bt,
-                                           last_idx, samp)
-        out = np.asarray(out)
-        return [out[i] for i in range(len(chunks))]
 
 
 # graft-lint import-of-engine hook: PT_ANALYSIS=strict refuses to import a

@@ -5,10 +5,10 @@ Plain continuous-batching decode (inference/serving.py) pays one full
 forward pass per emitted token.  Speculative decoding (Leviathan et al.,
 "Fast Inference from Transformers via Speculative Decoding") breaks that
 coupling: a cheap DRAFTER proposes K tokens, the target model scores all
-K+1 positions in ONE pass (the engine's verify program — the
-chunked-prefill gather math returning logits at every packed position),
-and rejection sampling accepts a prefix of the drafts.  Acceptance is
-provably exact:
+K+1 positions in ONE pass (a [last_token, drafts...] row of the
+engine's single ragged step program, whose raw logits at every packed
+position ride along with the sampled tokens), and rejection sampling
+accepts a prefix of the drafts.  Acceptance is provably exact:
 
 - temperature 0: a draft is accepted iff it equals the target argmax at
   its position, and the first rejection emits that argmax — so the
@@ -40,10 +40,10 @@ on repetitive text (code, structured output, self-repeating loops).
 
 ``DraftModelDrafter``: a small draft model with its OWN paged cache,
 embedded as a private single-slot LLMEngine used purely as a
-program/pool container.  Catch-up tokens ride the chunked-prefill
-program, subsequent drafts the decode program, and the engine's
-post-verify ``commit`` truncates the draft cache back to the accepted
-prefix so both caches stay in lock-step.
+program/pool container.  Catch-up tokens and subsequent drafts
+each ride a single-row launch of the engine's ragged step program, and
+the engine's post-verify ``commit`` truncates the draft cache back
+to the accepted prefix so both caches stay in lock-step.
 """
 from __future__ import annotations
 
@@ -113,9 +113,9 @@ class DraftModelDrafter(Drafter):
     """Small-draft-model proposals with their own paged KV cache.
 
     The inner LLMEngine is a CONTAINER, not a scheduler: this class
-    drives its chunked-prefill and decode programs by hand, one sequence
-    per call, so the draft cache lives in the same kind of paged pool
-    (and rolls back through the same ``truncate``) as the target's.
+    drives its ragged step program by hand, one sequence per call, so
+    the draft cache lives in the same kind of paged pool (and rolls
+    back through the same ``truncate``) as the target's.
     ``capacity`` bounds how many sequences can hold draft state at once
     — a pool-exhausted proposal returns ``([], None)`` and the engine
     falls back to plain decode for that sequence.
@@ -156,7 +156,7 @@ class DraftModelDrafter(Drafter):
             self.release(rid)
             return [], None
         # catch up: feed every context token not yet in the draft cache
-        # (at least the newest one) through the chunked program, then
+        # (at least the newest one) through one ragged chunk row, then
         # greedy-decode the remaining drafts one token at a time
         st = min(self._valid.get(rid, 0), n - 1)
         tok = self._chunk(rid, context[st:], st)
@@ -181,39 +181,36 @@ class DraftModelDrafter(Drafter):
         self._valid.pop(rid, None)
 
     def _chunk(self, rid, gap, start):
+        # one single-row ragged launch: the gap enters at absolute
+        # positions start..start+g-1, greedy-sampling the last position
         eng = self._eng
         g = len(gap)
-        Tp, Bp = eng._prefill_buckets(g, 1)
-        toks = np.zeros((Tp,), np.int32)
-        seg = np.full((Tp,), Bp, np.int32)
-        rel = np.zeros((Tp,), np.int32)
-        bt = np.full((Bp + 1, eng.nblk), NULL_BLOCK, np.int32)
+        Tq = eng._ragged_bucket(g)
+        toks = np.zeros((Tq,), np.int32)
         toks[:g] = gap
-        seg[:g] = 0
-        rel[:g] = np.arange(start, start + g)
+        cu = np.asarray([0, g], np.int32)
+        kvl = np.asarray([start + g], np.int32)
+        bt = np.full((2, eng.nblk), NULL_BLOCK, np.int32)
         bt[0] = eng.blocks.padded_table(rid, eng.nblk)
-        last_idx = np.zeros((Bp,), np.int32)
-        last_idx[0] = g - 1
-        samp = make_samp(Bp, eng.config.vocab_size)   # greedy defaults
-        prog = eng._get_chunked_prog(Tp, Bp)
-        out, eng._kc, eng._vc = prog(eng.params, eng._kc, eng._vc,
-                                     toks, seg, rel, bt, last_idx, samp)
-        return int(np.asarray(out)[0])
+        lidx = np.asarray([g - 1], np.int32)
+        samp = make_samp(1, eng.config.vocab_size)    # greedy defaults
+        sampled, _ = eng._launch_ragged(Tq, toks, cu, kvl, bt, lidx,
+                                        samp, g)
+        return int(np.asarray(sampled)[0])
 
     def _decode(self, rid, tok, pos):
+        # a decode token is just a one-token ragged row (same program)
         eng = self._eng
-        Bb = eng._decode_bucket(1)
-        toks = np.zeros((Bb,), np.int32)
-        posa = np.zeros((Bb,), np.int32)
-        bt = np.full((Bb, eng.nblk), NULL_BLOCK, np.int32)
-        toks[0] = tok
-        posa[0] = pos
+        toks = np.asarray([tok], np.int32)
+        cu = np.asarray([0, 1], np.int32)
+        kvl = np.asarray([pos + 1], np.int32)
+        bt = np.full((2, eng.nblk), NULL_BLOCK, np.int32)
         bt[0] = eng.blocks.padded_table(rid, eng.nblk)
-        samp = make_samp(Bb, eng.config.vocab_size)   # greedy defaults
-        prog = eng._get_decode_prog(Bb)
-        out, eng._kc, eng._vc = prog(eng.params, eng._kc, eng._vc,
-                                     toks, posa, bt, samp)
-        return int(np.asarray(out)[0])
+        lidx = np.zeros((1,), np.int32)
+        samp = make_samp(1, eng.config.vocab_size)    # greedy defaults
+        sampled, _ = eng._launch_ragged(eng._ragged_bucket(1), toks, cu,
+                                        kvl, bt, lidx, samp, 1)
+        return int(np.asarray(sampled)[0])
 
 
 def verify_and_accept(logits, drafts, *, q_dists=None, temperature=0.0,

@@ -1,21 +1,28 @@
-"""Pallas paged-KV decode attention (TPU).
+"""Pallas paged-KV attention (TPU): ragged serving kernel + decode kernel.
 
-The serving decode step attends one fresh query token per sequence against
-that sequence's KV cache, which lives in non-contiguous fixed-size pages
-addressed by a block table (the reference's paged CUDA decode kernel,
+The serving step attends query tokens against KV caches that live in
+non-contiguous fixed-size pages addressed by block tables (the reference's
+paged CUDA decode kernel,
 /root/reference/paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu
 -> block_attn.h).  The XLA composition must first GATHER every sequence's
 pages into a dense [B, nblk*bs] buffer — O(B * max_len) HBM traffic twice
-(gather + read).  This kernel instead walks the block table with Pallas
-scalar prefetch: the grid's page dimension indexes `block_tables[b, i]`
+(gather + read).  These kernels instead walk the block table with Pallas
+scalar prefetch: the grid's page dimension indexes the block table
 directly in each page's BlockSpec index map, so pages stream from HBM to
 VMEM exactly once, with no dense intermediate.
 
-Layout: caches are [num_blocks, H_kv, bs, D] (blha cache layout), the
-query is [B, H, D], block table [B, nblk] int32, lengths [B] int32 (count
-of valid positions per sequence AFTER the current token's k/v insert).
-GQA is native: grid runs over kv heads, each kernel instance carries the
-q-head group [G, D] so the [G, bs] score tile keeps the MXU busy.
+`ragged_paged_attention` is the serving workhorse (arxiv 2604.15464): the
+grid runs over FLAT query tokens, each token resolves its owning row via
+`cu_seqlens` and masks keys at its absolute position — so a prefill
+chunk, a resumed chunk, a single decode token, and a k-draft verify row
+are all just rows with different query lengths, served by ONE program.
+`paged_decode_attention` is the original one-token-per-row special case,
+kept for the incubating blha path and as a second oracle.
+
+Layout: caches are [num_blocks, H_kv, bs, D] (blha cache layout), block
+tables int32, per-row lengths int32.  GQA is native: grid runs over kv
+heads, each kernel instance carries the q-head group [G, D] so the
+[G, bs] score tile keeps the MXU busy.
 """
 from __future__ import annotations
 
@@ -158,6 +165,180 @@ def paged_decode_reference(q, key_cache, value_cache, block_tables,
     return out.astype(q.dtype)
 
 
+def _ragged_kernel(seg_ref, rel_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref, *, bs, sm_scale):
+    """grid (Tq, H_kv, nblk); refs: q [G, D] (one flat token's group for
+    one kv head), k/v [bs, D] (one page of that token's owning row),
+    o [G, D]; scratch m/l [G, 1] f32, acc [G, D] f32.
+
+    seg[t] names the block-table row owning flat token t; rel[t] is the
+    token's position within that row's KV (0-based), so causality is just
+    `keypos <= rel[t]` — uniform across prefill/resume/decode/verify rows.
+    """
+    t = pl.program_id(0)
+    i = pl.program_id(2)
+    nblk = pl.num_programs(2)
+    rel = rel_ref[t]                          # absolute key budget, 0-based
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    base = i * bs
+
+    @pl.when(base <= rel)
+    def _tile():
+        q = (q_ref[...].astype(jnp.float32) * sm_scale).astype(q_ref.dtype)
+        k = k_ref[...]                         # [bs, D]
+        v = v_ref[...]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [G, bs]
+        pos = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos <= rel, s, -jnp.inf)
+        m_prev = m_ref[...]                    # [G, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                 # [G, bs]
+        alpha = jnp.exp(m_prev - m_new)        # [G, 1]
+        l_ref[...] = alpha * l_ref[...] + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    @pl.when(i == nblk - 1)
+    def _finalize():
+        o_ref[...] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+def ragged_segments(cu_seqlens, kv_lens, n_tokens):
+    """Derive per-flat-token (seg, rel) from the ragged row layout.
+
+    cu_seqlens [R+1] int32 (row r owns flat tokens cu[r]..cu[r+1]);
+    kv_lens [R] int32 (valid KV positions per row AFTER this launch's
+    inserts).  Padding tokens past cu[R] get seg == R and rel == 0 so the
+    kernel computes a finite garbage row the caller discards.
+    """
+    cu = cu_seqlens.astype(jnp.int32)
+    kvl = kv_lens.astype(jnp.int32)
+    R = kvl.shape[0]
+    tpos = jnp.arange(n_tokens, dtype=jnp.int32)
+    seg = jnp.searchsorted(cu[1:], tpos, side="right").astype(jnp.int32)
+    segc = jnp.minimum(seg, R - 1)
+    qlen = cu[1:] - cu[:-1]
+    rel = jnp.where(seg < R, kvl[segc] - qlen[segc] + tpos - cu[segc], 0)
+    return seg, rel
+
+
+def ragged_paged_attention_segrel(q, key_cache, value_cache, block_tables,
+                                  seg, rel):
+    """Ragged attention with precomputed (seg, rel) per flat token.
+
+    q [Tq, H, D]; caches [num_blocks, H_kv, bs, D]; block_tables [R, nblk]
+    int32; seg [Tq] int32 in [0, R] (R == padding sentinel); rel [Tq]
+    int32.  Returns [Tq, H, D].
+    """
+    Tq, H, D = q.shape
+    _, Hkv, bs, _ = key_cache.shape
+    G = H // Hkv
+    R, nblk = block_tables.shape
+    sm_scale = 1.0 / (D ** 0.5)
+
+    kernel = functools.partial(_ragged_kernel, bs=bs, sm_scale=sm_scale)
+    qr = q.reshape(Tq, Hkv, G, D)
+    # clamp table entries (blha -1 padding) AND seg (R == pad sentinel) so
+    # every index map resolves to a real page; padded/overhung tiles are
+    # DMA'd but masked or skipped in compute
+    block_tables = jnp.clip(block_tables.astype(jnp.int32), 0,
+                            key_cache.shape[0] - 1)
+    seg = jnp.clip(seg.astype(jnp.int32), 0, R - 1)
+    rel = rel.astype(jnp.int32)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,             # seg, rel, block_tables
+            grid=(Tq, Hkv, nblk),
+            in_specs=[
+                pl.BlockSpec((None, None, G, D),
+                             lambda t, h, i, sg, rl, bt: (t, h, 0, 0)),
+                pl.BlockSpec((None, None, bs, D),
+                             lambda t, h, i, sg, rl, bt:
+                             (bt[sg[t], i], h, 0, 0)),
+                pl.BlockSpec((None, None, bs, D),
+                             lambda t, h, i, sg, rl, bt:
+                             (bt[sg[t], i], h, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((None, None, G, D),
+                                   lambda t, h, i, sg, rl, bt: (t, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((G, 1), jnp.float32),
+                pltpu.VMEM((G, 1), jnp.float32),
+                pltpu.VMEM((G, D), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((Tq, Hkv, G, D), q.dtype),
+        interpret=interpret_mode(),
+    )(seg, rel, block_tables, qr, key_cache, value_cache)
+    return out.reshape(Tq, H, D)
+
+
+def ragged_paged_attention(q, key_cache, value_cache, block_tables,
+                           cu_seqlens, kv_lens):
+    """One ragged launch over flat query tokens from mixed-phase rows.
+
+    q [Tq, H, D] (rows packed back-to-back, tail padding allowed);
+    caches [num_blocks, H_kv, bs, D]; block_tables [R, nblk] int32;
+    cu_seqlens [R+1] int32; kv_lens [R] int32 (valid KV per row AFTER
+    this launch's inserts — a row's queries sit at its LAST kv_lens
+    positions).  Returns [Tq, H, D]; padding rows are finite garbage.
+    """
+    seg, rel = ragged_segments(cu_seqlens, kv_lens, q.shape[0])
+    return ragged_paged_attention_segrel(
+        q, key_cache, value_cache, block_tables, seg, rel)
+
+
+def ragged_paged_reference_segrel(q, key_cache, value_cache, block_tables,
+                                  seg, rel):
+    """Dense-gather XLA oracle for the ragged kernel (the engine's former
+    chunked-resume math, term for term)."""
+    Tq, H, D = q.shape
+    _, Hkv, bs, _ = key_cache.shape
+    R, nblk = block_tables.shape
+    bt = jnp.clip(block_tables.astype(jnp.int32), 0,
+                  key_cache.shape[0] - 1)
+    seg = jnp.clip(seg.astype(jnp.int32), 0, R - 1)
+    kg = key_cache[bt].transpose(0, 1, 3, 2, 4).reshape(
+        R, nblk * bs, Hkv, D)                  # [R, S, Hkv, D]
+    vg = value_cache[bt].transpose(0, 1, 3, 2, 4).reshape(
+        R, nblk * bs, Hkv, D)
+    kq = kg[seg]                               # [Tq, S, Hkv, D]
+    vq = vg[seg]
+    if Hkv != H:
+        g = H // Hkv
+        kq = jnp.repeat(kq, g, axis=2)
+        vq = jnp.repeat(vq, g, axis=2)
+    sm_scale = 1.0 / (D ** 0.5)
+    scores = jnp.einsum("qhd,qshd->qhs", q.astype(jnp.float32),
+                        kq.astype(jnp.float32)) * sm_scale
+    keypos = jnp.arange(nblk * bs, dtype=jnp.int32)
+    mask = keypos[None, None, :] <= rel[:, None, None]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("qhs,qshd->qhd", probs, vq.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def ragged_paged_reference(q, key_cache, value_cache, block_tables,
+                           cu_seqlens, kv_lens):
+    """Dense-gather XLA oracle with the public (cu, kv_lens) interface."""
+    seg, rel = ragged_segments(cu_seqlens, kv_lens, q.shape[0])
+    return ragged_paged_reference_segrel(
+        q, key_cache, value_cache, block_tables, seg, rel)
+
+
 _PROBE_CACHE: dict = {}
 _PROBE_LOGGED = False
 
@@ -213,3 +394,54 @@ def supports(B, H, Hkv, D, bs, nblk=None, dtype=jnp.float32) -> bool:
     if nblk is None:
         return True     # shape-only query (no probe possible yet)
     return _probe_lowering(B, H, Hkv, D, bs, nblk, dtype)
+
+
+def _probe_ragged_lowering(Tq, H, Hkv, D, bs, R, nblk, dtype) -> bool:
+    """Compile-probe the ragged kernel for these shapes (cached; same
+    degrade-don't-crash contract as `_probe_lowering`)."""
+    global _PROBE_LOGGED
+    key = ("ragged", Tq, H, Hkv, D, bs, R, nblk, str(dtype),
+           jax.default_backend())
+    hit = _PROBE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    if interpret_mode():  # interpreter enforces no TPU tiling rules
+        _PROBE_CACHE[key] = True
+        return True
+    num_blocks = max(nblk * R, 1)
+    try:
+        jax.jit(ragged_paged_attention_segrel).lower(
+            jax.ShapeDtypeStruct((Tq, H, D), dtype),
+            jax.ShapeDtypeStruct((num_blocks, Hkv, bs, D), dtype),
+            jax.ShapeDtypeStruct((num_blocks, Hkv, bs, D), dtype),
+            jax.ShapeDtypeStruct((R, nblk), jnp.int32),
+            jax.ShapeDtypeStruct((Tq,), jnp.int32),
+            jax.ShapeDtypeStruct((Tq,), jnp.int32),
+        ).compile()
+        ok = True
+    except Exception as e:
+        ok = False
+        if not _PROBE_LOGGED:
+            _PROBE_LOGGED = True
+            import logging
+            logging.getLogger("paddle_tpu.pallas").warning(
+                "ragged paged kernel does not lower for "
+                f"Tq={Tq} H={H} Hkv={Hkv} D={D} bs={bs}: "
+                f"{type(e).__name__}; falling back to dense gather")
+    _PROBE_CACHE[key] = ok
+    return ok
+
+
+def ragged_supports(Tq, H, Hkv, D, bs, R=None, nblk=None,
+                    dtype=jnp.float32) -> bool:
+    """Eligibility for the ragged pallas kernel: shape heuristic, then an
+    actual lowering probe (cached)."""
+    if H % Hkv != 0:
+        return False
+    if D % 128 != 0 and D not in (64,):
+        return False
+    if bs % 8 != 0:
+        return False
+    if R is None or nblk is None:
+        return True     # shape-only query (no probe possible yet)
+    return _probe_ragged_lowering(Tq, H, Hkv, D, bs, R, nblk, dtype)

@@ -1,0 +1,23 @@
+"""Seeds attention-program-budget: a second attention program kind in an
+inference/ path — budget is ONE ragged step per engine."""
+import jax
+
+
+def _ragged_attention(q, k, v):
+    return q
+
+
+def _decode_attention(q, k, v):
+    return q
+
+
+def ragged_step(q, k, v):
+    return _ragged_attention(q, k, v)
+
+
+def decode_step(q, k, v):
+    return _decode_attention(q, k, v)
+
+
+RAGGED = jax.jit(ragged_step)
+DECODE = jax.jit(decode_step)    # second attention program kind: over budget

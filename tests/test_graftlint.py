@@ -42,6 +42,8 @@ def _lint_fix(name):
     ("fix_tracer_branch.py", "tracer-branch", 7, "root", ERROR),
     ("fix_mutable_default.py", "mutable-default-arg", 4, "helper", WARNING),
     ("fix_unkeyed_jit.py", "unkeyed-jit", 6, "call", ERROR),
+    (os.path.join("inference", "fix_attention_budget.py"),
+     "attention-program-budget", 18, "decode_step", ERROR),
 ])
 def test_ast_fixture_fires_exactly_once(fixture, rule, line, func, severity):
     findings = _lint_fix(fixture)
@@ -56,6 +58,15 @@ def test_ast_fixture_fires_exactly_once(fixture, rule, line, func, severity):
 
 def test_clean_fixture_is_silent():
     assert _lint_fix("fix_clean.py") == []
+
+
+def test_serving_engine_within_attention_program_budget():
+    """The shipped engine holds the contract the budget rule guards:
+    exactly one attention-bearing compiled program (the ragged step)."""
+    findings = lint_file(os.path.join(_REPO, "paddle_tpu", "inference",
+                                      "serving.py"), root=_REPO)
+    assert [f for f in findings
+            if f.rule == "attention-program-budget"] == []
 
 
 def test_mutable_default_is_error_in_compiled_path():
@@ -232,7 +243,7 @@ def test_every_catalog_rule_is_exercised():
     to the catalog without a test."""
     covered = {
         "numpy-in-jit", "host-sync-in-jit", "tracer-branch",
-        "mutable-default-arg", "unkeyed-jit",
+        "mutable-default-arg", "unkeyed-jit", "attention-program-budget",
         "undonated-buffer", "host-callback", "dtype-promotion",
         "dead-code", "dead-input", "passthrough-output",
     }
@@ -324,10 +335,10 @@ def test_cli_nonzero_on_fixture_tree_json():
     r = _run_cli(_FIX, "--format", "json", "--no-default-baseline")
     assert r.returncode == 1, r.stdout + r.stderr
     doc = json.loads(r.stdout)
-    assert doc["counts"]["ERROR"] == 4          # one per ERROR fixture
+    assert doc["counts"]["ERROR"] == 5          # one per ERROR fixture
     rules = {f["rule"] for f in doc["findings"]}
     assert {"numpy-in-jit", "host-sync-in-jit", "tracer-branch",
-            "unkeyed-jit"} <= rules
+            "unkeyed-jit", "attention-program-budget"} <= rules
 
 
 def test_cli_exit_zero_on_shipped_tree():

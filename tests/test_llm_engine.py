@@ -217,6 +217,24 @@ def test_engine_matches_generate_on_ragged_stream(model):
     assert s["decode_tokens"] > 0 and s["p50_token_ms"] > 0
 
 
+def test_decode_repack_after_mid_batch_retirement(model):
+    """The pure-decode fast path keys its persistent host buffers on the
+    packed-row LAYOUT (the rid order behind cu_seqlens), not just on
+    block-table versions.  Retiring a mid-batch sequence between steps
+    shifts every later row up one slot; a layout-blind repack would
+    decode row i against row i+1's pages and positions.  Outputs must
+    stay byte-identical to the oracle through the retirement."""
+    eng = _engine(model)
+    rng = np.random.RandomState(17)
+    prompts = [rng.randint(0, VOCAB, n).tolist() for n in (5, 7, 6)]
+    budgets = [12, 3, 12]                # middle row retires first
+    rids = [eng.add_request(p, max_new_tokens=mn)
+            for p, mn in zip(prompts, budgets)]
+    outs = eng.run()
+    for rid, p, mn in zip(rids, prompts, budgets):
+        assert outs[rid].generated == _oracle(model, p, mn), rid
+
+
 def test_engine_sampling_deterministic_per_seed(model):
     """Temperature sampling keys depend only on (seed, token index), so a
     rerun — and any scheduling order — reproduces the stream."""

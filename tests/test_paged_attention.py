@@ -206,3 +206,83 @@ def test_blha_prefill_varlen_pallas_matches_dense():
     np.testing.assert_allclose(outs[True][0], outs[False][0],
                                rtol=2e-4, atol=2e-4)
     np.testing.assert_allclose(outs[True][1], outs[False][1])
+
+
+# ---------------------------------------------------------------------------
+# ragged serving kernel: edge geometries vs the XLA gather oracle.
+# Prefill chunks, resumed chunks, decode tokens and k-draft verify rows
+# are all just rows with different query_lens — each geometry must match
+# the dense-gather reference on every valid token.
+# ---------------------------------------------------------------------------
+
+def _ragged_case(rng, query_lens, kv_lens, Tq, *, H=4, Hkv=4, D=64, bs=8,
+                 nblk=4, num_blocks=64, contiguous=True):
+    R = len(query_lens)
+    q = jnp.asarray(rng.randn(Tq, H, D), jnp.float32)
+    kc = jnp.asarray(rng.randn(num_blocks, Hkv, bs, D), jnp.float32)
+    vc = jnp.asarray(rng.randn(num_blocks, Hkv, bs, D), jnp.float32)
+    if contiguous:
+        picks = np.arange(R * nblk).reshape(R, nblk)
+    else:
+        picks = rng.choice(num_blocks, R * nblk,
+                           replace=False).reshape(R, nblk)
+    bt = jnp.asarray(picks, jnp.int32)
+    cu = jnp.asarray(np.concatenate(
+        [[0], np.cumsum(query_lens)]).astype(np.int32))
+    kvl = jnp.asarray(np.asarray(kv_lens, np.int32))
+    return q, kc, vc, bt, cu, kvl
+
+
+def _check_ragged(q, kc, vc, bt, cu, kvl, atol=2e-5):
+    out = np.asarray(pa.ragged_paged_attention(q, kc, vc, bt, cu, kvl))
+    ref = np.asarray(pa.ragged_paged_reference(q, kc, vc, bt, cu, kvl))
+    total = int(np.asarray(cu)[-1])
+    assert np.isfinite(out).all()        # padding rows: finite garbage
+    np.testing.assert_allclose(out[:total], ref[:total], atol=atol)
+    return out
+
+
+def test_ragged_all_decode_rows_matches_decode_oracle():
+    """Pure decode geometry: every query_len is 1.  Must match the
+    gather oracle AND the dedicated decode oracle at each row's absolute
+    position (the row's query sits at kv_len - 1)."""
+    rng = np.random.RandomState(20)
+    R = 4
+    kvl = rng.randint(1, 4 * 8 + 1, R)
+    q, kc, vc, bt, cu, kvl_j = _ragged_case(rng, [1] * R, kvl, Tq=R,
+                                            contiguous=False)
+    out = _check_ragged(q, kc, vc, bt, cu, kvl_j)
+    dec = pa.paged_decode_reference(q, kc, vc, bt,
+                                    jnp.asarray(kvl, jnp.int32))
+    np.testing.assert_allclose(out, np.asarray(dec), atol=2e-5)
+
+
+def test_ragged_one_row_owns_whole_bucket():
+    """A single sequence's prefill filling every flat token (and every
+    KV page) — the pure varlen-prefill corner, cache exactly full."""
+    rng = np.random.RandomState(21)
+    Tq = 24                              # == nblk * bs == kv_len
+    q, kc, vc, bt, cu, kvl = _ragged_case(rng, [Tq], [Tq], Tq=Tq,
+                                          bs=8, nblk=3)
+    _check_ragged(q, kc, vc, bt, cu, kvl)
+
+
+def test_ragged_empty_tail_padding_rows():
+    """Real tokens in the front, a long padded tail (the bucket the
+    engine actually launches): resumed chunk at a KV offset + a verify-
+    shaped row, padding never NaN-poisons the valid rows."""
+    rng = np.random.RandomState(22)
+    q, kc, vc, bt, cu, kvl = _ragged_case(
+        rng, [3, 4], [19, 11], Tq=16)    # 7 real tokens, 9 padding
+    _check_ragged(q, kc, vc, bt, cu, kvl)
+
+
+def test_ragged_noncontiguous_block_table_gqa():
+    """Scattered physical pages (allocator churn order) under GQA, with
+    all four row kinds in one launch: prefill chunk (5), decode (1),
+    verify row (4 = k+1 drafts), resumed chunk (3) at a deep offset."""
+    rng = np.random.RandomState(23)
+    q, kc, vc, bt, cu, kvl = _ragged_case(
+        rng, [5, 1, 4, 3], [5, 9, 17, 26], Tq=16, H=8, Hkv=4,
+        contiguous=False)
+    _check_ragged(q, kc, vc, bt, cu, kvl)

@@ -69,15 +69,44 @@ def test_serve_bench_spec_emits_acceptance_surface():
     assert record["baseline_tokens_per_s"] > 0
     assert record["spec_k"] == 3
     # speculation must actually fire on a repetitive stream: drafts
-    # proposed, some accepted, and the single-bucket verify program built
+    # proposed, some accepted — with verify rows riding the same ragged
+    # program kind as everything else (no dedicated verify compile)
     assert record["draft_proposed"] > 0
     assert record["draft_accepted"] > 0
     assert 0.0 < record["accept_rate"] <= 1.0
     assert record["verify_steps"] > 0
-    assert record["verify_compiles"] == 1
+    assert record["attention_compiles"] >= 1
     assert record["speedup"] > 0
     # rejections roll pages back through BlockManager.truncate
     assert record["rollback_tokens"] >= 0
+
+
+def test_serve_bench_mixed_emits_padding_surface():
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "--smoke", "--mixed", "--requests", "12"],
+        capture_output=True, text=True, timeout=540,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.strip().splitlines() if ln.strip()]
+    assert lines, f"no stdout; stderr: {out.stderr[-2000:]}"
+    record = json.loads(lines[-1])
+    assert record["metric"] == "serve_mixed_tokens_per_s"
+    assert "error" not in record, record
+    assert record["value"] > 0
+    assert record["decode_tokens_per_s"] > 0
+    # the zoo actually showed up: chunked prefills and verify rounds
+    assert record["long_prompts"] > 0
+    assert record["prefill_tokens"] > 0
+    assert record["verify_steps"] > 0
+    # ISSUE acceptance: ONE attention program kind, and the single
+    # ragged bucket pads strictly less than the per-phase programs
+    # would have for the identical launches
+    assert record["attention_program_kinds"] == 1
+    assert record["padding_waste_ratio"] >= 1.0
+    assert record["padding_waste_ratio"] \
+        < record["legacy_padding_waste_ratio"]
+    assert record["padding_waste_reduction"] > 0
+    assert record["p99_token_ms"] >= record["p50_token_ms"] > 0
 
 
 def test_serve_bench_prefix_share_emits_cache_surface():

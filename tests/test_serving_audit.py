@@ -1,10 +1,11 @@
 """jaxpr audit of the REAL serving engine + captured train step.
 
-ISSUE acceptance: the analyzer runs against the actual prefill/decode
-programs the engine compiles (via ``LLMEngine.program_specs``), the JSON
-report is asserted in-tree (donation + transfer rules at minimum), and a
-mixed 16-request stream compiles exactly the documented number of
-programs (the compile-count regression guard)."""
+ISSUE acceptance: the analyzer runs against the actual programs the
+engine compiles (via ``LLMEngine.program_specs``) — since the ragged
+refactor that is ONE attention-bearing step program plus the CoW copy
+kernel — the JSON report is asserted in-tree (donation + transfer rules
+at minimum), and a mixed 16-request stream compiles exactly the
+documented number of programs (the compile-count regression guard)."""
 import json
 import os
 
@@ -48,20 +49,15 @@ def test_audit_engine_report_donation_and_transfer_clean(model):
     report = audit_engine(eng, large_bytes=1 << 10)
     doc = json.loads(json.dumps(report))           # JSON-serializable
     names = [p["name"] for p in doc["programs"]]
-    assert names == ["serving.decode", "serving.prefill",
-                     "serving.chunked_prefill", "serving.verify",
-                     "serving.cow_copy"]
+    assert names == ["serving.ragged_step", "serving.cow_copy"]
     all_findings = [f for p in doc["programs"] for f in p["findings"]]
-    rules = {f["rule"] for f in all_findings}
-    # donation rule: the KV pool + params donation contract holds on
-    # every program; transfer rule: no host callback anywhere
-    assert "undonated-buffer" not in rules
-    assert "host-callback" not in rules
+    # donation rule: the KV pool donation contract holds on the one
+    # step program; transfer rule: no host callback anywhere; and the
+    # ragged metadata (cu_seqlens/kv_lens/block_tables/logit_idx) is
+    # all live — collapsing the four phase programs removed the dense
+    # prefill path whose cu_seqlens input was dead on CPU
+    assert all_findings == []
     assert doc["errors"] == 0
-    # the single known finding: cu_seqlens dead on the dense (CPU)
-    # prefill path — live on the TPU varlen path, accepted in baseline
-    assert [f["rule"] for f in all_findings] == ["dead-input"]
-    assert all_findings[0]["func"] == "arg7"
 
 
 def test_audit_engine_report_is_baseline_clean(model):
@@ -91,11 +87,13 @@ def test_committed_report_matches_fresh_audit(model):
 
 
 def test_donation_rule_fires_when_donation_stripped(model):
-    """Negative control: the same decode program with donate_argnums
-    removed must trip undonated-buffer on the KV pool halves."""
+    """Negative control: the same ragged step program with
+    donate_argnums removed must trip undonated-buffer on the KV pool
+    halves."""
     eng = _engine(model)
     spec = eng.program_specs(large_bytes=1 << 10)[0]
-    assert spec.name == "serving.decode" and spec.donate_argnums == (1, 2)
+    assert spec.name == "serving.ragged_step"
+    assert spec.donate_argnums == (1, 2)
     stripped = ProgramSpec(spec.name, spec.fn, spec.args,
                            donate_argnums=(),
                            declared_dtype=spec.declared_dtype,
@@ -108,7 +106,7 @@ def test_donation_rule_fires_when_donation_stripped(model):
 
 
 def test_transfer_rule_fires_on_callback_variant(model):
-    """Negative control: inserting a host callback into the decode step
+    """Negative control: inserting a host callback into the ragged step
     must trip host-callback with a source trail."""
     eng = _engine(model)
     spec = eng.program_specs(large_bytes=1 << 10)[0]
@@ -120,7 +118,7 @@ def test_transfer_rule_fires_on_callback_variant(model):
                                                           out.dtype), out)
         return logged, kc, vc
 
-    cb_spec = ProgramSpec("serving.decode+cb", with_callback, spec.args,
+    cb_spec = ProgramSpec("serving.ragged_step+cb", with_callback, spec.args,
                           donate_argnums=spec.donate_argnums,
                           large_bytes=spec.large_bytes)
     findings = [f for f in analyze_program(cb_spec)
@@ -144,75 +142,74 @@ def _mixed_stream(eng):
 
 
 def test_compile_counts_mixed_stream_cache_on(model):
-    """Documented program budget with prefix caching ON:
-    - stream 1 (cold): 1 varlen prefill (all prompts bucket to one
-      (Tp, Bp)) + 1 decode (one padded batch bucket) = 2 programs;
-    - stream 2 (prefix-cache hits resume mid-sequence): +1 chunked
-      prefill, nothing else;
+    """Documented program budget with prefix caching ON — ONE program
+    KIND (the ragged step), instantiated per token-bucket:
+    - stream 1 (cold): bucket 4 (pure-decode steps) + bucket 64
+      (prefill-bearing steps) = 2 instantiations;
+    - stream 2 (prefix-cache hits resume mid-sequence with short miss
+      suffixes): +1 for bucket 32, nothing else;
     - stream 3: steady state, ZERO new compiles.
     Any drift here is a recompile regression (or an intentional change
     that must update these numbers)."""
     eng = _engine(model, enable_prefix_caching=True)
     _mixed_stream(eng)
-    assert eng.compile_counts == {"decode": 1, "prefill": 1, "chunked": 0,
-                                  "verify": 0, "cow": 0}
+    assert eng.compile_counts == {"ragged": 2, "cow": 0}
     _mixed_stream(eng)
-    assert eng.compile_counts == {"decode": 1, "prefill": 1, "chunked": 1,
-                                  "verify": 0, "cow": 0}
+    assert eng.compile_counts == {"ragged": 3, "cow": 0}
     _mixed_stream(eng)
-    assert eng.compile_counts == {"decode": 1, "prefill": 1, "chunked": 1,
-                                  "verify": 0, "cow": 0}
+    assert eng.compile_counts == {"ragged": 3, "cow": 0}
+    # bucket split the properties expose: one decode-sized bucket, the
+    # rest prefill-sized
+    assert eng.num_decode_programs == 1
+    assert eng.num_prefill_programs == 2
 
 
 def test_compile_counts_mixed_stream_cache_off(model):
     """Prefix caching OFF: every prompt prefills whole-from-zero, so the
-    chunked program never compiles; a repeat stream adds nothing."""
+    mid-size resume bucket never appears; a repeat stream adds
+    nothing."""
     eng = _engine(model, enable_prefix_caching=False)
     _mixed_stream(eng)
-    assert eng.compile_counts == {"decode": 1, "prefill": 1, "chunked": 0,
-                                  "verify": 0, "cow": 0}
+    assert eng.compile_counts == {"ragged": 2, "cow": 0}
     _mixed_stream(eng)
-    assert eng.compile_counts == {"decode": 1, "prefill": 1, "chunked": 0,
-                                  "verify": 0, "cow": 0}
+    assert eng.compile_counts == {"ragged": 2, "cow": 0}
 
 
 def test_compile_counts_spec_stream(model):
-    """Speculation ON adds EXACTLY ONE program — the single-bucket verify
-    step — regardless of how many sequences speculate or how draft
-    lengths vary step to step (prefill/chunked buckets still vary with
-    admission raggedness, exactly as without speculation)."""
+    """Speculation ON compiles ZERO extra program kinds: verify rows are
+    just ragged rows with query_len k+1, so the only delta vs the plain
+    engine is which token buckets get exercised — here the k-draft
+    verify steps land in bucket 32."""
     eng = _engine(model, enable_prefix_caching=True, drafter="ngram",
                   spec_k=4)
     _mixed_stream(eng)
-    assert eng.compile_counts["verify"] == 1
-    assert eng.compile_counts["decode"] == 1
-    assert eng.compile_counts["cow"] == 0
-    # spec-off requests on the same engine: the verify program is not
-    # touched and nothing else recompiles for the sampling params
-    verify_before = eng.compile_counts["verify"]
+    assert eng.compile_counts == {"ragged": 3, "cow": 0}
+    # spec-off requests on the same engine ride the warm buckets; no
+    # recompiles for the sampling params
     rng = np.random.RandomState(7)
     for _ in range(8):
         eng.add_request(rng.randint(0, VOCAB, 11).tolist(),
                         max_new_tokens=4, spec_k=0)
     eng.run()
-    assert eng.compile_counts["verify"] == verify_before
+    assert eng.compile_counts == {"ragged": 3, "cow": 0}
     # another speculative stream: steady state, ZERO new programs of any
-    # kind — every (Tp, Bp) bucket and the one verify bucket are warm
-    before = dict(eng.compile_counts)
+    # kind — every token bucket is warm
     _mixed_stream(eng)
-    assert eng.compile_counts == before
+    assert eng.compile_counts == {"ragged": 3, "cow": 0}
 
 
-def test_spec_off_engine_never_compiles_verify(model):
-    """No drafter -> the verify program must never build, even when
-    requests ask for spec_k (the engine clamps it to 0)."""
+def test_spec_off_engine_single_attention_program_kind(model):
+    """No drafter -> nothing beyond the ragged-step kind must ever
+    build, even when requests ask for spec_k (the engine clamps it to
+    0)."""
     eng = _engine(model)
     rng = np.random.RandomState(11)
     for _ in range(6):
         eng.add_request(rng.randint(0, VOCAB, 9).tolist(),
                         max_new_tokens=4, spec_k=4)
     eng.run()
-    assert eng.compile_counts["verify"] == 0
+    assert set(eng.compile_counts) == {"ragged", "cow"}
+    assert eng.compile_counts["cow"] == 0
 
 
 # ---------------------------------------------------------------------------

@@ -28,6 +28,17 @@ surface:
    "draft_proposed": ..., "draft_accepted": ..., "rollback_tokens": ...,
    "verify_steps": ..., "spec_disables": ..., ...}
 
+With ``--mixed`` the stream interleaves long prefills (chunk-resumed
+across steps), short prompts, plain decodes and n-gram speculation
+rounds — every row shape the ONE ragged step program serves — and
+reports the padding-waste ratio (padded/real tokens) against what the
+retired per-phase programs would have padded for the same launches:
+
+  {"metric": "serve_mixed_tokens_per_s", "value": ..., "unit": "tok/s",
+   "padding_waste_ratio": ..., "legacy_padding_waste_ratio": ...,
+   "padding_waste_reduction": ..., "attention_compiles": ...,
+   "attention_program_kinds": 1, "accept_rate": ..., ...}
+
 With ``--http`` the SAME ragged workload runs twice over the real HTTP
 frontend (paddle_tpu.inference.frontend) on localhost — concurrent
 streaming clients, SSE parsing, client-side TTFT/ITL — next to an
@@ -286,7 +297,7 @@ def run_spec_bench(smoke: bool, n_requests: int, spec_k: int, seed: int,
                     > best["decode_tokens_per_s"]:
                 best = s
         s = best
-        s["verify_compiles"] = engine.compile_counts["verify"]
+        s["attention_compiles"] = engine.compile_counts["ragged"]
         runs[spec] = s
 
     on, off = runs[True], runs[False]
@@ -312,7 +323,7 @@ def run_spec_bench(smoke: bool, n_requests: int, spec_k: int, seed: int,
         "decode_steps": on["decode_steps"],
         "baseline_decode_steps": off["decode_steps"],
         "decode_tokens": on["decode_tokens"],
-        "verify_compiles": on["verify_compiles"],
+        "attention_compiles": on["attention_compiles"],
         "p50_token_ms": on["p50_token_ms"],
         "p99_token_ms": on["p99_token_ms"],
         "preempted": on["preemptions"],
@@ -481,6 +492,114 @@ def run_http_bench(smoke: bool, n_requests: int, seed: int, backend: str):
     }
 
 
+def _mixed_request_stream(rng, n_requests, vocab, max_len,
+                          max_prefill_tokens):
+    """The whole serving zoo in one arrival-scheduled stream: every 4th
+    request is a LONG prompt (over the per-step prefill budget, so it
+    resumes across chunked steps while other rows decode), the rest are
+    short; prompts are motif-tiled so the n-gram drafter keeps proposing
+    and verify rows interleave with plain decodes."""
+    stream, step = [], 0
+    for i in range(n_requests):
+        step += int(rng.poisson(1.0))
+        motif = rng.randint(0, vocab, int(rng.randint(2, 5))).tolist()
+        if i % 4 == 0:
+            n = int(rng.randint(max_prefill_tokens + 4,
+                                max_prefill_tokens * 2))
+        else:
+            n = int(rng.randint(4, 17))
+        prompt = (motif * (n // len(motif) + 1))[:n]
+        max_new = int(rng.randint(12, min(41, max_len - n)))
+        stream.append((step, prompt, max_new))
+    return stream
+
+
+def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str):
+    """The ISSUE's headline workload: long prefills, chunked resumes,
+    plain decodes, and speculative verify rounds all riding the ONE
+    ragged step program.  Reports throughput, the exact attention
+    program budget, and the padding-waste ratio (padded/real tokens)
+    next to what the retired four-program engine would have padded for
+    the same launches (``legacy_padding_waste_ratio``)."""
+    import numpy as np
+
+    import paddle_tpu
+    from paddle_tpu.inference import LLMEngine, NGramDrafter
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    paddle_tpu.seed(seed)
+
+    if smoke or backend == "cpu":
+        cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4,
+                               ffn=64, seq=256)
+        engine_kw = dict(max_num_seqs=8, block_size=8, max_model_len=256,
+                         max_prefill_tokens=64, prefill_token_bucket=32)
+        spec_k = 3
+    else:
+        cfg = LlamaConfig(vocab_size=8192, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=4,
+                          num_attention_heads=8, num_key_value_heads=8,
+                          max_position_embeddings=1024)
+        engine_kw = dict(max_num_seqs=16, block_size=16, max_model_len=1024,
+                         max_prefill_tokens=256, prefill_token_bucket=128)
+        spec_k = 4
+
+    model = LlamaForCausalLM(cfg)
+    engine = LLMEngine(model, enable_prefix_caching=True,
+                       drafter=NGramDrafter(max_ngram=6, min_ngram=1),
+                       spec_k=spec_k, max_spec_k=spec_k,
+                       spec_accept_floor=0.0, **engine_kw)
+    rng = np.random.RandomState(seed)
+    stream = _mixed_request_stream(rng, n_requests, cfg.vocab_size,
+                                   engine_kw["max_model_len"],
+                                   engine_kw["max_prefill_tokens"])
+    total_new = sum(mn for _, _, mn in stream)
+
+    _drive(engine, list(stream))         # warm pass: compile every bucket
+    engine.stats.reset()
+    for k in engine.pad_stats:           # ratio is for the timed pass only
+        engine.pad_stats[k] = 0
+    elapsed = _drive(engine, list(stream))
+    s = engine.stats.summary()
+    ps = dict(engine.pad_stats)
+
+    real = max(ps["real"], 1)
+    waste = ps["padded"] / real
+    legacy_waste = ps["legacy_padded"] / real
+    return {
+        "metric": "serve_mixed_tokens_per_s",
+        "value": round(total_new / elapsed, 2) if elapsed else 0.0,
+        "unit": "tok/s",
+        "backend": backend,
+        "requests": n_requests,
+        "long_prompts": (n_requests + 3) // 4,
+        "spec_k": spec_k,
+        "new_tokens": total_new,
+        "decode_tokens_per_s": s["decode_tokens_per_s"],
+        "real_tokens": ps["real"],
+        "padded_tokens": ps["padded"],
+        "legacy_padded_tokens": ps["legacy_padded"],
+        "padding_waste_ratio": round(waste, 3),
+        "legacy_padding_waste_ratio": round(legacy_waste, 3),
+        "padding_waste_reduction": round(
+            1.0 - ps["padded"] / ps["legacy_padded"], 3)
+        if ps["legacy_padded"] else 0.0,
+        "attention_compiles": engine.compile_counts["ragged"],
+        "attention_program_kinds": len(
+            [k for k, v in engine.compile_counts.items()
+             if v and k != "cow"]),
+        "accept_rate": s["accept_rate"],
+        "verify_steps": s["verify_steps"],
+        "spec_emitted_tokens": s["spec_emitted_tokens"],
+        "prefill_tokens": s["prefill_tokens"],
+        "p50_token_ms": s["p50_token_ms"],
+        "p99_token_ms": s["p99_token_ms"],
+        "ttft_p50_ms": s["ttft_p50_ms"],
+        "ttft_p99_ms": s["ttft_p99_ms"],
+        "preempted": s["preemptions"],
+    }
+
+
 def run_bench(smoke: bool, n_requests: int, seed: int, backend: str):
     import numpy as np
 
@@ -556,10 +675,21 @@ def main(argv=None):
                     help="drive the same workload through the real HTTP "
                          "frontend (concurrent SSE clients on localhost) "
                          "next to an engine-direct run")
+    ap.add_argument("--mixed", action="store_true",
+                    help="interleave long prefills, chunked resumes, plain "
+                         "decodes and speculative verify rounds in one "
+                         "stream; report the padding-waste ratio of the "
+                         "single ragged program vs the retired per-phase "
+                         "programs")
     args = ap.parse_args(argv)
 
     backend, probe_err = _probe_backend()
-    if args.http:
+    if args.mixed:
+        n_requests = args.requests or (16 if (args.smoke
+                                              or backend == "cpu") else 64)
+        record = {"metric": "serve_mixed_tokens_per_s", "value": 0.0,
+                  "unit": "tok/s", "backend": backend}
+    elif args.http:
         n_requests = args.requests or (8 if (args.smoke or backend == "cpu")
                                        else 32)
         record = {"metric": "serve_http_tokens_per_s", "value": 0.0,
@@ -582,7 +712,10 @@ def main(argv=None):
     if probe_err:
         record["backend_note"] = f"cpu fallback: {probe_err}"
     try:
-        if args.http:
+        if args.mixed:
+            record.update(run_mixed_bench(args.smoke, n_requests, args.seed,
+                                          backend))
+        elif args.http:
             record.update(run_http_bench(args.smoke, n_requests, args.seed,
                                          backend))
         elif args.spec:
