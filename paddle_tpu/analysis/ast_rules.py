@@ -396,7 +396,68 @@ def lint_source(text: str, path: str = "<string>") -> list:
                  f"kind in the serving tier (first: `{att[0].name}`) — "
                  "budget is 1 attention program per engine; route rows "
                  "through the single ragged step instead")
+
+        # ---- swallowed-exception (serving tier only) ---------------------
+        # Fault-tolerance contract: failures in step/release/abort/recover
+        # paths must SURFACE — the supervised watchdog classifies a crashed
+        # step by catching its exception, and quarantine/page accounting
+        # depend on release errors propagating.  A broad handler that only
+        # passes (or logs and continues) converts a crash into a silent
+        # hang or a leaked sequence.
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            fn = None
+            for anc in ctx.ancestors(node):
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fn = anc
+                    break
+            if fn is None or not _CRITICAL_RE.search(fn.name):
+                continue
+            if _broad_handler(node) and _swallowing_body(node):
+                emit("swallowed-exception", node,
+                     f"broad `except` in `{fn.name}` swallows the "
+                     "exception (pass/log-and-continue) — step/release/"
+                     "abort/recover paths must let failures surface for "
+                     "the watchdog and quarantine logic")
     return findings
+
+
+# step/release/abort/recover paths: the functions whose failures the
+# fault-tolerance machinery must be able to observe
+_CRITICAL_RE = re.compile(r"step|release|abort|free|recover|retire",
+                          re.IGNORECASE)
+_LOG_FN_NAMES = {"debug", "info", "warning", "error", "exception", "log",
+                 "print"}
+
+
+def _broad_handler(handler: ast.ExceptHandler) -> bool:
+    """Bare ``except:`` or a clause naming Exception/BaseException
+    (directly or inside a tuple)."""
+    t = handler.type
+    if t is None:
+        return True
+    for n in (t.elts if isinstance(t, ast.Tuple) else [t]):
+        d = _dotted(n)
+        if d and d[-1] in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+def _swallowing_body(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body is pass/continue only, optionally after
+    one logging call — i.e. the exception goes nowhere."""
+    body = list(handler.body)
+    if body and isinstance(body[0], ast.Expr) \
+            and isinstance(body[0].value, ast.Call):
+        func = body[0].value.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None)
+        if name in _LOG_FN_NAMES:
+            body = body[1:]
+    if not body:
+        return True                      # log-only handler
+    return all(isinstance(s, (ast.Pass, ast.Continue)) for s in body)
 
 
 def lint_file(path: str, root: str | None = None) -> list:

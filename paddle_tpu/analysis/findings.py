@@ -90,6 +90,12 @@ RULES = {
         "is ONE attention program kind (the ragged step); phase-special "
         "attention kernels reintroduce bucket fragmentation and "
         "recompiles")),
+    "swallowed-exception": (ERROR, "ast", (
+        "a bare/broad `except` that only passes (or logs and continues) "
+        "inside an inference-tier step/release/abort/recover path — the "
+        "supervised-recovery watchdog and quarantine logic depend on "
+        "failures surfacing; an eaten exception turns a crashed step "
+        "into a silent hang or a leaked sequence")),
 }
 
 

@@ -19,7 +19,8 @@ __all__ = ["Config", "Predictor", "PredictorPool", "Tensor",
            "PrecisionType", "get_num_bytes_of_data_type",
            "convert_to_mixed_precision",
            "BlockManager", "BlockPoolExhausted", "LLMEngine", "Request",
-           "RequestOutput", "Drafter", "NGramDrafter", "DraftModelDrafter"]
+           "RequestOutput", "Drafter", "NGramDrafter", "DraftModelDrafter",
+           "FaultPlan", "InjectedFault", "DegradationController"]
 
 
 def __getattr__(name):
@@ -36,6 +37,12 @@ def __getattr__(name):
     if name in ("Drafter", "NGramDrafter", "DraftModelDrafter"):
         from . import spec_decode
         return getattr(spec_decode, name)
+    if name in ("FaultPlan", "InjectedFault"):
+        from . import faults
+        return getattr(faults, name)
+    if name == "DegradationController":
+        from .pressure import DegradationController
+        return DegradationController
     raise AttributeError(name)
 
 

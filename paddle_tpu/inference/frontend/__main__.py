@@ -45,13 +45,20 @@ def _build_engine(args):
 
     model = LlamaForCausalLM(cfg)
     drafter = "ngram" if args.spec_k > 0 else None
-    return LLMEngine(
-        model, max_num_seqs=args.max_num_seqs, block_size=args.block_size,
-        max_model_len=cfg.max_position_embeddings,
-        max_prefill_tokens=args.max_prefill_tokens,
-        enable_prefix_caching=not args.no_prefix_caching,
-        drafter=drafter, spec_k=args.spec_k,
-        retain_outputs=False)
+
+    def make_engine():
+        # shares the model (same weights!) so supervised recovery can
+        # rebuild the engine and replay journals byte-identically
+        return LLMEngine(
+            model, max_num_seqs=args.max_num_seqs,
+            block_size=args.block_size,
+            max_model_len=cfg.max_position_embeddings,
+            max_prefill_tokens=args.max_prefill_tokens,
+            enable_prefix_caching=not args.no_prefix_caching,
+            drafter=drafter, spec_k=args.spec_k,
+            retain_outputs=False)
+
+    return make_engine
 
 
 def main(argv=None) -> int:
@@ -78,17 +85,24 @@ def main(argv=None) -> int:
     ap.add_argument("--deadline-ms", type=float, default=0,
                     help="default per-request deadline (0 = none)")
     ap.add_argument("--drain-timeout-s", type=float, default=30.0)
+    ap.add_argument("--step-deadline-s", type=float, default=0,
+                    help="supervised recovery: rebuild the engine and "
+                         "replay in-flight requests when a step crashes "
+                         "or runs past this wall budget (0 = off)")
     args = ap.parse_args(argv)
 
     print(f"[frontend] building {args.model} engine ...", flush=True)
-    engine = _build_engine(args)
+    make_engine = _build_engine(args)
+    engine = make_engine()
 
     from .app import ServingFrontend
     frontend = ServingFrontend(
         engine, model_name=args.model, host=args.host, port=args.port,
         max_pending=args.max_pending or None,
         default_deadline_s=(args.deadline_ms / 1e3
-                            if args.deadline_ms else None))
+                            if args.deadline_ms else None),
+        engine_factory=make_engine if args.step_deadline_s else None,
+        step_deadline_s=args.step_deadline_s or None)
 
     async def run():
         await frontend.start()
@@ -96,11 +110,14 @@ def main(argv=None) -> int:
               f"{frontend.port}  (model={args.model}, "
               f"max_num_seqs={engine.max_num_seqs})", flush=True)
         stop = asyncio.Event()
+        second = asyncio.Event()
         hits = {"n": 0}
 
         def on_signal():
             hits["n"] += 1
             stop.set()
+            if hits["n"] > 1:
+                second.set()
 
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGINT, signal.SIGTERM):
@@ -114,9 +131,21 @@ def main(argv=None) -> int:
         print("[frontend] draining "
               f"({frontend.runner.inflight()} in flight"
               f"{', aborting' if impatient else ''}) ...", flush=True)
-        drained = await frontend.shutdown(
+        drain = asyncio.ensure_future(frontend.shutdown(
             drain_timeout_s=args.drain_timeout_s,
-            abort_inflight=impatient)
+            abort_inflight=impatient))
+        if not impatient:
+            # a second signal at ANY point during the drain escalates:
+            # abort the in-flight set so the drain completes now
+            escalate = asyncio.ensure_future(second.wait())
+            done, _ = await asyncio.wait(
+                {drain, escalate}, return_when=asyncio.FIRST_COMPLETED)
+            if drain not in done:
+                n = frontend.runner.abort_all("shutdown")
+                print(f"[frontend] second signal: aborting {n} in-flight "
+                      "request(s) ...", flush=True)
+            escalate.cancel()
+        drained = await drain
         serve.cancel()
         print(f"[frontend] {'drained' if drained else 'DRAIN TIMED OUT'}; "
               "bye", flush=True)

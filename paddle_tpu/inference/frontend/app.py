@@ -39,6 +39,7 @@ are the only cross-thread reads, and those surfaces lock internally.
 from __future__ import annotations
 
 import asyncio
+import math
 import threading
 
 from .http import (HTTPError, SSEWriter, read_request, response_bytes)
@@ -66,18 +67,22 @@ class ServingFrontend:
     max_pending: admission bound forwarded to EngineRunner.
     default_deadline_s: applied when a request carries no deadline_ms;
         None means no deadline.
+    engine_factory/step_deadline_s: forwarded to EngineRunner; together
+        they arm the supervised-recovery watchdog (see runner docs).
     """
 
     def __init__(self, engine, *, model_name: str = "model",
                  host: str = "127.0.0.1", port: int = 8000,
                  max_pending: int | None = None,
-                 default_deadline_s: float | None = None):
-        self.engine = engine
+                 default_deadline_s: float | None = None,
+                 engine_factory=None, step_deadline_s: float | None = None):
         self.model_name = str(model_name)
         self.host = host
         self.port = int(port)
         self.default_deadline_s = default_deadline_s
-        self.runner = EngineRunner(engine, max_pending=max_pending)
+        self.runner = EngineRunner(engine, max_pending=max_pending,
+                                   engine_factory=engine_factory,
+                                   step_deadline_s=step_deadline_s)
         self._server = None
         self._writers: set = set()        # open connections, for shutdown
         self._lock = threading.Lock()
@@ -86,6 +91,23 @@ class ServingFrontend:
         self._requests_total: dict = {}   # (route, code) -> n
         self._shed_total = 0
         self._active_streams = 0
+
+    @property
+    def engine(self):
+        # always the LIVE engine: supervised recovery may have replaced
+        # the one this frontend was constructed with
+        return self.runner.engine
+
+    def _retry_after(self) -> str:
+        """Retry-After seconds for 429s, from the live free-page trend
+        when a DegradationController is attached (else a flat 1)."""
+        pressure = getattr(self.engine, "pressure", None)
+        if pressure is None:
+            return "1"
+        try:
+            return str(max(1, int(math.ceil(pressure.retry_after_s()))))
+        except Exception:
+            return "1"
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -227,6 +249,20 @@ class ServingFrontend:
             await writer.drain()
             return True
 
+        pressure = getattr(self.engine, "pressure", None)
+        if pressure is not None and pressure.admission_paused:
+            # graceful degradation: shed before the request costs any
+            # runner/engine state; Retry-After from the free-page trend
+            with self._lock:
+                self._shed_total += 1
+            self._count(route, 429)
+            writer.write(response_bytes(
+                429, error_body(429, "admission paused under memory "
+                                "pressure", kind="overloaded"),
+                extra_headers={"Retry-After": self._retry_after()}))
+            await writer.drain()
+            return True
+
         deadline_s = (deadline_ms / 1e3 if deadline_ms is not None
                       else self.default_deadline_s)
         loop = asyncio.get_running_loop()
@@ -250,7 +286,7 @@ class ServingFrontend:
             self._count(route, 429)
             writer.write(response_bytes(
                 429, error_body(429, str(e), kind="overloaded"),
-                extra_headers={"Retry-After": "1"}))
+                extra_headers={"Retry-After": self._retry_after()}))
             await writer.drain()
             return True
         except RunnerDraining as e:
@@ -262,8 +298,10 @@ class ServingFrontend:
             return False
 
         if stream:
+            plan = getattr(self.engine, "fault_plan", None)
+            inject_drop = plan is not None and plan.take_conn_drop()
             return await self._stream_response(
-                request_id, q, reader, writer)
+                request_id, q, reader, writer, inject_drop=inject_drop)
         return await self._unary_response(request_id, q, reader, writer)
 
     @staticmethod
@@ -288,7 +326,8 @@ class ServingFrontend:
         except Exception:
             pass
 
-    async def _stream_response(self, request_id, q, reader, writer) -> bool:
+    async def _stream_response(self, request_id, q, reader, writer,
+                               inject_drop: bool = False) -> bool:
         route = "/v1/completions"
         sse = SSEWriter(writer)
         with self._lock:
@@ -313,6 +352,12 @@ class ServingFrontend:
                 if kind == "token":
                     await sse.event(stream_token_frame(
                         request_id, self.model_name, payload))
+                    if inject_drop:
+                        # injected mid-stream disconnect: behave exactly
+                        # like the client vanished after this frame
+                        self.engine.stats.record_fault("conn")
+                        self.runner.abort(request_id, reason="aborted")
+                        return False
                 else:
                     await sse.event(stream_finish_frame(
                         request_id, self.model_name, payload))

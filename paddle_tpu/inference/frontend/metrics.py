@@ -106,6 +106,35 @@ def render_metrics(snapshot: dict, *, engine=None,
              "Tokens emitted by the engine.",
              [(None, s.get("decode_tokens"))])
 
+    # -- fault tolerance --------------------------------------------------
+    d.metric("engine_restarts_total", "counter",
+             "Supervised engine rebuilds (crashed or hung steps).",
+             [(None, s.get("engine_restarts"))])
+    d.metric("uptime_seconds", "gauge",
+             "Service uptime (survives engine rebuilds).",
+             [(None, s.get("uptime_seconds"))])
+    d.metric("quarantined_total", "counter",
+             "Sequences retired with finish_reason=numerical_error.",
+             [(None, s.get("quarantined"))])
+    d.metric("faults_injected_total", "counter",
+             "Injected faults fired, by kind (chaos testing).",
+             [({"kind": k}, n)
+              for k, n in sorted((s.get("fault_injections")
+                                  or {}).items())]
+             or [(None, 0)])
+    d.metric("degradation_state", "gauge",
+             "Pressure tier: 0 normal, 1 spec-shrink, 2 admit-pause, "
+             "3 evict-parked.", [(None, s.get("degradation_state"))])
+    d.metric("degradation_transitions_total", "counter",
+             "Degradation tier changes.",
+             [(None, s.get("degradation_transitions"))])
+    d.metric("parked_evictions_total", "counter",
+             "Parked pages proactively evicted under pressure.",
+             [(None, s.get("parked_evictions"))])
+    d.metric("abort_noops_total", "counter",
+             "Aborts of already-finished/unknown request ids (benign).",
+             [(None, s.get("abort_noops"))])
+
     # -- prefix cache and speculation ------------------------------------
     d.metric("prefix_cache_hit_rate", "gauge",
              "Fraction of prompt tokens served from cached KV pages.",

@@ -35,6 +35,25 @@ the admission queue.
 refuses), let the engine finish or deadline-out everything in flight,
 then park the thread.  ``close(abort_inflight=True)`` is the impatient
 variant that aborts the in-flight set instead of finishing it.
+
+Supervised recovery (``engine_factory`` + ``step_deadline_s``): the
+runner journals every token a handle has been delivered
+(``StreamHandle.emitted``).  When a step CRASHES, the stepping thread
+rebuilds the engine via the factory and replays every admitted handle
+as a continuation (``add_request(generated=journal)``) — the prefix
+cache makes the re-prefill cheap, and because sampling keys derive from
+(seed, position) the continuation is byte-identical to the
+uninterrupted run.  When a step HANGS past ``step_deadline_s``, a
+watchdog thread performs the same recovery and spawns a replacement
+stepping thread; the wedged thread becomes a zombie that exits at its
+next generation check.  Every token/finish callback is GENERATION-
+guarded under the runner lock — a zombie's late deliveries are dropped
+before they can duplicate or reorder what the client sees — and the
+journal append + guard + delivery happen under that one lock, so the
+recovery snapshot is race-free by construction.  The engine's
+ServingStats object (and any FaultPlan / DegradationController) carries
+over to the rebuilt engine, so uptime and counters describe the
+SERVICE, not one engine incarnation.
 """
 from __future__ import annotations
 
@@ -66,6 +85,11 @@ class StreamHandle:
     rid: int = -1                     # engine rid once admitted
     done: bool = False
     t_submit: float = field(default_factory=time.monotonic)
+    # recovery journal: every token delivered so far.  Appended under
+    # the runner lock by the generation-guarded on_token closure; a
+    # rebuilt engine replays the request as a continuation of exactly
+    # this list.
+    emitted: list = field(default_factory=list)
 
 
 class EngineRunner:
@@ -82,15 +106,33 @@ class EngineRunner:
         bound.
     idle_wait_s: how long the stepping thread parks when there is no
         work (woken early by submit/abort/drain).
+    engine_factory: nullary callable building a replacement engine after
+        a crashed or hung step.  None (the default) disables recovery —
+        a step exception fails the in-flight set and stops the runner.
+    step_deadline_s: watchdog per-step wall budget.  A step running
+        longer is treated as hung: the watchdog thread rebuilds the
+        engine and spawns a replacement stepping thread.  Must sit above
+        the engine's worst-case honest step (first-step XLA compiles
+        included).  None disables the watchdog (crash recovery still
+        works when a factory is set).
+    max_restarts: recovery budget; exceeding it fails the in-flight set
+        instead of rebuilding again (a deterministic crash must not loop
+        forever).
     """
 
     def __init__(self, engine, *, max_pending: int | None = None,
-                 idle_wait_s: float = 0.05):
+                 idle_wait_s: float = 0.05, engine_factory=None,
+                 step_deadline_s: float | None = None,
+                 max_restarts: int = 8):
         self.engine = engine
         self.max_pending = int(max_pending
                                if max_pending is not None
                                else 4 * engine.max_num_seqs)
         self.idle_wait_s = float(idle_wait_s)
+        self._engine_factory = engine_factory
+        self.step_deadline_s = None if step_deadline_s is None \
+            else float(step_deadline_s)
+        self.max_restarts = int(max_restarts)
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._inbox: deque = deque()          # StreamHandle, FIFO
@@ -101,9 +143,24 @@ class EngineRunner:
         self._draining = False
         self._stopped = False
         self._seq = itertools.count()
-        self._thread = threading.Thread(target=self._loop,
+        # recovery generation: bumped (under _lock) on every engine
+        # rebuild.  Callbacks and loop iterations carry the generation
+        # they were created under; a mismatch means "your engine is
+        # dead — drop everything and exit".
+        self._gen = 0
+        self._restarts = 0
+        # (generation, t_start) of the step currently executing, or None
+        # between steps.  Generation-tagged so a zombie's cleanup cannot
+        # clear the replacement thread's timer.
+        self._step_started = None
+        self._thread = threading.Thread(target=self._loop, args=(0,),
                                         name="llm-engine", daemon=True)
+        self._watchdog = None
         self._started = False
+
+    @property
+    def restarts(self) -> int:
+        return self._restarts
 
     # ------------------------------------------------------------------
     # any-thread API
@@ -113,6 +170,11 @@ class EngineRunner:
         if not self._started:
             self._started = True
             self._thread.start()
+            if self.step_deadline_s is not None \
+                    and self._engine_factory is not None:
+                self._watchdog = threading.Thread(
+                    target=self._watch, name="llm-watchdog", daemon=True)
+                self._watchdog.start()
         return self
 
     def submit(self, prompt, *, deliver, deadline_s: float | None = None,
@@ -183,27 +245,41 @@ class EngineRunner:
             self._thread.join(timeout=5.0)
         return drained
 
+    def abort_all(self, reason: str = "shutdown") -> int:
+        """Queue an abort for every request still in flight (applied at
+        the next step boundary); returns how many were queued.  The CLI's
+        second-SIGINT escalation: a graceful drain already in progress
+        completes as soon as these aborts land."""
+        with self._lock:
+            ids = [h.request_id for h in self._handles.values()
+                   if not h.done]
+        for request_id in ids:
+            self.abort(request_id, reason)
+        return len(ids)
+
     def close(self, *, abort_inflight: bool = True) -> None:
         """Impatient shutdown: abort whatever is still in flight (reason
         "shutdown"), then stop the thread."""
         if abort_inflight:
             with self._lock:
-                ids = list(self._handles)
                 self._draining = True
-            for request_id in ids:
-                self.abort(request_id, reason="shutdown")
+            self.abort_all("shutdown")
         self.drain(timeout_s=30.0)
 
     # ------------------------------------------------------------------
     # engine thread
     # ------------------------------------------------------------------
 
-    def _finish_handle(self, h, out) -> None:
-        # engine thread only; lock held by caller where required
-        if h.done:
-            return
-        h.done = True
+    def _finish_handle(self, h, out, gen: int | None = None) -> None:
+        # engine thread only.  ``gen`` guards a stale engine's finish:
+        # after a rebuild the replacement owns the handle, so the old
+        # engine's terminal event must be dropped, not delivered.
         with self._lock:
+            if gen is not None and gen != self._gen:
+                return
+            if h.done:
+                return
+            h.done = True
             self._handles.pop(h.request_id, None)
             if h.rid >= 0:
                 self._by_rid.pop(h.rid, None)
@@ -213,44 +289,61 @@ class EngineRunner:
         except Exception:
             pass                      # a dead consumer must not kill the loop
 
-    def _admit_inbox(self) -> None:
-        eng = self.engine
-        while True:
-            with self._lock:
-                if not self._inbox:
-                    return
-                h = self._inbox.popleft()
-            if h.done:                # aborted while still queued
-                continue
+    def _admit_one(self, eng, h, gen: int, generated=None) -> bool:
+        """Admit one handle into ``eng`` with generation-guarded
+        callbacks.  ``generated`` is the recovery journal (continuation
+        replay); None for a first admission."""
 
-            def _on_token(rid, tok, h=h):
+        def _on_token(rid, tok, h=h, g=gen):
+            # guard + journal append + delivery under ONE lock hold:
+            # the recovery snapshot (which bumps _gen under the same
+            # lock before reading h.emitted) can therefore never miss a
+            # delivered token or race a zombie into a duplicate
+            with self._lock:
+                if g != self._gen or h.done:
+                    return
+                h.emitted.append(tok)
                 try:
                     h.deliver(("token", tok))
                 except Exception:
                     pass
 
-            def _on_finish(out, h=h):
-                self._finish_handle(h, out)
+        def _on_finish(out, h=h, g=gen):
+            self._finish_handle(h, out, gen=g)
 
-            params = dict(h.params)
-            prompt = params.pop("prompt")
-            try:
-                rid = eng.add_request(prompt, on_token=_on_token,
-                                      on_finish=_on_finish, **params)
-            except Exception as e:
-                from ..serving import RequestOutput
-                self._finish_handle(h, RequestOutput(
-                    rid=-1, prompt=list(prompt), generated=[],
-                    finish_reason=f"error: {type(e).__name__}: {e}"))
-                continue
-            h.rid = rid
-            with self._lock:
-                self._by_rid[rid] = h
+        params = dict(h.params)
+        prompt = params.pop("prompt")
+        if generated is not None:
+            params["generated"] = list(generated)
+        try:
+            rid = eng.add_request(prompt, on_token=_on_token,
+                                  on_finish=_on_finish, **params)
+        except Exception as e:
+            from ..serving import RequestOutput
+            self._finish_handle(h, RequestOutput(
+                rid=-1, prompt=list(prompt), generated=list(h.emitted),
+                finish_reason=f"error: {type(e).__name__}: {e}"))
+            return False
+        h.rid = rid
+        with self._lock:
+            self._by_rid[rid] = h
+        return True
 
-    def _apply_aborts(self) -> None:
+    def _admit_inbox(self, gen: int) -> None:
+        eng = self.engine
         while True:
             with self._lock:
-                if not self._aborts:
+                if gen != self._gen or not self._inbox:
+                    return
+                h = self._inbox.popleft()
+            if h.done:                # aborted while still queued
+                continue
+            self._admit_one(eng, h, gen)
+
+    def _apply_aborts(self, gen: int) -> None:
+        while True:
+            with self._lock:
+                if gen != self._gen or not self._aborts:
                     return
                 request_id, reason = self._aborts.popleft()
                 h = self._handles.get(request_id)
@@ -266,7 +359,7 @@ class EngineRunner:
                     rid=-1, prompt=[], generated=[], finish_reason=reason))
                 self.engine.stats.record_abort(reason)
 
-    def _sweep_deadlines(self) -> None:
+    def _sweep_deadlines(self, gen: int) -> None:
         now = time.monotonic()
         with self._lock:
             expired = [h.request_id for h in self._handles.values()
@@ -276,19 +369,128 @@ class EngineRunner:
             with self._lock:
                 self._aborts.append((request_id, "deadline"))
         if expired:
-            self._apply_aborts()
+            self._apply_aborts(gen)
 
-    def _loop(self) -> None:
-        eng = self.engine
+    # -- supervised recovery -----------------------------------------------
+
+    def _recover(self, gen: int):
+        """Rebuild the engine after a crashed/hung step and replay the
+        in-flight set from the journal.  Returns the new generation, or
+        None when recovery is off/raced/exhausted (the runner stops).
+        Called from the stepping thread (crash) or the watchdog (hang);
+        the generation check under the lock makes the two racers safe —
+        exactly one wins."""
+        with self._lock:
+            if gen != self._gen:
+                return None           # someone else already recovered
+            self._gen += 1
+            newgen = self._gen
+            self._restarts += 1
+            restarts = self._restarts
+            live = [h for h in self._handles.values() if not h.done]
+            # the journal snapshot: taken AFTER the generation bump, so
+            # no old-generation callback can append past this point
+            replay = [(h, list(h.emitted)) for h in live if h.rid >= 0]
+            requeue = [h for h in live
+                       if h.rid < 0 and h not in self._inbox]
+        old = self.engine
+        if self._engine_factory is None or restarts > self.max_restarts:
+            from ..serving import RequestOutput
+            for h in live:
+                self._finish_handle(h, RequestOutput(
+                    rid=-1, prompt=list(h.params.get("prompt", [])),
+                    generated=list(h.emitted),
+                    finish_reason="engine_error"))
+            with self._lock:
+                self._stopped = True
+            self._wake.set()
+            return None
+        # detach the shared fault plan / pressure controller from the
+        # dead engine FIRST: a hung step finishing on the zombie thread
+        # must not consume scheduled faults or feed the controller stale
+        # pool readings while the replacement runs
+        plan = getattr(old, "fault_plan", None)
+        pressure = getattr(old, "pressure", None)
+        if plan is not None:
+            old.set_fault_plan(None)
+        if pressure is not None:
+            old.pressure = None
+        eng = self._engine_factory()
+        # metric continuity: the service's stats survive the engine
+        eng.stats = old.stats
+        eng.stats.record_restart()
+        if plan is not None:
+            eng.set_fault_plan(plan)
+        eng.pressure = pressure
+        self.engine = eng
+        # replay admitted requests in submission order (dict order) as
+        # continuations of their journals; failures fail only that handle
+        for h, emitted in replay:
+            h.rid = -1
+            cap = int(h.params.get("max_new_tokens", 32))
+            if len(emitted) >= cap:
+                # the crash lost only the terminal event — the journal
+                # already holds the whole budget
+                from ..serving import RequestOutput
+                self._finish_handle(h, RequestOutput(
+                    rid=-1, prompt=list(h.params.get("prompt", [])),
+                    generated=list(emitted), finish_reason="length"))
+                continue
+            self._admit_one(eng, h, newgen,
+                            generated=emitted if emitted else None)
+        with self._lock:
+            for h in requeue:        # popped from the inbox mid-crash
+                self._inbox.append(h)
+        self._wake.set()
+        return newgen
+
+    def _watch(self) -> None:
+        """Watchdog thread: when the current step has run past
+        step_deadline_s, recover and spawn a replacement stepping
+        thread.  The wedged thread exits at its next generation check;
+        its late callbacks are dropped by the generation guard."""
+        poll = min(self.step_deadline_s / 4.0, 0.05)
         while True:
             with self._lock:
                 if self._stopped:
                     return
-            self._apply_aborts()
-            self._sweep_deadlines()
-            self._admit_inbox()
-            if eng.has_unfinished():
-                eng.step()
+                gen = self._gen
+            ss = self._step_started
+            if ss is not None and ss[0] == gen \
+                    and time.monotonic() - ss[1] > self.step_deadline_s:
+                newgen = self._recover(gen)
+                if newgen is not None:
+                    t = threading.Thread(target=self._loop, args=(newgen,),
+                                         name=f"llm-engine-g{newgen}",
+                                         daemon=True)
+                    self._thread = t
+                    t.start()
+            time.sleep(poll)
+
+    def _loop(self, gen: int) -> None:
+        while True:
+            with self._lock:
+                if self._stopped or gen != self._gen:
+                    return
+            eng = self.engine
+            try:
+                self._apply_aborts(gen)
+                self._sweep_deadlines(gen)
+                self._admit_inbox(gen)
+                if eng.has_unfinished():
+                    self._step_started = (gen, time.monotonic())
+                    try:
+                        eng.step()
+                    finally:
+                        ss = self._step_started
+                        if ss is not None and ss[0] == gen:
+                            self._step_started = None
+                    continue
+            except Exception:
+                newgen = self._recover(gen)
+                if newgen is None:
+                    return
+                gen = newgen
                 continue
             with self._lock:
                 idle = not self._inbox and not self._aborts \

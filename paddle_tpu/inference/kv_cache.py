@@ -102,6 +102,11 @@ class BlockManager:
         self.cache_miss_tokens = 0
         self.cow_count = 0
         self.eviction_count = 0
+        self.parked_evicted = 0
+        # fault-injection seam: a nullary callable returning True while a
+        # FaultPlan simulates pool exhaustion (allocation pressure without
+        # shrinking the pool); None -> zero cost
+        self._fault_hook = None
 
     # -- capacity queries ---------------------------------------------------
 
@@ -122,6 +127,8 @@ class BlockManager:
         return (self.num_blocks - 1) - len(self._free) - len(self._cached)
 
     def can_allocate(self, n_blocks: int) -> bool:
+        if self._fault_hook is not None and self._fault_hook():
+            return False
         # cached pages are evictable, so they count as available
         return n_blocks <= len(self._free) + len(self._cached)
 
@@ -130,6 +137,8 @@ class BlockManager:
     def _take_block(self) -> int:
         """One fresh page: free list first, else evict the LRU cached page
         (the only moment a cached page loses its registered content)."""
+        if self._fault_hook is not None and self._fault_hook():
+            raise BlockPoolExhausted("injected pool exhaustion")
         if self._free:
             return self._free.pop()
         if self._cached:
@@ -246,7 +255,9 @@ class BlockManager:
         hit_blocks = hits + ([partial] if partial is not None else [])
         fresh = self.blocks_for(len(ids)) - len(hit_blocks)
         evictable_hits = sum(1 for b in hit_blocks if b in self._cached)
-        if fresh > len(self._free) + len(self._cached) - evictable_hits:
+        if fresh > len(self._free) + len(self._cached) - evictable_hits \
+                or (fresh > 0 and self._fault_hook is not None
+                    and self._fault_hook()):
             return None
         for b in hit_blocks:
             self._incref(b)
@@ -500,6 +511,22 @@ class BlockManager:
             self._decref(b)
         self._freed.add(seq_id)
 
+    def evict_parked(self, n: int) -> int:
+        """Proactively evict up to ``n`` LRU parked (refcount-0 cached)
+        pages back to the free list — the degradation controller's
+        tier-3 lever: trade future prefix-cache hits for immediate
+        allocation headroom.  Counted separately from demand evictions
+        (``eviction_count`` is _take_block's last-resort path).
+        Returns the number of pages actually evicted."""
+        done = 0
+        while done < int(n) and self._cached:
+            blk, _ = self._cached.popitem(last=False)     # oldest first
+            self._unregister(blk)
+            self._free.append(blk)
+            done += 1
+        self.parked_evicted += done
+        return done
+
     def has(self, seq_id) -> bool:
         return seq_id in self._tables
 
@@ -562,6 +589,7 @@ class BlockManager:
             "cache_miss_tokens": self.cache_miss_tokens,
             "cow_count": self.cow_count,
             "eviction_count": self.eviction_count,
+            "parked_evicted": self.parked_evicted,
         }
 
     # -- invariants (test surface) ------------------------------------------

@@ -76,6 +76,7 @@ from jax import lax
 from ..models.llama import _rms_weight, _rope_positions
 from ..ops.pallas import paged_attention as _pa
 from ..profiler import RecordEvent, ServingStats
+from .faults import InjectedFault
 from .kv_cache import NULL_BLOCK, BlockManager, BlockPoolExhausted
 from .sampling import make_samp, samp_structs, sample_tokens
 
@@ -187,7 +188,8 @@ class LLMEngine:
                  enable_prefix_caching: bool = True,
                  drafter=None, spec_k: int = 0, max_spec_k: int = 8,
                  spec_accept_floor: float = 0.35, spec_window: int = 32,
-                 retain_outputs: bool = True):
+                 retain_outputs: bool = True,
+                 fault_plan=None, pressure=None):
         cfg = model.config
         self.config = cfg
         self.params = model.decode_params()
@@ -281,6 +283,22 @@ class LLMEngine:
         self._evictions_seen = 0
         self.stats = ServingStats()
 
+        # fault-tolerance surfaces: a FaultPlan drives deterministic
+        # chaos through the step/pool seams (None -> one attribute check
+        # per step); a DegradationController (inference/pressure.py)
+        # sheds load in tiers before preemption becomes necessary
+        self.fault_plan = None
+        self.set_fault_plan(fault_plan)
+        self.pressure = pressure
+
+    def set_fault_plan(self, plan) -> None:
+        """Install (or clear) a FaultPlan on this engine and its pool.
+        The runner re-installs the same plan on a rebuilt engine, so a
+        schedule survives recovery with its consumed faults consumed."""
+        self.fault_plan = plan
+        self.blocks._fault_hook = plan.pool_exhausted \
+            if plan is not None else None
+
     # ------------------------------------------------------------------
     # request API
     # ------------------------------------------------------------------
@@ -289,11 +307,27 @@ class LLMEngine:
                     temperature: float = 0.0, eos_token_id=None,
                     seed: int = 0, top_k: int = 0, top_p: float = 1.0,
                     repetition_penalty: float = 1.0,
-                    spec_k: int | None = None,
+                    spec_k: int | None = None, generated=None,
                     on_token=None, on_finish=None) -> int:
+        """Queue one generation request; returns its rid.
+
+        ``generated`` re-admits a request that already emitted tokens
+        (the runner's crash-recovery replay): the request enters exactly
+        as a preempted sequence would — prefill covers prompt+generated,
+        ``max_new_tokens`` still counts from the ORIGINAL prompt — so
+        with the same seed the continuation is byte-identical to the
+        uninterrupted run (sampling keys derive from (seed,
+        len(generated)), and the prefix cache makes the re-prefill
+        cheap when the old engine's pages survived).
+        """
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
+        generated = [int(t) for t in (generated or [])]
+        if len(generated) >= int(max_new_tokens):
+            raise ValueError(
+                f"continuation already holds {len(generated)} of "
+                f"max_new_tokens={max_new_tokens} tokens")
         if len(prompt) + int(max_new_tokens) > self.max_model_len:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens "
@@ -312,7 +346,9 @@ class LLMEngine:
             if self.drafter is not None else 0
         rid = self._next_rid
         self._next_rid += 1
-        req = Request(rid=rid, prompt=prompt, tokens=list(prompt),
+        req = Request(rid=rid, prompt=prompt,
+                      tokens=list(prompt) + generated,
+                      generated=list(generated),
                       max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature),
                       eos_token_id=eos_token_id, seed=int(seed),
@@ -323,6 +359,7 @@ class LLMEngine:
         if req.repetition_penalty != 1.0:
             req.seen = np.zeros((self.config.vocab_size,), bool)
             req.seen[prompt] = True
+            req.seen[generated] = True
         self._waiting.append(req)
         return rid
 
@@ -346,9 +383,10 @@ class LLMEngine:
 
         Returns the partial RequestOutput, or None when request_id is
         unknown or already finished (an abort racing a natural finish is
-        a benign no-op).  Must be called from the engine's stepping
-        thread, between steps — the frontend's EngineRunner queues
-        cross-thread aborts and applies them at the next step boundary.
+        a benign, COUNTED no-op — ``stats.abort_noops`` — never an
+        error).  Must be called from the engine's stepping thread,
+        between steps — the frontend's EngineRunner queues cross-thread
+        aborts and applies them at the next step boundary.
         """
         req = None
         for r in self._running:
@@ -364,6 +402,7 @@ class LLMEngine:
                     self._waiting.remove(r)
                     break
         if req is None:
+            self.stats.record_abort_noop()
             return None
         # a waiting request normally holds no pages — unless it was
         # preempted after generating (pages freed then) or never admitted
@@ -476,6 +515,31 @@ class LLMEngine:
         retire.  Returns the requests that finished during this step."""
         finished = []
 
+        plan = self.fault_plan
+        if plan is not None:
+            # fault seams fire BEFORE any scheduler mutation, so a crash
+            # leaves queues and pool in the consistent between-steps
+            # state recovery replays from
+            plan.advance()
+            if plan.take_pool_entry():
+                self.stats.record_fault("pool")
+            slow = plan.take_slow()
+            if slow > 0.0:
+                self.stats.record_fault("slow")
+                time.sleep(slow)
+            if plan.take_crash():
+                self.stats.record_fault("crash")
+                raise InjectedFault(
+                    f"injected step crash at plan step {plan.step}")
+
+        if self.pressure is not None:
+            self.pressure.update(self.blocks)
+            self.stats.set_degradation_state(self.pressure.state)
+            if self.pressure.evict_now:
+                n = self.blocks.evict_parked(self.pressure.evict_batch)
+                if n:
+                    self.stats.record_parked_evictions(n)
+
         admitted = self._admit()
         if admitted:
             self.stats.record_admission(len(admitted))
@@ -508,11 +572,12 @@ class LLMEngine:
         if chunks or spec or batch:
             t0 = time.perf_counter()
             with RecordEvent("llm_engine.ragged_step"):
-                sampled, spec_logits, chunk_slots, batch_slots = \
-                    self._run_ragged(chunks, spec, batch)
+                sampled, ok, spec_ok, spec_logits, chunk_slots, \
+                    batch_slots = self._run_ragged(chunks, spec, batch)
             dur = time.perf_counter() - t0
-            self._apply_ragged(chunks, spec, batch, sampled, spec_logits,
-                               chunk_slots, batch_slots, dur, finished)
+            self._apply_ragged(chunks, spec, batch, sampled, ok, spec_ok,
+                               spec_logits, chunk_slots, batch_slots,
+                               dur, finished)
 
         ev = self.blocks.eviction_count
         if ev != self._evictions_seen:
@@ -520,13 +585,20 @@ class LLMEngine:
             self._evictions_seen = ev
         return finished
 
-    def _apply_ragged(self, chunks, spec, batch, sampled, spec_logits,
-                      chunk_slots, batch_slots, dur, finished):
+    def _apply_ragged(self, chunks, spec, batch, sampled, ok, spec_ok,
+                      spec_logits, chunk_slots, batch_slots, dur,
+                      finished):
         """Advance every packed row from the launch's outputs: chunk rows
         commit their prefix (emitting a first token when the prompt
         completes), spec rows run host-side draft acceptance, decode rows
-        emit one token.  The launch duration splits across the stats
-        channels pro-rata by packed tokens."""
+        emit one token.  A row whose logits came back non-finite is
+        QUARANTINED before any of its state commits — the offending
+        sequence retires with finish_reason="numerical_error" and its
+        pages leave through the abort-hardened release path (never the
+        cache-registering free path), so one poison row cannot spread
+        through the prefix cache or take down its batchmates.  The
+        launch duration splits across the stats channels pro-rata by
+        packed tokens."""
         chunk_tokens = sum(n for _, n in chunks)
         spec_tokens = sum(len(d) + 1 for _, d, _ in spec)
         total = max(chunk_tokens + spec_tokens + len(batch), 1)
@@ -534,6 +606,9 @@ class LLMEngine:
 
         done = 0
         for (req, n), s in zip(chunks, chunk_slots):
+            if not ok[s]:
+                self._quarantine(req, finished)
+                continue
             req.cached += n
             if self.enable_prefix_caching:
                 self.blocks.commit_prefill(req.rid, n)
@@ -554,13 +629,20 @@ class LLMEngine:
 
         if spec:
             n_emitted = 0
-            for (req, drafts, qd), lg in zip(spec, spec_logits):
+            for i, ((req, drafts, qd), lg) in enumerate(
+                    zip(spec, spec_logits)):
+                if not spec_ok[i]:
+                    self._quarantine(req, finished)
+                    continue
                 n_emitted += self._apply_spec_result(req, drafts, qd, lg,
                                                      finished)
             self.stats.record_verify(dur * spec_tokens / total,
                                      n_emitted, occ)
 
         for req, s in zip(batch, batch_slots):
+            if not ok[s]:
+                self._quarantine(req, finished)
+                continue
             if self.enable_prefix_caching:
                 self.blocks.commit_decode_token(req.rid,
                                                 req.generated[-1])
@@ -574,6 +656,31 @@ class LLMEngine:
         if batch:
             self.stats.record_decode(dur * len(batch) / total,
                                      len(batch), occ)
+
+    def _quarantine(self, req, finished: list) -> None:
+        """Retire one sequence whose step logits came back non-finite.
+
+        The sequence's pages leave through ``release`` (decref-only:
+        pages shared with healthy neighbours survive, and the possibly-
+        corrupt unshared tail is dropped WITHOUT registering in the
+        prefix cache — corrupt K/V must never become a future cache
+        hit).  Clients see finish_reason="numerical_error"; the rest of
+        the batch is untouched."""
+        self.blocks.release(req.rid)
+        self._running.remove(req)
+        self._release_slot(req)
+        if self.drafter is not None:
+            self.drafter.release(req.rid)
+        out = RequestOutput(rid=req.rid, prompt=list(req.prompt),
+                            generated=list(req.generated),
+                            finish_reason="numerical_error")
+        if self.retain_outputs:
+            self._finished[req.rid] = out
+        finished.append(out)
+        self.stats.record_quarantine()
+        self.stats.record_abort("numerical_error")
+        if req.on_finish is not None:
+            req.on_finish(out)
 
     def _claim_slot(self, req) -> None:
         req.slot = self._slot_used.index(False)
@@ -590,6 +697,8 @@ class LLMEngine:
         prompt's token chain against the cache and allocates only the
         miss suffix; chunked prefill means admission is no longer gated
         on the per-step token budget."""
+        if self.pressure is not None and self.pressure.admission_paused:
+            return []
         admitted = []
         while self._waiting and len(self._running) < self.max_num_seqs:
             req = self._waiting[0]
@@ -744,8 +853,13 @@ class LLMEngine:
         if self.drafter is None:
             return [], batch
         spec, plain = [], []
+        cap = self.max_spec_k
+        if self.pressure is not None:
+            # under pressure, shrinking drafts is the cheapest lever:
+            # verify windows are the largest transient page consumers
+            cap = self.pressure.spec_k_cap(self.max_spec_k)
         for req in batch:
-            k = 0 if req.spec_disabled else req.spec_k
+            k = 0 if req.spec_disabled else min(req.spec_k, cap)
             # the verify step writes K/V at cached..cached+k, so the
             # sequence may hold at most max_model_len tokens afterwards;
             # drafting past max_new_tokens (plus the bonus token) is waste
@@ -991,9 +1105,14 @@ class LLMEngine:
             logits = (hsel.astype(jnp.float32)
                       @ params["head"].astype(jnp.float32))   # [Lq, V]
             sampled = sample_tokens(logits, samp)
+            # per-row finiteness flag: the quarantine guard retires a
+            # poisoned row host-side without touching its batchmates
+            # (padded rows may be legitimately non-finite; the host only
+            # consults live slots)
+            fin = jnp.all(jnp.isfinite(logits), axis=-1)      # [Lq]
             if with_logits:
-                return sampled, logits, kc, vc
-            return sampled, kc, vc
+                return sampled, fin, logits, kc, vc
+            return sampled, fin, kc, vc
 
         # donation reuses the pool buffers in place; _get_ragged_prog
         # drops it on CPU (that runtime cannot alias and warns per call)
@@ -1005,15 +1124,15 @@ class LLMEngine:
         self.pad_stats["padded"] += int(Tq)
         prog = self._get_ragged_prog(Tq)
         if self._with_logits:
-            sampled, logits, self._kc, self._vc = prog(
+            sampled, fin, logits, self._kc, self._vc = prog(
                 self.params, self._kc, self._vc, toks, cu, kvl, bt,
                 lidx, samp)
         else:
-            sampled, self._kc, self._vc = prog(
+            sampled, fin, self._kc, self._vc = prog(
                 self.params, self._kc, self._vc, toks, cu, kvl, bt,
                 lidx, samp)
             logits = None
-        return sampled, logits
+        return sampled, logits, fin
 
     def _fill_samp(self, samp, s, req):
         samp["temps"][s] = req.temperature
@@ -1032,8 +1151,9 @@ class LLMEngine:
 
         Row order: prefill chunks (scheduler order), speculative
         [last_token, drafts...] windows, plain decode tokens (slot
-        order).  Returns (sampled tokens, per-spec-row logits, chunk
-        logit slots, decode logit slots)."""
+        order).  Returns (sampled tokens, per-logit-row finite flags,
+        per-spec-row finite flags, per-spec-row logits, chunk logit
+        slots, decode logit slots)."""
         total = sum(n for _, n in chunks) \
             + sum(len(d) + 1 for _, d, _ in spec) + len(batch)
         Tq = self._ragged_bucket(total)
@@ -1100,13 +1220,18 @@ class LLMEngine:
             req.bt_version = -1
         self._d_layout = ()
 
-        sampled, logits = self._launch_ragged(Tq, toks, cu, kvl, bt,
-                                              lidx, samp, total)
+        sampled, logits, fin = self._launch_ragged(Tq, toks, cu, kvl, bt,
+                                                   lidx, samp, total)
+        ok = np.asarray(fin)
+        ok = self._inject_nan(ok, chunk_slots + batch_slots
+                              + [o for o, _ in spec_slices])
+        spec_ok = [bool(ok[o:o + n].all()) for o, n in spec_slices]
         spec_logits = None
         if spec:
             logits = np.asarray(logits)
             spec_logits = [logits[o:o + n] for o, n in spec_slices]
-        return np.asarray(sampled), spec_logits, chunk_slots, batch_slots
+        return (np.asarray(sampled), ok, spec_ok, spec_logits,
+                chunk_slots, batch_slots)
 
     def _run_ragged_decode(self, batch: list, Tq: int):
         """Pure-decode launch over the persistent host buffers.  Rows
@@ -1150,10 +1275,29 @@ class LLMEngine:
             if req.temperature > 0.0:
                 samp["keys"][s] = self._req_key(req)
         self.pad_stats["legacy_padded"] += self.max_num_seqs
-        sampled, _ = self._launch_ragged(Tq, self._d_toks, self._d_cu,
-                                         self._d_kvl, self._d_bt,
-                                         self._d_lidx, samp, n)
-        return np.asarray(sampled), None, [], list(range(n))
+        sampled, _, fin = self._launch_ragged(Tq, self._d_toks,
+                                              self._d_cu, self._d_kvl,
+                                              self._d_bt, self._d_lidx,
+                                              samp, n)
+        ok = self._inject_nan(np.asarray(fin), list(range(n)))
+        return np.asarray(sampled), ok, [], None, [], list(range(n))
+
+    def _inject_nan(self, ok, live_slots: list):
+        """FaultPlan NaN seam: corrupt one LIVE logit row's finiteness
+        flag, as if the device had produced a non-finite row there.
+        Flipping the host-side flag (rather than the device logits)
+        keeps the injection exact and free when no plan is set; the
+        quarantine path downstream is the same either way."""
+        plan = self.fault_plan
+        if plan is None or not live_slots:
+            return ok
+        j = plan.take_nan_row(len(live_slots))
+        if j is None:
+            return ok
+        ok = ok.copy()
+        ok[live_slots[j]] = False
+        self.stats.record_fault("nan")
+        return ok
 
     def _req_key(self, req):
         # key for token i of request r depends only on (seed, i): sampling

@@ -194,8 +194,8 @@ class DraftModelDrafter(Drafter):
         bt[0] = eng.blocks.padded_table(rid, eng.nblk)
         lidx = np.asarray([g - 1], np.int32)
         samp = make_samp(1, eng.config.vocab_size)    # greedy defaults
-        sampled, _ = eng._launch_ragged(Tq, toks, cu, kvl, bt, lidx,
-                                        samp, g)
+        sampled, _, _ = eng._launch_ragged(Tq, toks, cu, kvl, bt, lidx,
+                                           samp, g)
         return int(np.asarray(sampled)[0])
 
     def _decode(self, rid, tok, pos):
@@ -208,8 +208,8 @@ class DraftModelDrafter(Drafter):
         bt[0] = eng.blocks.padded_table(rid, eng.nblk)
         lidx = np.zeros((1,), np.int32)
         samp = make_samp(1, eng.config.vocab_size)    # greedy defaults
-        sampled, _ = eng._launch_ragged(eng._ragged_bucket(1), toks, cu,
-                                        kvl, bt, lidx, samp, 1)
+        sampled, _, _ = eng._launch_ragged(eng._ragged_bucket(1), toks,
+                                           cu, kvl, bt, lidx, samp, 1)
         return int(np.asarray(sampled)[0])
 
 

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 
 __all__ = ["ServingStats"]
 
@@ -145,6 +146,15 @@ class ServingStats:
         # request-lifecycle surface (PR 5: the HTTP frontend)
         self.aborts = 0                  # aborted before finishing (any reason)
         self.abort_reasons: dict = {}    # finish_reason -> count
+        self.abort_noops = 0             # aborts of finished/unknown rids
+        # fault-tolerance surface (PR 7: recovery/quarantine/degradation)
+        self.engine_restarts = 0         # supervised engine rebuilds
+        self.quarantined = 0             # sequences retired for NaN logits
+        self.fault_injections: dict = {} # injected fault kind -> count
+        self.degradation_state = 0       # current pressure tier (gauge)
+        self.degradation_transitions = 0 # tier changes (counter)
+        self.parked_evictions = 0        # pages evicted by tier-3 pressure
+        self._t_start = time.monotonic() # process-lifetime uptime anchor
 
     # -- recording (engine-facing) ------------------------------------------
 
@@ -225,6 +235,41 @@ class ServingStats:
     def record_spec_disable(self, n: int = 1) -> None:
         self.spec_disables += int(n)
 
+    def record_abort_noop(self, n: int = 1) -> None:
+        """Abort of an unknown/already-finished request id — benign
+        (an abort racing natural retirement), but counted so a frontend
+        bug that aborts wildly is visible."""
+        self.abort_noops += int(n)
+
+    def record_restart(self, n: int = 1) -> None:
+        """One supervised engine rebuild (crash or hung-step watchdog)."""
+        self.engine_restarts += int(n)
+
+    def record_quarantine(self, n: int = 1) -> None:
+        """One sequence retired with finish_reason='numerical_error'."""
+        self.quarantined += int(n)
+
+    def record_fault(self, kind: str, n: int = 1) -> None:
+        """One injected fault fired (kind: crash/slow/nan/pool/conn)."""
+        self.fault_injections[kind] = \
+            self.fault_injections.get(kind, 0) + int(n)
+
+    def set_degradation_state(self, state: int) -> None:
+        """Current pressure tier; transitions are counted."""
+        state = int(state)
+        if state != self.degradation_state:
+            self.degradation_transitions += 1
+            self.degradation_state = state
+
+    def record_parked_evictions(self, n: int = 1) -> None:
+        self.parked_evictions += int(n)
+
+    def uptime_seconds(self) -> float:
+        """Seconds since these stats were created/reset.  The runner
+        carries one ServingStats across engine rebuilds, so this is the
+        SERVICE uptime, not the current engine's."""
+        return time.monotonic() - self._t_start
+
     # -- derived metrics ----------------------------------------------------
 
     def decode_tokens_per_s(self) -> float:
@@ -289,6 +334,15 @@ class ServingStats:
             "rollback_tokens": self.rollback_tokens,
             "rollback_pages": self.rollback_pages,
             "spec_disables": self.spec_disables,
+            "abort_noops": self.abort_noops,
+            "engine_restarts": self.engine_restarts,
+            "uptime_seconds": round(self.uptime_seconds(), 3),
+            "quarantined": self.quarantined,
+            "fault_injections": dict(self.fault_injections),
+            "faults_injected_total": sum(self.fault_injections.values()),
+            "degradation_state": self.degradation_state,
+            "degradation_transitions": self.degradation_transitions,
+            "parked_evictions": self.parked_evictions,
         }
 
     # summary() predates snapshot() and is the name the engine/benches

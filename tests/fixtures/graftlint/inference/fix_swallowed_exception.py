@@ -1,0 +1,27 @@
+"""Seeds swallowed-exception: a broad handler that eats failures inside
+an inference-tier release path — the watchdog and quarantine logic
+depend on those failures surfacing."""
+
+
+def release_pages(pool, rid):
+    try:
+        pool.release(rid)
+    except Exception:
+        pass
+
+
+def release_pages_carefully(pool, rid, log):
+    # broad but NOT swallowing: the failure is re-raised after logging
+    try:
+        pool.release(rid)
+    except Exception as e:
+        log.warning("release failed: %s", e)
+        raise
+
+
+def close_quietly(sock):
+    # swallowing, but not a step/release/abort/recover path: out of scope
+    try:
+        sock.close()
+    except Exception:
+        pass

@@ -200,6 +200,14 @@ def test_engine_abort_unknown_and_finished_is_noop(model):
     assert eng.abort(rid) is None        # already finished
     assert eng.abort(10_000) is None     # never existed
     assert eng.stats.aborts == 0
+    # the no-ops are COUNTED (idempotency is observable, not silent)
+    assert eng.stats.abort_noops == 2
+    assert eng.abort(rid) is None        # idempotent: call it again
+    assert eng.stats.abort_noops == 3
+    assert eng.stats.snapshot()["abort_noops"] == 3
+    # pool untouched by the no-ops
+    assert eng.blocks.num_used == 0
+    eng.blocks.check_invariants()
 
 
 def test_engine_abort_shared_prefix_keeps_cache(model):

@@ -1,0 +1,135 @@
+"""CLI shutdown contract for ``python -m paddle_tpu.inference.frontend``:
+one SIGINT drains gracefully (exit 0), a second SIGINT during the drain
+escalates to aborting the in-flight set."""
+import http.client
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(os.name != "posix",
+                                reason="POSIX signals required")
+
+
+class _Server:
+    """The frontend CLI as a subprocess, stdout pumped to a list."""
+
+    def __init__(self, *extra_args):
+        self.proc = subprocess.Popen(
+            [sys.executable, "-u", "-m", "paddle_tpu.inference.frontend",
+             "--model", "tiny", "--port", "0", *extra_args],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        self.lines = []
+        threading.Thread(target=self._pump, daemon=True).start()
+
+    def _pump(self):
+        for line in self.proc.stdout:
+            self.lines.append(line)
+
+    def output(self) -> str:
+        return "".join(self.lines)
+
+    def wait_for(self, substr, timeout_s=120.0) -> bool:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout_s:
+            if substr in self.output():
+                return True
+            if self.proc.poll() is not None:
+                return substr in self.output()
+            time.sleep(0.05)
+        return False
+
+    def port(self) -> int:
+        assert self.wait_for("listening on"), self.output()
+        m = re.search(r"listening on http://[\d.]+:(\d+)", self.output())
+        assert m, self.output()
+        return int(m.group(1))
+
+    def kill(self):
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+
+
+def _stream_in_thread(port, max_tokens):
+    """Open a streaming completion and read it to the end (or until the
+    server closes it); returns the collector dict."""
+    got = {"frames": 0, "finish": None}
+
+    def run():
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=120)
+            body = json.dumps({"prompt": [1, 2, 3], "stream": True,
+                               "max_tokens": max_tokens}).encode()
+            conn.request("POST", "/v1/completions", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            buf = b""
+            while True:
+                chunk = resp.read(64)
+                if not chunk:
+                    break
+                buf += chunk
+                got["frames"] = buf.count(b"data: ")
+                m = re.search(rb'"finish_reason":\s*"([^"]+)"', buf)
+                if m:
+                    got["finish"] = m.group(1).decode()
+            conn.close()
+        except Exception:
+            pass                       # server-side close mid-read is fine
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    got["thread"] = t
+    return got
+
+
+def test_cli_sigint_drains_and_exits_zero():
+    srv = _Server("--drain-timeout-s", "60")
+    try:
+        srv.port()                         # up and listening
+        srv.proc.send_signal(signal.SIGINT)
+        rc = srv.proc.wait(timeout=90)
+        assert rc == 0, srv.output()
+        out = srv.output()
+        assert "draining" in out
+        assert "drained" in out and "bye" in out
+        assert "DRAIN TIMED OUT" not in out
+    finally:
+        srv.kill()
+
+
+def test_cli_second_sigint_aborts_inflight():
+    srv = _Server("--drain-timeout-s", "120", "--max-model-len", "512")
+    try:
+        port = srv.port()
+        # a long stream keeps the drain busy well past the second signal
+        got = _stream_in_thread(port, max_tokens=400)
+        t0 = time.monotonic()
+        while got["frames"] < 2 and time.monotonic() - t0 < 120:
+            time.sleep(0.05)
+        assert got["frames"] >= 2, srv.output()
+
+        srv.proc.send_signal(signal.SIGINT)
+        assert srv.wait_for("draining"), srv.output()
+        time.sleep(0.3)                    # the graceful drain is underway
+        srv.proc.send_signal(signal.SIGINT)
+        rc = srv.proc.wait(timeout=90)
+        assert rc == 0, srv.output()
+        assert "aborting" in srv.output(), srv.output()
+        got["thread"].join(timeout=30)
+        # the aborted stream got its terminal frame (or, at worst, the
+        # closing server won the race and dropped the socket first)
+        assert got["finish"] in ("shutdown", None), got
+    finally:
+        srv.kill()

@@ -44,6 +44,8 @@ def _lint_fix(name):
     ("fix_unkeyed_jit.py", "unkeyed-jit", 6, "call", ERROR),
     (os.path.join("inference", "fix_attention_budget.py"),
      "attention-program-budget", 18, "decode_step", ERROR),
+    (os.path.join("inference", "fix_swallowed_exception.py"),
+     "swallowed-exception", 9, "release_pages", ERROR),
 ])
 def test_ast_fixture_fires_exactly_once(fixture, rule, line, func, severity):
     findings = _lint_fix(fixture)
@@ -244,6 +246,7 @@ def test_every_catalog_rule_is_exercised():
     covered = {
         "numpy-in-jit", "host-sync-in-jit", "tracer-branch",
         "mutable-default-arg", "unkeyed-jit", "attention-program-budget",
+        "swallowed-exception",
         "undonated-buffer", "host-callback", "dtype-promotion",
         "dead-code", "dead-input", "passthrough-output",
     }
@@ -335,10 +338,11 @@ def test_cli_nonzero_on_fixture_tree_json():
     r = _run_cli(_FIX, "--format", "json", "--no-default-baseline")
     assert r.returncode == 1, r.stdout + r.stderr
     doc = json.loads(r.stdout)
-    assert doc["counts"]["ERROR"] == 5          # one per ERROR fixture
+    assert doc["counts"]["ERROR"] == 6          # one per ERROR fixture
     rules = {f["rule"] for f in doc["findings"]}
     assert {"numpy-in-jit", "host-sync-in-jit", "tracer-branch",
-            "unkeyed-jit", "attention-program-budget"} <= rules
+            "unkeyed-jit", "attention-program-budget",
+            "swallowed-exception"} <= rules
 
 
 def test_cli_exit_zero_on_shipped_tree():

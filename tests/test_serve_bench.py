@@ -109,6 +109,34 @@ def test_serve_bench_mixed_emits_padding_surface():
     assert record["p99_token_ms"] >= record["p50_token_ms"] > 0
 
 
+def test_serve_bench_chaos_emits_recovery_surface():
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "--smoke", "--chaos", "--requests", "8"],
+        capture_output=True, text=True, timeout=540,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.strip().splitlines() if ln.strip()]
+    assert lines, f"no stdout; stderr: {out.stderr[-2000:]}"
+    record = json.loads(lines[-1])
+    assert record["metric"] == "serve_chaos_goodput_tokens_per_s"
+    assert "error" not in record, record
+    # goodput survives the schedule: the stream completes THROUGH the
+    # injected crash/hang/NaN/pool faults, not around them
+    assert record["value"] > 0
+    assert record["faults_exhausted"] is True
+    assert record["fault_injections"].get("crash", 0) >= 1
+    assert record["fault_injections"].get("nan", 0) >= 1
+    assert record["fault_injections"].get("slow", 0) >= 1
+    assert record["engine_restarts"] >= 1
+    assert record["quarantined"] >= 1
+    assert record["completed"] + record["quarantined"] \
+        >= record["requests"]
+    # recovery leaks nothing and the runner still drains
+    assert record["leaked_pages"] == 0
+    assert record["pool_clean"] is True
+    assert record["drained"] is True
+
+
 def test_serve_bench_prefix_share_emits_cache_surface():
     out = subprocess.run(
         [sys.executable, SCRIPT, "--smoke", "--prefix-share", "2",

@@ -112,11 +112,11 @@ def test_transfer_rule_fires_on_callback_variant(model):
     spec = eng.program_specs(large_bytes=1 << 10)[0]
 
     def with_callback(*args):
-        out, kc, vc = spec.fn(*args)
+        out, fin, kc, vc = spec.fn(*args)
         logged = jax.pure_callback(
             lambda t: np.asarray(t), jax.ShapeDtypeStruct(out.shape,
                                                           out.dtype), out)
-        return logged, kc, vc
+        return logged, fin, kc, vc
 
     cb_spec = ProgramSpec("serving.ragged_step+cb", with_callback, spec.args,
                           donate_argnums=spec.donate_argnums,

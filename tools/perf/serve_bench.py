@@ -600,6 +600,101 @@ def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str):
     }
 
 
+def run_chaos_bench(smoke: bool, n_requests: int, seed: int, backend: str):
+    """Goodput under injected faults: the ragged request stream runs
+    through the supervised EngineRunner while a seeded FaultPlan crashes
+    a step, hangs a step past the watchdog deadline, poisons a logit
+    row, and fakes a pool-exhaustion window.  Value is tokens delivered
+    to clients per wall second INCLUDING the recovery stalls — the
+    self-healing tax, measured, not estimated."""
+    import queue as queue_mod
+    import time
+
+    import numpy as np
+
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.inference.faults import FaultPlan
+    from paddle_tpu.inference.frontend import EngineRunner
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if smoke or backend == "cpu":
+        cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4,
+                               ffn=64, seq=64)
+        engine_kw = dict(max_num_seqs=8, block_size=8, max_model_len=64,
+                         max_prefill_tokens=64, prefill_token_bucket=128)
+        step_deadline_s, slow_s = 12.0, 30.0
+    else:
+        cfg = LlamaConfig(vocab_size=8192, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=4,
+                          num_attention_heads=8, num_key_value_heads=8,
+                          max_position_embeddings=1024)
+        engine_kw = dict(max_num_seqs=16, block_size=16, max_model_len=1024,
+                         max_prefill_tokens=256, prefill_token_bucket=128)
+        step_deadline_s, slow_s = 30.0, 75.0
+
+    model = LlamaForCausalLM(cfg)
+
+    def factory():
+        return LLMEngine(model, retain_outputs=False, **engine_kw)
+
+    # the full schedule from one seed: one crash (in-thread recovery),
+    # one hang past the watchdog deadline, one NaN row (quarantine), one
+    # pool-exhaustion window (preempt + degradation pressure)
+    plan = FaultPlan.seeded(seed, slow_s=slow_s, horizon=24)
+    engine = factory()
+    engine.set_fault_plan(plan)
+    runner = EngineRunner(engine, max_pending=4 * n_requests,
+                          engine_factory=factory,
+                          step_deadline_s=step_deadline_s).start()
+
+    rng = np.random.RandomState(seed)
+    queues = []
+    t0 = time.perf_counter()
+    for _ in range(n_requests):
+        prompt = rng.randint(0, cfg.vocab_size,
+                             int(rng.randint(4, 17))).tolist()
+        q = queue_mod.Queue()
+        queues.append(q)
+        runner.submit(prompt, deliver=q.put_nowait,
+                      max_new_tokens=int(rng.randint(8, 25)))
+    outs = []
+    for q in queues:
+        while True:
+            kind, val = q.get(timeout=600)
+            if kind == "finish":
+                outs.append(val)
+                break
+    wall = time.perf_counter() - t0
+    drained = runner.drain(timeout_s=60.0)
+    fin = runner.engine
+
+    completed = [o for o in outs if o.finish_reason in ("eos", "length")]
+    good_tokens = sum(len(o.generated) for o in completed)
+    snap = fin.stats.snapshot()
+    return {
+        "metric": "serve_chaos_goodput_tokens_per_s",
+        "value": round(good_tokens / wall, 2) if wall else 0.0,
+        "unit": "tok/s",
+        "backend": backend,
+        "requests": n_requests,
+        "completed": len(completed),
+        "goodput_tokens": good_tokens,
+        "wall_s": round(wall, 3),
+        "engine_restarts": snap["engine_restarts"],
+        "quarantined": snap["quarantined"],
+        "fault_injections": snap["fault_injections"],
+        "faults_exhausted": plan.exhausted(),
+        "degradation_transitions": snap["degradation_transitions"],
+        "preempted": snap["preemptions"],
+        "attention_compiles": fin.compile_counts["ragged"],
+        "leaked_pages": fin.blocks.num_used,
+        "pool_clean": fin.blocks.num_used == 0,
+        "drained": bool(drained),
+        "finish_reasons": sorted({o.finish_reason for o in outs}),
+        "step_deadline_s": step_deadline_s,
+    }
+
+
 def run_bench(smoke: bool, n_requests: int, seed: int, backend: str):
     import numpy as np
 
@@ -681,10 +776,20 @@ def main(argv=None):
                          "stream; report the padding-waste ratio of the "
                          "single ragged program vs the retired per-phase "
                          "programs")
+    ap.add_argument("--chaos", action="store_true",
+                    help="run the stream through the supervised runner "
+                         "under a seeded FaultPlan (crash, hang, NaN row, "
+                         "pool window); report goodput including the "
+                         "recovery stalls")
     args = ap.parse_args(argv)
 
     backend, probe_err = _probe_backend()
-    if args.mixed:
+    if args.chaos:
+        n_requests = args.requests or (8 if (args.smoke or backend == "cpu")
+                                       else 32)
+        record = {"metric": "serve_chaos_goodput_tokens_per_s",
+                  "value": 0.0, "unit": "tok/s", "backend": backend}
+    elif args.mixed:
         n_requests = args.requests or (16 if (args.smoke
                                               or backend == "cpu") else 64)
         record = {"metric": "serve_mixed_tokens_per_s", "value": 0.0,
@@ -712,7 +817,10 @@ def main(argv=None):
     if probe_err:
         record["backend_note"] = f"cpu fallback: {probe_err}"
     try:
-        if args.mixed:
+        if args.chaos:
+            record.update(run_chaos_bench(args.smoke, n_requests, args.seed,
+                                          backend))
+        elif args.mixed:
             record.update(run_mixed_bench(args.smoke, n_requests, args.seed,
                                           backend))
         elif args.http:
