@@ -59,6 +59,36 @@ _COERCIONS = {"float", "int", "bool"}
 
 _ATTEN_RE = re.compile(r"atten", re.IGNORECASE)
 
+# KV-PAGE pool names (kc/vc/k_cache/kv_cache/page_pool...); scale pools
+# (_ks/_vs/scales) deliberately don't match — f32 scales are the contract
+_KV_PAGE_RE = re.compile(
+    r"(^|_)(kc|vc)$|(k|key|v|value)_?cache|kv_?(cache|pages?|pool)"
+    r"|page_?pool", re.IGNORECASE)
+_ALLOC_FNS = {"zeros", "ones", "empty", "full",
+              "zeros_like", "ones_like", "empty_like", "full_like"}
+
+
+def _mentions_float32(call) -> bool:
+    for n in ast.walk(call):
+        if isinstance(n, ast.Attribute) and n.attr == "float32":
+            return True
+        if isinstance(n, ast.Name) and n.id == "float32":
+            return True
+        if isinstance(n, ast.Constant) and n.value == "float32":
+            return True
+    return False
+
+
+def _kv_dtype_test(test) -> bool:
+    """An `if` test comparing a kv_dtype-ish name against "int8"."""
+    has_kv = any(
+        (isinstance(n, ast.Name) and "kv_dtype" in n.id)
+        or (isinstance(n, ast.Attribute) and "kv_dtype" in n.attr)
+        for n in ast.walk(test))
+    has_i8 = any(isinstance(n, ast.Constant) and n.value == "int8"
+                 for n in ast.walk(test))
+    return has_kv and has_i8
+
 _DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([\w\-, ]+)")
 _DISABLE_NEXT_RE = re.compile(r"#\s*graftlint:\s*disable-next=([\w\-, ]+)")
 _SKIP_RE = re.compile(r"#\s*graftlint:\s*skip-file")
@@ -390,12 +420,58 @@ def lint_source(text: str, path: str = "<string>") -> list:
 
         att = sorted((d for d in tops if _mentions_attention(d)),
                      key=lambda d: d.lineno)
-        for d in att[1:]:
+        # kind identity is the def NAME, mirroring the runtime
+        # compile_counts budget keyed by program kind: dtype variants of
+        # the one ragged step (float32 vs quantized int8 pages) share a
+        # name and an engine only ever compiles one of them, while a
+        # phase-special kernel sneaking back in arrives under its own
+        # name (decode_step, prefill_attn, ...)
+        kinds = []
+        for d in att:
+            if all(d.name != k.name for k in kinds):
+                kinds.append(d)
+        for d in kinds[1:]:
             emit("attention-program-budget", d,
                  f"compiled def `{d.name}` is a second attention program "
-                 f"kind in the serving tier (first: `{att[0].name}`) — "
+                 f"kind in the serving tier (first: `{kinds[0].name}`) — "
                  "budget is 1 attention program per engine; route rows "
                  "through the single ragged step instead")
+
+        # ---- quantized-kv-float32-page (serving tier only) ---------------
+        # In the branch an engine takes when configured kv_dtype="int8",
+        # the page pools must be int8 (with f32 SCALE rows in a parallel
+        # pool — scale names don't look like page names).  A float32
+        # allocation bound to a KV-page-like name there silently forfeits
+        # the whole HBM win the quantized format exists for.
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.If) and _kv_dtype_test(node.test)):
+                continue
+            quant = node.body
+            if isinstance(node.test, ast.Compare) and node.test.ops \
+                    and isinstance(node.test.ops[0], ast.NotEq):
+                quant = node.orelse
+            for stmt in quant:
+                for n in ast.walk(stmt):
+                    if not (isinstance(n, ast.Assign)
+                            and isinstance(n.value, ast.Call)):
+                        continue
+                    dd = _dotted(n.value.func) or ()
+                    if not dd or dd[-1] not in _ALLOC_FNS \
+                            or not _mentions_float32(n.value):
+                        continue
+                    tname = next(
+                        (t.attr if isinstance(t, ast.Attribute) else t.id
+                         for t in n.targets
+                         if isinstance(t, (ast.Attribute, ast.Name))),
+                        None)
+                    if tname and _KV_PAGE_RE.search(tname):
+                        emit("quantized-kv-float32-page", n,
+                             f"float32 KV-page allocation `{tname}` in "
+                             "the quantized (kv_dtype == \"int8\") branch "
+                             "— quantized engines store int8 pages with "
+                             "f32 scale rows; a float32 page pool "
+                             "silently forfeits the HBM win",
+                             severity=WARNING)
 
         # ---- swallowed-exception (serving tier only) ---------------------
         # Fault-tolerance contract: failures in step/release/abort/recover

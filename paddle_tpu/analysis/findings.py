@@ -90,6 +90,12 @@ RULES = {
         "is ONE attention program kind (the ragged step); phase-special "
         "attention kernels reintroduce bucket fragmentation and "
         "recompiles")),
+    "quantized-kv-float32-page": (WARNING, "ast", (
+        "a float32 allocation bound to a KV-page-like name inside an "
+        "inference-tier kv_dtype == \"int8\" branch — quantized engines "
+        "store int8 pages (with f32 scale rows in a parallel pool); a "
+        "float32 page pool silently forfeits the ~4x HBM headroom the "
+        "format exists for")),
     "swallowed-exception": (ERROR, "ast", (
         "a bare/broad `except` that only passes (or logs and continues) "
         "inside an inference-tier step/release/abort/recover path — the "

@@ -56,6 +56,7 @@ def _build_engine(args):
             max_prefill_tokens=args.max_prefill_tokens,
             enable_prefix_caching=not args.no_prefix_caching,
             drafter=drafter, spec_k=args.spec_k,
+            kv_dtype=args.kv_dtype,
             retain_outputs=False)
 
     return make_engine
@@ -76,6 +77,11 @@ def main(argv=None) -> int:
                     help="0 = the preset's max_position_embeddings")
     ap.add_argument("--max-prefill-tokens", type=int, default=512)
     ap.add_argument("--no-prefix-caching", action="store_true")
+    ap.add_argument("--kv-dtype", default="float32",
+                    choices=["float32", "int8"],
+                    help="KV page storage dtype; int8 quarters the page "
+                         "pool's HBM cost (per-page scales, in-kernel "
+                         "dequant) for 2x+ resident sequences")
     ap.add_argument("--spec-k", type=int, default=0,
                     help="speculative draft length (0 disables; >0 enables "
                          "the n-gram drafter)")

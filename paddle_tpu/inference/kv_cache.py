@@ -94,6 +94,11 @@ class BlockManager:
         self._chain: dict = {}           # seq id -> per-full-page chain hashes
         self._version: dict = {}         # seq id -> table mutation counter
         self._freed: set = set()         # for clear double-free errors
+        # pages handed out since the last drain_fresh(): their previous
+        # content (and, in int8 mode, their quantization scales) is dead.
+        # The quantized engine drains this each step and resets the scale
+        # rows device-side before any new write lands.
+        self._fresh: set = set()
         # counters for the scheduler stats surface
         self.alloc_count = 0
         self.free_count = 0
@@ -140,11 +145,14 @@ class BlockManager:
         if self._fault_hook is not None and self._fault_hook():
             raise BlockPoolExhausted("injected pool exhaustion")
         if self._free:
-            return self._free.pop()
+            blk = self._free.pop()
+            self._fresh.add(blk)
+            return blk
         if self._cached:
             blk, _ = self._cached.popitem(last=False)     # oldest first
             self._unregister(blk)
             self.eviction_count += 1
+            self._fresh.add(blk)
             return blk
         raise BlockPoolExhausted("no free or evictable page left")
 
@@ -316,6 +324,10 @@ class BlockManager:
         if self._ref.get(src, 0) <= 1:
             return None
         dst = self._take_block()          # may raise BlockPoolExhausted
+        # the engine's CoW program copies the page's quantization scale
+        # rows along with its data, so the dst page is NOT fresh — a
+        # scale reset here would corrupt the copied int8 content
+        self._fresh.discard(dst)
         self._incref(dst)
         table[idx] = dst
         self._decref(src)                 # others keep the original
@@ -526,6 +538,18 @@ class BlockManager:
             done += 1
         self.parked_evicted += done
         return done
+
+    def drain_fresh(self) -> list:
+        """Pages handed out (via ``_take_block``) since the last drain,
+        excluding CoW destinations (their content is a live copy).  The
+        quantized engine calls this once per step and zeroes the returned
+        pages' scale-pool rows before the step's writes commit; the
+        float32 engine never needs it (stale page content is masked by
+        ``kv_lens`` at read time, but a stale SCALE would rescale freshly
+        written int8 values).  Sorted for determinism; clears the set."""
+        out = sorted(self._fresh)
+        self._fresh.clear()
+        return out
 
     def has(self, seq_id) -> bool:
         return seq_id in self._tables

@@ -110,6 +110,15 @@ class DegradationController:
     # -- levers the engine/frontend consult --------------------------------
 
     @property
+    def tier_entries(self) -> int:
+        """Escalating transitions so far (NORMAL->worse or worse->worse):
+        how many times pressure forced the controller UP a tier.  The
+        serve_bench memory-pressure A/B reports this next to preemptions
+        — quantized pages must show strictly fewer of both at matched
+        traffic."""
+        return sum(1 for _, frm, to in self.transitions if to > frm)
+
+    @property
     def admission_paused(self) -> bool:
         return self.state >= ADMIT_PAUSE
 

@@ -168,6 +168,12 @@ class LLMEngine:
         drafts to verify, speculation auto-disables for it if its
         lifetime acceptance rate sits below the floor (the drafter is
         not helping; stop paying the verify overhead).
+    kv_dtype: "float32" (full-width pages in the model dtype) or "int8"
+        (pages quantize symmetrically at commit time with per-page-per-
+        head f32 scales in a parallel pool; attention dequantizes inline
+        at read time).  Int8 pages cost ~4x less HBM per resident
+        sequence; greedy outputs are near-identical, gated by the
+        tolerance oracle in tests rather than byte-equality.
     retain_outputs: keep every finished RequestOutput in the dict that
         ``run()`` returns.  A long-running server (the HTTP frontend)
         passes False — outputs are delivered through each request's
@@ -189,10 +195,15 @@ class LLMEngine:
                  drafter=None, spec_k: int = 0, max_spec_k: int = 8,
                  spec_accept_floor: float = 0.35, spec_window: int = 32,
                  retain_outputs: bool = True,
-                 fault_plan=None, pressure=None):
+                 fault_plan=None, pressure=None,
+                 kv_dtype: str = "float32"):
         cfg = model.config
         self.config = cfg
         self.params = model.decode_params()
+        if kv_dtype not in ("float32", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'float32' or 'int8', got {kv_dtype!r}")
+        self.kv_dtype = kv_dtype
         self.max_num_seqs = int(max_num_seqs)
         self.block_size = int(block_size)
         self.max_model_len = int(max_model_len or cfg.max_position_embeddings)
@@ -218,9 +229,26 @@ class LLMEngine:
         self._hd = cfg.hidden_size // self._nh
         L = cfg.num_hidden_layers
         dt = self.params["embed"].dtype
-        self._kc = jnp.zeros((L, num_blocks, self._kvh, self.block_size,
-                              self._hd), dt)
-        self._vc = jnp.zeros_like(self._kc)
+        if self.kv_dtype == "int8":
+            # int8 pages + a parallel per-page-per-head f32 scale pool
+            # (symmetric: float = int8 * scale).  Scales are written at
+            # commit time inside the step program; the kernel/reference
+            # dequantizes inline at read time, so every host-side page
+            # structure (hashing, CoW, sharing, parking) is unchanged.
+            self._kc = jnp.zeros((L, num_blocks, self._kvh,
+                                  self.block_size, self._hd), jnp.int8)
+            self._vc = jnp.zeros_like(self._kc)
+            self._ks = jnp.zeros((L, num_blocks, self._kvh), jnp.float32)
+            self._vs = jnp.zeros_like(self._ks)
+        else:
+            # "float32" means full-width model dtype (f32/bf16) pages
+            self._kc = jnp.zeros((L, num_blocks, self._kvh,
+                                  self.block_size, self._hd), dt)
+            self._vc = jnp.zeros_like(self._kc)
+            self._ks = self._vs = None
+        # scale-reset feed: pages BlockManager handed out since the last
+        # launch (their old scales are dead); consumed by _launch_ragged
+        self._fresh_np = np.zeros((num_blocks,), bool)
 
         self._waiting: deque = deque()
         self._running: list = []
@@ -281,6 +309,7 @@ class LLMEngine:
         # (serve_bench --mixed reports the two ratios side by side)
         self.pad_stats = {"real": 0, "padded": 0, "legacy_padded": 0}
         self._evictions_seen = 0
+        self.peak_resident_seqs = 0
         self.stats = ServingStats()
 
         # fault-tolerance surfaces: a FaultPlan drives deterministic
@@ -450,7 +479,33 @@ class LLMEngine:
         """One dict of serving metrics + block-pool state for this run."""
         out = self.stats.summary()
         out["block_pool"] = self.blocks.stats()
+        out["kv_dtype"] = self.kv_dtype
+        out["kv_bytes_resident"] = self.kv_bytes_resident()
+        out["peak_resident_seqs"] = self.peak_resident_seqs
         return out
+
+    def kv_page_bytes(self) -> int:
+        """Device bytes one KV page costs: K and V slabs across every
+        layer, plus the page's scale-pool rows in int8 mode."""
+        L = self.config.num_hidden_layers
+        per = (2 * L * self._kvh * self.block_size * self._hd
+               * np.dtype(self._kc.dtype).itemsize)
+        if self.kv_dtype == "int8":
+            per += 2 * L * self._kvh * np.dtype(np.float32).itemsize
+        return per
+
+    def kv_bytes_resident(self) -> int:
+        """Device bytes holding real KV content: pages backing live
+        sequences plus parked prefix pages (retained in HBM precisely so
+        a prefix hit skips recompute; ``evict_parked`` reclaims them)."""
+        return ((self.blocks.num_used + self.blocks.num_cached)
+                * self.kv_page_bytes())
+
+    @property
+    def degradation_tier_entries(self) -> int:
+        """Escalating degradation-controller transitions (0 when no
+        pressure controller is installed)."""
+        return 0 if self.pressure is None else self.pressure.tier_entries
 
     def program_specs(self, *, large_bytes: int = 1 << 20) -> list:
         """Every program this engine compiles, as analysis ProgramSpecs.
@@ -484,6 +539,26 @@ class LLMEngine:
         def seqs(n):      # [n] i32 token/pos/index vectors
             return sds((n,), i32)
 
+        if self.kv_dtype == "int8":
+            # the quantized step threads the scale pools (donated along
+            # with the page pools) plus the per-launch fresh-page mask
+            ks = sds(self._ks.shape, self._ks.dtype)
+            vs = sds(self._vs.shape, self._vs.dtype)
+            fresh = sds((self._kc.shape[1],), jnp.bool_)
+            return [
+                ProgramSpec(
+                    "serving.ragged_step_q8", rag_fn,
+                    (params, kc, vc, ks, vs, fresh, seqs(Tq), seqs(B + 1),
+                     seqs(B), sds((B + 1, self.nblk), i32),
+                     seqs(self._Lq), samp_structs(self._Lq, V)),
+                    donate_argnums=rag_donate, declared_dtype=declared,
+                    large_bytes=large_bytes),
+                ProgramSpec(
+                    "serving.cow_copy_q8", cow_fn,
+                    (kc, vc, ks, vs, sds((), i32), sds((), i32)),
+                    donate_argnums=cow_donate, declared_dtype=declared,
+                    large_bytes=large_bytes),
+            ]
         return [
             ProgramSpec(
                 "serving.ragged_step", rag_fn,
@@ -543,6 +618,8 @@ class LLMEngine:
         admitted = self._admit()
         if admitted:
             self.stats.record_admission(len(admitted))
+        self.peak_resident_seqs = max(self.peak_resident_seqs,
+                                      len(self._running))
         self.stats.record_prefill_queue(
             sum(1 for r in self._running if r.cached < len(r.tokens))
             + len(self._waiting))
@@ -987,7 +1064,20 @@ class LLMEngine:
     def _make_cow_fn(self):
         """(unjitted page-copy fn, intended donate_argnums) — the spec the
         analyzer sees; _apply_cow jits it (CPU drops donation: the CPU
-        runtime cannot alias and would warn every call)."""
+        runtime cannot alias and would warn every call).  In int8 mode
+        the copy carries the page's scale-pool rows along with its data
+        — the dst page is a live replica, so BlockManager excludes it
+        from the fresh-page scale reset."""
+        if self.kv_dtype == "int8":
+            def run(kc, vc, ks, vs, s, d):
+                kc = kc.at[:, d].set(kc[:, s])
+                vc = vc.at[:, d].set(vc[:, s])
+                ks = ks.at[:, d].set(ks[:, s])
+                vs = vs.at[:, d].set(vs[:, s])
+                return kc, vc, ks, vs
+
+            return run, (0, 1, 2, 3)
+
         def run(kc, vc, s, d):
             kc = kc.at[:, d].set(kc[:, s])
             vc = vc.at[:, d].set(vc[:, s])
@@ -1005,8 +1095,13 @@ class LLMEngine:
                 donate = ()
             self._cow_prog = jax.jit(run, donate_argnums=donate)
             self.compile_counts["cow"] += 1
-        self._kc, self._vc = self._cow_prog(
-            self._kc, self._vc, np.int32(src), np.int32(dst))
+        if self.kv_dtype == "int8":
+            self._kc, self._vc, self._ks, self._vs = self._cow_prog(
+                self._kc, self._vc, self._ks, self._vs,
+                np.int32(src), np.int32(dst))
+        else:
+            self._kc, self._vc = self._cow_prog(
+                self._kc, self._vc, np.int32(src), np.int32(dst))
 
     # ------------------------------------------------------------------
     # the compiled ragged step
@@ -1057,6 +1152,8 @@ class LLMEngine:
         eps = self.config.rms_norm_eps
         theta = self.config.rope_theta
         dt = self.params["embed"].dtype
+        if self.kv_dtype == "int8":
+            return self._make_ragged_fn_q8(Tq)
         # the interpreted kernel costs a Python step per (Tq, H_kv, nblk)
         # grid cell EVERY launch — serving on CPU uses the XLA reference
         # path (term-identical math) unless a test forces the interpreter
@@ -1118,11 +1215,145 @@ class LLMEngine:
         # drops it on CPU (that runtime cannot alias and warns per call)
         return run, (1, 2)
 
+    def _make_ragged_fn_q8(self, Tq: int):
+        """Int8-page variant of the one serving step program: identical
+        row semantics, but each layer QUANTIZES its packed tokens' K/V
+        at commit time and attention dequantizes at read time.
+
+        Quantize-at-commit, per layer, per launch:
+        1. zero the scale rows of ``fresh`` pages (pages BlockManager
+           handed out since the last launch: their old content AND old
+           scales are dead; CoW destinations are excluded — the CoW
+           program copied their scale rows with their data);
+        2. scatter-max each touched page's scale with the incoming
+           tokens' per-head amax/127 (scales only grow while a page is
+           live, so previously committed int8 values never overflow);
+        3. re-encode the touched pages' existing int8 content from the
+           old scale to the grown scale (one extra rounding per growth
+           event — the accepted precision cost of page-granular scales);
+        4. quantize the new tokens at the settled scale and scatter them
+           into their slots.
+        Duplicate page indices across tokens are safe throughout: the
+        scatter-max makes every duplicate observe the same settled
+        scale, so duplicate re-encodes write identical bytes.
+        """
+        nh, kvh, d = self._nh, self._kvh, self._hd
+        bs = self.block_size
+        B = self.max_num_seqs
+        with_logits = self._with_logits
+        eps = self.config.rms_norm_eps
+        theta = self.config.rope_theta
+        dt = self.params["embed"].dtype
+        use_pallas = _pa.INTERPRET is True or (
+            jax.default_backend() == "tpu"
+            and _pa.ragged_quant_supports(Tq, nh, kvh, d, bs, B + 1,
+                                          self.nblk, dt))
+
+        def run(params, kc, vc, ks, vs, fresh, toks, cu, kvl, bt, lidx,
+                samp):
+            # args as the float step, plus: ks/vs [L, num_blocks, H_kv]
+            # f32 scale pools (donated with the page pools) and fresh
+            # [num_blocks] bool (pages whose scales reset this launch)
+            seg, rel = _pa.ragged_segments(cu, kvl, Tq)
+            x = jnp.take(params["embed"], toks, axis=0)       # [Tq, H]
+
+            def body(x, inp):
+                p, kcl, vcl, ksl, vsl = inp
+                h = _rms_weight(x, p["ln1"], eps)
+                q = (h @ p["wq"]).reshape(Tq, nh, d)
+                k = (h @ p["wk"]).reshape(Tq, kvh, d)
+                v = (h @ p["wv"]).reshape(Tq, kvh, d)
+                q = _rope_positions(q, rel, theta)
+                k = _rope_positions(k, rel, theta)
+                blk = bt[seg, rel // bs]                      # [Tq]
+                slot = rel % bs
+                kf = k.astype(jnp.float32)
+                vf = v.astype(jnp.float32)
+                ksl = jnp.where(fresh[:, None], 0.0, ksl)
+                vsl = jnp.where(fresh[:, None], 0.0, vsl)
+                ks_old = ksl[blk]                             # [Tq, kvh]
+                vs_old = vsl[blk]
+                ksl = ksl.at[blk].max(jnp.max(jnp.abs(kf), axis=-1)
+                                      / 127.0)
+                vsl = vsl.at[blk].max(jnp.max(jnp.abs(vf), axis=-1)
+                                      / 127.0)
+                ks_new = ksl[blk]
+                vs_new = vsl[blk]
+                rk = jnp.where(ks_new > 0.0,
+                               ks_old / jnp.maximum(ks_new, 1e-30), 0.0)
+                rv = jnp.where(vs_new > 0.0,
+                               vs_old / jnp.maximum(vs_new, 1e-30), 0.0)
+                kp = jnp.round(kcl[blk].astype(jnp.float32)
+                               * rk[:, :, None, None])
+                vp = jnp.round(vcl[blk].astype(jnp.float32)
+                               * rv[:, :, None, None])
+                kcl = kcl.at[blk].set(
+                    jnp.clip(kp, -127, 127).astype(jnp.int8))
+                vcl = vcl.at[blk].set(
+                    jnp.clip(vp, -127, 127).astype(jnp.int8))
+                kq = jnp.round(kf / jnp.maximum(ks_new, 1e-30)[:, :, None])
+                vq = jnp.round(vf / jnp.maximum(vs_new, 1e-30)[:, :, None])
+                kcl = kcl.at[blk, :, slot, :].set(
+                    jnp.clip(kq, -127, 127).astype(jnp.int8))
+                vcl = vcl.at[blk, :, slot, :].set(
+                    jnp.clip(vq, -127, 127).astype(jnp.int8))
+                if use_pallas:
+                    att = _pa.ragged_paged_attention_quant_segrel(
+                        q, kcl, vcl, ksl, vsl, bt, seg, rel)
+                else:
+                    att = _pa.ragged_paged_reference_quant_segrel(
+                        q, kcl, vcl, ksl, vsl, bt, seg, rel)
+                att = att.astype(x.dtype)
+                x = x + att.reshape(Tq, nh * d) @ p["wo"]
+                h2 = _rms_weight(x, p["ln2"], eps)
+                a = jax.nn.silu((h2 @ p["gate"]).astype(jnp.float32)
+                                ).astype(h2.dtype) * (h2 @ p["up"])
+                return x + a @ p["down"], (kcl, vcl, ksl, vsl)
+
+            x, (kc, vc, ks, vs) = lax.scan(body, x,
+                                           (params["layers"], kc, vc,
+                                            ks, vs))
+            h = _rms_weight(x, params["norm_f"], eps)
+            hsel = h[lidx]                                    # [Lq, H]
+            logits = (hsel.astype(jnp.float32)
+                      @ params["head"].astype(jnp.float32))   # [Lq, V]
+            sampled = sample_tokens(logits, samp)
+            fin = jnp.all(jnp.isfinite(logits), axis=-1)      # [Lq]
+            if with_logits:
+                return sampled, fin, logits, kc, vc, ks, vs
+            return sampled, fin, kc, vc, ks, vs
+
+        # donate the page pools AND scale pools; fresh is input-only
+        return run, (1, 2, 3, 4)
+
+    def _consume_fresh(self):
+        """Accumulate BlockManager's freshly handed-out pages into the
+        persistent mask, hand a snapshot to the launch, and clear — the
+        launch's in-program scale reset consumes the batch."""
+        for b in self.blocks.drain_fresh():
+            self._fresh_np[b] = True
+        out = self._fresh_np.copy()
+        self._fresh_np[:] = False
+        return out
+
     def _launch_ragged(self, Tq, toks, cu, kvl, bt, lidx, samp,
                        real_tokens):
         self.pad_stats["real"] += int(real_tokens)
         self.pad_stats["padded"] += int(Tq)
         prog = self._get_ragged_prog(Tq)
+        if self.kv_dtype == "int8":
+            fresh = self._consume_fresh()
+            if self._with_logits:
+                sampled, fin, logits, self._kc, self._vc, self._ks, \
+                    self._vs = prog(
+                        self.params, self._kc, self._vc, self._ks,
+                        self._vs, fresh, toks, cu, kvl, bt, lidx, samp)
+            else:
+                sampled, fin, self._kc, self._vc, self._ks, self._vs = \
+                    prog(self.params, self._kc, self._vc, self._ks,
+                         self._vs, fresh, toks, cu, kvl, bt, lidx, samp)
+                logits = None
+            return sampled, logits, fin
         if self._with_logits:
             sampled, fin, logits, self._kc, self._vc = prog(
                 self.params, self._kc, self._vc, toks, cu, kvl, bt,

@@ -123,18 +123,21 @@ class DraftModelDrafter(Drafter):
 
     def __init__(self, model, *, block_size: int = 16,
                  max_model_len: int | None = None, capacity: int = 8,
-                 catchup_bucket: int = 64):
+                 catchup_bucket: int = 64, kv_dtype: str = "float32"):
         from .serving import LLMEngine   # deferred: serving imports us
 
         nblk = -(-int(max_model_len or model.config.max_position_embeddings)
                  // int(block_size))
+        # kv_dtype rides through so a quantized target engine can keep
+        # its draft cache quantized too (half the reason to quantize is
+        # freeing HBM for MORE resident state, drafts included)
         self._eng = LLMEngine(
             model, max_num_seqs=1, block_size=block_size,
             num_blocks=1 + int(capacity) * nblk,
             max_model_len=max_model_len,
             max_prefill_tokens=int(catchup_bucket),
             prefill_token_bucket=int(catchup_bucket),
-            enable_prefix_caching=False)
+            enable_prefix_caching=False, kv_dtype=kv_dtype)
         self._valid: dict = {}            # rid -> tokens with draft K/V
 
     @property

@@ -213,6 +213,57 @@ def _ragged_kernel(seg_ref, rel_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[...] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
 
 
+def _ragged_quant_kernel(seg_ref, rel_ref, bt_ref, ksc_ref, vsc_ref,
+                         q_ref, k_ref, v_ref, o_ref,
+                         m_ref, l_ref, acc_ref, *, bs, sm_scale):
+    """Int8-page variant of `_ragged_kernel`: k/v refs are int8 pages and
+    the per-page-per-head float32 scales ride the scalar-prefetch path
+    (SMEM) next to the block table, so dequantization happens inline as
+    each page streams into VMEM — no dense float intermediate ever
+    exists.  ksc/vsc are [num_blocks, H_kv] f32; the page's scale is
+    looked up through the same `bt[seg[t], i]` indirection the BlockSpec
+    index maps use.
+    """
+    t = pl.program_id(0)
+    h = pl.program_id(1)
+    i = pl.program_id(2)
+    nblk = pl.num_programs(2)
+    rel = rel_ref[t]                          # absolute key budget, 0-based
+    blk = bt_ref[seg_ref[t], i]
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    base = i * bs
+
+    @pl.when(base <= rel)
+    def _tile():
+        q = q_ref[...].astype(jnp.float32) * sm_scale
+        k = k_ref[...].astype(jnp.float32) * ksc_ref[blk, h]   # [bs, D]
+        v = v_ref[...].astype(jnp.float32) * vsc_ref[blk, h]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [G, bs]
+        pos = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos <= rel, s, -jnp.inf)
+        m_prev = m_ref[...]                    # [G, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                 # [G, bs]
+        alpha = jnp.exp(m_prev - m_new)        # [G, 1]
+        l_ref[...] = alpha * l_ref[...] + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    @pl.when(i == nblk - 1)
+    def _finalize():
+        o_ref[...] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
 def ragged_segments(cu_seqlens, kv_lens, n_tokens):
     """Derive per-flat-token (seg, rel) from the ragged row layout.
 
@@ -298,6 +349,76 @@ def ragged_paged_attention(q, key_cache, value_cache, block_tables,
     seg, rel = ragged_segments(cu_seqlens, kv_lens, q.shape[0])
     return ragged_paged_attention_segrel(
         q, key_cache, value_cache, block_tables, seg, rel)
+
+
+def ragged_paged_attention_quant_segrel(q, key_cache, value_cache,
+                                        key_scales, value_scales,
+                                        block_tables, seg, rel):
+    """Ragged attention over int8 KV pages with per-page-per-head scales.
+
+    q [Tq, H, D] float; caches [num_blocks, H_kv, bs, D] int8;
+    key_scales/value_scales [num_blocks, H_kv] f32 (symmetric:
+    float = int8 * scale); block_tables [R, nblk] int32; seg/rel as in
+    `ragged_paged_attention_segrel`.  Returns [Tq, H, D] in q.dtype.
+    """
+    Tq, H, D = q.shape
+    _, Hkv, bs, _ = key_cache.shape
+    G = H // Hkv
+    R, nblk = block_tables.shape
+    sm_scale = 1.0 / (D ** 0.5)
+
+    kernel = functools.partial(_ragged_quant_kernel, bs=bs,
+                               sm_scale=sm_scale)
+    qr = q.reshape(Tq, Hkv, G, D)
+    block_tables = jnp.clip(block_tables.astype(jnp.int32), 0,
+                            key_cache.shape[0] - 1)
+    seg = jnp.clip(seg.astype(jnp.int32), 0, R - 1)
+    rel = rel.astype(jnp.int32)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=5,     # seg, rel, block_tables, ksc, vsc
+            grid=(Tq, Hkv, nblk),
+            in_specs=[
+                pl.BlockSpec((None, None, G, D),
+                             lambda t, h, i, sg, rl, bt, ks, vs:
+                             (t, h, 0, 0)),
+                pl.BlockSpec((None, None, bs, D),
+                             lambda t, h, i, sg, rl, bt, ks, vs:
+                             (bt[sg[t], i], h, 0, 0)),
+                pl.BlockSpec((None, None, bs, D),
+                             lambda t, h, i, sg, rl, bt, ks, vs:
+                             (bt[sg[t], i], h, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((None, None, G, D),
+                                   lambda t, h, i, sg, rl, bt, ks, vs:
+                                   (t, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((G, 1), jnp.float32),
+                pltpu.VMEM((G, 1), jnp.float32),
+                pltpu.VMEM((G, D), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((Tq, Hkv, G, D), q.dtype),
+        interpret=interpret_mode(),
+    )(seg, rel, block_tables, key_scales.astype(jnp.float32),
+      value_scales.astype(jnp.float32), qr, key_cache, value_cache)
+    return out.reshape(Tq, H, D)
+
+
+def ragged_paged_reference_quant_segrel(q, key_cache, value_cache,
+                                        key_scales, value_scales,
+                                        block_tables, seg, rel):
+    """Fake-quant XLA oracle for the int8-page kernel: dequantize the
+    whole pool densely (float = int8 * scale, the exact math the kernel
+    applies per page) and delegate to the float reference, so CPU tests
+    stay exact-vs-oracle in int8 mode."""
+    kd = key_cache.astype(jnp.float32) * \
+        key_scales.astype(jnp.float32)[:, :, None, None]
+    vd = value_cache.astype(jnp.float32) * \
+        value_scales.astype(jnp.float32)[:, :, None, None]
+    return ragged_paged_reference_segrel(q, kd, vd, block_tables, seg, rel)
 
 
 def ragged_paged_reference_segrel(q, key_cache, value_cache, block_tables,
@@ -445,3 +566,58 @@ def ragged_supports(Tq, H, Hkv, D, bs, R=None, nblk=None,
     if R is None or nblk is None:
         return True     # shape-only query (no probe possible yet)
     return _probe_ragged_lowering(Tq, H, Hkv, D, bs, R, nblk, dtype)
+
+
+def _probe_ragged_quant_lowering(Tq, H, Hkv, D, bs, R, nblk, dtype) -> bool:
+    """Compile-probe the int8-page ragged kernel (cached; same
+    degrade-don't-crash contract as `_probe_lowering`)."""
+    global _PROBE_LOGGED
+    key = ("ragged-q8", Tq, H, Hkv, D, bs, R, nblk, str(dtype),
+           jax.default_backend())
+    hit = _PROBE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    if interpret_mode():  # interpreter enforces no TPU tiling rules
+        _PROBE_CACHE[key] = True
+        return True
+    num_blocks = max(nblk * R, 1)
+    try:
+        jax.jit(ragged_paged_attention_quant_segrel).lower(
+            jax.ShapeDtypeStruct((Tq, H, D), dtype),
+            jax.ShapeDtypeStruct((num_blocks, Hkv, bs, D), jnp.int8),
+            jax.ShapeDtypeStruct((num_blocks, Hkv, bs, D), jnp.int8),
+            jax.ShapeDtypeStruct((num_blocks, Hkv), jnp.float32),
+            jax.ShapeDtypeStruct((num_blocks, Hkv), jnp.float32),
+            jax.ShapeDtypeStruct((R, nblk), jnp.int32),
+            jax.ShapeDtypeStruct((Tq,), jnp.int32),
+            jax.ShapeDtypeStruct((Tq,), jnp.int32),
+        ).compile()
+        ok = True
+    except Exception as e:
+        ok = False
+        if not _PROBE_LOGGED:
+            _PROBE_LOGGED = True
+            import logging
+            logging.getLogger("paddle_tpu.pallas").warning(
+                "int8 ragged paged kernel does not lower for "
+                f"Tq={Tq} H={H} Hkv={Hkv} D={D} bs={bs}: "
+                f"{type(e).__name__}; falling back to dense fake-quant")
+    _PROBE_CACHE[key] = ok
+    return ok
+
+
+def ragged_quant_supports(Tq, H, Hkv, D, bs, R=None, nblk=None,
+                          dtype=jnp.float32) -> bool:
+    """Eligibility for the int8-page ragged kernel.  Int8 pages carry a
+    (32, 128) minimum tile (vs (8, 128) for f32), so the page-size
+    heuristic is stricter than the float path's before the authoritative
+    lowering probe runs."""
+    if H % Hkv != 0:
+        return False
+    if D % 128 != 0 and D not in (64,):
+        return False
+    if bs % 32 != 0:
+        return False
+    if R is None or nblk is None:
+        return True     # shape-only query (no probe possible yet)
+    return _probe_ragged_quant_lowering(Tq, H, Hkv, D, bs, R, nblk, dtype)

@@ -135,6 +135,7 @@ class ServingStats:
         self._ttft = _Reservoir(r, seed=4)   # arrival -> first token (s)
         # speculative decoding surface (PR 4)
         self.verify_steps = 0            # verify program launches
+        self.verify_tokens = 0           # tokens emitted by verify steps
         self.verify_time = 0.0
         self.spec_rounds = 0             # (sequence, verify) acceptance rounds
         self.draft_proposed = 0          # draft tokens sent to verify
@@ -212,13 +213,17 @@ class ServingStats:
     def record_verify(self, duration_s: float, n_tokens: int,
                       occupancy: float) -> None:
         """One verify-program launch that emitted n_tokens across its
-        speculative sequences.  The tokens count as decode output (that
-        is what they replace) so tok/s comparisons stay apples-to-apples
-        with speculation off."""
+        speculative sequences.  Verify output stays in its OWN channel:
+        folding it into decode_tokens/decode_time (as this method once
+        did) made the on/off "speedup" ratio compare verify throughput
+        against decode throughput of a different token mix — a
+        bookkeeping artifact, not a measurement.  Cross-phase
+        comparisons use wall-clock emitted tok/s per phase instead.
+        The tokens still feed the stream-wide ITL reservoir (they are
+        real emitted tokens and each observed this step's latency)."""
         self.verify_steps += 1
         self.verify_time += float(duration_s)
-        self.decode_tokens += int(n_tokens)
-        self.decode_time += float(duration_s)
+        self.verify_tokens += int(n_tokens)
         self._token_lat.extend(float(duration_s), int(n_tokens))
         self._occupancy.add(float(occupancy))
 
@@ -276,6 +281,20 @@ class ServingStats:
         return self.decode_tokens / self.decode_time if self.decode_time \
             else 0.0
 
+    def verify_tokens_per_s(self) -> float:
+        return self.verify_tokens / self.verify_time if self.verify_time \
+            else 0.0
+
+    def prefill_tokens_per_s(self) -> float:
+        return self.prefill_tokens / self.prefill_time \
+            if self.prefill_time else 0.0
+
+    def emitted_tokens_per_s(self) -> float:
+        """Wall-clock emitted throughput across decode AND verify — the
+        honest cross-phase number for spec on/off comparisons."""
+        t = self.decode_time + self.verify_time
+        return (self.decode_tokens + self.verify_tokens) / t if t else 0.0
+
     def token_latency_ms(self, q: float) -> float:
         return 1e3 * self._token_lat.percentile(q)
 
@@ -305,6 +324,10 @@ class ServingStats:
             "decode_steps": self.decode_steps,
             "decode_tokens": self.decode_tokens,
             "decode_tokens_per_s": round(self.decode_tokens_per_s(), 2),
+            "prefill_tokens_per_s": round(self.prefill_tokens_per_s(), 2),
+            "verify_tokens": self.verify_tokens,
+            "verify_tokens_per_s": round(self.verify_tokens_per_s(), 2),
+            "emitted_tokens_per_s": round(self.emitted_tokens_per_s(), 2),
             "p50_token_ms": round(self.token_latency_ms(50), 3),
             "p99_token_ms": round(self.token_latency_ms(99), 3),
             "itl_p50_ms": round(self.token_latency_ms(50), 3),

@@ -1,0 +1,15 @@
+"""Seeds quantized-kv-float32-page: the engine's quantized branch
+allocates its page pool in float32 — forfeiting the HBM headroom the
+int8 page format exists for.  The scale pool staying float32 is the
+contract and must NOT fire."""
+import jax.numpy as jnp
+
+
+def build_pools(shape, kv_dtype):
+    if kv_dtype == "int8":
+        kv_cache = jnp.zeros(shape, jnp.float32)     # pages left float32
+        scales = jnp.zeros(shape[:3], jnp.float32)   # scale rows: correct
+    else:
+        kv_cache = jnp.zeros(shape, jnp.float32)
+        scales = None
+    return kv_cache, scales

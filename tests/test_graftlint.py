@@ -44,6 +44,8 @@ def _lint_fix(name):
     ("fix_unkeyed_jit.py", "unkeyed-jit", 6, "call", ERROR),
     (os.path.join("inference", "fix_attention_budget.py"),
      "attention-program-budget", 18, "decode_step", ERROR),
+    (os.path.join("inference", "fix_quantized_kv.py"),
+     "quantized-kv-float32-page", 10, "build_pools", WARNING),
     (os.path.join("inference", "fix_swallowed_exception.py"),
      "swallowed-exception", 9, "release_pages", ERROR),
 ])
@@ -64,11 +66,16 @@ def test_clean_fixture_is_silent():
 
 def test_serving_engine_within_attention_program_budget():
     """The shipped engine holds the contract the budget rule guards:
-    exactly one attention-bearing compiled program (the ragged step)."""
+    exactly one attention-bearing compiled program KIND (the ragged
+    step; its float32 and quantized-int8 dtype variants share the kind
+    — an engine only ever compiles one).  And its quantized branch
+    allocates int8 pages, so the float32-page rule stays silent too."""
     findings = lint_file(os.path.join(_REPO, "paddle_tpu", "inference",
                                       "serving.py"), root=_REPO)
     assert [f for f in findings
             if f.rule == "attention-program-budget"] == []
+    assert [f for f in findings
+            if f.rule == "quantized-kv-float32-page"] == []
 
 
 def test_mutable_default_is_error_in_compiled_path():
@@ -246,7 +253,7 @@ def test_every_catalog_rule_is_exercised():
     covered = {
         "numpy-in-jit", "host-sync-in-jit", "tracer-branch",
         "mutable-default-arg", "unkeyed-jit", "attention-program-budget",
-        "swallowed-exception",
+        "quantized-kv-float32-page", "swallowed-exception",
         "undonated-buffer", "host-callback", "dtype-promotion",
         "dead-code", "dead-input", "passthrough-output",
     }

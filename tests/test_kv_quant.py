@@ -1,0 +1,277 @@
+"""Quantized int8 KV pages: page/scale-pool lockstep through CoW,
+rollback and eviction; greedy tolerance vs float32; compile budget.
+
+The int8 page format stores pages as int8 with per-page-per-head f32
+scales in a parallel pool, quantizing at commit time (scales only ever
+grow, so already-written int8 never overflows; pages taken fresh from
+the pool get their scale rows zeroed at the next launch).  Everything
+the host-side BlockManager does — CoW, refcounted sharing, truncate
+rollback, LRU parking, evict_parked — must keep the scale pool in
+lockstep with the data pool."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu
+from paddle_tpu.inference import BlockManager, LLMEngine, NGramDrafter
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+VOCAB = 97
+CFG = LlamaConfig.tiny(vocab=VOCAB, hidden=32, layers=2, heads=4, ffn=64,
+                       seq=64)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle_tpu.seed(123)       # tolerance counts depend on the weights
+    return LlamaForCausalLM(CFG)
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_num_seqs", 4)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_model_len", 64)
+    kw.setdefault("max_prefill_tokens", 128)
+    kw.setdefault("prefill_token_bucket", 32)
+    return LLMEngine(model, **kw)
+
+
+# ---------------------------------------------------------------------------
+# page format: bytes, dtypes, summary surface
+# ---------------------------------------------------------------------------
+
+def test_int8_pages_shrink_hbm_cost(model):
+    f32 = _engine(model)
+    q8 = _engine(model, kv_dtype="int8")
+    assert f32.kv_dtype == "float32" and q8.kv_dtype == "int8"
+    assert q8._kc.dtype == jnp.int8 and q8._vc.dtype == jnp.int8
+    assert q8._ks.dtype == jnp.float32 and q8._vs.dtype == jnp.float32
+    # int8 page + its f32 scale rows vs a float32 page: >= 3.5x smaller
+    assert f32.kv_page_bytes() / q8.kv_page_bytes() >= 3.5
+    for eng in (f32, q8):
+        s = eng.summary()
+        assert s["kv_dtype"] == eng.kv_dtype
+        assert s["kv_bytes_resident"] == 0
+        assert s["peak_resident_seqs"] == 0
+
+
+def test_rejects_unknown_kv_dtype(model):
+    with pytest.raises(ValueError, match="kv_dtype"):
+        _engine(model, kv_dtype="int4")
+
+
+# ---------------------------------------------------------------------------
+# tolerance oracle: int8 greedy vs float32 greedy
+# ---------------------------------------------------------------------------
+
+def test_int8_greedy_tracks_float32_within_tolerance(model):
+    """Greedy outputs on int8 pages are float32-greedy up to near-tie
+    argmax flips from quantization noise.  The oracle: a clear majority
+    of requests byte-identical, every request runs to its budget, and a
+    rerun on a fresh int8 engine reproduces the stream exactly
+    (quantize-at-commit is deterministic)."""
+    rng = np.random.RandomState(7)
+    reqs = [(rng.randint(0, VOCAB, n).tolist(), mn)
+            for n, mn in [(4, 8), (9, 8), (13, 6), (6, 10),
+                          (11, 8), (5, 12), (8, 6), (15, 8)]]
+
+    def drive(kv_dtype):
+        eng = _engine(model, max_num_seqs=8, kv_dtype=kv_dtype)
+        rids = [eng.add_request(p, max_new_tokens=mn) for p, mn in reqs]
+        outs = eng.run()
+        assert eng.blocks.num_used == 0
+        eng.blocks.check_invariants()
+        return [outs[r].generated for r in rids]
+
+    ref = drive("float32")
+    got = drive("int8")
+    for (p, mn), g in zip(reqs, got):
+        assert len(g) == mn                      # budget honoured
+    identical = sum(r == g for r, g in zip(ref, got))
+    assert identical >= len(reqs) // 2 + 1, (identical, len(reqs))
+    assert got == drive("int8")                  # deterministic rerun
+
+
+# ---------------------------------------------------------------------------
+# CoW: scale rows travel with the page; dst is NOT scale-reset
+# ---------------------------------------------------------------------------
+
+def test_cow_program_copies_scale_rows(model):
+    eng = _engine(model, kv_dtype="int8", enable_prefix_caching=True)
+    eng._kc = eng._kc.at[:, 3].set(7)
+    eng._vc = eng._vc.at[:, 3].set(-5)
+    eng._ks = eng._ks.at[:, 3].set(0.25)
+    eng._vs = eng._vs.at[:, 3].set(0.5)
+    eng._apply_cow(3, 4)
+    np.testing.assert_array_equal(np.asarray(eng._kc[:, 4]), 7)
+    np.testing.assert_array_equal(np.asarray(eng._vc[:, 4]), -5)
+    np.testing.assert_array_equal(np.asarray(eng._ks[:, 4]), 0.25)
+    np.testing.assert_array_equal(np.asarray(eng._vs[:, 4]), 0.5)
+    assert eng.compile_counts["cow"] == 1
+
+
+def test_cow_dst_is_not_marked_fresh():
+    """The CoW destination is a live replica (its int8 bytes arrive with
+    their scales via the copy program); marking it fresh would zero its
+    scale rows at the next launch and dequantize the page to garbage.
+    Every OTHER newly-taken page must be fresh."""
+    bm = BlockManager(12, 4, enable_prefix_caching=True)
+    ids = list(range(8))
+    bm.acquire("a", ids)
+    bm.commit_prefill("a", 8)
+    bm.free("a")                                  # park both pages
+    assert bm.acquire("b", ids + [50]) == 8       # shares parked pages
+    assert bm.acquire("c", ids + [70]) == 8
+    bm.drain_fresh()                              # clear setup-phase pages
+    bm.truncate("b", 6)                           # roll into shared page
+    cw = bm.cow_if_shared("b", 6)
+    assert cw is not None
+    src, dst = cw
+    fresh = bm.drain_fresh()
+    assert dst not in fresh
+    bm.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# fresh-page tracking: reuse after free AND after evict_parked
+# ---------------------------------------------------------------------------
+
+def test_reused_pages_are_fresh_again_for_scale_reset():
+    bm = BlockManager(9, 4, enable_prefix_caching=False)
+    bm.allocate("a", 10)
+    first = set(bm.drain_fresh())
+    assert len(first) == 3
+    assert bm.drain_fresh() == []                 # drain is consuming
+    bm.free("a")
+    bm.allocate("b", 10)
+    # the same physical pages come back off the free list: their stale
+    # scales (and stale int8 bytes) must be reset at the next launch
+    assert set(bm.drain_fresh()) == first
+
+
+def test_evicted_parked_pages_are_fresh_on_reuse():
+    bm = BlockManager(9, 4, enable_prefix_caching=True)
+    ids = list(range(8))
+    bm.acquire("a", ids)
+    bm.commit_prefill("a", 8)
+    parked = set(bm.block_table("a"))
+    bm.free("a")                                  # refcount-0, parked
+    bm.drain_fresh()
+    assert bm.evict_parked(8) == 2
+    bm.check_invariants()
+    # a cold allocation picks the evicted pages back up -> fresh again
+    bm.acquire("z", [90, 91, 92, 93, 94, 95, 96, 89])
+    assert parked <= set(bm.drain_fresh())
+    bm.check_invariants()
+
+
+def test_evict_parked_reduces_kv_bytes_resident(model):
+    eng = _engine(model, kv_dtype="int8", enable_prefix_caching=True)
+    rng = np.random.RandomState(3)
+    eng.add_request(rng.randint(0, VOCAB, 17).tolist(), max_new_tokens=4)
+    eng.run()
+    before = eng.kv_bytes_resident()
+    assert before > 0                             # parked pages still count
+    assert before == ((eng.blocks.num_used + eng.blocks.num_cached)
+                      * eng.kv_page_bytes())
+    assert eng.blocks.evict_parked(2) == 2
+    assert eng.kv_bytes_resident() == before - 2 * eng.kv_page_bytes()
+    eng.blocks.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# spec decode on int8 pages: rollback keeps both pools coherent
+# ---------------------------------------------------------------------------
+
+def test_int8_spec_decode_rollback_stays_coherent(model):
+    """Verify writes + truncate rollback on quantized pages: the table
+    rolls back, rescaled int8 bytes stay consistent under the
+    scales-only-grow invariant, and the emitted stream matches plain
+    int8 decode on a clear majority of requests (near-tie flips are
+    tolerance territory, exactly as in the float-vs-int8 oracle)."""
+    rng = np.random.RandomState(11)
+    reqs = []
+    for _ in range(4):
+        motif = rng.randint(0, VOCAB, int(rng.randint(2, 5))).tolist()
+        prompt = (motif * 6)[:int(rng.randint(8, 14))]
+        reqs.append((prompt, 16))
+
+    def drive(**kw):
+        eng = _engine(model, kv_dtype="int8", **kw)
+        rids = [eng.add_request(p, max_new_tokens=mn) for p, mn in reqs]
+        outs = eng.run()
+        assert eng.blocks.num_used == 0
+        eng.blocks.check_invariants()
+        return eng, [outs[r].generated for r in rids]
+
+    eng, spec = drive(drafter=NGramDrafter(max_ngram=4, min_ngram=1),
+                      spec_k=3, max_spec_k=3, spec_accept_floor=0.0)
+    s = eng.stats.summary()
+    assert s["draft_proposed"] > 0 and s["draft_accepted"] > 0
+    assert s["verify_steps"] > 0
+    _, plain = drive()
+    assert sum(a == b for a, b in zip(spec, plain)) >= 3
+    for (p, mn), g in zip(reqs, spec):
+        assert len(g) == mn
+
+
+# ---------------------------------------------------------------------------
+# release fuzz (PR-5 shape) on the quantized engine
+# ---------------------------------------------------------------------------
+
+def test_int8_release_fuzz_pool_returns_to_initial_state(model):
+    """Random admits, steps, aborts and natural finishes on an int8
+    engine with prefix sharing: data-pool and scale-pool bookkeeping
+    (fresh tracking included) never corrupt the partition invariants,
+    and the pool returns to its initial accounting."""
+    eng = _engine(model, kv_dtype="int8", enable_prefix_caching=True,
+                  retain_outputs=True)
+    rng = np.random.RandomState(1234)
+    free0 = eng.blocks.num_free + eng.blocks.num_cached
+    live, aborted, submitted = [], 0, 0
+    sys_prompt = rng.randint(0, VOCAB, 11).tolist()
+    for _ in range(50):
+        if submitted < 20 and (rng.rand() < 0.5 or not live):
+            n = int(rng.randint(2, 20))
+            prompt = (sys_prompt[:n] if rng.rand() < 0.5
+                      else rng.randint(0, VOCAB, n).tolist())
+            live.append(eng.add_request(prompt, max_new_tokens=int(
+                rng.randint(2, 16))))
+            submitted += 1
+        for _ in range(int(rng.randint(1, 3))):
+            eng.step()
+        live = [r for r in live if r not in eng._finished]
+        if live and rng.rand() < 0.35:
+            victim = live.pop(int(rng.randint(len(live))))
+            assert eng.abort(victim).finish_reason == "aborted"
+            aborted += 1
+            eng.blocks.check_invariants()
+    outs = eng.run()
+    assert aborted >= 3
+    assert eng.blocks.num_used == 0
+    assert eng.blocks.num_free + eng.blocks.num_cached == free0
+    eng.blocks.check_invariants()
+    finished = [o for o in outs.values() if o.finish_reason == "length"]
+    assert finished and all(o.generated for o in finished)
+
+
+# ---------------------------------------------------------------------------
+# compile budget: int8 stays ONE ragged kind
+# ---------------------------------------------------------------------------
+
+def test_int8_engine_keeps_single_ragged_program_kind(model):
+    eng = _engine(model, kv_dtype="int8", max_num_seqs=4)
+    rng = np.random.RandomState(5)
+    stream = [(rng.randint(0, VOCAB, n).tolist(), mn)
+              for n, mn in [(4, 6), (9, 6), (13, 4), (5, 8)]]
+    for p, mn in stream:
+        eng.add_request(p, max_new_tokens=mn)
+    eng.run()
+    counts = dict(eng.compile_counts)
+    assert set(k for k, v in counts.items() if v) == {"ragged"}
+    # the identical shape mix costs ZERO new programs on a second pass
+    for p, mn in stream:
+        eng.add_request(p, max_new_tokens=mn)
+    eng.run()
+    assert dict(eng.compile_counts) == counts

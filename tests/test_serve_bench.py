@@ -25,6 +25,11 @@ def test_serve_bench_smoke_emits_json_line():
     assert record["value"] > 0
     assert record["decode_compiles"] <= 2
     assert record["p99_token_ms"] >= record["p50_token_ms"] > 0
+    # KV-residency surface rides every mode's record, all dtypes
+    assert record["kv_dtype"] == "float32"
+    assert record["kv_bytes_resident"] >= 0
+    assert record["peak_resident_seqs"] > 0
+    assert record["degradation_tier_entries"] == 0
 
 
 def test_serve_bench_http_emits_frontend_surface():
@@ -76,7 +81,13 @@ def test_serve_bench_spec_emits_acceptance_surface():
     assert 0.0 < record["accept_rate"] <= 1.0
     assert record["verify_steps"] > 0
     assert record["attention_compiles"] >= 1
-    assert record["speedup"] > 0
+    # per-phase WALL-CLOCK throughput, each phase over its own time —
+    # the old "speedup" key divided verify-folded decode numbers and is
+    # gone for good
+    assert "speedup" not in record
+    assert record["decode_tokens_per_s"] > 0
+    assert record["verify_tokens_per_s"] > 0
+    assert record["verify_tokens"] > 0
     # rejections roll pages back through BlockManager.truncate
     assert record["rollback_tokens"] >= 0
 
@@ -135,6 +146,37 @@ def test_serve_bench_chaos_emits_recovery_surface():
     assert record["leaked_pages"] == 0
     assert record["pool_clean"] is True
     assert record["drained"] is True
+
+
+def test_serve_bench_memory_pressure_emits_residency_surface():
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "--memory-pressure",
+         "--kv-dtype", "int8"],
+        capture_output=True, text=True, timeout=540,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.strip().splitlines() if ln.strip()]
+    assert lines, f"no stdout; stderr: {out.stderr[-2000:]}"
+    record = json.loads(lines[-1])
+    assert record["metric"] == "serve_pressure_resident_seqs"
+    assert "error" not in record, record
+    # the KV-residency keys every mode carries
+    for key in ("kv_dtype", "kv_bytes_resident", "peak_resident_seqs",
+                "degradation_tier_entries"):
+        assert key in record, key
+    # ISSUE acceptance: same byte budget, ~4x the blocks, >=1.9x the
+    # resident sequences, strictly fewer preemptions and tier entries
+    assert record["kv_dtype"] == "int8"
+    assert record["hbm_budget_bytes"] > 0
+    assert record["num_blocks"] > 3 * record["baseline_num_blocks"]
+    assert record["kv_page_bytes"] < record["baseline_kv_page_bytes"]
+    assert record["resident_ratio"] >= 1.9
+    assert record["preempted"] < record["baseline_preempted"]
+    assert record["degradation_tier_entries"] \
+        < record["baseline_degradation_tier_entries"]
+    # matched traffic: both pools completed the identical stream
+    assert record["retired"] == record["baseline_retired"] \
+        == record["requests"]
 
 
 def test_serve_bench_prefix_share_emits_cache_surface():

@@ -60,6 +60,21 @@ def test_audit_engine_report_donation_and_transfer_clean(model):
     assert doc["errors"] == 0
 
 
+def test_audit_quantized_engine_report_clean(model):
+    """The int8 engine's program pair: the scale pools ride the step as
+    donated operands (a forgotten donation there copies the full scale
+    pool every launch) and the q8 CoW program donates all four pools."""
+    eng = _engine(model, kv_dtype="int8")
+    report = audit_engine(eng, large_bytes=1 << 10)
+    doc = json.loads(json.dumps(report))
+    by_name = {p["name"]: p for p in doc["programs"]}
+    assert set(by_name) == {"serving.ragged_step_q8", "serving.cow_copy_q8"}
+    assert by_name["serving.ragged_step_q8"]["donate_argnums"] == [1, 2, 3, 4]
+    assert by_name["serving.cow_copy_q8"]["donate_argnums"] == [0, 1, 2, 3]
+    assert [f for p in doc["programs"] for f in p["findings"]] == []
+    assert doc["errors"] == 0
+
+
 def test_audit_engine_report_is_baseline_clean(model):
     eng = _engine(model)
     report = audit_engine(eng, large_bytes=1 << 10,
@@ -75,8 +90,14 @@ def test_committed_report_matches_fresh_audit(model):
         os.path.abspath(__file__))), "docs", "analysis",
         "serving_report.json")
     committed = json.load(open(path))
-    fresh = audit_engine(_engine(model), large_bytes=1 << 10)
-    fresh_by_name = {p["name"]: p for p in fresh["programs"]}
+    fresh_by_name = {}
+    for kv_dtype in ("float32", "int8"):
+        fresh = audit_engine(_engine(model, kv_dtype=kv_dtype),
+                             large_bytes=1 << 10)
+        fresh_by_name.update({p["name"]: p for p in fresh["programs"]})
+    committed_names = {p["name"] for p in committed["programs"]}
+    assert {"serving.ragged_step_q8",
+            "serving.cow_copy_q8"} <= committed_names
     for prog in committed["programs"]:
         if prog["name"] == "jit.capture_step":     # CLI-only extra spec
             continue

@@ -8,10 +8,11 @@ Usage:
 Default path is ``paddle_tpu``.  Exit status: 0 when no ERROR-severity
 finding survives the baseline, 1 otherwise (2 on usage errors).
 
-``--audit-serving`` additionally builds a tiny CPU LLMEngine and a
-captured train step and runs the jaxpr passes over every program they
-compile — the donation/transfer/dtype/dead audit of what XLA is really
-handed.  This imports jax; plain source linting does not.
+``--audit-serving`` additionally builds a tiny CPU LLMEngine (one per
+KV dtype: float32 and quantized int8) and a captured train step and
+runs the jaxpr passes over every program they compile — the
+donation/transfer/dtype/dead audit of what XLA is really handed.  This
+imports jax; plain source linting does not.
 
 ``--write-baseline`` rewrites the baseline file to accept every finding
 of the current run (review the diff before committing it).
@@ -45,9 +46,14 @@ def _serving_findings(large_bytes: int):
     cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4, ffn=64,
                            seq=64)
     model = LlamaForCausalLM(cfg)
-    engine = LLMEngine(model, max_num_seqs=4, block_size=8, max_model_len=64,
-                       max_prefill_tokens=128, prefill_token_bucket=32)
+    engine_kw = dict(max_num_seqs=4, block_size=8, max_model_len=64,
+                     max_prefill_tokens=128, prefill_token_bucket=32)
+    engine = LLMEngine(model, **engine_kw)
     specs = engine.program_specs(large_bytes=large_bytes)
+    # the quantized engine compiles its own program pair (q8 step + q8
+    # CoW); its scale pools are large buffers that must be donated too
+    q8 = LLMEngine(model, kv_dtype="int8", **engine_kw)
+    specs += q8.program_specs(large_bytes=large_bytes)
 
     # captured train step: tiny linear regression, donated params
     from paddle_tpu.jit.step import capture_step

@@ -20,13 +20,16 @@ both plus the cache's own surface:
 
 With ``--spec K`` the stream is repetitive text (the n-gram prompt-lookup
 drafter's home turf) and the same workload runs with speculation OFF then
-ON (spec_k=K), reporting decode throughput for both plus the speculation
-surface:
+ON (spec_k=K), reporting wall-clock emitted tok/s for both plus per-phase
+throughput — decode and verify each over their own wall time.  (The old
+"speedup" ratio compared verify-folded decode numbers against plain
+decode of a different token mix — a bookkeeping artifact, dropped):
 
   {"metric": "serve_spec_tokens_per_s", "value": ..., "unit": "tok/s",
-   "baseline_tokens_per_s": ..., "speedup": ..., "accept_rate": ...,
-   "draft_proposed": ..., "draft_accepted": ..., "rollback_tokens": ...,
-   "verify_steps": ..., "spec_disables": ..., ...}
+   "baseline_tokens_per_s": ..., "decode_tokens_per_s": ...,
+   "verify_tokens_per_s": ..., "accept_rate": ..., "draft_proposed": ...,
+   "draft_accepted": ..., "rollback_tokens": ..., "verify_steps": ...,
+   "spec_disables": ..., ...}
 
 With ``--mixed`` the stream interleaves long prefills (chunk-resumed
 across steps), short prompts, plain decodes and n-gram speculation
@@ -49,6 +52,23 @@ the HTTP tier costs:
    "engine_tokens_per_s": ..., "http_overhead": ...,
    "ttft_p50_ms": ..., "ttft_p99_ms": ..., "itl_p50_ms": ...,
    "itl_p99_ms": ..., "requests": ..., "aborts": ..., "shed": ...}
+
+With ``--memory-pressure`` the page pool is sized from a fixed HBM byte
+budget (not a block count) and a burst of medium prompts runs once per
+KV dtype — float32 baseline, then ``--kv-dtype`` — each through a
+DegradationController, so the line proves what quantized pages buy on
+the same silicon at matched traffic:
+
+  {"metric": "serve_pressure_resident_seqs", "value": ..., "unit": "seqs",
+   "resident_ratio": ..., "baseline_peak_resident_seqs": ...,
+   "preempted": ..., "baseline_preempted": ...,
+   "degradation_tier_entries": ..., "baseline_degradation_tier_entries": ...,
+   "hbm_budget_bytes": ..., "num_blocks": ..., "baseline_num_blocks": ...}
+
+Every mode's record also carries the KV-residency surface — ``kv_dtype``,
+``kv_bytes_resident``, ``peak_resident_seqs``,
+``degradation_tier_entries`` — and ``--kv-dtype int8`` threads quantized
+KV pages through every engine the bench builds.
 
 Hardening contract (same as bench.py): the JSON line ALWAYS prints.  The
 backend is probed in a subprocess with a hard timeout before this process
@@ -146,8 +166,19 @@ def _drive(engine, stream):
     return time.perf_counter() - t0
 
 
+def _mem_keys(engine):
+    """KV-residency surface every mode reports, all dtypes: what the
+    pages cost in bytes and how many sequences they held at peak."""
+    return {
+        "kv_dtype": engine.kv_dtype,
+        "kv_bytes_resident": engine.kv_bytes_resident(),
+        "peak_resident_seqs": engine.peak_resident_seqs,
+        "degradation_tier_entries": engine.degradation_tier_entries,
+    }
+
+
 def run_prefix_bench(smoke: bool, n_requests: int, share_ways: int,
-                     seed: int, backend: str):
+                     seed: int, backend: str, kv_dtype: str = "float32"):
     """Same shared-prefix workload with prefix caching OFF then ON.  Each
     engine gets one untimed pass (compiles every program bucket and, for
     the cached engine, populates the pool) and one timed steady-state
@@ -178,7 +209,7 @@ def run_prefix_bench(smoke: bool, n_requests: int, share_ways: int,
     runs = {}
     for caching in (False, True):
         engine = LLMEngine(model, enable_prefix_caching=caching,
-                           **engine_kw)
+                           kv_dtype=kv_dtype, **engine_kw)
         rng = np.random.RandomState(seed)
         stream = _prefix_stream(rng, n_requests, share_ways,
                                 cfg.vocab_size, engine_kw["max_model_len"])
@@ -217,6 +248,7 @@ def run_prefix_bench(smoke: bool, n_requests: int, share_ways: int,
         "decode_compiles": on["decode_compiles"],
         "prefill_compiles": on["prefill_compiles"],
         "preempted": on["preemptions"],
+        **_mem_keys(engine),
     }
 
 
@@ -238,12 +270,14 @@ def _spec_text_stream(rng, n_requests, vocab, max_len):
 
 
 def run_spec_bench(smoke: bool, n_requests: int, spec_k: int, seed: int,
-                   backend: str):
+                   backend: str, kv_dtype: str = "float32"):
     """Same repetitive-text workload with speculation OFF then ON.  Each
     engine gets one untimed pass (compiles every program bucket) and one
-    timed pass; value is decode tokens per decode-wall second (verify
-    time is folded into decode time, so the comparison is
-    apples-to-apples: same emitted tokens, different step counts)."""
+    timed pass; value is emitted tokens per wall second across the
+    decode AND verify phases (each phase also reported over its own wall
+    time).  The same emitted tokens ride fewer, heavier steps when
+    speculation wins — the per-phase numbers make that legible instead
+    of hiding verify time inside decode time."""
     import numpy as np
 
     import paddle_tpu
@@ -283,7 +317,7 @@ def run_spec_bench(smoke: bool, n_requests: int, spec_k: int, seed: int,
             kw.update(drafter=NGramDrafter(max_ngram=6, min_ngram=1),
                       spec_k=spec_k, max_spec_k=spec_k,
                       spec_accept_floor=0.0)
-        engine = LLMEngine(model, **kw)
+        engine = LLMEngine(model, kv_dtype=kv_dtype, **kw)
         rng = np.random.RandomState(seed)
         stream = _spec_text_stream(rng, n_requests, cfg.vocab_size,
                                    engine_kw["max_model_len"])
@@ -293,8 +327,8 @@ def run_spec_bench(smoke: bool, n_requests: int, spec_k: int, seed: int,
             engine.stats.reset()          # runs are short, wall noise is
             _drive(engine, list(stream))  # not
             s = engine.stats.summary()
-            if best is None or s["decode_tokens_per_s"] \
-                    > best["decode_tokens_per_s"]:
+            if best is None or s["emitted_tokens_per_s"] \
+                    > best["emitted_tokens_per_s"]:
                 best = s
         s = best
         s["attention_compiles"] = engine.compile_counts["ragged"]
@@ -303,15 +337,16 @@ def run_spec_bench(smoke: bool, n_requests: int, spec_k: int, seed: int,
     on, off = runs[True], runs[False]
     return {
         "metric": "serve_spec_tokens_per_s",
-        "value": on["decode_tokens_per_s"],
+        "value": on["emitted_tokens_per_s"],
         "unit": "tok/s",
         "backend": backend,
         "spec_k": spec_k,
         "requests": n_requests,
-        "baseline_tokens_per_s": off["decode_tokens_per_s"],
-        "speedup": round(on["decode_tokens_per_s"]
-                         / off["decode_tokens_per_s"], 3)
-        if off["decode_tokens_per_s"] else 0.0,
+        "baseline_tokens_per_s": off["emitted_tokens_per_s"],
+        "decode_tokens_per_s": on["decode_tokens_per_s"],
+        "verify_tokens_per_s": on["verify_tokens_per_s"],
+        "prefill_tokens_per_s": on["prefill_tokens_per_s"],
+        "baseline_decode_tokens_per_s": off["decode_tokens_per_s"],
         "accept_rate": on["accept_rate"],
         "draft_proposed": on["draft_proposed"],
         "draft_accepted": on["draft_accepted"],
@@ -323,10 +358,12 @@ def run_spec_bench(smoke: bool, n_requests: int, spec_k: int, seed: int,
         "decode_steps": on["decode_steps"],
         "baseline_decode_steps": off["decode_steps"],
         "decode_tokens": on["decode_tokens"],
+        "verify_tokens": on["verify_tokens"],
         "attention_compiles": on["attention_compiles"],
         "p50_token_ms": on["p50_token_ms"],
         "p99_token_ms": on["p99_token_ms"],
         "preempted": on["preemptions"],
+        **_mem_keys(engine),
     }
 
 
@@ -389,7 +426,8 @@ def _http_drive(port, stream, *, step_delay_s: float = 0.002):
     return time.perf_counter() - t0, results
 
 
-def run_http_bench(smoke: bool, n_requests: int, seed: int, backend: str):
+def run_http_bench(smoke: bool, n_requests: int, seed: int, backend: str,
+                   kv_dtype: str = "float32"):
     """The run_bench workload through the real HTTP frontend (SSE
     streaming clients over localhost) next to an engine-direct run of
     the identical stream.  Both engines get one untimed warm pass; value
@@ -423,7 +461,7 @@ def run_http_bench(smoke: bool, n_requests: int, seed: int, backend: str):
     # engine-direct reference: TWO warm passes (the first compiles the
     # cold-cache prefill buckets, the second compiles the chunked-resume
     # buckets that only exist once the prefix cache is hot), then timed
-    direct = LLMEngine(model, **engine_kw)
+    direct = LLMEngine(model, kv_dtype=kv_dtype, **engine_kw)
     _drive(direct, list(stream))
     _drive(direct, list(stream))
     direct.stats.reset()
@@ -436,7 +474,8 @@ def run_http_bench(smoke: bool, n_requests: int, seed: int, backend: str):
     # can still hit a never-seen (tokens, batch) bucket and pay a
     # compile; the record carries timed_new_compiles so an inflated
     # TTFT tail is attributable.
-    served = LLMEngine(model, retain_outputs=False, **engine_kw)
+    served = LLMEngine(model, retain_outputs=False, kv_dtype=kv_dtype,
+                       **engine_kw)
     srv = serve_background(served, model_name="bench",
                            max_pending=4 * len(stream))
     try:
@@ -489,6 +528,7 @@ def run_http_bench(smoke: bool, n_requests: int, seed: int, backend: str):
         "timed_new_compiles": new_compiles,
         "drained": bool(drained),
         "finish_reasons": sorted({r["finish"] for r in results if r}),
+        **_mem_keys(served),
     }
 
 
@@ -514,7 +554,8 @@ def _mixed_request_stream(rng, n_requests, vocab, max_len,
     return stream
 
 
-def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str):
+def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str,
+                    kv_dtype: str = "float32"):
     """The ISSUE's headline workload: long prefills, chunked resumes,
     plain decodes, and speculative verify rounds all riding the ONE
     ragged step program.  Reports throughput, the exact attention
@@ -548,7 +589,8 @@ def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str):
     engine = LLMEngine(model, enable_prefix_caching=True,
                        drafter=NGramDrafter(max_ngram=6, min_ngram=1),
                        spec_k=spec_k, max_spec_k=spec_k,
-                       spec_accept_floor=0.0, **engine_kw)
+                       spec_accept_floor=0.0, kv_dtype=kv_dtype,
+                       **engine_kw)
     rng = np.random.RandomState(seed)
     stream = _mixed_request_stream(rng, n_requests, cfg.vocab_size,
                                    engine_kw["max_model_len"],
@@ -597,10 +639,12 @@ def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str):
         "ttft_p50_ms": s["ttft_p50_ms"],
         "ttft_p99_ms": s["ttft_p99_ms"],
         "preempted": s["preemptions"],
+        **_mem_keys(engine),
     }
 
 
-def run_chaos_bench(smoke: bool, n_requests: int, seed: int, backend: str):
+def run_chaos_bench(smoke: bool, n_requests: int, seed: int, backend: str,
+                    kv_dtype: str = "float32"):
     """Goodput under injected faults: the ragged request stream runs
     through the supervised EngineRunner while a seeded FaultPlan crashes
     a step, hangs a step past the watchdog deadline, poisons a logit
@@ -635,7 +679,8 @@ def run_chaos_bench(smoke: bool, n_requests: int, seed: int, backend: str):
     model = LlamaForCausalLM(cfg)
 
     def factory():
-        return LLMEngine(model, retain_outputs=False, **engine_kw)
+        return LLMEngine(model, retain_outputs=False, kv_dtype=kv_dtype,
+                         **engine_kw)
 
     # the full schedule from one seed: one crash (in-thread recovery),
     # one hang past the watchdog deadline, one NaN row (quarantine), one
@@ -692,10 +737,127 @@ def run_chaos_bench(smoke: bool, n_requests: int, seed: int, backend: str):
         "drained": bool(drained),
         "finish_reasons": sorted({o.finish_reason for o in outs}),
         "step_deadline_s": step_deadline_s,
+        **_mem_keys(fin),
     }
 
 
-def run_bench(smoke: bool, n_requests: int, seed: int, backend: str):
+def _pressure_stream(rng, n_requests, vocab):
+    """Burst arrivals of medium prompts with modest decode budgets —
+    sized so page residency, not compute, is the binding resource."""
+    stream, step = [], 0
+    for _ in range(n_requests):
+        step += int(rng.poisson(0.3))
+        prompt = rng.randint(0, vocab, 48).tolist()
+        stream.append((step, prompt, 16))
+    return stream
+
+
+def _page_bytes(cfg, block_size, kv_dtype):
+    """Per-page HBM cost for a dtype BEFORE building an engine — the
+    pressure bench sizes pools from a byte budget, so both dtypes get
+    the same silicon, not the same block count."""
+    hd = cfg.hidden_size // cfg.num_attention_heads
+    per = 2 * cfg.num_hidden_layers * cfg.num_key_value_heads \
+        * block_size * hd * (1 if kv_dtype == "int8" else 4)
+    if kv_dtype == "int8":
+        # f32 scale rows ride in a parallel pool
+        per += 2 * cfg.num_hidden_layers * cfg.num_key_value_heads * 4
+    return per
+
+
+def _drive_peak(engine, stream):
+    """_drive plus per-step sampling of the KV-residency peak."""
+    import time
+
+    t0 = time.perf_counter()
+    step_no, peak_bytes = 0, 0
+    pending = list(stream)
+    while pending or engine.has_unfinished():
+        while pending and pending[0][0] <= step_no:
+            _, prompt, max_new = pending.pop(0)
+            engine.add_request(prompt, max_new_tokens=max_new)
+        engine.step()
+        peak_bytes = max(peak_bytes, engine.kv_bytes_resident())
+        step_no += 1
+    return time.perf_counter() - t0, peak_bytes
+
+
+def run_pressure_bench(smoke: bool, n_requests: int, seed: int,
+                       backend: str, kv_dtype: str):
+    """Fixed-HBM A/B: the same burst stream runs on a float32 pool and
+    a ``kv_dtype`` pool sized from the SAME byte budget, each with a
+    DegradationController installed.  int8 pages are ~4x smaller, so
+    the budget holds ~4x the blocks — the record shows how many more
+    sequences stayed resident and how many preemptions / degradation
+    tier entries that headroom avoided at matched traffic."""
+    import numpy as np
+
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.inference.pressure import DegradationController
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    # a residency proof, not a throughput race: one tiny config serves
+    # every backend, sized so the float32 pool starves mid-stream
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4,
+                           ffn=64, seq=256)
+    engine_kw = dict(max_num_seqs=16, block_size=8, max_model_len=256,
+                     max_prefill_tokens=128, prefill_token_bucket=64)
+    budget = 52 * _page_bytes(cfg, engine_kw["block_size"], "float32")
+
+    model = LlamaForCausalLM(cfg)
+    runs = {}
+    for dt in ("float32", kv_dtype):
+        nb = budget // _page_bytes(cfg, engine_kw["block_size"], dt)
+        engine = LLMEngine(model, kv_dtype=dt, num_blocks=int(nb),
+                           pressure=DegradationController(), **engine_kw)
+        rng = np.random.RandomState(seed)
+        stream = _pressure_stream(rng, n_requests, cfg.vocab_size)
+        wall, peak_bytes = _drive_peak(engine, stream)
+        s = engine.stats.summary()
+        runs[dt] = {
+            "num_blocks": int(nb),
+            "kv_page_bytes": engine.kv_page_bytes(),
+            "peak_resident_seqs": engine.peak_resident_seqs,
+            "peak_kv_bytes_resident": int(peak_bytes),
+            "kv_bytes_resident": engine.kv_bytes_resident(),
+            "degradation_tier_entries": engine.degradation_tier_entries,
+            "preempted": s["preemptions"],
+            "retired": s["retired"],
+            "wall_s": round(wall, 3),
+        }
+    q, base = runs[kv_dtype], runs["float32"]
+    return {
+        "metric": "serve_pressure_resident_seqs",
+        "value": q["peak_resident_seqs"],
+        "unit": "seqs",
+        "backend": backend,
+        "kv_dtype": kv_dtype,
+        "requests": n_requests,
+        "hbm_budget_bytes": int(budget),
+        "num_blocks": q["num_blocks"],
+        "baseline_num_blocks": base["num_blocks"],
+        "kv_page_bytes": q["kv_page_bytes"],
+        "baseline_kv_page_bytes": base["kv_page_bytes"],
+        "peak_resident_seqs": q["peak_resident_seqs"],
+        "baseline_peak_resident_seqs": base["peak_resident_seqs"],
+        "resident_ratio": round(q["peak_resident_seqs"]
+                                / base["peak_resident_seqs"], 3)
+        if base["peak_resident_seqs"] else 0.0,
+        "peak_kv_bytes_resident": q["peak_kv_bytes_resident"],
+        "baseline_peak_kv_bytes_resident": base["peak_kv_bytes_resident"],
+        "kv_bytes_resident": q["kv_bytes_resident"],
+        "degradation_tier_entries": q["degradation_tier_entries"],
+        "baseline_degradation_tier_entries":
+            base["degradation_tier_entries"],
+        "preempted": q["preempted"],
+        "baseline_preempted": base["preempted"],
+        "retired": q["retired"],
+        "baseline_retired": base["retired"],
+    }
+
+
+def run_bench(smoke: bool, n_requests: int, seed: int, backend: str,
+              kv_dtype: str = "float32"):
     import numpy as np
 
     from paddle_tpu.inference import LLMEngine
@@ -716,7 +878,7 @@ def run_bench(smoke: bool, n_requests: int, seed: int, backend: str):
                          max_prefill_tokens=2048, prefill_token_bucket=256)
 
     model = LlamaForCausalLM(cfg)
-    engine = LLMEngine(model, **engine_kw)
+    engine = LLMEngine(model, kv_dtype=kv_dtype, **engine_kw)
     rng = np.random.RandomState(seed)
     stream = _request_stream(rng, n_requests, cfg.vocab_size,
                              engine_kw["max_model_len"])
@@ -749,6 +911,7 @@ def run_bench(smoke: bool, n_requests: int, seed: int, backend: str):
         "requests": n_requests,
         "preempted": s["preemptions"],
         "decode_tokens": s["decode_tokens"],
+        **_mem_keys(engine),
     }
 
 
@@ -781,10 +944,25 @@ def main(argv=None):
                          "under a seeded FaultPlan (crash, hang, NaN row, "
                          "pool window); report goodput including the "
                          "recovery stalls")
+    ap.add_argument("--kv-dtype", choices=("float32", "int8"),
+                    default="float32",
+                    help="KV-page dtype for every engine the bench "
+                         "builds (int8 = quantized pages + f32 scale "
+                         "pools, dequantized in-kernel)")
+    ap.add_argument("--memory-pressure", action="store_true",
+                    help="size the page pool from a fixed HBM byte "
+                         "budget and run the same burst stream on a "
+                         "float32 pool vs a --kv-dtype pool; report "
+                         "resident sequences, preemptions and "
+                         "degradation tier entries for both")
     args = ap.parse_args(argv)
 
     backend, probe_err = _probe_backend()
-    if args.chaos:
+    if args.memory_pressure:
+        n_requests = args.requests or 16
+        record = {"metric": "serve_pressure_resident_seqs", "value": 0.0,
+                  "unit": "seqs", "backend": backend}
+    elif args.chaos:
         n_requests = args.requests or (8 if (args.smoke or backend == "cpu")
                                        else 32)
         record = {"metric": "serve_chaos_goodput_tokens_per_s",
@@ -817,25 +995,30 @@ def main(argv=None):
     if probe_err:
         record["backend_note"] = f"cpu fallback: {probe_err}"
     try:
-        if args.chaos:
+        if args.memory_pressure:
+            record.update(run_pressure_bench(args.smoke, n_requests,
+                                             args.seed, backend,
+                                             args.kv_dtype))
+        elif args.chaos:
             record.update(run_chaos_bench(args.smoke, n_requests, args.seed,
-                                          backend))
+                                          backend, args.kv_dtype))
         elif args.mixed:
             record.update(run_mixed_bench(args.smoke, n_requests, args.seed,
-                                          backend))
+                                          backend, args.kv_dtype))
         elif args.http:
             record.update(run_http_bench(args.smoke, n_requests, args.seed,
-                                         backend))
+                                         backend, args.kv_dtype))
         elif args.spec:
             record.update(run_spec_bench(args.smoke, n_requests, args.spec,
-                                         args.seed, backend))
+                                         args.seed, backend,
+                                         args.kv_dtype))
         elif args.prefix_share:
             record.update(run_prefix_bench(args.smoke, n_requests,
                                            args.prefix_share, args.seed,
-                                           backend))
+                                           backend, args.kv_dtype))
         else:
             record.update(run_bench(args.smoke, n_requests, args.seed,
-                                    backend))
+                                    backend, args.kv_dtype))
         if probe_err:
             record["backend_note"] = f"cpu fallback: {probe_err}"
     except Exception as e:  # the line must still print
