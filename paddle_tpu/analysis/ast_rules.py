@@ -59,6 +59,10 @@ _COERCIONS = {"float", "int", "bool"}
 
 _ATTEN_RE = re.compile(r"atten", re.IGNORECASE)
 
+# mesh collectives whose axis name binds only under shard_map
+_COLLECTIVES = {"psum", "all_gather", "psum_scatter", "ppermute",
+                "all_to_all", "pmean", "pmax", "pmin"}
+
 # KV-PAGE pool names (kc/vc/k_cache/kv_cache/page_pool...); scale pools
 # (_ks/_vs/scales) deliberately don't match — f32 scales are the contract
 _KV_PAGE_RE = re.compile(
@@ -496,6 +500,49 @@ def lint_source(text: str, path: str = "<string>") -> list:
                      "exception (pass/log-and-continue) — step/release/"
                      "abort/recover paths must let failures surface for "
                      "the watchdog and quarantine logic")
+
+        # ---- collective-outside-shard-map (serving tier only) -------------
+        # TP contract: lax collectives bind their mesh axis name ("tp")
+        # only under shard_map.  A collective in a compiled def never
+        # routed through shard_map either fails to trace (unbound axis)
+        # or runs unsharded on one chip.  Same name-based fixpoint as the
+        # compiled set: ``shard_map(run, ...)`` marks every def named
+        # ``run``, plus its nested defs and by-name callees.
+        shardmapped = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and (_dotted(node.func) or ())[-1:] == ("shard_map",):
+                for arg in node.args[:1]:
+                    if isinstance(arg, ast.Name):
+                        shardmapped.update(ctx.by_name.get(arg.id, ()))
+        changed = True
+        while changed:
+            changed = False
+            for d in list(shardmapped):
+                for node in ast.walk(d):
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) \
+                            and node not in shardmapped:
+                        shardmapped.add(node)
+                        changed = True
+                    elif isinstance(node, ast.Call) \
+                            and isinstance(node.func, ast.Name):
+                        for callee in ctx.by_name.get(node.func.id, ()):
+                            if callee not in shardmapped:
+                                shardmapped.add(callee)
+                                changed = True
+        for d in compiled - shardmapped:
+            for node in _walk_own(d):
+                if not isinstance(node, ast.Call):
+                    continue
+                dd = _dotted(node.func)
+                if dd and dd[-1] in _COLLECTIVES \
+                        and ("lax" in dd or len(dd) == 1):
+                    emit("collective-outside-shard-map", node,
+                         f"`{'.'.join(dd)}` inside compiled `{d.name}`, "
+                         "which is never handed to shard_map — the mesh "
+                         "axis name is unbound here; wrap the step with "
+                         "shard_map before jax.jit")
     return findings
 
 

@@ -102,6 +102,12 @@ RULES = {
         "supervised-recovery watchdog and quarantine logic depend on "
         "failures surfacing; an eaten exception turns a crashed step "
         "into a silent hang or a leaked sequence")),
+    "collective-outside-shard-map": (ERROR, "ast", (
+        "a lax collective (psum/all_gather/ppermute/...) inside an "
+        "inference-tier compiled def that is never routed through "
+        "shard_map — the mesh axis name is unbound outside shard_map, so "
+        "the program either fails to trace or silently runs unsharded on "
+        "one chip; wrap the step with shard_map before jitting")),
 }
 
 

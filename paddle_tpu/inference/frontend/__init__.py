@@ -10,6 +10,9 @@ The package that turns ``LLMEngine`` into a server:
   steps the single-threaded engine; submit/abort cross over via queues
   drained at step boundaries; tokens stream out through per-request
   deliver callbacks.
+- ``router.ReplicaRouter`` — data-parallel fan-out: D engine replicas
+  (each its own runner thread) behind one EngineRunner-shaped facade,
+  with prefix-affinity / least-outstanding-tokens / random routing.
 - ``protocol`` — the OpenAI-completions-shaped wire schema (token-id
   native), ``http`` — the minimal hand-rolled HTTP/1.1 + SSE layer,
   ``metrics`` — Prometheus rendering of ``ServingStats.snapshot()``.
@@ -20,9 +23,10 @@ Everything is stdlib (asyncio + sockets); there is no web-framework
 dependency anywhere under this package.
 """
 from .app import BackgroundServer, ServingFrontend, serve_background
+from .router import ReplicaRouter, build_replicas
 from .runner import (EngineRunner, RunnerDraining, RunnerSaturated,
                      StreamHandle)
 
 __all__ = ["ServingFrontend", "BackgroundServer", "serve_background",
            "EngineRunner", "RunnerSaturated", "RunnerDraining",
-           "StreamHandle"]
+           "StreamHandle", "ReplicaRouter", "build_replicas"]

@@ -20,8 +20,21 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import os
 import signal
 import sys
+
+
+def _ensure_host_devices(n: int) -> None:
+    """Make sure XLA exposes >= n host devices for --tp on CPU.  Must
+    run BEFORE the first jax import (which is why every jax import in
+    this module is function-local)."""
+    if n <= 1:
+        return
+    flag = f"--xla_force_host_platform_device_count={n}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = f"{flags} {flag}".strip()
 
 
 def _build_engine(args):
@@ -56,7 +69,7 @@ def _build_engine(args):
             max_prefill_tokens=args.max_prefill_tokens,
             enable_prefix_caching=not args.no_prefix_caching,
             drafter=drafter, spec_k=args.spec_k,
-            kv_dtype=args.kv_dtype,
+            kv_dtype=args.kv_dtype, tp=args.tp,
             retain_outputs=False)
 
     return make_engine
@@ -95,9 +108,27 @@ def main(argv=None) -> int:
                     help="supervised recovery: rebuild the engine and "
                          "replay in-flight requests when a step crashes "
                          "or runs past this wall budget (0 = off)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel shards per engine: heads and KV "
+                         "pages split over a tp-way mesh inside one "
+                         "compiled step (byte-identical to --tp 1; on CPU "
+                         "host devices are forced automatically)")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="data-parallel engine replicas behind one "
+                         "listener, fed by the replica router")
+    ap.add_argument("--router-policy", default="affinity",
+                    choices=["affinity", "least", "random"],
+                    help="replica routing: prefix-affinity (shared "
+                         "prompts land on the replica already holding "
+                         "their KV pages), least-outstanding-tokens, or "
+                         "random (ignored with --replicas 1)")
     args = ap.parse_args(argv)
 
-    print(f"[frontend] building {args.model} engine ...", flush=True)
+    _ensure_host_devices(args.tp)
+    print(f"[frontend] building {args.model} engine"
+          + (f" x{args.replicas}" if args.replicas > 1 else "")
+          + (f" (tp={args.tp})" if args.tp > 1 else "")
+          + " ...", flush=True)
     make_engine = _build_engine(args)
     engine = make_engine()
 
@@ -107,8 +138,10 @@ def main(argv=None) -> int:
         max_pending=args.max_pending or None,
         default_deadline_s=(args.deadline_ms / 1e3
                             if args.deadline_ms else None),
-        engine_factory=make_engine if args.step_deadline_s else None,
-        step_deadline_s=args.step_deadline_s or None)
+        engine_factory=(make_engine if args.step_deadline_s
+                        or args.replicas > 1 else None),
+        step_deadline_s=args.step_deadline_s or None,
+        replicas=args.replicas, router_policy=args.router_policy)
 
     async def run():
         await frontend.start()

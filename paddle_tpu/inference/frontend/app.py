@@ -47,6 +47,7 @@ from .metrics import render_metrics
 from .protocol import (ProtocolError, completion_response, error_body,
                        parse_completion_request, stream_finish_frame,
                        stream_token_frame)
+from .router import ReplicaRouter, build_replicas
 from .runner import EngineRunner, RunnerDraining, RunnerSaturated
 
 __all__ = ["ServingFrontend", "BackgroundServer", "serve_background"]
@@ -64,25 +65,42 @@ class ServingFrontend:
     model_name: echoed in response bodies as ``model``.
     host/port: bind address; port 0 picks a free port (``self.port``
         holds the real one after ``start()``).
-    max_pending: admission bound forwarded to EngineRunner.
+    max_pending: admission bound forwarded to EngineRunner (per replica
+        when ``replicas > 1``).
     default_deadline_s: applied when a request carries no deadline_ms;
         None means no deadline.
     engine_factory/step_deadline_s: forwarded to EngineRunner; together
         they arm the supervised-recovery watchdog (see runner docs).
+    replicas: data-parallel engine replicas behind one listener.  1 (the
+        default) keeps the single EngineRunner.  D > 1 builds D engines
+        — the passed ``engine`` plus D-1 from ``engine_factory`` (then
+        REQUIRED) — each with its own stepping thread, and routes
+        requests across them with a ReplicaRouter; ``self.runner`` keeps
+        the same surface either way.
+    router_policy: "affinity" (default) | "least" | "random" — see
+        router.py.  Ignored when replicas == 1.
     """
 
     def __init__(self, engine, *, model_name: str = "model",
                  host: str = "127.0.0.1", port: int = 8000,
                  max_pending: int | None = None,
                  default_deadline_s: float | None = None,
-                 engine_factory=None, step_deadline_s: float | None = None):
+                 engine_factory=None, step_deadline_s: float | None = None,
+                 replicas: int = 1, router_policy: str = "affinity"):
         self.model_name = str(model_name)
         self.host = host
         self.port = int(port)
         self.default_deadline_s = default_deadline_s
-        self.runner = EngineRunner(engine, max_pending=max_pending,
-                                   engine_factory=engine_factory,
-                                   step_deadline_s=step_deadline_s)
+        if int(replicas) > 1:
+            self.runner = ReplicaRouter(
+                build_replicas(engine, engine_factory, int(replicas),
+                               max_pending=max_pending,
+                               step_deadline_s=step_deadline_s),
+                policy=router_policy)
+        else:
+            self.runner = EngineRunner(engine, max_pending=max_pending,
+                                       engine_factory=engine_factory,
+                                       step_deadline_s=step_deadline_s)
         self._server = None
         self._writers: set = set()        # open connections, for shutdown
         self._lock = threading.Lock()
@@ -207,9 +225,17 @@ class ServingFrontend:
             await writer.drain()
             return True
         if route == ("GET", "/metrics"):
+            # a ReplicaRouter aggregates stats across its fleet and adds
+            # per-replica routing gauges; a plain runner reads one engine
+            if hasattr(self.runner, "stats_snapshot"):
+                snap = self.runner.stats_snapshot()
+                router = self.runner.router_counters()
+            else:
+                snap = self.engine.stats.snapshot()
+                router = None
             text = render_metrics(
-                self.engine.stats.snapshot(), engine=self.engine,
-                frontend=self._frontend_counters())
+                snap, engine=self.engine,
+                frontend=self._frontend_counters(), router=router)
             self._count("/metrics", 200)
             writer.write(response_bytes(
                 200, text.encode("utf-8"),

@@ -47,15 +47,21 @@ class _Doc:
 
 
 def render_metrics(snapshot: dict, *, engine=None,
-                   frontend: dict | None = None) -> str:
+                   frontend: dict | None = None,
+                   router: dict | None = None) -> str:
     """Render one /metrics scrape.
 
-    snapshot: ServingStats.snapshot() dict.
+    snapshot: ServingStats.snapshot() dict (or the fleet aggregate from
+        ``ServingStats.aggregate`` when a router is attached).
     engine: the live LLMEngine for pool/queue gauges (optional so the
-        renderer stays unit-testable with a bare snapshot).
+        renderer stays unit-testable with a bare snapshot).  Under a
+        replica router this is replica 0 — the fleet-wide counters come
+        from the aggregated snapshot, the pool gauges are one replica's.
     frontend: the frontend's own counters —
         {"requests_total": {(route, code): n}, "shed_total": n,
          "active_streams": n, "queue_depth": n, "draining": bool}.
+    router: ReplicaRouter.router_counters() — per-replica routing gauges
+        labeled {replica="i"}; None for a single-runner frontend.
     """
     d = _Doc()
     s = snapshot
@@ -142,6 +148,26 @@ def render_metrics(snapshot: dict, *, engine=None,
     d.metric("spec_accept_rate", "gauge",
              "Fraction of speculated draft tokens accepted by verify.",
              [(None, s.get("accept_rate"))])
+
+    # -- replica routing --------------------------------------------------
+    if router is not None:
+        d.metric("replicas", "gauge",
+                 "Data-parallel engine replicas behind the router.",
+                 [(None, router.get("replicas"))])
+        d.metric("replica_outstanding_tokens", "gauge",
+                 "Routing load estimate per replica: prompt + budget "
+                 "tokens submitted and not yet finished.",
+                 [({"replica": str(i)}, v) for i, v in
+                  enumerate(router.get("outstanding_tokens", []))])
+        d.metric("replica_routed_requests_total", "counter",
+                 "Requests landed on each replica.",
+                 [({"replica": str(i)}, v) for i, v in
+                  enumerate(router.get("routed_requests", []))])
+        d.metric("replica_affinity_hits_total", "counter",
+                 "Requests routed by a prefix-affinity match, per "
+                 "replica.",
+                 [({"replica": str(i)}, v) for i, v in
+                  enumerate(router.get("affinity_hits", []))])
 
     # -- engine gauges ----------------------------------------------------
     if engine is not None:

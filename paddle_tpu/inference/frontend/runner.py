@@ -118,13 +118,18 @@ class EngineRunner:
     max_restarts: recovery budget; exceeding it fails the in-flight set
         instead of rebuilding again (a deterministic crash must not loop
         forever).
+    name: optional runner name, prefixed onto every request id
+        ("r0-req-3") — a replica router recovers the owning runner from
+        the id alone, so aborts route without a shared table.
     """
 
     def __init__(self, engine, *, max_pending: int | None = None,
                  idle_wait_s: float = 0.05, engine_factory=None,
                  step_deadline_s: float | None = None,
-                 max_restarts: int = 8):
+                 max_restarts: int = 8, name: str = ""):
         self.engine = engine
+        self.name = str(name)
+        self._id_prefix = f"{self.name}-" if self.name else ""
         self.max_pending = int(max_pending
                                if max_pending is not None
                                else 4 * engine.max_num_seqs)
@@ -153,8 +158,9 @@ class EngineRunner:
         # between steps.  Generation-tagged so a zombie's cleanup cannot
         # clear the replacement thread's timer.
         self._step_started = None
+        tname = f"llm-engine-{self.name}" if self.name else "llm-engine"
         self._thread = threading.Thread(target=self._loop, args=(0,),
-                                        name="llm-engine", daemon=True)
+                                        name=tname, daemon=True)
         self._watchdog = None
         self._started = False
 
@@ -192,7 +198,7 @@ class EngineRunner:
                 raise RunnerSaturated(
                     f"{self._inflight} requests in flight >= max_pending "
                     f"{self.max_pending}")
-            request_id = f"req-{next(self._seq)}"
+            request_id = f"{self._id_prefix}req-{next(self._seq)}"
             deadline = None if deadline_s is None \
                 else time.monotonic() + float(deadline_s)
             h = StreamHandle(request_id=request_id, deliver=deliver,

@@ -40,7 +40,8 @@ from collections import OrderedDict
 
 import numpy as np
 
-__all__ = ["BlockManager", "BlockPoolExhausted", "NULL_BLOCK"]
+__all__ = ["BlockManager", "BlockPoolExhausted", "NULL_BLOCK",
+           "prefix_chain_hashes"]
 
 NULL_BLOCK = 0
 
@@ -655,3 +656,22 @@ def _page_hash_chain(ids, n_pages, bs):
     for p in range(n_pages):
         prev = _page_hash(prev, ids[p * bs:(p + 1) * bs])
     return prev
+
+
+def prefix_chain_hashes(token_ids, block_size: int) -> list:
+    """Chain hash of EVERY full page prefix of ``token_ids``, in order.
+
+    ``result[i]`` identifies pages 0..i of the sequence — exactly the
+    hashes ``BlockManager`` registers for a prompt's full pages, computed
+    WITHOUT touching any pool.  The replica router uses this to predict
+    which engine's prefix cache already holds a prompt's leading pages
+    (frontend/router.py): two prompts share cached pages iff their chain
+    hashes match, so matching hashes host-side is exactly the cache's own
+    sharing criterion."""
+    ids = [int(t) for t in token_ids]
+    bs = int(block_size)
+    out, prev = [], None
+    for p in range(len(ids) // bs):
+        prev = _page_hash(prev, ids[p * bs:(p + 1) * bs])
+        out.append(prev)
+    return out

@@ -72,7 +72,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..core.jaxcompat import shard_map
 from ..models.llama import _rms_weight, _rope_positions
 from ..ops.pallas import paged_attention as _pa
 from ..profiler import RecordEvent, ServingStats
@@ -179,6 +181,20 @@ class LLMEngine:
         passes False — outputs are delivered through each request's
         ``on_finish`` callback instead, so finished requests cost no
         memory once their stream closes.
+    tp: tensor-parallel degree.  tp > 1 lays the SAME ragged step over a
+        1-D device mesh via shard_map: attention heads (Hq and Hkv) and
+        the KV/scale page pools shard per chip along the head axis,
+        block tables and (cu_seqlens, kv_lens) replicate, and one
+        all-gather of per-shard attention heads (plus logit slices when
+        vocab_size % tp == 0) runs INSIDE the compiled step — the host
+        still sees one launch per step and ``compile_counts`` still
+        counts one attention program kind.  Requires num_attention_heads
+        % tp == 0 and num_key_value_heads % tp == 0.  Head partitioning
+        is by contiguous blocks, so GQA group structure is preserved and
+        greedy outputs stay byte-identical to tp=1.  Host bookkeeping
+        (BlockManager, scheduler, sampling params) is untouched — it is
+        mesh-blind.  Testable on CPU via
+        ``XLA_FLAGS=--xla_force_host_platform_device_count=N``.
 
     The engine is SINGLE-THREADED by design: add_request/step/abort must
     all be called from one thread (the frontend's EngineRunner owns that
@@ -196,7 +212,7 @@ class LLMEngine:
                  spec_accept_floor: float = 0.35, spec_window: int = 32,
                  retain_outputs: bool = True,
                  fault_plan=None, pressure=None,
-                 kv_dtype: str = "float32"):
+                 kv_dtype: str = "float32", tp: int = 1):
         cfg = model.config
         self.config = cfg
         self.params = model.decode_params()
@@ -204,6 +220,27 @@ class LLMEngine:
             raise ValueError(
                 f"kv_dtype must be 'float32' or 'int8', got {kv_dtype!r}")
         self.kv_dtype = kv_dtype
+        self.tp = int(tp)
+        if self.tp < 1:
+            raise ValueError(f"tp must be >= 1, got {tp}")
+        if self.tp > 1:
+            if (cfg.num_attention_heads % self.tp
+                    or cfg.num_key_value_heads % self.tp):
+                raise ValueError(
+                    f"tp={self.tp} must divide num_attention_heads="
+                    f"{cfg.num_attention_heads} and num_key_value_heads="
+                    f"{cfg.num_key_value_heads} (contiguous head "
+                    "partition keeps GQA groups on one shard)")
+            from ..distributed.auto_parallel.process_mesh import ProcessMesh
+            self._mesh = ProcessMesh(list(range(self.tp)),
+                                     dim_names=["tp"]).jax_mesh()
+        else:
+            self._mesh = None
+        # the unembedding shards over vocab only when it divides evenly
+        # (padding the vocab axis would poison the per-row finiteness
+        # flag); otherwise the head matmul replicates and the per-layer
+        # attention-head all-gather is the step's collective
+        self._shard_head = self.tp > 1 and cfg.vocab_size % self.tp == 0
         self.max_num_seqs = int(max_num_seqs)
         self.block_size = int(block_size)
         self.max_model_len = int(max_model_len or cfg.max_position_embeddings)
@@ -246,6 +283,17 @@ class LLMEngine:
                                   self.block_size, self._hd), dt)
             self._vc = jnp.zeros_like(self._kc)
             self._ks = self._vs = None
+        if self.tp > 1:
+            # lay the pools and the head-partitioned weights out on the
+            # mesh ONCE at construction; every step launch then runs
+            # without resharding transfers
+            self.params = self._shard_params(self.params)
+            kv_sh = NamedSharding(self._mesh, P(None, None, "tp"))
+            self._kc = jax.device_put(self._kc, kv_sh)
+            self._vc = jax.device_put(self._vc, kv_sh)
+            if self._ks is not None:
+                self._ks = jax.device_put(self._ks, kv_sh)
+                self._vs = jax.device_put(self._vs, kv_sh)
         # scale-reset feed: pages BlockManager handed out since the last
         # launch (their old scales are dead); consumed by _launch_ragged
         self._fresh_np = np.zeros((num_blocks,), bool)
@@ -327,6 +375,66 @@ class LLMEngine:
         self.fault_plan = plan
         self.blocks._fault_hook = plan.pool_exhausted \
             if plan is not None else None
+
+    # ------------------------------------------------------------------
+    # tensor-parallel layout (tp > 1)
+    # ------------------------------------------------------------------
+
+    def _param_specs(self) -> dict:
+        """PartitionSpec pytree for decode_params under the 1-D tp mesh.
+
+        q/k/v projections column-shard along their HEAD output axis
+        (leading L axis from the per-layer stack, then hidden, then
+        heads*head_dim) — each shard computes its contiguous head block
+        with the full replicated activation, so no contraction is ever
+        split and greedy outputs stay byte-identical to tp=1.  wo, the
+        MLP, and the norms replicate; the unembedding column-shards over
+        vocab only when it divides evenly.
+        """
+        layers = {k: P() for k in self.params["layers"]}
+        for k in ("wq", "wk", "wv"):
+            layers[k] = P(None, None, "tp")
+        return {"layers": layers, "embed": P(), "norm_f": P(),
+                "head": P(None, "tp") if self._shard_head else P()}
+
+    def _shard_params(self, params) -> dict:
+        # specs lead the map (a PartitionSpec is itself a tuple pytree,
+        # so it must be the is_leaf-guarded side)
+        return jax.tree_util.tree_map(
+            lambda s, x: jax.device_put(x, NamedSharding(self._mesh, s)),
+            self._param_specs(), params,
+            is_leaf=lambda x: isinstance(x, P))
+
+    def _step_specs(self, n_host_args: int):
+        """(in_specs, out_specs) for the shard_map-wrapped ragged step.
+
+        KV/scale pools shard along their H_kv axis; params follow
+        ``_param_specs``; the ``n_host_args`` trailing host-packed
+        operands (tokens, cu_seqlens, kv_lens, block tables, logit
+        index, sampling pytree — plus the fresh-page mask in int8 mode)
+        replicate, a single P() covering each pytree by prefix.  Every
+        non-pool output (sampled tokens, finiteness flags, logits) is
+        genuinely replicated after the in-step all-gathers, so its
+        out_spec is P().
+        """
+        kv = P(None, None, "tp")
+        pools = (kv, kv) if self.kv_dtype == "float32" else (kv,) * 4
+        in_specs = (self._param_specs(), *pools) + (P(),) * n_host_args
+        out_front = (P(), P(), P()) if self._with_logits else (P(), P())
+        return in_specs, out_front + pools
+
+    def _wrap_tp(self, run, n_host_args: int):
+        """shard_map the step body over the tp mesh (identity at tp=1).
+
+        check_vma=False: the body mixes replicated and sharded operands
+        and resolves them with explicit all-gathers, the same contract
+        as the auto-parallel tier's cached psum programs.
+        """
+        if self.tp == 1:
+            return run
+        in_specs, out_specs = self._step_specs(n_host_args)
+        return shard_map(run, mesh=self._mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
 
     # ------------------------------------------------------------------
     # request API
@@ -480,13 +588,17 @@ class LLMEngine:
         out = self.stats.summary()
         out["block_pool"] = self.blocks.stats()
         out["kv_dtype"] = self.kv_dtype
+        out["tp"] = self.tp
         out["kv_bytes_resident"] = self.kv_bytes_resident()
+        out["kv_bytes_resident_per_shard"] = \
+            self.kv_bytes_resident_per_shard()
         out["peak_resident_seqs"] = self.peak_resident_seqs
         return out
 
     def kv_page_bytes(self) -> int:
-        """Device bytes one KV page costs: K and V slabs across every
-        layer, plus the page's scale-pool rows in int8 mode."""
+        """MESH-TOTAL device bytes one KV page costs: K and V slabs
+        across every layer, plus the page's scale-pool rows in int8
+        mode, summed over every tp shard."""
         L = self.config.num_hidden_layers
         per = (2 * L * self._kvh * self.block_size * self._hd
                * np.dtype(self._kc.dtype).itemsize)
@@ -494,12 +606,29 @@ class LLMEngine:
             per += 2 * L * self._kvh * np.dtype(np.float32).itemsize
         return per
 
+    def kv_page_bytes_per_shard(self) -> int:
+        """Bytes one KV page costs ON ONE CHIP.  Pools shard along the
+        H_kv axis (tp divides kvh, so page and scale slabs split
+        exactly) — per-chip HBM is the binding capacity constraint, so
+        pool sizing and pressure thresholds must use this figure under
+        tp, not the mesh total."""
+        return self.kv_page_bytes() // self.tp
+
     def kv_bytes_resident(self) -> int:
         """Device bytes holding real KV content: pages backing live
         sequences plus parked prefix pages (retained in HBM precisely so
-        a prefix hit skips recompute; ``evict_parked`` reclaims them)."""
+        a prefix hit skips recompute; ``evict_parked`` reclaims them).
+        Mesh-total under tp; the per-chip figure is
+        ``kv_bytes_resident_per_shard``."""
         return ((self.blocks.num_used + self.blocks.num_cached)
                 * self.kv_page_bytes())
+
+    def kv_bytes_resident_per_shard(self) -> int:
+        """Resident KV bytes on ONE chip of the tp mesh (equals the
+        mesh total at tp=1) — the number a per-chip HBM budget or
+        DegradationController threshold should be compared against."""
+        return ((self.blocks.num_used + self.blocks.num_cached)
+                * self.kv_page_bytes_per_shard())
 
     @property
     def degradation_tier_entries(self) -> int:
@@ -535,6 +664,9 @@ class LLMEngine:
 
         rag_fn, rag_donate = self._make_ragged_fn(Tq)
         cow_fn, cow_donate = self._make_cow_fn()
+        # a tp>1 engine compiles the SAME program kinds laid over the
+        # mesh; the suffix keeps its audit entries distinct in reports
+        sfx = f"_tp{self.tp}" if self.tp > 1 else ""
 
         def seqs(n):      # [n] i32 token/pos/index vectors
             return sds((n,), i32)
@@ -547,28 +679,28 @@ class LLMEngine:
             fresh = sds((self._kc.shape[1],), jnp.bool_)
             return [
                 ProgramSpec(
-                    "serving.ragged_step_q8", rag_fn,
+                    "serving.ragged_step_q8" + sfx, rag_fn,
                     (params, kc, vc, ks, vs, fresh, seqs(Tq), seqs(B + 1),
                      seqs(B), sds((B + 1, self.nblk), i32),
                      seqs(self._Lq), samp_structs(self._Lq, V)),
                     donate_argnums=rag_donate, declared_dtype=declared,
                     large_bytes=large_bytes),
                 ProgramSpec(
-                    "serving.cow_copy_q8", cow_fn,
+                    "serving.cow_copy_q8" + sfx, cow_fn,
                     (kc, vc, ks, vs, sds((), i32), sds((), i32)),
                     donate_argnums=cow_donate, declared_dtype=declared,
                     large_bytes=large_bytes),
             ]
         return [
             ProgramSpec(
-                "serving.ragged_step", rag_fn,
+                "serving.ragged_step" + sfx, rag_fn,
                 (params, kc, vc, seqs(Tq), seqs(B + 1), seqs(B),
                  sds((B + 1, self.nblk), i32), seqs(self._Lq),
                  samp_structs(self._Lq, V)),
                 donate_argnums=rag_donate, declared_dtype=declared,
                 large_bytes=large_bytes),
             ProgramSpec(
-                "serving.cow_copy", cow_fn,
+                "serving.cow_copy" + sfx, cow_fn,
                 (kc, vc, sds((), i32), sds((), i32)),
                 donate_argnums=cow_donate, declared_dtype=declared,
                 large_bytes=large_bytes),
@@ -1154,6 +1286,12 @@ class LLMEngine:
         dt = self.params["embed"].dtype
         if self.kv_dtype == "int8":
             return self._make_ragged_fn_q8(Tq)
+        # under tp the body runs on PER-SHARD shapes: a contiguous block
+        # of nh/tp query heads attending over kvh/tp KV heads (GQA
+        # groups never straddle shards — tp divides kvh)
+        tp = self.tp
+        nh, kvh = nh // tp, kvh // tp
+        shard_head = self._shard_head
         # the interpreted kernel costs a Python step per (Tq, H_kv, nblk)
         # grid cell EVERY launch — serving on CPU uses the XLA reference
         # path (term-identical math) unless a test forces the interpreter
@@ -1168,7 +1306,9 @@ class LLMEngine:
             # valid KV per row AFTER this launch's writes; bt [B+1, nblk]
             # i32 (row B: the null row pads resolve to); lidx [Lq] i32
             # flat index of each logit row; samp the make_samp pytree,
-            # one row per logit row.
+            # one row per logit row.  Under tp>1 this traces per shard:
+            # kc/vc and the q/k/v projections arrive head-sliced, toks..
+            # samp arrive replicated.
             seg, rel = _pa.ragged_segments(cu, kvl, Tq)
             x = jnp.take(params["embed"], toks, axis=0)       # [Tq, H]
 
@@ -1190,7 +1330,12 @@ class LLMEngine:
                 else:
                     att = _pa.ragged_paged_reference_segrel(
                         q, kcl, vcl, bt, seg, rel)
-                x = x + att.reshape(Tq, nh * d) @ p["wo"]
+                if tp > 1:
+                    # tiled gather concatenates shard head blocks in
+                    # mesh order — exactly the tp=1 head layout, so the
+                    # replicated wo matmul is byte-identical
+                    att = lax.all_gather(att, "tp", axis=1, tiled=True)
+                x = x + att.reshape(Tq, tp * nh * d) @ p["wo"]
                 h2 = _rms_weight(x, p["ln2"], eps)
                 a = jax.nn.silu((h2 @ p["gate"]).astype(jnp.float32)
                                 ).astype(h2.dtype) * (h2 @ p["up"])
@@ -1201,6 +1346,10 @@ class LLMEngine:
             hsel = h[lidx]                                    # [Lq, H]
             logits = (hsel.astype(jnp.float32)
                       @ params["head"].astype(jnp.float32))   # [Lq, V]
+            if shard_head:
+                # vocab-sliced logits -> one gather; sampling then runs
+                # replicated on identical full-width rows
+                logits = lax.all_gather(logits, "tp", axis=1, tiled=True)
             sampled = sample_tokens(logits, samp)
             # per-row finiteness flag: the quarantine guard retires a
             # poisoned row host-side without touching its batchmates
@@ -1213,7 +1362,7 @@ class LLMEngine:
 
         # donation reuses the pool buffers in place; _get_ragged_prog
         # drops it on CPU (that runtime cannot alias and warns per call)
-        return run, (1, 2)
+        return self._wrap_tp(run, 6), (1, 2)
 
     def _make_ragged_fn_q8(self, Tq: int):
         """Int8-page variant of the one serving step program: identical
@@ -1244,6 +1393,12 @@ class LLMEngine:
         eps = self.config.rms_norm_eps
         theta = self.config.rope_theta
         dt = self.params["embed"].dtype
+        # per-shard head counts under tp (see _make_ragged_fn): the
+        # scale pools slice along the same H_kv axis as the page pools,
+        # so quantize-at-commit stays a purely per-head-local transform
+        tp = self.tp
+        nh, kvh = nh // tp, kvh // tp
+        shard_head = self._shard_head
         use_pallas = _pa.INTERPRET is True or (
             jax.default_backend() == "tpu"
             and _pa.ragged_quant_supports(Tq, nh, kvh, d, bs, B + 1,
@@ -1304,7 +1459,9 @@ class LLMEngine:
                     att = _pa.ragged_paged_reference_quant_segrel(
                         q, kcl, vcl, ksl, vsl, bt, seg, rel)
                 att = att.astype(x.dtype)
-                x = x + att.reshape(Tq, nh * d) @ p["wo"]
+                if tp > 1:
+                    att = lax.all_gather(att, "tp", axis=1, tiled=True)
+                x = x + att.reshape(Tq, tp * nh * d) @ p["wo"]
                 h2 = _rms_weight(x, p["ln2"], eps)
                 a = jax.nn.silu((h2 @ p["gate"]).astype(jnp.float32)
                                 ).astype(h2.dtype) * (h2 @ p["up"])
@@ -1317,6 +1474,8 @@ class LLMEngine:
             hsel = h[lidx]                                    # [Lq, H]
             logits = (hsel.astype(jnp.float32)
                       @ params["head"].astype(jnp.float32))   # [Lq, V]
+            if shard_head:
+                logits = lax.all_gather(logits, "tp", axis=1, tiled=True)
             sampled = sample_tokens(logits, samp)
             fin = jnp.all(jnp.isfinite(logits), axis=-1)      # [Lq]
             if with_logits:
@@ -1324,7 +1483,7 @@ class LLMEngine:
             return sampled, fin, kc, vc, ks, vs
 
         # donate the page pools AND scale pools; fresh is input-only
-        return run, (1, 2, 3, 4)
+        return self._wrap_tp(run, 7), (1, 2, 3, 4)
 
     def _consume_fresh(self):
         """Accumulate BlockManager's freshly handed-out pages into the

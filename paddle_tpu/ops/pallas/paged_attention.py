@@ -556,7 +556,15 @@ def _probe_ragged_lowering(Tq, H, Hkv, D, bs, R, nblk, dtype) -> bool:
 def ragged_supports(Tq, H, Hkv, D, bs, R=None, nblk=None,
                     dtype=jnp.float32) -> bool:
     """Eligibility for the ragged pallas kernel: shape heuristic, then an
-    actual lowering probe (cached)."""
+    actual lowering probe (cached).
+
+    Under tensor parallelism callers pass PER-SHARD head counts (H/tp,
+    Hkv/tp): the kernel launches inside shard_map, so Mosaic lowers and
+    tiles against the shard-local q/kv shapes, never the mesh-global
+    ones.  The engine guarantees tp divides both counts, so the GQA
+    ratio H % Hkv == 0 is shard-invariant."""
+    if H < 1 or Hkv < 1:
+        return False
     if H % Hkv != 0:
         return False
     if D % 128 != 0 and D not in (64,):
@@ -611,7 +619,10 @@ def ragged_quant_supports(Tq, H, Hkv, D, bs, R=None, nblk=None,
     """Eligibility for the int8-page ragged kernel.  Int8 pages carry a
     (32, 128) minimum tile (vs (8, 128) for f32), so the page-size
     heuristic is stricter than the float path's before the authoritative
-    lowering probe runs."""
+    lowering probe runs.  As with ``ragged_supports``, tensor-parallel
+    callers pass per-shard head counts."""
+    if H < 1 or Hkv < 1:
+        return False
     if H % Hkv != 0:
         return False
     if D % 128 != 0 and D not in (64,):

@@ -371,3 +371,66 @@ class ServingStats:
     # summary() predates snapshot() and is the name the engine/benches
     # grew up with; both return the same dict
     summary = snapshot
+
+    # ------------------------------------------------------------------
+    # fleet aggregation
+    # ------------------------------------------------------------------
+
+    # snapshot keys that are NOT plain summable counters, by how a
+    # D-replica fleet combines them:
+    #   _RATE     recomputed from the summed numerator/denominator —
+    #             summing or averaging ratios of unequal denominators
+    #             would misweight replicas
+    #   _THROUGH  summed: replicas run in parallel, fleet tokens/s is
+    #             the sum of per-replica tokens/s
+    #   _MAX      worst replica wins — latency percentiles cannot be
+    #             recombined from per-replica reservoirs, so the fleet
+    #             reports the conservative bound; degradation_state and
+    #             uptime likewise describe the worst/oldest member
+    #   _MEAN     unweighted mean across replicas (occupancy/queue depth
+    #             are already per-engine means)
+    _RATE = ("prefix_hit_rate", "accept_rate")
+    _THROUGH = ("decode_tokens_per_s", "prefill_tokens_per_s",
+                "verify_tokens_per_s", "emitted_tokens_per_s")
+    _MAX = ("p50_token_ms", "p99_token_ms", "itl_p50_ms", "itl_p99_ms",
+            "ttft_p50_ms", "ttft_p99_ms", "max_prefill_queue_depth",
+            "uptime_seconds", "degradation_state")
+    _MEAN = ("mean_batch_occupancy", "mean_prefill_queue_depth")
+
+    @staticmethod
+    def aggregate(snapshots) -> dict:
+        """Combine per-replica ``snapshot()`` dicts into one fleet view
+        (the dict a replicated frontend's ``/metrics`` renders).  Plain
+        counters sum; see the class-level key tables for everything
+        else.  A single snapshot passes through semantically unchanged
+        (max == mean == sum-of-one)."""
+        snaps = list(snapshots)
+        if not snaps:
+            raise ValueError("aggregate() needs at least one snapshot")
+        out: dict = {}
+        for key in snaps[0]:
+            vals = [s[key] for s in snaps]
+            if isinstance(vals[0], dict):        # abort_reasons, fault_injections
+                merged: dict = {}
+                for v in vals:
+                    for k, n in v.items():
+                        merged[k] = merged.get(k, 0) + n
+                out[key] = merged
+            elif key in ServingStats._RATE:
+                pass                             # recomputed below
+            elif key in ServingStats._THROUGH:
+                out[key] = round(sum(vals), 2)
+            elif key in ServingStats._MAX:
+                out[key] = max(vals)
+            elif key in ServingStats._MEAN:
+                out[key] = round(sum(vals) / len(vals), 4)
+            else:
+                out[key] = sum(vals)
+        hit, miss = out["cache_hit_tokens"], out["cache_miss_tokens"]
+        out["prefix_hit_rate"] = round(hit / (hit + miss), 4) \
+            if hit + miss else 0.0
+        out["accept_rate"] = round(
+            out["draft_accepted"] / out["draft_proposed"], 4) \
+            if out["draft_proposed"] else 0.0
+        out["replicas"] = len(snaps)
+        return out

@@ -1,0 +1,25 @@
+"""Seeds collective-outside-shard-map: a lax collective in a compiled
+def that is never routed through shard_map — the mesh axis name is
+unbound there.  The shard_map-wrapped twin and the never-compiled
+helper stay silent."""
+import jax
+from jax import lax
+from jax.experimental.shard_map import shard_map
+
+
+def gather_logits(x):
+    return lax.all_gather(x, "tp", axis=1, tiled=True)
+
+
+def sharded_run(x):
+    return lax.psum(x, "tp")     # silent: routed through shard_map below
+
+
+def host_helper(x):
+    return lax.pmax(x, "tp")     # silent: never compiled
+
+
+PLAIN = jax.jit(gather_logits)   # fires: jitted, never handed to shard_map
+RAW = jax.jit(sharded_run)       # the tp=1 path compiles it directly...
+WRAPPED = jax.jit(shard_map(sharded_run, mesh=None,
+                            in_specs=None, out_specs=None))

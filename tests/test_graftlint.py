@@ -48,6 +48,8 @@ def _lint_fix(name):
      "quantized-kv-float32-page", 10, "build_pools", WARNING),
     (os.path.join("inference", "fix_swallowed_exception.py"),
      "swallowed-exception", 9, "release_pages", ERROR),
+    (os.path.join("inference", "fix_collective_outside_shard_map.py"),
+     "collective-outside-shard-map", 11, "gather_logits", ERROR),
 ])
 def test_ast_fixture_fires_exactly_once(fixture, rule, line, func, severity):
     findings = _lint_fix(fixture)
@@ -254,6 +256,7 @@ def test_every_catalog_rule_is_exercised():
         "numpy-in-jit", "host-sync-in-jit", "tracer-branch",
         "mutable-default-arg", "unkeyed-jit", "attention-program-budget",
         "quantized-kv-float32-page", "swallowed-exception",
+        "collective-outside-shard-map",
         "undonated-buffer", "host-callback", "dtype-promotion",
         "dead-code", "dead-input", "passthrough-output",
     }
@@ -345,11 +348,11 @@ def test_cli_nonzero_on_fixture_tree_json():
     r = _run_cli(_FIX, "--format", "json", "--no-default-baseline")
     assert r.returncode == 1, r.stdout + r.stderr
     doc = json.loads(r.stdout)
-    assert doc["counts"]["ERROR"] == 6          # one per ERROR fixture
+    assert doc["counts"]["ERROR"] == 7          # one per ERROR fixture
     rules = {f["rule"] for f in doc["findings"]}
     assert {"numpy-in-jit", "host-sync-in-jit", "tracer-branch",
             "unkeyed-jit", "attention-program-budget",
-            "swallowed-exception"} <= rules
+            "swallowed-exception", "collective-outside-shard-map"} <= rules
 
 
 def test_cli_exit_zero_on_shipped_tree():

@@ -179,6 +179,58 @@ def test_serve_bench_memory_pressure_emits_residency_surface():
         == record["requests"]
 
 
+def test_serve_bench_tp_emits_sharded_record():
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "--smoke", "--tp", "2",
+         "--requests", "4"],
+        capture_output=True, text=True, timeout=540,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.strip().splitlines() if ln.strip()]
+    assert lines, f"no stdout; stderr: {out.stderr[-2000:]}"
+    record = json.loads(lines[-1])
+    assert record["metric"] == "serve_decode_tokens_per_s"
+    assert "error" not in record, record
+    assert record["value"] > 0
+    # every record carries the parallelism shape, and the sharded
+    # engine still runs ONE decode program (the shard_map-wrapped
+    # ragged step, not per-shard variants)
+    assert record["tp"] == 2
+    assert record["replicas"] == 1
+    assert record["decode_compiles"] <= 2
+
+
+def test_serve_bench_router_emits_affinity_surface():
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "--smoke", "--http", "--replicas", "2",
+         "--prefix-share", "4", "--requests", "12"],
+        capture_output=True, text=True, timeout=540,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.strip().splitlines() if ln.strip()]
+    assert lines, f"no stdout; stderr: {out.stderr[-2000:]}"
+    record = json.loads(lines[-1])
+    assert record["metric"] == "serve_router_tokens_per_s"
+    assert "error" not in record, record
+    assert record["value"] > 0
+    assert record["replicas"] == 2
+    assert record["share_ways"] == 4
+    # the affinity pass routed shared prompts to cached replicas: more
+    # than half the timed requests matched a registry prefix, and both
+    # replicas saw work
+    assert record["affinity_hit_rate"] > 0.5
+    assert len(record["routed_requests"]) == 2
+    assert all(n > 0 for n in record["routed_requests"])
+    # the control arm ran too
+    assert record["random_tokens_per_s"] > 0
+    assert record["random_ttft_p50_ms"] > 0
+    assert record["ttft_p99_ms"] >= record["ttft_p50_ms"] > 0
+    # load imbalance is max/mean outstanding tokens, so >= 1 whenever
+    # sampled mid-flight (0.0 only if the fleet was never caught busy)
+    assert record["load_imbalance"] == 0.0 \
+        or record["load_imbalance"] >= 1.0
+
+
 def test_serve_bench_prefix_share_emits_cache_surface():
     out = subprocess.run(
         [sys.executable, SCRIPT, "--smoke", "--prefix-share", "2",

@@ -75,6 +75,24 @@ def test_audit_quantized_engine_report_clean(model):
     assert doc["errors"] == 0
 
 
+def test_audit_tp_engine_report_clean(model):
+    """The tp=2 engine's sharded program pair (shard_map laid over the
+    2-chip mesh inside the jit) audits exactly as clean as the
+    single-chip pair, with the identical donation contract — the
+    per-shard KV pools ride donate_argnums 1,2 just like the full
+    pools do at tp=1."""
+    eng = _engine(model, tp=2)
+    report = audit_engine(eng, large_bytes=1 << 10)
+    doc = json.loads(json.dumps(report))
+    by_name = {p["name"]: p for p in doc["programs"]}
+    assert set(by_name) == {"serving.ragged_step_tp2",
+                            "serving.cow_copy_tp2"}
+    assert by_name["serving.ragged_step_tp2"]["donate_argnums"] == [1, 2]
+    assert by_name["serving.cow_copy_tp2"]["donate_argnums"] == [0, 1]
+    assert [f for p in doc["programs"] for f in p["findings"]] == []
+    assert doc["errors"] == 0
+
+
 def test_audit_engine_report_is_baseline_clean(model):
     eng = _engine(model)
     report = audit_engine(eng, large_bytes=1 << 10,
@@ -91,13 +109,13 @@ def test_committed_report_matches_fresh_audit(model):
         "serving_report.json")
     committed = json.load(open(path))
     fresh_by_name = {}
-    for kv_dtype in ("float32", "int8"):
-        fresh = audit_engine(_engine(model, kv_dtype=kv_dtype),
-                             large_bytes=1 << 10)
+    for kw in ({"kv_dtype": "float32"}, {"kv_dtype": "int8"}, {"tp": 2}):
+        fresh = audit_engine(_engine(model, **kw), large_bytes=1 << 10)
         fresh_by_name.update({p["name"]: p for p in fresh["programs"]})
     committed_names = {p["name"] for p in committed["programs"]}
-    assert {"serving.ragged_step_q8",
-            "serving.cow_copy_q8"} <= committed_names
+    assert {"serving.ragged_step_q8", "serving.cow_copy_q8",
+            "serving.ragged_step_tp2",
+            "serving.cow_copy_tp2"} <= committed_names
     for prog in committed["programs"]:
         if prog["name"] == "jit.capture_step":     # CLI-only extra spec
             continue

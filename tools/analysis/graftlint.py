@@ -9,7 +9,8 @@ Default path is ``paddle_tpu``.  Exit status: 0 when no ERROR-severity
 finding survives the baseline, 1 otherwise (2 on usage errors).
 
 ``--audit-serving`` additionally builds a tiny CPU LLMEngine (one per
-KV dtype: float32 and quantized int8) and a captured train step and
+KV dtype: float32 and quantized int8, plus a tp=2 tensor-parallel
+engine over forced host devices) and a captured train step and
 runs the jaxpr passes over every program they compile — the
 donation/transfer/dtype/dead audit of what XLA is really handed.  This
 imports jax; plain source linting does not.
@@ -34,6 +35,12 @@ def _serving_findings(large_bytes: int):
     # must be pinned before jax imports: the TPU plugin hangs probing pods
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    # the tp=2 audit engine needs two devices; force host devices so the
+    # sharded programs trace anywhere (no-op on a real multi-chip host)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            f"{flags} --xla_force_host_platform_device_count=2".strip()
 
     import jax.numpy as jnp
 
@@ -54,6 +61,12 @@ def _serving_findings(large_bytes: int):
     # CoW); its scale pools are large buffers that must be donated too
     q8 = LLMEngine(model, kv_dtype="int8", **engine_kw)
     specs += q8.program_specs(large_bytes=large_bytes)
+    # the tensor-parallel engine lays the same step over a 2-chip mesh
+    # (shard_map inside the jit) — its pools are per-shard, its donation
+    # contract identical; the audit proves the sharded program is as
+    # clean as the single-chip one
+    tp2 = LLMEngine(model, tp=2, **engine_kw)
+    specs += tp2.program_specs(large_bytes=large_bytes)
 
     # captured train step: tiny linear regression, donated params
     from paddle_tpu.jit.step import capture_step

@@ -65,10 +65,24 @@ the same silicon at matched traffic:
    "degradation_tier_entries": ..., "baseline_degradation_tier_entries": ...,
    "hbm_budget_bytes": ..., "num_blocks": ..., "baseline_num_blocks": ...}
 
+With ``--http --replicas D`` the shared-prefix workload (``share_ways``
+from ``--prefix-share``, default 4) runs over D data-parallel engine
+replicas behind the prefix-affinity replica router — the SAME stream
+once under random routing, once under affinity — so the line shows what
+landing shared prompts on the replica that already holds their KV pages
+buys:
+
+  {"metric": "serve_router_tokens_per_s", "value": ..., "unit": "tok/s",
+   "affinity_hit_rate": ..., "load_imbalance": ...,
+   "random_tokens_per_s": ..., "ttft_p50_ms": ...,
+   "random_ttft_p50_ms": ..., "routed_requests": [...], ...}
+
 Every mode's record also carries the KV-residency surface — ``kv_dtype``,
 ``kv_bytes_resident``, ``peak_resident_seqs``,
-``degradation_tier_entries`` — and ``--kv-dtype int8`` threads quantized
-KV pages through every engine the bench builds.
+``degradation_tier_entries`` — plus ``tp`` and ``replicas``;
+``--kv-dtype int8`` threads quantized KV pages and ``--tp N`` threads an
+N-way tensor-parallel mesh (host devices forced on CPU) through every
+engine the bench builds.
 
 Hardening contract (same as bench.py): the JSON line ALWAYS prints.  The
 backend is probed in a subprocess with a hard timeout before this process
@@ -178,7 +192,8 @@ def _mem_keys(engine):
 
 
 def run_prefix_bench(smoke: bool, n_requests: int, share_ways: int,
-                     seed: int, backend: str, kv_dtype: str = "float32"):
+                     seed: int, backend: str, kv_dtype: str = "float32",
+                     tp: int = 1):
     """Same shared-prefix workload with prefix caching OFF then ON.  Each
     engine gets one untimed pass (compiles every program bucket and, for
     the cached engine, populates the pool) and one timed steady-state
@@ -209,7 +224,7 @@ def run_prefix_bench(smoke: bool, n_requests: int, share_ways: int,
     runs = {}
     for caching in (False, True):
         engine = LLMEngine(model, enable_prefix_caching=caching,
-                           kv_dtype=kv_dtype, **engine_kw)
+                           kv_dtype=kv_dtype, tp=tp, **engine_kw)
         rng = np.random.RandomState(seed)
         stream = _prefix_stream(rng, n_requests, share_ways,
                                 cfg.vocab_size, engine_kw["max_model_len"])
@@ -270,7 +285,7 @@ def _spec_text_stream(rng, n_requests, vocab, max_len):
 
 
 def run_spec_bench(smoke: bool, n_requests: int, spec_k: int, seed: int,
-                   backend: str, kv_dtype: str = "float32"):
+                   backend: str, kv_dtype: str = "float32", tp: int = 1):
     """Same repetitive-text workload with speculation OFF then ON.  Each
     engine gets one untimed pass (compiles every program bucket) and one
     timed pass; value is emitted tokens per wall second across the
@@ -317,7 +332,7 @@ def run_spec_bench(smoke: bool, n_requests: int, spec_k: int, seed: int,
             kw.update(drafter=NGramDrafter(max_ngram=6, min_ngram=1),
                       spec_k=spec_k, max_spec_k=spec_k,
                       spec_accept_floor=0.0)
-        engine = LLMEngine(model, kv_dtype=kv_dtype, **kw)
+        engine = LLMEngine(model, kv_dtype=kv_dtype, tp=tp, **kw)
         rng = np.random.RandomState(seed)
         stream = _spec_text_stream(rng, n_requests, cfg.vocab_size,
                                    engine_kw["max_model_len"])
@@ -427,7 +442,7 @@ def _http_drive(port, stream, *, step_delay_s: float = 0.002):
 
 
 def run_http_bench(smoke: bool, n_requests: int, seed: int, backend: str,
-                   kv_dtype: str = "float32"):
+                   kv_dtype: str = "float32", tp: int = 1):
     """The run_bench workload through the real HTTP frontend (SSE
     streaming clients over localhost) next to an engine-direct run of
     the identical stream.  Both engines get one untimed warm pass; value
@@ -461,7 +476,7 @@ def run_http_bench(smoke: bool, n_requests: int, seed: int, backend: str,
     # engine-direct reference: TWO warm passes (the first compiles the
     # cold-cache prefill buckets, the second compiles the chunked-resume
     # buckets that only exist once the prefix cache is hot), then timed
-    direct = LLMEngine(model, kv_dtype=kv_dtype, **engine_kw)
+    direct = LLMEngine(model, kv_dtype=kv_dtype, tp=tp, **engine_kw)
     _drive(direct, list(stream))
     _drive(direct, list(stream))
     direct.stats.reset()
@@ -475,7 +490,7 @@ def run_http_bench(smoke: bool, n_requests: int, seed: int, backend: str,
     # compile; the record carries timed_new_compiles so an inflated
     # TTFT tail is attributable.
     served = LLMEngine(model, retain_outputs=False, kv_dtype=kv_dtype,
-                       **engine_kw)
+                       tp=tp, **engine_kw)
     srv = serve_background(served, model_name="bench",
                            max_pending=4 * len(stream))
     try:
@@ -532,6 +547,134 @@ def run_http_bench(smoke: bool, n_requests: int, seed: int, backend: str,
     }
 
 
+def run_router_bench(smoke: bool, n_requests: int, share_ways: int,
+                     seed: int, backend: str, kv_dtype: str,
+                     replicas: int, tp: int = 1):
+    """The shared-prefix workload over the HTTP frontend with
+    ``replicas`` data-parallel engines behind the replica router.  The
+    SAME stream runs once under random routing (the control: shared
+    prompts scatter, every replica re-prefills every system prompt) and
+    once under prefix-affinity (shared prompts land on the replica whose
+    cache already holds their pages).  Value is streamed tokens per wall
+    second of the affinity pass; the record carries both policies' TTFT,
+    the affinity hit rate, and the per-replica load imbalance (max/mean
+    outstanding tokens, sampled while the stream is in flight)."""
+    import threading
+    import time
+
+    import numpy as np
+
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.inference.frontend import serve_background
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if smoke or backend == "cpu":
+        cfg = LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                               ffn=128, seq=256)
+        engine_kw = dict(max_num_seqs=4, block_size=8, max_model_len=256,
+                         max_prefill_tokens=256, prefill_token_bucket=64)
+    else:
+        cfg = LlamaConfig(vocab_size=8192, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=4,
+                          num_attention_heads=8, num_key_value_heads=8,
+                          max_position_embeddings=1024)
+        engine_kw = dict(max_num_seqs=16, block_size=16, max_model_len=1024,
+                         max_prefill_tokens=2048, prefill_token_bucket=256)
+
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(seed)
+    stream = _prefix_stream(rng, n_requests, share_ways,
+                            cfg.vocab_size, engine_kw["max_model_len"])
+    # warm with DIFFERENT system prompts: compiles every program bucket
+    # (cold prefill, hot chunked resume, decode) on every replica while
+    # leaving the timed stream's prefixes uncached — otherwise two warm
+    # passes of the real stream would park every prefix in every
+    # replica's cache and random routing would measure as well as
+    # affinity
+    warm = _prefix_stream(np.random.RandomState(seed + 1), n_requests,
+                          share_ways, cfg.vocab_size,
+                          engine_kw["max_model_len"])
+
+    def make_engine():
+        return LLMEngine(model, retain_outputs=False, kv_dtype=kv_dtype,
+                         enable_prefix_caching=True, tp=tp, **engine_kw)
+
+    runs = {}
+    for policy in ("random", "affinity"):
+        srv = serve_background(make_engine(), model_name="bench",
+                               max_pending=4 * len(stream),
+                               engine_factory=make_engine,
+                               replicas=replicas, router_policy=policy)
+        router = srv.frontend.runner
+        try:
+            _http_drive(srv.port, warm)
+            _http_drive(srv.port, warm)
+            before = router.router_counters()
+            imb, stop_ev = [], threading.Event()
+
+            def sample(_r=router, _imb=imb, _ev=stop_ev):
+                while not _ev.is_set():
+                    vals = _r.router_counters()["outstanding_tokens"]
+                    mean = sum(vals) / len(vals)
+                    if mean > 0:
+                        _imb.append(max(vals) / mean)
+                    time.sleep(0.005)
+
+            sampler = threading.Thread(target=sample, daemon=True)
+            sampler.start()
+            wall, results = _http_drive(srv.port, stream)
+            stop_ev.set()
+            sampler.join(timeout=5.0)
+            counters = router.router_counters()
+        finally:
+            srv.stop()
+        got = sum(len(r["tokens"]) for r in results if r)
+        ttfts = sorted(r["ttft_s"] for r in results if r)
+        # marginal counters: the timed pass only, not the warm passes
+        hits = (counters["affinity_hit_total"]
+                - before["affinity_hit_total"])
+        routed_n = counters["routed_total"] - before["routed_total"]
+        runs[policy] = {
+            "tokens_per_s": got / wall if wall else 0.0,
+            "ttfts": ttfts,
+            "hit_rate": hits / routed_n if routed_n else 0.0,
+            "imbalance": sum(imb) / len(imb) if imb else 0.0,
+            "routed": [a - b for a, b in
+                       zip(counters["routed_requests"],
+                           before["routed_requests"])],
+        }
+
+    def _pct(vals, q):
+        if not vals:
+            return 0.0
+        return 1e3 * vals[min(len(vals) - 1,
+                              int(round(q / 100.0 * (len(vals) - 1))))]
+
+    aff, rnd = runs["affinity"], runs["random"]
+    return {
+        "metric": "serve_router_tokens_per_s",
+        "value": round(aff["tokens_per_s"], 2),
+        "unit": "tok/s",
+        "backend": backend,
+        "requests": n_requests,
+        "share_ways": share_ways,
+        "router_policy": "affinity",
+        "affinity_hit_rate": round(aff["hit_rate"], 4),
+        "load_imbalance": round(aff["imbalance"], 3),
+        "routed_requests": aff["routed"],
+        "ttft_p50_ms": round(_pct(aff["ttfts"], 50), 3),
+        "ttft_p99_ms": round(_pct(aff["ttfts"], 99), 3),
+        "random_tokens_per_s": round(rnd["tokens_per_s"], 2),
+        "random_ttft_p50_ms": round(_pct(rnd["ttfts"], 50), 3),
+        "random_ttft_p99_ms": round(_pct(rnd["ttfts"], 99), 3),
+        "random_load_imbalance": round(rnd["imbalance"], 3),
+        "random_routed_requests": rnd["routed"],
+        "speedup": round(aff["tokens_per_s"] / rnd["tokens_per_s"], 3)
+        if rnd["tokens_per_s"] else 0.0,
+        "kv_dtype": kv_dtype,
+    }
+
+
 def _mixed_request_stream(rng, n_requests, vocab, max_len,
                           max_prefill_tokens):
     """The whole serving zoo in one arrival-scheduled stream: every 4th
@@ -555,7 +698,7 @@ def _mixed_request_stream(rng, n_requests, vocab, max_len,
 
 
 def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str,
-                    kv_dtype: str = "float32"):
+                    kv_dtype: str = "float32", tp: int = 1):
     """The ISSUE's headline workload: long prefills, chunked resumes,
     plain decodes, and speculative verify rounds all riding the ONE
     ragged step program.  Reports throughput, the exact attention
@@ -589,7 +732,7 @@ def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str,
     engine = LLMEngine(model, enable_prefix_caching=True,
                        drafter=NGramDrafter(max_ngram=6, min_ngram=1),
                        spec_k=spec_k, max_spec_k=spec_k,
-                       spec_accept_floor=0.0, kv_dtype=kv_dtype,
+                       spec_accept_floor=0.0, kv_dtype=kv_dtype, tp=tp,
                        **engine_kw)
     rng = np.random.RandomState(seed)
     stream = _mixed_request_stream(rng, n_requests, cfg.vocab_size,
@@ -644,7 +787,7 @@ def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str,
 
 
 def run_chaos_bench(smoke: bool, n_requests: int, seed: int, backend: str,
-                    kv_dtype: str = "float32"):
+                    kv_dtype: str = "float32", tp: int = 1):
     """Goodput under injected faults: the ragged request stream runs
     through the supervised EngineRunner while a seeded FaultPlan crashes
     a step, hangs a step past the watchdog deadline, poisons a logit
@@ -680,7 +823,7 @@ def run_chaos_bench(smoke: bool, n_requests: int, seed: int, backend: str,
 
     def factory():
         return LLMEngine(model, retain_outputs=False, kv_dtype=kv_dtype,
-                         **engine_kw)
+                         tp=tp, **engine_kw)
 
     # the full schedule from one seed: one crash (in-thread recovery),
     # one hang past the watchdog deadline, one NaN row (quarantine), one
@@ -783,7 +926,7 @@ def _drive_peak(engine, stream):
 
 
 def run_pressure_bench(smoke: bool, n_requests: int, seed: int,
-                       backend: str, kv_dtype: str):
+                       backend: str, kv_dtype: str, tp: int = 1):
     """Fixed-HBM A/B: the same burst stream runs on a float32 pool and
     a ``kv_dtype`` pool sized from the SAME byte budget, each with a
     DegradationController installed.  int8 pages are ~4x smaller, so
@@ -802,14 +945,18 @@ def run_pressure_bench(smoke: bool, n_requests: int, seed: int,
                            ffn=64, seq=256)
     engine_kw = dict(max_num_seqs=16, block_size=8, max_model_len=256,
                      max_prefill_tokens=128, prefill_token_bucket=64)
-    budget = 52 * _page_bytes(cfg, engine_kw["block_size"], "float32")
+    # the budget binds PER CHIP: under tp each shard holds 1/tp of every
+    # page, so the same per-chip HBM affords tp x the page count
+    budget = 52 * _page_bytes(cfg, engine_kw["block_size"], "float32") // tp
 
     model = LlamaForCausalLM(cfg)
     runs = {}
     for dt in ("float32", kv_dtype):
-        nb = budget // _page_bytes(cfg, engine_kw["block_size"], dt)
+        nb = budget // (_page_bytes(cfg, engine_kw["block_size"], dt)
+                        // tp)
         engine = LLMEngine(model, kv_dtype=dt, num_blocks=int(nb),
-                           pressure=DegradationController(), **engine_kw)
+                           pressure=DegradationController(), tp=tp,
+                           **engine_kw)
         rng = np.random.RandomState(seed)
         stream = _pressure_stream(rng, n_requests, cfg.vocab_size)
         wall, peak_bytes = _drive_peak(engine, stream)
@@ -857,7 +1004,7 @@ def run_pressure_bench(smoke: bool, n_requests: int, seed: int,
 
 
 def run_bench(smoke: bool, n_requests: int, seed: int, backend: str,
-              kv_dtype: str = "float32"):
+              kv_dtype: str = "float32", tp: int = 1):
     import numpy as np
 
     from paddle_tpu.inference import LLMEngine
@@ -878,7 +1025,7 @@ def run_bench(smoke: bool, n_requests: int, seed: int, backend: str,
                          max_prefill_tokens=2048, prefill_token_bucket=256)
 
     model = LlamaForCausalLM(cfg)
-    engine = LLMEngine(model, kv_dtype=kv_dtype, **engine_kw)
+    engine = LLMEngine(model, kv_dtype=kv_dtype, tp=tp, **engine_kw)
     rng = np.random.RandomState(seed)
     stream = _request_stream(rng, n_requests, cfg.vocab_size,
                              engine_kw["max_model_len"])
@@ -955,10 +1102,33 @@ def main(argv=None):
                          "float32 pool vs a --kv-dtype pool; report "
                          "resident sequences, preemptions and "
                          "degradation tier entries for both")
+    ap.add_argument("--tp", type=int, default=1, metavar="N",
+                    help="tensor-parallel shards for every engine the "
+                         "bench builds (heads + KV pages split over an "
+                         "N-way mesh inside one compiled step; host "
+                         "devices forced on CPU)")
+    ap.add_argument("--replicas", type=int, default=1, metavar="D",
+                    help="with --http: D data-parallel engine replicas "
+                         "behind the prefix-affinity router, A/B'd "
+                         "against random routing on the shared-prefix "
+                         "workload")
     args = ap.parse_args(argv)
 
+    if args.tp > 1 and "xla_force_host_platform_device_count" \
+            not in os.environ.get("XLA_FLAGS", ""):
+        # must land before this process's first jax import (they are all
+        # function-local below); the probe subprocess inherits it too
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.tp}").strip()
+
     backend, probe_err = _probe_backend()
-    if args.memory_pressure:
+    if args.http and args.replicas > 1:
+        n_requests = args.requests or (16 if (args.smoke
+                                              or backend == "cpu") else 64)
+        record = {"metric": "serve_router_tokens_per_s", "value": 0.0,
+                  "unit": "tok/s", "backend": backend}
+    elif args.memory_pressure:
         n_requests = args.requests or 16
         record = {"metric": "serve_pressure_resident_seqs", "value": 0.0,
                   "unit": "seqs", "backend": backend}
@@ -992,35 +1162,46 @@ def main(argv=None):
                                        else 64)
         record = {"metric": "serve_decode_tokens_per_s", "value": 0.0,
                   "unit": "tok/s", "backend": backend}
+    record["tp"] = args.tp
+    record["replicas"] = args.replicas
     if probe_err:
         record["backend_note"] = f"cpu fallback: {probe_err}"
     try:
-        if args.memory_pressure:
+        if args.http and args.replicas > 1:
+            record.update(run_router_bench(args.smoke, n_requests,
+                                           args.prefix_share or 4,
+                                           args.seed, backend,
+                                           args.kv_dtype, args.replicas,
+                                           args.tp))
+        elif args.memory_pressure:
             record.update(run_pressure_bench(args.smoke, n_requests,
                                              args.seed, backend,
-                                             args.kv_dtype))
+                                             args.kv_dtype, args.tp))
         elif args.chaos:
             record.update(run_chaos_bench(args.smoke, n_requests, args.seed,
-                                          backend, args.kv_dtype))
+                                          backend, args.kv_dtype, args.tp))
         elif args.mixed:
             record.update(run_mixed_bench(args.smoke, n_requests, args.seed,
-                                          backend, args.kv_dtype))
+                                          backend, args.kv_dtype, args.tp))
         elif args.http:
             record.update(run_http_bench(args.smoke, n_requests, args.seed,
-                                         backend, args.kv_dtype))
+                                         backend, args.kv_dtype, args.tp))
         elif args.spec:
             record.update(run_spec_bench(args.smoke, n_requests, args.spec,
                                          args.seed, backend,
-                                         args.kv_dtype))
+                                         args.kv_dtype, args.tp))
         elif args.prefix_share:
             record.update(run_prefix_bench(args.smoke, n_requests,
                                            args.prefix_share, args.seed,
-                                           backend, args.kv_dtype))
+                                           backend, args.kv_dtype,
+                                           args.tp))
         else:
             record.update(run_bench(args.smoke, n_requests, args.seed,
-                                    backend, args.kv_dtype))
+                                    backend, args.kv_dtype, args.tp))
         if probe_err:
             record["backend_note"] = f"cpu fallback: {probe_err}"
+        record["tp"] = args.tp
+        record["replicas"] = args.replicas
     except Exception as e:  # the line must still print
         record["error"] = f"{type(e).__name__}: {e}"
     _emit(record)
