@@ -543,6 +543,58 @@ def lint_source(text: str, path: str = "<string>") -> list:
                          "which is never handed to shard_map — the mesh "
                          "axis name is unbound here; wrap the step with "
                          "shard_map before jax.jit")
+
+    # ---- untuned-pallas-launch (ops/pallas only) -------------------------
+    # Autotuner contract: every Pallas launch's geometry (block sizes,
+    # grid blocking, page-walk width) flows from the tuning-cache lookup
+    # helper `paddle_tpu.tune.kernel_config`, so per-device winners apply
+    # at trace time.  Same name-based fixpoint as the compiled set: a def
+    # that references kernel_config is tuned, and so is any def calling a
+    # tuned def (the lookup usually lives in a small `_fa_blocks`-style
+    # helper the launcher calls).
+    if "pallas" in re.split(r"[\\/]", path):
+        tuned = set()
+        for d in ctx.defs:
+            for n in ast.walk(d):
+                name = n.id if isinstance(n, ast.Name) else (
+                    n.attr if isinstance(n, ast.Attribute) else None)
+                if name in ("kernel_config", "kernel_config_with_meta"):
+                    tuned.add(d)
+                    break
+        changed = True
+        while changed:
+            changed = False
+            for d in ctx.defs:
+                if d in tuned:
+                    continue
+                for n in ast.walk(d):
+                    if isinstance(n, ast.Call) \
+                            and isinstance(n.func, ast.Name) \
+                            and any(c in tuned
+                                    for c in ctx.by_name.get(n.func.id,
+                                                             ())):
+                        tuned.add(d)
+                        changed = True
+                        break
+        launches = set()
+        for d in ctx.defs:
+            if any(isinstance(n, ast.Call)
+                   and (_dotted(n.func) or ())[-1:] == ("pallas_call",)
+                   for n in ast.walk(d)):
+                launches.add(d)
+        # outermost launch defs only: a nested kernel closure belongs to
+        # its enclosing launcher
+        for d in launches:
+            if any(a in launches for a in ctx.ancestors(d)):
+                continue
+            if d in tuned or any(a in tuned for a in ctx.ancestors(d)):
+                continue
+            emit("untuned-pallas-launch", d,
+                 f"`{d.name}` contains a pl.pallas_call whose geometry "
+                 "does not flow from the tuning-cache lookup helper "
+                 "(paddle_tpu.tune.kernel_config) — hardcoded launch "
+                 "geometry freezes one device's tradeoffs; resolve "
+                 "block/grid choices through kernel_config")
     return findings
 
 

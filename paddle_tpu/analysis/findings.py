@@ -102,6 +102,13 @@ RULES = {
         "supervised-recovery watchdog and quarantine logic depend on "
         "failures surfacing; an eaten exception turns a crashed step "
         "into a silent hang or a leaked sequence")),
+    "untuned-pallas-launch": (WARNING, "ast", (
+        "a pl.pallas_call in ops/pallas whose launch geometry does not "
+        "flow from the tuning-cache lookup helper (paddle_tpu.tune."
+        "kernel_config) — hardcoded block/grid choices freeze one "
+        "device's tradeoffs into every device's launches; route the "
+        "geometry through kernel_config so the autotuner's winners "
+        "apply at trace time")),
     "collective-outside-shard-map": (ERROR, "ast", (
         "a lax collective (psum/all_gather/ppermute/...) inside an "
         "inference-tier compiled def that is never routed through "

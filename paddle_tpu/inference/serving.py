@@ -359,6 +359,10 @@ class LLMEngine:
         self._evictions_seen = 0
         self.peak_resident_seqs = 0
         self.stats = ServingStats()
+        # resolve this engine's launch geometry from the tuning cache
+        # once at build — pure host-side dict reads (no compile) whose
+        # provenance summary() and serve_bench records surface
+        self._tuning_report = self._resolve_tuning()
 
         # fault-tolerance surfaces: a FaultPlan drives deterministic
         # chaos through the step/pool seams (None -> one attribute check
@@ -583,6 +587,42 @@ class LLMEngine:
             self.step()
         return dict(self._finished)
 
+    def _resolve_tuning(self) -> dict:
+        """Consult the kernel tuning cache once for this engine's launch
+        geometry — per registered kernel: the bucket key queried, the
+        config chosen, and whether a cache entry (exact or nearest
+        bucket) answered.  Lookups are pure host-side dict reads; the
+        kernels re-resolve the same keys at trace time, so this report
+        is the provenance of the geometry the programs actually run."""
+        from ..tune import cache_path, device_kind, kernel_config_with_meta
+        dt = jnp.dtype(self.params["embed"].dtype).name
+        d = self._hd
+        shapes = {
+            "flash_attention": {
+                "seq_q": self.max_model_len, "seq_k": self.max_model_len,
+                "head_dim": d, "dtype": dt},
+            "flash_attention_varlen": {
+                "seq_q": self.max_prefill_tokens,
+                "seq_k": self.max_model_len, "head_dim": d, "dtype": dt},
+            "fused_norms": {
+                "rows": self.max_prefill_tokens,
+                "hidden": self.config.hidden_size, "dtype": dt},
+            "paged_attention": {
+                "tq": self.prefill_token_bucket,
+                "kv_heads": self._kvh // self.tp, "head_dim": d,
+                "page": self.block_size, "nblk": self.nblk,
+                "dtype": self.kv_dtype},
+        }
+        kernels = {}
+        for name, shape in shapes.items():
+            config, meta = kernel_config_with_meta(name, shape)
+            self.stats.record_tuning(name, bool(meta["hit"]))
+            kernels[name] = {"hit": bool(meta["hit"]),
+                             "source": meta["source"], "config": config,
+                             "key": meta["key"]}
+        return {"path": cache_path(), "device": device_kind(),
+                "kernels": kernels}
+
     def summary(self) -> dict:
         """One dict of serving metrics + block-pool state for this run."""
         out = self.stats.summary()
@@ -593,6 +633,12 @@ class LLMEngine:
         out["kv_bytes_resident_per_shard"] = \
             self.kv_bytes_resident_per_shard()
         out["peak_resident_seqs"] = self.peak_resident_seqs
+        out["tuning_cache"] = {
+            "path": self._tuning_report["path"],
+            "device": self._tuning_report["device"],
+            "kernels": {k: dict(v) for k, v in
+                        self._tuning_report["kernels"].items()},
+        }
         return out
 
     def kv_page_bytes(self) -> int:
@@ -1325,7 +1371,12 @@ class LLMEngine:
                 kcl = kcl.at[blk, :, slot, :].set(k.astype(kcl.dtype))
                 vcl = vcl.at[blk, :, slot, :].set(v.astype(vcl.dtype))
                 if use_pallas:
-                    att = _pa.ragged_paged_attention_segrel(
+                    # the host packing path owns these buffers: bt is the
+                    # int32 NULL_BLOCK-padded pool table ([B+1] rows, so
+                    # the seg pad sentinel B is the valid null row) and
+                    # seg/rel come int32 from ragged_segments — the
+                    # packed entry skips the per-launch re-clip/re-cast
+                    att = _pa.ragged_paged_attention_segrel_packed(
                         q, kcl, vcl, bt, seg, rel)
                 else:
                     att = _pa.ragged_paged_reference_segrel(
@@ -1453,7 +1504,9 @@ class LLMEngine:
                 vcl = vcl.at[blk, :, slot, :].set(
                     jnp.clip(vq, -127, 127).astype(jnp.int8))
                 if use_pallas:
-                    att = _pa.ragged_paged_attention_quant_segrel(
+                    # packed-entry invariant as in the float step; the
+                    # scale pools are born f32 on the host
+                    att = _pa.ragged_paged_attention_quant_segrel_packed(
                         q, kcl, vcl, ksl, vsl, bt, seg, rel)
                 else:
                     att = _pa.ragged_paged_reference_quant_segrel(

@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import functools
 import math
-import os
 
 import jax
 import jax.numpy as jnp
@@ -29,11 +28,22 @@ try:
 except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
-_BLOCK_Q = int(os.environ.get("PADDLE_TPU_FA_BLOCK_Q", "512"))
-_BLOCK_K = int(os.environ.get("PADDLE_TPU_FA_BLOCK_K", "512"))
-
 # Tests flip this to run the same kernels via the Pallas interpreter on CPU.
 INTERPRET = False
+
+
+def _fa_blocks(sq: int, sk: int, d: int, dtype_name: str,
+               kernel: str = "flash_attention"):
+    """Trace-time tuned (block_q, block_k) for this launch shape.
+
+    Geometry flows from the tuning cache (env overrides and forced
+    configs win inside kernel_config); _pick_block then snaps each
+    preference to a power of two dividing the actual extent."""
+    from ...tune import kernel_config
+    cfg = kernel_config(kernel, {"seq_q": sq, "seq_k": sk, "head_dim": d,
+                                 "dtype": dtype_name})
+    return (_pick_block(sq, int(cfg["block_q"])),
+            _pick_block(sk, int(cfg["block_k"])))
 
 
 def _pick_block(seq_len: int, pref: int) -> int:
@@ -174,8 +184,7 @@ def _flash_fwd_pallas(q, k, v, causal):
     kr = jnp.swapaxes(k, 1, 2).reshape(b * hk, sk, d)
     vr = jnp.swapaxes(v, 1, 2).reshape(b * hk, sk, d)
 
-    block_q = _pick_block(sq, _BLOCK_Q)
-    block_k = _pick_block(sk, _BLOCK_K)
+    block_q, block_k = _fa_blocks(sq, sk, d, jnp.dtype(q.dtype).name)
 
     kernel = functools.partial(_fwd_kernel, causal=causal, sm_scale=sm_scale,
                                block_k=block_k, kv_len=sk)
@@ -332,8 +341,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal):
     delta = jnp.sum(dor.astype(jnp.float32) * outr.astype(jnp.float32),
                     axis=-1)[:, None, :]
 
-    block_q = _pick_block(sq, _BLOCK_Q)
-    block_k = _pick_block(sk, _BLOCK_K)
+    block_q, block_k = _fa_blocks(sq, sk, d, jnp.dtype(q.dtype).name)
     q_map, kv_map = _gqa_maps(h, group)
 
     def vec_q_map(bh, blk):

@@ -245,8 +245,12 @@ def _prep(q, k, v, cu_q, cu_k, causal):
     tq, h, d = q.shape
     tk = k.shape[0]
     nseq = cu_q.shape[0] - 1
-    block_q = _pick_block(max(128, -(-tq // 128) * 128), _fa._BLOCK_Q)
-    block_k = _pick_block(max(128, -(-tk // 128) * 128), _fa._BLOCK_K)
+    from ...tune import kernel_config
+    cfg = kernel_config("flash_attention_varlen",
+                        {"seq_q": tq, "seq_k": tk, "head_dim": d,
+                         "dtype": jnp.dtype(q.dtype).name})
+    block_q = _pick_block(max(128, -(-tq // 128) * 128), int(cfg["block_q"]))
+    block_k = _pick_block(max(128, -(-tk // 128) * 128), int(cfg["block_k"]))
     pad_q = -(-tq // block_q) * block_q
     pad_k = -(-tk // block_k) * block_k
     # sentinel segments: q pads get nseq, k pads nseq+1 -> never equal

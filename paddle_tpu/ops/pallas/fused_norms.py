@@ -22,7 +22,7 @@ try:
 except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
-_BLOCK_R = 256
+_BLOCK_R = 256  # built-in preference; the tuning cache can widen/narrow it
 
 
 def _rms_ref(x, w, eps):
@@ -56,21 +56,26 @@ def _ln_kernel(x_ref, w_ref, b_ref, o_ref, *, eps):
     o_ref[...] = (y * w[None, :] + b[None, :]).astype(o_ref.dtype)
 
 
-def _pick_block_r(R):
-    """Largest power-of-two block <= _BLOCK_R that exactly divides R.
+def _pick_block_r(R, pref=None):
+    """Largest power-of-two block <= pref that exactly divides R.
 
     The grid is R // block_r with no ragged-tail masking, so block_r MUST
     divide R; _supports guarantees R % 8 == 0, making 8 the floor here.
     """
-    for b in (256, 128, 64, 32, 16, 8):
-        if b <= _BLOCK_R and R % b == 0:
+    pref = _BLOCK_R if pref is None else pref
+    for b in (1024, 512, 256, 128, 64, 32, 16, 8):
+        if b <= pref and R % b == 0:
             return b
     return None
 
 
 def _row_call(kernel, out_dtype, x2d, *vecs):
+    from ...tune import kernel_config
     R, H = x2d.shape
-    block_r = _pick_block_r(R)
+    cfg = kernel_config("fused_norms",
+                        {"rows": R, "hidden": H,
+                         "dtype": jnp.dtype(x2d.dtype).name})
+    block_r = _pick_block_r(R, int(cfg["block_r"]))
     vec_specs = [pl.BlockSpec((H,), lambda r: (0,)) for _ in vecs]
     return pl.pallas_call(
         kernel,

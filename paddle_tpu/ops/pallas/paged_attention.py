@@ -51,13 +51,41 @@ def interpret_mode() -> bool:
     return bool(INTERPRET)
 
 
-def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_ref, l_ref, acc_ref, *, bs, sm_scale):
-    """grid (B, H_kv, nblk); refs: q [G, D], k/v [bs, D] (one page of one
-    kv head), o [G, D]; scratch m/l [G, 1] f32, acc [G, D] f32."""
+def _pages_per_step(tq, kv_heads, head_dim, page, nblk, dtype):
+    """Trace-time tuned page-walk width for the paged kernels.
+
+    The tuned value only widens the innermost grid step — pages are
+    still visited in the same ascending order, so the online-softmax
+    accumulation (and therefore every output byte) is invariant; only
+    the launch-overhead amortization changes."""
+    from ...tune import kernel_config
+    cfg = kernel_config("paged_attention",
+                        {"tq": tq, "kv_heads": kv_heads,
+                         "head_dim": head_dim, "page": page, "nblk": nblk,
+                         "dtype": jnp.dtype(dtype).name})
+    return max(1, min(int(cfg["pages_per_step"]), nblk))
+
+
+def _page_index(i, pages, j, nblk):
+    """Block-table column for page-slot j of grid step i.  The final
+    step may overhang nblk; the clamp keeps the DMA on a real page and
+    the kernels' `base <= rel` / `base < seq_len` guards (base >=
+    nblk*bs for overhang slots) skip its compute."""
+    return jnp.minimum(i * pages + j, nblk - 1)
+
+
+def _decode_kernel(bt_ref, len_ref, q_ref, *refs, bs, sm_scale, pages,
+                   nblk):
+    """grid (B, H_kv, ceil(nblk/pages)); refs: q [G, D], then `pages` k
+    pages and `pages` v pages [bs, D] (one kv head each), o [G, D];
+    scratch m/l [G, 1] f32, acc [G, D] f32.  Pages are walked j=0..pages
+    in ascending order — identical accumulation order for any width."""
+    k_refs = refs[:pages]
+    v_refs = refs[pages:2 * pages]
+    o_ref, m_ref, l_ref, acc_ref = refs[2 * pages:]
     b = pl.program_id(0)
     i = pl.program_id(2)
-    nblk = pl.num_programs(2)
+    steps = pl.num_programs(2)
     seq_len = len_ref[b]                      # valid positions this seq
 
     @pl.when(i == 0)
@@ -66,68 +94,70 @@ def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    base = i * bs
+    for j in range(pages):
+        base = (i * pages + j) * bs
 
-    @pl.when(base < seq_len)
-    def _tile():
-        q = (q_ref[...].astype(jnp.float32) * sm_scale).astype(q_ref.dtype)
-        k = k_ref[...]                         # [bs, D]
-        v = v_ref[...]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [G, bs]
-        pos = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(pos < seq_len, s, -jnp.inf)
-        m_prev = m_ref[...]                    # [G, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)                 # [G, bs]
-        alpha = jnp.exp(m_prev - m_new)        # [G, 1]
-        l_ref[...] = alpha * l_ref[...] + jnp.sum(p, axis=1, keepdims=True)
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_ref[...] = m_new
+        @pl.when(base < seq_len)
+        def _tile(base=base, k_ref=k_refs[j], v_ref=v_refs[j]):
+            q = (q_ref[...].astype(jnp.float32) * sm_scale).astype(
+                q_ref.dtype)
+            k = k_ref[...]                     # [bs, D]
+            v = v_ref[...]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)      # [G, bs]
+            pos = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(pos < seq_len, s, -jnp.inf)
+            m_prev = m_ref[...]                # [G, 1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)             # [G, bs]
+            alpha = jnp.exp(m_prev - m_new)    # [G, 1]
+            l_ref[...] = alpha * l_ref[...] + \
+                jnp.sum(p, axis=1, keepdims=True)
+            acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[...] = m_new
 
-    @pl.when(i == nblk - 1)
+    @pl.when(i == steps - 1)
     def _finalize():
         o_ref[...] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
 
 
-def paged_decode_attention(q, key_cache, value_cache, block_tables,
-                           lengths):
-    """One-token-per-sequence decode over paged KV.
-
-    q [B, H, D]; caches [num_blocks, H_kv, bs, D]; block_tables [B, nblk]
-    int32; lengths [B] int32 (valid positions incl. the fresh token).
-    Returns [B, H, D].
-    """
+def _paged_decode_launch(q, key_cache, value_cache, block_tables,
+                         lengths):
+    """The raw decode launch.  Callers must satisfy the packed-operand
+    invariant: block_tables/lengths already int32 with every table entry
+    in [0, num_blocks) — the grid DMAs a page per table entry even past
+    each sequence's length (compute is skipped, the copy is not), so an
+    out-of-range entry is an out-of-bounds DMA."""
     B, H, D = q.shape
     _, Hkv, bs, _ = key_cache.shape
     G = H // Hkv
     nblk = block_tables.shape[1]
     sm_scale = 1.0 / (D ** 0.5)
+    pages = _pages_per_step(B, Hkv, D, bs, nblk, q.dtype)
 
-    kernel = functools.partial(_decode_kernel, bs=bs, sm_scale=sm_scale)
+    kernel = functools.partial(_decode_kernel, bs=bs, sm_scale=sm_scale,
+                               pages=pages, nblk=nblk)
     # q rows for kv head h are h*G..(h+1)*G: block (1, G, D) at index (b, h)
     qr = q.reshape(B, Hkv, G, D)
-    # the grid DMAs a page per table entry even past each sequence's
-    # length (compute is skipped, the copy is not): clamp the reference
-    # blha convention's -1 padding entries to a valid block index
-    block_tables = jnp.clip(block_tables, 0, key_cache.shape[0] - 1)
+
+    def _kv_spec(j):
+        return pl.BlockSpec(
+            (None, None, bs, D),
+            lambda b, h, i, bt, ln, _j=j:
+            (bt[b, _page_index(i, pages, _j, nblk)], h, 0, 0))
 
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,             # block_tables, lengths
-            grid=(B, Hkv, nblk),
+            grid=(B, Hkv, -(-nblk // pages)),
             in_specs=[
                 pl.BlockSpec((None, None, G, D),
                              lambda b, h, i, bt, ln: (b, h, 0, 0)),
-                pl.BlockSpec((None, None, bs, D),
-                             lambda b, h, i, bt, ln: (bt[b, i], h, 0, 0)),
-                pl.BlockSpec((None, None, bs, D),
-                             lambda b, h, i, bt, ln: (bt[b, i], h, 0, 0)),
-            ],
+            ] + [_kv_spec(j) for j in range(pages)] * 2,
             out_specs=pl.BlockSpec((None, None, G, D),
                                    lambda b, h, i, bt, ln: (b, h, 0, 0)),
             scratch_shapes=[
@@ -138,9 +168,36 @@ def paged_decode_attention(q, key_cache, value_cache, block_tables,
         ),
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
         interpret=interpret_mode(),
-    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
-      qr, key_cache, value_cache)
+    )(block_tables, lengths, qr,
+      *([key_cache] * pages), *([value_cache] * pages))
     return out.reshape(B, H, D)
+
+
+def paged_decode_attention(q, key_cache, value_cache, block_tables,
+                           lengths):
+    """One-token-per-sequence decode over paged KV.
+
+    q [B, H, D]; caches [num_blocks, H_kv, bs, D]; block_tables [B, nblk]
+    int32; lengths [B] int32 (valid positions incl. the fresh token).
+    Returns [B, H, D].  Clamps the reference blha convention's -1 table
+    padding to a valid block index before launching; callers that pack
+    valid tables on the host should use
+    :func:`paged_decode_attention_packed` instead.
+    """
+    block_tables = jnp.clip(block_tables, 0,
+                            key_cache.shape[0] - 1).astype(jnp.int32)
+    return _paged_decode_launch(q, key_cache, value_cache, block_tables,
+                                lengths.astype(jnp.int32))
+
+
+def paged_decode_attention_packed(q, key_cache, value_cache, block_tables,
+                                  lengths):
+    """Decode launch without the defensive table clip/casts, for callers
+    owning the host packing path (serving.py keeps its table pool int32
+    and NULL_BLOCK-padded with valid indices, so re-normalizing every
+    launch is pure waste)."""
+    return _paged_decode_launch(q, key_cache, value_cache, block_tables,
+                                lengths)
 
 
 def paged_decode_reference(q, key_cache, value_cache, block_tables,
@@ -165,19 +222,26 @@ def paged_decode_reference(q, key_cache, value_cache, block_tables,
     return out.astype(q.dtype)
 
 
-def _ragged_kernel(seg_ref, rel_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_ref, l_ref, acc_ref, *, bs, sm_scale):
-    """grid (Tq, H_kv, nblk); refs: q [G, D] (one flat token's group for
-    one kv head), k/v [bs, D] (one page of that token's owning row),
-    o [G, D]; scratch m/l [G, 1] f32, acc [G, D] f32.
+def _ragged_kernel(seg_ref, rel_ref, bt_ref, q_ref, *refs, bs, sm_scale,
+                   pages, nblk):
+    """grid (Tq, H_kv, ceil(nblk/pages)); refs: q [G, D] (one flat
+    token's group for one kv head), then `pages` k pages and `pages` v
+    pages [bs, D] of that token's owning row, o [G, D]; scratch m/l
+    [G, 1] f32, acc [G, D] f32.
 
     seg[t] names the block-table row owning flat token t; rel[t] is the
     token's position within that row's KV (0-based), so causality is just
     `keypos <= rel[t]` — uniform across prefill/resume/decode/verify rows.
+    Pages are walked j=0..pages in ascending order: the accumulation
+    order — and therefore every output byte — is identical for any
+    `pages` width; only launch-overhead amortization changes.
     """
+    k_refs = refs[:pages]
+    v_refs = refs[pages:2 * pages]
+    o_ref, m_ref, l_ref, acc_ref = refs[2 * pages:]
     t = pl.program_id(0)
     i = pl.program_id(2)
-    nblk = pl.num_programs(2)
+    steps = pl.num_programs(2)
     rel = rel_ref[t]                          # absolute key budget, 0-based
 
     @pl.when(i == 0)
@@ -186,50 +250,54 @@ def _ragged_kernel(seg_ref, rel_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    base = i * bs
+    for j in range(pages):
+        base = (i * pages + j) * bs
 
-    @pl.when(base <= rel)
-    def _tile():
-        q = (q_ref[...].astype(jnp.float32) * sm_scale).astype(q_ref.dtype)
-        k = k_ref[...]                         # [bs, D]
-        v = v_ref[...]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [G, bs]
-        pos = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(pos <= rel, s, -jnp.inf)
-        m_prev = m_ref[...]                    # [G, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)                 # [G, bs]
-        alpha = jnp.exp(m_prev - m_new)        # [G, 1]
-        l_ref[...] = alpha * l_ref[...] + jnp.sum(p, axis=1, keepdims=True)
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_ref[...] = m_new
+        @pl.when(base <= rel)
+        def _tile(base=base, k_ref=k_refs[j], v_ref=v_refs[j]):
+            q = (q_ref[...].astype(jnp.float32) * sm_scale).astype(
+                q_ref.dtype)
+            k = k_ref[...]                     # [bs, D]
+            v = v_ref[...]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)      # [G, bs]
+            pos = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(pos <= rel, s, -jnp.inf)
+            m_prev = m_ref[...]                # [G, 1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)             # [G, bs]
+            alpha = jnp.exp(m_prev - m_new)    # [G, 1]
+            l_ref[...] = alpha * l_ref[...] + \
+                jnp.sum(p, axis=1, keepdims=True)
+            acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[...] = m_new
 
-    @pl.when(i == nblk - 1)
+    @pl.when(i == steps - 1)
     def _finalize():
         o_ref[...] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
 
 
 def _ragged_quant_kernel(seg_ref, rel_ref, bt_ref, ksc_ref, vsc_ref,
-                         q_ref, k_ref, v_ref, o_ref,
-                         m_ref, l_ref, acc_ref, *, bs, sm_scale):
+                         q_ref, *refs, bs, sm_scale, pages, nblk):
     """Int8-page variant of `_ragged_kernel`: k/v refs are int8 pages and
     the per-page-per-head float32 scales ride the scalar-prefetch path
     (SMEM) next to the block table, so dequantization happens inline as
     each page streams into VMEM — no dense float intermediate ever
-    exists.  ksc/vsc are [num_blocks, H_kv] f32; the page's scale is
-    looked up through the same `bt[seg[t], i]` indirection the BlockSpec
-    index maps use.
+    exists.  ksc/vsc are [num_blocks, H_kv] f32; each page-slot's scale
+    is looked up through the same clamped `bt[seg[t], i*pages+j]`
+    indirection its BlockSpec index map uses.
     """
+    k_refs = refs[:pages]
+    v_refs = refs[pages:2 * pages]
+    o_ref, m_ref, l_ref, acc_ref = refs[2 * pages:]
     t = pl.program_id(0)
     h = pl.program_id(1)
     i = pl.program_id(2)
-    nblk = pl.num_programs(2)
+    steps = pl.num_programs(2)
     rel = rel_ref[t]                          # absolute key budget, 0-based
-    blk = bt_ref[seg_ref[t], i]
 
     @pl.when(i == 0)
     def _init():
@@ -237,29 +305,32 @@ def _ragged_quant_kernel(seg_ref, rel_ref, bt_ref, ksc_ref, vsc_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    base = i * bs
+    for j in range(pages):
+        base = (i * pages + j) * bs
+        blk = bt_ref[seg_ref[t], _page_index(i, pages, j, nblk)]
 
-    @pl.when(base <= rel)
-    def _tile():
-        q = q_ref[...].astype(jnp.float32) * sm_scale
-        k = k_ref[...].astype(jnp.float32) * ksc_ref[blk, h]   # [bs, D]
-        v = v_ref[...].astype(jnp.float32) * vsc_ref[blk, h]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [G, bs]
-        pos = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(pos <= rel, s, -jnp.inf)
-        m_prev = m_ref[...]                    # [G, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)                 # [G, bs]
-        alpha = jnp.exp(m_prev - m_new)        # [G, 1]
-        l_ref[...] = alpha * l_ref[...] + jnp.sum(p, axis=1, keepdims=True)
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_ref[...] = m_new
+        @pl.when(base <= rel)
+        def _tile(base=base, blk=blk, k_ref=k_refs[j], v_ref=v_refs[j]):
+            q = q_ref[...].astype(jnp.float32) * sm_scale
+            k = k_ref[...].astype(jnp.float32) * ksc_ref[blk, h]  # [bs, D]
+            v = v_ref[...].astype(jnp.float32) * vsc_ref[blk, h]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)      # [G, bs]
+            pos = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(pos <= rel, s, -jnp.inf)
+            m_prev = m_ref[...]                # [G, 1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)             # [G, bs]
+            alpha = jnp.exp(m_prev - m_new)    # [G, 1]
+            l_ref[...] = alpha * l_ref[...] + \
+                jnp.sum(p, axis=1, keepdims=True)
+            acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[...] = m_new
 
-    @pl.when(i == nblk - 1)
+    @pl.when(i == steps - 1)
     def _finalize():
         o_ref[...] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
 
@@ -283,45 +354,37 @@ def ragged_segments(cu_seqlens, kv_lens, n_tokens):
     return seg, rel
 
 
-def ragged_paged_attention_segrel(q, key_cache, value_cache, block_tables,
-                                  seg, rel):
-    """Ragged attention with precomputed (seg, rel) per flat token.
-
-    q [Tq, H, D]; caches [num_blocks, H_kv, bs, D]; block_tables [R, nblk]
-    int32; seg [Tq] int32 in [0, R] (R == padding sentinel); rel [Tq]
-    int32.  Returns [Tq, H, D].
-    """
+def _ragged_launch(q, key_cache, value_cache, block_tables, seg, rel):
+    """The raw ragged launch.  Callers must satisfy the packed-operand
+    invariant: int32 scalar operands, table entries in [0, num_blocks),
+    seg values naming real table rows (serving's [B+1]-row table makes
+    the pad sentinel B a valid null row)."""
     Tq, H, D = q.shape
     _, Hkv, bs, _ = key_cache.shape
     G = H // Hkv
     R, nblk = block_tables.shape
     sm_scale = 1.0 / (D ** 0.5)
+    pages = _pages_per_step(Tq, Hkv, D, bs, nblk, key_cache.dtype)
 
-    kernel = functools.partial(_ragged_kernel, bs=bs, sm_scale=sm_scale)
+    kernel = functools.partial(_ragged_kernel, bs=bs, sm_scale=sm_scale,
+                               pages=pages, nblk=nblk)
     qr = q.reshape(Tq, Hkv, G, D)
-    # clamp table entries (blha -1 padding) AND seg (R == pad sentinel) so
-    # every index map resolves to a real page; padded/overhung tiles are
-    # DMA'd but masked or skipped in compute
-    block_tables = jnp.clip(block_tables.astype(jnp.int32), 0,
-                            key_cache.shape[0] - 1)
-    seg = jnp.clip(seg.astype(jnp.int32), 0, R - 1)
-    rel = rel.astype(jnp.int32)
+
+    def _kv_spec(j):
+        return pl.BlockSpec(
+            (None, None, bs, D),
+            lambda t, h, i, sg, rl, bt, _j=j:
+            (bt[sg[t], _page_index(i, pages, _j, nblk)], h, 0, 0))
 
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,             # seg, rel, block_tables
-            grid=(Tq, Hkv, nblk),
+            grid=(Tq, Hkv, -(-nblk // pages)),
             in_specs=[
                 pl.BlockSpec((None, None, G, D),
                              lambda t, h, i, sg, rl, bt: (t, h, 0, 0)),
-                pl.BlockSpec((None, None, bs, D),
-                             lambda t, h, i, sg, rl, bt:
-                             (bt[sg[t], i], h, 0, 0)),
-                pl.BlockSpec((None, None, bs, D),
-                             lambda t, h, i, sg, rl, bt:
-                             (bt[sg[t], i], h, 0, 0)),
-            ],
+            ] + [_kv_spec(j) for j in range(pages)] * 2,
             out_specs=pl.BlockSpec((None, None, G, D),
                                    lambda t, h, i, sg, rl, bt: (t, h, 0, 0)),
             scratch_shapes=[
@@ -332,8 +395,42 @@ def ragged_paged_attention_segrel(q, key_cache, value_cache, block_tables,
         ),
         out_shape=jax.ShapeDtypeStruct((Tq, Hkv, G, D), q.dtype),
         interpret=interpret_mode(),
-    )(seg, rel, block_tables, qr, key_cache, value_cache)
+    )(seg, rel, block_tables, qr,
+      *([key_cache] * pages), *([value_cache] * pages))
     return out.reshape(Tq, H, D)
+
+
+def ragged_paged_attention_segrel(q, key_cache, value_cache, block_tables,
+                                  seg, rel):
+    """Ragged attention with precomputed (seg, rel) per flat token.
+
+    q [Tq, H, D]; caches [num_blocks, H_kv, bs, D]; block_tables [R, nblk]
+    int32; seg [Tq] int32 in [0, R] (R == padding sentinel); rel [Tq]
+    int32.  Returns [Tq, H, D].
+
+    Clamps table entries (blha -1 padding) AND seg (R == pad sentinel) so
+    every index map resolves to a real page; padded/overhung tiles are
+    DMA'd but masked or skipped in compute.  Callers that already pack
+    valid int32 operands on the host should use
+    :func:`ragged_paged_attention_segrel_packed`.
+    """
+    R = block_tables.shape[0]
+    block_tables = jnp.clip(block_tables.astype(jnp.int32), 0,
+                            key_cache.shape[0] - 1)
+    seg = jnp.clip(seg.astype(jnp.int32), 0, R - 1)
+    return _ragged_launch(q, key_cache, value_cache, block_tables, seg,
+                          rel.astype(jnp.int32))
+
+
+def ragged_paged_attention_segrel_packed(q, key_cache, value_cache,
+                                         block_tables, seg, rel):
+    """Ragged launch without the defensive clips/casts, for callers that
+    guarantee the host-packing invariant (serving.py owns these buffers:
+    its table pool is int32 and NULL_BLOCK-padded with valid indices,
+    and its [B+1]-row table makes the seg pad sentinel a real null row,
+    so re-normalizing every launch is pure waste)."""
+    return _ragged_launch(q, key_cache, value_cache, block_tables, seg,
+                          rel)
 
 
 def ragged_paged_attention(q, key_cache, value_cache, block_tables,
@@ -351,46 +448,37 @@ def ragged_paged_attention(q, key_cache, value_cache, block_tables,
         q, key_cache, value_cache, block_tables, seg, rel)
 
 
-def ragged_paged_attention_quant_segrel(q, key_cache, value_cache,
-                                        key_scales, value_scales,
-                                        block_tables, seg, rel):
-    """Ragged attention over int8 KV pages with per-page-per-head scales.
-
-    q [Tq, H, D] float; caches [num_blocks, H_kv, bs, D] int8;
-    key_scales/value_scales [num_blocks, H_kv] f32 (symmetric:
-    float = int8 * scale); block_tables [R, nblk] int32; seg/rel as in
-    `ragged_paged_attention_segrel`.  Returns [Tq, H, D] in q.dtype.
-    """
+def _ragged_quant_launch(q, key_cache, value_cache, key_scales,
+                         value_scales, block_tables, seg, rel):
+    """The raw int8-page ragged launch; same packed-operand invariant as
+    `_ragged_launch`, plus f32 scales."""
     Tq, H, D = q.shape
     _, Hkv, bs, _ = key_cache.shape
     G = H // Hkv
     R, nblk = block_tables.shape
     sm_scale = 1.0 / (D ** 0.5)
+    pages = _pages_per_step(Tq, Hkv, D, bs, nblk, key_cache.dtype)
 
     kernel = functools.partial(_ragged_quant_kernel, bs=bs,
-                               sm_scale=sm_scale)
+                               sm_scale=sm_scale, pages=pages, nblk=nblk)
     qr = q.reshape(Tq, Hkv, G, D)
-    block_tables = jnp.clip(block_tables.astype(jnp.int32), 0,
-                            key_cache.shape[0] - 1)
-    seg = jnp.clip(seg.astype(jnp.int32), 0, R - 1)
-    rel = rel.astype(jnp.int32)
+
+    def _kv_spec(j):
+        return pl.BlockSpec(
+            (None, None, bs, D),
+            lambda t, h, i, sg, rl, bt, ks, vs, _j=j:
+            (bt[sg[t], _page_index(i, pages, _j, nblk)], h, 0, 0))
 
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=5,     # seg, rel, block_tables, ksc, vsc
-            grid=(Tq, Hkv, nblk),
+            grid=(Tq, Hkv, -(-nblk // pages)),
             in_specs=[
                 pl.BlockSpec((None, None, G, D),
                              lambda t, h, i, sg, rl, bt, ks, vs:
                              (t, h, 0, 0)),
-                pl.BlockSpec((None, None, bs, D),
-                             lambda t, h, i, sg, rl, bt, ks, vs:
-                             (bt[sg[t], i], h, 0, 0)),
-                pl.BlockSpec((None, None, bs, D),
-                             lambda t, h, i, sg, rl, bt, ks, vs:
-                             (bt[sg[t], i], h, 0, 0)),
-            ],
+            ] + [_kv_spec(j) for j in range(pages)] * 2,
             out_specs=pl.BlockSpec((None, None, G, D),
                                    lambda t, h, i, sg, rl, bt, ks, vs:
                                    (t, h, 0, 0)),
@@ -402,9 +490,39 @@ def ragged_paged_attention_quant_segrel(q, key_cache, value_cache,
         ),
         out_shape=jax.ShapeDtypeStruct((Tq, Hkv, G, D), q.dtype),
         interpret=interpret_mode(),
-    )(seg, rel, block_tables, key_scales.astype(jnp.float32),
-      value_scales.astype(jnp.float32), qr, key_cache, value_cache)
+    )(seg, rel, block_tables, key_scales, value_scales, qr,
+      *([key_cache] * pages), *([value_cache] * pages))
     return out.reshape(Tq, H, D)
+
+
+def ragged_paged_attention_quant_segrel(q, key_cache, value_cache,
+                                        key_scales, value_scales,
+                                        block_tables, seg, rel):
+    """Ragged attention over int8 KV pages with per-page-per-head scales.
+
+    q [Tq, H, D] float; caches [num_blocks, H_kv, bs, D] int8;
+    key_scales/value_scales [num_blocks, H_kv] f32 (symmetric:
+    float = int8 * scale); block_tables [R, nblk] int32; seg/rel as in
+    `ragged_paged_attention_segrel`.  Returns [Tq, H, D] in q.dtype.
+    """
+    R = block_tables.shape[0]
+    block_tables = jnp.clip(block_tables.astype(jnp.int32), 0,
+                            key_cache.shape[0] - 1)
+    seg = jnp.clip(seg.astype(jnp.int32), 0, R - 1)
+    return _ragged_quant_launch(
+        q, key_cache, value_cache, key_scales.astype(jnp.float32),
+        value_scales.astype(jnp.float32), block_tables, seg,
+        rel.astype(jnp.int32))
+
+
+def ragged_paged_attention_quant_segrel_packed(q, key_cache, value_cache,
+                                               key_scales, value_scales,
+                                               block_tables, seg, rel):
+    """Int8-page ragged launch without the defensive clips/casts, for
+    callers that guarantee the host-packing invariant (serving.py packs
+    int32 tables/seg/rel and f32 scale pools)."""
+    return _ragged_quant_launch(q, key_cache, value_cache, key_scales,
+                                value_scales, block_tables, seg, rel)
 
 
 def ragged_paged_reference_quant_segrel(q, key_cache, value_cache,

@@ -155,6 +155,11 @@ class ServingStats:
         self.degradation_state = 0       # current pressure tier (gauge)
         self.degradation_transitions = 0 # tier changes (counter)
         self.parked_evictions = 0        # pages evicted by tier-3 pressure
+        # kernel-autotuning surface (PR 10): per-kernel tuning-cache
+        # lookup outcomes at engine build (dict-of-int — aggregate()
+        # merges dict values by int addition)
+        self.tuning_hits: dict = {}      # kernel -> cache-hit lookups
+        self.tuning_misses: dict = {}    # kernel -> default/env fallbacks
         self._t_start = time.monotonic() # process-lifetime uptime anchor
 
     # -- recording (engine-facing) ------------------------------------------
@@ -269,6 +274,12 @@ class ServingStats:
     def record_parked_evictions(self, n: int = 1) -> None:
         self.parked_evictions += int(n)
 
+    def record_tuning(self, kernel: str, hit: bool) -> None:
+        """One tuning-cache lookup for a kernel's launch geometry (the
+        engine resolves each registered kernel once at build)."""
+        slot = self.tuning_hits if hit else self.tuning_misses
+        slot[kernel] = slot.get(kernel, 0) + 1
+
     def uptime_seconds(self) -> float:
         """Seconds since these stats were created/reset.  The runner
         carries one ServingStats across engine rebuilds, so this is the
@@ -366,6 +377,8 @@ class ServingStats:
             "degradation_state": self.degradation_state,
             "degradation_transitions": self.degradation_transitions,
             "parked_evictions": self.parked_evictions,
+            "tuning_cache_hits": dict(self.tuning_hits),
+            "tuning_cache_misses": dict(self.tuning_misses),
         }
 
     # summary() predates snapshot() and is the name the engine/benches

@@ -1,0 +1,26 @@
+"""Kernel autotuning: searched launch configs, persisted per device.
+
+Kernels resolve their launch geometry at trace time through
+:func:`kernel_config`, which walks forced/env overrides, then the
+JSON tuning cache (exact bucket, then nearest same-dtype bucket), then
+built-in defaults.  ``tools/perf/autotune.py`` runs the sweep that
+populates the cache — wall-clock in subprocess isolation on a chip,
+arithmetic-intensity cost model on CPU.
+"""
+from .cache import (TuningCache, bucket_signature, cache_path, current_cache,
+                    device_kind, kernel_config, kernel_config_with_meta,
+                    provenance_snapshot, reset_provenance, set_cache_path)
+from .registry import (TunableKernel, all_kernels, candidate_configs,
+                       get_kernel, register)
+from .search import (CostModelMeasurer, SubprocessMeasurer, run_sweep,
+                     sweep_kernel, untuned_launch_report)
+
+__all__ = [
+    "TuningCache", "bucket_signature", "cache_path", "current_cache",
+    "device_kind", "kernel_config", "kernel_config_with_meta",
+    "provenance_snapshot", "reset_provenance", "set_cache_path",
+    "TunableKernel", "all_kernels", "candidate_configs", "get_kernel",
+    "register",
+    "CostModelMeasurer", "SubprocessMeasurer", "run_sweep", "sweep_kernel",
+    "untuned_launch_report",
+]

@@ -1,0 +1,92 @@
+"""Arithmetic-intensity cost model: scores candidates without a chip.
+
+Off-chip (CPU CI) the autotuner cannot time kernels, but it can still
+rank them: each candidate's runtime is modeled as the roofline max of
+compute time and memory time plus a per-grid-program launch overhead,
+with a VMEM-working-set feasibility gate.  The constants are a generic
+TPU-class device — absolute numbers are meaningless, the RANKING is
+what the sweep persists, and on-chip wall-clock measurement replaces
+this model entirely (``--wall`` mode).
+"""
+from __future__ import annotations
+
+import math
+
+__all__ = ["estimate", "PEAK_FLOPS", "PEAK_BW", "VMEM_BYTES"]
+
+PEAK_FLOPS = 200e12     # flop/s, generic bf16-class systolic peak
+PEAK_BW = 1.0e12        # byte/s HBM
+VMEM_BYTES = 64 << 20   # per-core VMEM working-set budget
+PER_PROGRAM_S = 1.2e-6  # grid-program launch/prologue overhead
+PER_TILE_S = 0.1e-6     # per inner-tile loop overhead (k-blocks, pages)
+
+_DTYPE_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2, "int8": 1}
+
+
+def _bytes(dtype: str) -> int:
+    return _DTYPE_BYTES.get(dtype, 4)
+
+
+def _roofline(flops: float, traffic: float, programs: float,
+              tiles: float, vmem: float):
+    if vmem > VMEM_BYTES:
+        return math.inf
+    return (max(flops / PEAK_FLOPS, traffic / PEAK_BW)
+            + programs * PER_PROGRAM_S + tiles * PER_TILE_S)
+
+
+def _flash(shape: dict, config: dict) -> float:
+    sq, sk, d = shape["seq_q"], shape["seq_k"], shape["head_dim"]
+    eb = _bytes(shape.get("dtype", "float32"))
+    bq = min(config["block_q"], sq)
+    bk = min(config["block_k"], sk)
+    heads = shape.get("heads", 8)
+    programs = heads * math.ceil(sq / bq)
+    tiles = programs * math.ceil(sk / bk)
+    flops = 4.0 * heads * sq * sk * d
+    # each q-block streams the full K/V once; bigger q-blocks mean fewer
+    # K/V passes, bigger k-blocks amortize tile overhead
+    traffic = eb * heads * (sq * d * 2 + math.ceil(sq / bq) * sk * d * 2)
+    vmem = eb * (bq * d + 2 * bk * d) + 4 * bq * d + 4 * bq * 2
+    return _roofline(flops, traffic, programs, tiles, vmem)
+
+
+def _norms(shape: dict, config: dict) -> float:
+    rows, hidden = shape["rows"], shape["hidden"]
+    eb = _bytes(shape.get("dtype", "float32"))
+    br = min(config["block_r"], rows)
+    programs = math.ceil(rows / br)
+    flops = 8.0 * rows * hidden
+    traffic = eb * rows * hidden * 2
+    vmem = eb * br * hidden * 2 + 4 * br * hidden
+    return _roofline(flops, traffic, programs, programs, vmem)
+
+
+def _paged(shape: dict, config: dict) -> float:
+    tq, kvh, d = shape["tq"], shape["kv_heads"], shape["head_dim"]
+    page, nblk = shape["page"], shape["nblk"]
+    eb = _bytes(shape.get("dtype", "float32"))
+    p = max(1, config["pages_per_step"])
+    steps = math.ceil(nblk / p)
+    programs = tq * kvh * steps
+    flops = 4.0 * tq * kvh * nblk * page * d
+    traffic = eb * tq * kvh * nblk * page * d * 2 + 4.0 * tq * kvh * d
+    # p page-pairs resident per step plus the f32 accumulator
+    vmem = eb * p * page * d * 2 + 4 * d * 3
+    return _roofline(flops, traffic, programs, programs * p, vmem)
+
+
+_MODELS = {
+    "flash_attention": _flash,
+    "flash_attention_varlen": _flash,
+    "fused_norms": _norms,
+    "paged_attention": _paged,
+}
+
+
+def estimate(kernel: str, shape: dict, config: dict) -> float:
+    """Modeled seconds for one launch; math.inf when infeasible."""
+    fn = _MODELS.get(kernel)
+    if fn is None:
+        raise KeyError(f"no cost model for kernel {kernel!r}")
+    return fn(shape, config)

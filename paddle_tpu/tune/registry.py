@@ -1,0 +1,135 @@
+"""TunableKernel registry: the search space each Pallas kernel exposes.
+
+A registration declares, per kernel: the tunable parameters and their
+candidate values (``space``), the built-in defaults the fallback chain
+bottoms out at, any deprecated env-var levers that still override the
+cache, and a ``sweep`` of representative shape keys the autotuner
+measures.  The registry is pure data — it imports no kernel module, so
+the lint CLI and the subprocess sweep workers can enumerate it without
+touching jax.
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+
+__all__ = ["TunableKernel", "register", "get_kernel", "all_kernels",
+           "candidate_configs"]
+
+
+@dataclass(frozen=True)
+class TunableKernel:
+    """Search-space declaration for one Pallas kernel.
+
+    name           cache key component ("flash_attention", ...)
+    space          param -> tuple of candidate values
+    defaults       param -> built-in value (end of the fallback chain)
+    env_overrides  param -> deprecated env var that still wins over the
+                   cache (with a DeprecationWarning)
+    sweep          representative shape keys measured by autotune.py;
+                   trace-time lookups resolve to these via the bucket
+                   fallback when their own bucket has no entry
+    describe       one-line human summary for reports
+    """
+    name: str
+    space: dict = field(default_factory=dict)
+    defaults: dict = field(default_factory=dict)
+    env_overrides: dict = field(default_factory=dict)
+    sweep: tuple = ()
+    describe: str = ""
+
+
+_REGISTRY: dict = {}
+
+
+def register(kernel: TunableKernel) -> TunableKernel:
+    _REGISTRY[kernel.name] = kernel
+    return kernel
+
+
+def get_kernel(name: str):
+    return _REGISTRY.get(name)
+
+
+def all_kernels() -> tuple:
+    return tuple(_REGISTRY[k] for k in sorted(_REGISTRY))
+
+
+def candidate_configs(kernel: TunableKernel):
+    """Cartesian product of the kernel's search space, defaults first."""
+    names = sorted(kernel.space)
+    seen = []
+    default = {k: kernel.defaults[k] for k in names}
+    seen.append(default)
+    for combo in itertools.product(*(kernel.space[k] for k in names)):
+        cfg = dict(zip(names, combo))
+        if cfg not in seen:
+            seen.append(cfg)
+    return seen
+
+
+# ---------------------------------------------------------------------------
+# the four shipped kernels
+# ---------------------------------------------------------------------------
+
+# dense flash attention: block_q/block_k tile the (seq_q, seq_k) grid.
+# Sweep covers the f32 CI shapes and the bf16 shapes real models run, so
+# any device the sweep touches gets a same-dtype bucket for both.
+register(TunableKernel(
+    name="flash_attention",
+    space={"block_q": (128, 256, 512, 1024), "block_k": (128, 256, 512, 1024)},
+    defaults={"block_q": 512, "block_k": 512},
+    env_overrides={"block_q": "PADDLE_TPU_FA_BLOCK_Q",
+                   "block_k": "PADDLE_TPU_FA_BLOCK_K"},
+    sweep=(
+        {"seq_q": 2048, "seq_k": 2048, "head_dim": 128, "dtype": "float32"},
+        {"seq_q": 2048, "seq_k": 2048, "head_dim": 128, "dtype": "bfloat16"},
+        {"seq_q": 8192, "seq_k": 8192, "head_dim": 128, "dtype": "bfloat16"},
+    ),
+    describe="dense flash attention fwd/bwd q/k tile sizes",
+))
+
+# varlen flash attention shares the block vocabulary but tiles ragged
+# token batches; its q-extent is the prefill token bucket, not seq_len.
+register(TunableKernel(
+    name="flash_attention_varlen",
+    space={"block_q": (128, 256, 512, 1024), "block_k": (128, 256, 512, 1024)},
+    defaults={"block_q": 512, "block_k": 512},
+    env_overrides={"block_q": "PADDLE_TPU_FA_BLOCK_Q",
+                   "block_k": "PADDLE_TPU_FA_BLOCK_K"},
+    sweep=(
+        {"seq_q": 1024, "seq_k": 2048, "head_dim": 128, "dtype": "float32"},
+        {"seq_q": 1024, "seq_k": 2048, "head_dim": 128, "dtype": "bfloat16"},
+    ),
+    describe="varlen (packed-prefill) flash attention tile sizes",
+))
+
+# fused RMS/LayerNorm: rows-per-program blocking.
+register(TunableKernel(
+    name="fused_norms",
+    space={"block_r": (64, 128, 256, 512)},
+    defaults={"block_r": 256},
+    sweep=(
+        {"rows": 2048, "hidden": 4096, "dtype": "float32"},
+        {"rows": 2048, "hidden": 4096, "dtype": "bfloat16"},
+    ),
+    describe="fused RMS/LayerNorm rows-per-program block",
+))
+
+# ragged paged attention: KV pages walked per grid step.  pages_per_step
+# widens the innermost grid dim's work without changing the sequential
+# page order, so accumulation — and therefore bytes — is identical.
+register(TunableKernel(
+    name="paged_attention",
+    space={"pages_per_step": (1, 2, 4, 8)},
+    defaults={"pages_per_step": 1},
+    sweep=(
+        {"tq": 8, "kv_heads": 4, "head_dim": 128, "page": 16, "nblk": 128,
+         "dtype": "float32"},
+        {"tq": 8, "kv_heads": 4, "head_dim": 128, "page": 16, "nblk": 128,
+         "dtype": "bfloat16"},
+        {"tq": 8, "kv_heads": 4, "head_dim": 128, "page": 32, "nblk": 256,
+         "dtype": "int8"},
+    ),
+    describe="ragged paged attention KV pages per grid step",
+))

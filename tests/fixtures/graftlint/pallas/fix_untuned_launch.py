@@ -1,0 +1,23 @@
+"""Seeds untuned-pallas-launch: a pl.pallas_call in a pallas/ path whose
+launch geometry is hardcoded instead of flowing from the tuning-cache
+lookup helper (paddle_tpu.tune.kernel_config)."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_BLOCK = 256                     # frozen geometry: one device's tradeoff
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def hardcoded_launch(x):
+    n = x.shape[0]
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(n // _BLOCK,),
+        in_specs=[pl.BlockSpec((_BLOCK,), lambda i: (i,))],
+        out_specs=pl.BlockSpec((_BLOCK,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((n,), jnp.float32),
+    )(x)

@@ -50,6 +50,8 @@ def _lint_fix(name):
      "swallowed-exception", 9, "release_pages", ERROR),
     (os.path.join("inference", "fix_collective_outside_shard_map.py"),
      "collective-outside-shard-map", 11, "gather_logits", ERROR),
+    (os.path.join("pallas", "fix_untuned_launch.py"),
+     "untuned-pallas-launch", 15, "hardcoded_launch", WARNING),
 ])
 def test_ast_fixture_fires_exactly_once(fixture, rule, line, func, severity):
     findings = _lint_fix(fixture)
@@ -256,7 +258,7 @@ def test_every_catalog_rule_is_exercised():
         "numpy-in-jit", "host-sync-in-jit", "tracer-branch",
         "mutable-default-arg", "unkeyed-jit", "attention-program-budget",
         "quantized-kv-float32-page", "swallowed-exception",
-        "collective-outside-shard-map",
+        "collective-outside-shard-map", "untuned-pallas-launch",
         "undonated-buffer", "host-callback", "dtype-promotion",
         "dead-code", "dead-input", "passthrough-output",
     }

@@ -30,6 +30,16 @@ def test_serve_bench_smoke_emits_json_line():
     assert record["kv_bytes_resident"] >= 0
     assert record["peak_resident_seqs"] > 0
     assert record["degradation_tier_entries"] == 0
+    # tuning-cache provenance rides every mode's record: which configs
+    # this engine's four kernels actually traced with, and from where
+    tc = record["tuning_cache"]
+    assert set(tc["kernels"]) == {"flash_attention",
+                                  "flash_attention_varlen", "fused_norms",
+                                  "paged_attention"}
+    for info in tc["kernels"].values():
+        assert info["source"] in ("forced", "env", "exact", "bucket",
+                                  "default")
+        assert isinstance(info["config"], dict) and info["config"]
 
 
 def test_serve_bench_http_emits_frontend_surface():

@@ -61,10 +61,12 @@ for _ in range(3):
 reps.sort()
 n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
 tokps = reps[1]
+from paddle_tpu.tune import provenance_snapshot
 print(json.dumps({"tokens_per_sec": round(tokps, 1),
                   "reps": [round(r, 1) for r in reps],
                   "mfu": round(6.0 * n * tokps / 197e12, 4),
-                  "n_params": n}))
+                  "n_params": n,
+                  "tuning_cache": provenance_snapshot()}))
 """
 
 LEVERS = [
@@ -161,6 +163,7 @@ def main():
                             "reps": rec["reps"], "mfu": rec["mfu"],
                             "backend": "tpu", "config": f"ablation:{tag}",
                             "n_params": rec.get("n_params"),
+                            "tuning_cache": rec.get("tuning_cache"),
                             "time": stamp})
     # atomic replace: a mid-write tunnel death must not truncate the
     # committed evidence file

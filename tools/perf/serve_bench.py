@@ -188,6 +188,7 @@ def _mem_keys(engine):
         "kv_bytes_resident": engine.kv_bytes_resident(),
         "peak_resident_seqs": engine.peak_resident_seqs,
         "degradation_tier_entries": engine.degradation_tier_entries,
+        "tuning_cache": engine.summary()["tuning_cache"],
     }
 
 
