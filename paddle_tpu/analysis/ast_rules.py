@@ -595,6 +595,23 @@ def lint_source(text: str, path: str = "<string>") -> list:
                  "(paddle_tpu.tune.kernel_config) — hardcoded launch "
                  "geometry freezes one device's tradeoffs; resolve "
                  "block/grid choices through kernel_config")
+
+    # ---- wallclock-in-timing-path (inference + profiler tiers) -----------
+    # Timing contract: every duration in the serving and profiling tiers
+    # comes from a monotonic clock — Tracer spans are perf_counter_ns,
+    # ServingStats durations are perf_counter deltas, uptime is
+    # monotonic().  A `time.time()` in these files measures the
+    # NTP-adjustable wall clock: a slew mid-measurement makes the
+    # duration jump or go negative, silently corrupting latency stats.
+    if {"inference", "profiler"} & set(re.split(r"[\\/]", path)):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and _dotted(node.func) == ("time", "time"):
+                emit("wallclock-in-timing-path", node,
+                     "`time.time()` in a timing path — the wall clock is "
+                     "not monotonic (NTP slew makes durations jump or go "
+                     "negative); use time.perf_counter()/"
+                     "perf_counter_ns(), or time.monotonic() for uptime")
     return findings
 
 

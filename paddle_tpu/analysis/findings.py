@@ -109,6 +109,13 @@ RULES = {
         "device's tradeoffs into every device's launches; route the "
         "geometry through kernel_config so the autotuner's winners "
         "apply at trace time")),
+    "wallclock-in-timing-path": (WARNING, "ast", (
+        "a direct time.time() call in an inference/profiler-tier file — "
+        "the wall clock is NTP-adjustable and non-monotonic, so durations "
+        "computed from it can jump or go negative under clock slew; "
+        "timing paths use time.perf_counter()/perf_counter_ns() (the "
+        "clock every Tracer span and ServingStats reservoir is stamped "
+        "with), or time.monotonic() for coarse uptime")),
     "collective-outside-shard-map": (ERROR, "ast", (
         "a lax collective (psum/all_gather/ppermute/...) inside an "
         "inference-tier compiled def that is never routed through "

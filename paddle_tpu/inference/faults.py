@@ -70,6 +70,17 @@ class FaultPlan:
         self._pool_entered = False
         self._conn_drop = frozenset(int(i) for i in conn_drop_requests)
         self._stream_ordinal = 0
+        # step-timeline hook: the owning engine's set_tracer/set_fault_plan
+        # install these so every fired fault lands in the trace as an
+        # instant; None keeps each take_* at one extra attribute check
+        self.tracer = None
+        self.trace_track = "engine"
+
+    def _trace(self, kind: str, **args) -> None:
+        tr = self.tracer
+        if tr is not None:
+            args["step"] = self.step
+            tr.instant("fault." + kind, track=self.trace_track, args=args)
 
     @classmethod
     def seeded(cls, seed: int, *, n_crash: int = 1, n_nan: int = 1,
@@ -112,13 +123,16 @@ class FaultPlan:
         """True once per scheduled crash whose step has been reached."""
         if self._crash and self.step >= self._crash[0]:
             self._crash.pop(0)
+            self._trace("crash")
             return True
         return False
 
     def take_slow(self) -> float:
         """Sleep seconds for a due slow-step fault, else 0.0."""
         if self._slow and self.step >= self._slow[0][0]:
-            return self._slow.pop(0)[1]
+            dur = self._slow.pop(0)[1]
+            self._trace("slow", seconds=dur)
+            return dur
         return 0.0
 
     def take_nan_row(self, n_rows: int) -> int | None:
@@ -130,7 +144,9 @@ class FaultPlan:
         """
         if n_rows > 0 and self._nan and self.step >= self._nan[0]:
             self._nan.pop(0)
-            return self._rng.randrange(n_rows)
+            row = self._rng.randrange(n_rows)
+            self._trace("nan", row=row)
+            return row
         return None
 
     # -- pool seam ---------------------------------------------------------
@@ -148,6 +164,7 @@ class FaultPlan:
         (for fault-injection accounting)."""
         if not self._pool_entered and self.pool_exhausted():
             self._pool_entered = True
+            self._trace("pool", window=list(self.pool_window))
             return True
         return False
 
@@ -159,7 +176,10 @@ class FaultPlan:
         order."""
         i = self._stream_ordinal
         self._stream_ordinal += 1
-        return i in self._conn_drop
+        if i in self._conn_drop:
+            self._trace("conn", ordinal=i)
+            return True
+        return False
 
     # -- introspection -----------------------------------------------------
 

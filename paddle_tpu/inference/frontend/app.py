@@ -39,6 +39,7 @@ are the only cross-thread reads, and those surfaces lock internally.
 from __future__ import annotations
 
 import asyncio
+import json
 import math
 import threading
 
@@ -79,6 +80,10 @@ class ServingFrontend:
         the same surface either way.
     router_policy: "affinity" (default) | "least" | "random" — see
         router.py.  Ignored when replicas == 1.
+    tracer: optional ``profiler.Tracer`` for the step timeline; falls
+        back to the engine's own tracer so one ``set_tracer()`` on the
+        engine lights up all four tiers.  When set, ``GET /debug/trace``
+        serves the Chrome trace-event JSON.
     """
 
     def __init__(self, engine, *, model_name: str = "model",
@@ -86,21 +91,33 @@ class ServingFrontend:
                  max_pending: int | None = None,
                  default_deadline_s: float | None = None,
                  engine_factory=None, step_deadline_s: float | None = None,
-                 replicas: int = 1, router_policy: str = "affinity"):
+                 replicas: int = 1, router_policy: str = "affinity",
+                 tracer=None):
         self.model_name = str(model_name)
         self.host = host
         self.port = int(port)
         self.default_deadline_s = default_deadline_s
+        self.tracer = tracer if tracer is not None \
+            else getattr(engine, "tracer", None)
+        self._http_track = self.tracer.register("http") \
+            if self.tracer is not None else "http"
         if int(replicas) > 1:
             self.runner = ReplicaRouter(
                 build_replicas(engine, engine_factory, int(replicas),
                                max_pending=max_pending,
                                step_deadline_s=step_deadline_s),
-                policy=router_policy)
+                policy=router_policy, tracer=self.tracer)
         else:
             self.runner = EngineRunner(engine, max_pending=max_pending,
                                        engine_factory=engine_factory,
                                        step_deadline_s=step_deadline_s)
+        if self.tracer is not None:
+            # every replica engine records onto the SAME ring so one
+            # trace shows a request crossing http -> router -> runner ->
+            # engine with correlated ids
+            for e in getattr(self.runner, "engines", [self.runner.engine]):
+                if getattr(e, "tracer", None) is None:
+                    e.set_tracer(self.tracer)
         self._server = None
         self._writers: set = set()        # open connections, for shutdown
         self._lock = threading.Lock()
@@ -242,8 +259,22 @@ class ServingFrontend:
                 content_type="text/plain; version=0.0.4; charset=utf-8"))
             await writer.drain()
             return True
+        if route == ("GET", "/debug/trace"):
+            tr = self.tracer
+            if tr is None:
+                self._count("/debug/trace", 404)
+                writer.write(response_bytes(404, error_body(
+                    404, "tracing is not enabled on this server")))
+                await writer.drain()
+                return True
+            body = json.dumps(tr.chrome_trace()).encode("utf-8")
+            self._count("/debug/trace", 200)
+            writer.write(response_bytes(
+                200, body, content_type="application/json"))
+            await writer.drain()
+            return True
         status = 405 if req.path in ("/v1/completions", "/healthz",
-                                     "/metrics") else 404
+                                     "/metrics", "/debug/trace") else 404
         self._count(req.path, status)
         writer.write(response_bytes(
             status, error_body(status, f"no route {req.method} {req.path}"),
@@ -267,8 +298,16 @@ class ServingFrontend:
 
     async def _completions(self, req, reader, writer) -> bool:
         route = "/v1/completions"
+        # stackless now()/complete() here and below: an asyncio handler
+        # must never hold a span() across an await (coroutines interleave
+        # on one thread and would corrupt the per-thread span stack)
+        tr = self.tracer
         try:
+            t_parse = tr.now() if tr is not None else 0
             kwargs, stream, deadline_ms = parse_completion_request(req.body)
+            if tr is not None:
+                tr.complete("http.parse", t_parse, track=self._http_track,
+                            args={"bytes": len(req.body or b"")})
         except ProtocolError as e:
             self._count(route, 400)
             writer.write(response_bytes(400, error_body(400, str(e))))
@@ -323,6 +362,9 @@ class ServingFrontend:
             await writer.drain()
             return False
 
+        if tr is not None:
+            tr.instant("http.request", track=self._http_track,
+                       args={"request_id": request_id, "stream": stream})
         if stream:
             plan = getattr(self.engine, "fault_plan", None)
             inject_drop = plan is not None and plan.take_conn_drop()
@@ -355,6 +397,7 @@ class ServingFrontend:
     async def _stream_response(self, request_id, q, reader, writer,
                                inject_drop: bool = False) -> bool:
         route = "/v1/completions"
+        tr = self.tracer
         sse = SSEWriter(writer)
         with self._lock:
             self._active_streams += 1
@@ -376,8 +419,14 @@ class ServingFrontend:
                 else:
                     kind, payload = q.get_nowait()
                 if kind == "token":
+                    t_w = tr.now() if tr is not None else 0
                     await sse.event(stream_token_frame(
                         request_id, self.model_name, payload))
+                    if tr is not None:
+                        tr.complete("http.sse_write", t_w,
+                                    track=self._http_track,
+                                    args={"request_id": request_id,
+                                          "kind": "token"})
                     if inject_drop:
                         # injected mid-stream disconnect: behave exactly
                         # like the client vanished after this frame
@@ -385,9 +434,15 @@ class ServingFrontend:
                         self.runner.abort(request_id, reason="aborted")
                         return False
                 else:
+                    t_w = tr.now() if tr is not None else 0
                     await sse.event(stream_finish_frame(
                         request_id, self.model_name, payload))
                     await sse.done()
+                    if tr is not None:
+                        tr.complete("http.sse_write", t_w,
+                                    track=self._http_track,
+                                    args={"request_id": request_id,
+                                          "kind": "finish"})
                     return True
         except (ConnectionError, asyncio.IncompleteReadError):
             self.runner.abort(request_id, reason="aborted")

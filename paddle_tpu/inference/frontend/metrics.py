@@ -42,6 +42,21 @@ class _Doc:
             sval = repr(int(v)) if v == int(v) else repr(v)
             self.lines.append(f"{full}{lbl} {sval}")
 
+    def histogram(self, name, help_text, buckets, total, count):
+        """One true Prometheus histogram: cumulative ``_bucket{le=}``
+        samples (ascending, ending at +Inf) plus ``_sum``/``_count``.
+        ``buckets`` is the ``_Hist.buckets()`` dict — already cumulative
+        and insertion-ordered by upper bound."""
+        full = f"{_PREFIX}_{name}"
+        self.lines.append(f"# HELP {full} {help_text}")
+        self.lines.append(f"# TYPE {full} histogram")
+        for le, n in buckets.items():
+            self.lines.append(f'{full}_bucket{{le="{_esc(le)}"}} {int(n)}')
+        v = float(total)
+        sval = repr(int(v)) if v == int(v) else repr(v)
+        self.lines.append(f"{full}_sum {sval}")
+        self.lines.append(f"{full}_count {int(count)}")
+
     def render(self) -> str:
         return "\n".join(self.lines) + "\n"
 
@@ -111,6 +126,23 @@ def render_metrics(snapshot: dict, *, engine=None,
     d.metric("generated_tokens_total", "counter",
              "Tokens emitted by the engine.",
              [(None, s.get("decode_tokens"))])
+
+    # -- latency histograms ----------------------------------------------
+    # exact-count cumulative-bucket series next to the quantile gauges
+    # above: buckets with identical bounds SUM across replicas/scrapes,
+    # so these aggregate honestly where max-of-quantile gauges cannot
+    for key, name, help_text in (
+            ("ttft_hist", "ttft_hist_seconds",
+             "Time to first token, as cumulative histogram buckets."),
+            ("itl_hist", "itl_hist_seconds",
+             "Inter-token latency, as cumulative histogram buckets."),
+            ("step_hist", "step_duration_seconds",
+             "Engine launch-cycle wall-clock duration, as cumulative "
+             "histogram buckets.")):
+        buckets = s.get(f"{key}_buckets")
+        if buckets:
+            d.histogram(name, help_text, buckets,
+                        s.get(f"{key}_sum", 0.0), s.get(f"{key}_count", 0))
 
     # -- fault tolerance --------------------------------------------------
     d.metric("engine_restarts_total", "counter",

@@ -72,7 +72,7 @@ class ReplicaRouter:
     """
 
     def __init__(self, runners, *, policy: str = "affinity",
-                 registry_cap: int = 8192, seed: int = 0):
+                 registry_cap: int = 8192, seed: int = 0, tracer=None):
         if not runners:
             raise ValueError("need at least one replica runner")
         if policy not in _POLICIES:
@@ -95,6 +95,10 @@ class ReplicaRouter:
         # per-replica LRU of page chain hashes routed there
         self._registry = [OrderedDict() for _ in range(n)]
         self._block_size = self.runners[0].engine.block_size
+        # step-timeline hook: pick latency + affinity outcome per route
+        self.tracer = tracer
+        self._trace_track = tracer.register("router") \
+            if tracer is not None else "router"
 
     # ------------------------------------------------------------------
     # EngineRunner surface
@@ -138,8 +142,14 @@ class ReplicaRouter:
         cost = len(toks) + int(params.get("max_new_tokens", 32))
         hashes = prefix_chain_hashes(toks, self._block_size) \
             if self.policy == "affinity" else []
+        tr = self.tracer
         with self._lock:
+            t_pick = tr.now() if tr is not None else 0
             idx, hit = self._pick(hashes)
+            if tr is not None:
+                tr.complete("router.pick", t_pick, track=self._trace_track,
+                            args={"replica": idx, "policy": self.policy,
+                                  "prefix_pages": len(hashes)})
             # credit BEFORE the replica's submit: the engine thread can
             # deliver the terminal event (and settle) before submit
             # returns, and later _pick calls must see this request's
@@ -167,9 +177,19 @@ class ReplicaRouter:
             _deliver(ev)
 
         try:
-            return self.runners[idx].submit(
+            rid = self.runners[idx].submit(
                 toks, deliver=deliver_wrapped, deadline_s=deadline_s,
                 **params)
+            if tr is not None:
+                tr.instant(
+                    "router.affinity_hit" if hit
+                    else "router.affinity_miss",
+                    track=self._trace_track,
+                    args={"replica": idx, "request_id": rid})
+                tr.instant("router.routed", track=self._trace_track,
+                           args={"replica": idx, "request_id": rid,
+                                 "cost_tokens": cost})
+            return rid
         except Exception:
             with self._lock:
                 self._outstanding[idx] -= cost
@@ -279,10 +299,14 @@ class ReplicaRouter:
         return max(vals) / mean if mean > 0 else 0.0
 
     def stats_snapshot(self) -> dict:
-        """Aggregated ServingStats snapshot across every replica."""
+        """Aggregated ServingStats snapshot across every replica.
+        Snapshots carry their reservoir samples so the fleet's latency
+        percentiles are recomputed over the pooled union rather than
+        reported as a max-of-quantiles bound."""
         from ...profiler import ServingStats
         return ServingStats.aggregate(
-            [r.engine.stats.snapshot() for r in self.runners])
+            [r.engine.stats.snapshot(include_samples=True)
+             for r in self.runners])
 
 
 def build_replicas(engine, engine_factory, n: int, *,

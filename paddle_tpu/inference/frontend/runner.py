@@ -163,6 +163,18 @@ class EngineRunner:
                                         name=tname, daemon=True)
         self._watchdog = None
         self._started = False
+        # step-timeline track, registered lazily on first traced event
+        # (the engine owns the Tracer; a rebuilt engine keeps it via the
+        # factory, so delivery/restart events survive recovery)
+        self._trace_track = None
+
+    def _tracer(self):
+        """The live engine's Tracer, or None (the zero-cost default)."""
+        tr = getattr(self.engine, "tracer", None)
+        if tr is not None and self._trace_track is None:
+            base = f"runner-{self.name}" if self.name else "runner"
+            self._trace_track = tr.register(base)
+        return tr
 
     @property
     def restarts(self) -> int:
@@ -294,6 +306,12 @@ class EngineRunner:
             h.deliver(("finish", out))
         except Exception:
             pass                      # a dead consumer must not kill the loop
+        tr = self._tracer()
+        if tr is not None:
+            tr.instant("runner.finish", track=self._trace_track,
+                       args={"request_id": h.request_id, "rid": h.rid,
+                             "finish_reason": getattr(
+                                 out, "finish_reason", None)})
 
     def _admit_one(self, eng, h, gen: int, generated=None) -> bool:
         """Admit one handle into ``eng`` with generation-guarded
@@ -313,6 +331,12 @@ class EngineRunner:
                     h.deliver(("token", tok))
                 except Exception:
                     pass
+            tr = self._tracer()
+            if tr is not None:
+                # the cross-tier join point: engine rid <-> frontend id
+                tr.instant("runner.deliver", track=self._trace_track,
+                           args={"request_id": h.request_id, "rid": rid,
+                                 "tokens": len(h.emitted)})
 
         def _on_finish(out, h=h, g=gen):
             self._finish_handle(h, out, gen=g)
@@ -400,6 +424,9 @@ class EngineRunner:
             requeue = [h for h in live
                        if h.rid < 0 and h not in self._inbox]
         old = self.engine
+        tr = self._tracer()
+        if tr is not None:
+            t_rec = tr.now()
         if self._engine_factory is None or restarts > self.max_restarts:
             from ..serving import RequestOutput
             for h in live:
@@ -448,6 +475,10 @@ class EngineRunner:
             for h in requeue:        # popped from the inbox mid-crash
                 self._inbox.append(h)
         self._wake.set()
+        if tr is not None:
+            tr.complete("runner.restart", t_rec, track=self._trace_track,
+                        args={"gen": newgen, "restarts": restarts,
+                              "replayed": len(replay)})
         return newgen
 
     def _watch(self) -> None:
@@ -464,6 +495,12 @@ class EngineRunner:
             ss = self._step_started
             if ss is not None and ss[0] == gen \
                     and time.monotonic() - ss[1] > self.step_deadline_s:
+                tr = self._tracer()
+                if tr is not None:
+                    tr.instant("runner.watchdog_fired",
+                               track=self._trace_track,
+                               args={"gen": gen, "stuck_s": round(
+                                   time.monotonic() - ss[1], 3)})
                 newgen = self._recover(gen)
                 if newgen is not None:
                     t = threading.Thread(target=self._loop, args=(newgen,),

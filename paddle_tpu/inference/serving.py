@@ -212,7 +212,8 @@ class LLMEngine:
                  spec_accept_floor: float = 0.35, spec_window: int = 32,
                  retain_outputs: bool = True,
                  fault_plan=None, pressure=None,
-                 kv_dtype: str = "float32", tp: int = 1):
+                 kv_dtype: str = "float32", tp: int = 1,
+                 tracer=None):
         cfg = model.config
         self.config = cfg
         self.params = model.decode_params()
@@ -359,6 +360,12 @@ class LLMEngine:
         self._evictions_seen = 0
         self.peak_resident_seqs = 0
         self.stats = ServingStats()
+        # step-timeline tracer (profiler/trace.py): None means every
+        # instrumentation seam is one attribute check and nothing else —
+        # the same zero-cost contract the fault plan keeps
+        self.tracer = None
+        self._trace_track = "engine"
+        self._trace_steps = 0
         # resolve this engine's launch geometry from the tuning cache
         # once at build — pure host-side dict reads (no compile) whose
         # provenance summary() and serve_bench records surface
@@ -371,6 +378,7 @@ class LLMEngine:
         self.fault_plan = None
         self.set_fault_plan(fault_plan)
         self.pressure = pressure
+        self.set_tracer(tracer)
 
     def set_fault_plan(self, plan) -> None:
         """Install (or clear) a FaultPlan on this engine and its pool.
@@ -379,6 +387,30 @@ class LLMEngine:
         self.fault_plan = plan
         self.blocks._fault_hook = plan.pool_exhausted \
             if plan is not None else None
+        if plan is not None:
+            plan.tracer = self.tracer
+            plan.trace_track = self._trace_track
+
+    def set_tracer(self, tracer) -> None:
+        """Install (or clear) a step-timeline Tracer on this engine (and
+        on its fault plan, so injected faults land in the trace).  With
+        None installed the step loop performs no trace work at all."""
+        self.tracer = tracer
+        if tracer is not None:
+            self._trace_track = tracer.register("engine")
+        if self.fault_plan is not None:
+            self.fault_plan.tracer = tracer
+            self.fault_plan.trace_track = self._trace_track
+
+    def dump_trace(self, path) -> int:
+        """Write this engine's step timeline as Chrome trace-event JSON
+        (Perfetto-loadable); returns the number of events written.
+        Raises when tracing was never enabled."""
+        if self.tracer is None:
+            raise RuntimeError(
+                "tracing is not enabled: build the engine with tracer= "
+                "or call set_tracer() first")
+        return self.tracer.dump(path)
 
     # ------------------------------------------------------------------
     # tensor-parallel layout (tp > 1)
@@ -502,6 +534,15 @@ class LLMEngine:
             req.seen[prompt] = True
             req.seen[generated] = True
         self._waiting.append(req)
+        tr = self.tracer
+        if tr is not None:
+            tr.async_begin("req", f"{self._trace_track}:{rid}",
+                           args={"rid": rid,
+                                 "prompt_tokens": len(prompt),
+                                 "replayed": len(generated),
+                                 "max_new_tokens": int(max_new_tokens)})
+            tr.instant("request.queued", track=self._trace_track,
+                       args={"rid": rid})
         return rid
 
     def has_unfinished(self) -> bool:
@@ -558,6 +599,11 @@ class LLMEngine:
         if self.retain_outputs:
             self._finished[req.rid] = out
         self.stats.record_abort(finish_reason)
+        tr = self.tracer
+        if tr is not None:
+            tr.async_end("req", f"{self._trace_track}:{req.rid}",
+                         args={"finish_reason": finish_reason,
+                               "generated": len(req.generated)})
         if req.on_finish is not None:
             req.on_finish(out)
         return out
@@ -765,7 +811,24 @@ class LLMEngine:
     def step(self) -> list:
         """One engine iteration: admit -> schedule (prefill chunks +
         verify windows + decode tokens) -> ONE ragged launch -> apply ->
-        retire.  Returns the requests that finished during this step."""
+        retire.  Returns the requests that finished during this step.
+
+        With a tracer installed every phase lands in the step timeline
+        (admit / schedule / pack / block-table stage / device launch /
+        block-on-result / sample-commit / retire); with none the phase
+        seams are single attribute checks."""
+        tr = self.tracer
+        if tr is None:
+            return self._step(None)
+        self._trace_steps += 1
+        t0 = tr.now()
+        finished = self._step(tr)
+        tr.complete("engine.step", t0, track=self._trace_track,
+                    args={"step": self._trace_steps,
+                          "finished": len(finished)})
+        return finished
+
+    def _step(self, tr) -> list:
         finished = []
 
         plan = self.fault_plan
@@ -793,15 +856,24 @@ class LLMEngine:
                 if n:
                     self.stats.record_parked_evictions(n)
 
+        if tr is not None:
+            t = tr.now()
         admitted = self._admit()
         if admitted:
             self.stats.record_admission(len(admitted))
+        if tr is not None:
+            tr.complete("engine.admit", t, track=self._trace_track,
+                        args={"admitted": len(admitted),
+                              "running": len(self._running),
+                              "waiting": len(self._waiting)})
         self.peak_resident_seqs = max(self.peak_resident_seqs,
                                       len(self._running))
         self.stats.record_prefill_queue(
             sum(1 for r in self._running if r.cached < len(r.tokens))
             + len(self._waiting))
 
+        if tr is not None:
+            t = tr.now()
         chunks = self._schedule_prefill_chunks()
 
         # decode-ready set (chunk owners are still mid-prefill, so the
@@ -823,6 +895,10 @@ class LLMEngine:
         spec = [(r, d, q) for r, d, q in spec if r in self._running]
         batch = [r for r in batch if r in self._running]
         batch.sort(key=lambda r: r.slot)
+        if tr is not None:
+            tr.complete("engine.schedule", t, track=self._trace_track,
+                        args={"chunks": len(chunks), "spec": len(spec),
+                              "decode": len(batch)})
 
         if chunks or spec or batch:
             t0 = time.perf_counter()
@@ -830,9 +906,16 @@ class LLMEngine:
                 sampled, ok, spec_ok, spec_logits, chunk_slots, \
                     batch_slots = self._run_ragged(chunks, spec, batch)
             dur = time.perf_counter() - t0
+            self.stats.record_step(dur)
+            if tr is not None:
+                t = tr.now()
             self._apply_ragged(chunks, spec, batch, sampled, ok, spec_ok,
                                spec_logits, chunk_slots, batch_slots,
                                dur, finished)
+            if tr is not None:
+                tr.complete("engine.sample_commit", t,
+                            track=self._trace_track,
+                            args={"finished": len(finished)})
 
         ev = self.blocks.eviction_count
         if ev != self._evictions_seen:
@@ -858,6 +941,7 @@ class LLMEngine:
         spec_tokens = sum(len(d) + 1 for _, d, _ in spec)
         total = max(chunk_tokens + spec_tokens + len(batch), 1)
         occ = len(self._running) / self.max_num_seqs
+        tr = self.tracer
 
         done = 0
         for (req, n), s in zip(chunks, chunk_slots):
@@ -867,6 +951,11 @@ class LLMEngine:
             req.cached += n
             if self.enable_prefix_caching:
                 self.blocks.commit_prefill(req.rid, n)
+            if tr is not None:
+                tr.instant("request.prefill_chunk",
+                           track=self._trace_track,
+                           args={"rid": req.rid, "tokens": n,
+                                 "done": req.cached >= len(req.tokens)})
             if req.cached == len(req.tokens):
                 done += 1
                 tok = int(sampled[s])
@@ -876,6 +965,10 @@ class LLMEngine:
                 if len(req.generated) == 1:
                     self.stats.record_ttft(
                         time.perf_counter() - req.t_arrival)
+                    if tr is not None:
+                        tr.instant("request.first_token",
+                                   track=self._trace_track,
+                                   args={"rid": req.rid})
                 self._notify_tokens(req, (tok,))
                 self._maybe_retire(req, finished)
         if chunks:
@@ -934,6 +1027,12 @@ class LLMEngine:
         finished.append(out)
         self.stats.record_quarantine()
         self.stats.record_abort("numerical_error")
+        tr = self.tracer
+        if tr is not None:
+            tr.instant("engine.quarantine", track=self._trace_track,
+                       args={"rid": req.rid})
+            tr.async_end("req", f"{self._trace_track}:{req.rid}",
+                         args={"finish_reason": "numerical_error"})
         if req.on_finish is not None:
             req.on_finish(out)
 
@@ -1073,6 +1172,10 @@ class LLMEngine:
         if self.drafter is not None:
             self.drafter.release(req.rid)
         self.stats.record_preemption()
+        if self.tracer is not None:
+            self.tracer.instant("request.preempted",
+                                track=self._trace_track,
+                                args={"rid": req.rid})
 
     def _maybe_retire(self, req, finished: list) -> None:
         eos = req.eos_token_id
@@ -1082,6 +1185,9 @@ class LLMEngine:
             reason = "length"
         else:
             return
+        tr = self.tracer
+        if tr is not None:
+            t = tr.now()
         self.blocks.free(req.rid)
         self._running.remove(req)
         self._release_slot(req)
@@ -1094,6 +1200,12 @@ class LLMEngine:
         if self.drafter is not None:
             self.drafter.release(req.rid)
         self.stats.record_retirement()
+        if tr is not None:
+            tr.complete("engine.retire", t, track=self._trace_track,
+                        args={"rid": req.rid, "finish_reason": reason})
+            tr.async_end("req", f"{self._trace_track}:{req.rid}",
+                         args={"finish_reason": reason,
+                               "generated": len(req.generated)})
         if req.on_finish is not None:
             req.on_finish(out)
 
@@ -1621,6 +1733,9 @@ class LLMEngine:
         samp = make_samp(self._Lq, self.config.vocab_size)
         spec_slices, chunk_slots, batch_slots = [], [], []
 
+        tr = self.tracer
+        if tr is not None:
+            t = tr.now()
         off = 0      # flat-token cursor
         ls = 0       # logit-row cursor
         for i, (req, window, kind) in enumerate(rows):
@@ -1628,7 +1743,6 @@ class LLMEngine:
             toks[off:off + n] = window
             cu[i + 1] = off + n
             kvl[i] = req.cached + n
-            bt[i] = self.blocks.padded_table(req.rid, self.nblk)
             if kind == "s":
                 # every window position is scored; acceptance is
                 # sequential on host, so the device-sampled rows for
@@ -1643,6 +1757,17 @@ class LLMEngine:
                 ls += 1
             off += n
         cu[len(rows) + 1:] = off
+        if tr is not None:
+            tr.complete("engine.pack", t, track=self._trace_track,
+                        args={"rows": len(rows), "tokens": total,
+                              "bucket": int(Tq)})
+            t = tr.now()
+        for i, (req, _w, _k) in enumerate(rows):
+            bt[i] = self.blocks.padded_table(req.rid, self.nblk)
+        if tr is not None:
+            tr.complete("engine.block_table_stage", t,
+                        track=self._trace_track,
+                        args={"rows": len(rows)})
 
         # padding a four-program step would have cost: a token-bucketed
         # chunk launch, plus the full-width verify launch when anything
@@ -1663,17 +1788,29 @@ class LLMEngine:
             req.bt_version = -1
         self._d_layout = ()
 
+        if tr is not None:
+            t = tr.now()
         sampled, logits, fin = self._launch_ragged(Tq, toks, cu, kvl, bt,
                                                    lidx, samp, total)
+        if tr is not None:
+            tr.complete("engine.device_launch", t,
+                        track=self._trace_track,
+                        args={"bucket": int(Tq)})
+            t = tr.now()
+        sampled = np.asarray(sampled)
         ok = np.asarray(fin)
+        if spec:
+            logits = np.asarray(logits)
+        if tr is not None:
+            tr.complete("engine.block_on_result", t,
+                        track=self._trace_track)
         ok = self._inject_nan(ok, chunk_slots + batch_slots
                               + [o for o, _ in spec_slices])
         spec_ok = [bool(ok[o:o + n].all()) for o, n in spec_slices]
         spec_logits = None
         if spec:
-            logits = np.asarray(logits)
             spec_logits = [logits[o:o + n] for o, n in spec_slices]
-        return (np.asarray(sampled), ok, spec_ok, spec_logits,
+        return (sampled, ok, spec_ok, spec_logits,
                 chunk_slots, batch_slots)
 
     def _run_ragged_decode(self, batch: list, Tq: int):
@@ -1705,25 +1842,49 @@ class LLMEngine:
                 samp["top_p"][s] = req.top_p
                 samp["penalty"][s] = req.repetition_penalty
                 req.bt_version = -1          # force a table repack below
+        tr = self.tracer
+        if tr is not None:
+            t = tr.now()
         for s, req in enumerate(batch):
             self._d_toks[s] = req.generated[-1]
             self._d_kvl[s] = req.cached + 1
+            if req.seen is not None:
+                np.copyto(samp["seen"][s], req.seen)
+            if req.temperature > 0.0:
+                samp["keys"][s] = self._req_key(req)
+        if tr is not None:
+            tr.complete("engine.pack", t, track=self._trace_track,
+                        args={"rows": n, "tokens": n, "bucket": int(Tq),
+                              "fast_path": True})
+            t = tr.now()
+        for s, req in enumerate(batch):
             ver = self.blocks.table_version(req.rid)
             if req.bt_version != ver:
                 self._d_bt[s] = self.blocks.padded_table(req.rid,
                                                          self.nblk)
                 req.bt_version = ver
-            if req.seen is not None:
-                np.copyto(samp["seen"][s], req.seen)
-            if req.temperature > 0.0:
-                samp["keys"][s] = self._req_key(req)
+        if tr is not None:
+            tr.complete("engine.block_table_stage", t,
+                        track=self._trace_track, args={"rows": n})
         self.pad_stats["legacy_padded"] += self.max_num_seqs
+        if tr is not None:
+            t = tr.now()
         sampled, _, fin = self._launch_ragged(Tq, self._d_toks,
                                               self._d_cu, self._d_kvl,
                                               self._d_bt, self._d_lidx,
                                               samp, n)
-        ok = self._inject_nan(np.asarray(fin), list(range(n)))
-        return np.asarray(sampled), ok, [], None, [], list(range(n))
+        if tr is not None:
+            tr.complete("engine.device_launch", t,
+                        track=self._trace_track,
+                        args={"bucket": int(Tq)})
+            t = tr.now()
+        sampled = np.asarray(sampled)
+        fin = np.asarray(fin)
+        if tr is not None:
+            tr.complete("engine.block_on_result", t,
+                        track=self._trace_track)
+        ok = self._inject_nan(fin, list(range(n)))
+        return sampled, ok, [], None, [], list(range(n))
 
     def _inject_nan(self, ok, live_slots: list):
         """FaultPlan NaN seam: corrupt one LIVE logit row's finiteness

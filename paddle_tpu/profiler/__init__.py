@@ -15,11 +15,12 @@ from .profiler import (  # noqa: F401
 )
 from .serving import ServingStats  # noqa: F401
 from .timer import benchmark  # noqa: F401
+from .trace import Tracer  # noqa: F401
 
 __all__ = [
     "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
     "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-    "benchmark", "ServingStats",
+    "benchmark", "ServingStats", "Tracer",
 ]
 
 

@@ -22,6 +22,7 @@ frontend thread can snapshot while the engine thread records.
 """
 from __future__ import annotations
 
+import bisect
 import random
 import threading
 import time
@@ -88,11 +89,62 @@ class _Reservoir:
             vals = sorted(self._sample)
         return _percentile(vals, q)
 
+    def samples(self) -> list:
+        """Copy of the current sample — the fleet aggregator pools these
+        across replicas and recomputes percentiles over the union."""
+        with self._lock:
+            return list(self._sample)
+
     def mean(self) -> float:
         return self.total / self.count if self.count else 0.0
 
     def __len__(self) -> int:
         return self.count
+
+
+# Prometheus-style latency bucket bounds in SECONDS — one shared ladder
+# for TTFT/ITL/step-duration so fleet aggregation can sum bucket counts
+# replica-by-replica (cumulative counts with identical bounds add).
+_HIST_BOUNDS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class _Hist:
+    """Fixed-bound latency histogram (exact counts, unlike the
+    reservoirs): per-bucket tallies plus total sum/count, rendered on
+    ``/metrics`` as a real Prometheus histogram series (``_bucket{le=}``
+    cumulative counts + ``_sum`` + ``_count``) next to the quantile
+    gauges.  ``le`` is inclusive, matching Prometheus semantics."""
+
+    __slots__ = ("bounds", "_counts", "total", "count", "_lock")
+
+    def __init__(self, bounds=_HIST_BOUNDS):
+        self.bounds = tuple(float(b) for b in bounds)
+        self._counts = [0] * (len(self.bounds) + 1)   # last = +Inf
+        self.total = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def add(self, value: float, n: int = 1) -> None:
+        v = float(value)
+        n = int(n)
+        i = bisect.bisect_left(self.bounds, v)   # v <= bounds[i] -> bucket i
+        with self._lock:
+            self._counts[i] += n
+            self.count += n
+            self.total += v * n
+
+    def buckets(self) -> dict:
+        """Cumulative counts keyed by upper bound ("0.005" ... "+Inf")."""
+        with self._lock:
+            counts = list(self._counts)
+        out: dict = {}
+        c = 0
+        for b, n in zip(self.bounds, counts):
+            c += n
+            out[f"{b:g}"] = c
+        out["+Inf"] = c + counts[-1]
+        return out
 
 
 class ServingStats:
@@ -160,6 +212,13 @@ class ServingStats:
         # merges dict values by int addition)
         self.tuning_hits: dict = {}      # kernel -> cache-hit lookups
         self.tuning_misses: dict = {}    # kernel -> default/env fallbacks
+        # observability surface (PR 11): exact-count histograms beside
+        # the reservoir quantiles, and whole-step wall-clock accounting
+        self._ttft_hist = _Hist()
+        self._itl_hist = _Hist()
+        self._step_hist = _Hist()
+        self.engine_steps = 0            # LLMEngine.step launch cycles
+        self.step_time = 0.0
         self._t_start = time.monotonic() # process-lifetime uptime anchor
 
     # -- recording (engine-facing) ------------------------------------------
@@ -171,6 +230,7 @@ class ServingStats:
         self.prefill_time += float(duration_s)
         # each sequence's first token comes out of the prefill step
         self._token_lat.extend(float(duration_s), int(n_seqs))
+        self._itl_hist.add(float(duration_s), int(n_seqs))
 
     def record_decode(self, duration_s: float, n_tokens: int,
                       occupancy: float) -> None:
@@ -178,7 +238,16 @@ class ServingStats:
         self.decode_tokens += int(n_tokens)
         self.decode_time += float(duration_s)
         self._token_lat.extend(float(duration_s), int(n_tokens))
+        self._itl_hist.add(float(duration_s), int(n_tokens))
         self._occupancy.add(float(occupancy))
+
+    def record_step(self, duration_s: float) -> None:
+        """One launch cycle's wall-clock duration — the whole
+        pack/stage/launch/sync section regardless of phase mix."""
+        d = float(duration_s)
+        self.engine_steps += 1
+        self.step_time += d
+        self._step_hist.add(d)
 
     def record_admission(self, n: int = 1) -> None:
         self.admitted += int(n)
@@ -214,6 +283,7 @@ class ServingStats:
 
     def record_ttft(self, duration_s: float) -> None:
         self._ttft.add(float(duration_s))
+        self._ttft_hist.add(float(duration_s))
 
     def record_verify(self, duration_s: float, n_tokens: int,
                       occupancy: float) -> None:
@@ -230,6 +300,7 @@ class ServingStats:
         self.verify_time += float(duration_s)
         self.verify_tokens += int(n_tokens)
         self._token_lat.extend(float(duration_s), int(n_tokens))
+        self._itl_hist.add(float(duration_s), int(n_tokens))
         self._occupancy.add(float(occupancy))
 
     def record_spec(self, *, proposed: int, accepted: int, emitted: int,
@@ -323,13 +394,19 @@ class ServingStats:
         return self.draft_accepted / self.draft_proposed \
             if self.draft_proposed else 0.0
 
-    def snapshot(self) -> dict:
+    def snapshot(self, include_samples: bool = False) -> dict:
         """Point-in-time view of every counter and on-demand percentile.
         The ONE read surface: the frontend's ``/metrics`` endpoint and
         serve_bench both render this dict.  Safe to call from a thread
         other than the recording one (reservoirs lock internally;
-        counters are plain ints read atomically under the GIL)."""
-        return {
+        counters are plain ints read atomically under the GIL).
+
+        ``include_samples=True`` additionally attaches the raw latency
+        reservoir samples under ``"_samples"`` so ``aggregate()`` can
+        recompute fleet percentiles over the pooled union instead of
+        falling back to the worst replica's quantile.  The key is
+        underscore-prefixed and stripped by the metrics renderer."""
+        out = {
             "prefill_steps": self.prefill_steps,
             "prefill_tokens": self.prefill_tokens,
             "decode_steps": self.decode_steps,
@@ -379,7 +456,22 @@ class ServingStats:
             "parked_evictions": self.parked_evictions,
             "tuning_cache_hits": dict(self.tuning_hits),
             "tuning_cache_misses": dict(self.tuning_misses),
+            "engine_steps": self.engine_steps,
+            "step_time_s": round(self.step_time, 6),
+            "ttft_hist_buckets": self._ttft_hist.buckets(),
+            "ttft_hist_sum": self._ttft_hist.total,
+            "ttft_hist_count": self._ttft_hist.count,
+            "itl_hist_buckets": self._itl_hist.buckets(),
+            "itl_hist_sum": self._itl_hist.total,
+            "itl_hist_count": self._itl_hist.count,
+            "step_hist_buckets": self._step_hist.buckets(),
+            "step_hist_sum": self._step_hist.total,
+            "step_hist_count": self._step_hist.count,
         }
+        if include_samples:
+            out["_samples"] = {"token_lat": self._token_lat.samples(),
+                               "ttft": self._ttft.samples()}
+        return out
 
     # summary() predates snapshot() and is the name the engine/benches
     # grew up with; both return the same dict
@@ -396,10 +488,14 @@ class ServingStats:
     #             would misweight replicas
     #   _THROUGH  summed: replicas run in parallel, fleet tokens/s is
     #             the sum of per-replica tokens/s
-    #   _MAX      worst replica wins — latency percentiles cannot be
-    #             recombined from per-replica reservoirs, so the fleet
-    #             reports the conservative bound; degradation_state and
-    #             uptime likewise describe the worst/oldest member
+    #   _MAX      worst replica wins — the FALLBACK for latency
+    #             percentiles when snapshots carry no reservoir samples
+    #             (when every snapshot was taken with
+    #             include_samples=True the percentiles are instead
+    #             recomputed over the pooled sample union — honest
+    #             fleet quantiles, not a max-of-quantiles bound);
+    #             degradation_state and uptime always describe the
+    #             worst/oldest member
     #   _MEAN     unweighted mean across replicas (occupancy/queue depth
     #             are already per-engine means)
     _RATE = ("prefix_hit_rate", "accept_rate")
@@ -422,6 +518,8 @@ class ServingStats:
             raise ValueError("aggregate() needs at least one snapshot")
         out: dict = {}
         for key in snaps[0]:
+            if key == "_samples":
+                continue                         # pooled below, never summed
             vals = [s[key] for s in snaps]
             if isinstance(vals[0], dict):        # abort_reasons, fault_injections
                 merged: dict = {}
@@ -445,5 +543,18 @@ class ServingStats:
         out["accept_rate"] = round(
             out["draft_accepted"] / out["draft_proposed"], 4) \
             if out["draft_proposed"] else 0.0
+        if all("_samples" in s for s in snaps):
+            # honest fleet quantiles: pool every replica's reservoir
+            # sample and recompute, replacing the max-of-quantiles
+            # fallback written by the _MAX pass above
+            tok = sorted(v for s in snaps
+                         for v in s["_samples"]["token_lat"])
+            ttft = sorted(v for s in snaps for v in s["_samples"]["ttft"])
+            for q in (50, 99):
+                out[f"p{q}_token_ms"] = round(
+                    1e3 * _percentile(tok, q), 3)
+                out[f"itl_p{q}_ms"] = out[f"p{q}_token_ms"]
+                out[f"ttft_p{q}_ms"] = round(
+                    1e3 * _percentile(ttft, q), 3)
         out["replicas"] = len(snaps)
         return out

@@ -1,0 +1,18 @@
+"""Seeds wallclock-in-timing-path: a time.time() duration anchor in an
+inference-tier file — the wall clock is NTP-adjustable, so a duration
+measured from it can jump or go negative under clock slew."""
+import time
+
+
+def measure_step(engine):
+    start = time.time()
+    engine.step()
+    return start
+
+
+def measure_step_monotonic(engine):
+    # the sanctioned clocks: perf_counter for durations, monotonic for
+    # coarse uptime — neither fires
+    t0 = time.perf_counter()
+    engine.step()
+    return time.perf_counter() - t0, time.monotonic()

@@ -50,6 +50,8 @@ def _lint_fix(name):
      "swallowed-exception", 9, "release_pages", ERROR),
     (os.path.join("inference", "fix_collective_outside_shard_map.py"),
      "collective-outside-shard-map", 11, "gather_logits", ERROR),
+    (os.path.join("inference", "fix_wallclock_timing.py"),
+     "wallclock-in-timing-path", 8, "measure_step", WARNING),
     (os.path.join("pallas", "fix_untuned_launch.py"),
      "untuned-pallas-launch", 15, "hardcoded_launch", WARNING),
 ])
@@ -259,6 +261,7 @@ def test_every_catalog_rule_is_exercised():
         "mutable-default-arg", "unkeyed-jit", "attention-program-budget",
         "quantized-kv-float32-page", "swallowed-exception",
         "collective-outside-shard-map", "untuned-pallas-launch",
+        "wallclock-in-timing-path",
         "undonated-buffer", "host-callback", "dtype-promotion",
         "dead-code", "dead-input", "passthrough-output",
     }

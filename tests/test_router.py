@@ -223,15 +223,80 @@ def test_stats_aggregate_semantics(model):
     agg = ServingStats.aggregate([s, s])
     assert agg["replicas"] == 2
     assert agg["retired"] == 2 * s["retired"]                  # counters sum
-    assert agg["p50_token_ms"] == s["p50_token_ms"]            # quantiles max
+    assert agg["p50_token_ms"] == s["p50_token_ms"]  # no samples: max
     assert agg["mean_batch_occupancy"] == \
         pytest.approx(s["mean_batch_occupancy"])               # means mean
     assert agg["decode_tokens_per_s"] == \
         pytest.approx(2 * s["decode_tokens_per_s"], rel=1e-6)  # rates sum
     assert agg["prefix_hit_rate"] == \
         pytest.approx(s["prefix_hit_rate"])       # recomputed from sums
+    # histograms merge bucket-by-bucket: identical bounds, counts add
+    assert agg["itl_hist_count"] == 2 * s["itl_hist_count"]
+    assert all(agg["itl_hist_buckets"][le] == 2 * n
+               for le, n in s["itl_hist_buckets"].items())
     with pytest.raises(ValueError):
         ServingStats.aggregate([])
+
+
+def test_stats_aggregate_pools_reservoir_samples():
+    """Honest fleet quantiles: snapshots carrying their reservoir
+    samples aggregate to the percentile of the pooled UNION, not the
+    max of per-replica percentiles.  Two disjoint latency populations
+    make the two semantics differ visibly."""
+    fast, slow = ServingStats(), ServingStats()
+    for _ in range(150):
+        fast.record_decode(0.001, n_tokens=1, occupancy=1.0)   # 1 ms
+    for _ in range(50):
+        slow.record_decode(0.101, n_tokens=1, occupancy=1.0)   # 101 ms
+    snaps = [fast.snapshot(include_samples=True),
+             slow.snapshot(include_samples=True)]
+    agg = ServingStats.aggregate(snaps)
+    # max-of-quantiles would say p50 == 101 ms; 3/4 of the pooled union
+    # is the fast population, so the honest fleet p50 is 1 ms
+    assert agg["p50_token_ms"] == pytest.approx(1.0, rel=1e-6)
+    assert agg["itl_p50_ms"] == agg["p50_token_ms"]
+    assert agg["p99_token_ms"] == pytest.approx(101.0, rel=1e-6)
+    # the raw samples themselves never leak into the aggregate
+    assert "_samples" not in agg
+    # without samples the conservative max-of-quantiles fallback holds
+    fallback = ServingStats.aggregate(
+        [fast.snapshot(), slow.snapshot()])
+    assert fallback["p50_token_ms"] == pytest.approx(101.0, rel=1e-6)
+
+
+def test_metrics_render_true_histograms():
+    """The /metrics exposition carries real Prometheus histograms for
+    TTFT / ITL / step duration: ``# TYPE ... histogram``, cumulative
+    ``_bucket{le=}`` samples monotone in le and ending at +Inf, and
+    consistent ``_sum`` / ``_count``."""
+    stats = ServingStats()
+    for v in (0.0005, 0.003, 0.02, 0.02, 0.7, 30.0):
+        stats.record_decode(v, n_tokens=1, occupancy=1.0)
+    stats.record_ttft(0.004)
+    stats.record_ttft(0.09)
+    stats.record_step(0.002)
+    text = render_metrics(stats.snapshot())
+    for series in ("ttft_hist_seconds", "itl_hist_seconds",
+                   "step_duration_seconds"):
+        assert f"# TYPE paddle_tpu_{series} histogram" in text
+        assert f'paddle_tpu_{series}_bucket{{le="+Inf"}}' in text
+    # cumulative counts are non-decreasing across the le ladder and the
+    # +Inf bucket equals _count; _sum matches the recorded observations
+    lines = text.splitlines()
+    itl = [ln for ln in lines
+           if ln.startswith("paddle_tpu_itl_hist_seconds_bucket")]
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in itl]
+    assert counts == sorted(counts)
+    assert counts[-1] == 6
+    assert "paddle_tpu_itl_hist_seconds_count 6" in text
+    assert 'paddle_tpu_itl_hist_seconds_bucket{le="0.001"} 1' in text
+    assert 'paddle_tpu_itl_hist_seconds_bucket{le="10"} 5' in text
+    sum_ln = next(ln for ln in lines
+                  if ln.startswith("paddle_tpu_itl_hist_seconds_sum"))
+    assert float(sum_ln.rsplit(" ", 1)[1]) == \
+        pytest.approx(0.0005 + 0.003 + 0.02 + 0.02 + 0.7 + 30.0)
+    assert "paddle_tpu_ttft_hist_seconds_count 2" in text
+    assert "paddle_tpu_step_duration_seconds_count 1" in text
 
 
 def test_metrics_render_carries_per_replica_series(model):

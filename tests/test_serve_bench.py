@@ -130,6 +130,54 @@ def test_serve_bench_mixed_emits_padding_surface():
     assert record["p99_token_ms"] >= record["p50_token_ms"] > 0
 
 
+def test_serve_bench_trace_writes_loadable_step_timeline(tmp_path):
+    """--trace writes a loadable Chrome trace with engine.step spans,
+    the record carries the drop counter, and step_timeline.py turns the
+    artifact into a host/device attribution record."""
+    trace_path = os.path.join(str(tmp_path), "trace.json")
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "--smoke", "--mixed", "--requests", "8",
+         "--trace", trace_path],
+        capture_output=True, text=True, timeout=540,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.strip().splitlines() if ln.strip()]
+    assert lines, f"no stdout; stderr: {out.stderr[-2000:]}"
+    record = json.loads(lines[-1])
+    assert "error" not in record, record
+    assert record["trace_path"] == trace_path
+    assert record["trace_events"] > 0
+    assert "trace_dropped_events" in record
+    assert record["trace_unbalanced_spans"] == 0
+    with open(trace_path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    steps = [ev for ev in doc["traceEvents"]
+             if ev.get("ph") == "X" and ev["name"] == "engine.step"]
+    assert len(steps) > 0
+    assert all("dur" in ev and "ts" in ev for ev in steps)
+    # all four serving tiers land in the same trace (--trace replays
+    # part of the stream through a 2-replica HTTP frontend)
+    tracks = {ev["args"]["name"] for ev in doc["traceEvents"]
+              if ev.get("ph") == "M" and ev["name"] == "thread_name"}
+    assert "engine" in tracks and "http" in tracks
+    assert any(t.startswith("runner") for t in tracks)
+    assert "router" in tracks
+
+    # the attribution tool consumes the artifact and reports a nonzero
+    # host-bubble fraction on CPU
+    tool = os.path.join(REPO, "tools", "perf", "step_timeline.py")
+    out2 = subprocess.run(
+        [sys.executable, tool, trace_path],
+        capture_output=True, text=True, timeout=120)
+    assert out2.returncode == 0, out2.stderr[-2000:]
+    rec2 = json.loads(out2.stdout.strip().splitlines()[-1])
+    assert rec2["metric"] == "step_timeline_host_bubble_frac"
+    assert rec2["steps"] > 0
+    assert rec2["value"] > 0
+    assert rec2["host_ms"] > 0
+    assert "engine.device_launch" in rec2["phases"]
+
+
 def test_serve_bench_chaos_emits_recovery_surface():
     out = subprocess.run(
         [sys.executable, SCRIPT, "--smoke", "--chaos", "--requests", "8"],

@@ -1,0 +1,271 @@
+"""Step-timeline tracer: ring-buffer and nesting semantics, Chrome
+trace-event export validity, the zero-cost disabled seam, greedy
+byte-identity with tracing on, and cross-tier correlation through the
+HTTP frontend's /debug/trace endpoint."""
+import http.client
+import json
+import os
+import threading
+import tracemalloc
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.inference import LLMEngine
+from paddle_tpu.inference.frontend import serve_background
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.profiler import Tracer
+
+VOCAB = 97
+CFG = LlamaConfig.tiny(vocab=VOCAB, hidden=32, layers=2, heads=4, ffn=64,
+                       seq=64)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return LlamaForCausalLM(CFG)
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_num_seqs", 4)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_model_len", 64)
+    kw.setdefault("max_prefill_tokens", 128)
+    kw.setdefault("prefill_token_bucket", 32)
+    return LLMEngine(model, **kw)
+
+
+def _post(port, obj, path="/v1/completions", timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", path, body=json.dumps(obj).encode(),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, body
+
+
+def _get(port, path, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, body
+
+
+# ---------------------------------------------------------------------------
+# ring buffer + span stack semantics
+# ---------------------------------------------------------------------------
+
+def test_ring_drops_oldest_first_and_counts():
+    tr = Tracer(capacity=4)
+    track = tr.register("engine")
+    for i in range(10):
+        tr.instant(f"i{i}", track=track)
+    assert [e[1] for e in tr.events()] == ["i6", "i7", "i8", "i9"]
+    assert tr.dropped == 6
+    assert len(tr) == 4
+    assert tr.chrome_trace()["otherData"]["dropped_events"] == 6
+    tr.clear()
+    assert tr.dropped == 0 and len(tr) == 0
+
+
+def test_span_nesting_is_strictly_per_thread():
+    """Two threads interleaving nested spans never see each other's
+    stack: every exit matches its own thread's enter."""
+    tr = Tracer()
+    track = tr.register("engine")
+    barrier = threading.Barrier(2)
+    errs = []
+
+    def work():
+        try:
+            for _ in range(50):
+                with tr.span("outer", track=track):
+                    barrier.wait(10)      # force interleaving mid-span
+                    with tr.span("inner", track=track):
+                        pass
+        except Exception as e:            # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=work) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not errs
+    assert tr.unbalanced == 0
+    assert len(tr.events()) == 200        # 2 threads * 50 * (outer+inner)
+
+
+def test_mismatched_span_exit_counts_unbalanced_never_raises():
+    tr = Tracer()
+    track = tr.register("engine")
+    outer, inner = tr.span("outer", track=track), tr.span("inner",
+                                                          track=track)
+    outer.__enter__()
+    inner.__enter__()
+    outer.__exit__(None, None, None)      # exits out of order
+    inner.__exit__(None, None, None)
+    assert tr.unbalanced == 2
+    stray = tr.span("stray", track=track)
+    stray.__enter__()
+    tr._stack().clear()                   # exit against an empty stack
+    stray.__exit__(None, None, None)
+    assert tr.unbalanced == 3
+    # the damaged stack never blocks recording: all 3 "X" events landed
+    assert [e[0] for e in tr.events()] == ["X", "X", "X"]
+    assert tr.chrome_trace()["otherData"]["unbalanced_spans"] == 3
+
+
+# ---------------------------------------------------------------------------
+# chrome trace-event export
+# ---------------------------------------------------------------------------
+
+def test_chrome_trace_export_is_valid_and_monotonic():
+    tr = Tracer()
+    track = tr.register("engine")
+    tr.async_begin("request", "engine:req-0", args={"request_id": "r-0"})
+    with tr.span("engine.step", track=track, step=1):
+        with tr.span("engine.pack", track=track):
+            pass
+    tr.instant("engine.first_token", track=track, args={"rid": "req-0"})
+    tr.async_end("request", "engine:req-0")
+    doc = json.loads(json.dumps(tr.chrome_trace()))   # JSON round-trip
+    evs = doc["traceEvents"]
+    assert all({"ph", "name", "pid", "tid"} <= set(ev) for ev in evs)
+    body = [ev for ev in evs if ev["ph"] != "M"]
+    assert len(body) == 5
+    # timestamps are non-decreasing after export sorting, even though
+    # the wrapper "engine.step" X event is APPENDED after its inner span
+    ts = [ev["ts"] for ev in body]
+    assert ts == sorted(ts)
+    for ev in body:
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0
+        elif ev["ph"] == "i":
+            assert ev["s"] == "t"
+        else:
+            assert ev["ph"] in ("b", "e")
+            assert ev["cat"] == "request"
+            assert ev["id"] == "engine:req-0"
+    meta = {ev["args"]["name"] for ev in evs
+            if ev["ph"] == "M" and ev["name"] == "thread_name"}
+    assert "engine" in meta
+    assert doc["otherData"]["clock"] == "perf_counter_ns"
+
+
+# ---------------------------------------------------------------------------
+# engine integration: byte-identity + zero-cost disabled seam
+# ---------------------------------------------------------------------------
+
+def test_tracing_on_off_byte_identical_with_pinned_compiles(model):
+    """ISSUE acceptance: the 16-request ragged audit stream produces
+    byte-identical greedy outputs with tracing on vs off, and the
+    compile budget does not move."""
+    def run_stream(tracer):
+        eng = _engine(model, max_num_seqs=8, max_prefill_tokens=256,
+                      prefill_token_bucket=64)
+        if tracer is not None:
+            eng.set_tracer(tracer)
+        rng = np.random.RandomState(7)
+        shapes = [(4, 8), (9, 8), (13, 6)]
+        for i in range(16):
+            n, max_new = shapes[i % len(shapes)]
+            eng.add_request(rng.randint(0, VOCAB, n).tolist(),
+                            max_new_tokens=max_new)
+        outs = eng.run()
+        return ([outs[rid].generated for rid in sorted(outs)],
+                eng.num_decode_programs, dict(eng.compile_counts))
+
+    base, base_programs, base_compiles = run_stream(None)
+    tr = Tracer()
+    traced, traced_programs, traced_compiles = run_stream(tr)
+    assert traced == base
+    assert traced_programs == base_programs
+    assert traced_compiles == base_compiles
+    assert tr.unbalanced == 0 and tr.dropped == 0
+    names = {e[1] for e in tr.events()}
+    for phase in ("engine.step", "engine.admit", "engine.schedule",
+                  "engine.pack", "engine.block_table_stage",
+                  "engine.device_launch", "engine.block_on_result",
+                  "engine.sample_commit", "engine.retire"):
+        assert phase in names, phase
+    # every request opened AND closed its lifecycle pair
+    assert sum(1 for e in tr.events() if e[0] == "b") == 16
+    assert sum(1 for e in tr.events() if e[0] == "e") == 16
+
+
+def test_disabled_tracer_allocates_nothing_in_step_loop(model):
+    """The zero-cost seam, pinned: with tracer=None the step loop never
+    executes a line of profiler/trace.py, so tracemalloc filtered to
+    that file sees zero allocations."""
+    eng = _engine(model)
+    rng = np.random.RandomState(11)
+    eng.add_request(rng.randint(0, VOCAB, 8).tolist(), max_new_tokens=4)
+    eng.run()                             # warm compiles outside the probe
+    for _ in range(3):
+        eng.add_request(rng.randint(0, VOCAB, 8).tolist(),
+                        max_new_tokens=6)
+    trace_file = os.path.join("*", "profiler", "trace.py")
+    tracemalloc.start()
+    try:
+        while eng.has_unfinished():
+            eng.step()
+        snap = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    stats = snap.filter_traces(
+        [tracemalloc.Filter(True, trace_file)]).statistics("lineno")
+    assert stats == []
+
+
+# ---------------------------------------------------------------------------
+# cross-tier: /debug/trace through the HTTP frontend
+# ---------------------------------------------------------------------------
+
+def test_debug_trace_endpoint_serves_cross_tier_json(model):
+    tr = Tracer()
+    eng = _engine(model, retain_outputs=False)
+    eng.set_tracer(tr)
+    srv = serve_background(eng, model_name="tiny")
+    try:
+        status, _ = _post(srv.port, {"model": "tiny",
+                                     "prompt": list(range(6)),
+                                     "max_tokens": 4})
+        assert status == 200
+        status, raw = _get(srv.port, "/debug/trace")
+        assert status == 200
+        doc = json.loads(raw)
+    finally:
+        srv.stop()
+    tracks = {ev["args"]["name"] for ev in doc["traceEvents"]
+              if ev.get("ph") == "M" and ev["name"] == "thread_name"}
+    assert "engine" in tracks
+    assert "http" in tracks
+    assert any(t.startswith("runner") for t in tracks)
+    # the request lifecycle pair is balanced and correlated by id
+    bs = {ev["id"] for ev in doc["traceEvents"] if ev.get("ph") == "b"}
+    es = {ev["id"] for ev in doc["traceEvents"] if ev.get("ph") == "e"}
+    assert bs and bs == es
+    # runner delivery instants join the engine rid to the frontend's
+    # request id — the cross-tier correlation key
+    joins = [ev["args"] for ev in doc["traceEvents"]
+             if ev.get("ph") == "i" and ev["name"] == "runner.deliver"]
+    assert joins and all("request_id" in a and "rid" in a for a in joins)
+    # http tier saw the same request
+    assert any(ev["name"] == "http.request"
+               for ev in doc["traceEvents"] if ev.get("ph") == "i")
+
+
+def test_debug_trace_404_without_tracer(model):
+    eng = _engine(model, retain_outputs=False)
+    srv = serve_background(eng, model_name="tiny")
+    try:
+        status, _ = _get(srv.port, "/debug/trace")
+        assert status == 404
+    finally:
+        srv.stop()

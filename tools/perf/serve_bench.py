@@ -699,7 +699,7 @@ def _mixed_request_stream(rng, n_requests, vocab, max_len,
 
 
 def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str,
-                    kv_dtype: str = "float32", tp: int = 1):
+                    kv_dtype: str = "float32", tp: int = 1, tracer=None):
     """The ISSUE's headline workload: long prefills, chunked resumes,
     plain decodes, and speculative verify rounds all riding the ONE
     ragged step program.  Reports throughput, the exact attention
@@ -745,9 +745,37 @@ def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str,
     engine.stats.reset()
     for k in engine.pad_stats:           # ratio is for the timed pass only
         engine.pad_stats[k] = 0
+    if tracer is not None:
+        # trace the TIMED pass only: the warm pass's compiles would
+        # drown the steady-state step phases the timeline is for
+        engine.set_tracer(tracer)
     elapsed = _drive(engine, list(stream))
     s = engine.stats.summary()
     ps = dict(engine.pad_stats)
+
+    if tracer is not None:
+        # ride a handful of the same requests through the full serving
+        # stack (HTTP SSE -> replica router -> runner -> engine) onto
+        # the SAME ring, so one dumped trace shows request-correlated
+        # spans from all four tiers next to the engine-direct timeline
+        from paddle_tpu.inference.frontend import serve_background
+
+        def _factory():
+            return LLMEngine(model, retain_outputs=False,
+                             enable_prefix_caching=True,
+                             kv_dtype=kv_dtype, tp=tp, **engine_kw)
+
+        http_engine = _factory()
+        http_engine.set_tracer(tracer)
+        srv = serve_background(http_engine, model_name="bench",
+                               replicas=2, engine_factory=_factory,
+                               max_pending=4 * len(stream))
+        try:
+            _http_drive(srv.port,
+                        [(i, prompt, max_new) for i, (_, prompt, max_new)
+                         in enumerate(stream[:6])])
+        finally:
+            srv.stop()
 
     real = max(ps["real"], 1)
     waste = ps["padded"] / real
@@ -1113,6 +1141,12 @@ def main(argv=None):
                          "behind the prefix-affinity router, A/B'd "
                          "against random routing on the shared-prefix "
                          "workload")
+    ap.add_argument("--trace", default=None, metavar="OUT.json",
+                    help="with --mixed: record a step timeline of the "
+                         "timed pass (plus a short HTTP/router pass so "
+                         "all four tiers appear) and write it as Chrome "
+                         "trace-event JSON — open in ui.perfetto.dev or "
+                         "feed tools/perf/step_timeline.py")
     args = ap.parse_args(argv)
 
     if args.tp > 1 and "xla_force_host_platform_device_count" \
@@ -1167,6 +1201,13 @@ def main(argv=None):
     record["replicas"] = args.replicas
     if probe_err:
         record["backend_note"] = f"cpu fallback: {probe_err}"
+    tracer = None
+    if args.trace:
+        if args.mixed:
+            from paddle_tpu.profiler import Tracer
+            tracer = Tracer()
+        else:
+            record["trace_note"] = "--trace records the --mixed workload"
     try:
         if args.http and args.replicas > 1:
             record.update(run_router_bench(args.smoke, n_requests,
@@ -1183,7 +1224,8 @@ def main(argv=None):
                                           backend, args.kv_dtype, args.tp))
         elif args.mixed:
             record.update(run_mixed_bench(args.smoke, n_requests, args.seed,
-                                          backend, args.kv_dtype, args.tp))
+                                          backend, args.kv_dtype, args.tp,
+                                          tracer=tracer))
         elif args.http:
             record.update(run_http_bench(args.smoke, n_requests, args.seed,
                                          backend, args.kv_dtype, args.tp))
@@ -1205,6 +1247,14 @@ def main(argv=None):
         record["replicas"] = args.replicas
     except Exception as e:  # the line must still print
         record["error"] = f"{type(e).__name__}: {e}"
+    if tracer is not None:
+        try:
+            record["trace_events"] = tracer.dump(args.trace)
+            record["trace_path"] = args.trace
+            record["trace_dropped_events"] = tracer.dropped
+            record["trace_unbalanced_spans"] = tracer.unbalanced
+        except Exception as e:
+            record.setdefault("error", f"{type(e).__name__}: {e}")
     _emit(record)
     return 0
 

@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""Host/device attribution from a serving step-timeline trace.
+
+Reads the Chrome trace-event JSON that ``serve_bench --trace OUT.json``
+(or ``LLMEngine.dump_trace`` / ``GET /debug/trace``) writes, and answers
+the question the raw Perfetto view makes you eyeball: where does one
+engine step's wall-clock go, and how much of it is HOST bookkeeping
+parked next to an idle accelerator?
+
+Per engine-step phase ("engine.admit" .. "engine.retire") it prints
+count, p50/p95/total milliseconds and the share of summed step time,
+then three derived numbers:
+
+  host-bubble fraction   host-phase time (admit/schedule/pack/
+                         block-table-stage/sample-commit/retire plus the
+                         untracked step remainder) over summed step time
+                         — the fraction of the step the device program
+                         is NOT the thing being waited on
+  device fraction        device_launch + block_on_result over step time
+  overlap opportunity    per step, min(pack + block_table_stage,
+                         device_launch): the host packing work that an
+                         async engine could overlap UNDER the previous
+                         step's device span; summed, as a fraction of
+                         step time.  This is the number the async-engine
+                         roadmap item banks on.
+
+Usage:
+  python tools/perf/step_timeline.py TRACE.json
+
+Last stdout line is a one-line JSON record (same contract as the other
+tools/perf benches) with metric ``step_timeline_host_bubble_frac``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+_HOST_PHASES = ("engine.admit", "engine.schedule", "engine.pack",
+                "engine.block_table_stage", "engine.sample_commit",
+                "engine.retire")
+_DEVICE_PHASES = ("engine.device_launch", "engine.block_on_result")
+_PHASE_ORDER = ("engine.admit", "engine.schedule", "engine.pack",
+                "engine.block_table_stage", "engine.device_launch",
+                "engine.block_on_result", "engine.sample_commit",
+                "engine.retire")
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def load_trace(path):
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", [])
+    tracks = {}                           # tid -> track name
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+            tracks[ev["tid"]] = ev["args"]["name"]
+    return doc, events, tracks
+
+
+def analyze(doc, events, tracks):
+    """Attribution over every engine track in the trace (a replicated
+    trace sums its engines — the phases are per step either way)."""
+    engine_tids = {tid for tid, name in tracks.items()
+                   if name == "engine" or name.startswith("engine-")}
+    xs = [ev for ev in events if ev.get("ph") == "X"
+          and ev["tid"] in engine_tids]
+    steps = sorted((ev for ev in xs if ev["name"] == "engine.step"),
+                   key=lambda e: e["ts"])
+    inner = [ev for ev in xs if ev["name"] != "engine.step"]
+
+    durs = {}                             # phase -> [dur_us,...]
+    for ev in inner:
+        durs.setdefault(ev["name"], []).append(ev["dur"])
+
+    step_total = sum(ev["dur"] for ev in steps)
+    host_us = sum(d for p in _HOST_PHASES for d in durs.get(p, ()))
+    device_us = sum(d for p in _DEVICE_PHASES for d in durs.get(p, ()))
+    tracked_us = host_us + device_us
+    untracked_us = max(0.0, step_total - tracked_us)
+
+    # overlap opportunity: per step, the packing host work that could
+    # hide under a device span of this size in an async engine
+    overlap_us = 0.0
+    by_tid = {}
+    for ev in inner:
+        by_tid.setdefault(ev["tid"], []).append(ev)
+    for st in steps:
+        t0, t1 = st["ts"], st["ts"] + st["dur"]
+        mine = [ev for ev in by_tid.get(st["tid"], ())
+                if t0 <= ev["ts"] and ev["ts"] + ev["dur"] <= t1 + 1e-6]
+        pack = sum(ev["dur"] for ev in mine
+                   if ev["name"] in ("engine.pack",
+                                     "engine.block_table_stage"))
+        dev = sum(ev["dur"] for ev in mine
+                  if ev["name"] == "engine.device_launch")
+        overlap_us += min(pack, dev)
+
+    phases = {}
+    for name in _PHASE_ORDER:
+        vals = sorted(durs.get(name, []))
+        if not vals:
+            continue
+        phases[name] = {
+            "count": len(vals),
+            "p50_ms": round(_pct(vals, 50) / 1e3, 4),
+            "p95_ms": round(_pct(vals, 95) / 1e3, 4),
+            "total_ms": round(sum(vals) / 1e3, 3),
+            "share": round(sum(vals) / step_total, 4) if step_total else 0.0,
+        }
+    step_vals = sorted(ev["dur"] for ev in steps)
+    other = doc.get("otherData", {})
+    return {
+        "metric": "step_timeline_host_bubble_frac",
+        "value": round((host_us + untracked_us) / step_total, 4)
+        if step_total else 0.0,
+        "unit": "frac",
+        "steps": len(steps),
+        "step_p50_ms": round(_pct(step_vals, 50) / 1e3, 4),
+        "step_p95_ms": round(_pct(step_vals, 95) / 1e3, 4),
+        "step_total_ms": round(step_total / 1e3, 3),
+        "host_ms": round(host_us / 1e3, 3),
+        "device_ms": round(device_us / 1e3, 3),
+        "untracked_ms": round(untracked_us / 1e3, 3),
+        "device_frac": round(device_us / step_total, 4)
+        if step_total else 0.0,
+        "overlap_opportunity_ms": round(overlap_us / 1e3, 3),
+        "overlap_opportunity_frac": round(overlap_us / step_total, 4)
+        if step_total else 0.0,
+        "phases": phases,
+        "tiers": sorted(set(tracks.values())),
+        "dropped_events": other.get("dropped_events", 0),
+        "unbalanced_spans": other.get("unbalanced_spans", 0),
+    }
+
+
+def print_table(rec, out=sys.stdout):
+    w = out.write
+    w(f"step timeline: {rec['steps']} steps, "
+      f"step p50 {rec['step_p50_ms']:.3f} ms / "
+      f"p95 {rec['step_p95_ms']:.3f} ms, tiers: "
+      f"{', '.join(rec['tiers'])}\n\n")
+    w(f"{'phase':<26}{'count':>7}{'p50 ms':>10}{'p95 ms':>10}"
+      f"{'total ms':>11}{'share':>8}\n")
+    for name, p in rec["phases"].items():
+        kind = ("device" if name in _DEVICE_PHASES else "host")
+        w(f"{name:<26}{p['count']:>7}{p['p50_ms']:>10.4f}"
+          f"{p['p95_ms']:>10.4f}{p['total_ms']:>11.3f}"
+          f"{p['share']:>8.1%}  [{kind}]\n")
+    if rec["untracked_ms"]:
+        share = rec["untracked_ms"] / rec["step_total_ms"] \
+            if rec["step_total_ms"] else 0.0
+        w(f"{'(untracked step time)':<26}{'':>7}{'':>10}{'':>10}"
+          f"{rec['untracked_ms']:>11.3f}{share:>8.1%}  [host]\n")
+    w("\n")
+    w(f"host-bubble fraction:  {rec['value']:.1%} "
+      f"({rec['host_ms'] + rec['untracked_ms']:.3f} ms host-side of "
+      f"{rec['step_total_ms']:.3f} ms stepped)\n")
+    w(f"device fraction:       {rec['device_frac']:.1%} "
+      f"({rec['device_ms']:.3f} ms in launch + result sync)\n")
+    w(f"overlap opportunity:   {rec['overlap_opportunity_frac']:.1%} "
+      f"({rec['overlap_opportunity_ms']:.3f} ms of packing that an "
+      f"async engine could hide under device spans)\n")
+    if rec["dropped_events"]:
+        w(f"NOTE: ring dropped {rec['dropped_events']} oldest events — "
+          f"totals cover the surviving window only\n")
+    w("\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="host/device attribution over a serve_bench --trace "
+                    "step timeline")
+    ap.add_argument("trace", help="Chrome trace-event JSON "
+                                  "(serve_bench --trace OUT.json)")
+    ap.add_argument("--json-only", action="store_true",
+                    help="skip the table; print only the record line")
+    args = ap.parse_args(argv)
+
+    doc, events, tracks = load_trace(args.trace)
+    rec = analyze(doc, events, tracks)
+    if rec["steps"] == 0:
+        rec["error"] = "no engine.step spans in trace"
+    elif not args.json_only:
+        print_table(rec)
+    print(json.dumps(rec))
+    sys.stdout.flush()
+    return 0 if rec["steps"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
