@@ -544,6 +544,110 @@ def lint_source(text: str, path: str = "<string>") -> list:
                          "axis name is unbound here; wrap the step with "
                          "shard_map before jax.jit")
 
+        # ---- host-sync-in-dispatch-path (serving tier only) ---------------
+        # Async-pipeline contract: the dispatch section launches the step
+        # program WITHOUT materializing its results — materialization
+        # belongs to the completion seam.  Same name-based fixpoint as
+        # the compiled set: defs named like dispatch/prestage, plus their
+        # nested defs, by-name callees and self-method callees, form the
+        # dispatch path; names assigned from a *launch*-ish call are the
+        # step-program outputs.  int()/float()/np.asarray()/.item() on
+        # one of those names inside the dispatch path forces the host
+        # sync the pipeline exists to avoid.
+        dispatch_set = {d for d in ctx.defs
+                        if "dispatch" in d.name or "prestage" in d.name}
+        changed = True
+        while changed:
+            changed = False
+            for d in list(dispatch_set):
+                for node in ast.walk(d):
+                    callee = None
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) \
+                            and node not in dispatch_set:
+                        dispatch_set.add(node)
+                        changed = True
+                        continue
+                    if isinstance(node, ast.Call):
+                        if isinstance(node.func, ast.Name):
+                            callee = node.func.id
+                        elif isinstance(node.func, ast.Attribute) \
+                                and isinstance(node.func.value, ast.Name) \
+                                and node.func.value.id == "self":
+                            callee = node.func.attr
+                    if callee is not None:
+                        for cd in ctx.by_name.get(callee, ()):
+                            if cd not in dispatch_set:
+                                dispatch_set.add(cd)
+                                changed = True
+        # step-program output names: assigned from a call whose terminal
+        # name mentions "launch", then propagated through plain ALIASES
+        # only (x = sampled; x = sampled[0]) — a computed RHS (bucket
+        # math, slicing arithmetic) launders the device handle into a
+        # host value on its own and must not spread the taint
+        def _alias_root(n):
+            while isinstance(n, (ast.Subscript, ast.Attribute)):
+                n = n.value
+            return n.id if isinstance(n, ast.Name) else None
+
+        outputs = set()
+        changed = True
+        while changed:
+            changed = False
+            for d in dispatch_set:
+                for node in _walk_own(d):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    tainted = False
+                    if isinstance(node.value, ast.Call):
+                        dd = _dotted(node.value.func) or ()
+                        tainted = bool(dd) and "launch" in dd[-1]
+                    if not tainted:
+                        tainted = _alias_root(node.value) in outputs
+                    if not tainted:
+                        continue
+                    for t in node.targets:
+                        elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                        for e in elts:
+                            if isinstance(e, ast.Name) \
+                                    and e.id not in outputs:
+                                outputs.add(e.id)
+                                changed = True
+
+        def _touches_output(expr) -> str | None:
+            for n in ast.walk(expr):
+                if isinstance(n, ast.Name) and n.id in outputs:
+                    return n.id
+            return None
+
+        for d in dispatch_set:
+            for node in _walk_own(d):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = None
+                how = None
+                if isinstance(node.func, ast.Name) \
+                        and node.func.id in _COERCIONS and node.args:
+                    hit = _touches_output(node.args[0])
+                    how = f"{node.func.id}()"
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "item":
+                    hit = _touches_output(node.func.value)
+                    how = ".item()"
+                else:
+                    dd = _dotted(node.func) or ()
+                    if len(dd) >= 2 and dd[0] in ctx.np_aliases \
+                            and dd[-1] in ("asarray", "array") and node.args:
+                        hit = _touches_output(node.args[0])
+                        how = f"{'.'.join(dd)}()"
+                if hit is not None:
+                    emit("host-sync-in-dispatch-path", node,
+                         f"`{how}` on step-program output {hit!r} inside "
+                         f"dispatch-path `{d.name}` — this blocks on the "
+                         "in-flight device program and re-serializes host "
+                         "packing with device compute; materialize in the "
+                         "completion seam instead")
+
     # ---- untuned-pallas-launch (ops/pallas only) -------------------------
     # Autotuner contract: every Pallas launch's geometry (block sizes,
     # grid blocking, page-walk width) flows from the tuning-cache lookup

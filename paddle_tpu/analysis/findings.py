@@ -122,6 +122,14 @@ RULES = {
         "shard_map — the mesh axis name is unbound outside shard_map, so "
         "the program either fails to trace or silently runs unsharded on "
         "one chip; wrap the step with shard_map before jitting")),
+    "host-sync-in-dispatch-path": (WARNING, "ast", (
+        "int()/float()/np.asarray()/.item() applied to a step-program "
+        "output inside an inference-tier dispatch/prestage path — the "
+        "async pipeline's whole win is that dispatch launches WITHOUT "
+        "materializing device results (JAX async dispatch); a host sync "
+        "here re-serializes host packing with device compute, silently "
+        "reverting the engine to its synchronous behavior; move the "
+        "materialization to the completion seam")),
 }
 
 

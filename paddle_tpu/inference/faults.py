@@ -54,16 +54,30 @@ class FaultPlan:
     conn_drop_requests:
         Ordinals (0-based) of *streaming* frontend requests whose
         connection is dropped server-side after the first token frame.
+    inflight_crash_steps / inflight_slow_steps:
+        Like ``crash_steps``/``slow_steps`` but fired from the engine's
+        COMPLETION seam, while the scheduled step's launch is genuinely
+        in flight on-device (overlap mode only — a synchronous engine
+        never leaves a launch in flight, so these seams never fire
+        there).  Step indices are keyed on completion order, which the
+        depth-1 pipeline keeps equal to dispatch order: "in-flight
+        crash at step 5" dies between step 5's launch and its
+        materialization, after step 4's outputs were delivered.
     """
 
     def __init__(self, *, seed: int = 0, crash_steps=(), slow_steps=None,
-                 nan_steps=(), pool_window=None, conn_drop_requests=()):
+                 nan_steps=(), pool_window=None, conn_drop_requests=(),
+                 inflight_crash_steps=(), inflight_slow_steps=None):
         self.seed = int(seed)
         self._rng = random.Random(self.seed)
         self.step = 0
         self._crash = sorted(int(s) for s in crash_steps)
         self._slow = sorted((int(s), float(d))
                             for s, d in (slow_steps or {}).items())
+        self._inflight_crash = sorted(int(s) for s in inflight_crash_steps)
+        self._inflight_slow = sorted(
+            (int(s), float(d))
+            for s, d in (inflight_slow_steps or {}).items())
         self._nan = sorted(int(s) for s in nan_steps)
         self.pool_window = (None if pool_window is None
                             else (int(pool_window[0]), int(pool_window[1])))
@@ -135,6 +149,28 @@ class FaultPlan:
             return dur
         return 0.0
 
+    def take_inflight_crash(self) -> bool:
+        """True once per scheduled in-flight crash whose step has been
+        reached.  The engine consults this at the top of its completion
+        seam, only when the ticket it is about to block on genuinely
+        crossed a step boundary in flight."""
+        if self._inflight_crash and self.step >= self._inflight_crash[0]:
+            self._inflight_crash.pop(0)
+            self._trace("inflight_crash")
+            return True
+        return False
+
+    def take_inflight_slow(self) -> float:
+        """Sleep seconds for a due in-flight hang fault, else 0.0.
+        Fired from the completion seam like ``take_inflight_crash`` —
+        the hang sits between a launch and its materialization, where
+        the runner's step-deadline watchdog must still catch it."""
+        if self._inflight_slow and self.step >= self._inflight_slow[0][0]:
+            dur = self._inflight_slow.pop(0)[1]
+            self._trace("inflight_slow", seconds=dur)
+            return dur
+        return 0.0
+
     def take_nan_row(self, n_rows: int) -> int | None:
         """Row index to corrupt in the current launch, or None.
 
@@ -185,9 +221,12 @@ class FaultPlan:
 
     def exhausted(self) -> bool:
         """True once every scheduled engine-side fault has fired."""
-        return not (self._crash or self._slow or self._nan)
+        return not (self._crash or self._slow or self._nan
+                    or self._inflight_crash or self._inflight_slow)
 
     def __repr__(self):
         return (f"FaultPlan(step={self.step}, crash={self._crash}, "
                 f"slow={self._slow}, nan={self._nan}, "
-                f"pool={self.pool_window})")
+                f"pool={self.pool_window}, "
+                f"inflight_crash={self._inflight_crash}, "
+                f"inflight_slow={self._inflight_slow})")

@@ -144,6 +144,24 @@ def render_metrics(snapshot: dict, *, engine=None,
             d.histogram(name, help_text, buckets,
                         s.get(f"{key}_sum", 0.0), s.get(f"{key}_count", 0))
 
+    # -- async step pipeline ---------------------------------------------
+    # each launch cycle split into the host dispatch section vs the
+    # completion block on device results (overlap hides the latter)
+    d.metric("step_dispatch_seconds_total", "counter",
+             "Cumulative host dispatch time (pack/stage/launch enqueue).",
+             [(None, s.get("dispatch_time_s"))])
+    d.metric("step_block_seconds_total", "counter",
+             "Cumulative completion-block time (waiting on device "
+             "results).", [(None, s.get("block_time_s"))])
+    d.metric("step_dispatch_seconds", "gauge",
+             "Per-step host dispatch duration.",
+             [({"quantile": "0.5"}, _ms(s.get("dispatch_ms_p50"))),
+              ({"quantile": "0.99"}, _ms(s.get("dispatch_ms_p99")))])
+    d.metric("step_block_seconds", "gauge",
+             "Per-step completion-block duration.",
+             [({"quantile": "0.5"}, _ms(s.get("block_ms_p50"))),
+              ({"quantile": "0.99"}, _ms(s.get("block_ms_p99")))])
+
     # -- fault tolerance --------------------------------------------------
     d.metric("engine_restarts_total", "counter",
              "Supervised engine rebuilds (crashed or hung steps).",

@@ -54,6 +54,17 @@ recovery snapshot is race-free by construction.  The engine's
 ServingStats object (and any FaultPlan / DegradationController) carries
 over to the rebuilt engine, so uptime and counters describe the
 SERVICE, not one engine incarnation.
+
+The async engine pipeline (``LLMEngine(overlap=True)``) needs NOTHING
+new here, by construction: ``engine.step()`` still contains the
+blocking completion of whatever launch it materializes, so the
+watchdog's per-call deadline naturally spans dispatch→completion of a
+ticket, and ``on_token`` fires from ``step()``'s returned outputs —
+i.e. only at COMPLETION boundaries, never for a launch still in
+flight.  A crash mid-pipeline therefore leaves the journal holding
+exactly the tokens of fully completed steps, which is precisely the
+state the replay continuation rebuilds; the in-flight launch and any
+speculatively pre-staged next step die with the old engine.
 """
 from __future__ import annotations
 
@@ -113,8 +124,11 @@ class EngineRunner:
         longer is treated as hung: the watchdog thread rebuilds the
         engine and spawns a replacement stepping thread.  Must sit above
         the engine's worst-case honest step (first-step XLA compiles
-        included).  None disables the watchdog (crash recovery still
-        works when a factory is set).
+        included).  Under the async pipeline one ``step()`` call spans
+        the completion block of the in-flight launch plus the next
+        dispatch, so the budget covers dispatch→completion of a ticket
+        with no watchdog change.  None disables the watchdog (crash
+        recovery still works when a factory is set).
     max_restarts: recovery budget; exceeding it fails the in-flight set
         instead of rebuilding again (a deterministic crash must not loop
         forever).

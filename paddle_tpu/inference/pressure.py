@@ -72,14 +72,22 @@ class DegradationController:
 
     # -- per-step update ---------------------------------------------------
 
-    def update(self, blocks) -> int:
+    def update(self, blocks, spec_reserved: int = 0) -> int:
         """Observe the pool and move the state machine.  Returns the
-        (possibly new) state.  Called once per engine step."""
+        (possibly new) state.  Called once per engine step.
+
+        ``spec_reserved`` credits back pages the async engine's
+        prestage took SPECULATIVELY for the next launch: at this point
+        of a synchronous step they would still be free, so counting
+        them as used would skew the free-page fraction (and the
+        retry-after trend) against the overlap engine for pages that
+        are not real demand yet."""
         self._step += 1
         total = blocks.num_blocks - 1  # slot 0 is the null block
         self._total = total
-        f = blocks.num_free / total if total > 0 else 1.0
-        self._history.append((time.monotonic(), blocks.num_free))
+        free = min(blocks.num_free + int(spec_reserved), total)
+        f = free / total if total > 0 else 1.0
+        self._history.append((time.monotonic(), free))
 
         # deepest tier whose entry threshold the pool has breached
         target = NORMAL

@@ -105,7 +105,6 @@ class Request:
     arrival: int = 0                  # admission priority (FCFS)
     slot: int = -1                    # stable decode-batch slot
     t_arrival: float = 0.0            # wall clock at add_request (TTFT)
-    bt_version: int = -1              # last block-table version packed
     seen: object = None               # [V] bool penalty mask (lazy)
     spec_proposed: int = 0            # drafts sent to verify (lifetime)
     spec_accepted: int = 0            # drafts accepted (lifetime)
@@ -126,6 +125,55 @@ class RequestOutput:
     @property
     def token_ids(self):
         return list(self.prompt) + list(self.generated)
+
+
+@dataclass
+class _StepTicket:
+    """One dispatched-but-not-completed ragged launch.
+
+    ``dispatch()`` fills it with the launch's UNMATERIALIZED device
+    arrays plus the packed-row layout needed to apply them; ``complete()``
+    pops it, blocks on the arrays, and commits.  The pipeline is depth-1
+    by design: the next dispatch needs the sampled tokens this ticket
+    carries (a decode row's input IS the previous step's output), so at
+    most one launch is ever in flight."""
+    chunks: list
+    spec: list
+    batch: list
+    sampled: object                   # device array (async, not blocked)
+    logits: object                    # device array | None
+    fin: object                       # device array
+    spec_slices: list
+    chunk_slots: list
+    batch_slots: list
+    dispatch_s: float                 # host seconds packing + launching
+    t_launch: float                   # perf_counter at launch return
+    launch_ns: int                    # tracer clock at launch (0 untraced)
+    inflight: bool = False            # crossed a step() boundary in flight
+
+
+class _DecodeBufs:
+    """One set of persistent host-side pack buffers for the pure-decode
+    fast path.  With overlap on the engine holds TWO and alternates
+    launches between them: CPU PJRT may zero-copy alias an aligned host
+    array into the program's input, so the buffers of an in-flight
+    launch must not be rewritten until its results materialize.
+
+    ``bt_ver`` maps rid -> the block-table version staged into THIS
+    buffer's ``bt`` row (the per-buffer replacement for the old
+    per-request ``bt_version`` field: each buffer tracks its own
+    staleness).  ``layout`` is the rid order last packed."""
+
+    __slots__ = ("toks", "cu", "kvl", "bt", "samp", "layout", "bt_ver")
+
+    def __init__(self, B, nblk, Lq, vocab_size):
+        self.toks = np.zeros((B,), np.int32)
+        self.cu = np.zeros((B + 1,), np.int32)
+        self.kvl = np.zeros((B,), np.int32)
+        self.bt = np.full((B + 1, nblk), NULL_BLOCK, np.int32)
+        self.samp = make_samp(Lq, vocab_size)
+        self.layout: tuple = ()
+        self.bt_ver: dict = {}
 
 
 def _next_pow2(n: int) -> int:
@@ -195,6 +243,17 @@ class LLMEngine:
         (BlockManager, scheduler, sampling params) is untouched — it is
         mesh-blind.  Testable on CPU via
         ``XLA_FLAGS=--xla_force_host_platform_device_count=N``.
+    overlap: run the step loop as a dispatch/completion PIPELINE (the
+        default).  ``step()`` first pre-stages and completes the launch
+        the previous call left in flight, then dispatches this call's
+        launch WITHOUT materializing its results — JAX async dispatch
+        keeps the device busy across the step boundary while the host
+        does the next call's admission/scheduling/packing.  Greedy
+        output is byte-identical on or off and ``compile_counts`` is
+        unchanged (the pipeline adds zero programs); the visible
+        difference is that a request's outputs surface one ``step()``
+        call later and ``run()`` takes one extra draining call.  False
+        restores the fully synchronous launch-then-block step.
 
     The engine is SINGLE-THREADED by design: add_request/step/abort must
     all be called from one thread (the frontend's EngineRunner owns that
@@ -213,7 +272,7 @@ class LLMEngine:
                  retain_outputs: bool = True,
                  fault_plan=None, pressure=None,
                  kv_dtype: str = "float32", tp: int = 1,
-                 tracer=None):
+                 tracer=None, overlap: bool = True):
         cfg = model.config
         self.config = cfg
         self.params = model.decode_params()
@@ -335,15 +394,22 @@ class LLMEngine:
         self._Lq = B * (self.max_spec_k + 1) if self._with_logits else B
 
         # decode fast-path buffers (general mixed launches repack from
-        # scratch; steady pure-decode steps reuse these)
-        self._d_toks = np.zeros((B,), np.int32)
-        self._d_cu = np.zeros((B + 1,), np.int32)
-        self._d_kvl = np.zeros((B,), np.int32)
-        self._d_bt = np.full((B + 1, self.nblk), NULL_BLOCK, np.int32)
+        # scratch; steady pure-decode steps reuse these).  Two sets:
+        # with overlap on, launches alternate buffers so the host never
+        # rewrites arrays a still-in-flight launch may be aliasing
+        # (overlap off only ever touches buffer 0).  lidx is read-only
+        # to the program and safely shared between them.
+        self.overlap = bool(overlap)
+        self._dbufs = (_DecodeBufs(B, self.nblk, self._Lq, cfg.vocab_size),
+                       _DecodeBufs(B, self.nblk, self._Lq, cfg.vocab_size))
+        self._d_cur = 0                   # buffer of the latest launch
         self._d_lidx = np.minimum(np.arange(self._Lq), B - 1) \
             .astype(np.int32)
-        self._d_samp = make_samp(self._Lq, cfg.vocab_size)
-        self._d_layout: tuple = ()        # rid order last packed
+        # dispatch/completion pipeline state (depth-1 queue)
+        self._inflight: _StepTicket | None = None
+        self._prestaged = None            # (buf index, layout) when valid
+        self._pending_finished: list = [] # finishes from an abort() flush
+        self._spec_pages: dict = {}       # rid -> pages prestage reserved
 
         # program cache: ONE attention program kind, keyed only by the
         # flat-token bucket Tq.  The counter dict is the test-visible
@@ -546,7 +612,12 @@ class LLMEngine:
         return rid
 
     def has_unfinished(self) -> bool:
-        return bool(self._waiting or self._running)
+        # an in-flight launch still owes its completion even when every
+        # queue is empty — run() drains the pipeline through it; same
+        # for outputs an abort() flush buffered for the next step()
+        return bool(self._waiting or self._running
+                    or self._inflight is not None
+                    or self._pending_finished)
 
     def abort(self, request_id: int, finish_reason: str = "aborted"):
         """Retire a request before it finishes — the client disconnected,
@@ -570,6 +641,20 @@ class LLMEngine:
         between steps — the frontend's EngineRunner queues cross-thread
         aborts and applies them at the next step boundary.
         """
+        # flush the pipeline first: an in-flight launch may hold this
+        # very request as a packed row, and completing it leaves pool
+        # and queues in the consistent between-steps state the abort
+        # paths (and their callers) assume.  The victim's own rows are
+        # DROPPED unapplied — the caller decided to abort against the
+        # state it could observe (tokens through the last completed
+        # step), so the in-flight step's token for this request is
+        # discarded and the abort output reports exactly the observable
+        # prefix, same as a synchronous abort.  Other rows commit and
+        # retire as usual; their outputs surface from the next step().
+        if self._inflight is not None:
+            self._complete(self.tracer, self._pending_finished,
+                           drop_rid=request_id)
+        self._spec_pages.pop(request_id, None)
         req = None
         for r in self._running:
             if r.rid == request_id:
@@ -809,13 +894,21 @@ class LLMEngine:
                 and req.cached == len(req.prompt) + len(req.generated) - 1)
 
     def step(self) -> list:
-        """One engine iteration: admit -> schedule (prefill chunks +
-        verify windows + decode tokens) -> ONE ragged launch -> apply ->
-        retire.  Returns the requests that finished during this step.
+        """One engine iteration.  With ``overlap`` on (the default) this
+        is one turn of the dispatch/completion PIPELINE: pre-stage the
+        next pack while the previous call's launch is still on-device,
+        block on and commit that launch, then dispatch this call's
+        launch without materializing it.  Returns the requests that
+        finished — under overlap these are the completions of the
+        PREVIOUS call's dispatch (the pipeline's one-step latency).
+        With ``overlap`` off the dispatch completes in the same call and
+        the step is the classic synchronous admit -> schedule -> launch
+        -> apply -> retire iteration.
 
         With a tracer installed every phase lands in the step timeline
-        (admit / schedule / pack / block-table stage / device launch /
-        block-on-result / sample-commit / retire); with none the phase
+        (dispatch: admit / schedule / pack / block-table stage / device
+        launch; complete: block-on-result / sample-commit / retire; plus
+        prestage and the device in-flight window); with none the phase
         seams are single attribute checks."""
         tr = self.tracer
         if tr is None:
@@ -829,13 +922,40 @@ class LLMEngine:
         return finished
 
     def _step(self, tr) -> list:
-        finished = []
+        # outputs that finished inside an abort()'s pipeline flush
+        # surface here, so the step()-return channel never drops one
+        finished = self._pending_finished
+        self._pending_finished = []
+        if self._inflight is not None:
+            # the launch from the previous step() call is (possibly)
+            # still running on-device: do next step's speculative host
+            # work first, INSIDE that window, then block on the ticket
+            self._prestage(tr)
+            self._complete(tr, finished)
+        self._dispatch(tr)
+        if not self.overlap and self._inflight is not None:
+            self._complete(tr, finished)
 
+        ev = self.blocks.eviction_count
+        if ev != self._evictions_seen:
+            self.stats.record_evictions(ev - self._evictions_seen)
+            self._evictions_seen = ev
+        return finished
+
+    def _dispatch(self, tr) -> None:
+        """Admission + scheduling + packing + block-table staging + the
+        ragged launch, WITHOUT materializing results: the returned
+        device arrays ride an in-flight ``_StepTicket`` (JAX async
+        dispatch — nothing in this path forces a host sync on them).
+        ``_complete`` blocks on the ticket and commits."""
         plan = self.fault_plan
         if plan is not None:
             # fault seams fire BEFORE any scheduler mutation, so a crash
             # leaves queues and pool in the consistent between-steps
-            # state recovery replays from
+            # state recovery replays from.  advance() here keys the plan
+            # step on DISPATCH order, which equals completion order (the
+            # depth-1 pipeline completes ticket N before dispatching
+            # N+1), so a schedule means the same thing overlap on or off.
             plan.advance()
             if plan.take_pool_entry():
                 self.stats.record_fault("pool")
@@ -849,7 +969,14 @@ class LLMEngine:
                     f"injected step crash at plan step {plan.step}")
 
         if self.pressure is not None:
-            self.pressure.update(self.blocks)
+            # pages the prestage reserved for rows still alive are
+            # credited back: at this point in the SYNC engine's step
+            # they would not have been taken yet, so the free-page
+            # signal (and every tier decision derived from it) sees the
+            # identical per-step timeline
+            self.pressure.update(
+                self.blocks,
+                spec_reserved=sum(self._spec_pages.values()))
             self.stats.set_degradation_state(self.pressure.state)
             if self.pressure.evict_now:
                 n = self.blocks.evict_parked(self.pressure.evict_batch)
@@ -857,6 +984,7 @@ class LLMEngine:
                     self.stats.record_parked_evictions(n)
 
         if tr is not None:
+            t_d = tr.now()
             t = tr.now()
         admitted = self._admit()
         if admitted:
@@ -903,25 +1031,239 @@ class LLMEngine:
         if chunks or spec or batch:
             t0 = time.perf_counter()
             with RecordEvent("llm_engine.ragged_step"):
-                sampled, ok, spec_ok, spec_logits, chunk_slots, \
+                sampled, logits, fin, spec_slices, chunk_slots, \
                     batch_slots = self._run_ragged(chunks, spec, batch)
-            dur = time.perf_counter() - t0
-            self.stats.record_step(dur)
-            if tr is not None:
-                t = tr.now()
-            self._apply_ragged(chunks, spec, batch, sampled, ok, spec_ok,
-                               spec_logits, chunk_slots, batch_slots,
-                               dur, finished)
-            if tr is not None:
-                tr.complete("engine.sample_commit", t,
-                            track=self._trace_track,
-                            args={"finished": len(finished)})
+            now = time.perf_counter()
+            self._inflight = _StepTicket(
+                chunks=chunks, spec=spec, batch=batch, sampled=sampled,
+                logits=logits, fin=fin, spec_slices=spec_slices,
+                chunk_slots=chunk_slots, batch_slots=batch_slots,
+                dispatch_s=now - t0, t_launch=now,
+                launch_ns=tr.now() if tr is not None else 0,
+                inflight=self.overlap)
+        # prestage page credit expires: every reserved page is now
+        # either owned by a row this dispatch packed (its ensure() saw
+        # the page already in place) or was freed with its retired row
+        self._spec_pages.clear()
+        if tr is not None:
+            tr.complete("engine.dispatch", t_d, track=self._trace_track,
+                        args={"chunks": len(chunks), "spec": len(spec),
+                              "decode": len(batch),
+                              "launched": self._inflight is not None})
 
-        ev = self.blocks.eviction_count
-        if ev != self._evictions_seen:
-            self.stats.record_evictions(ev - self._evictions_seen)
-            self._evictions_seen = ev
-        return finished
+    def _complete(self, tr, finished: list, drop_rid=None) -> None:
+        """Block on the in-flight ticket and commit it: materialize the
+        sampled tokens / finiteness flags / verify logits, run the NaN
+        seam over the live rows, split the step timing into its
+        dispatch/block halves, and apply + retire.
+
+        ``drop_rid`` (abort-while-in-flight) discards that request's
+        packed rows unapplied: no token commit, no retirement, leaving
+        the request holding exactly the tokens the aborting caller
+        could observe."""
+        ticket = self._inflight
+        self._inflight = None
+        plan = self.fault_plan
+        if plan is not None and ticket.inflight:
+            # completion-order seams: fire while the ticket is genuinely
+            # in flight (overlap on), between launch and materialize —
+            # the window a real device fault or host stall would hit
+            slow = plan.take_inflight_slow()
+            if slow > 0.0:
+                self.stats.record_fault("inflight_slow")
+                time.sleep(slow)
+            if plan.take_inflight_crash():
+                self.stats.record_fault("inflight_crash")
+                raise InjectedFault(
+                    f"injected in-flight crash at plan step {plan.step}")
+        if tr is not None:
+            t_c = tr.now()
+            t = t_c
+        t0 = time.perf_counter()
+        sampled = np.asarray(ticket.sampled)
+        ok = np.asarray(ticket.fin)
+        logits = np.asarray(ticket.logits) if ticket.spec else None
+        block_s = time.perf_counter() - t0
+        if tr is not None:
+            tr.complete("engine.block_on_result", t,
+                        track=self._trace_track)
+            if ticket.launch_ns and ticket.inflight:
+                # X event spanning launch -> materialized: the window
+                # host work can hide inside (step_timeline.py intersects
+                # host-phase spans with these to report overlap ACHIEVED).
+                # Synchronous tickets (overlap off, or the drain path)
+                # emit no window: nothing host ran while they flew.
+                tr.complete("engine.device_inflight", ticket.launch_ns,
+                            track=self._trace_track,
+                            args={"rows": len(ticket.chunks)
+                                  + len(ticket.spec)
+                                  + len(ticket.batch)})
+        ok = self._inject_nan(ok, ticket.chunk_slots + ticket.batch_slots
+                              + [o for o, _ in ticket.spec_slices])
+        chunks, spec, batch = ticket.chunks, ticket.spec, ticket.batch
+        chunk_slots = ticket.chunk_slots
+        batch_slots = ticket.batch_slots
+        spec_slices = ticket.spec_slices
+        if drop_rid is not None:
+            kc = [i for i, (r, _) in enumerate(chunks) if r.rid != drop_rid]
+            chunks = [chunks[i] for i in kc]
+            chunk_slots = [chunk_slots[i] for i in kc]
+            ks = [i for i, (r, _, _) in enumerate(spec)
+                  if r.rid != drop_rid]
+            spec = [spec[i] for i in ks]
+            spec_slices = [spec_slices[i] for i in ks]
+            kb = [i for i, r in enumerate(batch) if r.rid != drop_rid]
+            batch = [batch[i] for i in kb]
+            batch_slots = [batch_slots[i] for i in kb]
+        spec_ok = [bool(ok[o:o + n].all())
+                   for o, n in spec_slices]
+        spec_logits = None
+        if spec:
+            spec_logits = [logits[o:o + n]
+                           for o, n in spec_slices]
+        # dur is the engine's ACTIVE time on this launch (host packing +
+        # the residual block); the device time hidden under prestage and
+        # the inter-call gap is exactly what the overlap bought
+        dur = ticket.dispatch_s + block_s
+        self.stats.record_step(dur, dispatch_s=ticket.dispatch_s,
+                               block_s=block_s)
+        if tr is not None:
+            t = tr.now()
+        self._apply_ragged(chunks, spec, batch, sampled, ok, spec_ok,
+                           spec_logits, chunk_slots, batch_slots, dur,
+                           finished)
+        if tr is not None:
+            tr.complete("engine.sample_commit", t,
+                        track=self._trace_track,
+                        args={"finished": len(finished)})
+            tr.complete("engine.complete", t_c, track=self._trace_track,
+                        args={"finished": len(finished)})
+
+    def _prestage(self, tr) -> None:
+        """Speculatively stage the NEXT dispatch's pure-decode pack
+        while the in-flight ticket runs on-device.
+
+        A surviving decode row's next position is known before the
+        ticket's sampled token is: it packs exactly kv_len+1 next step.
+        So page reservation (``ensure``), the block-table rows, the
+        kv-length column, and the per-row sampling keys all pre-stage
+        into the idle decode buffer; only the token-id column (and the
+        repetition-penalty masks) are patched in at dispatch.  The
+        prestage NEVER preempts — a short pool abandons it, and the
+        partial row-local writes are idempotent (the normal incremental
+        path redoes them).  Rollback rides the existing machinery: a row
+        the completion retires/quarantines (or a later preemption)
+        returns its speculatively reserved page with the rest of its
+        table through free()/release(), and the layout-signature check
+        at dispatch discards the stale pack."""
+        if not self.overlap:
+            return
+        ticket = self._inflight
+        if ticket.chunks or ticket.spec or not ticket.batch:
+            return                      # only pure-decode launches
+        if self._waiting:
+            return                      # next step admits -> mixed pack
+        for r in self._running:
+            if r.cached < len(r.tokens):
+                return                  # mid-prefill row -> mixed pack
+        if self.drafter is not None:
+            for r in ticket.batch:
+                if not r.spec_disabled and r.spec_k > 0:
+                    return              # next step may pack verify rows
+        batch = ticket.batch            # already slot-sorted at dispatch
+        self._prestaged = None
+        if tr is not None:
+            t_p = tr.now()
+        # reserve each row's next write: pre-apply cached+2 is exactly
+        # the post-apply cached+1 the dispatch's ensure() will ask for,
+        # so that ensure becomes a no-op.  Newly taken pages are
+        # tracked per rid so the pressure signal credits them back
+        # until this dispatch (or a retirement) owns them.
+        abandoned = False
+        for req in batch:
+            before = self.blocks.num_free
+            try:
+                if not self.blocks.ensure(req.rid, req.cached + 2):
+                    abandoned = True
+            except BlockPoolExhausted:
+                abandoned = True
+            if abandoned:
+                break
+            took = before - self.blocks.num_free
+            if took > 0:
+                self._spec_pages[req.rid] = \
+                    self._spec_pages.get(req.rid, 0) + took
+        if abandoned:
+            if tr is not None:
+                tr.complete("engine.prestage", t_p,
+                            track=self._trace_track,
+                            args={"abandoned": "pool"})
+            return
+        bi = 1 - self._d_cur            # the buffer NOT in flight
+        buf = self._dbufs[bi]
+        samp = buf.samp
+        n = len(batch)
+        layout = tuple(r.rid for r in batch)
+        if layout != buf.layout:
+            buf.layout = layout
+            buf.bt_ver.clear()
+            buf.bt[:] = NULL_BLOCK
+            buf.kvl[:] = 0
+            buf.cu[:n + 1] = np.arange(n + 1)
+            buf.cu[n + 1:] = n
+            samp["temps"][:] = 0.0
+            samp["top_k"][:] = 0
+            samp["top_p"][:] = 1.0
+            samp["penalty"][:] = 1.0
+            samp["seen"][:] = False
+            for s, req in enumerate(batch):
+                samp["temps"][s] = req.temperature
+                samp["top_k"][s] = req.top_k
+                samp["top_p"][s] = req.top_p
+                samp["penalty"][s] = req.repetition_penalty
+        if tr is not None:
+            t = tr.now()
+        for s, req in enumerate(batch):
+            buf.kvl[s] = req.cached + 2      # post-apply cached+1
+            if req.temperature > 0.0:
+                # the key for the NEXT position: len(generated) will
+                # have advanced by one when this buffer launches
+                samp["keys"][s] = self._req_key(req, ahead=1)
+        if tr is not None:
+            tr.complete("engine.pack", t, track=self._trace_track,
+                        args={"rows": n, "prestage": True})
+            t = tr.now()
+        for s, req in enumerate(batch):
+            ver = self.blocks.table_version(req.rid)
+            if buf.bt_ver.get(req.rid) != ver:
+                buf.bt[s] = self.blocks.padded_table(req.rid, self.nblk)
+                buf.bt_ver[req.rid] = ver
+        if tr is not None:
+            tr.complete("engine.block_table_stage", t,
+                        track=self._trace_track,
+                        args={"rows": n, "prestage": True})
+        self._prestaged = (bi, layout)
+        if tr is not None:
+            tr.complete("engine.prestage", t_p, track=self._trace_track,
+                        args={"rows": n})
+
+    def _invalidate_bt(self, rid: int) -> None:
+        """Drop both decode buffers' staged block-table rows for rid.
+        Called whenever a rid's staged table can go stale without a
+        version bump: admission re-acquires reset the version counter,
+        and preemption frees the table outright."""
+        for buf in self._dbufs:
+            buf.bt_ver.pop(rid, None)
+
+    def _break_decode_layout(self) -> None:
+        """Invalidate the decode fast path entirely: any mixed launch
+        (and post-verify truncate) rewrites tables and row order, so
+        both buffers full-restage at their next pure-decode launch and
+        any pre-staged pack is discarded."""
+        for buf in self._dbufs:
+            buf.layout = ()
+            buf.bt_ver.clear()
+        self._prestaged = None
 
     def _apply_ragged(self, chunks, spec, batch, sampled, ok, spec_ok,
                       spec_logits, chunk_slots, batch_slots, dur,
@@ -1015,6 +1357,7 @@ class LLMEngine:
         hit).  Clients see finish_reason="numerical_error"; the rest of
         the batch is untouched."""
         self.blocks.release(req.rid)
+        self._spec_pages.pop(req.rid, None)
         self._running.remove(req)
         self._release_slot(req)
         if self.drafter is not None:
@@ -1069,7 +1412,7 @@ class LLMEngine:
             self._waiting.popleft()
             req.arrival = self._arrival
             self._arrival += 1
-            req.bt_version = -1
+            self._invalidate_bt(req.rid)
             self._claim_slot(req)
             self._running.append(req)
             admitted.append(req)
@@ -1163,11 +1506,12 @@ class LLMEngine:
         very pages this preemption returned and re-prefills only the
         tail."""
         self.blocks.free(req.rid)
+        self._spec_pages.pop(req.rid, None)
         self._running.remove(req)
         self._release_slot(req)
         req.tokens = list(req.prompt) + list(req.generated)
         req.cached = 0
-        req.bt_version = -1
+        self._invalidate_bt(req.rid)
         self._waiting.appendleft(req)
         if self.drafter is not None:
             self.drafter.release(req.rid)
@@ -1189,6 +1533,7 @@ class LLMEngine:
         if tr is not None:
             t = tr.now()
         self.blocks.free(req.rid)
+        self._spec_pages.pop(req.rid, None)
         self._running.remove(req)
         self._release_slot(req)
         out = RequestOutput(rid=req.rid, prompt=list(req.prompt),
@@ -1706,9 +2051,10 @@ class LLMEngine:
 
         Row order: prefill chunks (scheduler order), speculative
         [last_token, drafts...] windows, plain decode tokens (slot
-        order).  Returns (sampled tokens, per-logit-row finite flags,
-        per-spec-row finite flags, per-spec-row logits, chunk logit
-        slots, decode logit slots)."""
+        order).  Returns (sampled tokens, per-spec-row logits or None,
+        per-logit-row finite flags, spec row slices, chunk logit slots,
+        decode logit slots) — the first three are UNMATERIALIZED device
+        arrays the caller's completion ticket blocks on later."""
         total = sum(n for _, n in chunks) \
             + sum(len(d) + 1 for _, d, _ in spec) + len(batch)
         Tq = self._ragged_bucket(total)
@@ -1783,10 +2129,8 @@ class LLMEngine:
 
         # the launch (re)packed every row's table fresh, and post-verify
         # truncate changes tables again — break the decode fast path's
-        # layout reuse and force per-row repacks next step
-        for req, _, _ in rows:
-            req.bt_version = -1
-        self._d_layout = ()
+        # layout reuse and force full restages next step
+        self._break_decode_layout()
 
         if tr is not None:
             t = tr.now()
@@ -1796,41 +2140,43 @@ class LLMEngine:
             tr.complete("engine.device_launch", t,
                         track=self._trace_track,
                         args={"bucket": int(Tq)})
-            t = tr.now()
-        sampled = np.asarray(sampled)
-        ok = np.asarray(fin)
-        if spec:
-            logits = np.asarray(logits)
-        if tr is not None:
-            tr.complete("engine.block_on_result", t,
-                        track=self._trace_track)
-        ok = self._inject_nan(ok, chunk_slots + batch_slots
-                              + [o for o, _ in spec_slices])
-        spec_ok = [bool(ok[o:o + n].all()) for o, n in spec_slices]
-        spec_logits = None
-        if spec:
-            spec_logits = [logits[o:o + n] for o, n in spec_slices]
-        return (sampled, ok, spec_ok, spec_logits,
-                chunk_slots, batch_slots)
+        # NO materialization here: sampled/logits/fin return as async
+        # device arrays; _complete blocks on them (the dispatch path
+        # must never force a host sync on step-program outputs)
+        if not spec:
+            logits = None
+        return sampled, logits, fin, spec_slices, chunk_slots, batch_slots
 
     def _run_ragged_decode(self, batch: list, Tq: int):
         """Pure-decode launch over the persistent host buffers.  Rows
         repack incrementally ONLY while the layout signature — the rid
         order of the packed rows — is unchanged since the last pure-
-        decode step; retirement, admission, preemption, or any mixed
-        launch in between changes the signature and forces a full
-        repack, so ragged packing never reuses a stale row order.
-        Within a stable layout, block-table rows still refresh whenever
-        the sequence's table version bumped (page growth/CoW)."""
+        decode step through THIS buffer; retirement, admission,
+        preemption, or any mixed launch in between changes the
+        signature and forces a full repack, so ragged packing never
+        reuses a stale row order.  Within a stable layout, block-table
+        rows still refresh whenever the sequence's table version bumped
+        (page growth/CoW).
+
+        With overlap on, launches ALTERNATE between the two buffer sets
+        (the previous launch may still be in flight and CPU PJRT can
+        alias its input arrays) and a valid ``_prestage`` pack for this
+        buffer+layout shrinks the incremental work to patching the
+        token-id column and the penalty masks."""
         n = len(batch)
-        samp = self._d_samp
+        bi = (1 - self._d_cur) if self.overlap else 0
+        buf = self._dbufs[bi]
+        samp = buf.samp
         layout = tuple(r.rid for r in batch)
-        if layout != self._d_layout:
-            self._d_layout = layout
-            self._d_bt[:] = NULL_BLOCK
-            self._d_kvl[:] = 0
-            self._d_cu[:n + 1] = np.arange(n + 1)
-            self._d_cu[n + 1:] = n
+        pre = self._prestaged == (bi, layout)
+        self._prestaged = None              # single-use
+        if layout != buf.layout:
+            pre = False
+            buf.layout = layout
+            buf.bt[:] = NULL_BLOCK
+            buf.kvl[:] = 0
+            buf.cu[:n + 1] = np.arange(n + 1)
+            buf.cu[n + 1:] = n
             samp["temps"][:] = 0.0
             samp["top_k"][:] = 0
             samp["top_p"][:] = 1.0
@@ -1841,50 +2187,51 @@ class LLMEngine:
                 samp["top_k"][s] = req.top_k
                 samp["top_p"][s] = req.top_p
                 samp["penalty"][s] = req.repetition_penalty
-                req.bt_version = -1          # force a table repack below
+            buf.bt_ver.clear()               # force table repacks below
         tr = self.tracer
         if tr is not None:
             t = tr.now()
-        for s, req in enumerate(batch):
-            self._d_toks[s] = req.generated[-1]
-            self._d_kvl[s] = req.cached + 1
-            if req.seen is not None:
-                np.copyto(samp["seen"][s], req.seen)
-            if req.temperature > 0.0:
-                samp["keys"][s] = self._req_key(req)
+        if pre:
+            # prestage already wrote kvl and the sampling keys; only
+            # the column that depends on the completed step's SAMPLED
+            # token needs patching
+            for s, req in enumerate(batch):
+                buf.toks[s] = req.generated[-1]
+                if req.seen is not None:
+                    np.copyto(samp["seen"][s], req.seen)
+        else:
+            for s, req in enumerate(batch):
+                buf.toks[s] = req.generated[-1]
+                buf.kvl[s] = req.cached + 1
+                if req.seen is not None:
+                    np.copyto(samp["seen"][s], req.seen)
+                if req.temperature > 0.0:
+                    samp["keys"][s] = self._req_key(req)
         if tr is not None:
             tr.complete("engine.pack", t, track=self._trace_track,
                         args={"rows": n, "tokens": n, "bucket": int(Tq),
-                              "fast_path": True})
+                              "fast_path": True, "prestaged": pre})
             t = tr.now()
         for s, req in enumerate(batch):
             ver = self.blocks.table_version(req.rid)
-            if req.bt_version != ver:
-                self._d_bt[s] = self.blocks.padded_table(req.rid,
-                                                         self.nblk)
-                req.bt_version = ver
+            if buf.bt_ver.get(req.rid) != ver:
+                buf.bt[s] = self.blocks.padded_table(req.rid, self.nblk)
+                buf.bt_ver[req.rid] = ver
         if tr is not None:
             tr.complete("engine.block_table_stage", t,
                         track=self._trace_track, args={"rows": n})
         self.pad_stats["legacy_padded"] += self.max_num_seqs
         if tr is not None:
             t = tr.now()
-        sampled, _, fin = self._launch_ragged(Tq, self._d_toks,
-                                              self._d_cu, self._d_kvl,
-                                              self._d_bt, self._d_lidx,
-                                              samp, n)
+        sampled, _, fin = self._launch_ragged(Tq, buf.toks, buf.cu,
+                                              buf.kvl, buf.bt,
+                                              self._d_lidx, samp, n)
         if tr is not None:
             tr.complete("engine.device_launch", t,
                         track=self._trace_track,
                         args={"bucket": int(Tq)})
-            t = tr.now()
-        sampled = np.asarray(sampled)
-        fin = np.asarray(fin)
-        if tr is not None:
-            tr.complete("engine.block_on_result", t,
-                        track=self._trace_track)
-        ok = self._inject_nan(fin, list(range(n)))
-        return sampled, ok, [], None, [], list(range(n))
+        self._d_cur = bi
+        return sampled, None, fin, [], [], list(range(n))
 
     def _inject_nan(self, ok, live_slots: list):
         """FaultPlan NaN seam: corrupt one LIVE logit row's finiteness
@@ -1903,11 +2250,13 @@ class LLMEngine:
         self.stats.record_fault("nan")
         return ok
 
-    def _req_key(self, req):
+    def _req_key(self, req, ahead: int = 0):
         # key for token i of request r depends only on (seed, i): sampling
-        # is reproducible across scheduling orders and preemptions
+        # is reproducible across scheduling orders and preemptions.
+        # ahead=1 derives the NEXT position's key (the prestage path:
+        # len(generated) will have advanced by one at dispatch time)
         key = jax.random.fold_in(jax.random.PRNGKey(req.seed),
-                                 len(req.generated))
+                                 len(req.generated) + ahead)
         return np.asarray(key, np.uint32)
 
 

@@ -219,6 +219,14 @@ class ServingStats:
         self._step_hist = _Hist()
         self.engine_steps = 0            # LLMEngine.step launch cycles
         self.step_time = 0.0
+        # async-pipeline surface (PR 12): each launch cycle's wall time
+        # split into the host dispatch section (pack/stage/enqueue) vs
+        # the completion block (waiting on device results) — under
+        # overlap the block shrinks toward zero while dispatch stays
+        self.dispatch_time = 0.0
+        self.block_time = 0.0
+        self._dispatch_lat = _Reservoir(r, seed=5)
+        self._block_lat = _Reservoir(r, seed=6)
         self._t_start = time.monotonic() # process-lifetime uptime anchor
 
     # -- recording (engine-facing) ------------------------------------------
@@ -241,13 +249,25 @@ class ServingStats:
         self._itl_hist.add(float(duration_s), int(n_tokens))
         self._occupancy.add(float(occupancy))
 
-    def record_step(self, duration_s: float) -> None:
+    def record_step(self, duration_s: float, dispatch_s: float = 0.0,
+                    block_s: float = 0.0) -> None:
         """One launch cycle's wall-clock duration — the whole
-        pack/stage/launch/sync section regardless of phase mix."""
+        pack/stage/launch/sync section regardless of phase mix.
+
+        ``dispatch_s``/``block_s`` split that duration into the host
+        dispatch section (admit/schedule/pack/stage/enqueue, which the
+        async engine runs while the previous launch is still on-device)
+        and the completion block (materializing device results).  A
+        caller that can't attribute the split leaves both at 0; the
+        fused total stays authoritative either way."""
         d = float(duration_s)
         self.engine_steps += 1
         self.step_time += d
         self._step_hist.add(d)
+        self.dispatch_time += float(dispatch_s)
+        self.block_time += float(block_s)
+        self._dispatch_lat.add(float(dispatch_s))
+        self._block_lat.add(float(block_s))
 
     def record_admission(self, n: int = 1) -> None:
         self.admitted += int(n)
@@ -458,6 +478,12 @@ class ServingStats:
             "tuning_cache_misses": dict(self.tuning_misses),
             "engine_steps": self.engine_steps,
             "step_time_s": round(self.step_time, 6),
+            "dispatch_time_s": round(self.dispatch_time, 6),
+            "block_time_s": round(self.block_time, 6),
+            "dispatch_ms_p50": round(1e3 * self._dispatch_lat.percentile(50), 3),
+            "dispatch_ms_p99": round(1e3 * self._dispatch_lat.percentile(99), 3),
+            "block_ms_p50": round(1e3 * self._block_lat.percentile(50), 3),
+            "block_ms_p99": round(1e3 * self._block_lat.percentile(99), 3),
             "ttft_hist_buckets": self._ttft_hist.buckets(),
             "ttft_hist_sum": self._ttft_hist.total,
             "ttft_hist_count": self._ttft_hist.count,
@@ -503,7 +529,9 @@ class ServingStats:
                 "verify_tokens_per_s", "emitted_tokens_per_s")
     _MAX = ("p50_token_ms", "p99_token_ms", "itl_p50_ms", "itl_p99_ms",
             "ttft_p50_ms", "ttft_p99_ms", "max_prefill_queue_depth",
-            "uptime_seconds", "degradation_state")
+            "uptime_seconds", "degradation_state",
+            "dispatch_ms_p50", "dispatch_ms_p99",
+            "block_ms_p50", "block_ms_p99")
     _MEAN = ("mean_batch_occupancy", "mean_prefill_queue_depth")
 
     @staticmethod

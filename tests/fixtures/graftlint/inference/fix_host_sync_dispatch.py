@@ -1,0 +1,21 @@
+"""Seeds host-sync-in-dispatch-path: the dispatch section coerces a
+step-program output with int(), blocking on the in-flight device
+program and re-serializing host packing with device compute.  The
+completion-side twin (materialization belongs there) and the
+launch-free helper stay silent."""
+import numpy as np
+
+
+def dispatch_step(engine, rows):
+    sampled, fin = launch_ragged(engine, rows)
+    engine.ticket = (sampled, fin)
+    return int(sampled[0])        # fires: host sync inside dispatch
+
+
+def complete_step(engine):
+    sampled, fin = engine.ticket
+    return np.asarray(sampled)    # silent: the completion seam owns syncs
+
+
+def launch_ragged(engine, rows):
+    return engine.program(rows)   # silent: enqueue only, no materialize

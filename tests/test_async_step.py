@@ -1,0 +1,237 @@
+"""Async dispatch/completion pipeline: overlap-on must be a pure
+latency optimization.
+
+The contract (CPU, paged kernel in interpret mode):
+
+- byte-identity: greedy outputs of an ``overlap=True`` engine match an
+  ``overlap=False`` engine token for token on the 16-request ragged
+  audit stream, across speculation on/off, prefix cache on/off,
+  float32/int8 KV pages, and tp=1/2 — with compile_counts EXACTLY
+  equal (the pipeline adds zero programs);
+- pipeline shape: outputs surface one step() call later than the
+  synchronous engine (depth-1 queue), has_unfinished() covers the
+  in-flight ticket, and run() drains it;
+- abort while a ticket is in flight: the flush drops the victim's
+  packed rows unapplied (the abort output reports the tokens the
+  caller has actually observed), batchmates lose nothing, and the
+  pool comes back clean;
+- tracing: overlap-on emits the dispatch/complete/prestage wrapper
+  spans and engine.device_inflight windows; overlap-off emits none of
+  the in-flight windows (step_timeline.py's "synchronous" reading).
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import LLMEngine
+from paddle_tpu.profiler import Tracer
+
+VOCAB = 97
+
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+CFG = LlamaConfig.tiny(vocab=VOCAB, hidden=32, layers=2, heads=4, ffn=64,
+                       seq=64)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return LlamaForCausalLM(CFG)
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_num_seqs", 8)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_model_len", 64)
+    kw.setdefault("max_prefill_tokens", 256)
+    kw.setdefault("prefill_token_bucket", 64)
+    return LLMEngine(model, **kw)
+
+
+def _audit_drive(model, overlap, **kw):
+    """The 16-request ragged audit stream; (engine, outputs-by-index)."""
+    eng = _engine(model, overlap=overlap, **kw)
+    rng = np.random.RandomState(7)
+    shapes = [(4, 8), (9, 8), (13, 6)]
+    order = {}
+    for i in range(16):
+        n, max_new = shapes[i % len(shapes)]
+        p = rng.randint(0, VOCAB, n).tolist()
+        order[eng.add_request(p, max_new_tokens=max_new)] = i
+    outs = eng.run()
+    assert len(outs) == 16
+    return eng, {order[rid]: (tuple(o.generated), o.finish_reason)
+                 for rid, o in outs.items()}
+
+
+# ---------------------------------------------------------------------------
+# byte-identity across the config matrix, compile budget pinned
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kw", [
+    {},                                                   # baseline f32
+    {"enable_prefix_caching": False},                     # cache off
+    {"drafter": "ngram", "spec_k": 3},                    # speculation on
+    {"kv_dtype": "int8"},                                 # quantized pages
+    {"kv_dtype": "int8", "drafter": "ngram", "spec_k": 3},
+    {"tp": 2},                                            # sharded step
+], ids=["f32", "cache-off", "spec", "int8", "int8-spec", "tp2"])
+def test_overlap_byte_identical_to_sync(model, kw):
+    """Dispatch order == completion order (depth-1 queue), the prestage
+    only reserves what the next dispatch would have, and sampling keys
+    are position-keyed — so the async engine's token stream is the
+    synchronous engine's, bit for bit, and it compiles NOTHING new."""
+    e_on, o_on = _audit_drive(model, True, **kw)
+    e_off, o_off = _audit_drive(model, False, **kw)
+    assert o_on == o_off
+    assert e_on.compile_counts == e_off.compile_counts
+    for eng in (e_on, e_off):
+        assert eng.blocks.num_used == 0
+        eng.blocks.check_invariants()
+    assert e_on._spec_pages == {}
+
+
+# ---------------------------------------------------------------------------
+# pipeline shape: depth-1 queue, one extra draining step
+# ---------------------------------------------------------------------------
+
+def test_outputs_surface_one_step_later_and_run_drains(model):
+    rng = np.random.RandomState(7)
+    prompt = rng.randint(0, VOCAB, 6).tolist()
+
+    def steps_to_finish(overlap):
+        eng = _engine(model, overlap=overlap)
+        eng.add_request(prompt, max_new_tokens=4)
+        first_returns, n = [], 0
+        while eng.has_unfinished():
+            outs = eng.step()
+            n += 1
+            if n == 1:
+                first_returns.extend(outs)
+        assert eng.blocks.num_used == 0
+        return first_returns, n
+
+    sync_first, sync_n = steps_to_finish(False)
+    async_first, async_n = steps_to_finish(True)
+    # the async engine's first step() only FILLS the pipeline: the
+    # prefill is launched but its outputs surface next call, and the
+    # whole run takes exactly one extra draining call
+    assert async_first == []
+    assert async_n == sync_n + 1
+
+
+def test_has_unfinished_covers_inflight_ticket(model):
+    eng = _engine(model, overlap=True)
+    eng.add_request([3, 1, 4, 1, 5], max_new_tokens=1)
+    eng.step()                          # dispatched, nothing completed
+    assert eng._inflight is not None
+    assert eng.has_unfinished()         # only the ticket keeps it alive
+    outs = eng.step()                   # completes (and dispatches nothing)
+    assert [o for o in outs if o.finish_reason]
+    assert eng._inflight is None
+    assert not eng.has_unfinished()
+
+
+# ---------------------------------------------------------------------------
+# abort while in flight: flush, drop, nothing else disturbed
+# ---------------------------------------------------------------------------
+
+def test_abort_while_inflight_drops_victim_keeps_batchmates(model):
+    rng = np.random.RandomState(19)
+    pa = rng.randint(0, VOCAB, 8).tolist()
+    pb = rng.randint(0, VOCAB, 11).tolist()
+
+    base = _engine(model, overlap=False)
+    base.add_request(pb, max_new_tokens=8)
+    b_full = tuple(base.run().popitem()[1].generated)
+
+    eng = _engine(model, overlap=True)
+    ra = eng.add_request(pa, max_new_tokens=8)
+    rb = eng.add_request(pb, max_new_tokens=8)
+    for _ in range(4):
+        eng.step()
+    assert eng._inflight is not None    # a decode launch is in flight
+    out_a = eng.abort(ra)
+    # the flush dropped the in-flight step's row for the victim: its
+    # abort output is exactly the prefix the caller had already seen
+    assert out_a.finish_reason == "aborted"
+    assert eng._inflight is None
+    assert len(out_a.generated) < 8
+    # the batchmate is untouched: it finishes byte-identical to a run
+    # that never shared a batch with the aborted row
+    outs = eng.run()
+    assert tuple(outs[rb].generated) == b_full
+    assert outs[rb].finish_reason in ("length", "eos")
+    assert eng.blocks.num_used == 0
+    eng.blocks.check_invariants()
+    assert eng._spec_pages == {}
+
+
+def test_abort_flush_buffers_batchmate_finishes(model):
+    """If the abort's pipeline flush happens to FINISH a batchmate, its
+    output must still come out of the step()-return channel (buffered,
+    drained by the next step call) — never silently dropped, and
+    has_unfinished() keeps the driving loop alive until it surfaces."""
+    rng = np.random.RandomState(23)
+    pa = rng.randint(0, VOCAB, 5).tolist()
+    pb = rng.randint(0, VOCAB, 7).tolist()
+    eng = _engine(model, overlap=True)
+    ra = eng.add_request(pa, max_new_tokens=8)
+    rb = eng.add_request(pb, max_new_tokens=1)   # finishes on its first token
+    finishes = []
+    assert eng.step() == []                       # both prefills in flight
+    assert eng._inflight is not None
+    # the flush inside abort() retires rb OUTSIDE any step() call
+    out_a = eng.abort(ra)
+    assert out_a.finish_reason == "aborted"
+    assert eng._pending_finished                  # rb's output, buffered
+    assert eng.has_unfinished()                   # loop must keep driving
+    while eng.has_unfinished():
+        finishes.extend(eng.step())
+    by_rid = {o.rid: o for o in finishes}
+    assert rb in by_rid                           # surfaced, not dropped
+    assert len(by_rid[rb].generated) == 1
+    assert by_rid[rb].finish_reason in ("length", "eos")
+    assert not eng.has_unfinished()
+    assert eng.blocks.num_used == 0
+    eng.blocks.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# trace surface: wrapper spans + in-flight windows
+# ---------------------------------------------------------------------------
+
+def _traced_events(model, overlap):
+    eng = _engine(model, overlap=overlap)
+    tr = Tracer()
+    eng.set_tracer(tr)
+    rng = np.random.RandomState(29)
+    for _ in range(3):
+        eng.add_request(rng.randint(0, VOCAB, 6).tolist(),
+                        max_new_tokens=6)
+    eng.run()
+    # raw tuples: (ph, name, ts_ns, dur_ns, tid, args, id)
+    return tr.events()
+
+
+def test_overlap_trace_emits_pipeline_spans(model):
+    evs = _traced_events(model, True)
+    names = [e[1] for e in evs]
+    for span in ("engine.dispatch", "engine.complete", "engine.prestage",
+                 "engine.device_inflight"):
+        assert span in names, span
+    # the prestage stamps its pack/block-table work as ordinary leaf
+    # phases marked prestage=True, so step_timeline.py can intersect
+    # them with the in-flight windows
+    prestaged_packs = [e for e in evs if e[1] == "engine.pack"
+                       and (e[5] or {}).get("prestage")]
+    assert prestaged_packs
+
+
+def test_sync_trace_has_no_inflight_windows(model):
+    names = [e[1] for e in _traced_events(model, False)]
+    assert "engine.device_inflight" not in names
+    assert "engine.prestage" not in names
+    # the dispatch/complete wrappers still bracket the synchronous
+    # step's two halves — the attribution split exists either way
+    assert "engine.dispatch" in names
+    assert "engine.complete" in names

@@ -446,6 +446,95 @@ def test_chaos_acceptance_recovery_byte_identical(model):
     assert snap["uptime_seconds"] > 0.0
 
 
+def test_inflight_fault_recovery_discards_prestaged_pack(model):
+    """Crash and hang injected WHILE a step is in flight (the async
+    pipeline's completion seam, between a launch and its
+    materialization): the runner's journal replay must recover exactly
+    as it does for synchronous faults — the in-flight launch and the
+    speculatively pre-staged N+1 pack simply die with the old engine,
+    never having touched the journal.  Every output is byte-identical
+    to the fault-free baseline (these faults poison nothing), zero
+    pages leak (including speculatively reserved ones), and the restart
+    counter advances once per fault."""
+    reqs = _requests(24, seed=7)
+    base_eng, base = _run_direct(model, reqs)
+    budget = dict(base_eng.compile_counts)
+    assert budget == {"ragged": 2, "cow": 0}
+
+    # in-flight crash at 5 (in-thread recovery), in-flight hang at 9
+    # (the sleep sits between launch and materialize; the watchdog must
+    # still catch it there)
+    plan = FaultPlan(seed=13, inflight_crash_steps=(5,),
+                     inflight_slow_steps={9: 45.0})
+
+    def factory():
+        return _engine(model)
+
+    eng = factory()
+    assert eng.overlap                            # seams need the pipeline
+    eng.set_fault_plan(plan)
+    runner = EngineRunner(eng, max_pending=48, engine_factory=factory,
+                          step_deadline_s=12.0).start()
+    queues = []
+    try:
+        for r in reqs:
+            q = queue.Queue()
+            queues.append(q)
+            runner.submit(r["prompt"], deliver=q.put_nowait,
+                          max_new_tokens=r["max_new_tokens"],
+                          temperature=r["temperature"], seed=r["seed"])
+        streams = [_collect(q) for q in queues]
+    finally:
+        assert runner.drain(timeout_s=120.0)
+
+    fin = runner.engine
+    assert fin is not eng
+    stats = fin.stats
+    assert stats.fault_injections.get("inflight_crash") == 1
+    assert stats.fault_injections.get("inflight_slow") == 1
+    assert plan.exhausted()
+    assert stats.engine_restarts >= 2
+    assert runner.restarts == stats.engine_restarts
+
+    # no poisoned rows here: EVERY stream is byte-identical to the
+    # fault-free baseline, token-by-token view included — proof the
+    # discarded in-flight step and its pre-staged successor never
+    # leaked a token into the journal
+    for i, (toks, out) in enumerate(streams):
+        assert toks == list(out.generated)
+        assert out.generated == base[i].generated, f"request {i} diverged"
+        assert out.finish_reason == base[i].finish_reason
+
+    # zero leaked pages, including speculatively reserved prestage pages
+    assert fin.blocks.num_used == 0
+    assert fin._spec_pages == {}
+    fin.blocks.check_invariants()
+    assert fin.compile_counts == budget
+
+
+def test_inflight_seams_never_fire_synchronously(model):
+    """With overlap off no launch ever crosses a step boundary, so the
+    in-flight seams must never fire: the plan stays armed and the run
+    completes fault-free."""
+    reqs = _requests(6, seed=7)
+    plan = FaultPlan(seed=13, inflight_crash_steps=(2,),
+                     inflight_slow_steps={3: 30.0})
+    eng = _engine(model, overlap=False)
+    eng.set_fault_plan(plan)
+    outs = {}
+    for i, r in enumerate(reqs):
+        eng.add_request(r["prompt"], max_new_tokens=r["max_new_tokens"],
+                        temperature=r["temperature"], seed=r["seed"],
+                        on_finish=lambda o, i=i: outs.__setitem__(i, o))
+    while eng.has_unfinished():
+        eng.step()
+    assert len(outs) == len(reqs)
+    assert "inflight_crash" not in eng.stats.fault_injections
+    assert "inflight_slow" not in eng.stats.fault_injections
+    assert not plan.exhausted()                   # both still armed
+    assert eng.blocks.num_used == 0
+
+
 # ---------------------------------------------------------------------------
 # injected connection drop at the frontend seam
 # ---------------------------------------------------------------------------

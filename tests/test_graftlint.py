@@ -52,6 +52,8 @@ def _lint_fix(name):
      "collective-outside-shard-map", 11, "gather_logits", ERROR),
     (os.path.join("inference", "fix_wallclock_timing.py"),
      "wallclock-in-timing-path", 8, "measure_step", WARNING),
+    (os.path.join("inference", "fix_host_sync_dispatch.py"),
+     "host-sync-in-dispatch-path", 12, "dispatch_step", WARNING),
     (os.path.join("pallas", "fix_untuned_launch.py"),
      "untuned-pallas-launch", 15, "hardcoded_launch", WARNING),
 ])
@@ -261,7 +263,7 @@ def test_every_catalog_rule_is_exercised():
         "mutable-default-arg", "unkeyed-jit", "attention-program-budget",
         "quantized-kv-float32-page", "swallowed-exception",
         "collective-outside-shard-map", "untuned-pallas-launch",
-        "wallclock-in-timing-path",
+        "wallclock-in-timing-path", "host-sync-in-dispatch-path",
         "undonated-buffer", "host-callback", "dtype-promotion",
         "dead-code", "dead-input", "passthrough-output",
     }

@@ -128,6 +128,15 @@ def test_serve_bench_mixed_emits_padding_surface():
         < record["legacy_padding_waste_ratio"]
     assert record["padding_waste_reduction"] > 0
     assert record["p99_token_ms"] >= record["p50_token_ms"] > 0
+    # async-pipeline A/B: BOTH arms ride the one record, each with its
+    # wall-clock, dispatch/block split and host-bubble fraction
+    assert record["overlap"] == "on"
+    for arm in ("on", "off"):
+        assert record[f"overlap_{arm}_wall_s"] > 0
+        assert record[f"overlap_{arm}_tokens_per_s"] > 0
+        assert record[f"overlap_{arm}_dispatch_time_s"] > 0
+        assert record[f"overlap_{arm}_block_time_s"] > 0
+        assert 0.0 < record[f"overlap_{arm}_host_bubble_frac"] < 1.0
 
 
 def test_serve_bench_trace_writes_loadable_step_timeline(tmp_path):
@@ -176,6 +185,41 @@ def test_serve_bench_trace_writes_loadable_step_timeline(tmp_path):
     assert rec2["value"] > 0
     assert rec2["host_ms"] > 0
     assert "engine.device_launch" in rec2["phases"]
+    # ISSUE acceptance: with overlap on (the default arm), host work
+    # measurably ran inside in-flight device windows
+    assert rec2["inflight_windows"] > 0
+    assert rec2["overlap_achieved_frac"] > 0
+    assert rec2["overlap_achieved_ms"] > 0
+
+
+def test_serve_bench_overlap_off_arm_traces_synchronously(tmp_path):
+    """--overlap off flips the headline/traced arm: the record still
+    carries BOTH arms, and the artifact loads in step_timeline.py with
+    zero in-flight windows (hence ~0 overlap achieved)."""
+    trace_path = os.path.join(str(tmp_path), "trace_off.json")
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "--smoke", "--mixed", "--requests", "6",
+         "--overlap", "off", "--trace", trace_path],
+        capture_output=True, text=True, timeout=540,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.strip().splitlines() if ln.strip()]
+    assert lines, f"no stdout; stderr: {out.stderr[-2000:]}"
+    record = json.loads(lines[-1])
+    assert "error" not in record, record
+    assert record["overlap"] == "off"
+    for arm in ("on", "off"):
+        assert record[f"overlap_{arm}_wall_s"] > 0
+        assert f"overlap_{arm}_host_bubble_frac" in record
+    tool = os.path.join(REPO, "tools", "perf", "step_timeline.py")
+    out2 = subprocess.run(
+        [sys.executable, tool, trace_path],
+        capture_output=True, text=True, timeout=120)
+    assert out2.returncode == 0, out2.stderr[-2000:]
+    rec2 = json.loads(out2.stdout.strip().splitlines()[-1])
+    assert rec2["steps"] > 0
+    assert rec2["inflight_windows"] == 0
+    assert rec2["overlap_achieved_frac"] == 0.0
 
 
 def test_serve_bench_chaos_emits_recovery_surface():

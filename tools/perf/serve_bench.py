@@ -42,6 +42,17 @@ retired per-phase programs would have padded for the same launches:
    "padding_waste_reduction": ..., "attention_compiles": ...,
    "attention_program_kinds": 1, "accept_rate": ..., ...}
 
+``--mixed`` also A/Bs the async step pipeline: the identical stream
+runs on an ``overlap=True`` engine and an ``overlap=False`` one
+(``--overlap off`` flips which arm is the headline/traced one), and
+the record carries both arms' decode wall-clock plus their
+dispatch/block attribution and host-bubble fraction:
+
+  {"overlap": "on", "overlap_on_wall_s": ..., "overlap_on_tokens_per_s":
+   ..., "overlap_on_dispatch_time_s": ..., "overlap_on_block_time_s":
+   ..., "overlap_on_host_bubble_frac": ..., "overlap_off_wall_s": ...,
+   ...}
+
 With ``--http`` the SAME ragged workload runs twice over the real HTTP
 frontend (paddle_tpu.inference.frontend) on localhost — concurrent
 streaming clients, SSE parsing, client-side TTFT/ITL — next to an
@@ -699,13 +710,20 @@ def _mixed_request_stream(rng, n_requests, vocab, max_len,
 
 
 def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str,
-                    kv_dtype: str = "float32", tp: int = 1, tracer=None):
+                    kv_dtype: str = "float32", tp: int = 1, tracer=None,
+                    overlap: str = "on"):
     """The ISSUE's headline workload: long prefills, chunked resumes,
     plain decodes, and speculative verify rounds all riding the ONE
     ragged step program.  Reports throughput, the exact attention
     program budget, and the padding-waste ratio (padded/real tokens)
     next to what the retired four-program engine would have padded for
-    the same launches (``legacy_padding_waste_ratio``)."""
+    the same launches (``legacy_padding_waste_ratio``).
+
+    Always runs BOTH async-pipeline arms over the same stream — the
+    ``--overlap`` flag only picks which arm is the headline (and traced)
+    one — so the record carries each arm's decode wall-clock plus its
+    dispatch/block split and host-bubble fraction
+    (``overlap_{on,off}_wall_s`` / ``_host_bubble_frac``)."""
     import numpy as np
 
     import paddle_tpu
@@ -730,11 +748,15 @@ def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str,
         spec_k = 4
 
     model = LlamaForCausalLM(cfg)
-    engine = LLMEngine(model, enable_prefix_caching=True,
-                       drafter=NGramDrafter(max_ngram=6, min_ngram=1),
-                       spec_k=spec_k, max_spec_k=spec_k,
-                       spec_accept_floor=0.0, kv_dtype=kv_dtype, tp=tp,
-                       **engine_kw)
+
+    def _mk_engine(ov: bool):
+        return LLMEngine(model, enable_prefix_caching=True,
+                         drafter=NGramDrafter(max_ngram=6, min_ngram=1),
+                         spec_k=spec_k, max_spec_k=spec_k,
+                         spec_accept_floor=0.0, kv_dtype=kv_dtype, tp=tp,
+                         overlap=ov, **engine_kw)
+
+    engine = _mk_engine(overlap != "off")
     rng = np.random.RandomState(seed)
     stream = _mixed_request_stream(rng, n_requests, cfg.vocab_size,
                                    engine_kw["max_model_len"],
@@ -753,6 +775,34 @@ def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str,
     s = engine.stats.summary()
     ps = dict(engine.pad_stats)
 
+    # A/B arm: the same stream on an engine with the OPPOSITE overlap
+    # setting (warm pass, then timed), so one record carries both the
+    # async pipeline and the synchronous step for the same workload
+    engine_b = _mk_engine(overlap == "off")
+    _drive(engine_b, list(stream))
+    engine_b.stats.reset()
+    elapsed_b = _drive(engine_b, list(stream))
+    s_b = engine_b.stats.summary()
+
+    def _arm_keys(arm, wall, st):
+        # host-bubble: the step wall time NOT spent blocked on the
+        # device result (dispatch packing + apply/retire bookkeeping)
+        step_s = st["step_time_s"]
+        bubble = 1.0 - st["block_time_s"] / step_s if step_s else 0.0
+        return {
+            f"overlap_{arm}_wall_s": round(wall, 3),
+            f"overlap_{arm}_tokens_per_s":
+            round(total_new / wall, 2) if wall else 0.0,
+            f"overlap_{arm}_dispatch_time_s": st["dispatch_time_s"],
+            f"overlap_{arm}_block_time_s": st["block_time_s"],
+            f"overlap_{arm}_host_bubble_frac": round(bubble, 4),
+        }
+
+    arm = "off" if overlap == "off" else "on"
+    other = "on" if arm == "off" else "off"
+    ab_keys = {"overlap": arm, **_arm_keys(arm, elapsed, s),
+               **_arm_keys(other, elapsed_b, s_b)}
+
     if tracer is not None:
         # ride a handful of the same requests through the full serving
         # stack (HTTP SSE -> replica router -> runner -> engine) onto
@@ -761,9 +811,13 @@ def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str,
         from paddle_tpu.inference.frontend import serve_background
 
         def _factory():
+            # same overlap arm as the headline engine, so the dumped
+            # trace is internally consistent (an --overlap off artifact
+            # carries zero engine.device_inflight windows anywhere)
             return LLMEngine(model, retain_outputs=False,
                              enable_prefix_caching=True,
-                             kv_dtype=kv_dtype, tp=tp, **engine_kw)
+                             kv_dtype=kv_dtype, tp=tp,
+                             overlap=overlap != "off", **engine_kw)
 
         http_engine = _factory()
         http_engine.set_tracer(tracer)
@@ -811,6 +865,7 @@ def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str,
         "ttft_p50_ms": s["ttft_p50_ms"],
         "ttft_p99_ms": s["ttft_p99_ms"],
         "preempted": s["preemptions"],
+        **ab_keys,
         **_mem_keys(engine),
     }
 
@@ -1141,6 +1196,11 @@ def main(argv=None):
                          "behind the prefix-affinity router, A/B'd "
                          "against random routing on the shared-prefix "
                          "workload")
+    ap.add_argument("--overlap", choices=("on", "off"), default="on",
+                    help="with --mixed: which async-pipeline arm is the "
+                         "headline (and --trace'd) one; BOTH arms always "
+                         "run and land in the record, this picks the one "
+                         "the tok/s value and the timeline describe")
     ap.add_argument("--trace", default=None, metavar="OUT.json",
                     help="with --mixed: record a step timeline of the "
                          "timed pass (plus a short HTTP/router pass so "
@@ -1225,7 +1285,8 @@ def main(argv=None):
         elif args.mixed:
             record.update(run_mixed_bench(args.smoke, n_requests, args.seed,
                                           backend, args.kv_dtype, args.tp,
-                                          tracer=tracer))
+                                          tracer=tracer,
+                                          overlap=args.overlap))
         elif args.http:
             record.update(run_http_bench(args.smoke, n_requests, args.seed,
                                          backend, args.kv_dtype, args.tp))

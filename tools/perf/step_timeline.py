@@ -23,12 +23,20 @@ then three derived numbers:
                          step's device span; summed, as a fraction of
                          step time.  This is the number the async-engine
                          roadmap item banks on.
+  overlap achieved       host-phase time that actually ran INSIDE an
+                         ``engine.device_inflight`` window (the async
+                         engine's launch→materialize span, emitted at
+                         completion).  Zero on a synchronous trace or
+                         with ``--overlap off`` — this is the measured
+                         payoff of the async pipeline, reported next to
+                         the opportunity it was sized against.
 
 Usage:
   python tools/perf/step_timeline.py TRACE.json
 
 Last stdout line is a one-line JSON record (same contract as the other
-tools/perf benches) with metric ``step_timeline_host_bubble_frac``.
+tools/perf benches) with metric ``step_timeline_host_bubble_frac``
+(plus ``step_timeline_overlap_achieved_frac`` as a secondary key).
 """
 from __future__ import annotations
 
@@ -44,6 +52,13 @@ _PHASE_ORDER = ("engine.admit", "engine.schedule", "engine.pack",
                 "engine.block_table_stage", "engine.device_launch",
                 "engine.block_on_result", "engine.sample_commit",
                 "engine.retire")
+# async-pipeline WRAPPER spans: they contain the leaf phases above (and
+# engine.device_inflight brackets whole launch→materialize windows), so
+# counting them as phases would double-charge host time and drive the
+# untracked remainder negative.  They feed the overlap-achieved
+# computation instead.
+_WRAPPER_SPANS = ("engine.dispatch", "engine.complete", "engine.prestage",
+                  "engine.device_inflight")
 
 
 def _pct(sorted_vals, q):
@@ -74,7 +89,9 @@ def analyze(doc, events, tracks):
           and ev["tid"] in engine_tids]
     steps = sorted((ev for ev in xs if ev["name"] == "engine.step"),
                    key=lambda e: e["ts"])
-    inner = [ev for ev in xs if ev["name"] != "engine.step"]
+    inner = [ev for ev in xs if ev["name"] != "engine.step"
+             and ev["name"] not in _WRAPPER_SPANS]
+    inflight = [ev for ev in xs if ev["name"] == "engine.device_inflight"]
 
     durs = {}                             # phase -> [dur_us,...]
     for ev in inner:
@@ -102,6 +119,29 @@ def analyze(doc, events, tracks):
         dev = sum(ev["dur"] for ev in mine
                   if ev["name"] == "engine.device_launch")
         overlap_us += min(pack, dev)
+
+    # overlap ACHIEVED: host-phase wall time that ran inside an
+    # engine.device_inflight window (launch → materialize of the async
+    # ticket).  Computed globally per track, not per step window — the
+    # in-flight window deliberately CROSSES the step() boundary (launch
+    # in one call, materialize in the next), which is the whole point.
+    achieved_us = 0.0
+    infl_by_tid = {}
+    for ev in inflight:
+        infl_by_tid.setdefault(ev["tid"], []).append(
+            (ev["ts"], ev["ts"] + ev["dur"]))
+    for tid, wins in infl_by_tid.items():
+        wins.sort()
+        for ev in by_tid.get(tid, ()):
+            if ev["name"] not in _HOST_PHASES:
+                continue
+            a0, a1 = ev["ts"], ev["ts"] + ev["dur"]
+            for w0, w1 in wins:
+                if w0 >= a1:
+                    break
+                if w1 <= a0:
+                    continue
+                achieved_us += min(a1, w1) - max(a0, w0)
 
     phases = {}
     for name in _PHASE_ORDER:
@@ -134,6 +174,12 @@ def analyze(doc, events, tracks):
         "overlap_opportunity_ms": round(overlap_us / 1e3, 3),
         "overlap_opportunity_frac": round(overlap_us / step_total, 4)
         if step_total else 0.0,
+        "overlap_achieved_ms": round(achieved_us / 1e3, 3),
+        "overlap_achieved_frac": round(achieved_us / step_total, 4)
+        if step_total else 0.0,
+        "step_timeline_overlap_achieved_frac":
+        round(achieved_us / step_total, 4) if step_total else 0.0,
+        "inflight_windows": len(inflight),
         "phases": phases,
         "tiers": sorted(set(tracks.values())),
         "dropped_events": other.get("dropped_events", 0),
@@ -168,6 +214,13 @@ def print_table(rec, out=sys.stdout):
     w(f"overlap opportunity:   {rec['overlap_opportunity_frac']:.1%} "
       f"({rec['overlap_opportunity_ms']:.3f} ms of packing that an "
       f"async engine could hide under device spans)\n")
+    if rec.get("inflight_windows"):
+        w(f"overlap achieved:      {rec['overlap_achieved_frac']:.1%} "
+          f"({rec['overlap_achieved_ms']:.3f} ms of host work inside "
+          f"{rec['inflight_windows']} in-flight device windows)\n")
+    else:
+        w("overlap achieved:      0.0% (no engine.device_inflight "
+          "windows — synchronous engine or overlap off)\n")
     if rec["dropped_events"]:
         w(f"NOTE: ring dropped {rec['dropped_events']} oldest events — "
           f"totals cover the surviving window only\n")
