@@ -716,6 +716,59 @@ def lint_source(text: str, path: str = "<string>") -> list:
                      "not monotonic (NTP slew makes durations jump or go "
                      "negative); use time.perf_counter()/"
                      "perf_counter_ns(), or time.monotonic() for uptime")
+
+    # ---- unbounded-observability-buffer (inference + profiler tiers) -----
+    # Telemetry discipline: every always-on buffer in the observability
+    # layer is bounded and counts what it sheds (the Tracer ring drops
+    # and counts, the flight recorder LRU-evicts and counts, reservoirs
+    # subsample).  An observability class that plain-appends per request
+    # or per step is a slow leak on a long-running server.  Evidence of
+    # a bound anywhere in the class acquits every append in it: a
+    # capacity/maxlen/limit-named attribute, a deque(maxlen=...), or a
+    # pop-style eviction call.
+    if {"inference", "profiler"} & set(re.split(r"[\\/]", path)):
+        obs_re = re.compile(r"Stats|Trace|Record|Flight|Window|Telemetry"
+                            r"|SLO|Spool|Reservoir|Hist|Monitor|Detector"
+                            r"|Ring")
+        bound_re = re.compile(r"cap|maxlen|limit|max_|bound", re.IGNORECASE)
+        for cls in ast.walk(ctx.tree):
+            if not (isinstance(cls, ast.ClassDef)
+                    and obs_re.search(cls.name)):
+                continue
+            bounded = False
+            appends = []
+            for node in ast.walk(cls):
+                if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        name = t.attr if isinstance(t, ast.Attribute) else (
+                            t.id if isinstance(t, ast.Name) else "")
+                        if name and bound_re.search(name):
+                            bounded = True
+                elif isinstance(node, ast.Call):
+                    d = _dotted(node.func)
+                    if d and d[-1] in ("pop", "popleft", "popitem"):
+                        bounded = True
+                    elif d and d[-1] == "deque" and any(
+                            kw.arg == "maxlen" for kw in node.keywords):
+                        bounded = True
+                    elif d and d[-1] == "append":
+                        appends.append(node)
+                    if node.keywords and any(
+                            kw.arg and bound_re.search(kw.arg)
+                            for kw in node.keywords):
+                        bounded = True
+            if bounded:
+                continue
+            for node in appends:
+                emit("unbounded-observability-buffer", node,
+                     f"`.append` inside observability class `{cls.name}` "
+                     "with no visible bound (no capacity/maxlen/limit "
+                     "attribute, no deque(maxlen=), no pop-style "
+                     "eviction) — always-on telemetry that grows per "
+                     "request leaks on a long-running server; cap the "
+                     "buffer and count what it sheds")
     return findings
 
 

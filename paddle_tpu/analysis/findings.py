@@ -122,6 +122,14 @@ RULES = {
         "shard_map — the mesh axis name is unbound outside shard_map, so "
         "the program either fails to trace or silently runs unsharded on "
         "one chip; wrap the step with shard_map before jitting")),
+    "unbounded-observability-buffer": (WARNING, "ast", (
+        "a list .append accumulation inside an observability-tier class "
+        "(Stats/Tracer/Recorder/Window/Spool/...) with no visible bound "
+        "— no capacity/maxlen/limit attribute, no deque(maxlen=), no "
+        "pop-style eviction anywhere in the class — always-on telemetry "
+        "that grows per request or per step leaks without bound on a "
+        "long-running server; cap the buffer and count what it sheds "
+        "(the Tracer-ring discipline)")),
     "host-sync-in-dispatch-path": (WARNING, "ast", (
         "int()/float()/np.asarray()/.item() applied to a step-program "
         "output inside an inference-tier dispatch/prestage path — the "

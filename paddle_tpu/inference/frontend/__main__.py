@@ -122,6 +122,26 @@ def main(argv=None) -> int:
                          "prompts land on the replica already holding "
                          "their KV pages), least-outstanding-tokens, or "
                          "random (ignored with --replicas 1)")
+    ap.add_argument("--flight-capacity", type=int, default=512,
+                    help="per-replica flight-recorder bound for "
+                         "GET /debug/requests (0 disables)")
+    ap.add_argument("--anomaly-spool", default=None, metavar="DIR",
+                    help="directory for anomaly-triggered trace "
+                         "captures: slow-step/slow-request outliers "
+                         "snapshot the trace window + slowest flight "
+                         "records there (bounded; drops are counted)")
+    ap.add_argument("--slo-ttft-p95-ms", type=float, default=500.0,
+                    help="SLO objective: 95%% of first tokens under "
+                         "this many ms")
+    ap.add_argument("--slo-itl-p99-ms", type=float, default=200.0,
+                    help="SLO objective: 99%% of inter-token intervals "
+                         "under this many ms")
+    ap.add_argument("--slo-deadline-attainment", type=float, default=0.99,
+                    help="SLO objective: fraction of deadline-carrying "
+                         "requests that must finish in budget")
+    ap.add_argument("--slo-availability", type=float, default=0.999,
+                    help="SLO objective: fraction of requests that must "
+                         "finish without error/quarantine")
     args = ap.parse_args(argv)
 
     _ensure_host_devices(args.tp)
@@ -141,7 +161,13 @@ def main(argv=None) -> int:
         engine_factory=(make_engine if args.step_deadline_s
                         or args.replicas > 1 else None),
         step_deadline_s=args.step_deadline_s or None,
-        replicas=args.replicas, router_policy=args.router_policy)
+        replicas=args.replicas, router_policy=args.router_policy,
+        slo_config={"ttft_p95_ms": args.slo_ttft_p95_ms,
+                    "itl_p99_ms": args.slo_itl_p99_ms,
+                    "deadline_attainment": args.slo_deadline_attainment,
+                    "availability": args.slo_availability},
+        flight_capacity=args.flight_capacity,
+        anomaly_spool=args.anomaly_spool)
 
     async def run():
         await frontend.start()

@@ -1,10 +1,14 @@
 """ServingFrontend: the asyncio HTTP tier over one EngineRunner.
 
-Three routes own the whole serving surface:
+These routes own the whole serving surface:
 
-    POST /v1/completions   generate (JSON body; SSE stream or one JSON)
-    GET  /healthz          liveness + drain state
-    GET  /metrics          Prometheus text (ServingStats + pool gauges)
+    POST /v1/completions       generate (JSON body; SSE stream or one JSON)
+    GET  /healthz              liveness + drain state
+    GET  /metrics              Prometheus text (ServingStats + pool gauges)
+    GET  /slo                  windowed percentiles + SLO burn-rate state
+    GET  /debug/requests       flight-recorder list (?finished=&sort=&limit=)
+    GET  /debug/requests/<id>  one request's flight record
+    GET  /debug/trace          Chrome trace JSON (404 unless tracing on)
 
 The request lifecycle the frontend guarantees, end to end:
 
@@ -84,6 +88,18 @@ class ServingFrontend:
         back to the engine's own tracer so one ``set_tracer()`` on the
         engine lights up all four tiers.  When set, ``GET /debug/trace``
         serves the Chrome trace-event JSON.
+    slo_config: optional ``profiler.SLOConfig`` (or dict of its fields)
+        evaluated by the windowed-telemetry layer; None uses defaults.
+        The frontend always enables windowed telemetry on its engines —
+        ``GET /slo`` serves the rolling percentiles and burn-rate state.
+    flight_capacity: per-replica flight-recorder bound (records kept for
+        ``GET /debug/requests``); 0 disables the recorder entirely and
+        the debug routes 404.
+    anomaly_spool: directory for anomaly-triggered trace captures.  When
+        set, slow-step/slow-request outliers snapshot the trace window
+        plus the slowest flight records to bounded JSON files there; if
+        no tracer was passed a small always-on ring is armed so there is
+        a window to snapshot.
     """
 
     def __init__(self, engine, *, model_name: str = "model",
@@ -92,13 +108,20 @@ class ServingFrontend:
                  default_deadline_s: float | None = None,
                  engine_factory=None, step_deadline_s: float | None = None,
                  replicas: int = 1, router_policy: str = "affinity",
-                 tracer=None):
+                 tracer=None, slo_config=None, flight_capacity: int = 512,
+                 anomaly_spool: str | None = None):
         self.model_name = str(model_name)
         self.host = host
         self.port = int(port)
         self.default_deadline_s = default_deadline_s
         self.tracer = tracer if tracer is not None \
             else getattr(engine, "tracer", None)
+        if anomaly_spool is not None and self.tracer is None:
+            # anomaly capture needs a window to snapshot: arm a small
+            # always-on ring (bounded; evicts itself) when the operator
+            # asked for a spool but not for full tracing
+            from ...profiler.trace import Tracer
+            self.tracer = Tracer(capacity=4096)
         self._http_track = self.tracer.register("http") \
             if self.tracer is not None else "http"
         if int(replicas) > 1:
@@ -118,6 +141,23 @@ class ServingFrontend:
             for e in getattr(self.runner, "engines", [self.runner.engine]):
                 if getattr(e, "tracer", None) is None:
                     e.set_tracer(self.tracer)
+        # SLO observatory: windowed telemetry on every replica engine
+        # (the per-engine ``enable_windows`` is what makes /slo render),
+        # a bounded flight recorder per replica, and — when a spool
+        # directory is given — anomaly-triggered trace capture.
+        self.anomaly_spool = None
+        if anomaly_spool is not None:
+            from ...profiler.slo import AnomalySpool
+            self.anomaly_spool = AnomalySpool(anomaly_spool)
+        for e in getattr(self.runner, "engines", [self.runner.engine]):
+            e.stats.enable_windows(slo_config, tracer=self.tracer)
+            if int(flight_capacity) > 0 and getattr(e, "flight", None) is None:
+                from ..flight import FlightRecorder
+                e.set_flight(FlightRecorder(int(flight_capacity)))
+            if self.anomaly_spool is not None:
+                e.stats.windows.arm_anomaly(
+                    spool=self.anomaly_spool, tracer=self.tracer,
+                    flight=getattr(e, "flight", None))
         self._server = None
         self._writers: set = set()        # open connections, for shutdown
         self._lock = threading.Lock()
@@ -259,6 +299,36 @@ class ServingFrontend:
                 content_type="text/plain; version=0.0.4; charset=utf-8"))
             await writer.drain()
             return True
+        if route == ("GET", "/slo"):
+            # same snapshot surface as /metrics: fleet-pooled when a
+            # router is in front, single-engine otherwise
+            if hasattr(self.runner, "stats_snapshot"):
+                snap = self.runner.stats_snapshot()
+            else:
+                snap = self.engine.stats.snapshot()
+            if "windows" not in snap:
+                self._count("/slo", 404)
+                writer.write(response_bytes(404, error_body(
+                    404, "windowed telemetry is not enabled")))
+                await writer.drain()
+                return True
+            out = {k: snap.get(k) for k in (
+                "slo_state", "slo_state_name", "ttft_p95_w60s",
+                "itl_p99_w60s", "queue_wait_p95_w60s",
+                "anomalies_detected", "anomalies_captured",
+                "anomaly_spool_dropped")}
+            out["slo"] = snap.get("slo")
+            out["windows"] = snap["windows"]
+            self._count("/slo", 200)
+            writer.write(response_bytes(
+                200, json.dumps(out).encode("utf-8"),
+                content_type="application/json"))
+            await writer.drain()
+            return True
+        if req.method == "GET" and (req.path == "/debug/requests"
+                                    or req.path.startswith(
+                                        "/debug/requests/")):
+            return await self._debug_requests(req, writer)
         if route == ("GET", "/debug/trace"):
             tr = self.tracer
             if tr is None:
@@ -274,13 +344,83 @@ class ServingFrontend:
             await writer.drain()
             return True
         status = 405 if req.path in ("/v1/completions", "/healthz",
-                                     "/metrics", "/debug/trace") else 404
+                                     "/metrics", "/debug/trace", "/slo",
+                                     "/debug/requests") else 404
         self._count(req.path, status)
         writer.write(response_bytes(
             status, error_body(status, f"no route {req.method} {req.path}"),
             keep_alive=False))
         await writer.drain()
         return False
+
+    def _flight_recorders(self) -> list:
+        return [fl for fl in (
+            getattr(e, "flight", None)
+            for e in getattr(self.runner, "engines", [self.runner.engine]))
+            if fl is not None]
+
+    async def _debug_requests(self, req, writer) -> bool:
+        """GET /debug/requests (ranked list) and /debug/requests/<id>
+        (one flight record).  404 when flight recording is disabled."""
+        recorders = self._flight_recorders()
+        if not recorders:
+            self._count("/debug/requests", 404)
+            writer.write(response_bytes(404, error_body(
+                404, "flight recording is not enabled")))
+            await writer.drain()
+            return True
+        rest = req.path[len("/debug/requests"):].strip("/")
+        if rest:                          # one record, by frontend id
+            rec = None
+            for fl in recorders:
+                rec = fl.get(rest)
+                if rec is None and rest.isdigit():
+                    rec = fl.get(int(rest))   # raw engine rid fallback
+                if rec is not None:
+                    break
+            if rec is None:
+                self._count("/debug/requests", 404)
+                writer.write(response_bytes(404, error_body(
+                    404, f"no flight record for {rest!r} (evicted or "
+                    "never admitted)")))
+                await writer.drain()
+                return True
+            self._count("/debug/requests", 200)
+            writer.write(response_bytes(
+                200, json.dumps(rec).encode("utf-8"),
+                content_type="application/json"))
+            await writer.drain()
+            return True
+        fq = req.query.get("finished")
+        sort = req.query.get("sort", "slowest")
+        finished = None
+        if fq in ("true", "1", "yes"):
+            finished = True
+        elif fq in ("false", "0", "no"):
+            finished = False
+        elif fq == "slowest":             # ?finished=slowest shorthand
+            finished, sort = True, "slowest"
+        try:
+            limit = max(1, min(512, int(req.query.get("limit", 32))))
+        except ValueError:
+            limit = 32
+        merged: list = []
+        for fl in recorders:
+            merged.extend(fl.list(finished=finished, sort=sort,
+                                  limit=limit))
+        if sort == "slowest":             # re-rank across replicas
+            merged.sort(key=lambda r: r.get("elapsed_s") or 0.0,
+                        reverse=True)
+        merged = merged[:limit]
+        body = {"count": len(merged),
+                "evicted": sum(fl.evicted for fl in recorders),
+                "requests": merged}
+        self._count("/debug/requests", 200)
+        writer.write(response_bytes(
+            200, json.dumps(body).encode("utf-8"),
+            content_type="application/json"))
+        await writer.drain()
+        return True
 
     def _frontend_counters(self) -> dict:
         with self._lock:

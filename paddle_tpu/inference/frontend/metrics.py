@@ -120,6 +120,10 @@ def render_metrics(snapshot: dict, *, engine=None,
              "Inter-token latency (per-token decode interval).",
              [({"quantile": "0.5"}, _ms(s.get("itl_p50_ms"))),
               ({"quantile": "0.99"}, _ms(s.get("itl_p99_ms")))])
+    d.metric("queue_wait_seconds", "gauge",
+             "Admission queue wait (arrival to engine admission).",
+             [({"quantile": "0.5"}, _ms(s.get("queue_wait_p50_ms"))),
+              ({"quantile": "0.99"}, _ms(s.get("queue_wait_p99_ms")))])
     d.metric("throughput_tokens_per_second", "gauge",
              "Generated-token throughput over the stats window.",
              [(None, s.get("decode_tokens_per_s"))])
@@ -143,6 +147,50 @@ def render_metrics(snapshot: dict, *, engine=None,
         if buckets:
             d.histogram(name, help_text, buckets,
                         s.get(f"{key}_sum", 0.0), s.get(f"{key}_count", 0))
+
+    # -- windowed telemetry + SLO -----------------------------------------
+    # rolling-window quantiles labeled {window=,quantile=} — unlike the
+    # lifetime gauges above these answer "how are we doing RIGHT NOW"
+    w = s.get("windows")
+    if w:
+        lat_samples, rate_samples = [], []
+        for wl in sorted((k for k in w if k != "bounds"),
+                         key=lambda k: float(k[:-1])):
+            for ch, st in sorted(w[wl].items()):
+                if "p95_ms" in st:
+                    for key, q in (("p50_ms", "0.5"), ("p95_ms", "0.95"),
+                                   ("p99_ms", "0.99")):
+                        lat_samples.append((
+                            {"channel": ch, "window": wl, "quantile": q},
+                            _ms(st.get(key))))
+                elif "rate" in st:
+                    rate_samples.append((
+                        {"channel": ch, "window": wl}, st.get("rate")))
+        d.metric("windowed_latency_seconds", "gauge",
+                 "Rolling-window latency quantiles by channel (ttft, "
+                 "itl, step, queue_wait, request).", lat_samples)
+        d.metric("windowed_rate", "gauge",
+                 "Rolling-window rates by channel (accept, deadline, "
+                 "availability).", rate_samples)
+        d.metric("slo_state", "gauge",
+                 "SLO burn-rate state: 0 normal, 1 warn, 2 page.",
+                 [(None, s.get("slo_state"))])
+        burns = (s.get("slo") or {}).get("burn_rates") or {}
+        d.metric("slo_burn_rate", "gauge",
+                 "Error-budget burn rate per objective and window "
+                 "(1.0 = consuming exactly the budget).",
+                 [({"objective": obj, "window": wl}, v)
+                  for wl, objs in sorted(burns.items())
+                  for obj, v in sorted(objs.items()) if obj != "max"])
+        d.metric("anomalies_detected_total", "counter",
+                 "Slow-step/slow-request outliers flagged by the MAD "
+                 "detector.", [(None, s.get("anomalies_detected"))])
+        d.metric("anomalies_captured_total", "counter",
+                 "Anomaly trace snapshots written to the spool.",
+                 [(None, s.get("anomalies_captured"))])
+        d.metric("anomaly_spool_dropped_total", "counter",
+                 "Anomaly snapshots dropped by the spool bound.",
+                 [(None, s.get("anomaly_spool_dropped"))])
 
     # -- async step pipeline ---------------------------------------------
     # each launch cycle split into the host dispatch section vs the

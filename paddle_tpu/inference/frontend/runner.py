@@ -316,6 +316,11 @@ class EngineRunner:
             if h.rid >= 0:
                 self._by_rid.pop(h.rid, None)
             self._inflight -= 1
+        if h.deadline is not None:
+            # deadline attainment: only deadline-carrying requests vote
+            self.engine.stats.record_deadline(
+                getattr(out, "finish_reason", None) != "deadline"
+                and time.monotonic() <= h.deadline)
         try:
             h.deliver(("finish", out))
         except Exception:
@@ -369,6 +374,16 @@ class EngineRunner:
                 finish_reason=f"error: {type(e).__name__}: {e}"))
             return False
         h.rid = rid
+        fl = getattr(eng, "flight", None)
+        if fl is not None:
+            # the same cross-tier join the tracer instants carry:
+            # engine rid <-> frontend request id, plus the remaining
+            # deadline budget measured at engine admission (the flight
+            # record's t_submit) so slack fields line up
+            fl.annotate(rid, request_id=h.request_id,
+                        replica=self.name or None,
+                        deadline_s=None if h.deadline is None
+                        else h.deadline - time.monotonic())
         with self._lock:
             self._by_rid[rid] = h
         return True
@@ -463,9 +478,11 @@ class EngineRunner:
         if pressure is not None:
             old.pressure = None
         eng = self._engine_factory()
-        # metric continuity: the service's stats survive the engine
+        # metric continuity: the service's stats (and the flight
+        # recorder's forensic window) survive the engine
         eng.stats = old.stats
         eng.stats.record_restart()
+        eng.flight = getattr(old, "flight", None)
         if plan is not None:
             eng.set_fault_plan(plan)
         eng.pressure = pressure

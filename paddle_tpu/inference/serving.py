@@ -80,6 +80,7 @@ from ..ops.pallas import paged_attention as _pa
 from ..profiler import RecordEvent, ServingStats
 from .faults import InjectedFault
 from .kv_cache import NULL_BLOCK, BlockManager, BlockPoolExhausted
+from .pressure import STATE_NAMES as _TIER_NAMES
 from .sampling import make_samp, samp_structs, sample_tokens
 
 __all__ = ["LLMEngine", "Request", "RequestOutput"]
@@ -426,6 +427,10 @@ class LLMEngine:
         self._evictions_seen = 0
         self.peak_resident_seqs = 0
         self.stats = ServingStats()
+        # per-request flight recorder (inference/flight.py): None means
+        # every request-lifecycle seam is one attribute check and
+        # nothing else — the tracer's zero-cost contract
+        self.flight = None
         # step-timeline tracer (profiler/trace.py): None means every
         # instrumentation seam is one attribute check and nothing else —
         # the same zero-cost contract the fault plan keeps
@@ -467,6 +472,16 @@ class LLMEngine:
         if self.fault_plan is not None:
             self.fault_plan.tracer = tracer
             self.fault_plan.trace_track = self._trace_track
+
+    def set_flight(self, recorder) -> None:
+        """Install (or clear) a per-request FlightRecorder
+        (inference/flight.py).  With None installed the request
+        lifecycle seams perform no forensic work at all."""
+        self.flight = recorder
+
+    def _tier(self) -> int:
+        """Current degradation tier (0 when no pressure controller)."""
+        return 0 if self.pressure is None else self.pressure.state
 
     def dump_trace(self, path) -> int:
         """Write this engine's step timeline as Chrome trace-event JSON
@@ -600,6 +615,10 @@ class LLMEngine:
             req.seen[prompt] = True
             req.seen[generated] = True
         self._waiting.append(req)
+        fl = self.flight
+        if fl is not None:
+            fl.open(rid, prompt_tokens=len(prompt),
+                    t_submit=req.t_arrival)
         tr = self.tracer
         if tr is not None:
             tr.async_begin("req", f"{self._trace_track}:{rid}",
@@ -684,6 +703,15 @@ class LLMEngine:
         if self.retain_outputs:
             self._finished[req.rid] = out
         self.stats.record_abort(finish_reason)
+        if self.stats.windows is not None:
+            self.stats.record_finish_quality(False)
+            self.stats.record_request_latency(
+                time.perf_counter() - req.t_arrival)
+        fl = self.flight
+        if fl is not None:
+            fl.finished(req.rid, reason=finish_reason,
+                        generated=len(req.generated),
+                        tier=self._tier())
         tr = self.tracer
         if tr is not None:
             tr.async_end("req", f"{self._trace_track}:{req.rid}",
@@ -974,10 +1002,18 @@ class LLMEngine:
             # they would not have been taken yet, so the free-page
             # signal (and every tier decision derived from it) sees the
             # identical per-step timeline
+            prev_tier = self.pressure.state
             self.pressure.update(
                 self.blocks,
                 spec_reserved=sum(self._spec_pages.values()))
             self.stats.set_degradation_state(self.pressure.state)
+            if tr is not None and self.pressure.state != prev_tier:
+                tr.instant("pressure.tier", track=self._trace_track,
+                           args={"from": prev_tier,
+                                 "to": self.pressure.state,
+                                 "name": _TIER_NAMES.get(
+                                     self.pressure.state,
+                                     str(self.pressure.state))})
             if self.pressure.evict_now:
                 n = self.blocks.evict_parked(self.pressure.evict_batch)
                 if n:
@@ -1298,6 +1334,9 @@ class LLMEngine:
                            track=self._trace_track,
                            args={"rid": req.rid, "tokens": n,
                                  "done": req.cached >= len(req.tokens)})
+            fl = self.flight
+            if fl is not None:
+                fl.prefill_chunk(req.rid, n)
             if req.cached == len(req.tokens):
                 done += 1
                 tok = int(sampled[s])
@@ -1305,8 +1344,10 @@ class LLMEngine:
                 if req.seen is not None:
                     req.seen[tok] = True
                 if len(req.generated) == 1:
-                    self.stats.record_ttft(
-                        time.perf_counter() - req.t_arrival)
+                    ttft = time.perf_counter() - req.t_arrival
+                    self.stats.record_ttft(ttft)
+                    if fl is not None:
+                        fl.first_token(req.rid, ttft)
                     if tr is not None:
                         tr.instant("request.first_token",
                                    track=self._trace_track,
@@ -1370,6 +1411,15 @@ class LLMEngine:
         finished.append(out)
         self.stats.record_quarantine()
         self.stats.record_abort("numerical_error")
+        if self.stats.windows is not None:
+            self.stats.record_finish_quality(False)
+            self.stats.record_request_latency(
+                time.perf_counter() - req.t_arrival)
+        fl = self.flight
+        if fl is not None:
+            fl.finished(req.rid, reason="numerical_error",
+                        generated=len(req.generated),
+                        tier=self._tier(), quarantined=True)
         tr = self.tracer
         if tr is not None:
             tr.instant("engine.quarantine", track=self._trace_track,
@@ -1416,6 +1466,16 @@ class LLMEngine:
             self._claim_slot(req)
             self._running.append(req)
             admitted.append(req)
+            # queue wait = arrival -> this admission (for a preempted
+            # request that re-admits, arrival -> LATEST admission: the
+            # whole stall was service latency)
+            qw = time.perf_counter() - req.t_arrival
+            self.stats.record_queue_wait(qw)
+            fl = self.flight
+            if fl is not None:
+                fl.admitted(req.rid, queue_wait_s=qw,
+                            cache_hit_tokens=req.cached,
+                            tier=self._tier())
         return admitted
 
     def _schedule_prefill_chunks(self) -> list:
@@ -1516,6 +1576,9 @@ class LLMEngine:
         if self.drafter is not None:
             self.drafter.release(req.rid)
         self.stats.record_preemption()
+        fl = self.flight
+        if fl is not None:
+            fl.preempted(req.rid)
         if self.tracer is not None:
             self.tracer.instant("request.preempted",
                                 track=self._trace_track,
@@ -1545,6 +1608,15 @@ class LLMEngine:
         if self.drafter is not None:
             self.drafter.release(req.rid)
         self.stats.record_retirement()
+        if self.stats.windows is not None:
+            self.stats.record_finish_quality(True)
+            self.stats.record_request_latency(
+                time.perf_counter() - req.t_arrival)
+        fl = self.flight
+        if fl is not None:
+            fl.finished(req.rid, reason=reason,
+                        generated=len(req.generated),
+                        tier=self._tier())
         if tr is not None:
             tr.complete("engine.retire", t, track=self._trace_track,
                         args={"rid": req.rid, "finish_reason": reason})
@@ -1681,6 +1753,9 @@ class LLMEngine:
             self.stats.record_spec(proposed=k, accepted=min(j, n_acc),
                                    emitted=m, rollback=k - j,
                                    pages_rolled=rolled)
+            fl = self.flight
+            if fl is not None:
+                fl.spec_round(req.rid, min(j, n_acc), k - j)
             if (not req.spec_disabled
                     and req.spec_proposed >= self.spec_window
                     and req.spec_accepted

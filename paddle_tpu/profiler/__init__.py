@@ -14,6 +14,10 @@ from .profiler import (  # noqa: F401
     export_chrome_tracing, load_profiler_result, make_scheduler,
 )
 from .serving import ServingStats  # noqa: F401
+from .slo import (  # noqa: F401
+    AnomalyDetector, AnomalySpool, SLOConfig, SLOMonitor,
+    WindowedTelemetry,
+)
 from .timer import benchmark  # noqa: F401
 from .trace import Tracer  # noqa: F401
 
@@ -21,6 +25,8 @@ __all__ = [
     "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
     "make_scheduler", "export_chrome_tracing", "load_profiler_result",
     "benchmark", "ServingStats", "Tracer",
+    "SLOConfig", "SLOMonitor", "WindowedTelemetry", "AnomalyDetector",
+    "AnomalySpool",
 ]
 
 

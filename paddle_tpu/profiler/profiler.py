@@ -95,15 +95,23 @@ def load_profiler_result(file_name: str):
 class _HostTracer:
     """Collects (name, start_ns, dur_ns, tid) host events."""
 
-    def __init__(self):
+    def __init__(self, max_events: int = 1_000_000):
         self.events = []
         self.enabled = False
+        # per-cycle cap: events clear on every cycle boundary, but a
+        # runaway RECORD span must not grow the host heap without bound;
+        # overflow is counted, not silent
+        self.max_events = max_events
+        self.dropped = 0
         self._lock = threading.Lock()
 
     def add(self, name, start_ns, dur_ns):
         if not self.enabled:
             return
         with self._lock:
+            if len(self.events) >= self.max_events:
+                self.dropped += 1
+                return
             self.events.append(
                 (name, start_ns, dur_ns, threading.get_ident()))
 

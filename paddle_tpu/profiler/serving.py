@@ -227,7 +227,36 @@ class ServingStats:
         self.block_time = 0.0
         self._dispatch_lat = _Reservoir(r, seed=5)
         self._block_lat = _Reservoir(r, seed=6)
+        # SLO-observatory surface (PR 13): queue wait (arrival ->
+        # admission) joins the lifetime reservoirs, and an OPT-IN
+        # windowed layer (profiler/slo.py) rides beside them — None
+        # means every record path below pays one attribute check and
+        # never executes a line of slo.py (pinned by tracemalloc test)
+        self._queue_wait = _Reservoir(r, seed=7)
+        # enablement SURVIVES reset (benches reset between passes, the
+        # runner resets nothing but shares stats across rebuilds): the
+        # rings are rolling, stale samples age out on their own
+        self._windows = getattr(self, "_windows", None)
         self._t_start = time.monotonic() # process-lifetime uptime anchor
+
+    def enable_windows(self, slo=None, *, windows=(10.0, 60.0, 300.0),
+                       tracer=None, clock=None):
+        """Attach the windowed-telemetry layer (rolling TTFT/ITL/step/
+        queue-wait/accept-rate windows + SLO burn-rate state — see
+        profiler/slo.py).  Idempotent: the first call builds it from
+        ``slo`` (an SLOConfig or None for defaults); later calls return
+        the existing layer so engine and frontend can both ask for it."""
+        if self._windows is None:
+            from .slo import WindowedTelemetry
+            kw = {} if clock is None else {"clock": clock}
+            self._windows = WindowedTelemetry(slo, windows=windows,
+                                              tracer=tracer, **kw)
+        return self._windows
+
+    @property
+    def windows(self):
+        """The windowed-telemetry layer, or None when never enabled."""
+        return self._windows
 
     # -- recording (engine-facing) ------------------------------------------
 
@@ -239,6 +268,9 @@ class ServingStats:
         # each sequence's first token comes out of the prefill step
         self._token_lat.extend(float(duration_s), int(n_seqs))
         self._itl_hist.add(float(duration_s), int(n_seqs))
+        w = self._windows
+        if w is not None and n_seqs:
+            w.record_itl(float(duration_s), int(n_seqs))
 
     def record_decode(self, duration_s: float, n_tokens: int,
                       occupancy: float) -> None:
@@ -248,6 +280,9 @@ class ServingStats:
         self._token_lat.extend(float(duration_s), int(n_tokens))
         self._itl_hist.add(float(duration_s), int(n_tokens))
         self._occupancy.add(float(occupancy))
+        w = self._windows
+        if w is not None and n_tokens:
+            w.record_itl(float(duration_s), int(n_tokens))
 
     def record_step(self, duration_s: float, dispatch_s: float = 0.0,
                     block_s: float = 0.0) -> None:
@@ -268,6 +303,9 @@ class ServingStats:
         self.block_time += float(block_s)
         self._dispatch_lat.add(float(dispatch_s))
         self._block_lat.add(float(block_s))
+        w = self._windows
+        if w is not None:
+            w.record_step(d)
 
     def record_admission(self, n: int = 1) -> None:
         self.admitted += int(n)
@@ -304,6 +342,40 @@ class ServingStats:
     def record_ttft(self, duration_s: float) -> None:
         self._ttft.add(float(duration_s))
         self._ttft_hist.add(float(duration_s))
+        w = self._windows
+        if w is not None:
+            w.record_ttft(float(duration_s))
+
+    def record_queue_wait(self, duration_s: float) -> None:
+        """Seconds one request sat queued between arrival and engine
+        admission — the scheduler-pressure signal the future SLO-aware
+        admission predictor consumes."""
+        self._queue_wait.add(float(duration_s))
+        w = self._windows
+        if w is not None:
+            w.record_queue_wait(float(duration_s))
+
+    def record_request_latency(self, duration_s: float) -> None:
+        """One finished request's arrival-to-last-token latency; feeds
+        the windowed slow-request anomaly detector (windowed layer
+        only — lifetime latency already decomposes into TTFT + ITL)."""
+        w = self._windows
+        if w is not None:
+            w.record_request(float(duration_s))
+
+    def record_deadline(self, met: bool) -> None:
+        """One deadline-bearing request finished: did it beat its
+        deadline?  (Windowed layer only; recorded by the runner.)"""
+        w = self._windows
+        if w is not None:
+            w.record_deadline(bool(met))
+
+    def record_finish_quality(self, ok: bool) -> None:
+        """One finished request, natural (True) or errored (False) —
+        the availability objective's windowed sample."""
+        w = self._windows
+        if w is not None:
+            w.record_finish(bool(ok))
 
     def record_verify(self, duration_s: float, n_tokens: int,
                       occupancy: float) -> None:
@@ -322,6 +394,9 @@ class ServingStats:
         self._token_lat.extend(float(duration_s), int(n_tokens))
         self._itl_hist.add(float(duration_s), int(n_tokens))
         self._occupancy.add(float(occupancy))
+        w = self._windows
+        if w is not None and n_tokens:
+            w.record_itl(float(duration_s), int(n_tokens))
 
     def record_spec(self, *, proposed: int, accepted: int, emitted: int,
                     rollback: int, pages_rolled: int = 0) -> None:
@@ -332,6 +407,9 @@ class ServingStats:
         self.spec_emitted_tokens += int(emitted)
         self.rollback_tokens += int(rollback)
         self.rollback_pages += int(pages_rolled)
+        w = self._windows
+        if w is not None and proposed:
+            w.record_accept(int(accepted), int(proposed))
 
     def record_spec_disable(self, n: int = 1) -> None:
         self.spec_disables += int(n)
@@ -493,7 +571,13 @@ class ServingStats:
             "step_hist_buckets": self._step_hist.buckets(),
             "step_hist_sum": self._step_hist.total,
             "step_hist_count": self._step_hist.count,
+            "queue_wait_p50_ms": round(
+                1e3 * self._queue_wait.percentile(50), 3),
+            "queue_wait_p99_ms": round(
+                1e3 * self._queue_wait.percentile(99), 3),
         }
+        if self._windows is not None:
+            out.update(self._windows.snapshot_keys())
         if include_samples:
             out["_samples"] = {"token_lat": self._token_lat.samples(),
                                "ttft": self._ttft.samples()}
@@ -531,8 +615,19 @@ class ServingStats:
             "ttft_p50_ms", "ttft_p99_ms", "max_prefill_queue_depth",
             "uptime_seconds", "degradation_state",
             "dispatch_ms_p50", "dispatch_ms_p99",
-            "block_ms_p50", "block_ms_p99")
+            "block_ms_p50", "block_ms_p99",
+            "queue_wait_p50_ms", "queue_wait_p99_ms")
     _MEAN = ("mean_batch_occupancy", "mean_prefill_queue_depth")
+    # windowed-telemetry keys (present only when enable_windows() ran)
+    # are pooled structurally after the generic pass: bucket counts sum
+    # per window index across replicas (identical ladders), windowed
+    # percentiles and burn rates recompute from the POOLED distribution,
+    # and the fleet SLO state is the worst replica's (a page anywhere
+    # pages the fleet)
+    _WINDOWED = ("windows", "slo", "slo_state", "slo_state_name",
+                 "ttft_p95_w60s", "itl_p99_w60s", "queue_wait_p95_w60s",
+                 "anomalies_detected", "anomalies_captured",
+                 "anomaly_spool_dropped")
 
     @staticmethod
     def aggregate(snapshots) -> dict:
@@ -546,7 +641,7 @@ class ServingStats:
             raise ValueError("aggregate() needs at least one snapshot")
         out: dict = {}
         for key in snaps[0]:
-            if key == "_samples":
+            if key == "_samples" or key in ServingStats._WINDOWED:
                 continue                         # pooled below, never summed
             vals = [s[key] for s in snaps]
             if isinstance(vals[0], dict):        # abort_reasons, fault_injections
@@ -584,5 +679,32 @@ class ServingStats:
                 out[f"itl_p{q}_ms"] = out[f"p{q}_token_ms"]
                 out[f"ttft_p{q}_ms"] = round(
                     1e3 * _percentile(ttft, q), 3)
+        windowed = [s for s in snaps if "windows" in s]
+        if windowed:
+            from .slo import (SLO_STATE_NAMES, aggregate_windows,
+                              evaluate_slo)
+            ws = aggregate_windows([s["windows"] for s in windowed])
+            out["windows"] = ws
+            ev = evaluate_slo(windowed[0]["slo"]["config"], ws)
+            # worst replica wins over the fleet-level evaluation: one
+            # paging replica must not be averaged away by healthy peers
+            state = max([ev["state"]]
+                        + [s.get("slo_state", 0) for s in windowed])
+            ev["state"] = state
+            ev["state_name"] = SLO_STATE_NAMES[state]
+            out["slo"] = ev
+            out["slo_state"] = state
+            out["slo_state_name"] = SLO_STATE_NAMES[state]
+            mid = sorted((k for k in ws if k != "bounds"),
+                         key=lambda s: float(s[:-1]))
+            mid = mid[min(1, len(mid) - 1)] if mid else None
+            if mid is not None:
+                out["ttft_p95_w60s"] = ws[mid]["ttft"]["p95_ms"]
+                out["itl_p99_w60s"] = ws[mid]["itl"]["p99_ms"]
+                out["queue_wait_p95_w60s"] = \
+                    ws[mid]["queue_wait"]["p95_ms"]
+            for key in ("anomalies_detected", "anomalies_captured",
+                        "anomaly_spool_dropped"):
+                out[key] = sum(s.get(key, 0) for s in windowed)
         out["replicas"] = len(snaps)
         return out

@@ -1,0 +1,29 @@
+"""Seeds unbounded-observability-buffer: a per-step append inside an
+observability-tier class with no visible bound — no capacity/maxlen/
+limit attribute, no deque(maxlen=), no pop-style eviction — always-on
+telemetry that leaks on a long-running server."""
+
+
+class StepStatsLog:
+    """Collects one row per engine step, forever."""
+
+    def __init__(self):
+        self.rows = []
+
+    def record(self, step_ms):
+        self.rows.append(step_ms)
+
+
+class BoundedStepStatsLog:
+    """The sanctioned shape: a cap plus counted shedding — silent."""
+
+    def __init__(self, capacity=1024):
+        self.capacity = capacity
+        self.dropped = 0
+        self.rows = []
+
+    def record(self, step_ms):
+        if len(self.rows) >= self.capacity:
+            self.dropped += 1
+            return
+        self.rows.append(step_ms)

@@ -1,0 +1,173 @@
+"""Perf-regression gate (tools/perf/bench_history.py): the pure
+check_record comparison, the append/check CLI round trip, and the gate
+against the repo's real bench_history.json."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+_CLI = os.path.join(_REPO, "tools", "perf", "bench_history.py")
+
+sys.path.insert(0, os.path.join(_REPO, "tools", "perf"))
+from bench_history import check_record  # noqa: E402
+
+
+def _serve_rec(value=100.0, ttft=50.0, itl=20.0, **kw):
+    rec = {"metric": "serve_slo_tokens_per_s", "backend": "cpu",
+           "tp": 1, "replicas": 1, "value": value,
+           "ttft_p95_w60s": ttft, "itl_p99_w60s": itl}
+    rec.update(kw)
+    return rec
+
+
+BASE = [_serve_rec(100.0 + d, 50.0 + d, 20.0) for d in (-2, 0, 2, 1)]
+
+
+# ---------------------------------------------------------------------------
+# check_record: the pure comparison
+# ---------------------------------------------------------------------------
+
+def test_within_noise_band_passes():
+    out = check_record(_serve_rec(98.0, 52.0, 20.5), BASE)
+    assert out["verdict"] == "pass" and out["regressed"] == []
+    assert out["checked"]["value"]["ok"] is True
+
+
+def test_throughput_drop_regresses():
+    out = check_record(_serve_rec(value=40.0), BASE)
+    assert out["verdict"] == "regression"
+    assert out["regressed"] == ["value"]
+    c = out["checked"]["value"]
+    assert c["value"] < c["threshold"] <= c["median"]
+
+
+def test_latency_climb_regresses():
+    # 3x the baseline TTFT median: far past median + max(k*MAD, 25%)
+    out = check_record(_serve_rec(ttft=150.0), BASE)
+    assert out["verdict"] == "regression"
+    assert out["regressed"] == ["ttft_p95_w60s"]
+    c = out["checked"]["ttft_p95_w60s"]
+    assert c["value"] > c["threshold"] >= c["median"]
+
+
+def test_higher_throughput_and_lower_latency_never_flag():
+    out = check_record(_serve_rec(value=500.0, ttft=1.0, itl=0.5), BASE)
+    assert out["verdict"] == "pass"
+
+
+def test_insufficient_baseline_never_blocks():
+    out = check_record(_serve_rec(), BASE[:2])
+    assert out["verdict"] == "insufficient_baseline"
+
+
+def test_error_records_excluded_from_baseline_and_fail_as_newest():
+    poisoned = BASE + [_serve_rec(value=1.0, error="boom")] * 5
+    out = check_record(_serve_rec(98.0), poisoned)
+    assert out["verdict"] == "pass"        # error rows never join the band
+    out = check_record(_serve_rec(error="crashed"), BASE)
+    assert out["verdict"] == "error_record"
+
+
+def test_rel_floor_guards_identical_baselines():
+    # zero-MAD baseline: three identical runs; a 10% wobble stays in
+    # the 25% relative floor
+    same = [_serve_rec(100.0, 50.0, 20.0)] * 4
+    assert check_record(_serve_rec(90.0, 55.0, 22.0),
+                        same)["verdict"] == "pass"
+    assert check_record(_serve_rec(60.0), same)["verdict"] == "regression"
+
+
+def test_training_records_gate_on_tokens_per_sec():
+    base = [{"tokens_per_sec": 1000.0 + d, "backend": "cpu",
+             "config": "tiny"} for d in (-5, 0, 5, 2)]
+    assert check_record({"tokens_per_sec": 990.0, "backend": "cpu",
+                         "config": "tiny"}, base)["verdict"] == "pass"
+    out = check_record({"tokens_per_sec": 400.0, "backend": "cpu",
+                        "config": "tiny"}, base)
+    assert out["verdict"] == "regression"
+    assert out["regressed"] == ["tokens_per_sec"]
+
+
+# ---------------------------------------------------------------------------
+# CLI round trip (the CI wiring smoke)
+# ---------------------------------------------------------------------------
+
+def _run(tmp_path, *args):
+    return subprocess.run(
+        [sys.executable, _CLI, *args], capture_output=True, text=True,
+        cwd=tmp_path, timeout=60)
+
+
+def _append(tmp_path, rec):
+    p = tmp_path / "rec.json"
+    p.write_text(json.dumps(rec))
+    r = _run(tmp_path, "append", str(p))
+    assert r.returncode == 0, r.stdout + r.stderr
+    return json.loads(r.stdout.strip())
+
+
+def test_cli_append_check_round_trip_and_injected_regression(tmp_path):
+    for rec in BASE:
+        out = _append(tmp_path, rec)
+    assert out["n_records"] == len(BASE)
+    assert out["group"] == ["serve", "serve_slo_tokens_per_s", "cpu",
+                            "1", "1"]
+
+    # two healthy synthetic records in a row pass
+    for rec in (_serve_rec(99.0), _serve_rec(101.0)):
+        _append(tmp_path, rec)
+        r = _run(tmp_path, "check")
+        assert r.returncode == 0, r.stdout + r.stderr
+        verdict = json.loads(r.stdout.strip())
+        assert verdict["verdict"] == "pass"
+        assert verdict["baseline_n"] >= len(BASE)
+
+    # inject a 3x TTFT regression: nonzero exit, named metric
+    _append(tmp_path, _serve_rec(ttft=150.0))
+    r = _run(tmp_path, "check")
+    assert r.returncode == 1
+    verdict = json.loads(r.stdout.strip())
+    assert verdict["verdict"] == "regression"
+    assert "ttft_p95_w60s" in verdict["regressed"]
+
+    # history stays a valid JSON array through every append
+    hist = json.loads((tmp_path / "bench_history.json").read_text())
+    assert isinstance(hist, list) and len(hist) == len(BASE) + 3
+
+
+def test_cli_check_empty_history_is_a_pass(tmp_path):
+    r = _run(tmp_path, "check")
+    assert r.returncode == 0
+    assert json.loads(r.stdout.strip())["verdict"] == "insufficient_baseline"
+
+
+def test_cli_groups_never_cross_contaminate(tmp_path):
+    for rec in BASE:
+        _append(tmp_path, rec)
+    # a different metric's terrible value gates against ITS OWN (empty)
+    # baseline, not the serve_slo one
+    _append(tmp_path, _serve_rec(value=1.0, metric="serve_other"))
+    r = _run(tmp_path, "check")
+    assert r.returncode == 0
+    verdict = json.loads(r.stdout.strip())
+    assert verdict["verdict"] == "insufficient_baseline"
+    assert verdict["group"][1] == "serve_other"
+
+
+@pytest.mark.skipif(
+    not os.path.exists(os.path.join(_REPO, "bench_history.json")),
+    reason="repo bench_history.json absent")
+def test_gate_passes_on_repo_history(tmp_path):
+    """ISSUE acceptance: the gate runs clean over the repo's real
+    bench history (its newest record is not a regression)."""
+    r = subprocess.run(
+        [sys.executable, _CLI, "check",
+         "--history", os.path.join(_REPO, "bench_history.json")],
+        capture_output=True, text=True, cwd=tmp_path, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    verdict = json.loads(r.stdout.strip())
+    assert verdict["verdict"] in ("pass", "insufficient_baseline")

@@ -54,6 +54,8 @@ def _lint_fix(name):
      "wallclock-in-timing-path", 8, "measure_step", WARNING),
     (os.path.join("inference", "fix_host_sync_dispatch.py"),
      "host-sync-in-dispatch-path", 12, "dispatch_step", WARNING),
+    (os.path.join("inference", "fix_unbounded_buffer.py"),
+     "unbounded-observability-buffer", 14, "StepStatsLog.record", WARNING),
     (os.path.join("pallas", "fix_untuned_launch.py"),
      "untuned-pallas-launch", 15, "hardcoded_launch", WARNING),
 ])
@@ -264,6 +266,7 @@ def test_every_catalog_rule_is_exercised():
         "quantized-kv-float32-page", "swallowed-exception",
         "collective-outside-shard-map", "untuned-pallas-launch",
         "wallclock-in-timing-path", "host-sync-in-dispatch-path",
+        "unbounded-observability-buffer",
         "undonated-buffer", "host-callback", "dtype-promotion",
         "dead-code", "dead-input", "passthrough-output",
     }

@@ -68,6 +68,38 @@ def test_serve_bench_http_emits_frontend_surface():
     assert set(record["finish_reasons"]) <= {"length", "stop"}
 
 
+def test_serve_bench_slo_emits_observatory_surface():
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "--smoke", "--slo", "--requests", "6"],
+        capture_output=True, text=True, timeout=540,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.strip().splitlines() if ln.strip()]
+    assert lines, f"no stdout; stderr: {out.stderr[-2000:]}"
+    record = json.loads(lines[-1])
+    assert record["metric"] == "serve_slo_tokens_per_s"
+    assert "error" not in record, record
+    assert record["value"] > 0
+    # the observatory endpoints answered during the timed stream
+    assert record["slo_http_status"] == 200
+    assert record["debug_requests_http_status"] == 200
+    # windowed telemetry saw the stream: samples in the 60s rings, and
+    # the headline percentiles every mode's record now carries
+    assert record["windowed_ttft_samples"] > 0
+    assert record["windowed_itl_samples"] > 0
+    assert record["windowed_request_samples"] > 0
+    assert record["ttft_p95_w60s"] > 0
+    assert record["itl_p99_w60s"] > 0
+    assert record["slo_state"] in ("NORMAL", "WARN", "PAGE")
+    assert record["availability_rate"] == 1.0
+    # flight recorder captured the requests; anomaly spool stayed
+    # bounded (counts present even when nothing fired)
+    assert record["flight_records"] > 0
+    assert record["flight_evicted"] == 0
+    assert record["anomalies_captured"] >= 0
+    assert record["anomaly_spool_dropped"] == 0
+
+
 def test_serve_bench_spec_emits_acceptance_surface():
     out = subprocess.run(
         [sys.executable, SCRIPT, "--smoke", "--spec", "3",

@@ -1,0 +1,586 @@
+"""SLO observatory: windowed rings and burn-rate states, anomaly
+detection + bounded spool capture, the per-request flight recorder,
+cross-replica pooling, degradation-tier forensics, the frontend's
+/slo and /debug/requests endpoints, and the disabled-means-free
+contract (byte-identity + tracemalloc pins)."""
+import http.client
+import json
+import os
+import tracemalloc
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import LLMEngine
+from paddle_tpu.inference.flight import FlightRecorder
+from paddle_tpu.inference.frontend import serve_background
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.profiler import Tracer
+from paddle_tpu.profiler.serving import ServingStats
+from paddle_tpu.profiler.slo import (NORMAL, PAGE, WARN, AnomalyDetector,
+                                     AnomalySpool, SLOConfig,
+                                     WindowedTelemetry, aggregate_windows,
+                                     bucket_percentile, evaluate_slo)
+
+VOCAB = 97
+CFG = LlamaConfig.tiny(vocab=VOCAB, hidden=32, layers=2, heads=4, ffn=64,
+                       seq=64)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return LlamaForCausalLM(CFG)
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_num_seqs", 4)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_model_len", 64)
+    kw.setdefault("max_prefill_tokens", 128)
+    kw.setdefault("prefill_token_bucket", 32)
+    return LLMEngine(model, **kw)
+
+
+def _post(port, obj, path="/v1/completions", timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", path, body=json.dumps(obj).encode(),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, body
+
+
+def _get(port, path, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, body
+
+
+class _Clock:
+    """Deterministic stand-in for time.perf_counter."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# bucket math + ring rotation
+# ---------------------------------------------------------------------------
+
+def test_bucket_percentile_interpolates_and_clamps():
+    bounds = (0.001, 0.01, 0.1)
+    # 10 samples all inside the (0.001, 0.01] bucket
+    counts = [0, 10, 0, 0]
+    p50 = bucket_percentile(counts, 50, bounds)
+    assert 0.001 < p50 <= 0.01
+    # overflow bucket clamps to the highest finite bound
+    assert bucket_percentile([0, 0, 0, 5], 99, bounds) == 0.1
+    assert bucket_percentile([0, 0, 0, 0], 50, bounds) == 0.0
+
+
+def test_ring_rotation_expires_stale_buckets_in_place():
+    clk = _Clock(0.5)
+    tele = WindowedTelemetry(windows=(12.0,), n_buckets=12, clock=clk)
+    tele.record_ttft(0.02)                 # lands in bucket gen 0
+    clk.t = 9.5
+    tele.record_ttft(0.02)                 # bucket gen 9
+    clk.t = 10.0
+    assert tele.snapshot()["12s"]["ttft"]["count"] == 2
+    clk.t = 12.5                           # gen 0 now 12 spans stale
+    assert tele.snapshot()["12s"]["ttft"]["count"] == 1
+    clk.t = 21.5                           # gen 9 stale too
+    assert tele.snapshot()["12s"]["ttft"]["count"] == 0
+    # the ring recycles the stale slots rather than allocating: a new
+    # sample after full expiry is the only thing visible
+    tele.record_ttft(0.02)
+    assert tele.snapshot()["12s"]["ttft"]["count"] == 1
+
+
+def test_snapshot_carries_every_channel_and_rate():
+    clk = _Clock(1.0)
+    tele = WindowedTelemetry(clock=clk)
+    tele.record_ttft(0.02)
+    tele.record_itl(0.005, n=3)
+    tele.record_step(0.008)
+    tele.record_queue_wait(0.001)
+    tele.record_request(0.2)
+    tele.record_accept(3, 4)
+    tele.record_deadline(True)
+    tele.record_deadline(False)
+    tele.record_finish(True)
+    snap = tele.snapshot()
+    assert set(snap) == {"bounds", "10s", "60s", "300s"}
+    for label in ("10s", "60s", "300s"):
+        w = snap[label]
+        assert w["ttft"]["count"] == 1
+        assert w["itl"]["count"] == 3
+        assert w["step"]["count"] == 1
+        assert w["queue_wait"]["count"] == 1
+        assert w["request"]["count"] == 1
+        assert w["accept"] == {"num": 3, "den": 4, "rate": 0.75}
+        assert w["deadline"] == {"num": 1, "den": 2, "rate": 0.5}
+        assert w["availability"]["rate"] == 1.0
+        assert 10.0 <= w["ttft"]["p95_ms"] <= 25.0
+
+
+# ---------------------------------------------------------------------------
+# burn rates + state machine + transition instants
+# ---------------------------------------------------------------------------
+
+def _fill(tele, fast: int, slow: int):
+    for _ in range(fast):
+        tele.record_ttft(0.002)
+        tele.record_itl(0.002)
+    for _ in range(slow):
+        tele.record_ttft(0.9)
+
+
+def test_burn_rate_states_normal_warn_page():
+    cfg = SLOConfig(ttft_p95_ms=100.0, itl_p99_ms=100.0)
+    # all fast -> NORMAL
+    clk = _Clock(1.0)
+    tele = WindowedTelemetry(cfg, clock=clk)
+    _fill(tele, fast=20, slow=0)
+    assert evaluate_slo(cfg, tele.snapshot())["state"] == NORMAL
+    # 1/20 slow = exactly the 5% TTFT budget -> burn 1.0 -> WARN (mid
+    # window trips warn_burn but short+mid stay under page_burn)
+    tele = WindowedTelemetry(cfg, clock=clk)
+    _fill(tele, fast=19, slow=1)
+    ev = evaluate_slo(cfg, tele.snapshot())
+    assert ev["state"] == WARN
+    assert ev["burn_rates"]["60s"]["ttft"] == pytest.approx(1.0)
+    # every sample slow -> burn 20 in short AND mid -> PAGE
+    tele = WindowedTelemetry(cfg, clock=clk)
+    _fill(tele, fast=0, slow=20)
+    ev = evaluate_slo(cfg, tele.snapshot())
+    assert ev["state"] == PAGE
+    assert ev["burn_rates"]["10s"]["max"] >= 2.0
+
+
+def test_slo_transitions_land_as_tracer_instants():
+    cfg = SLOConfig(ttft_p95_ms=100.0)
+    clk = _Clock(1.0)
+    tr = Tracer()
+    track = tr.register("engine")
+    tele = WindowedTelemetry(cfg, clock=clk, tracer=tr, track=track)
+    _fill(tele, fast=20, slow=0)
+    keys = tele.snapshot_keys()
+    assert keys["slo_state"] == NORMAL and not tele.slo.transitions
+    _fill(tele, fast=0, slow=40)
+    keys = tele.snapshot_keys()
+    assert keys["slo_state"] == PAGE
+    assert keys["slo_state_name"] == "PAGE"
+    # a full window roll later every ring is empty: burn 0 -> NORMAL
+    clk.t += 400.0
+    assert tele.snapshot_keys()["slo_state"] == NORMAL
+    assert list(tele.slo.transitions) == [(NORMAL, PAGE), (PAGE, NORMAL)]
+    insts = [ev for ev in tr.chrome_trace()["traceEvents"]
+             if ev.get("ph") == "i" and ev["name"] == "slo.transition"]
+    assert [(i["args"]["from"], i["args"]["to"]) for i in insts] \
+        == [("NORMAL", "PAGE"), ("PAGE", "NORMAL")]
+
+
+def test_snapshot_keys_headline_scalars():
+    clk = _Clock(1.0)
+    tele = WindowedTelemetry(clock=clk)
+    tele.record_ttft(0.3)
+    tele.record_itl(0.02)
+    tele.record_queue_wait(0.004)
+    keys = tele.snapshot_keys()
+    assert keys["ttft_p95_w60s"] == keys["windows"]["60s"]["ttft"]["p95_ms"]
+    assert keys["itl_p99_w60s"] == keys["windows"]["60s"]["itl"]["p99_ms"]
+    assert keys["queue_wait_p95_w60s"] > 0
+    assert keys["anomalies_detected"] == 0
+    assert keys["anomalies_captured"] == 0
+    assert keys["anomaly_spool_dropped"] == 0
+
+
+# ---------------------------------------------------------------------------
+# anomaly detection + bounded spool
+# ---------------------------------------------------------------------------
+
+def test_anomaly_detector_mad_threshold_and_cooldown():
+    clk = _Clock(0.0)
+    det = AnomalyDetector(min_samples=8, k=8.0, cooldown_s=5.0, clock=clk)
+    for i in range(10):
+        assert det.observe(0.010 + 0.0001 * (i % 3)) is False
+    assert det.observe(1.0) is True        # 100x the median: anomaly
+    assert det.detected == 1
+    # inside the cooldown: detected counts, but no second fire
+    assert det.observe(1.0) is False
+    assert det.detected == 2
+    clk.t += 10.0
+    assert det.observe(5.0) is True        # cooldown elapsed
+    assert det.detected == 3
+    assert det.last["value_s"] == 5.0
+    assert det.last["threshold_s"] > det.last["median_s"]
+
+
+def test_anomaly_spool_is_bounded_and_counts_drops(tmp_path):
+    spool = AnomalySpool(tmp_path / "sp", max_files=3)
+    paths = [spool.capture({"kind": "slow_step", "i": i}) for i in range(5)]
+    assert [p is not None for p in paths] == [True] * 3 + [False] * 2
+    assert spool.captured == 3 and spool.dropped == 2
+    files = sorted(os.listdir(tmp_path / "sp"))
+    assert files == [f"anomaly-{i:06d}.json" for i in range(3)]
+    with open(paths[0]) as f:
+        assert json.load(f)["kind"] == "slow_step"
+    # a reopened spool counts the files already on disk toward the cap
+    again = AnomalySpool(tmp_path / "sp", max_files=3)
+    assert again.capture({"kind": "x"}) is None
+    assert again.dropped == 1
+
+
+def test_anomaly_capture_snapshots_trace_and_flight(tmp_path):
+    clk = _Clock(0.0)
+    tr = Tracer(capacity=64)
+    track = tr.register("engine")
+    tr.instant("engine.step", track=track)
+    fl = FlightRecorder(8)
+    fl.open(0, prompt_tokens=4)
+    spool = AnomalySpool(tmp_path / "sp", max_files=4)
+    tele = WindowedTelemetry(clock=clk)
+    tele.arm_anomaly(
+        spool=spool, tracer=tr, flight=fl,
+        step_detector=AnomalyDetector(min_samples=4, cooldown_s=0.0,
+                                      clock=clk))
+    for _ in range(6):
+        tele.record_step(0.01)
+    tele.record_step(2.0)                  # outlier -> capture
+    assert spool.captured == 1
+    assert tele.snapshot_keys()["anomalies_captured"] == 1
+    (fname,) = os.listdir(tmp_path / "sp")
+    with open(tmp_path / "sp" / fname) as f:
+        payload = json.load(f)
+    assert payload["kind"] == "slow_step"
+    assert payload["value_s"] == 2.0
+    assert any(ev["name"] == "engine.step"
+               for ev in payload["trace"]["traceEvents"]
+               if ev.get("ph") == "i")
+    assert payload["flight"][0]["rid"] == 0
+
+
+# ---------------------------------------------------------------------------
+# cross-replica pooling (satellite: ServingStats.aggregate)
+# ---------------------------------------------------------------------------
+
+def test_aggregate_windows_pools_bucket_counts_exactly():
+    clk = _Clock(1.0)
+    fast, slow = WindowedTelemetry(clock=clk), WindowedTelemetry(clock=clk)
+    for _ in range(100):
+        fast.record_ttft(0.002)
+        slow.record_ttft(0.9)
+    for _ in range(10):
+        fast.record_deadline(True)
+        slow.record_deadline(False)
+    agg = aggregate_windows([fast.snapshot(), slow.snapshot()])
+    for label in ("10s", "60s", "300s"):
+        w = agg[label]["ttft"]
+        assert w["count"] == 200
+        assert sum(w["buckets"]) == 200
+        # honest fleet percentiles from the POOLED distribution: the
+        # p95 sits in the slow population's bucket, not at either
+        # replica's own quantile
+        assert 500.0 < w["p95_ms"] <= 1000.0
+        assert agg[label]["deadline"] == {"num": 10, "den": 20,
+                                          "rate": 0.5}
+    # each replica alone disagrees with the pool (the max-of-quantiles
+    # bound this replaces)
+    assert fast.snapshot()["60s"]["ttft"]["p95_ms"] < 5.0
+
+
+def test_serving_stats_aggregate_pools_disjoint_replica_windows():
+    """Satellite: two replicas with disjoint latency populations pool
+    into one fleet view — summed bucket counts, recomputed percentiles,
+    and worst-replica-wins SLO state."""
+    clk = _Clock(1.0)
+    s_fast, s_slow = ServingStats(), ServingStats()
+    s_fast.enable_windows(clock=clk)
+    s_slow.enable_windows(clock=clk)
+    for _ in range(50):
+        s_fast.record_ttft(0.002)
+        s_slow.record_ttft(0.9)            # blows the 500ms default SLO
+    agg = ServingStats.aggregate([s_fast.snapshot(), s_slow.snapshot()])
+    assert agg["windows"]["60s"]["ttft"]["count"] == 100
+    assert sum(agg["windows"]["60s"]["ttft"]["buckets"]) == 100
+    assert agg["ttft_p95_w60s"] > 500.0
+    # one paging replica pages the fleet, never averaged away
+    assert s_fast.snapshot()["slo_state"] == NORMAL
+    assert s_slow.snapshot()["slo_state"] == PAGE
+    assert agg["slo_state"] == PAGE and agg["slo_state_name"] == "PAGE"
+
+
+def test_aggregate_without_windows_unchanged():
+    a, b = ServingStats(), ServingStats()
+    agg = ServingStats.aggregate([a.snapshot(), b.snapshot()])
+    assert "windows" not in agg and "slo_state" not in agg
+
+
+# ---------------------------------------------------------------------------
+# flight recorder unit
+# ---------------------------------------------------------------------------
+
+def test_flight_lru_evicts_oldest_and_cleans_the_id_index():
+    fr = FlightRecorder(capacity=2)
+    fr.open(0, prompt_tokens=1)
+    fr.open(1, prompt_tokens=1)
+    fr.annotate(1, request_id="r-1", replica="r0", deadline_s=4.0)
+    fr.open(2, prompt_tokens=1)            # evicts rid 0
+    assert len(fr) == 2 and fr.evicted == 1
+    assert fr.get(0) is None
+    assert fr.get("r-1")["rid"] == 1
+    assert fr.get("r-1")["replica"] == "r0"
+    fr.open(3, prompt_tokens=1)            # evicts rid 1 -> index entry too
+    assert fr.get("r-1") is None
+    # seams against evicted/unknown rids are silent no-ops
+    fr.admitted(0, queue_wait_s=0.1)
+    fr.finished(99, reason="eos", generated=1)
+
+
+def test_flight_slowest_ranking_filters_and_elapsed():
+    import time as _time
+    now = _time.perf_counter()
+    fr = FlightRecorder(capacity=8)
+    fr.open(0, prompt_tokens=1, t_submit=now - 10.0)   # live, oldest
+    fr.open(1, prompt_tokens=1, t_submit=now - 5.0)
+    fr.finished(1, reason="eos", generated=3)          # latency ~5s
+    fr.open(2, prompt_tokens=1, t_submit=now - 1.0)    # live, newest
+    slowest = fr.list(sort="slowest")
+    assert [r["rid"] for r in slowest] == [0, 1, 2]
+    es = [r["elapsed_s"] for r in slowest]
+    assert es == sorted(es, reverse=True)
+    assert [r["rid"] for r in fr.list(finished=True)] == [1]
+    assert {r["rid"] for r in fr.list(finished=False)} == {0, 2}
+    assert len(fr.list(limit=1)) == 1
+    assert [r["rid"] for r in fr.list(sort="recent")] == [2, 1, 0]
+
+
+def test_flight_deadline_slack_phases():
+    fr = FlightRecorder(capacity=4)
+    fr.open(0, prompt_tokens=4)
+    fr.annotate(0, request_id="q-0", deadline_s=10.0)
+    fr.admitted(0, queue_wait_s=1.0, cache_hit_tokens=2, tier=1)
+    fr.first_token(0, 2.0)
+    rec = fr.get("q-0")
+    assert rec["slack_admit_s"] == pytest.approx(9.0)
+    assert rec["slack_first_token_s"] == pytest.approx(8.0)
+    assert rec["tier_admit"] == 1 and rec["cache_hit_tokens"] == 2
+    assert rec["finished"] is False
+
+
+# ---------------------------------------------------------------------------
+# engine integration
+# ---------------------------------------------------------------------------
+
+def test_engine_populates_flight_and_windows(model):
+    eng = _engine(model)
+    fl = FlightRecorder(16)
+    eng.set_flight(fl)
+    eng.stats.enable_windows()
+    rng = np.random.RandomState(3)
+    for _ in range(3):
+        eng.add_request(rng.randint(0, VOCAB, 8).tolist(),
+                        max_new_tokens=4)
+    outs = eng.run()
+    assert len(outs) == 3
+    recs = fl.list(finished=True)
+    assert len(recs) == 3
+    for r in recs:
+        assert r["finish_reason"] in ("length", "eos", "stop")
+        assert r["generated_tokens"] > 0
+        assert r["queue_wait_s"] is not None
+        assert r["prefill_chunks"] >= 1
+        assert r["ttft_s"] is not None and r["latency_s"] >= r["ttft_s"]
+        assert r["tier_admit"] == 0 and r["tier_finish"] == 0
+    snap = eng.stats.snapshot()
+    w60 = snap["windows"]["60s"]
+    assert w60["ttft"]["count"] == 3
+    assert w60["request"]["count"] == 3
+    assert w60["availability"] == {"num": 3, "den": 3, "rate": 1.0}
+    assert w60["itl"]["count"] > 0 and w60["step"]["count"] > 0
+    assert snap["slo_state_name"] in ("NORMAL", "WARN", "PAGE")
+
+
+class _ScriptedPressure:
+    """Deterministic stand-in for DegradationController: walks a
+    scripted tier sequence, one entry per engine step, then holds."""
+
+    def __init__(self, script):
+        self._script = list(script)
+        self.state = 0
+        self.tier_entries = 0
+        self.evict_batch = 0
+
+    def update(self, blocks, spec_reserved: int = 0) -> int:
+        if self._script:
+            new = self._script.pop(0)
+            if new > self.state:
+                self.tier_entries += 1
+            self.state = new
+        return self.state
+
+    @property
+    def admission_paused(self) -> bool:
+        return False
+
+    @property
+    def evict_now(self) -> bool:
+        return False
+
+
+def test_tier_walk_instants_and_flight_tier_forensics(model):
+    """Satellite: a forced NORMAL->...->EVICT_PARKED walk lands every
+    transition as a pressure.tier tracer instant, and the flight record
+    pins the tier at admission vs at finish."""
+    tr = Tracer()
+    fl = FlightRecorder(8)
+    eng = _engine(model, pressure=_ScriptedPressure([0, 1, 2, 3]))
+    eng.set_tracer(tr)
+    eng.set_flight(fl)
+    rng = np.random.RandomState(5)
+    eng.add_request(rng.randint(0, VOCAB, 8).tolist(), max_new_tokens=6)
+    outs = eng.run()
+    assert len(outs) == 1
+    insts = [ev["args"] for ev in tr.chrome_trace()["traceEvents"]
+             if ev.get("ph") == "i" and ev["name"] == "pressure.tier"]
+    assert [(a["from"], a["to"]) for a in insts] == [(0, 1), (1, 2), (2, 3)]
+    assert [a["name"] for a in insts] \
+        == ["spec_shrink", "admit_pause", "evict_parked"]
+    (rec,) = fl.list(finished=True)
+    assert rec["tier_admit"] == 0          # admitted before the walk
+    assert rec["tier_finish"] == 3         # finished at the deepest tier
+    snap = eng.stats.snapshot()
+    assert snap["degradation_state"] == 3
+    assert snap["degradation_transitions"] == 3
+
+
+# ---------------------------------------------------------------------------
+# disabled means free: byte-identity + tracemalloc pins
+# ---------------------------------------------------------------------------
+
+def test_observability_on_off_byte_identical_with_pinned_compiles(model):
+    """ISSUE acceptance: the 16-request ragged audit stream produces
+    byte-identical greedy outputs with windows+flight on vs off, and
+    compile_counts does not move by a single entry."""
+    def run_stream(observability: bool):
+        eng = _engine(model, max_num_seqs=8, max_prefill_tokens=256,
+                      prefill_token_bucket=64)
+        if observability:
+            eng.stats.enable_windows()
+            eng.set_flight(FlightRecorder(64))
+        rng = np.random.RandomState(7)
+        shapes = [(4, 8), (9, 8), (13, 6)]
+        for i in range(16):
+            n, max_new = shapes[i % len(shapes)]
+            eng.add_request(rng.randint(0, VOCAB, n).tolist(),
+                            max_new_tokens=max_new)
+        outs = eng.run()
+        return ([outs[rid].generated for rid in sorted(outs)],
+                dict(eng.compile_counts), eng)
+
+    base, base_compiles, _ = run_stream(False)
+    obs, obs_compiles, eng = run_stream(True)
+    assert obs == base
+    assert obs_compiles == base_compiles
+    assert len(eng.flight.list(finished=True)) == 16
+    assert eng.stats.snapshot()["windows"]["300s"]["ttft"]["count"] == 16
+
+
+def test_disabled_observability_allocates_nothing(model):
+    """The zero-cost seam, pinned: with windows never enabled and no
+    flight recorder installed, the step loop executes no line of
+    profiler/slo.py or inference/flight.py."""
+    eng = _engine(model)
+    assert eng.stats.windows is None and eng.flight is None
+    rng = np.random.RandomState(11)
+    eng.add_request(rng.randint(0, VOCAB, 8).tolist(), max_new_tokens=4)
+    eng.run()                              # warm compiles outside the probe
+    for _ in range(3):
+        eng.add_request(rng.randint(0, VOCAB, 8).tolist(),
+                        max_new_tokens=6)
+    slo_file = os.path.join("*", "profiler", "slo.py")
+    flight_file = os.path.join("*", "inference", "flight.py")
+    tracemalloc.start()
+    try:
+        while eng.has_unfinished():
+            eng.step()
+        snap = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    stats = snap.filter_traces(
+        [tracemalloc.Filter(True, slo_file),
+         tracemalloc.Filter(True, flight_file)]).statistics("lineno")
+    assert stats == []
+
+
+# ---------------------------------------------------------------------------
+# frontend endpoints
+# ---------------------------------------------------------------------------
+
+def test_slo_and_debug_requests_endpoints(model):
+    eng = _engine(model, retain_outputs=False)
+    srv = serve_background(eng, model_name="tiny",
+                           slo_config={"ttft_p95_ms": 250.0},
+                           flight_capacity=32)
+    try:
+        ids = []
+        for i in range(2):
+            status, raw = _post(srv.port, {"model": "tiny",
+                                           "prompt": list(range(4 + i)),
+                                           "max_tokens": 4})
+            assert status == 200
+            ids.append(json.loads(raw)["id"])
+
+        status, raw = _get(srv.port, "/slo")
+        assert status == 200
+        doc = json.loads(raw)
+        assert doc["slo_state_name"] in ("NORMAL", "WARN", "PAGE")
+        assert doc["slo"]["config"]["ttft_p95_ms"] == 250.0
+        assert doc["windows"]["60s"]["ttft"]["count"] >= 2
+        assert "burn_rates" in doc["slo"]
+        assert doc["ttft_p95_w60s"] > 0
+
+        status, raw = _get(srv.port, "/debug/requests?finished=true")
+        assert status == 200
+        listing = json.loads(raw)
+        assert listing["count"] >= 2
+        by_id = {r["request_id"]: r for r in listing["requests"]}
+        assert set(ids) <= set(by_id)
+        for rid in ids:
+            assert by_id[rid]["finished"] is True
+            assert by_id[rid]["elapsed_s"] > 0
+
+        status, raw = _get(srv.port, f"/debug/requests/{ids[0]}")
+        assert status == 200
+        rec = json.loads(raw)
+        assert rec["request_id"] == ids[0]
+        assert rec["generated_tokens"] > 0
+
+        status, _ = _get(srv.port, "/debug/requests/not-a-request")
+        assert status == 404
+        status, _ = _post(srv.port, {}, path="/slo")
+        assert status == 405
+    finally:
+        srv.stop()
+
+
+def test_debug_requests_404_when_flight_disabled(model):
+    eng = _engine(model, retain_outputs=False)
+    srv = serve_background(eng, model_name="tiny", flight_capacity=0)
+    try:
+        status, _ = _get(srv.port, "/debug/requests")
+        assert status == 404
+        status, _ = _get(srv.port, "/debug/requests/x")
+        assert status == 404
+        # /slo stays live: windows are always enabled in the frontend
+        status, _ = _get(srv.port, "/slo")
+        assert status == 200
+    finally:
+        srv.stop()

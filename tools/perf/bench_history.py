@@ -1,0 +1,182 @@
+"""Perf-regression gate over bench_history.json.
+
+Two subcommands, one JSON line each (the bench.py contract):
+
+    python tools/perf/bench_history.py append record.json
+    python tools/perf/bench_history.py check            # exit 1 on regression
+
+``append`` adds one bench record (a JSON object from a file or stdin
+``-``) to the history array.  ``check`` compares the NEWEST record
+against the trailing records of its own group and exits nonzero when a
+watched metric regressed past the noise band.
+
+Two record shapes share the file:
+
+* training rows (tools/perf/bench.py): ``tokens_per_sec``, ``backend``,
+  ``config``, ... — grouped by (backend, config), throughput must not
+  drop.
+* serving rows (tools/perf/serve_bench.py): ``metric``, ``value``,
+  latency keys — grouped by (metric, backend, tp, replicas); ``value``
+  must not drop and the latency tails (``ttft_p95_w60s``,
+  ``itl_p99_w60s``, ``p99_token_ms``, ...) must not climb.
+
+The noise band is robust, not hand-tuned: per metric the baseline's
+median +- max(k * MAD, rel_floor * |median|).  MAD (median absolute
+deviation) ignores the odd outlier run a stddev would chase, and the
+relative floor keeps near-zero-MAD baselines (three identical runs)
+from flagging every wobble.  Fewer than ``--min-baseline`` comparable
+runs means there is nothing to gate against: verdict
+``insufficient_baseline``, exit 0 — the gate never blocks a young
+history.  Records carrying an ``"error"`` field never join a baseline,
+and an error NEWEST record fails the gate outright.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# serving metrics watched beyond the headline "value": (key, higher_is_better)
+_SERVE_WATCH = (
+    ("value", True),
+    ("ttft_p95_w60s", False),
+    ("itl_p99_w60s", False),
+    ("ttft_p99_ms", False),
+    ("itl_p99_ms", False),
+    ("p99_token_ms", False),
+)
+_TRAIN_WATCH = (("tokens_per_sec", True),)
+
+
+def _median(vals):
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def _mad(vals, med):
+    return _median([abs(v - med) for v in vals])
+
+
+def _group_key(rec):
+    """Which trailing records a record may be compared against."""
+    if "metric" in rec:                   # serve_bench shape
+        return ("serve", rec.get("metric"), rec.get("backend"),
+                str(rec.get("tp", 1)), str(rec.get("replicas", 1)))
+    return ("train", rec.get("backend"), rec.get("config"))
+
+
+def _num(rec, key):
+    v = rec.get(key)
+    return float(v) if isinstance(v, (int, float)) \
+        and not isinstance(v, bool) else None
+
+
+def check_record(newest, baseline, *, k: float = 4.0,
+                 rel_floor: float = 0.25, min_baseline: int = 3) -> dict:
+    """Pure comparison (the tests drive this directly): newest record
+    vs its same-group baseline records.  Returns the verdict dict the
+    CLI prints; ``verdict`` is "pass" | "regression" |
+    "insufficient_baseline" | "error_record"."""
+    if newest.get("error"):
+        return {"verdict": "error_record",
+                "error": newest["error"], "checked": {}}
+    watch = _SERVE_WATCH if "metric" in newest else _TRAIN_WATCH
+    baseline = [b for b in baseline if not b.get("error")]
+    checked: dict = {}
+    regressed = []
+    enough = False
+    for key, higher_better in watch:
+        v = _num(newest, key)
+        if v is None:
+            continue
+        base = [x for x in (_num(b, key) for b in baseline)
+                if x is not None]
+        if len(base) < min_baseline:
+            checked[key] = {"value": v, "baseline_n": len(base),
+                            "ok": None}
+            continue
+        enough = True
+        med = _median(base)
+        slack = max(k * _mad(base, med), rel_floor * abs(med))
+        worst = med - slack if higher_better else med + slack
+        ok = v >= worst if higher_better else v <= worst
+        checked[key] = {"value": round(v, 4), "median": round(med, 4),
+                        "mad": round(_mad(base, med), 4),
+                        "threshold": round(worst, 4),
+                        "baseline_n": len(base), "ok": ok}
+        if not ok:
+            regressed.append(key)
+    if not enough:
+        return {"verdict": "insufficient_baseline", "checked": checked,
+                "min_baseline": min_baseline}
+    return {"verdict": "regression" if regressed else "pass",
+            "regressed": regressed, "checked": checked}
+
+
+def _load(path):
+    try:
+        with open(path) as f:
+            hist = json.load(f)
+    except FileNotFoundError:
+        return []
+    if not isinstance(hist, list):
+        raise SystemExit(f"{path}: expected a JSON array of records")
+    return hist
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python tools/perf/bench_history.py",
+        description="Append bench records to bench_history.json and "
+                    "gate the newest one against its trailing baseline.")
+    ap.add_argument("cmd", choices=("append", "check"))
+    ap.add_argument("record", nargs="?", default=None,
+                    help="append: JSON record file ('-' = stdin)")
+    ap.add_argument("--history", default="bench_history.json")
+    ap.add_argument("--k", type=float, default=4.0,
+                    help="MAD multiplier for the noise band")
+    ap.add_argument("--rel-floor", type=float, default=0.25,
+                    help="minimum band as a fraction of the median "
+                         "(guards near-zero-MAD baselines)")
+    ap.add_argument("--min-baseline", type=int, default=3,
+                    help="comparable runs required before gating")
+    args = ap.parse_args(argv)
+
+    hist = _load(args.history)
+    if args.cmd == "append":
+        if args.record is None:
+            ap.error("append needs a record file (or '-')")
+        raw = sys.stdin.read() if args.record == "-" \
+            else open(args.record).read()
+        rec = json.loads(raw)
+        if not isinstance(rec, dict):
+            raise SystemExit("record must be a JSON object")
+        hist.append(rec)
+        with open(args.history, "w") as f:
+            json.dump(hist, f, indent=1)
+            f.write("\n")
+        print(json.dumps({"appended": True, "history": args.history,
+                          "n_records": len(hist),
+                          "group": list(_group_key(rec))}))
+        return 0
+
+    if not hist:
+        print(json.dumps({"verdict": "insufficient_baseline",
+                          "n_records": 0}))
+        return 0
+    newest = hist[-1]
+    key = _group_key(newest)
+    baseline = [r for r in hist[:-1] if _group_key(r) == key]
+    out = check_record(newest, baseline, k=args.k,
+                       rel_floor=args.rel_floor,
+                       min_baseline=args.min_baseline)
+    out["group"] = list(key)
+    out["n_records"] = len(hist)
+    out["baseline_n"] = len(baseline)
+    print(json.dumps(out))
+    return 1 if out["verdict"] in ("regression", "error_record") else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -64,6 +64,19 @@ the HTTP tier costs:
    "ttft_p50_ms": ..., "ttft_p99_ms": ..., "itl_p50_ms": ...,
    "itl_p99_ms": ..., "requests": ..., "aborts": ..., "shed": ...}
 
+With ``--slo`` the same stream rides the HTTP frontend with the SLO
+observatory armed — windowed telemetry, per-request flight recorder,
+anomaly spool — and the record is built from ``GET /slo`` and
+``GET /debug/requests`` (so CI proves the observatory saw the traffic):
+
+  {"metric": "serve_slo_tokens_per_s", "value": ..., "unit": "tok/s",
+   "slo_state": "NORMAL", "ttft_p95_w60s": ..., "itl_p99_w60s": ...,
+   "windowed_ttft_samples": ..., "flight_records": ...,
+   "anomalies_captured": ...}
+
+Every mode's record also carries ``ttft_p95_w60s`` / ``itl_p99_w60s`` /
+``slo_state`` / ``anomalies_captured`` from the windowed layer.
+
 With ``--memory-pressure`` the page pool is sized from a fixed HBM byte
 budget (not a block count) and a burst of medium prompts runs once per
 KV dtype — float32 baseline, then ``--kv-dtype`` — each through a
@@ -203,6 +216,18 @@ def _mem_keys(engine):
     }
 
 
+def _slo_keys(snap):
+    """Windowed SLO surface every mode reports next to the lifetime
+    stats: the rolling mid-window percentiles, the burn-rate state and
+    the anomaly-capture count (0s if windows were never enabled)."""
+    return {
+        "ttft_p95_w60s": snap.get("ttft_p95_w60s", 0.0),
+        "itl_p99_w60s": snap.get("itl_p99_w60s", 0.0),
+        "slo_state": snap.get("slo_state_name", "NORMAL"),
+        "anomalies_captured": snap.get("anomalies_captured", 0),
+    }
+
+
 def run_prefix_bench(smoke: bool, n_requests: int, share_ways: int,
                      seed: int, backend: str, kv_dtype: str = "float32",
                      tp: int = 1):
@@ -237,6 +262,7 @@ def run_prefix_bench(smoke: bool, n_requests: int, share_ways: int,
     for caching in (False, True):
         engine = LLMEngine(model, enable_prefix_caching=caching,
                            kv_dtype=kv_dtype, tp=tp, **engine_kw)
+        engine.stats.enable_windows()
         rng = np.random.RandomState(seed)
         stream = _prefix_stream(rng, n_requests, share_ways,
                                 cfg.vocab_size, engine_kw["max_model_len"])
@@ -276,6 +302,7 @@ def run_prefix_bench(smoke: bool, n_requests: int, share_ways: int,
         "prefill_compiles": on["prefill_compiles"],
         "preempted": on["preemptions"],
         **_mem_keys(engine),
+        **_slo_keys(engine.stats.snapshot()),
     }
 
 
@@ -345,6 +372,7 @@ def run_spec_bench(smoke: bool, n_requests: int, spec_k: int, seed: int,
                       spec_k=spec_k, max_spec_k=spec_k,
                       spec_accept_floor=0.0)
         engine = LLMEngine(model, kv_dtype=kv_dtype, tp=tp, **kw)
+        engine.stats.enable_windows()
         rng = np.random.RandomState(seed)
         stream = _spec_text_stream(rng, n_requests, cfg.vocab_size,
                                    engine_kw["max_model_len"])
@@ -391,6 +419,7 @@ def run_spec_bench(smoke: bool, n_requests: int, spec_k: int, seed: int,
         "p99_token_ms": on["p99_token_ms"],
         "preempted": on["preemptions"],
         **_mem_keys(engine),
+        **_slo_keys(engine.stats.snapshot()),
     }
 
 
@@ -489,6 +518,7 @@ def run_http_bench(smoke: bool, n_requests: int, seed: int, backend: str,
     # cold-cache prefill buckets, the second compiles the chunked-resume
     # buckets that only exist once the prefix cache is hot), then timed
     direct = LLMEngine(model, kv_dtype=kv_dtype, tp=tp, **engine_kw)
+    direct.stats.enable_windows()
     _drive(direct, list(stream))
     _drive(direct, list(stream))
     direct.stats.reset()
@@ -556,6 +586,104 @@ def run_http_bench(smoke: bool, n_requests: int, seed: int, backend: str,
         "drained": bool(drained),
         "finish_reasons": sorted({r["finish"] for r in results if r}),
         **_mem_keys(served),
+        **_slo_keys(served.stats.snapshot()),
+    }
+
+
+def run_slo_bench(smoke: bool, n_requests: int, seed: int, backend: str,
+                  kv_dtype: str = "float32", tp: int = 1):
+    """The SLO observatory exercised end to end: a mixed stream rides
+    the real HTTP frontend while windowed telemetry, the flight
+    recorder and an anomaly spool run, then the record is built FROM
+    the observability surfaces themselves — ``GET /slo`` and
+    ``GET /debug/requests`` — so CI proves the observatory saw the
+    traffic, not just that the traffic ran."""
+    import http.client
+    import tempfile
+    import time
+
+    import numpy as np
+
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.inference.frontend import serve_background
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if smoke or backend == "cpu":
+        cfg = LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                               ffn=128, seq=128)
+        engine_kw = dict(max_num_seqs=4, block_size=8, max_model_len=128,
+                         max_prefill_tokens=256, prefill_token_bucket=64)
+    else:
+        cfg = LlamaConfig(vocab_size=8192, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=4,
+                          num_attention_heads=8, num_key_value_heads=8,
+                          max_position_embeddings=1024)
+        engine_kw = dict(max_num_seqs=16, block_size=16, max_model_len=1024,
+                         max_prefill_tokens=2048, prefill_token_bucket=256)
+
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(seed)
+    stream = _request_stream(rng, n_requests, cfg.vocab_size,
+                             engine_kw["max_model_len"])
+    engine = LLMEngine(model, retain_outputs=False, kv_dtype=kv_dtype,
+                       tp=tp, **engine_kw)
+    spool_dir = tempfile.mkdtemp(prefix="serve-bench-anomaly-")
+    srv = serve_background(engine, model_name="bench",
+                           max_pending=4 * len(stream),
+                           anomaly_spool=spool_dir)
+
+    def _get_json(path):
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=60)
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read()
+        conn.close()
+        return resp.status, json.loads(body)
+
+    try:
+        _http_drive(srv.port, stream)        # warm: compile every bucket
+        t0 = time.perf_counter()
+        wall, results = _http_drive(srv.port, stream)
+        st_slo, slo = _get_json("/slo")
+        st_dbg, dbg = _get_json("/debug/requests?finished=true&limit=8")
+    finally:
+        srv.stop()
+
+    got = sum(len(r["tokens"]) for r in results if r)
+    ws = slo.get("windows", {})
+    labels = sorted((k for k in ws if k != "bounds"),
+                    key=lambda k: float(k[:-1]))
+    mid = ws[labels[min(1, len(labels) - 1)]] if labels else {}
+
+    def _count(ch):
+        return (mid.get(ch) or {}).get("count", 0)
+
+    return {
+        "metric": "serve_slo_tokens_per_s",
+        "value": round(got / wall, 2) if wall else 0.0,
+        "unit": "tok/s",
+        "backend": backend,
+        "requests": n_requests,
+        "streamed_tokens": got,
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "slo_http_status": st_slo,
+        "debug_requests_http_status": st_dbg,
+        "ttft_p95_w60s": slo.get("ttft_p95_w60s", 0.0),
+        "itl_p99_w60s": slo.get("itl_p99_w60s", 0.0),
+        "queue_wait_p95_w60s": slo.get("queue_wait_p95_w60s", 0.0),
+        "slo_state": slo.get("slo_state_name", "NORMAL"),
+        "windowed_ttft_samples": _count("ttft"),
+        "windowed_itl_samples": _count("itl"),
+        "windowed_request_samples": _count("request"),
+        "availability_rate": (mid.get("availability") or {}).get("rate",
+                                                                 0.0),
+        "flight_records": dbg.get("count", 0),
+        "flight_evicted": dbg.get("evicted", 0),
+        "anomalies_detected": slo.get("anomalies_detected", 0),
+        "anomalies_captured": slo.get("anomalies_captured", 0),
+        "anomaly_spool_dropped": slo.get("anomaly_spool_dropped", 0),
+        **_mem_keys(engine),
     }
 
 
@@ -638,6 +766,7 @@ def run_router_bench(smoke: bool, n_requests: int, share_ways: int,
             stop_ev.set()
             sampler.join(timeout=5.0)
             counters = router.router_counters()
+            runner_snap = router.stats_snapshot()
         finally:
             srv.stop()
         got = sum(len(r["tokens"]) for r in results if r)
@@ -684,6 +813,8 @@ def run_router_bench(smoke: bool, n_requests: int, share_ways: int,
         "speedup": round(aff["tokens_per_s"] / rnd["tokens_per_s"], 3)
         if rnd["tokens_per_s"] else 0.0,
         "kv_dtype": kv_dtype,
+        # the loop ends on the affinity pass: its fleet-pooled snapshot
+        **_slo_keys(runner_snap),
     }
 
 
@@ -757,6 +888,7 @@ def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str,
                          overlap=ov, **engine_kw)
 
     engine = _mk_engine(overlap != "off")
+    engine.stats.enable_windows()
     rng = np.random.RandomState(seed)
     stream = _mixed_request_stream(rng, n_requests, cfg.vocab_size,
                                    engine_kw["max_model_len"],
@@ -867,6 +999,7 @@ def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str,
         "preempted": s["preemptions"],
         **ab_keys,
         **_mem_keys(engine),
+        **_slo_keys(engine.stats.snapshot()),
     }
 
 
@@ -914,7 +1047,8 @@ def run_chaos_bench(smoke: bool, n_requests: int, seed: int, backend: str,
     # pool-exhaustion window (preempt + degradation pressure)
     plan = FaultPlan.seeded(seed, slow_s=slow_s, horizon=24)
     engine = factory()
-    engine.set_fault_plan(plan)
+    engine.stats.enable_windows()   # survives supervised rebuilds: the
+    engine.set_fault_plan(plan)     # runner carries stats across engines
     runner = EngineRunner(engine, max_pending=4 * n_requests,
                           engine_factory=factory,
                           step_deadline_s=step_deadline_s).start()
@@ -965,6 +1099,7 @@ def run_chaos_bench(smoke: bool, n_requests: int, seed: int, backend: str,
         "finish_reasons": sorted({o.finish_reason for o in outs}),
         "step_deadline_s": step_deadline_s,
         **_mem_keys(fin),
+        **_slo_keys(snap),
     }
 
 
@@ -1041,6 +1176,7 @@ def run_pressure_bench(smoke: bool, n_requests: int, seed: int,
         engine = LLMEngine(model, kv_dtype=dt, num_blocks=int(nb),
                            pressure=DegradationController(), tp=tp,
                            **engine_kw)
+        engine.stats.enable_windows()
         rng = np.random.RandomState(seed)
         stream = _pressure_stream(rng, n_requests, cfg.vocab_size)
         wall, peak_bytes = _drive_peak(engine, stream)
@@ -1084,6 +1220,7 @@ def run_pressure_bench(smoke: bool, n_requests: int, seed: int,
         "baseline_preempted": base["preempted"],
         "retired": q["retired"],
         "baseline_retired": base["retired"],
+        **_slo_keys(engine.stats.snapshot()),
     }
 
 
@@ -1110,6 +1247,7 @@ def run_bench(smoke: bool, n_requests: int, seed: int, backend: str,
 
     model = LlamaForCausalLM(cfg)
     engine = LLMEngine(model, kv_dtype=kv_dtype, tp=tp, **engine_kw)
+    engine.stats.enable_windows()
     rng = np.random.RandomState(seed)
     stream = _request_stream(rng, n_requests, cfg.vocab_size,
                              engine_kw["max_model_len"])
@@ -1143,6 +1281,7 @@ def run_bench(smoke: bool, n_requests: int, seed: int, backend: str,
         "preempted": s["preemptions"],
         "decode_tokens": s["decode_tokens"],
         **_mem_keys(engine),
+        **_slo_keys(engine.stats.snapshot()),
     }
 
 
@@ -1164,6 +1303,11 @@ def main(argv=None):
                     help="drive the same workload through the real HTTP "
                          "frontend (concurrent SSE clients on localhost) "
                          "next to an engine-direct run")
+    ap.add_argument("--slo", action="store_true",
+                    help="drive the stream through the HTTP frontend with "
+                         "the SLO observatory armed (windowed telemetry, "
+                         "flight recorder, anomaly spool) and build the "
+                         "record from GET /slo and GET /debug/requests")
     ap.add_argument("--mixed", action="store_true",
                     help="interleave long prefills, chunked resumes, plain "
                          "decodes and speculative verify rounds in one "
@@ -1237,6 +1381,11 @@ def main(argv=None):
                                               or backend == "cpu") else 64)
         record = {"metric": "serve_mixed_tokens_per_s", "value": 0.0,
                   "unit": "tok/s", "backend": backend}
+    elif args.slo:
+        n_requests = args.requests or (8 if (args.smoke or backend == "cpu")
+                                       else 32)
+        record = {"metric": "serve_slo_tokens_per_s", "value": 0.0,
+                  "unit": "tok/s", "backend": backend}
     elif args.http:
         n_requests = args.requests or (8 if (args.smoke or backend == "cpu")
                                        else 32)
@@ -1287,6 +1436,9 @@ def main(argv=None):
                                           backend, args.kv_dtype, args.tp,
                                           tracer=tracer,
                                           overlap=args.overlap))
+        elif args.slo:
+            record.update(run_slo_bench(args.smoke, n_requests, args.seed,
+                                        backend, args.kv_dtype, args.tp))
         elif args.http:
             record.update(run_http_bench(args.smoke, n_requests, args.seed,
                                          backend, args.kv_dtype, args.tp))
