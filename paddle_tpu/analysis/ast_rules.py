@@ -648,6 +648,96 @@ def lint_source(text: str, path: str = "<string>") -> list:
                          "packing with device compute; materialize in the "
                          "completion seam instead")
 
+        # ---- per-token-host-sync-in-decode-window (serving tier only) -----
+        # Decode-window contract: a body handed to lax.scan/lax.while_loop
+        # runs entirely on device — attention, sampling epilogue, KV
+        # append — and the host drains K committed tokens once per
+        # LAUNCH, after the loop returns.  A host materialization
+        # reachable from the body forces one sync per loop ITERATION,
+        # quietly reverting the window to per-token round trips.  Seed:
+        # defs passed by name (or as self-methods) to scan/while_loop;
+        # closure adds nested defs plus by-name AND self-method callees
+        # — the compiled fixpoint only follows by-name calls, so a
+        # hazard buried in a self-method callee goes unseen by the
+        # numpy-in-jit/host-sync-in-jit rules.  Name seeds resolve
+        # SCOPE-LOCALLY (defs nested in the lax call's enclosing
+        # function), the way Python resolves the closure actually
+        # passed — a whole-file by_name lookup would collide the local
+        # `step` body with an engine's `step` method and drag the whole
+        # host dispatch graph into the loop set.
+        def _enclosing_fn(node):
+            return next((a for a in ctx.ancestors(node)
+                         if isinstance(a, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef))), None)
+
+        window_set = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dd = _dotted(node.func) or ()
+            if dd[-1:] not in (("scan",), ("while_loop",)) \
+                    or not ("lax" in dd or len(dd) == 1):
+                continue
+            scope = _enclosing_fn(node)
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    for fd in ctx.by_name.get(arg.id, ()):
+                        if scope is None \
+                                or any(a is scope
+                                       for a in ctx.ancestors(fd)):
+                            window_set.add(fd)
+                elif isinstance(arg, ast.Attribute) \
+                        and isinstance(arg.value, ast.Name) \
+                        and arg.value.id == "self":
+                    window_set.update(ctx.by_name.get(arg.attr, ()))
+        changed = True
+        while changed:
+            changed = False
+            for d in list(window_set):
+                for node in ast.walk(d):
+                    callee = None
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) \
+                            and node not in window_set:
+                        window_set.add(node)
+                        changed = True
+                        continue
+                    if isinstance(node, ast.Call):
+                        if isinstance(node.func, ast.Name):
+                            callee = node.func.id
+                        elif isinstance(node.func, ast.Attribute) \
+                                and isinstance(node.func.value, ast.Name) \
+                                and node.func.value.id == "self":
+                            callee = node.func.attr
+                    if callee is not None:
+                        for cd in ctx.by_name.get(callee, ()):
+                            if cd not in window_set:
+                                window_set.add(cd)
+                                changed = True
+        for d in window_set:
+            for node in _walk_own(d):
+                if not isinstance(node, ast.Call):
+                    continue
+                how = None
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "item":
+                    how = ".item()"
+                else:
+                    dd = _dotted(node.func) or ()
+                    if dd[-1:] == ("device_get",):
+                        how = f"{'.'.join(dd)}()"
+                    elif len(dd) >= 2 and dd[0] in ctx.np_aliases \
+                            and dd[-1] in ("asarray", "array"):
+                        how = f"{'.'.join(dd)}()"
+                if how is not None:
+                    emit("per-token-host-sync-in-decode-window", node,
+                         f"`{how}` inside `{d.name}`, reachable from a "
+                         "lax.scan/while_loop body — this materializes "
+                         "on the host once per window iteration, turning "
+                         "the K-step on-device decode window back into "
+                         "per-token round trips; drain committed tokens "
+                         "once per launch, after the loop returns")
+
     # ---- untuned-pallas-launch (ops/pallas only) -------------------------
     # Autotuner contract: every Pallas launch's geometry (block sizes,
     # grid blocking, page-walk width) flows from the tuning-cache lookup

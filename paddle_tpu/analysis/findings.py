@@ -138,6 +138,15 @@ RULES = {
         "here re-serializes host packing with device compute, silently "
         "reverting the engine to its synchronous behavior; move the "
         "materialization to the completion seam")),
+    "per-token-host-sync-in-decode-window": (WARNING, "ast", (
+        "a host materialization (np.asarray()/np.array()/.item()/"
+        "device_get()) reachable from a loop body handed to lax.scan/"
+        "lax.while_loop in an inference-tier file — the decode-window "
+        "contract is one host round trip per LAUNCH of K steps, with "
+        "the drain reading committed tokens after the loop returns; a "
+        "materialization inside the body's call graph forces one sync "
+        "per iteration, quietly turning the K-step on-device window "
+        "back into per-token round trips")),
 }
 
 

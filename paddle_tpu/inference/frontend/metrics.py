@@ -210,6 +210,23 @@ def render_metrics(snapshot: dict, *, engine=None,
              [({"quantile": "0.5"}, _ms(s.get("block_ms_p50"))),
               ({"quantile": "0.99"}, _ms(s.get("block_ms_p99")))])
 
+    # -- device-resident decode window ------------------------------------
+    # how often the host blocked on the device, and how many emitted
+    # tokens each block drained (1.0 per-step; -> K with the window on)
+    d.metric("host_round_trips_total", "counter",
+             "Host<->device completion blocks (one per launch drained).",
+             [(None, s.get("host_round_trips"))])
+    d.metric("tokens_per_launch", "gauge",
+             "Emitted tokens (decode+verify) per host round-trip.",
+             [(None, s.get("tokens_per_launch"))])
+    d.metric("decode_window_k", "gauge",
+             "Largest on-device decode window this engine ran (1 = "
+             "per-step).", [(None, s.get("decode_window_k"))])
+    d.metric("decode_window_fallbacks_total", "counter",
+             "Eligible decode windows that ran per-step because the "
+             "page pool could not pre-reserve K tokens of slack.",
+             [(None, s.get("decode_window_fallbacks"))])
+
     # -- fault tolerance --------------------------------------------------
     d.metric("engine_restarts_total", "counter",
              "Supervised engine rebuilds (crashed or hung steps).",

@@ -312,6 +312,33 @@ class BlockManager:
         self._tokens[seq_id] = max(self._tokens.get(seq_id, 0), int(n_tokens))
         return True
 
+    def reserve_window(self, rows):
+        """All-or-nothing page-slack reservation for a K-step decode window.
+
+        ``rows`` is an iterable of ``(seq_id, n_tokens)`` targets.  Every
+        sequence is grown (``ensure``) to its target; if ANY row cannot be
+        covered, every grow this call performed is rolled back (``truncate``
+        to the recorded prior token count — a no-op truncate drops no pages
+        and does not bump the table version) and ``None`` is returned with
+        the pool exactly as found.  On success returns the list of prior
+        token counts, one per row, in input order: the rollback targets a
+        caller must truncate back to if IT later abandons the window (e.g.
+        a copy-on-write resolution fails mid-reservation).
+        """
+        done = []
+        for seq_id, n_tokens in rows:
+            prior = self._tokens.get(seq_id, 0)
+            try:
+                grown = self.ensure(seq_id, int(n_tokens))
+            except BlockPoolExhausted:
+                grown = False
+            if not grown:
+                for sid, tok in reversed(done):
+                    self.truncate(sid, tok)
+                return None
+            done.append((seq_id, prior))
+        return [tok for _, tok in done]
+
     def cow_if_shared(self, seq_id, pos: int):
         """Call before writing token position ``pos``: when the page
         holding pos is shared (refcount > 1) the writer gets a private

@@ -34,8 +34,8 @@ import jax.numpy as jnp
 
 __all__ = [
     "LogitProcessor", "RepetitionPenaltyProcessor", "TemperatureProcessor",
-    "TopKProcessor", "TopPProcessor", "DEFAULT_CHAIN", "make_samp",
-    "samp_structs", "sample_tokens", "target_dist",
+    "TopKProcessor", "TopPProcessor", "DEFAULT_CHAIN", "advance_keys",
+    "make_samp", "samp_structs", "sample_tokens", "target_dist",
 ]
 
 _NEG_INF = float("-inf")
@@ -139,6 +139,20 @@ def samp_structs(B: int, V: int) -> dict:
         "seen": sds((B, V), jnp.bool_),
         "keys": sds((B, 2), jnp.uint32),
     }
+
+
+def advance_keys(base_keys, offsets):
+    """Scan-carried sampler keys for the device-resident decode window.
+
+    The per-step host path derives each row's key as
+    ``fold_in(PRNGKey(seed), len(generated))`` immediately before launch;
+    inside a multi-step window the host is absent, so the loop carries
+    each row's base key (``PRNGKey(seed)``, [B,2] u32) plus a generated-
+    token counter and re-derives ``fold_in(base, counter)`` per iteration
+    — the identical threefry derivation, so any K-window slicing of the
+    decode stream samples from byte-identical keys.
+    """
+    return jax.vmap(jax.random.fold_in)(base_keys, offsets)
 
 
 def sample_tokens(logits, samp, chain=DEFAULT_CHAIN):

@@ -81,7 +81,8 @@ from ..profiler import RecordEvent, ServingStats
 from .faults import InjectedFault
 from .kv_cache import NULL_BLOCK, BlockManager, BlockPoolExhausted
 from .pressure import STATE_NAMES as _TIER_NAMES
-from .sampling import make_samp, samp_structs, sample_tokens
+from .sampling import (advance_keys, make_samp, samp_structs,
+                       sample_tokens)
 
 __all__ = ["LLMEngine", "Request", "RequestOutput"]
 
@@ -151,6 +152,8 @@ class _StepTicket:
     t_launch: float                   # perf_counter at launch return
     launch_ns: int                    # tracer clock at launch (0 untraced)
     inflight: bool = False            # crossed a step() boundary in flight
+    window: int = 0                   # K of a decode-window launch (0 =
+                                      # per-step; sampled/fin are [K, B])
 
 
 class _DecodeBufs:
@@ -255,6 +258,22 @@ class LLMEngine:
         difference is that a request's outputs surface one ``step()``
         call later and ``run()`` takes one extra draining call.  False
         restores the fully synchronous launch-then-block step.
+    decode_window: K > 1 runs STEADY pure-decode packs as one
+        device-resident K-step window: a single compiled program loops
+        attention -> logit-processor chain -> sampling -> paged K/V
+        append K times on device (sampled tokens, per-row PRNG keys,
+        ``seen`` masks, and kv_lens carried as loop state), and the host
+        drains up to K committed tokens per launch instead of paying a
+        round-trip per token.  Rows hitting eos/length freeze under an
+        active-mask (the loop exits early when every row is done); block
+        tables refresh only at window boundaries, with K tokens of page
+        slack pre-reserved per row before launch — when the pool cannot
+        cover the window the step falls back to the per-step path (never
+        preempting for a window).  Mixed packs (prefill chunks, verify
+        rows) and waiting-queue pressure always take the per-step path,
+        so admission latency is unchanged.  Greedy output is
+        byte-identical to decode_window=1; ``compile_counts`` gains at
+        most one "scan" program kind, only when a window launches.
 
     The engine is SINGLE-THREADED by design: add_request/step/abort must
     all be called from one thread (the frontend's EngineRunner owns that
@@ -273,7 +292,8 @@ class LLMEngine:
                  retain_outputs: bool = True,
                  fault_plan=None, pressure=None,
                  kv_dtype: str = "float32", tp: int = 1,
-                 tracer=None, overlap: bool = True):
+                 tracer=None, overlap: bool = True,
+                 decode_window: int = 1):
         cfg = model.config
         self.config = cfg
         self.params = model.decode_params()
@@ -420,6 +440,16 @@ class LLMEngine:
         self._ragged_progs: dict = {}
         self._cow_prog = None
         self.compile_counts = {"ragged": 0, "cow": 0}
+        # device-resident decode window (K > 1): one extra program kind
+        # ("scan") cached here, NOT in _ragged_progs — the decode/prefill
+        # program-count properties stay exact.  The "scan" key joins
+        # compile_counts only when a window actually compiles, so
+        # decode_window=1 engines keep the historical exact-dict budgets.
+        self.decode_window = int(decode_window)
+        if self.decode_window < 1:
+            raise ValueError(
+                f"decode_window must be >= 1, got {decode_window}")
+        self._window_prog = None
         # padding accounting: real packed tokens vs bucket width, plus
         # what the pre-ragged four-program engine would have padded to
         # (serve_bench --mixed reports the two ratios side by side)
@@ -427,6 +457,7 @@ class LLMEngine:
         self._evictions_seen = 0
         self.peak_resident_seqs = 0
         self.stats = ServingStats()
+        self.stats.set_decode_window(self.decode_window)
         # per-request flight recorder (inference/flight.py): None means
         # every request-lifecycle seam is one attribute check and
         # nothing else — the tracer's zero-cost contract
@@ -876,13 +907,19 @@ class LLMEngine:
         def seqs(n):      # [n] i32 token/pos/index vectors
             return sds((n,), i32)
 
+        # decode-window driver args: the [B]-wide carry seeds plus the
+        # per-row freeze/key inputs (shared tail of both kv dtypes)
+        win_tail = (seqs(B), seqs(B), sds((B,), jnp.bool_), seqs(B),
+                    seqs(B), seqs(B), sds((B, 2), jnp.uint32),
+                    sds((B + 1, self.nblk), i32), samp_structs(B, V))
+
         if self.kv_dtype == "int8":
             # the quantized step threads the scale pools (donated along
             # with the page pools) plus the per-launch fresh-page mask
             ks = sds(self._ks.shape, self._ks.dtype)
             vs = sds(self._vs.shape, self._vs.dtype)
             fresh = sds((self._kc.shape[1],), jnp.bool_)
-            return [
+            out = [
                 ProgramSpec(
                     "serving.ragged_step_q8" + sfx, rag_fn,
                     (params, kc, vc, ks, vs, fresh, seqs(Tq), seqs(B + 1),
@@ -896,7 +933,15 @@ class LLMEngine:
                     donate_argnums=cow_donate, declared_dtype=declared,
                     large_bytes=large_bytes),
             ]
-        return [
+            if self.decode_window > 1:
+                win_fn, win_donate = self._make_window_fn()
+                out.append(ProgramSpec(
+                    "serving.decode_window_q8" + sfx, win_fn,
+                    (params, kc, vc, ks, vs, fresh) + win_tail,
+                    donate_argnums=win_donate, declared_dtype=declared,
+                    large_bytes=large_bytes))
+            return out
+        out = [
             ProgramSpec(
                 "serving.ragged_step" + sfx, rag_fn,
                 (params, kc, vc, seqs(Tq), seqs(B + 1), seqs(B),
@@ -910,6 +955,14 @@ class LLMEngine:
                 donate_argnums=cow_donate, declared_dtype=declared,
                 large_bytes=large_bytes),
         ]
+        if self.decode_window > 1:
+            win_fn, win_donate = self._make_window_fn()
+            out.append(ProgramSpec(
+                "serving.decode_window" + sfx, win_fn,
+                (params, kc, vc) + win_tail,
+                donate_argnums=win_donate, declared_dtype=declared,
+                large_bytes=large_bytes))
+        return out
 
     # ------------------------------------------------------------------
     # scheduler
@@ -1066,17 +1119,24 @@ class LLMEngine:
 
         if chunks or spec or batch:
             t0 = time.perf_counter()
-            with RecordEvent("llm_engine.ragged_step"):
-                sampled, logits, fin, spec_slices, chunk_slots, \
-                    batch_slots = self._run_ragged(chunks, spec, batch)
-            now = time.perf_counter()
-            self._inflight = _StepTicket(
-                chunks=chunks, spec=spec, batch=batch, sampled=sampled,
-                logits=logits, fin=fin, spec_slices=spec_slices,
-                chunk_slots=chunk_slots, batch_slots=batch_slots,
-                dispatch_s=now - t0, t_launch=now,
-                launch_ns=tr.now() if tr is not None else 0,
-                inflight=self.overlap)
+            launched = False
+            if (self.decode_window > 1 and not chunks and not spec
+                    and self._window_eligible(batch)):
+                launched = self._dispatch_window(batch, tr, t0)
+            if not launched:
+                with RecordEvent("llm_engine.ragged_step"):
+                    sampled, logits, fin, spec_slices, chunk_slots, \
+                        batch_slots = self._run_ragged(chunks, spec,
+                                                       batch)
+                now = time.perf_counter()
+                self._inflight = _StepTicket(
+                    chunks=chunks, spec=spec, batch=batch,
+                    sampled=sampled, logits=logits, fin=fin,
+                    spec_slices=spec_slices, chunk_slots=chunk_slots,
+                    batch_slots=batch_slots, dispatch_s=now - t0,
+                    t_launch=now,
+                    launch_ns=tr.now() if tr is not None else 0,
+                    inflight=self.overlap)
         # prestage page credit expires: every reserved page is now
         # either owned by a row this dispatch packed (its ensure() saw
         # the page already in place) or was freed with its retired row
@@ -1120,6 +1180,10 @@ class LLMEngine:
         ok = np.asarray(ticket.fin)
         logits = np.asarray(ticket.logits) if ticket.spec else None
         block_s = time.perf_counter() - t0
+        # ONE host round-trip per completion, whether the launch carried
+        # a single step or a whole K-token decode window — the ratio of
+        # this counter to emitted tokens is the win the window buys
+        self.stats.record_round_trip()
         if tr is not None:
             tr.complete("engine.block_on_result", t,
                         track=self._trace_track)
@@ -1134,8 +1198,19 @@ class LLMEngine:
                             args={"rows": len(ticket.chunks)
                                   + len(ticket.spec)
                                   + len(ticket.batch)})
-        ok = self._inject_nan(ok, ticket.chunk_slots + ticket.batch_slots
-                              + [o for o, _ in ticket.spec_slices])
+        if ticket.window:
+            # window outputs are [K, B]: the NaN seam corrupts one live
+            # row's FIRST iteration (the device kept looping; the drain
+            # quarantines at the poisoned step and drops the rest of
+            # that row's column)
+            ok0 = self._inject_nan(ok[0], list(ticket.batch_slots))
+            if ok0 is not ok[0]:
+                ok = np.array(ok)
+                ok[0] = ok0
+        else:
+            ok = self._inject_nan(ok, ticket.chunk_slots
+                                  + ticket.batch_slots
+                                  + [o for o, _ in ticket.spec_slices])
         chunks, spec, batch = ticket.chunks, ticket.spec, ticket.batch
         chunk_slots = ticket.chunk_slots
         batch_slots = ticket.batch_slots
@@ -1165,9 +1240,13 @@ class LLMEngine:
                                block_s=block_s)
         if tr is not None:
             t = tr.now()
-        self._apply_ragged(chunks, spec, batch, sampled, ok, spec_ok,
-                           spec_logits, chunk_slots, batch_slots, dur,
-                           finished)
+        if ticket.window:
+            self._apply_window(batch, batch_slots, sampled, ok, dur,
+                               finished)
+        else:
+            self._apply_ragged(chunks, spec, batch, sampled, ok, spec_ok,
+                               spec_logits, chunk_slots, batch_slots,
+                               dur, finished)
         if tr is not None:
             tr.complete("engine.sample_commit", t,
                         track=self._trace_track,
@@ -1195,6 +1274,10 @@ class LLMEngine:
         if not self.overlap:
             return
         ticket = self._inflight
+        if ticket.window:
+            return                      # the window advanced K positions;
+                                        # its drain re-schedules from live
+                                        # request state, not a prestage
         if ticket.chunks or ticket.spec or not ticket.batch:
             return                      # only pure-decode launches
         if self._waiting:
@@ -1387,6 +1470,170 @@ class LLMEngine:
         if batch:
             self.stats.record_decode(dur * len(batch) / total,
                                      len(batch), occ)
+
+    # ------------------------------------------------------------------
+    # device-resident decode window (decode_window > 1)
+    # ------------------------------------------------------------------
+
+    def _window_eligible(self, batch: list) -> bool:
+        """True when this step's pack may run as a K-step device window:
+        a STEADY pure-decode state — every runner decode-ready, nobody
+        waiting for a slot (a window would delay their admission by up
+        to K steps), and no row about to carry a verify window.  The
+        caller already established there are no chunk/spec rows this
+        step; the per-step path remains the universal fallback."""
+        if not batch or self._waiting:
+            return False
+        if len(batch) != len(self._running):
+            return False                # a runner is still mid-prefill
+        if self.drafter is not None:
+            for r in batch:
+                if not r.spec_disabled and r.spec_k > 0:
+                    return False        # next rounds pack verify rows
+        return True
+
+    def _reserve_window_pages(self, batch: list):
+        """Pre-reserve each row's K tokens of page slack before the
+        window launches (clamped to the row's remaining generation
+        budget — a row the active-mask will freeze after m < K tokens
+        writes only m positions).  All-or-nothing: a pool that cannot
+        cover the whole window rolls every grow back and returns None —
+        the step falls back to K=1, it NEVER preempts for a window.
+
+        No copy-on-write resolution is needed here: the per-step
+        reservation that already ran this dispatch privatized the page
+        holding the first write position, and every page boundary the
+        window crosses past it lands on a freshly allocated (private)
+        page."""
+        K = self.decode_window
+        rows = []
+        for req in batch:
+            m = min(K, req.max_new_tokens - len(req.generated))
+            rows.append((req.rid, req.cached + m))
+        return self.blocks.reserve_window(rows)
+
+    def _dispatch_window(self, batch: list, tr, t0: float) -> bool:
+        """Reserve, pack, and launch one K-step decode window over
+        ``batch`` (slot-sorted, first-write pages already ensured).
+        Returns True with the window ticket in flight, or False when
+        the pool could not cover the K-token slack (the caller runs the
+        per-step path for this step)."""
+        K = self.decode_window
+        if self._reserve_window_pages(batch) is None:
+            self.stats.record_window_fallback()
+            if tr is not None:
+                tr.instant("engine.window_fallback",
+                           track=self._trace_track,
+                           args={"rows": len(batch), "k": K})
+            return False
+        B = self.max_num_seqs
+        n = len(batch)
+        toks = np.zeros((B,), np.int32)
+        kvl = np.zeros((B,), np.int32)
+        active = np.zeros((B,), bool)
+        gen = np.zeros((B,), np.int32)
+        budgets = np.zeros((B,), np.int32)
+        eos_ids = np.full((B,), -1, np.int32)   # no token id is < 0, so
+        base_keys = np.zeros((B, 2), np.uint32)  # -1 == "no eos" rows
+        bt = np.full((B + 1, self.nblk), NULL_BLOCK, np.int32)
+        samp = make_samp(B, self.config.vocab_size)
+        if tr is not None:
+            t = tr.now()
+        for s, req in enumerate(batch):
+            toks[s] = req.generated[-1]
+            kvl[s] = req.cached + 1
+            active[s] = True
+            gen[s] = len(req.generated)
+            budgets[s] = req.max_new_tokens
+            if req.eos_token_id is not None:
+                eos_ids[s] = int(req.eos_token_id)
+            self._fill_samp(samp, s, req)
+            if req.temperature > 0.0:
+                # the loop body re-derives fold_in(base, generated)
+                # per iteration — the identical threefry derivation
+                # _req_key performs host-side at K=1
+                base_keys[s] = np.asarray(
+                    jax.random.PRNGKey(req.seed), np.uint32)
+        if tr is not None:
+            tr.complete("engine.pack", t, track=self._trace_track,
+                        args={"rows": n, "window": K})
+            t = tr.now()
+        for s, req in enumerate(batch):
+            bt[s] = self.blocks.padded_table(req.rid, self.nblk)
+        if tr is not None:
+            tr.complete("engine.block_table_stage", t,
+                        track=self._trace_track,
+                        args={"rows": n, "window": K})
+        # the window grows tables past anything the per-step buffers
+        # staged; force full restages at the next per-step launch
+        self._break_decode_layout()
+        if tr is not None:
+            t = tr.now()
+        with RecordEvent("llm_engine.window_step"):
+            toks_out, fin_out = self._launch_window(
+                toks, kvl, active, gen, budgets, eos_ids, base_keys,
+                bt, samp)
+        if tr is not None:
+            tr.complete("engine.device_launch", t,
+                        track=self._trace_track,
+                        args={"rows": n, "window": K})
+        now = time.perf_counter()
+        self._inflight = _StepTicket(
+            chunks=[], spec=[], batch=list(batch), sampled=toks_out,
+            logits=None, fin=fin_out, spec_slices=[], chunk_slots=[],
+            batch_slots=list(range(n)), dispatch_s=now - t0,
+            t_launch=now, launch_ns=tr.now() if tr is not None else 0,
+            inflight=self.overlap, window=K)
+        return True
+
+    def _apply_window(self, batch, batch_slots, sampled, ok, dur,
+                      finished):
+        """Drain one completed K-step window: ONE materialized [K, B]
+        token (and finiteness) grid commits as up to K per-token steps
+        per row, in iteration-major order — the exact per-token sequence
+        (cache commit of the previous token, clock advance, append,
+        penalty mask, stream callback, retire check) the per-step path
+        runs, so prefix-cache content, retirement timing, and callbacks
+        are indistinguishable from K=1.  The host replays the device's
+        freeze logic: a row leaves the walk when it retires (eos/length
+        — the same predicates the active-mask evaluated on device) or
+        quarantines on a non-finite iteration; its later columns are the
+        frozen filler values the loop carried and are never committed."""
+        K = int(sampled.shape[0])
+        occ = len(self._running) / self.max_num_seqs
+        alive = {req.rid for req in batch}
+        committed = 0
+        iters = 0
+        for i in range(K):
+            if not alive:
+                break
+            iters += 1
+            for req, s in zip(batch, batch_slots):
+                if req.rid not in alive:
+                    continue
+                if not ok[i, s]:
+                    alive.discard(req.rid)
+                    self._quarantine(req, finished)
+                    continue
+                if self.enable_prefix_caching:
+                    self.blocks.commit_decode_token(req.rid,
+                                                    req.generated[-1])
+                req.cached += 1
+                tok = int(sampled[i, s])
+                req.generated.append(tok)
+                if req.seen is not None:
+                    req.seen[tok] = True
+                committed += 1
+                self._notify_tokens(req, (tok,))
+                self._maybe_retire(req, finished)
+                if req not in self._running:
+                    alive.discard(req.rid)
+        self.pad_stats["real"] += committed
+        self.pad_stats["padded"] += iters * self.max_num_seqs
+        self.pad_stats["legacy_padded"] += iters * self.max_num_seqs
+        if committed:
+            self.stats.record_decode(dur, committed, occ, rounds=iters)
+        self.stats.set_decode_window(K)
 
     def _quarantine(self, req, finished: list) -> None:
         """Retire one sequence whose step logits came back non-finite.
@@ -2108,6 +2355,311 @@ class LLMEngine:
                 lidx, samp)
             logits = None
         return sampled, logits, fin
+
+    def _get_window_prog(self):
+        """The compiled K-step decode window driver (one per engine —
+        its shapes are fixed at [B] rows / K iterations, so unlike the
+        ragged step it never re-specializes).  Compiling it adds exactly
+        one new ``compile_counts`` key, ``"scan"``, and only for engines
+        actually running decode_window > 1."""
+        if self._window_prog is None:
+            run, donate = self._make_window_fn()
+            if jax.default_backend() == "cpu":
+                donate = ()
+            self._window_prog = jax.jit(run, donate_argnums=donate)
+            self.compile_counts["scan"] = \
+                self.compile_counts.get("scan", 0) + 1
+        return self._window_prog
+
+    def _wrap_tp_window(self, run, n_host_args: int):
+        """shard_map for the window driver (identity at tp=1).  Same
+        sharding contract as ``_step_specs``: pools slice along H_kv,
+        host-packed operands replicate, and both non-pool outputs (the
+        [K, B] token and finiteness grids) are replicated after the
+        in-body all-gathers — every shard's while_loop sees identical
+        replicated logits, so the active-mask and the early-exit
+        condition agree across shards by construction."""
+        if self.tp == 1:
+            return run
+        kv = P(None, None, "tp")
+        pools = (kv, kv) if self.kv_dtype == "float32" else (kv,) * 4
+        in_specs = (self._param_specs(), *pools) + (P(),) * n_host_args
+        out_specs = (P(), P()) + pools
+        return shard_map(run, mesh=self._mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+    def _make_window_fn(self):
+        """The device-resident K-step decode window program.
+
+        One launch runs up to K = ``decode_window`` full decode steps
+        without a host round-trip: a ``lax.while_loop`` whose body is
+        EXACTLY the per-step decode program at Tq = B (same layer scan,
+        same paged K/V commit, same ragged attention entry, same
+        LogitProcessor chain) plus the carry bookkeeping the host does
+        between per-step launches — advance kv_lens, re-derive sampler
+        keys as fold_in(base, generated), update the repetition-penalty
+        ``seen`` mask, and freeze rows whose sampled token hits eos or
+        whose generation budget fills (the same predicates
+        ``_maybe_retire`` applies host-side).  Frozen rows redirect to
+        the sentinel block-table row via ``decode_window_segments`` so
+        their writes land in the null page like ragged padding; the
+        loop exits early once every row froze.  The host drains the
+        [K, B] token grid afterwards — logits and tokens never leave
+        the device mid-window, which is the whole point."""
+        nh, kvh, d = self._nh, self._kvh, self._hd
+        bs = self.block_size
+        B = self.max_num_seqs
+        K = self.decode_window
+        eps = self.config.rms_norm_eps
+        theta = self.config.rope_theta
+        dt = self.params["embed"].dtype
+        if self.kv_dtype == "int8":
+            return self._make_window_fn_q8()
+        tp = self.tp
+        nh, kvh = nh // tp, kvh // tp
+        shard_head = self._shard_head
+        use_pallas = _pa.INTERPRET is True or (
+            jax.default_backend() == "tpu"
+            and _pa.ragged_supports(B, nh, kvh, d, bs, B + 1,
+                                    self.nblk, dt))
+
+        def run(params, kc, vc, toks, kvl, active, gen, budgets,
+                eos_ids, base_keys, bt, samp):
+            # toks [B] i32 last committed token per row; kvl [B] i32
+            # valid KV AFTER iteration 0's write; active [B] bool;
+            # gen [B] i32 tokens generated so far (the sampler-key
+            # counter); budgets [B] i32 max_new_tokens; eos_ids [B] i32
+            # (-1: no eos); base_keys [B,2] u32 PRNGKey(seed) per row;
+            # bt [B+1, nblk]; samp the make_samp pytree (its "keys"
+            # field is dead — the body derives keys from base_keys).
+            rows = jnp.arange(B, dtype=jnp.int32)
+
+            def step(carry):
+                (i, tok, kvl, active, gen, seen, kc, vc, touts,
+                 fouts) = carry
+                seg, rel = _pa.decode_window_segments(active, kvl)
+                x = jnp.take(params["embed"], tok, axis=0)    # [B, H]
+
+                def body(x, inp):
+                    p, kcl, vcl = inp
+                    h = _rms_weight(x, p["ln1"], eps)
+                    q = (h @ p["wq"]).reshape(B, nh, d)
+                    k = (h @ p["wk"]).reshape(B, kvh, d)
+                    v = (h @ p["wv"]).reshape(B, kvh, d)
+                    q = _rope_positions(q, rel, theta)
+                    k = _rope_positions(k, rel, theta)
+                    blk = bt[seg, rel // bs]                  # [B]
+                    slot = rel % bs
+                    kcl = kcl.at[blk, :, slot, :].set(k.astype(kcl.dtype))
+                    vcl = vcl.at[blk, :, slot, :].set(v.astype(vcl.dtype))
+                    if use_pallas:
+                        att = _pa.ragged_paged_attention_segrel_packed(
+                            q, kcl, vcl, bt, seg, rel)
+                    else:
+                        att = _pa.ragged_paged_reference_segrel(
+                            q, kcl, vcl, bt, seg, rel)
+                    if tp > 1:
+                        att = lax.all_gather(att, "tp", axis=1,
+                                             tiled=True)
+                    x = x + att.reshape(B, tp * nh * d) @ p["wo"]
+                    h2 = _rms_weight(x, p["ln2"], eps)
+                    a = jax.nn.silu((h2 @ p["gate"]).astype(jnp.float32)
+                                    ).astype(h2.dtype) * (h2 @ p["up"])
+                    return x + a @ p["down"], (kcl, vcl)
+
+                x, (kc, vc) = lax.scan(body, x,
+                                       (params["layers"], kc, vc))
+                h = _rms_weight(x, params["norm_f"], eps)
+                # every row is its own logit row (lidx == identity)
+                logits = (h.astype(jnp.float32)
+                          @ params["head"].astype(jnp.float32))
+                if shard_head:
+                    logits = lax.all_gather(logits, "tp", axis=1,
+                                            tiled=True)
+                keys = advance_keys(base_keys, gen)
+                sampled = sample_tokens(
+                    logits, {"temps": samp["temps"],
+                             "top_k": samp["top_k"],
+                             "top_p": samp["top_p"],
+                             "penalty": samp["penalty"],
+                             "seen": seen, "keys": keys})
+                fin = jnp.all(jnp.isfinite(logits), axis=-1)  # [B]
+                # frozen rows carry their last committed token so the
+                # grid's dead columns hold committed values, never
+                # null-page garbage
+                sampled = jnp.where(active, sampled, tok)
+                touts = touts.at[i].set(sampled)
+                fouts = fouts.at[i].set(fin | ~active)
+                seen = seen.at[rows, sampled].set(
+                    seen[rows, sampled] | active)
+                nxt = active & (sampled != eos_ids) \
+                    & (gen + 1 < budgets)
+                adv = active.astype(jnp.int32)
+                return (i + 1, sampled, kvl + adv, nxt, gen + adv,
+                        seen, kc, vc, touts, fouts)
+
+            def cond(carry):
+                return (carry[0] < K) & jnp.any(carry[3])
+
+            carry = (jnp.int32(0), toks, kvl, active, gen,
+                     samp["seen"], kc, vc,
+                     jnp.zeros((K, B), jnp.int32),
+                     jnp.ones((K, B), jnp.bool_))
+            carry = lax.while_loop(cond, step, carry)
+            return carry[8], carry[9], carry[6], carry[7]
+
+        return self._wrap_tp_window(run, 9), (1, 2)
+
+    def _make_window_fn_q8(self):
+        """Int8-page variant of the decode window: the per-step q8 body
+        verbatim, except the fresh-page scale reset HOISTS out of the
+        loop.  The per-step program zeroes fresh pages' scale rows
+        inside every layer body because each launch consumes one fresh
+        batch; here the whole window's pages are handed out before
+        launch, and an in-body reset would wipe scales grown by earlier
+        window iterations — so the reset runs ONCE, before iteration 0,
+        when every fresh page is still unwritten (byte-equivalent)."""
+        nh, kvh, d = self._nh, self._kvh, self._hd
+        bs = self.block_size
+        B = self.max_num_seqs
+        K = self.decode_window
+        eps = self.config.rms_norm_eps
+        theta = self.config.rope_theta
+        dt = self.params["embed"].dtype
+        tp = self.tp
+        nh, kvh = nh // tp, kvh // tp
+        shard_head = self._shard_head
+        use_pallas = _pa.INTERPRET is True or (
+            jax.default_backend() == "tpu"
+            and _pa.ragged_quant_supports(B, nh, kvh, d, bs, B + 1,
+                                          self.nblk, dt))
+
+        def run(params, kc, vc, ks, vs, fresh, toks, kvl, active, gen,
+                budgets, eos_ids, base_keys, bt, samp):
+            rows = jnp.arange(B, dtype=jnp.int32)
+            ks = jnp.where(fresh[None, :, None], 0.0, ks)
+            vs = jnp.where(fresh[None, :, None], 0.0, vs)
+
+            def step(carry):
+                (i, tok, kvl, active, gen, seen, kc, vc, ks, vs, touts,
+                 fouts) = carry
+                seg, rel = _pa.decode_window_segments(active, kvl)
+                x = jnp.take(params["embed"], tok, axis=0)    # [B, H]
+
+                def body(x, inp):
+                    p, kcl, vcl, ksl, vsl = inp
+                    h = _rms_weight(x, p["ln1"], eps)
+                    q = (h @ p["wq"]).reshape(B, nh, d)
+                    k = (h @ p["wk"]).reshape(B, kvh, d)
+                    v = (h @ p["wv"]).reshape(B, kvh, d)
+                    q = _rope_positions(q, rel, theta)
+                    k = _rope_positions(k, rel, theta)
+                    blk = bt[seg, rel // bs]                  # [B]
+                    slot = rel % bs
+                    kf = k.astype(jnp.float32)
+                    vf = v.astype(jnp.float32)
+                    ks_old = ksl[blk]                         # [B, kvh]
+                    vs_old = vsl[blk]
+                    ksl = ksl.at[blk].max(jnp.max(jnp.abs(kf), axis=-1)
+                                          / 127.0)
+                    vsl = vsl.at[blk].max(jnp.max(jnp.abs(vf), axis=-1)
+                                          / 127.0)
+                    ks_new = ksl[blk]
+                    vs_new = vsl[blk]
+                    rk = jnp.where(ks_new > 0.0,
+                                   ks_old / jnp.maximum(ks_new, 1e-30),
+                                   0.0)
+                    rv = jnp.where(vs_new > 0.0,
+                                   vs_old / jnp.maximum(vs_new, 1e-30),
+                                   0.0)
+                    kp = jnp.round(kcl[blk].astype(jnp.float32)
+                                   * rk[:, :, None, None])
+                    vp = jnp.round(vcl[blk].astype(jnp.float32)
+                                   * rv[:, :, None, None])
+                    kcl = kcl.at[blk].set(
+                        jnp.clip(kp, -127, 127).astype(jnp.int8))
+                    vcl = vcl.at[blk].set(
+                        jnp.clip(vp, -127, 127).astype(jnp.int8))
+                    kq = jnp.round(kf / jnp.maximum(ks_new,
+                                                    1e-30)[:, :, None])
+                    vq = jnp.round(vf / jnp.maximum(vs_new,
+                                                    1e-30)[:, :, None])
+                    kcl = kcl.at[blk, :, slot, :].set(
+                        jnp.clip(kq, -127, 127).astype(jnp.int8))
+                    vcl = vcl.at[blk, :, slot, :].set(
+                        jnp.clip(vq, -127, 127).astype(jnp.int8))
+                    if use_pallas:
+                        att = \
+                            _pa.ragged_paged_attention_quant_segrel_packed(
+                                q, kcl, vcl, ksl, vsl, bt, seg, rel)
+                    else:
+                        att = _pa.ragged_paged_reference_quant_segrel(
+                            q, kcl, vcl, ksl, vsl, bt, seg, rel)
+                    att = att.astype(x.dtype)
+                    if tp > 1:
+                        att = lax.all_gather(att, "tp", axis=1,
+                                             tiled=True)
+                    x = x + att.reshape(B, tp * nh * d) @ p["wo"]
+                    h2 = _rms_weight(x, p["ln2"], eps)
+                    a = jax.nn.silu((h2 @ p["gate"]).astype(jnp.float32)
+                                    ).astype(h2.dtype) * (h2 @ p["up"])
+                    return x + a @ p["down"], (kcl, vcl, ksl, vsl)
+
+                x, (kc, vc, ks, vs) = lax.scan(body, x,
+                                               (params["layers"], kc,
+                                                vc, ks, vs))
+                h = _rms_weight(x, params["norm_f"], eps)
+                logits = (h.astype(jnp.float32)
+                          @ params["head"].astype(jnp.float32))
+                if shard_head:
+                    logits = lax.all_gather(logits, "tp", axis=1,
+                                            tiled=True)
+                keys = advance_keys(base_keys, gen)
+                sampled = sample_tokens(
+                    logits, {"temps": samp["temps"],
+                             "top_k": samp["top_k"],
+                             "top_p": samp["top_p"],
+                             "penalty": samp["penalty"],
+                             "seen": seen, "keys": keys})
+                fin = jnp.all(jnp.isfinite(logits), axis=-1)  # [B]
+                sampled = jnp.where(active, sampled, tok)
+                touts = touts.at[i].set(sampled)
+                fouts = fouts.at[i].set(fin | ~active)
+                seen = seen.at[rows, sampled].set(
+                    seen[rows, sampled] | active)
+                nxt = active & (sampled != eos_ids) \
+                    & (gen + 1 < budgets)
+                adv = active.astype(jnp.int32)
+                return (i + 1, sampled, kvl + adv, nxt, gen + adv,
+                        seen, kc, vc, ks, vs, touts, fouts)
+
+            def cond(carry):
+                return (carry[0] < K) & jnp.any(carry[3])
+
+            carry = (jnp.int32(0), toks, kvl, active, gen,
+                     samp["seen"], kc, vc, ks, vs,
+                     jnp.zeros((K, B), jnp.int32),
+                     jnp.ones((K, B), jnp.bool_))
+            carry = lax.while_loop(cond, step, carry)
+            return (carry[10], carry[11], carry[6], carry[7], carry[8],
+                    carry[9])
+
+        return self._wrap_tp_window(run, 10), (1, 2, 3, 4)
+
+    def _launch_window(self, toks, kvl, active, gen, budgets, eos_ids,
+                       base_keys, bt, samp):
+        prog = self._get_window_prog()
+        if self.kv_dtype == "int8":
+            fresh = self._consume_fresh()
+            touts, fouts, self._kc, self._vc, self._ks, self._vs = \
+                prog(self.params, self._kc, self._vc, self._ks,
+                     self._vs, fresh, toks, kvl, active, gen, budgets,
+                     eos_ids, base_keys, bt, samp)
+        else:
+            touts, fouts, self._kc, self._vc = prog(
+                self.params, self._kc, self._vc, toks, kvl, active,
+                gen, budgets, eos_ids, base_keys, bt, samp)
+        return touts, fouts
 
     def _fill_samp(self, samp, s, req):
         samp["temps"][s] = req.temperature

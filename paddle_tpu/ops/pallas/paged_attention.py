@@ -354,6 +354,27 @@ def ragged_segments(cu_seqlens, kv_lens, n_tokens):
     return seg, rel
 
 
+def decode_window_segments(active, kv_lens):
+    """Per-iteration (seg, rel) for the device-resident decode window.
+
+    One window iteration carries exactly one flat token per batch row
+    (token s belongs to row s), so the ragged searchsorted collapses to
+    an identity map.  Rows frozen by the active-mask (eos/length hit
+    mid-window) are redirected to the sentinel row B — the [B+1]-row
+    block table's null row — so their K/V append and attention reads
+    land in the reserved garbage page, exactly like ragged padding
+    tokens, and never touch a live sequence's pages.
+
+    active [B] bool (row still decoding), kv_lens [B] int32 (valid KV
+    positions AFTER this iteration's insert).  Returns (seg [B], rel [B])
+    int32 for the packed/reference segrel attention entry points.
+    """
+    B = active.shape[0]
+    seg = jnp.where(active, jnp.arange(B, dtype=jnp.int32), jnp.int32(B))
+    rel = jnp.where(active, kv_lens.astype(jnp.int32) - 1, 0)
+    return seg, rel
+
+
 def _ragged_launch(q, key_cache, value_cache, block_tables, seg, rel):
     """The raw ragged launch.  Callers must satisfy the packed-operand
     invariant: int32 scalar operands, table entries in [0, num_blocks),

@@ -227,6 +227,16 @@ class ServingStats:
         self.block_time = 0.0
         self._dispatch_lat = _Reservoir(r, seed=5)
         self._block_lat = _Reservoir(r, seed=6)
+        # device-resident decode-window surface (PR 16): how often the
+        # host actually blocked on the device, and how many tokens each
+        # block drained — the round-trip amortization the K-step window
+        # exists to buy.  decode_window_k is a gauge (the largest window
+        # this engine ran); fallbacks count windows the page pool
+        # couldn't cover that ran per-step instead
+        self.host_round_trips = 0
+        self.decode_rounds = 0           # per-row decode positions advanced
+        self.decode_window_k = 1
+        self.decode_window_fallbacks = 0
         # SLO-observatory surface (PR 13): queue wait (arrival ->
         # admission) joins the lifetime reservoirs, and an OPT-IN
         # windowed layer (profiler/slo.py) rides beside them — None
@@ -273,8 +283,14 @@ class ServingStats:
             w.record_itl(float(duration_s), int(n_seqs))
 
     def record_decode(self, duration_s: float, n_tokens: int,
-                      occupancy: float) -> None:
+                      occupancy: float, rounds: int = 1) -> None:
+        """``rounds`` is how many per-row decode POSITIONS this launch
+        advanced: 1 for a per-step launch (however wide its batch), the
+        iteration count for a K-step window drain.  host_round_trips /
+        decode_rounds is the sync count on one request's critical path
+        — ~1.0 per-step, falling toward 1/K with the window engaged."""
         self.decode_steps += 1
+        self.decode_rounds += int(rounds)
         self.decode_tokens += int(n_tokens)
         self.decode_time += float(duration_s)
         self._token_lat.extend(float(duration_s), int(n_tokens))
@@ -306,6 +322,21 @@ class ServingStats:
         w = self._windows
         if w is not None:
             w.record_step(d)
+
+    def record_round_trip(self, n: int = 1) -> None:
+        """One host<->device completion block: the host materialized a
+        launch's results.  Per-step decode pays one per token; a K-step
+        window pays one per K tokens."""
+        self.host_round_trips += int(n)
+
+    def set_decode_window(self, k: int) -> None:
+        """Largest decode window this engine ran (gauge, monotone)."""
+        self.decode_window_k = max(self.decode_window_k, int(k))
+
+    def record_window_fallback(self, n: int = 1) -> None:
+        """One eligible decode window that fell back to the per-step
+        path because the pool couldn't pre-reserve K tokens of slack."""
+        self.decode_window_fallbacks += int(n)
 
     def record_admission(self, n: int = 1) -> None:
         self.admitted += int(n)
@@ -475,6 +506,14 @@ class ServingStats:
         t = self.decode_time + self.verify_time
         return (self.decode_tokens + self.verify_tokens) / t if t else 0.0
 
+    def tokens_per_launch(self) -> float:
+        """Emitted tokens (decode + verify) per host round-trip — 1.0
+        for the per-step engine, approaching K with the decode window
+        engaged (prefill round-trips emit via TTFT, not here, so a
+        prefill-heavy stream honestly drags this below 1)."""
+        return (self.decode_tokens + self.verify_tokens) \
+            / self.host_round_trips if self.host_round_trips else 0.0
+
     def token_latency_ms(self, q: float) -> float:
         return 1e3 * self._token_lat.percentile(q)
 
@@ -554,6 +593,11 @@ class ServingStats:
             "parked_evictions": self.parked_evictions,
             "tuning_cache_hits": dict(self.tuning_hits),
             "tuning_cache_misses": dict(self.tuning_misses),
+            "host_round_trips": self.host_round_trips,
+            "decode_rounds": self.decode_rounds,
+            "tokens_per_launch": round(self.tokens_per_launch(), 3),
+            "decode_window_k": self.decode_window_k,
+            "decode_window_fallbacks": self.decode_window_fallbacks,
             "engine_steps": self.engine_steps,
             "step_time_s": round(self.step_time, 6),
             "dispatch_time_s": round(self.dispatch_time, 6),
@@ -608,12 +652,12 @@ class ServingStats:
     #             worst/oldest member
     #   _MEAN     unweighted mean across replicas (occupancy/queue depth
     #             are already per-engine means)
-    _RATE = ("prefix_hit_rate", "accept_rate")
+    _RATE = ("prefix_hit_rate", "accept_rate", "tokens_per_launch")
     _THROUGH = ("decode_tokens_per_s", "prefill_tokens_per_s",
                 "verify_tokens_per_s", "emitted_tokens_per_s")
     _MAX = ("p50_token_ms", "p99_token_ms", "itl_p50_ms", "itl_p99_ms",
             "ttft_p50_ms", "ttft_p99_ms", "max_prefill_queue_depth",
-            "uptime_seconds", "degradation_state",
+            "uptime_seconds", "degradation_state", "decode_window_k",
             "dispatch_ms_p50", "dispatch_ms_p99",
             "block_ms_p50", "block_ms_p99",
             "queue_wait_p50_ms", "queue_wait_p99_ms")
@@ -666,6 +710,10 @@ class ServingStats:
         out["accept_rate"] = round(
             out["draft_accepted"] / out["draft_proposed"], 4) \
             if out["draft_proposed"] else 0.0
+        trips = out.get("host_round_trips", 0)
+        out["tokens_per_launch"] = round(
+            (out["decode_tokens"] + out["verify_tokens"]) / trips, 3) \
+            if trips else 0.0
         if all("_samples" in s for s in snaps):
             # honest fleet quantiles: pool every replica's reservoir
             # sample and recompute, replacing the max-of-quantiles

@@ -1,0 +1,27 @@
+"""Seeds per-token-host-sync-in-decode-window: a self-method callee of
+the K-step decode-window loop body materializes tokens on the host with
+np.asarray, forcing one device->host sync per window ITERATION.  The
+launch-level drain twin (one sync per window, after the loop returns)
+stays silent, and so does numpy-in-jit — the compiled fixpoint never
+follows the self-method call that hides the hazard."""
+import numpy as np
+from jax import lax
+
+
+class DecodeEngine:
+    def drive_window(self, carry):
+        def cond(c):
+            return c[0] < self.window_k
+
+        def step(c):
+            i, toks = c
+            return i + 1, self._commit(toks)
+
+        return lax.while_loop(cond, step, carry)
+
+    def _commit(self, toks):
+        self.host_tok = np.asarray(toks)      # fires: per-iteration sync
+        return toks
+
+    def drain_window(self, toks):
+        return np.asarray(toks)               # silent: once per launch

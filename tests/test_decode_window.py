@@ -1,0 +1,214 @@
+"""Device-resident K-step decode window (CPU, paged kernel in interpret
+mode): greedy byte-identity against the synchronous per-step engine
+across dtype/sharding variants, the pinned compile budget (+1 program
+kind for the window driver, nothing else), and the scheduling seams —
+mid-window eos retirement, page-slack exhaustion falling back to K=1,
+and mid-window abort dropping every uncommitted window token."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.inference import LLMEngine
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+VOCAB = 97
+CFG = LlamaConfig.tiny(vocab=VOCAB, hidden=32, layers=2, heads=4, ffn=64,
+                       seq=64)
+
+_VARIANTS = {"f32": {}, "int8": {"kv_dtype": "int8"}, "tp2": {"tp": 2}}
+
+
+@pytest.fixture(scope="module")
+def model():
+    return LlamaForCausalLM(CFG)
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_num_seqs", 8)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_model_len", 64)
+    kw.setdefault("max_prefill_tokens", 256)
+    kw.setdefault("prefill_token_bucket", 64)
+    return LLMEngine(model, **kw)
+
+
+def _oracle(model, prompt, max_new, temperature=0.0, seed=0, eos=None):
+    out = model.generate(jnp.asarray([prompt], jnp.int32),
+                         max_new_tokens=max_new, temperature=temperature,
+                         seed=seed, eos_token_id=eos)
+    return np.asarray(out._data)[0, len(prompt):].tolist()
+
+
+def _audit_stream(n=16):
+    """The 16-request ragged stream the audit tests pin budgets on."""
+    rng = np.random.RandomState(7)
+    shapes = [(4, 8), (9, 8), (13, 6)]
+    return [(rng.randint(0, VOCAB, shapes[i % 3][0]).tolist(),
+             shapes[i % 3][1]) for i in range(n)]
+
+
+def _drive(eng, reqs, **req_kw):
+    rids = [eng.add_request(p, max_new_tokens=mx, **req_kw)
+            for p, mx in reqs]
+    outs = eng.run()
+    return [outs[r] for r in rids]
+
+
+@pytest.fixture(scope="module")
+def sync_ref(model):
+    """Per-variant synchronous (overlap=False, K=1) reference over the
+    audit stream, computed once and shared across the K matrix."""
+    cache = {}
+
+    def get(variant):
+        if variant not in cache:
+            eng = _engine(model, overlap=False, **_VARIANTS[variant])
+            cache[variant] = (eng, _drive(eng, _audit_stream()))
+        return cache[variant]
+
+    return get
+
+
+# ---------------------------------------------------------------------------
+# byte-identity matrix: greedy K in {2,4} x {f32, int8, tp2} vs K=1 sync
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("variant", ["f32", "int8", "tp2"])
+@pytest.mark.parametrize("k", [2, 4])
+def test_window_greedy_byte_identical_to_sync(model, sync_ref, variant, k):
+    sync_eng, sync_out = sync_ref(variant)
+    eng = _engine(model, decode_window=k, **_VARIANTS[variant])
+    win_out = _drive(eng, _audit_stream())
+    for s, w in zip(sync_out, win_out):
+        assert w.generated == s.generated
+        assert w.finish_reason == s.finish_reason
+    # compile budget: the window adds exactly ONE new program kind (the
+    # scan driver), and the ragged/cow budgets match the sync engine's
+    counts = dict(eng.compile_counts)
+    assert counts.pop("scan", 0) == 1, eng.compile_counts
+    assert counts == dict(sync_eng.compile_counts)
+    # the whole point: strictly fewer blocking host round trips for the
+    # identical token stream
+    assert eng.stats.host_round_trips < sync_eng.stats.host_round_trips
+    assert eng.stats.decode_window_k == k
+    # pool clean after the stream
+    assert eng.blocks.num_used == 0
+    eng.blocks.check_invariants()
+
+
+def test_k1_engine_compiles_no_window_program(model, sync_ref):
+    """decode_window=1 engines keep the exact pre-window program set —
+    the "scan" kind never appears in their compile counts."""
+    sync_eng, _ = sync_ref("f32")
+    assert set(sync_eng.compile_counts) == {"ragged", "cow"}
+    assert sync_eng.stats.decode_window_k == 1
+
+
+def test_window_sampled_rows_reproduce_per_step_stream(model):
+    """Temperature rows ride the window too: on-device fold_in key
+    derivation reproduces the host per-step key schedule exactly."""
+    reqs = _audit_stream(6)
+    sync = _engine(model, overlap=False)
+    s_out = _drive(sync, reqs, temperature=0.8, seed=3)
+    eng = _engine(model, decode_window=4)
+    w_out = _drive(eng, reqs, temperature=0.8, seed=3)
+    assert [o.generated for o in w_out] == [o.generated for o in s_out]
+
+
+# ---------------------------------------------------------------------------
+# scheduling seams
+# ---------------------------------------------------------------------------
+
+def test_window_eos_retirement_mid_window(model):
+    """A row hitting eos inside a K=4 window freezes at the eos token
+    (no post-eos commits) while its batchmates decode on, all
+    byte-identical to the per-row oracle."""
+    rng = np.random.RandomState(3)
+    vic = rng.randint(0, VOCAB, 6).tolist()
+    base = _oracle(model, vic, 12)
+    eos = base[4]                      # forces retirement mid-window
+    mates = [rng.randint(0, VOCAB, n).tolist() for n in (5, 9)]
+    eng = _engine(model, decode_window=4)
+    rid_v = eng.add_request(vic, max_new_tokens=12, eos_token_id=eos)
+    rid_m = [eng.add_request(p, max_new_tokens=12) for p in mates]
+    outs = eng.run()
+    got = outs[rid_v].generated
+    assert outs[rid_v].finish_reason == "eos"
+    assert got[-1] == eos and eos not in got[:-1]
+    assert got == base[:got.index(eos) + 1]
+    for rid, p in zip(rid_m, mates):
+        assert outs[rid].generated == _oracle(model, p, 12)
+    assert eng.blocks.num_used == 0
+    eng.blocks.check_invariants()
+
+
+def test_window_pool_exhaustion_falls_back_per_step(model):
+    """When the pool can't cover K tokens of page slack per row, the
+    scheduler launches the plain per-step path for that round instead
+    (counted), and outputs stay byte-identical even when the squeeze
+    also forces a preemption."""
+    kw = dict(num_blocks=13, max_num_seqs=4, max_prefill_tokens=128,
+              prefill_token_bucket=32)
+    rng = np.random.RandomState(1)
+    reqs = [(rng.randint(0, VOCAB, int(rng.randint(4, 12))).tolist(), 20)
+            for _ in range(4)]
+    sync = _engine(model, overlap=False, **kw)
+    s_out = _drive(sync, reqs)
+    eng = _engine(model, decode_window=4, **kw)
+    w_out = _drive(eng, reqs)
+    assert [o.generated for o in w_out] == [o.generated for o in s_out]
+    assert eng.stats.decode_window_fallbacks > 0
+    assert eng.stats.snapshot()["decode_window_fallbacks"] > 0
+    assert eng.blocks.num_used == 0
+    eng.blocks.check_invariants()
+
+
+def test_abort_mid_window_drops_uncommitted_tokens(model):
+    """abort() against a row inside an in-flight K-window reports
+    exactly the tokens observable at the last completed step — every
+    uncommitted window token is dropped — and the survivors finish
+    byte-identical to the sync reference with a clean pool."""
+    reqs = _audit_stream(4)
+    sync = _engine(model, overlap=False)
+    s_out = _drive(sync, reqs)
+
+    eng = _engine(model, decode_window=4)
+    assert eng.overlap                 # the seam needs an in-flight ticket
+    rids = [eng.add_request(p, max_new_tokens=mx) for p, mx in reqs]
+    outs = {}
+    for _ in range(3):                 # prefill + first windows in flight
+        for fo in eng.step():
+            outs[fo.rid] = fo
+    victim = next(r for r in eng._running if r.rid == rids[0])
+    observed = list(victim.generated)  # tokens through completed steps
+    aborted = eng.abort(rids[0])
+    assert aborted is not None and aborted.finish_reason == "aborted"
+    assert list(aborted.generated) == observed
+    while eng.has_unfinished():
+        for fo in eng.step():
+            outs[fo.rid] = fo
+    for rid, ref in list(zip(rids, s_out))[1:]:
+        assert outs[rid].generated == ref.generated
+        assert outs[rid].finish_reason == ref.finish_reason
+    assert eng.blocks.num_used == 0
+    eng.blocks.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# stats surface
+# ---------------------------------------------------------------------------
+
+def test_window_stats_round_trip_accounting(model):
+    """host_round_trips counts completions, decode_rounds counts per-row
+    decode positions: per-step engines sit at ~1 trip per round, the
+    K-window at ~1/K — the hardware-independent win the bench gates."""
+    reqs = _audit_stream(8)
+    eng = _engine(model, decode_window=4)
+    _drive(eng, reqs)
+    s = eng.stats.snapshot()
+    assert s["host_round_trips"] > 0
+    assert s["decode_rounds"] > 0
+    assert s["host_round_trips"] < s["decode_rounds"]
+    assert s["tokens_per_launch"] > 1.0
+    assert s["decode_window_k"] == 4

@@ -54,6 +54,9 @@ def _lint_fix(name):
      "wallclock-in-timing-path", 8, "measure_step", WARNING),
     (os.path.join("inference", "fix_host_sync_dispatch.py"),
      "host-sync-in-dispatch-path", 12, "dispatch_step", WARNING),
+    (os.path.join("inference", "fix_host_sync_window.py"),
+     "per-token-host-sync-in-decode-window", 23,
+     "DecodeEngine._commit", WARNING),
     (os.path.join("inference", "fix_unbounded_buffer.py"),
      "unbounded-observability-buffer", 14, "StepStatsLog.record", WARNING),
     (os.path.join("pallas", "fix_untuned_launch.py"),
@@ -266,6 +269,7 @@ def test_every_catalog_rule_is_exercised():
         "quantized-kv-float32-page", "swallowed-exception",
         "collective-outside-shard-map", "untuned-pallas-launch",
         "wallclock-in-timing-path", "host-sync-in-dispatch-path",
+        "per-token-host-sync-in-decode-window",
         "unbounded-observability-buffer",
         "undonated-buffer", "host-callback", "dtype-promotion",
         "dead-code", "dead-input", "passthrough-output",

@@ -44,6 +44,7 @@ _SERVE_WATCH = (
     ("ttft_p99_ms", False),
     ("itl_p99_ms", False),
     ("p99_token_ms", False),
+    ("decode_window_host_round_trips_per_token", False),
 )
 _TRAIN_WATCH = (("tokens_per_sec", True),)
 

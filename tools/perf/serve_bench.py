@@ -53,6 +53,20 @@ dispatch/block attribution and host-bubble fraction:
    ..., "overlap_on_host_bubble_frac": ..., "overlap_off_wall_s": ...,
    ...}
 
+With ``--decode-window K`` one steady pure-decode workload runs twice —
+per-step engine (decode_window=1) then the device-resident K-step
+window engine — same prompts, greedy, so the outputs must match
+byte-for-byte.  The headline value is the window arm's decode tok/s;
+the hardware-independent win is the round-trip count (every mode's
+record carries the same three keys at its own engine's values):
+
+  {"metric": "serve_window_tokens_per_s", "value": ..., "unit": "tok/s",
+   "outputs_match": true, "decode_window_k": K,
+   "decode_window_tokens_per_s": ...,
+   "decode_window_host_round_trips_per_token": ...,  # ~1.0 -> ~1/K
+   "baseline_host_round_trips_per_token": ...,
+   "tokens_per_launch": ..., "decode_window_fallbacks": ..., ...}
+
 With ``--http`` the SAME ragged workload runs twice over the real HTTP
 frontend (paddle_tpu.inference.frontend) on localhost — concurrent
 streaming clients, SSE parsing, client-side TTFT/ITL — next to an
@@ -228,6 +242,24 @@ def _slo_keys(snap):
     }
 
 
+def _window_keys(snap):
+    """Device-resident decode-window surface every decode-bearing mode
+    reports: the largest on-device window the engine ran, its decode
+    throughput, and host round-trips per PER-ROW decode position — the
+    sync count on one request's critical path, ~1.0 for the per-step
+    engine regardless of batch width, falling toward 1/K with a K-step
+    window engaged."""
+    rounds = snap.get("decode_rounds", 0)
+    trips = snap.get("host_round_trips", 0)
+    return {
+        "decode_window_k": snap.get("decode_window_k", 1),
+        "decode_window_tokens_per_s": snap.get("decode_tokens_per_s",
+                                               0.0),
+        "decode_window_host_round_trips_per_token":
+            round(trips / rounds, 4) if rounds else 0.0,
+    }
+
+
 def run_prefix_bench(smoke: bool, n_requests: int, share_ways: int,
                      seed: int, backend: str, kv_dtype: str = "float32",
                      tp: int = 1):
@@ -303,6 +335,7 @@ def run_prefix_bench(smoke: bool, n_requests: int, share_ways: int,
         "preempted": on["preemptions"],
         **_mem_keys(engine),
         **_slo_keys(engine.stats.snapshot()),
+        **_window_keys(engine.stats.snapshot()),
     }
 
 
@@ -420,6 +453,7 @@ def run_spec_bench(smoke: bool, n_requests: int, spec_k: int, seed: int,
         "preempted": on["preemptions"],
         **_mem_keys(engine),
         **_slo_keys(engine.stats.snapshot()),
+        **_window_keys(engine.stats.snapshot()),
     }
 
 
@@ -587,6 +621,7 @@ def run_http_bench(smoke: bool, n_requests: int, seed: int, backend: str,
         "finish_reasons": sorted({r["finish"] for r in results if r}),
         **_mem_keys(served),
         **_slo_keys(served.stats.snapshot()),
+        **_window_keys(served.stats.snapshot()),
     }
 
 
@@ -684,6 +719,7 @@ def run_slo_bench(smoke: bool, n_requests: int, seed: int, backend: str,
         "anomalies_captured": slo.get("anomalies_captured", 0),
         "anomaly_spool_dropped": slo.get("anomaly_spool_dropped", 0),
         **_mem_keys(engine),
+        **_window_keys(engine.stats.snapshot()),
     }
 
 
@@ -815,6 +851,7 @@ def run_router_bench(smoke: bool, n_requests: int, share_ways: int,
         "kv_dtype": kv_dtype,
         # the loop ends on the affinity pass: its fleet-pooled snapshot
         **_slo_keys(runner_snap),
+        **_window_keys(runner_snap),
     }
 
 
@@ -1000,6 +1037,7 @@ def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str,
         **ab_keys,
         **_mem_keys(engine),
         **_slo_keys(engine.stats.snapshot()),
+        **_window_keys(engine.stats.snapshot()),
     }
 
 
@@ -1100,6 +1138,7 @@ def run_chaos_bench(smoke: bool, n_requests: int, seed: int, backend: str,
         "step_deadline_s": step_deadline_s,
         **_mem_keys(fin),
         **_slo_keys(snap),
+        **_window_keys(snap),
     }
 
 
@@ -1221,6 +1260,97 @@ def run_pressure_bench(smoke: bool, n_requests: int, seed: int,
         "retired": q["retired"],
         "baseline_retired": base["retired"],
         **_slo_keys(engine.stats.snapshot()),
+        **_window_keys(engine.stats.snapshot()),
+    }
+
+
+def run_window_bench(smoke: bool, n_requests: int, window_k: int,
+                     seed: int, backend: str, kv_dtype: str = "float32",
+                     tp: int = 1):
+    """--decode-window K: one steady pure-decode workload, A/B'd between
+    the per-step engine (decode_window=1) and the device-resident
+    K-step window engine — same prompts, same budgets, greedy, so the
+    outputs must match byte-for-byte and the only difference is how
+    often the host blocked on the device.  The headline value is the
+    window arm's decode tok/s, but on CPU hosts the honest win is
+    ``decode_window_host_round_trips_per_token`` (~1.0 per-step,
+    -> ~1/K windowed): round-trip COUNT is hardware-independent, the
+    latency each trip costs is not."""
+    import time
+
+    import numpy as np
+
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if smoke or backend == "cpu":
+        cfg = LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                               ffn=128, seq=128)
+        engine_kw = dict(max_num_seqs=4, block_size=8, max_model_len=128,
+                         max_prefill_tokens=256, prefill_token_bucket=64)
+        max_new = 48
+    else:
+        cfg = LlamaConfig(vocab_size=8192, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=4,
+                          num_attention_heads=8, num_key_value_heads=8,
+                          max_position_embeddings=1024)
+        engine_kw = dict(max_num_seqs=16, block_size=16, max_model_len=1024,
+                         max_prefill_tokens=2048, prefill_token_bucket=256)
+        max_new = 128
+    # every request admitted up front, at most one per slot: after the
+    # shared prefill the whole stream is the steady pure-decode state
+    # the window targets, so windows (not the fallback) carry the run
+    n_rows = max(1, min(n_requests, engine_kw["max_num_seqs"]))
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, cfg.vocab_size,
+                           int(rng.randint(8, 17))).tolist()
+               for _ in range(n_rows)]
+
+    def arm(k):
+        eng = LLMEngine(model, kv_dtype=kv_dtype, tp=tp,
+                        decode_window=k, **engine_kw)
+        eng.stats.enable_windows()
+        eng.add_request(prompts[0][:4], max_new_tokens=max(4, 2 * k))
+        eng.run()                      # compile outside the timed pass
+        eng.stats.reset()
+        rids = [eng.add_request(p, max_new_tokens=max_new)
+                for p in prompts]
+        outs = {}
+        t0 = time.perf_counter()
+        while eng.has_unfinished():
+            for fo in eng.step():
+                outs[fo.rid] = list(fo.generated)
+        wall = time.perf_counter() - t0
+        return eng, [outs[r] for r in rids], wall
+
+    base_eng, base_out, base_wall = arm(1)
+    win_eng, win_out, win_wall = arm(window_k)
+    b = base_eng.stats.summary()
+    w = win_eng.stats.summary()
+    return {
+        "metric": "serve_window_tokens_per_s",
+        "value": w["decode_tokens_per_s"],
+        "unit": "tok/s",
+        "backend": backend,
+        "requests": n_rows,
+        "max_new_tokens": max_new,
+        "outputs_match": base_out == win_out,
+        "window_wall_s": round(win_wall, 4),
+        "baseline_wall_s": round(base_wall, 4),
+        "baseline_tokens_per_s": b["decode_tokens_per_s"],
+        "baseline_host_round_trips": b["host_round_trips"],
+        "baseline_host_round_trips_per_token":
+            _window_keys(b)["decode_window_host_round_trips_per_token"],
+        "host_round_trips": w["host_round_trips"],
+        "tokens_per_launch": w["tokens_per_launch"],
+        "decode_window_fallbacks": w["decode_window_fallbacks"],
+        "window_compiles": win_eng.compile_counts.get("scan", 0),
+        "p50_token_ms": w["p50_token_ms"],
+        "p99_token_ms": w["p99_token_ms"],
+        **_window_keys(w),
+        **_mem_keys(win_eng),
+        **_slo_keys(win_eng.stats.snapshot()),
     }
 
 
@@ -1282,6 +1412,7 @@ def run_bench(smoke: bool, n_requests: int, seed: int, backend: str,
         "decode_tokens": s["decode_tokens"],
         **_mem_keys(engine),
         **_slo_keys(engine.stats.snapshot()),
+        **_window_keys(engine.stats.snapshot()),
     }
 
 
@@ -1340,6 +1471,14 @@ def main(argv=None):
                          "behind the prefix-affinity router, A/B'd "
                          "against random routing on the shared-prefix "
                          "workload")
+    ap.add_argument("--decode-window", type=int, default=None,
+                    metavar="K",
+                    help="A/B the device-resident K-step decode window "
+                         "engine against the per-step one on a steady "
+                         "pure-decode stream; the record carries "
+                         "decode_window_{k,tokens_per_s,"
+                         "host_round_trips_per_token} and the "
+                         "byte-identity verdict")
     ap.add_argument("--overlap", choices=("on", "off"), default="on",
                     help="with --mixed: which async-pipeline arm is the "
                          "headline (and --trace'd) one; BOTH arms always "
@@ -1366,6 +1505,11 @@ def main(argv=None):
         n_requests = args.requests or (16 if (args.smoke
                                               or backend == "cpu") else 64)
         record = {"metric": "serve_router_tokens_per_s", "value": 0.0,
+                  "unit": "tok/s", "backend": backend}
+    elif args.decode_window:
+        n_requests = args.requests or (4 if (args.smoke
+                                             or backend == "cpu") else 16)
+        record = {"metric": "serve_window_tokens_per_s", "value": 0.0,
                   "unit": "tok/s", "backend": backend}
     elif args.memory_pressure:
         n_requests = args.requests or 16
@@ -1423,6 +1567,11 @@ def main(argv=None):
                                            args.prefix_share or 4,
                                            args.seed, backend,
                                            args.kv_dtype, args.replicas,
+                                           args.tp))
+        elif args.decode_window:
+            record.update(run_window_bench(args.smoke, n_requests,
+                                           args.decode_window, args.seed,
+                                           backend, args.kv_dtype,
                                            args.tp))
         elif args.memory_pressure:
             record.update(run_pressure_bench(args.smoke, n_requests,
