@@ -93,6 +93,82 @@ def _kv_dtype_test(test) -> bool:
                  for n in ast.walk(test))
     return has_kv and has_i8
 
+def _weight_dtype_test(test) -> bool:
+    """An `if` test comparing a weight_dtype-ish name to "float32"."""
+    has_w = any(
+        (isinstance(n, ast.Name) and "weight_dtype" in n.id)
+        or (isinstance(n, ast.Attribute) and "weight_dtype" in n.attr)
+        for n in ast.walk(test))
+    has_f32 = any(isinstance(n, ast.Constant) and n.value == "float32"
+                  for n in ast.walk(test))
+    return has_w and has_f32
+
+
+# WEIGHT-POOL entry names (the llama decode_params vocabulary); the
+# quantized pools (name_q) and their scales (name_s) deliberately don't
+# match — contracting against those is exactly what the helper does
+_WEIGHT_NAMES = {"wq", "wk", "wv", "wo", "gate", "up", "down",
+                 "embed", "head", "lm_head"}
+_WEIGHT_RE = re.compile(
+    r"(^|_)(wq|wk|wv|wo|gate|up|down|embed|head|weights?)$",
+    re.IGNORECASE)
+_MATMUL_FNS = {"matmul", "dot", "einsum", "dot_general"}
+
+
+def _weight_operand(node) -> str | None:
+    """'wq' for p["wq"] / params.wq / a bare weight-like Name; None for
+    anything else (including name_q/name_s quantized-pool entries)."""
+    if isinstance(node, ast.Subscript):
+        sl = node.slice
+        if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+            name = sl.value
+            if name.endswith(("_q", "_s")):
+                return None
+            if name in _WEIGHT_NAMES or _WEIGHT_RE.search(name):
+                return name
+        return None
+    name = None
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    if name and not name.endswith(("_q", "_s")) \
+            and (name in _WEIGHT_NAMES or _WEIGHT_RE.search(name)):
+        return name
+    return None
+
+
+def _weight_matmul(node) -> str | None:
+    """The weight name when `node` is a dense contraction against a
+    weight-pool entry: `x @ p["wq"]`, jnp.matmul/dot/einsum(...), or
+    lax.dot_general(...)."""
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.MatMult):
+        for side in (node.left, node.right):
+            w = _weight_operand(side)
+            if w:
+                return w
+        # `h @ p["wq"].astype(...)` — unwrap one call layer per side
+        for side in (node.left, node.right):
+            if isinstance(side, ast.Call) and side.args:
+                w = _weight_operand(side.args[0])
+                if w:
+                    return w
+            if isinstance(side, ast.Call) \
+                    and isinstance(side.func, ast.Attribute):
+                w = _weight_operand(side.func.value)
+                if w:
+                    return w
+        return None
+    if isinstance(node, ast.Call):
+        dd = _dotted(node.func) or ()
+        if dd and dd[-1] in _MATMUL_FNS:
+            for arg in node.args:
+                w = _weight_operand(arg)
+                if w:
+                    return w
+    return None
+
+
 _DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([\w\-, ]+)")
 _DISABLE_NEXT_RE = re.compile(r"#\s*graftlint:\s*disable-next=([\w\-, ]+)")
 _SKIP_RE = re.compile(r"#\s*graftlint:\s*skip-file")
@@ -475,6 +551,34 @@ def lint_source(text: str, path: str = "<string>") -> list:
                              "— quantized engines store int8 pages with "
                              "f32 scale rows; a float32 page pool "
                              "silently forfeits the HBM win",
+                             severity=WARNING)
+
+        # ---- f32-weight-matmul-in-quantized-engine (serving tier only) ---
+        # In the branch an engine takes when configured with a quantized
+        # weight_dtype, every projection/MLP/head contraction must route
+        # through the fused dequant-matmul helper over the int8/int4
+        # pools (name_q + name_s scale rows).  A dense matmul against a
+        # raw weight-pool entry there either KeyErrors on the quantized
+        # pool or silently streams f32 weights — forfeiting the whole
+        # 4x/8x weight-byte win the format exists for.
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.If)
+                    and _weight_dtype_test(node.test)):
+                continue
+            quant = node.body
+            if isinstance(node.test, ast.Compare) and node.test.ops \
+                    and isinstance(node.test.ops[0], ast.Eq):
+                quant = node.orelse
+            for stmt in quant:
+                for n in ast.walk(stmt):
+                    w = _weight_matmul(n)
+                    if w:
+                        emit("f32-weight-matmul-in-quantized-engine", n,
+                             f"dense matmul against weight `{w}` in the "
+                             "quantized (weight_dtype != \"float32\") "
+                             "branch — route the contraction through the "
+                             "fused dequant-matmul helper over the "
+                             f"`{w}_q`/`{w}_s` pools instead",
                              severity=WARNING)
 
         # ---- swallowed-exception (serving tier only) ---------------------

@@ -96,6 +96,15 @@ RULES = {
         "store int8 pages (with f32 scale rows in a parallel pool); a "
         "float32 page pool silently forfeits the ~4x HBM headroom the "
         "format exists for")),
+    "f32-weight-matmul-in-quantized-engine": (WARNING, "ast", (
+        "a dense matmul against a raw weight-pool entry (h @ p[\"wq\"], "
+        "jnp.einsum with params[...]) inside an inference-tier "
+        "weight_dtype != \"float32\" branch — quantized engines hold "
+        "int8/int4 pools (name_q) with scale rows (name_s) and route "
+        "every projection/MLP/head contraction through the fused "
+        "dequant-matmul helper; a dense matmul there either KeyErrors "
+        "on the quantized pool or silently streams f32 weights, "
+        "forfeiting the 4x/8x weight-byte win")),
     "swallowed-exception": (ERROR, "ast", (
         "a bare/broad `except` that only passes (or logs and continues) "
         "inside an inference-tier step/release/abort/recover path — the "

@@ -69,8 +69,8 @@ def _build_engine(args):
             max_prefill_tokens=args.max_prefill_tokens,
             enable_prefix_caching=not args.no_prefix_caching,
             drafter=drafter, spec_k=args.spec_k,
-            kv_dtype=args.kv_dtype, tp=args.tp,
-            retain_outputs=False)
+            kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype,
+            tp=args.tp, retain_outputs=False)
 
     return make_engine
 
@@ -95,6 +95,11 @@ def main(argv=None) -> int:
                     help="KV page storage dtype; int8 quarters the page "
                          "pool's HBM cost (per-page scales, in-kernel "
                          "dequant) for 2x+ resident sequences")
+    ap.add_argument("--weight-dtype", default="float32",
+                    choices=["float32", "int8", "int4"],
+                    help="weight pool storage dtype; int8/int4 cut "
+                         "resident weight bytes 4x/8x (per-channel "
+                         "scales, fused dequant-matmul kernel)")
     ap.add_argument("--spec-k", type=int, default=0,
                     help="speculative draft length (0 disables; >0 enables "
                          "the n-gram drafter)")

@@ -226,6 +226,24 @@ def render_metrics(snapshot: dict, *, engine=None,
              "Eligible decode windows that ran per-step because the "
              "page pool could not pre-reserve K tokens of slack.",
              [(None, s.get("decode_window_fallbacks"))])
+    d.metric("decode_window_shrinks_total", "counter",
+             "Eligible decode windows that ran device-resident at a "
+             "shrunk K' < K (largest slack the page pool covered).",
+             [(None, s.get("decode_window_shrinks"))])
+
+    # -- weight residency --------------------------------------------------
+    # quantized weight pools shrink resident weight bytes 4x/8x vs f32;
+    # the gauge sits next to kv_bytes_resident so HBM budgeting reads
+    # both halves of the residency story from one scrape
+    d.metric("weight_bytes_resident", "gauge",
+             "Bytes of model weights resident on device (pools + "
+             "scales), labeled by storage dtype.",
+             [({"dtype": s.get("weight_dtype") or "float32"},
+               s.get("weight_bytes_resident"))])
+    d.metric("weight_bytes_resident_per_shard", "gauge",
+             "Largest single shard's resident weight bytes (equals "
+             "the total at tp=1).",
+             [(None, s.get("weight_bytes_resident_per_shard"))])
 
     # -- fault tolerance --------------------------------------------------
     d.metric("engine_restarts_total", "counter",

@@ -77,6 +77,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core.jaxcompat import shard_map
 from ..models.llama import _rms_weight, _rope_positions
 from ..ops.pallas import paged_attention as _pa
+from ..ops.pallas import quant_matmul as _qm
 from ..profiler import RecordEvent, ServingStats
 from .faults import InjectedFault
 from .kv_cache import NULL_BLOCK, BlockManager, BlockPoolExhausted
@@ -293,7 +294,8 @@ class LLMEngine:
                  fault_plan=None, pressure=None,
                  kv_dtype: str = "float32", tp: int = 1,
                  tracer=None, overlap: bool = True,
-                 decode_window: int = 1):
+                 decode_window: int = 1,
+                 weight_dtype: str = "float32"):
         cfg = model.config
         self.config = cfg
         self.params = model.decode_params()
@@ -301,6 +303,16 @@ class LLMEngine:
             raise ValueError(
                 f"kv_dtype must be 'float32' or 'int8', got {kv_dtype!r}")
         self.kv_dtype = kv_dtype
+        if weight_dtype not in ("float32", "int8", "int4"):
+            raise ValueError(
+                "weight_dtype must be 'float32', 'int8' or 'int4', "
+                f"got {weight_dtype!r}")
+        self.weight_dtype = weight_dtype
+        # the step's activations keep the model's float dtype even when
+        # the embed table is about to become a quantized pool + scales
+        self._act_dtype = self.params["embed"].dtype
+        if self.weight_dtype != "float32":
+            self.params = self._quantize_params(self.params)
         self.tp = int(tp)
         if self.tp < 1:
             raise ValueError(f"tp must be >= 1, got {tp}")
@@ -346,7 +358,7 @@ class LLMEngine:
         self._kvh = cfg.num_key_value_heads
         self._hd = cfg.hidden_size // self._nh
         L = cfg.num_hidden_layers
-        dt = self.params["embed"].dtype
+        dt = self._act_dtype
         if self.kv_dtype == "int8":
             # int8 pages + a parallel per-page-per-head f32 scale pool
             # (symmetric: float = int8 * scale).  Scales are written at
@@ -458,6 +470,9 @@ class LLMEngine:
         self.peak_resident_seqs = 0
         self.stats = ServingStats()
         self.stats.set_decode_window(self.decode_window)
+        self.stats.set_weight_residency(
+            self.weight_dtype, self.weight_bytes_resident(),
+            self.weight_bytes_resident_per_shard())
         # per-request flight recorder (inference/flight.py): None means
         # every request-lifecycle seam is one attribute check and
         # nothing else — the tracer's zero-cost contract
@@ -525,6 +540,86 @@ class LLMEngine:
         return self.tracer.dump(path)
 
     # ------------------------------------------------------------------
+    # quantized weight pools (weight_dtype != "float32")
+    # ------------------------------------------------------------------
+
+    def _quantize_params(self, params) -> dict:
+        """Quantize decode_params ONCE at engine build into the pool
+        layout the fused dequant-matmul kernel streams.
+
+        Every projection/MLP weight ``name`` becomes a ``name_q``
+        quantized pool + ``name_s`` f32 scale tensor (int8:
+        per-output-channel; int4: nibble-packed with per-128-row-group
+        scales — see ops/pallas/quant_matmul.py); the embedding becomes
+        a per-vocab-row pool dequantized inline at gather.  Norms stay
+        f32 — they are O(H) gauge vectors, not bandwidth.  Runs BEFORE
+        ``_shard_params``: column-slicing commutes with quantization,
+        so tp=N shards the pools and scales by the same head/column
+        blocks with no resharding."""
+        wdt = self.weight_dtype
+        layers = params["layers"]
+        out_layers = {"ln1": layers["ln1"], "ln2": layers["ln2"]}
+        quant = jax.vmap(lambda w: _qm.quantize_weight(w, wdt))
+        for name in ("wq", "wk", "wv", "wo", "gate", "up", "down"):
+            q, s = quant(layers[name])
+            out_layers[name + "_q"] = q
+            out_layers[name + "_s"] = s
+        eq, es = _qm.quantize_embedding(params["embed"], wdt)
+        hq, hs = _qm.quantize_weight(params["head"], wdt)
+        return {"layers": out_layers, "embed_q": eq, "embed_s": es,
+                "norm_f": params["norm_f"], "head_q": hq, "head_s": hs}
+
+    def _weight_ops(self):
+        """(mm, embed, head_logits) for the step bodies, resolved once
+        per program build.
+
+        f32 engines get the literal dense expressions (byte-identity
+        with every pre-quantization program); quantized engines route
+        every projection/MLP/head matmul through the fused
+        dequant-matmul kernel on TPU (or under a forced interpreter)
+        and through its term-identical XLA fake-quant reference
+        everywhere else — the same split-contract the paged attention
+        kernel keeps."""
+        dt = self._act_dtype
+        wdt = self.weight_dtype
+        if wdt != "float32":
+            use_qmm = _qm.INTERPRET is True or \
+                jax.default_backend() == "tpu"
+
+            def mm(h, p, name):
+                q, s = p[name + "_q"], p[name + "_s"]
+                if use_qmm and _qm.supports(h.shape[0], h.shape[1],
+                                            q.shape[-1], wdt):
+                    out = _qm.matmul(h, q, s, weight_dtype=wdt)
+                else:
+                    out = _qm.reference_matmul(h, q, s, wdt)
+                return out.astype(h.dtype)
+
+            def embed(params, toks):
+                return _qm.dequantize_rows(
+                    jnp.take(params["embed_q"], toks, axis=0),
+                    jnp.take(params["embed_s"], toks), wdt).astype(dt)
+
+            def head_logits(params, hsel):
+                q, s = params["head_q"], params["head_s"]
+                if use_qmm and _qm.supports(hsel.shape[0], hsel.shape[1],
+                                            q.shape[-1], wdt):
+                    return _qm.matmul(hsel.astype(jnp.float32), q, s,
+                                      weight_dtype=wdt)
+                return _qm.reference_matmul(hsel, q, s, wdt)
+        else:
+            def mm(h, p, name):
+                return h @ p[name]
+
+            def embed(params, toks):
+                return jnp.take(params["embed"], toks, axis=0)
+
+            def head_logits(params, hsel):
+                return (hsel.astype(jnp.float32)
+                        @ params["head"].astype(jnp.float32))
+        return mm, embed, head_logits
+
+    # ------------------------------------------------------------------
     # tensor-parallel layout (tp > 1)
     # ------------------------------------------------------------------
 
@@ -538,12 +633,34 @@ class LLMEngine:
         split and greedy outputs stay byte-identical to tp=1.  wo, the
         MLP, and the norms replicate; the unembedding column-shards over
         vocab only when it divides evenly.
+
+        Quantized engines shard the SAME axes: a quantized pool slices
+        along its output-column axis exactly like the f32 weight it
+        replaced, and its scales slice with it (int8 scales are
+        per-output-column; int4 scales keep a leading row-group axis),
+        so tp=N never reshards or requantizes.
         """
         layers = {k: P() for k in self.params["layers"]}
-        for k in ("wq", "wk", "wv"):
+        if self.weight_dtype == "float32":
+            for k in ("wq", "wk", "wv"):
+                layers[k] = P(None, None, "tp")
+            return {"layers": layers, "embed": P(), "norm_f": P(),
+                    "head": P(None, "tp") if self._shard_head else P()}
+        for k in ("wq_q", "wk_q", "wv_q"):
             layers[k] = P(None, None, "tp")
-        return {"layers": layers, "embed": P(), "norm_f": P(),
-                "head": P(None, "tp") if self._shard_head else P()}
+        scale_cols = P(None, "tp") if self.weight_dtype == "int8" \
+            else P(None, None, "tp")
+        for k in ("wq_s", "wk_s", "wv_s"):
+            layers[k] = scale_cols
+        out = {"layers": layers, "embed_q": P(), "embed_s": P(),
+               "norm_f": P()}
+        if self._shard_head:
+            out["head_q"] = P(None, "tp")
+            out["head_s"] = P("tp") if self.weight_dtype == "int8" \
+                else P(None, "tp")
+        else:
+            out["head_q"] = out["head_s"] = P()
+        return out
 
     def _shard_params(self, params) -> dict:
         # specs lead the map (a PartitionSpec is itself a tuple pytree,
@@ -785,7 +902,7 @@ class LLMEngine:
         kernels re-resolve the same keys at trace time, so this report
         is the provenance of the geometry the programs actually run."""
         from ..tune import cache_path, device_kind, kernel_config_with_meta
-        dt = jnp.dtype(self.params["embed"].dtype).name
+        dt = jnp.dtype(self._act_dtype).name
         d = self._hd
         shapes = {
             "flash_attention": {
@@ -803,6 +920,14 @@ class LLMEngine:
                 "page": self.block_size, "nblk": self.nblk,
                 "dtype": self.kv_dtype},
         }
+        if self.weight_dtype != "float32":
+            # the decode-shaped MLP projection — the step's biggest
+            # weight stream and the shape the sweep's llama-class
+            # buckets answer for
+            shapes["quant_matmul"] = {
+                "m": self.max_num_seqs, "k": self.config.hidden_size,
+                "n": self.config.intermediate_size,
+                "dtype": self.weight_dtype}
         kernels = {}
         for name, shape in shapes.items():
             config, meta = kernel_config_with_meta(name, shape)
@@ -822,6 +947,10 @@ class LLMEngine:
         out["kv_bytes_resident"] = self.kv_bytes_resident()
         out["kv_bytes_resident_per_shard"] = \
             self.kv_bytes_resident_per_shard()
+        out["weight_dtype"] = self.weight_dtype
+        out["weight_bytes_resident"] = self.weight_bytes_resident()
+        out["weight_bytes_resident_per_shard"] = \
+            self.weight_bytes_resident_per_shard()
         out["peak_resident_seqs"] = self.peak_resident_seqs
         out["tuning_cache"] = {
             "path": self._tuning_report["path"],
@@ -866,6 +995,38 @@ class LLMEngine:
         return ((self.blocks.num_used + self.blocks.num_cached)
                 * self.kv_page_bytes_per_shard())
 
+    def weight_bytes_resident(self) -> int:
+        """MESH-TOTAL device bytes holding the decode weights: the
+        quantized pools + their f32 scales + the f32 norms (or the full
+        f32 tree for weight_dtype='float32').  The other half of
+        resident HBM next to ``kv_bytes_resident`` — int8 pools land
+        ~4x under f32, int4 ~8x."""
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(self.params):
+            total += int(np.prod(np.shape(leaf))) \
+                * np.dtype(leaf.dtype).itemsize
+        return total
+
+    def weight_bytes_resident_per_shard(self) -> int:
+        """Resident weight bytes on ONE chip of the tp mesh: sharded
+        leaves (q/k/v pools + scales, and the head when vocab divides)
+        contribute 1/tp of their mesh total, replicated leaves their
+        full size — the per-chip HBM figure budgets compare against."""
+        if self.tp == 1:
+            return self.weight_bytes_resident()
+        total = 0
+
+        def add(spec, x):
+            nonlocal total
+            b = int(np.prod(np.shape(x))) * np.dtype(x.dtype).itemsize
+            sharded = any(a is not None for a in spec)
+            total += b // self.tp if sharded else b
+            return x
+
+        jax.tree_util.tree_map(add, self._param_specs(), self.params,
+                               is_leaf=lambda x: isinstance(x, P))
+        return total
+
     @property
     def degradation_tier_entries(self) -> int:
         """Escalating degradation-controller transitions (0 when no
@@ -889,7 +1050,7 @@ class LLMEngine:
             lambda x: sds(np.shape(x), x.dtype), self.params)
         kc = sds(self._kc.shape, self._kc.dtype)
         vc = sds(self._vc.shape, self._vc.dtype)
-        dt = self.params["embed"].dtype
+        dt = self._act_dtype
         declared = dt if np.dtype(dt).name in ("bfloat16", "float16") \
             else None
         V = self.config.vocab_size
@@ -901,8 +1062,13 @@ class LLMEngine:
         rag_fn, rag_donate = self._make_ragged_fn(Tq)
         cow_fn, cow_donate = self._make_cow_fn()
         # a tp>1 engine compiles the SAME program kinds laid over the
-        # mesh; the suffix keeps its audit entries distinct in reports
-        sfx = f"_tp{self.tp}" if self.tp > 1 else ""
+        # mesh; the suffix keeps its audit entries distinct in reports.
+        # Weight-quantized engines likewise keep the same kinds with a
+        # dequant routed through the fused kernel path — their suffix
+        # keeps the regenerated serving report's names collision-free
+        # against the f32 engine's.
+        sfx = {"int8": "_w8", "int4": "_w4"}.get(self.weight_dtype, "")
+        sfx += f"_tp{self.tp}" if self.tp > 1 else ""
 
         def seqs(n):      # [n] i32 token/pos/index vectors
             return sds((n,), i32)
@@ -1242,7 +1408,7 @@ class LLMEngine:
             t = tr.now()
         if ticket.window:
             self._apply_window(batch, batch_slots, sampled, ok, dur,
-                               finished)
+                               finished, ticket.window)
         else:
             self._apply_ragged(chunks, spec, batch, sampled, ok, spec_ok,
                                spec_logits, chunk_slots, batch_slots,
@@ -1492,23 +1658,23 @@ class LLMEngine:
                     return False        # next rounds pack verify rows
         return True
 
-    def _reserve_window_pages(self, batch: list):
-        """Pre-reserve each row's K tokens of page slack before the
+    def _reserve_window_pages(self, batch: list, k: int):
+        """Pre-reserve each row's k tokens of page slack before the
         window launches (clamped to the row's remaining generation
-        budget — a row the active-mask will freeze after m < K tokens
-        writes only m positions).  All-or-nothing: a pool that cannot
-        cover the whole window rolls every grow back and returns None —
-        the step falls back to K=1, it NEVER preempts for a window.
+        budget — a row the active-mask will freeze after m < k tokens
+        writes only m positions).  All-or-nothing AT THIS k: a pool
+        that cannot cover the whole window rolls every grow back and
+        returns None — the dispatcher then retries at a smaller k'
+        before surrendering to K=1; it NEVER preempts for a window.
 
         No copy-on-write resolution is needed here: the per-step
         reservation that already ran this dispatch privatized the page
         holding the first write position, and every page boundary the
         window crosses past it lands on a freshly allocated (private)
         page."""
-        K = self.decode_window
         rows = []
         for req in batch:
-            m = min(K, req.max_new_tokens - len(req.generated))
+            m = min(k, req.max_new_tokens - len(req.generated))
             rows.append((req.rid, req.cached + m))
         return self.blocks.reserve_window(rows)
 
@@ -1516,16 +1682,34 @@ class LLMEngine:
         """Reserve, pack, and launch one K-step decode window over
         ``batch`` (slot-sorted, first-write pages already ensured).
         Returns True with the window ticket in flight, or False when
-        the pool could not cover the K-token slack (the caller runs the
-        per-step path for this step)."""
+        the pool could not cover even a 2-token window (the caller runs
+        the per-step path for this step).  Between those extremes the
+        window ADAPTS: when K tokens of slack don't fit, the dispatch
+        retries the reservation at K-1, K-2, ... and runs the largest
+        feasible K' device-resident — the per-row generation budgets
+        handed to the launch freeze every row after K' tokens, so the
+        compiled driver (still built at static K) exits the while_loop
+        early instead of the host surrendering the whole round-trip
+        amortization."""
         K = self.decode_window
-        if self._reserve_window_pages(batch) is None:
+        kp = 0
+        for k_try in range(K, 1, -1):
+            if self._reserve_window_pages(batch, k_try) is not None:
+                kp = k_try
+                break
+        if kp == 0:
             self.stats.record_window_fallback()
             if tr is not None:
                 tr.instant("engine.window_fallback",
                            track=self._trace_track,
                            args={"rows": len(batch), "k": K})
             return False
+        if kp < K:
+            self.stats.record_window_shrink()
+            if tr is not None:
+                tr.instant("engine.window_shrink",
+                           track=self._trace_track,
+                           args={"rows": len(batch), "k": K, "kp": kp})
         B = self.max_num_seqs
         n = len(batch)
         toks = np.zeros((B,), np.int32)
@@ -1544,7 +1728,11 @@ class LLMEngine:
             kvl[s] = req.cached + 1
             active[s] = True
             gen[s] = len(req.generated)
-            budgets[s] = req.max_new_tokens
+            # the K'-shrunk budget: the device active-mask freezes the
+            # row after exactly kp tokens (kp == K leaves the row's own
+            # generation budget in charge, same as before)
+            budgets[s] = min(req.max_new_tokens,
+                             len(req.generated) + kp)
             if req.eos_token_id is not None:
                 eos_ids[s] = int(req.eos_token_id)
             self._fill_samp(samp, s, req)
@@ -1556,14 +1744,14 @@ class LLMEngine:
                     jax.random.PRNGKey(req.seed), np.uint32)
         if tr is not None:
             tr.complete("engine.pack", t, track=self._trace_track,
-                        args={"rows": n, "window": K})
+                        args={"rows": n, "window": kp})
             t = tr.now()
         for s, req in enumerate(batch):
             bt[s] = self.blocks.padded_table(req.rid, self.nblk)
         if tr is not None:
             tr.complete("engine.block_table_stage", t,
                         track=self._trace_track,
-                        args={"rows": n, "window": K})
+                        args={"rows": n, "window": kp})
         # the window grows tables past anything the per-step buffers
         # staged; force full restages at the next per-step launch
         self._break_decode_layout()
@@ -1576,18 +1764,18 @@ class LLMEngine:
         if tr is not None:
             tr.complete("engine.device_launch", t,
                         track=self._trace_track,
-                        args={"rows": n, "window": K})
+                        args={"rows": n, "window": kp})
         now = time.perf_counter()
         self._inflight = _StepTicket(
             chunks=[], spec=[], batch=list(batch), sampled=toks_out,
             logits=None, fin=fin_out, spec_slices=[], chunk_slots=[],
             batch_slots=list(range(n)), dispatch_s=now - t0,
             t_launch=now, launch_ns=tr.now() if tr is not None else 0,
-            inflight=self.overlap, window=K)
+            inflight=self.overlap, window=kp)
         return True
 
     def _apply_window(self, batch, batch_slots, sampled, ok, dur,
-                      finished):
+                      finished, window):
         """Drain one completed K-step window: ONE materialized [K, B]
         token (and finiteness) grid commits as up to K per-token steps
         per row, in iteration-major order — the exact per-token sequence
@@ -1598,8 +1786,12 @@ class LLMEngine:
         freeze logic: a row leaves the walk when it retires (eos/length
         — the same predicates the active-mask evaluated on device) or
         quarantines on a non-finite iteration; its later columns are the
-        frozen filler values the loop carried and are never committed."""
-        K = int(sampled.shape[0])
+        frozen filler values the loop carried and are never committed.
+        ``window`` is the ticket's launched K' — a shrunk window's grid
+        still arrives [decode_window, B] wide (the compiled driver's
+        static K), so the drain MUST stop at K' or the budget-frozen
+        rows would commit their repeated filler columns."""
+        K = min(int(sampled.shape[0]), int(window))
         occ = len(self._running) / self.max_num_seqs
         alive = {req.rid for req in batch}
         committed = 0
@@ -2108,7 +2300,7 @@ class LLMEngine:
         with_logits = self._with_logits
         eps = self.config.rms_norm_eps
         theta = self.config.rope_theta
-        dt = self.params["embed"].dtype
+        dt = self._act_dtype
         if self.kv_dtype == "int8":
             return self._make_ragged_fn_q8(Tq)
         # under tp the body runs on PER-SHARD shapes: a contiguous block
@@ -2117,6 +2309,7 @@ class LLMEngine:
         tp = self.tp
         nh, kvh = nh // tp, kvh // tp
         shard_head = self._shard_head
+        mm, embed, head_logits = self._weight_ops()
         # the interpreted kernel costs a Python step per (Tq, H_kv, nblk)
         # grid cell EVERY launch — serving on CPU uses the XLA reference
         # path (term-identical math) unless a test forces the interpreter
@@ -2135,14 +2328,14 @@ class LLMEngine:
             # kc/vc and the q/k/v projections arrive head-sliced, toks..
             # samp arrive replicated.
             seg, rel = _pa.ragged_segments(cu, kvl, Tq)
-            x = jnp.take(params["embed"], toks, axis=0)       # [Tq, H]
+            x = embed(params, toks)                           # [Tq, H]
 
             def body(x, inp):
                 p, kcl, vcl = inp
                 h = _rms_weight(x, p["ln1"], eps)
-                q = (h @ p["wq"]).reshape(Tq, nh, d)
-                k = (h @ p["wk"]).reshape(Tq, kvh, d)
-                v = (h @ p["wv"]).reshape(Tq, kvh, d)
+                q = mm(h, p, "wq").reshape(Tq, nh, d)
+                k = mm(h, p, "wk").reshape(Tq, kvh, d)
+                v = mm(h, p, "wv").reshape(Tq, kvh, d)
                 q = _rope_positions(q, rel, theta)
                 k = _rope_positions(k, rel, theta)
                 blk = bt[seg, rel // bs]                      # [Tq]
@@ -2165,17 +2358,16 @@ class LLMEngine:
                     # mesh order — exactly the tp=1 head layout, so the
                     # replicated wo matmul is byte-identical
                     att = lax.all_gather(att, "tp", axis=1, tiled=True)
-                x = x + att.reshape(Tq, tp * nh * d) @ p["wo"]
+                x = x + mm(att.reshape(Tq, tp * nh * d), p, "wo")
                 h2 = _rms_weight(x, p["ln2"], eps)
-                a = jax.nn.silu((h2 @ p["gate"]).astype(jnp.float32)
-                                ).astype(h2.dtype) * (h2 @ p["up"])
-                return x + a @ p["down"], (kcl, vcl)
+                a = jax.nn.silu(mm(h2, p, "gate").astype(jnp.float32)
+                                ).astype(h2.dtype) * mm(h2, p, "up")
+                return x + mm(a, p, "down"), (kcl, vcl)
 
             x, (kc, vc) = lax.scan(body, x, (params["layers"], kc, vc))
             h = _rms_weight(x, params["norm_f"], eps)
             hsel = h[lidx]                                    # [Lq, H]
-            logits = (hsel.astype(jnp.float32)
-                      @ params["head"].astype(jnp.float32))   # [Lq, V]
+            logits = head_logits(params, hsel)                # [Lq, V]
             if shard_head:
                 # vocab-sliced logits -> one gather; sampling then runs
                 # replicated on identical full-width rows
@@ -2222,13 +2414,14 @@ class LLMEngine:
         with_logits = self._with_logits
         eps = self.config.rms_norm_eps
         theta = self.config.rope_theta
-        dt = self.params["embed"].dtype
+        dt = self._act_dtype
         # per-shard head counts under tp (see _make_ragged_fn): the
         # scale pools slice along the same H_kv axis as the page pools,
         # so quantize-at-commit stays a purely per-head-local transform
         tp = self.tp
         nh, kvh = nh // tp, kvh // tp
         shard_head = self._shard_head
+        mm, embed, head_logits = self._weight_ops()
         use_pallas = _pa.INTERPRET is True or (
             jax.default_backend() == "tpu"
             and _pa.ragged_quant_supports(Tq, nh, kvh, d, bs, B + 1,
@@ -2240,14 +2433,14 @@ class LLMEngine:
             # f32 scale pools (donated with the page pools) and fresh
             # [num_blocks] bool (pages whose scales reset this launch)
             seg, rel = _pa.ragged_segments(cu, kvl, Tq)
-            x = jnp.take(params["embed"], toks, axis=0)       # [Tq, H]
+            x = embed(params, toks)                           # [Tq, H]
 
             def body(x, inp):
                 p, kcl, vcl, ksl, vsl = inp
                 h = _rms_weight(x, p["ln1"], eps)
-                q = (h @ p["wq"]).reshape(Tq, nh, d)
-                k = (h @ p["wk"]).reshape(Tq, kvh, d)
-                v = (h @ p["wv"]).reshape(Tq, kvh, d)
+                q = mm(h, p, "wq").reshape(Tq, nh, d)
+                k = mm(h, p, "wk").reshape(Tq, kvh, d)
+                v = mm(h, p, "wv").reshape(Tq, kvh, d)
                 q = _rope_positions(q, rel, theta)
                 k = _rope_positions(k, rel, theta)
                 blk = bt[seg, rel // bs]                      # [Tq]
@@ -2293,19 +2486,18 @@ class LLMEngine:
                 att = att.astype(x.dtype)
                 if tp > 1:
                     att = lax.all_gather(att, "tp", axis=1, tiled=True)
-                x = x + att.reshape(Tq, tp * nh * d) @ p["wo"]
+                x = x + mm(att.reshape(Tq, tp * nh * d), p, "wo")
                 h2 = _rms_weight(x, p["ln2"], eps)
-                a = jax.nn.silu((h2 @ p["gate"]).astype(jnp.float32)
-                                ).astype(h2.dtype) * (h2 @ p["up"])
-                return x + a @ p["down"], (kcl, vcl, ksl, vsl)
+                a = jax.nn.silu(mm(h2, p, "gate").astype(jnp.float32)
+                                ).astype(h2.dtype) * mm(h2, p, "up")
+                return x + mm(a, p, "down"), (kcl, vcl, ksl, vsl)
 
             x, (kc, vc, ks, vs) = lax.scan(body, x,
                                            (params["layers"], kc, vc,
                                             ks, vs))
             h = _rms_weight(x, params["norm_f"], eps)
             hsel = h[lidx]                                    # [Lq, H]
-            logits = (hsel.astype(jnp.float32)
-                      @ params["head"].astype(jnp.float32))   # [Lq, V]
+            logits = head_logits(params, hsel)                # [Lq, V]
             if shard_head:
                 logits = lax.all_gather(logits, "tp", axis=1, tiled=True)
             sampled = sample_tokens(logits, samp)
@@ -2412,12 +2604,13 @@ class LLMEngine:
         K = self.decode_window
         eps = self.config.rms_norm_eps
         theta = self.config.rope_theta
-        dt = self.params["embed"].dtype
+        dt = self._act_dtype
         if self.kv_dtype == "int8":
             return self._make_window_fn_q8()
         tp = self.tp
         nh, kvh = nh // tp, kvh // tp
         shard_head = self._shard_head
+        mm, embed, head_logits = self._weight_ops()
         use_pallas = _pa.INTERPRET is True or (
             jax.default_backend() == "tpu"
             and _pa.ragged_supports(B, nh, kvh, d, bs, B + 1,
@@ -2438,14 +2631,14 @@ class LLMEngine:
                 (i, tok, kvl, active, gen, seen, kc, vc, touts,
                  fouts) = carry
                 seg, rel = _pa.decode_window_segments(active, kvl)
-                x = jnp.take(params["embed"], tok, axis=0)    # [B, H]
+                x = embed(params, tok)                        # [B, H]
 
                 def body(x, inp):
                     p, kcl, vcl = inp
                     h = _rms_weight(x, p["ln1"], eps)
-                    q = (h @ p["wq"]).reshape(B, nh, d)
-                    k = (h @ p["wk"]).reshape(B, kvh, d)
-                    v = (h @ p["wv"]).reshape(B, kvh, d)
+                    q = mm(h, p, "wq").reshape(B, nh, d)
+                    k = mm(h, p, "wk").reshape(B, kvh, d)
+                    v = mm(h, p, "wv").reshape(B, kvh, d)
                     q = _rope_positions(q, rel, theta)
                     k = _rope_positions(k, rel, theta)
                     blk = bt[seg, rel // bs]                  # [B]
@@ -2461,18 +2654,17 @@ class LLMEngine:
                     if tp > 1:
                         att = lax.all_gather(att, "tp", axis=1,
                                              tiled=True)
-                    x = x + att.reshape(B, tp * nh * d) @ p["wo"]
+                    x = x + mm(att.reshape(B, tp * nh * d), p, "wo")
                     h2 = _rms_weight(x, p["ln2"], eps)
-                    a = jax.nn.silu((h2 @ p["gate"]).astype(jnp.float32)
-                                    ).astype(h2.dtype) * (h2 @ p["up"])
-                    return x + a @ p["down"], (kcl, vcl)
+                    a = jax.nn.silu(mm(h2, p, "gate").astype(jnp.float32)
+                                    ).astype(h2.dtype) * mm(h2, p, "up")
+                    return x + mm(a, p, "down"), (kcl, vcl)
 
                 x, (kc, vc) = lax.scan(body, x,
                                        (params["layers"], kc, vc))
                 h = _rms_weight(x, params["norm_f"], eps)
                 # every row is its own logit row (lidx == identity)
-                logits = (h.astype(jnp.float32)
-                          @ params["head"].astype(jnp.float32))
+                logits = head_logits(params, h)
                 if shard_head:
                     logits = lax.all_gather(logits, "tp", axis=1,
                                             tiled=True)
@@ -2525,10 +2717,11 @@ class LLMEngine:
         K = self.decode_window
         eps = self.config.rms_norm_eps
         theta = self.config.rope_theta
-        dt = self.params["embed"].dtype
+        dt = self._act_dtype
         tp = self.tp
         nh, kvh = nh // tp, kvh // tp
         shard_head = self._shard_head
+        mm, embed, head_logits = self._weight_ops()
         use_pallas = _pa.INTERPRET is True or (
             jax.default_backend() == "tpu"
             and _pa.ragged_quant_supports(B, nh, kvh, d, bs, B + 1,
@@ -2544,14 +2737,14 @@ class LLMEngine:
                 (i, tok, kvl, active, gen, seen, kc, vc, ks, vs, touts,
                  fouts) = carry
                 seg, rel = _pa.decode_window_segments(active, kvl)
-                x = jnp.take(params["embed"], tok, axis=0)    # [B, H]
+                x = embed(params, tok)                        # [B, H]
 
                 def body(x, inp):
                     p, kcl, vcl, ksl, vsl = inp
                     h = _rms_weight(x, p["ln1"], eps)
-                    q = (h @ p["wq"]).reshape(B, nh, d)
-                    k = (h @ p["wk"]).reshape(B, kvh, d)
-                    v = (h @ p["wv"]).reshape(B, kvh, d)
+                    q = mm(h, p, "wq").reshape(B, nh, d)
+                    k = mm(h, p, "wk").reshape(B, kvh, d)
+                    v = mm(h, p, "wv").reshape(B, kvh, d)
                     q = _rope_positions(q, rel, theta)
                     k = _rope_positions(k, rel, theta)
                     blk = bt[seg, rel // bs]                  # [B]
@@ -2599,18 +2792,17 @@ class LLMEngine:
                     if tp > 1:
                         att = lax.all_gather(att, "tp", axis=1,
                                              tiled=True)
-                    x = x + att.reshape(B, tp * nh * d) @ p["wo"]
+                    x = x + mm(att.reshape(B, tp * nh * d), p, "wo")
                     h2 = _rms_weight(x, p["ln2"], eps)
-                    a = jax.nn.silu((h2 @ p["gate"]).astype(jnp.float32)
-                                    ).astype(h2.dtype) * (h2 @ p["up"])
-                    return x + a @ p["down"], (kcl, vcl, ksl, vsl)
+                    a = jax.nn.silu(mm(h2, p, "gate").astype(jnp.float32)
+                                    ).astype(h2.dtype) * mm(h2, p, "up")
+                    return x + mm(a, p, "down"), (kcl, vcl, ksl, vsl)
 
                 x, (kc, vc, ks, vs) = lax.scan(body, x,
                                                (params["layers"], kc,
                                                 vc, ks, vs))
                 h = _rms_weight(x, params["norm_f"], eps)
-                logits = (h.astype(jnp.float32)
-                          @ params["head"].astype(jnp.float32))
+                logits = head_logits(params, h)
                 if shard_head:
                     logits = lax.all_gather(logits, "tp", axis=1,
                                             tiled=True)

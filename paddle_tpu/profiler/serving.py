@@ -237,6 +237,17 @@ class ServingStats:
         self.decode_rounds = 0           # per-row decode positions advanced
         self.decode_window_k = 1
         self.decode_window_fallbacks = 0
+        # windows that ran device-resident but at a SHRUNK K' < K
+        # because the pool could only pre-reserve K' tokens of slack
+        self.decode_window_shrinks = 0
+        # weight residency (PR 17): engine-build-time gauges, so they
+        # SURVIVE reset like _windows — benches reset between passes
+        # without rebuilding the engine, and the pools don't move
+        self.weight_dtype = getattr(self, "weight_dtype", "float32")
+        self.weight_bytes_resident = getattr(
+            self, "weight_bytes_resident", 0)
+        self.weight_bytes_resident_per_shard = getattr(
+            self, "weight_bytes_resident_per_shard", 0)
         # SLO-observatory surface (PR 13): queue wait (arrival ->
         # admission) joins the lifetime reservoirs, and an OPT-IN
         # windowed layer (profiler/slo.py) rides beside them — None
@@ -337,6 +348,22 @@ class ServingStats:
         """One eligible decode window that fell back to the per-step
         path because the pool couldn't pre-reserve K tokens of slack."""
         self.decode_window_fallbacks += int(n)
+
+    def record_window_shrink(self, n: int = 1) -> None:
+        """One eligible decode window that ran device-resident at a
+        shrunk K' < decode_window (the pool covered K' tokens of slack
+        but not K) instead of falling back to per-step."""
+        self.decode_window_shrinks += int(n)
+
+    def set_weight_residency(self, dtype: str, total_bytes: int,
+                             per_shard_bytes: int | None = None) -> None:
+        """Engine-build gauges: the weight pools' storage dtype and
+        resident bytes (mesh-wide total and the largest single shard —
+        equal at tp=1)."""
+        self.weight_dtype = str(dtype)
+        self.weight_bytes_resident = int(total_bytes)
+        self.weight_bytes_resident_per_shard = int(
+            total_bytes if per_shard_bytes is None else per_shard_bytes)
 
     def record_admission(self, n: int = 1) -> None:
         self.admitted += int(n)
@@ -598,6 +625,11 @@ class ServingStats:
             "tokens_per_launch": round(self.tokens_per_launch(), 3),
             "decode_window_k": self.decode_window_k,
             "decode_window_fallbacks": self.decode_window_fallbacks,
+            "decode_window_shrinks": self.decode_window_shrinks,
+            "weight_dtype": self.weight_dtype,
+            "weight_bytes_resident": self.weight_bytes_resident,
+            "weight_bytes_resident_per_shard":
+                self.weight_bytes_resident_per_shard,
             "engine_steps": self.engine_steps,
             "step_time_s": round(self.step_time, 6),
             "dispatch_time_s": round(self.dispatch_time, 6),
@@ -660,7 +692,8 @@ class ServingStats:
             "uptime_seconds", "degradation_state", "decode_window_k",
             "dispatch_ms_p50", "dispatch_ms_p99",
             "block_ms_p50", "block_ms_p99",
-            "queue_wait_p50_ms", "queue_wait_p99_ms")
+            "queue_wait_p50_ms", "queue_wait_p99_ms",
+            "weight_bytes_resident_per_shard")
     _MEAN = ("mean_batch_occupancy", "mean_prefill_queue_depth")
     # windowed-telemetry keys (present only when enable_windows() ran)
     # are pooled structurally after the generic pass: bucket counts sum
@@ -694,6 +727,9 @@ class ServingStats:
                     for k, n in v.items():
                         merged[k] = merged.get(k, 0) + n
                 out[key] = merged
+            elif isinstance(vals[0], str):       # weight_dtype, ...
+                out[key] = vals[0] \
+                    if all(v == vals[0] for v in vals) else "mixed"
             elif key in ServingStats._RATE:
                 pass                             # recomputed below
             elif key in ServingStats._THROUGH:

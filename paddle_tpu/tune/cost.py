@@ -12,7 +12,8 @@ from __future__ import annotations
 
 import math
 
-__all__ = ["estimate", "PEAK_FLOPS", "PEAK_BW", "VMEM_BYTES"]
+__all__ = ["estimate", "f32_matmul_estimate", "PEAK_FLOPS", "PEAK_BW",
+           "VMEM_BYTES"]
 
 PEAK_FLOPS = 200e12     # flop/s, generic bf16-class systolic peak
 PEAK_BW = 1.0e12        # byte/s HBM
@@ -76,11 +77,55 @@ def _paged(shape: dict, config: dict) -> float:
     return _roofline(flops, traffic, programs, programs * p, vmem)
 
 
+def _weight_bytes_per_elem(dtype: str) -> float:
+    # int4 nibble-packs two weights per byte; scales ride separately
+    return 0.5 if dtype == "int4" else float(_bytes(dtype))
+
+
+def _quant_matmul(shape: dict, config: dict) -> float:
+    """Fused dequant matmul: x [M, K] f32 against a quantized [K, N]
+    weight pool.  Traffic is the decode story — activations and the f32
+    output are tiny next to the weight bytes, which shrink 4x/8x vs a
+    dense f32 operand.  VMEM holds one x block, one quantized weight
+    block plus its f32 upcast (the dequant temporary), and the f32
+    accumulator/output tile."""
+    m, k, n = shape["m"], shape["k"], shape["n"]
+    dtype = shape.get("dtype", "int8")
+    wb = _weight_bytes_per_elem(dtype)
+    bm = min(config["block_m"], m)
+    bn = min(config["block_n"], n)
+    bk = min(config["block_k"], k)
+    programs = math.ceil(m / bm) * math.ceil(n / bn)
+    tiles = programs * math.ceil(k / bk)
+    flops = 2.0 * m * k * n
+    scale_rows = math.ceil(k / 128) if dtype == "int4" else 1
+    traffic = (4.0 * m * k                    # activations
+               + wb * k * n                   # quantized weight stream
+               + 4.0 * scale_rows * n         # scales
+               + 4.0 * m * n)                 # f32 output
+    vmem = (4.0 * bm * bk                     # x block
+            + wb * bk * bn                    # quantized weight block
+            + 4.0 * bk * bn                   # f32 dequant temporary
+            + 4.0 * bm * bn * 2)              # accumulator + out tile
+    return _roofline(flops, traffic, programs, tiles, vmem)
+
+
+def f32_matmul_estimate(m: int, k: int, n: int) -> float:
+    """Roofline seconds for the dense f32 XLA matmul at the same shape —
+    the A/B baseline serve_bench and the acceptance gate quote against
+    the tuned ``quant_matmul`` estimate.  One program (XLA fuses the
+    whole contraction), full-width f32 weight traffic."""
+    flops = 2.0 * m * k * n
+    traffic = 4.0 * (m * k + k * n + m * n)
+    return max(flops / PEAK_FLOPS, traffic / PEAK_BW) + PER_PROGRAM_S
+
+
 _MODELS = {
     "flash_attention": _flash,
     "flash_attention_varlen": _flash,
     "fused_norms": _norms,
     "paged_attention": _paged,
+    "quant_matmul": _quant_matmul,
 }
 
 

@@ -69,7 +69,7 @@ def candidate_configs(kernel: TunableKernel):
 
 
 # ---------------------------------------------------------------------------
-# the four shipped kernels
+# the five shipped kernels
 # ---------------------------------------------------------------------------
 
 # dense flash attention: block_q/block_k tile the (seq_q, seq_k) grid.
@@ -132,4 +132,25 @@ register(TunableKernel(
          "dtype": "int8"},
     ),
     describe="ragged paged attention KV pages per grid step",
+))
+
+# fused dequant matmul: int8/int4 weight blocks stream from HBM and
+# upcast in VMEM against their scale rows.  block_m/n/k tile the
+# (M, N, K) grid; the launch clamps each to a divisor of its dim (and
+# block_k to the int4 128-row scale-group nesting), so every candidate
+# is feasible at every shape and only the tiling — never the math —
+# changes.  Sweep shapes are llama-class decode launches: M is the
+# decode batch, K/N the projection and MLP extents.
+register(TunableKernel(
+    name="quant_matmul",
+    space={"block_m": (8, 16, 32), "block_n": (128, 256, 512),
+           "block_k": (128, 256, 512)},
+    defaults={"block_m": 8, "block_n": 256, "block_k": 256},
+    sweep=(
+        {"m": 8, "k": 4096, "n": 4096, "dtype": "int8"},
+        {"m": 8, "k": 4096, "n": 11008, "dtype": "int8"},
+        {"m": 8, "k": 4096, "n": 4096, "dtype": "int4"},
+        {"m": 8, "k": 4096, "n": 11008, "dtype": "int4"},
+    ),
+    describe="fused dequant-matmul weight-block tiles (int8/int4)",
 ))

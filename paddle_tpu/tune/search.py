@@ -109,6 +109,23 @@ def build(name, s):
         rel = jnp.asarray(rng.randint(page, page * nblk, (tq,)), jnp.int32)
         fn = jax.jit(lambda *a: pa.ragged_paged_attention_segrel(*a))
         return fn, (q, kc, vc, bt, seg, rel)
+    if name == "quant_matmul":
+        from paddle_tpu.ops.pallas import quant_matmul as qm
+        rng = np.random.RandomState(0)
+        m, k, n = s["m"], s["k"], s["n"]
+        wdt = s.get("dtype", "int8")
+        x = jnp.asarray(rng.randn(m, k), jnp.float32)
+        w = jnp.asarray(rng.randn(k, n), jnp.float32)
+        q, sc = qm.quantize_weight(w, wdt)
+        if qm.supports(m, k, n, wdt):
+            fn = jax.jit(lambda x, q, sc: qm.matmul(
+                x, q, sc, weight_dtype=wdt))
+        else:
+            # off-chip grace: time the fake-quant reference so
+            # candidates tie and the winner degrades to the defaults
+            fn = jax.jit(lambda x, q, sc: qm.reference_matmul(
+                x, q, sc, wdt))
+        return fn, (x, q, sc)
     raise SystemExit(f"unknown kernel {name}")
 
 fn, args = build(spec["kernel"], spec["shape"])

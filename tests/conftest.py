@@ -33,9 +33,15 @@ if not _HW:
     jax.config.update("jax_platforms", "cpu")
 
 # Persistent XLA compile cache: the suite is compile-bound on the 1-core CI
-# host (VERDICT r1 weak #5); warm runs skip recompilation entirely.
+# host (VERDICT r1 weak #5); warm runs skip recompilation entirely.  Export
+# the env-var form too so the CLI subprocesses tests spawn (serve_bench,
+# autotune, frontend, launch) share the same cache instead of recompiling
+# the same tiny engines from scratch on every invocation.
 _cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           os.pardir, ".jax_cache")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

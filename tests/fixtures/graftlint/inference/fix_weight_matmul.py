@@ -1,0 +1,13 @@
+"""Seeds f32-weight-matmul-in-quantized-engine: the engine's quantized
+branch contracts the hidden states against a raw f32 weight-pool entry
+instead of routing through the fused dequant-matmul helper — forfeiting
+the 4x/8x weight-byte win the int8/int4 pools exist for.  The f32
+branch keeping its dense matmul is the contract and must NOT fire."""
+
+
+def project(h, params, weight_dtype):
+    if weight_dtype != "float32":
+        q = h @ params["wq"]                 # dense matmul, f32 weights
+    else:
+        q = h @ params["wq"]                 # f32 engine: correct
+    return q

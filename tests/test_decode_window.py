@@ -143,13 +143,38 @@ def test_window_eos_retirement_mid_window(model):
     eng.blocks.check_invariants()
 
 
-def test_window_pool_exhaustion_falls_back_per_step(model):
+def test_window_pool_squeeze_shrinks_kprime(model):
     """When the pool can't cover K tokens of page slack per row, the
-    scheduler launches the plain per-step path for that round instead
-    (counted), and outputs stay byte-identical even when the squeeze
-    also forces a preemption."""
+    dispatcher first ADAPTS: it retries the reservation at K-1, K-2,
+    ... and runs the largest feasible K' on the SAME compiled window
+    program (budgets freeze rows after K' tokens), counting the shrink
+    instead of surrendering the round trip — outputs byte-identical."""
     kw = dict(num_blocks=13, max_num_seqs=4, max_prefill_tokens=128,
               prefill_token_bucket=32)
+    rng = np.random.RandomState(1)
+    reqs = [(rng.randint(0, VOCAB, int(rng.randint(4, 12))).tolist(), 20)
+            for _ in range(4)]
+    sync = _engine(model, overlap=False, **kw)
+    s_out = _drive(sync, reqs)
+    eng = _engine(model, decode_window=4, **kw)
+    w_out = _drive(eng, reqs)
+    assert [o.generated for o in w_out] == [o.generated for o in s_out]
+    assert eng.stats.decode_window_shrinks > 0
+    assert eng.stats.snapshot()["decode_window_shrinks"] > 0
+    # the shrunken window reuses the static-K compiled scan: ONE
+    # program kind, no recompile per K'
+    assert eng.compile_counts.get("scan", 0) == 1
+    assert eng.blocks.num_used == 0
+    eng.blocks.check_invariants()
+
+
+def test_window_pool_exhaustion_falls_back_per_step(model):
+    """When even a 2-token window doesn't fit (tiny pages make every
+    row's slack a fresh page), the scheduler surrenders the round to
+    the plain per-step path (counted), and outputs stay byte-identical
+    even when the squeeze also forces a preemption."""
+    kw = dict(num_blocks=35, block_size=2, max_num_seqs=4,
+              max_prefill_tokens=128, prefill_token_bucket=32)
     rng = np.random.RandomState(1)
     reqs = [(rng.randint(0, VOCAB, int(rng.randint(4, 12))).tolist(), 20)
             for _ in range(4)]

@@ -46,6 +46,8 @@ def _lint_fix(name):
      "attention-program-budget", 18, "decode_step", ERROR),
     (os.path.join("inference", "fix_quantized_kv.py"),
      "quantized-kv-float32-page", 10, "build_pools", WARNING),
+    (os.path.join("inference", "fix_weight_matmul.py"),
+     "f32-weight-matmul-in-quantized-engine", 10, "project", WARNING),
     (os.path.join("inference", "fix_swallowed_exception.py"),
      "swallowed-exception", 9, "release_pages", ERROR),
     (os.path.join("inference", "fix_collective_outside_shard_map.py"),
@@ -89,6 +91,8 @@ def test_serving_engine_within_attention_program_budget():
             if f.rule == "attention-program-budget"] == []
     assert [f for f in findings
             if f.rule == "quantized-kv-float32-page"] == []
+    assert [f for f in findings
+            if f.rule == "f32-weight-matmul-in-quantized-engine"] == []
 
 
 def test_mutable_default_is_error_in_compiled_path():
@@ -267,6 +271,7 @@ def test_every_catalog_rule_is_exercised():
         "numpy-in-jit", "host-sync-in-jit", "tracer-branch",
         "mutable-default-arg", "unkeyed-jit", "attention-program-budget",
         "quantized-kv-float32-page", "swallowed-exception",
+        "f32-weight-matmul-in-quantized-engine",
         "collective-outside-shard-map", "untuned-pallas-launch",
         "wallclock-in-timing-path", "host-sync-in-dispatch-path",
         "per-token-host-sync-in-decode-window",

@@ -109,11 +109,13 @@ def test_committed_report_matches_fresh_audit(model):
         "serving_report.json")
     committed = json.load(open(path))
     fresh_by_name = {}
-    for kw in ({"kv_dtype": "float32"}, {"kv_dtype": "int8"}, {"tp": 2}):
+    for kw in ({"kv_dtype": "float32"}, {"kv_dtype": "int8"},
+               {"weight_dtype": "int8"}, {"tp": 2}):
         fresh = audit_engine(_engine(model, **kw), large_bytes=1 << 10)
         fresh_by_name.update({p["name"]: p for p in fresh["programs"]})
     committed_names = {p["name"] for p in committed["programs"]}
     assert {"serving.ragged_step_q8", "serving.cow_copy_q8",
+            "serving.ragged_step_w8", "serving.cow_copy_w8",
             "serving.ragged_step_tp2",
             "serving.cow_copy_tp2"} <= committed_names
     for prog in committed["programs"]:

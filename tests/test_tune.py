@@ -305,10 +305,11 @@ def test_autotune_cli_cost_model_end_to_end(clean_tune, tmp_path):
     assert record["value"] > 0
     # the shipped ops/pallas tree has zero untuned launches
     assert record["untuned_launches"] == []
-    # the sweep covered all four registered kernels
+    # the sweep covered all five registered kernels
     c = TuningCache(cache_file)
     assert c.kernels() == {"flash_attention", "flash_attention_varlen",
-                           "fused_norms", "paged_attention"}
+                           "fused_norms", "paged_attention",
+                           "quant_matmul"}
     # a subsequent engine build resolves every kernel from this cache
     from paddle_tpu.inference import LLMEngine
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
@@ -323,6 +324,17 @@ def test_autotune_cli_cost_model_end_to_end(clean_tune, tmp_path):
     for name in ("flash_attention", "flash_attention_varlen",
                  "fused_norms", "paged_attention"):
         assert report["kernels"][name]["hit"] is True, report["kernels"]
+    # an f32-weight engine never resolves quant_matmul ...
+    assert "quant_matmul" not in report["kernels"]
+    # ... and a quantized one resolves it from the same swept cache
+    # (bucket: the sweep ran llama-class extents, the tiny engine's
+    # shapes fall back to the nearest bucket entry)
+    eng8 = LLMEngine(LlamaForCausalLM(cfg), max_num_seqs=4, block_size=8,
+                     max_model_len=64, max_prefill_tokens=128,
+                     prefill_token_bucket=32, weight_dtype="int8")
+    report8 = eng8.summary()["tuning_cache"]
+    info = report8["kernels"]["quant_matmul"]
+    assert info["source"] in ("exact", "bucket"), report8["kernels"]
 
 
 def test_run_sweep_cost_model_in_process(clean_tune, tmp_path,

@@ -61,6 +61,11 @@ def _serving_findings(large_bytes: int):
     # CoW); its scale pools are large buffers that must be donated too
     q8 = LLMEngine(model, kv_dtype="int8", **engine_kw)
     specs += q8.program_specs(large_bytes=large_bytes)
+    # the weight-quantized engine routes every projection/MLP/embedding
+    # matmul through the quantized pools (programs suffixed _w8); its
+    # int8 pools + f32 scales are the large buffers under audit
+    w8 = LLMEngine(model, weight_dtype="int8", **engine_kw)
+    specs += w8.program_specs(large_bytes=large_bytes)
     # the tensor-parallel engine lays the same step over a 2-chip mesh
     # (shard_map inside the jit) — its pools are per-shard, its donation
     # contract identical; the audit proves the sharded program is as

@@ -45,6 +45,7 @@ _SERVE_WATCH = (
     ("itl_p99_ms", False),
     ("p99_token_ms", False),
     ("decode_window_host_round_trips_per_token", False),
+    ("weight_bytes_resident", False),
 )
 _TRAIN_WATCH = (("tokens_per_sec", True),)
 

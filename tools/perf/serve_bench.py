@@ -103,6 +103,22 @@ the same silicon at matched traffic:
    "degradation_tier_entries": ..., "baseline_degradation_tier_entries": ...,
    "hbm_budget_bytes": ..., "num_blocks": ..., "baseline_num_blocks": ...}
 
+With ``--weight-pressure`` the same burst workload A/Bs a float32
+weight pool against a ``--weight-dtype`` quantized one (int8 if the
+flag is left at float32) under the SAME per-chip HBM budget — the f32
+weights plus a fixed page allowance — so the bytes the quantized pool
+hands back buy extra KV pages.  The record shows the compression and
+the residency headroom, plus the roofline-modeled decode matmul cost
+of the tuned ``quant_matmul`` kernel vs the dense f32 XLA contraction
+at a llama-sm projection shape:
+
+  {"metric": "serve_weight_resident_seqs", "value": ..., "unit": "seqs",
+   "weight_compression_ratio": ..., "weight_bytes_resident": ...,
+   "baseline_weight_bytes_resident": ..., "resident_ratio": ...,
+   "modeled_decode_layer_s": ..., "modeled_f32_layer_s": ...,
+   "modeled_decode_cost_ratio": ..., "num_blocks": ...,
+   "baseline_num_blocks": ..., "hbm_budget_bytes": ...}
+
 With ``--http --replicas D`` the shared-prefix workload (``share_ways``
 from ``--prefix-share``, default 4) runs over D data-parallel engine
 replicas behind the prefix-affinity replica router — the SAME stream
@@ -118,9 +134,11 @@ buys:
 Every mode's record also carries the KV-residency surface — ``kv_dtype``,
 ``kv_bytes_resident``, ``peak_resident_seqs``,
 ``degradation_tier_entries`` — plus ``tp`` and ``replicas``;
-``--kv-dtype int8`` threads quantized KV pages and ``--tp N`` threads an
-N-way tensor-parallel mesh (host devices forced on CPU) through every
-engine the bench builds.
+``--kv-dtype int8`` threads quantized KV pages, ``--weight-dtype
+int8|int4`` threads quantized weight pools (every record carries
+``weight_dtype`` and ``weight_bytes_resident``), and ``--tp N`` threads
+an N-way tensor-parallel mesh (host devices forced on CPU) through
+every engine the bench builds.
 
 Hardening contract (same as bench.py): the JSON line ALWAYS prints.  The
 backend is probed in a subprocess with a hard timeout before this process
@@ -151,6 +169,12 @@ def _probe_backend(timeout_s: float = 110.0):
     """(backend, error_or_None) — subprocess probe, never raises/hangs."""
     import subprocess
     import time
+
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        # the caller pinned the platform (CI does, for every test in
+        # the suite): jax can't resolve anything else, so the probe
+        # subprocess would only re-pay a whole jax import to confirm it
+        return "cpu", "JAX_PLATFORMS pinned to cpu"
 
     err = None
     for attempt in range(2):
@@ -219,11 +243,14 @@ def _drive(engine, stream):
 
 
 def _mem_keys(engine):
-    """KV-residency surface every mode reports, all dtypes: what the
-    pages cost in bytes and how many sequences they held at peak."""
+    """Residency surface every mode reports, all dtypes: what the KV
+    pages and the weight pools cost in bytes and how many sequences
+    the pages held at peak."""
     return {
         "kv_dtype": engine.kv_dtype,
         "kv_bytes_resident": engine.kv_bytes_resident(),
+        "weight_dtype": engine.weight_dtype,
+        "weight_bytes_resident": engine.weight_bytes_resident(),
         "peak_resident_seqs": engine.peak_resident_seqs,
         "degradation_tier_entries": engine.degradation_tier_entries,
         "tuning_cache": engine.summary()["tuning_cache"],
@@ -262,7 +289,7 @@ def _window_keys(snap):
 
 def run_prefix_bench(smoke: bool, n_requests: int, share_ways: int,
                      seed: int, backend: str, kv_dtype: str = "float32",
-                     tp: int = 1):
+                     tp: int = 1, weight_dtype: str = "float32"):
     """Same shared-prefix workload with prefix caching OFF then ON.  Each
     engine gets one untimed pass (compiles every program bucket and, for
     the cached engine, populates the pool) and one timed steady-state
@@ -293,7 +320,7 @@ def run_prefix_bench(smoke: bool, n_requests: int, share_ways: int,
     runs = {}
     for caching in (False, True):
         engine = LLMEngine(model, enable_prefix_caching=caching,
-                           kv_dtype=kv_dtype, tp=tp, **engine_kw)
+                           kv_dtype=kv_dtype, weight_dtype=weight_dtype, tp=tp, **engine_kw)
         engine.stats.enable_windows()
         rng = np.random.RandomState(seed)
         stream = _prefix_stream(rng, n_requests, share_ways,
@@ -357,7 +384,8 @@ def _spec_text_stream(rng, n_requests, vocab, max_len):
 
 
 def run_spec_bench(smoke: bool, n_requests: int, spec_k: int, seed: int,
-                   backend: str, kv_dtype: str = "float32", tp: int = 1):
+                   backend: str, kv_dtype: str = "float32", tp: int = 1,
+                   weight_dtype: str = "float32"):
     """Same repetitive-text workload with speculation OFF then ON.  Each
     engine gets one untimed pass (compiles every program bucket) and one
     timed pass; value is emitted tokens per wall second across the
@@ -404,7 +432,7 @@ def run_spec_bench(smoke: bool, n_requests: int, spec_k: int, seed: int,
             kw.update(drafter=NGramDrafter(max_ngram=6, min_ngram=1),
                       spec_k=spec_k, max_spec_k=spec_k,
                       spec_accept_floor=0.0)
-        engine = LLMEngine(model, kv_dtype=kv_dtype, tp=tp, **kw)
+        engine = LLMEngine(model, kv_dtype=kv_dtype, weight_dtype=weight_dtype, tp=tp, **kw)
         engine.stats.enable_windows()
         rng = np.random.RandomState(seed)
         stream = _spec_text_stream(rng, n_requests, cfg.vocab_size,
@@ -517,7 +545,8 @@ def _http_drive(port, stream, *, step_delay_s: float = 0.002):
 
 
 def run_http_bench(smoke: bool, n_requests: int, seed: int, backend: str,
-                   kv_dtype: str = "float32", tp: int = 1):
+                   kv_dtype: str = "float32", tp: int = 1,
+                   weight_dtype: str = "float32"):
     """The run_bench workload through the real HTTP frontend (SSE
     streaming clients over localhost) next to an engine-direct run of
     the identical stream.  Both engines get one untimed warm pass; value
@@ -551,7 +580,7 @@ def run_http_bench(smoke: bool, n_requests: int, seed: int, backend: str,
     # engine-direct reference: TWO warm passes (the first compiles the
     # cold-cache prefill buckets, the second compiles the chunked-resume
     # buckets that only exist once the prefix cache is hot), then timed
-    direct = LLMEngine(model, kv_dtype=kv_dtype, tp=tp, **engine_kw)
+    direct = LLMEngine(model, kv_dtype=kv_dtype, weight_dtype=weight_dtype, tp=tp, **engine_kw)
     direct.stats.enable_windows()
     _drive(direct, list(stream))
     _drive(direct, list(stream))
@@ -565,7 +594,7 @@ def run_http_bench(smoke: bool, n_requests: int, seed: int, backend: str,
     # can still hit a never-seen (tokens, batch) bucket and pay a
     # compile; the record carries timed_new_compiles so an inflated
     # TTFT tail is attributable.
-    served = LLMEngine(model, retain_outputs=False, kv_dtype=kv_dtype,
+    served = LLMEngine(model, retain_outputs=False, kv_dtype=kv_dtype, weight_dtype=weight_dtype,
                        tp=tp, **engine_kw)
     srv = serve_background(served, model_name="bench",
                            max_pending=4 * len(stream))
@@ -626,7 +655,8 @@ def run_http_bench(smoke: bool, n_requests: int, seed: int, backend: str,
 
 
 def run_slo_bench(smoke: bool, n_requests: int, seed: int, backend: str,
-                  kv_dtype: str = "float32", tp: int = 1):
+                  kv_dtype: str = "float32", tp: int = 1,
+                  weight_dtype: str = "float32"):
     """The SLO observatory exercised end to end: a mixed stream rides
     the real HTTP frontend while windowed telemetry, the flight
     recorder and an anomaly spool run, then the record is built FROM
@@ -660,7 +690,7 @@ def run_slo_bench(smoke: bool, n_requests: int, seed: int, backend: str,
     rng = np.random.RandomState(seed)
     stream = _request_stream(rng, n_requests, cfg.vocab_size,
                              engine_kw["max_model_len"])
-    engine = LLMEngine(model, retain_outputs=False, kv_dtype=kv_dtype,
+    engine = LLMEngine(model, retain_outputs=False, kv_dtype=kv_dtype, weight_dtype=weight_dtype,
                        tp=tp, **engine_kw)
     spool_dir = tempfile.mkdtemp(prefix="serve-bench-anomaly-")
     srv = serve_background(engine, model_name="bench",
@@ -725,7 +755,8 @@ def run_slo_bench(smoke: bool, n_requests: int, seed: int, backend: str,
 
 def run_router_bench(smoke: bool, n_requests: int, share_ways: int,
                      seed: int, backend: str, kv_dtype: str,
-                     replicas: int, tp: int = 1):
+                     replicas: int, tp: int = 1,
+                     weight_dtype: str = "float32"):
     """The shared-prefix workload over the HTTP frontend with
     ``replicas`` data-parallel engines behind the replica router.  The
     SAME stream runs once under random routing (the control: shared
@@ -772,7 +803,7 @@ def run_router_bench(smoke: bool, n_requests: int, share_ways: int,
                           engine_kw["max_model_len"])
 
     def make_engine():
-        return LLMEngine(model, retain_outputs=False, kv_dtype=kv_dtype,
+        return LLMEngine(model, retain_outputs=False, kv_dtype=kv_dtype, weight_dtype=weight_dtype,
                          enable_prefix_caching=True, tp=tp, **engine_kw)
 
     runs = {}
@@ -879,7 +910,7 @@ def _mixed_request_stream(rng, n_requests, vocab, max_len,
 
 def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str,
                     kv_dtype: str = "float32", tp: int = 1, tracer=None,
-                    overlap: str = "on"):
+                    overlap: str = "on", weight_dtype: str = "float32"):
     """The ISSUE's headline workload: long prefills, chunked resumes,
     plain decodes, and speculative verify rounds all riding the ONE
     ragged step program.  Reports throughput, the exact attention
@@ -921,7 +952,7 @@ def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str,
         return LLMEngine(model, enable_prefix_caching=True,
                          drafter=NGramDrafter(max_ngram=6, min_ngram=1),
                          spec_k=spec_k, max_spec_k=spec_k,
-                         spec_accept_floor=0.0, kv_dtype=kv_dtype, tp=tp,
+                         spec_accept_floor=0.0, kv_dtype=kv_dtype, weight_dtype=weight_dtype, tp=tp,
                          overlap=ov, **engine_kw)
 
     engine = _mk_engine(overlap != "off")
@@ -985,7 +1016,7 @@ def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str,
             # carries zero engine.device_inflight windows anywhere)
             return LLMEngine(model, retain_outputs=False,
                              enable_prefix_caching=True,
-                             kv_dtype=kv_dtype, tp=tp,
+                             kv_dtype=kv_dtype, weight_dtype=weight_dtype, tp=tp,
                              overlap=overlap != "off", **engine_kw)
 
         http_engine = _factory()
@@ -1042,7 +1073,8 @@ def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str,
 
 
 def run_chaos_bench(smoke: bool, n_requests: int, seed: int, backend: str,
-                    kv_dtype: str = "float32", tp: int = 1):
+                    kv_dtype: str = "float32", tp: int = 1,
+                    weight_dtype: str = "float32"):
     """Goodput under injected faults: the ragged request stream runs
     through the supervised EngineRunner while a seeded FaultPlan crashes
     a step, hangs a step past the watchdog deadline, poisons a logit
@@ -1077,7 +1109,7 @@ def run_chaos_bench(smoke: bool, n_requests: int, seed: int, backend: str,
     model = LlamaForCausalLM(cfg)
 
     def factory():
-        return LLMEngine(model, retain_outputs=False, kv_dtype=kv_dtype,
+        return LLMEngine(model, retain_outputs=False, kv_dtype=kv_dtype, weight_dtype=weight_dtype,
                          tp=tp, **engine_kw)
 
     # the full schedule from one seed: one crash (in-thread recovery),
@@ -1184,7 +1216,8 @@ def _drive_peak(engine, stream):
 
 
 def run_pressure_bench(smoke: bool, n_requests: int, seed: int,
-                       backend: str, kv_dtype: str, tp: int = 1):
+                       backend: str, kv_dtype: str, tp: int = 1,
+                       weight_dtype: str = "float32"):
     """Fixed-HBM A/B: the same burst stream runs on a float32 pool and
     a ``kv_dtype`` pool sized from the SAME byte budget, each with a
     DegradationController installed.  int8 pages are ~4x smaller, so
@@ -1213,6 +1246,7 @@ def run_pressure_bench(smoke: bool, n_requests: int, seed: int,
         nb = budget // (_page_bytes(cfg, engine_kw["block_size"], dt)
                         // tp)
         engine = LLMEngine(model, kv_dtype=dt, num_blocks=int(nb),
+                           weight_dtype=weight_dtype,
                            pressure=DegradationController(), tp=tp,
                            **engine_kw)
         engine.stats.enable_windows()
@@ -1264,9 +1298,123 @@ def run_pressure_bench(smoke: bool, n_requests: int, seed: int,
     }
 
 
+def run_weight_bench(smoke: bool, n_requests: int, seed: int,
+                     backend: str, weight_dtype: str,
+                     kv_dtype: str = "float32", tp: int = 1):
+    """--weight-pressure: fixed-HBM A/B between a float32 weight pool
+    and a ``--weight-dtype`` quantized one.  Both arms get the SAME
+    per-chip byte budget (the f32 weights plus 52 f32-era KV pages);
+    the bytes the quantized pool hands back buy extra KV pages, so the
+    record shows the residency headroom weight streaming creates at
+    matched silicon — plus the roofline-modeled decode cost of the
+    tuned ``quant_matmul`` kernel against the dense f32 XLA matmul at
+    a llama-sm projection shape."""
+    import numpy as np
+
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.inference.pressure import DegradationController
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.tune import cost
+    from paddle_tpu.tune.registry import candidate_configs, get_kernel
+
+    # --weight-dtype float32 still wants an A/B: default the quantized
+    # arm to int8 so the mode always measures something
+    wdt = weight_dtype if weight_dtype != "float32" else "int8"
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4,
+                           ffn=64, seq=256)
+    engine_kw = dict(max_num_seqs=16, block_size=8, max_model_len=256,
+                     max_prefill_tokens=128, prefill_token_bucket=64)
+    page = _page_bytes(cfg, engine_kw["block_size"], kv_dtype) // tp
+    model = LlamaForCausalLM(cfg)
+
+    # probe builds measure each arm's resident weight bytes; the f32
+    # number anchors the shared budget (weights + 52 f32-sized pages,
+    # binding PER CHIP like the KV pressure bench)
+    weight_bytes = {}
+    for dt in ("float32", wdt):
+        # 33 = one full max_model_len sequence + the manager's null block
+        probe = LLMEngine(model, num_blocks=33, kv_dtype=kv_dtype,
+                          weight_dtype=dt, tp=tp, **engine_kw)
+        weight_bytes[dt] = probe.weight_bytes_resident()
+    budget = weight_bytes["float32"] // tp \
+        + 52 * _page_bytes(cfg, engine_kw["block_size"], "float32") // tp
+
+    runs = {}
+    for dt in ("float32", wdt):
+        nb = max(33, (budget - weight_bytes[dt] // tp) // page)
+        engine = LLMEngine(model, kv_dtype=kv_dtype, weight_dtype=dt,
+                           num_blocks=int(nb),
+                           pressure=DegradationController(), tp=tp,
+                           **engine_kw)
+        engine.stats.enable_windows()
+        rng = np.random.RandomState(seed)
+        stream = _pressure_stream(rng, n_requests, cfg.vocab_size)
+        wall, peak_bytes = _drive_peak(engine, stream)
+        s = engine.stats.summary()
+        runs[dt] = {
+            "num_blocks": int(nb),
+            "weight_bytes_resident": engine.weight_bytes_resident(),
+            "peak_resident_seqs": engine.peak_resident_seqs,
+            "peak_kv_bytes_resident": int(peak_bytes),
+            "preempted": s["preemptions"],
+            "retired": s["retired"],
+            "wall_s": round(wall, 3),
+        }
+
+    # modeled decode cost of ONE llama-sm decoder layer's matmuls
+    # (4x qkv/o projections, gate+up, down): best tuned quant_matmul
+    # candidate per shape vs the one-program dense f32 XLA contraction
+    m = engine_kw["max_num_seqs"]
+    layer_shapes = [(512, 512)] * 4 + [(512, 1408)] * 2 + [(1408, 512)]
+    kern = get_kernel("quant_matmul")
+    quant_s = sum(
+        min(cost.estimate("quant_matmul",
+                          {"m": m, "k": k, "n": n, "dtype": wdt}, c)
+            for c in candidate_configs(kern))
+        for k, n in layer_shapes)
+    f32_s = sum(cost.f32_matmul_estimate(m, k, n)
+                for k, n in layer_shapes)
+
+    q, base = runs[wdt], runs["float32"]
+    return {
+        "metric": "serve_weight_resident_seqs",
+        "value": q["peak_resident_seqs"],
+        "unit": "seqs",
+        "backend": backend,
+        "weight_dtype": wdt,
+        "kv_dtype": kv_dtype,
+        "requests": n_requests,
+        "hbm_budget_bytes": int(budget),
+        "weight_bytes_resident": q["weight_bytes_resident"],
+        "baseline_weight_bytes_resident": base["weight_bytes_resident"],
+        "weight_compression_ratio": round(
+            base["weight_bytes_resident"] / q["weight_bytes_resident"], 3)
+        if q["weight_bytes_resident"] else 0.0,
+        "num_blocks": q["num_blocks"],
+        "baseline_num_blocks": base["num_blocks"],
+        "peak_resident_seqs": q["peak_resident_seqs"],
+        "baseline_peak_resident_seqs": base["peak_resident_seqs"],
+        "resident_ratio": round(q["peak_resident_seqs"]
+                                / base["peak_resident_seqs"], 3)
+        if base["peak_resident_seqs"] else 0.0,
+        "peak_kv_bytes_resident": q["peak_kv_bytes_resident"],
+        "baseline_peak_kv_bytes_resident": base["peak_kv_bytes_resident"],
+        "preempted": q["preempted"],
+        "baseline_preempted": base["preempted"],
+        "retired": q["retired"],
+        "baseline_retired": base["retired"],
+        "modeled_decode_layer_s": quant_s,
+        "modeled_f32_layer_s": f32_s,
+        "modeled_decode_cost_ratio": round(f32_s / quant_s, 3)
+        if quant_s else 0.0,
+        **_slo_keys(engine.stats.snapshot()),
+        **_window_keys(engine.stats.snapshot()),
+    }
+
+
 def run_window_bench(smoke: bool, n_requests: int, window_k: int,
                      seed: int, backend: str, kv_dtype: str = "float32",
-                     tp: int = 1):
+                     tp: int = 1, weight_dtype: str = "float32"):
     """--decode-window K: one steady pure-decode workload, A/B'd between
     the per-step engine (decode_window=1) and the device-resident
     K-step window engine — same prompts, same budgets, greedy, so the
@@ -1308,7 +1456,7 @@ def run_window_bench(smoke: bool, n_requests: int, window_k: int,
                for _ in range(n_rows)]
 
     def arm(k):
-        eng = LLMEngine(model, kv_dtype=kv_dtype, tp=tp,
+        eng = LLMEngine(model, kv_dtype=kv_dtype, weight_dtype=weight_dtype, tp=tp,
                         decode_window=k, **engine_kw)
         eng.stats.enable_windows()
         eng.add_request(prompts[0][:4], max_new_tokens=max(4, 2 * k))
@@ -1355,7 +1503,8 @@ def run_window_bench(smoke: bool, n_requests: int, window_k: int,
 
 
 def run_bench(smoke: bool, n_requests: int, seed: int, backend: str,
-              kv_dtype: str = "float32", tp: int = 1):
+              kv_dtype: str = "float32", tp: int = 1,
+              weight_dtype: str = "float32"):
     import numpy as np
 
     from paddle_tpu.inference import LLMEngine
@@ -1376,7 +1525,7 @@ def run_bench(smoke: bool, n_requests: int, seed: int, backend: str,
                          max_prefill_tokens=2048, prefill_token_bucket=256)
 
     model = LlamaForCausalLM(cfg)
-    engine = LLMEngine(model, kv_dtype=kv_dtype, tp=tp, **engine_kw)
+    engine = LLMEngine(model, kv_dtype=kv_dtype, weight_dtype=weight_dtype, tp=tp, **engine_kw)
     engine.stats.enable_windows()
     rng = np.random.RandomState(seed)
     stream = _request_stream(rng, n_requests, cfg.vocab_size,
@@ -1461,6 +1610,18 @@ def main(argv=None):
                          "float32 pool vs a --kv-dtype pool; report "
                          "resident sequences, preemptions and "
                          "degradation tier entries for both")
+    ap.add_argument("--weight-dtype", choices=("float32", "int8", "int4"),
+                    default="float32",
+                    help="weight-pool dtype for every engine the bench "
+                         "builds (int8/int4 = quantized pools + f32 "
+                         "scales, dequantized in the fused quant_matmul "
+                         "kernel)")
+    ap.add_argument("--weight-pressure", action="store_true",
+                    help="A/B a float32 weight pool vs a --weight-dtype "
+                         "quantized one under the SAME per-chip HBM "
+                         "budget (weights + pages); report resident "
+                         "weight bytes, the KV headroom they free, and "
+                         "the roofline-modeled decode matmul cost")
     ap.add_argument("--tp", type=int, default=1, metavar="N",
                     help="tensor-parallel shards for every engine the "
                          "bench builds (heads + KV pages split over an "
@@ -1511,6 +1672,10 @@ def main(argv=None):
                                              or backend == "cpu") else 16)
         record = {"metric": "serve_window_tokens_per_s", "value": 0.0,
                   "unit": "tok/s", "backend": backend}
+    elif args.weight_pressure:
+        n_requests = args.requests or 16
+        record = {"metric": "serve_weight_resident_seqs", "value": 0.0,
+                  "unit": "seqs", "backend": backend}
     elif args.memory_pressure:
         n_requests = args.requests or 16
         record = {"metric": "serve_pressure_resident_seqs", "value": 0.0,
@@ -1552,6 +1717,7 @@ def main(argv=None):
                   "unit": "tok/s", "backend": backend}
     record["tp"] = args.tp
     record["replicas"] = args.replicas
+    record["weight_dtype"] = args.weight_dtype
     if probe_err:
         record["backend_note"] = f"cpu fallback: {probe_err}"
     tracer = None
@@ -1563,50 +1729,62 @@ def main(argv=None):
             record["trace_note"] = "--trace records the --mixed workload"
     try:
         if args.http and args.replicas > 1:
-            record.update(run_router_bench(args.smoke, n_requests,
-                                           args.prefix_share or 4,
-                                           args.seed, backend,
-                                           args.kv_dtype, args.replicas,
-                                           args.tp))
+            record.update(run_router_bench(
+                args.smoke, n_requests, args.prefix_share or 4,
+                args.seed, backend, args.kv_dtype, args.replicas,
+                args.tp, weight_dtype=args.weight_dtype))
         elif args.decode_window:
-            record.update(run_window_bench(args.smoke, n_requests,
-                                           args.decode_window, args.seed,
-                                           backend, args.kv_dtype,
-                                           args.tp))
+            record.update(run_window_bench(
+                args.smoke, n_requests, args.decode_window, args.seed,
+                backend, args.kv_dtype, args.tp,
+                weight_dtype=args.weight_dtype))
+        elif args.weight_pressure:
+            record.update(run_weight_bench(args.smoke, n_requests,
+                                           args.seed, backend,
+                                           args.weight_dtype,
+                                           kv_dtype=args.kv_dtype,
+                                           tp=args.tp))
         elif args.memory_pressure:
-            record.update(run_pressure_bench(args.smoke, n_requests,
-                                             args.seed, backend,
-                                             args.kv_dtype, args.tp))
+            record.update(run_pressure_bench(
+                args.smoke, n_requests, args.seed, backend,
+                args.kv_dtype, args.tp,
+                weight_dtype=args.weight_dtype))
         elif args.chaos:
-            record.update(run_chaos_bench(args.smoke, n_requests, args.seed,
-                                          backend, args.kv_dtype, args.tp))
+            record.update(run_chaos_bench(
+                args.smoke, n_requests, args.seed, backend,
+                args.kv_dtype, args.tp, weight_dtype=args.weight_dtype))
         elif args.mixed:
-            record.update(run_mixed_bench(args.smoke, n_requests, args.seed,
-                                          backend, args.kv_dtype, args.tp,
-                                          tracer=tracer,
-                                          overlap=args.overlap))
+            record.update(run_mixed_bench(
+                args.smoke, n_requests, args.seed, backend,
+                args.kv_dtype, args.tp, tracer=tracer,
+                overlap=args.overlap,
+                weight_dtype=args.weight_dtype))
         elif args.slo:
-            record.update(run_slo_bench(args.smoke, n_requests, args.seed,
-                                        backend, args.kv_dtype, args.tp))
+            record.update(run_slo_bench(
+                args.smoke, n_requests, args.seed, backend,
+                args.kv_dtype, args.tp, weight_dtype=args.weight_dtype))
         elif args.http:
-            record.update(run_http_bench(args.smoke, n_requests, args.seed,
-                                         backend, args.kv_dtype, args.tp))
+            record.update(run_http_bench(
+                args.smoke, n_requests, args.seed, backend,
+                args.kv_dtype, args.tp, weight_dtype=args.weight_dtype))
         elif args.spec:
-            record.update(run_spec_bench(args.smoke, n_requests, args.spec,
-                                         args.seed, backend,
-                                         args.kv_dtype, args.tp))
+            record.update(run_spec_bench(
+                args.smoke, n_requests, args.spec, args.seed, backend,
+                args.kv_dtype, args.tp, weight_dtype=args.weight_dtype))
         elif args.prefix_share:
-            record.update(run_prefix_bench(args.smoke, n_requests,
-                                           args.prefix_share, args.seed,
-                                           backend, args.kv_dtype,
-                                           args.tp))
+            record.update(run_prefix_bench(
+                args.smoke, n_requests, args.prefix_share, args.seed,
+                backend, args.kv_dtype, args.tp,
+                weight_dtype=args.weight_dtype))
         else:
-            record.update(run_bench(args.smoke, n_requests, args.seed,
-                                    backend, args.kv_dtype, args.tp))
+            record.update(run_bench(
+                args.smoke, n_requests, args.seed, backend,
+                args.kv_dtype, args.tp, weight_dtype=args.weight_dtype))
         if probe_err:
             record["backend_note"] = f"cpu fallback: {probe_err}"
         record["tp"] = args.tp
         record["replicas"] = args.replicas
+        record["weight_dtype"] = args.weight_dtype
     except Exception as e:  # the line must still print
         record["error"] = f"{type(e).__name__}: {e}"
     if tracer is not None:
