@@ -41,6 +41,8 @@ __all__ = [
     "filter_baseline", "findings_to_json", "format_text", "mode",
     "enforce", "enforce_import", "default_baseline_path",
     "audit_engine", "audit_captured_step", "audit_specs",
+    "race_lint_file", "race_lint_paths", "race_lint_source",
+    "default_race_paths",
 ]
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -60,6 +62,11 @@ def __getattr__(name):
     if name in ("audit_engine", "audit_captured_step", "audit_specs"):
         from . import serving_audit
         return getattr(serving_audit, name)
+    # race front end: stdlib-only; lazy so plain imports stay minimal
+    if name in ("race_lint_file", "race_lint_paths", "race_lint_source",
+                "default_race_paths"):
+        from . import race_rules
+        return getattr(race_rules, name)
     raise AttributeError(name)
 
 

@@ -156,6 +156,28 @@ RULES = {
         "materialization inside the body's call graph forces one sync "
         "per iteration, quietly turning the K-step on-device window "
         "back into per-token round trips")),
+    # race front end (race_rules.py): thread-role + lock-discipline
+    "unguarded-shared-state": (ERROR, "race", (
+        "an attribute written under a lock in one thread role is "
+        "read/written lock-free in another — the class established a "
+        "guard discipline for the attr and this access breaks it; take "
+        "the lock, or annotate the method `# guarded-by: <attr>` when "
+        "the caller provably holds it (validated at runtime under "
+        "PT_ANALYSIS=strict by analysis.lock_check)")),
+    "non-atomic-shared-rmw": (WARNING, "race", (
+        "`self.x += 1`-style read-modify-write, lock-free, on an "
+        "attribute multiple thread roles touch — the statement is a "
+        "load, an op and a store; two racing roles lose an update")),
+    "callback-under-lock": (WARNING, "race", (
+        "a user callback (deliver/on_*/callback/hook) invoked while a "
+        "lock is held — the callback can block or re-enter the class "
+        "(classic deadlock seed); deliver outside the lock or suppress "
+        "with the invariant that makes the hold load-bearing")),
+    "blocking-call-in-event-loop": (WARNING, "race", (
+        "a blocking call (bare .join(), queue .get(), time.sleep, "
+        "lock .acquire(), engine .step()) reachable from asyncio-role "
+        "code — it stalls the whole event loop (every connection), not "
+        "one request; use the async equivalent or run_in_executor")),
 }
 
 

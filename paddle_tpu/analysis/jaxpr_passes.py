@@ -190,23 +190,53 @@ def _dtype_pass(spec, jaxpr, findings):
                 f" bytes)", trail=_trail(eqn)))
 
 
-def _dead_pass(spec, jaxpr, invar_info, findings):
-    jx = jaxpr.jaxpr
-    live = {id(v) for v in jx.outvars
-            if not isinstance(v, jax.core.Literal)}
-    for eqn in reversed(jx.eqns):
-        outs = {id(v) for v in eqn.outvars}
-        if outs & live:
+def _sweep_dead(eqns, live):
+    """Backward liveness over one equation list; returns (dead eqns in
+    program order, live variable ids grown to cover every read)."""
+    dead = []
+    for eqn in reversed(eqns):
+        if {id(v) for v in eqn.outvars} & live:
             for v in eqn.invars:
                 if not isinstance(v, jax.core.Literal):
                     live.add(id(v))
         else:
+            dead.append(eqn)
+    dead.reverse()
+    return dead, live
+
+
+def _dead_pass(spec, jaxpr, invar_info, findings):
+    jx = jaxpr.jaxpr
+
+    def sweep(body, live, where):
+        dead, live = _sweep_dead(body.eqns, live)
+        for eqn in dead:
             findings.append(Finding(
                 "dead-code", rule_severity("dead-code"),
                 _eqn_loc(spec.name, eqn),
                 f"`{eqn.primitive.name}` result never reaches an output "
-                f"of {spec.name!r} (dead computation)",
+                f"of {where} (dead computation)",
                 trail=_trail(eqn)))
+        # recurse into the bodies of LIVE structured equations: an
+        # equation dead inside a scan/while/cond body wastes FLOPs every
+        # ITERATION even though the loop itself is live.  All sub-jaxpr
+        # outvars count as live (which outputs the outer primitive
+        # consumes is primitive-specific; conservative beats wrong), and
+        # dead equations' bodies are skipped — the outer report covers
+        # them.
+        dead_ids = {id(e) for e in dead}
+        for eqn in body.eqns:
+            if id(eqn) in dead_ids:
+                continue
+            for sub in _sub_jaxprs(eqn):
+                sub_live = {id(v) for v in sub.outvars
+                            if not isinstance(v, jax.core.Literal)}
+                sweep(sub, sub_live,
+                      f"the `{eqn.primitive.name}` body in {spec.name!r}")
+        return live
+
+    live = sweep(jx, {id(v) for v in jx.outvars
+                      if not isinstance(v, jax.core.Literal)}, repr(spec.name))
     outvar_ids = {id(v) for v in jx.outvars}
     for v, (argnum, path, _) in zip(jx.invars, invar_info):
         if id(v) not in live and id(v) not in outvar_ids:

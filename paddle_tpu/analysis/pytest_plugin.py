@@ -6,9 +6,11 @@ pins JAX_PLATFORMS=cpu, and this repo's environment hangs if anything
 touches jax before that pin.  This module only needs the AST front end,
 which is stdlib-only — the lint itself never imports jax.
 
-Behavior: at session start, AST-lint the ``paddle_tpu`` tree; subtract
-the committed baseline; report survivors in the terminal summary; and
-if any ERROR-severity finding survives, flip the session exit status so
+Behavior: at session start, AST-lint the ``paddle_tpu`` tree and
+race-lint the host serving tiers (inference + profiler — the
+thread-role/lock-discipline front end, also stdlib-only); subtract the
+committed baseline; report survivors in the terminal summary; and if
+any ERROR-severity finding survives, flip the session exit status so
 tier-1 fails — no workflow changes needed.  Disable with
 ``PT_ANALYSIS_PLUGIN=0`` (e.g. while iterating on a known-dirty tree).
 """
@@ -39,6 +41,9 @@ class GraftLintPlugin:
     def pytest_sessionstart(self, session):
         try:
             findings = lint_paths(self.paths, root=_REPO_ROOT)
+            from .race_rules import default_race_paths, race_lint_paths
+            findings += race_lint_paths(default_race_paths(_REPO_ROOT),
+                                        root=_REPO_ROOT)
         except Exception as e:                      # never break collection
             import warnings
             warnings.warn(f"graft-lint plugin failed to lint: {e!r}")

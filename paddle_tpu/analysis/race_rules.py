@@ -1,0 +1,642 @@
+"""Thread-role & lock-discipline front end: concurrency lint for the
+host serving stack.
+
+The jaxpr passes audit what XLA is handed; ``ast_rules`` audits what the
+tracer executes; this pass audits the code BETWEEN the two — the
+multi-threaded host tier that grew around the engine: the stepping
+thread + watchdog (``frontend/runner.py``), the asyncio HTTP frontend
+bridged over ``call_soon_threadsafe`` (``frontend/app.py``), the replica
+router's outstanding-token ledger (``frontend/router.py``), and the
+telemetry objects mutated from both the engine and HTTP tiers
+(``profiler/serving.py``, ``profiler/slo.py``, ``inference/flight.py``).
+Pure stdlib, same ``Finding`` model, same baseline/suppression rails.
+
+Two analyses compose per file:
+
+**Thread roles.**  A by-name call-graph fixpoint (the same resolution
+machinery ``ast_rules`` uses for its compiled set) seeded from the
+places a thread of control demonstrably enters the file:
+
+  - ``threading.Thread(target=f, name="llm-engine")`` / executor
+    ``.submit(f, ...)`` sites: ``f`` runs under a role named after the
+    thread (the literal ``name=`` when present, else ``thread:f``);
+  - ``async def`` defs and callbacks handed to
+    ``call_soon_threadsafe``/``call_soon``: role ``asyncio``;
+  - a module-level ``def main``: role ``main`` (the CLI);
+  - defs passed as ``on_*=``/``deliver=``/``callback=`` arguments:
+    role ``callback`` (they run on whichever thread fires the event);
+  - public methods of a SHARED class (one that owns a
+    ``threading.Lock``/``RLock``/``Condition``, spawns a thread, or
+    carries a ``# guarded-by:`` annotation): role ``api`` — the
+    any-caller-thread surface, treated as concurrent with everything
+    including itself;
+  - an explicit ``# thread-role: name`` comment on a ``def`` line.
+
+Roles close over calls by bare name and ``self.<method>()``, so every
+method resolves to the set of roles that can reach it.  A def no role
+reaches is invisible to the conflict rules (single-threaded by
+evidence).
+
+**Lock discipline.**  Within each shared class, every ``self.<attr>``
+access is tagged with the set of locks lexically held around it
+(``with self._lock:`` regions, where a lock attribute is one assigned a
+``Lock()``/``RLock()``/``Condition()`` in the class or named lock-like)
+— plus the locks a ``# guarded-by: <attr>`` annotation on the enclosing
+``def`` declares the CALLER holds (``analysis/lock_check.py`` verifies
+that claim at runtime under ``PT_ANALYSIS=strict``).  ``__init__``
+accesses are exempt (construction happens-before thread start: the
+``Thread.start()`` fence publishes them).
+
+Rules:
+
+  unguarded-shared-state (ERROR)    an attribute written under a lock
+      somewhere is read/written WITHOUT that lock from a method whose
+      roles make concurrent access possible — the class established a
+      guard discipline for the attr and this access breaks it.
+  non-atomic-shared-rmw (WARNING)   ``self.x += 1``-style
+      read-modify-write, lock-free, on an attribute multiple roles
+      touch — two racing increments lose one under any interpreter
+      that drops the GIL between the read and the write.
+  callback-under-lock (WARNING)     a user callback (``deliver``/
+      ``on_*``/``callback``/``cb``/``hook``-named callable) invoked
+      while a lock is held — the classic lock-inversion/deadlock seed:
+      the callback can re-enter the class or block on another lock.
+  blocking-call-in-event-loop (WARNING)  a blocking call — bare
+      ``.join()``, ``queue.get()``, ``time.sleep``, ``lock.acquire()``,
+      ``engine.step()`` — reachable from ``asyncio``-role code: it
+      stalls every connection the event loop serves, not one request.
+
+Suppression and baselines work exactly as for the AST front end:
+``# graftlint: disable=rule`` / ``disable-next=`` inline, fingerprints
+in ``tools/analysis/graftlint_baseline.json``.  The CLI runs this pass
+under ``--races`` (default scope: the inference + profiler tiers).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .ast_rules import _FileCtx, _dotted, _walk_own, collect_py_files
+from .findings import Finding, Location, rule_severity
+
+__all__ = ["race_lint_source", "race_lint_file", "race_lint_paths",
+           "default_race_paths"]
+
+ROLE_API = "api"                 # any-caller-thread public surface
+ROLE_ASYNC = "asyncio"
+ROLE_CALLBACK = "callback"
+ROLE_MAIN = "main"
+
+# with self.<X>: counts as a lock region when X was assigned one of
+# these constructors anywhere in the class, or is named lock-like
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_LOCKISH_NAME = re.compile(r"lock|mutex|cond", re.IGNORECASE)
+
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([\w]+(?:\s*,\s*[\w]+)*)")
+_THREAD_ROLE_RE = re.compile(r"#\s*thread-role:\s*([\w\-]+)")
+
+# callables whose invocation under a lock is a deadlock seed: names the
+# codebase gives to USER-supplied callbacks (not internal helpers)
+_CALLBACK_NAME = re.compile(r"^(deliver|callback|cb|hook|on_[a-z0-9_]+)$")
+_CALLBACK_KWARG = re.compile(r"^(deliver|callback|cb|hook|on_[a-z0-9_]+)$")
+
+# container-mutating method names: a call through self.<attr>.<m>(...)
+# writes the attr for discipline purposes
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
+             "add", "remove", "discard", "pop", "popleft", "popitem",
+             "clear", "update", "setdefault", "sort", "reverse",
+             "put", "put_nowait"}
+
+# engine-ish / queue-ish receiver names for the event-loop rule
+_ENGINE_NAME = re.compile(r"(^|_)(eng|engine)s?$|engine", re.IGNORECASE)
+_QUEUE_NAME = re.compile(r"(^|_)(q|queue|inbox|outbox)s?$|queue",
+                         re.IGNORECASE)
+
+
+def default_race_paths(repo_root: str) -> list:
+    """The host serving stack the race pass audits by default."""
+    return [os.path.join(repo_root, "paddle_tpu", "inference"),
+            os.path.join(repo_root, "paddle_tpu", "profiler")]
+
+
+# ---------------------------------------------------------------------------
+# per-file context
+# ---------------------------------------------------------------------------
+
+class _RaceCtx:
+    """File-level view: defs, classes, lock attrs, annotations, roles."""
+
+    def __init__(self, ctx: _FileCtx):
+        self.ctx = ctx
+        self.classes = [n for n in ast.walk(ctx.tree)
+                        if isinstance(n, ast.ClassDef)]
+        # def node -> enclosing ClassDef (innermost), or None
+        self.def_class = {}
+        for cls in self.classes:
+            for node in ast.walk(cls):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.def_class.setdefault(node, cls)
+        # per-class lock attribute names
+        self.lock_attrs = {cls: self._find_lock_attrs(cls)
+                           for cls in self.classes}
+        # defs carrying a "# guarded-by: X[, Y]" annotation (on the def
+        # line or the line directly above it)
+        self.guarded_by = {}
+        for d in ctx.defs:
+            locks = self._def_annotation(d, _GUARDED_BY_RE)
+            if locks:
+                self.guarded_by[d] = {x.strip() for x in locks.split(",")}
+        self.thread_role = {}
+        for d in ctx.defs:
+            role = self._def_annotation(d, _THREAD_ROLE_RE)
+            if role:
+                self.thread_role[d] = role.strip()
+
+    def _def_annotation(self, d, rx):
+        for ln in (d.lineno, d.lineno - 1):
+            if 1 <= ln <= len(self.ctx.lines):
+                m = rx.search(self.ctx.lines[ln - 1])
+                if m:
+                    return m.group(1)
+        return None
+
+    @staticmethod
+    def _find_lock_attrs(cls) -> set:
+        attrs = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                dd = _dotted(node.value.func) or ()
+                if dd and dd[-1] in _LOCK_CTORS:
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            attrs.add(t.attr)
+        return attrs
+
+    def is_lock_attr(self, cls, name: str) -> bool:
+        if name in self.lock_attrs.get(cls, ()):
+            return True
+        return bool(_LOCKISH_NAME.search(name))
+
+    def spawns_thread(self, cls) -> bool:
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Call):
+                dd = _dotted(node.func) or ()
+                if dd[-1:] == ("Thread",):
+                    return True
+        return False
+
+    def has_async(self, cls) -> bool:
+        return any(isinstance(n, ast.AsyncFunctionDef)
+                   for n in ast.walk(cls))
+
+    def is_shared(self, cls) -> bool:
+        """A class evidently used across threads: owns a lock, spawns a
+        thread, or a method claims a caller-held lock."""
+        return bool(self.lock_attrs.get(cls)) \
+            or self.spawns_thread(cls) \
+            or self.has_async(cls) \
+            or any(self.def_class.get(d) is cls for d in self.guarded_by)
+
+
+# ---------------------------------------------------------------------------
+# role inference
+# ---------------------------------------------------------------------------
+
+def _callable_defs(rc: _RaceCtx, node):
+    """Defs a callable-expression argument can refer to: a bare Name or
+    ``self.method``, resolved by name (the ast_rules convention)."""
+    ctx = rc.ctx
+    if isinstance(node, ast.Name):
+        return list(ctx.by_name.get(node.id, ()))
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return list(ctx.by_name.get(node.attr, ()))
+    return []
+
+
+def _thread_role_name(call, target_defs) -> str:
+    for kw in call.keywords:
+        if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    if target_defs:
+        return f"thread:{target_defs[0].name}"
+    return "thread:?"
+
+
+def _seed_roles(rc: _RaceCtx) -> dict:
+    """def node -> set of seeded role names."""
+    ctx = rc.ctx
+    roles: dict = {d: set() for d in ctx.defs}
+
+    def add(defs, role):
+        for d in defs:
+            roles.setdefault(d, set()).add(role)
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if isinstance(node, ast.AsyncFunctionDef):
+                roles.setdefault(node, set()).add(ROLE_ASYNC)
+            if node in rc.thread_role:
+                roles.setdefault(node, set()).add(rc.thread_role[node])
+            if isinstance(node, ast.FunctionDef) and node.name == "main" \
+                    and not any(isinstance(a, (ast.FunctionDef,
+                                               ast.AsyncFunctionDef,
+                                               ast.ClassDef))
+                                for a in ctx.ancestors(node)):
+                roles.setdefault(node, set()).add(ROLE_MAIN)
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        dd = _dotted(node.func) or ()
+        if dd[-1:] == ("Thread",):
+            target = next((kw.value for kw in node.keywords
+                           if kw.arg == "target"), None)
+            if target is not None:
+                defs = _callable_defs(rc, target)
+                add(defs, _thread_role_name(node, defs))
+        elif dd[-1:] == ("submit",) and len(dd) >= 2 and node.args:
+            # executor.submit(f, ...) — but NOT runner.submit(prompt,...):
+            # only seed when the first argument resolves to a local def
+            add(_callable_defs(rc, node.args[0]), "thread:pool")
+        elif dd[-1:] in (("call_soon_threadsafe",), ("call_soon",)) \
+                and node.args:
+            add(_callable_defs(rc, node.args[0]), ROLE_ASYNC)
+        else:
+            # defs handed off as callback kwargs run on the event
+            # source's thread — a role of their own
+            for kw in node.keywords:
+                if kw.arg and _CALLBACK_KWARG.match(kw.arg):
+                    add(_callable_defs(rc, kw.value), ROLE_CALLBACK)
+
+    # public surface of shared classes: any caller thread.  Dunders are
+    # public too (len()/iteration run on whichever thread calls them) —
+    # except construction-time ones, which happen-before sharing.
+    construction = {"__init__", "__post_init__", "__new__",
+                    "__init_subclass__", "__set_name__", "__del__"}
+    for cls in rc.classes:
+        if not rc.is_shared(cls):
+            continue
+        for stmt in cls.body:
+            if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            name = stmt.name
+            public = (not name.startswith("_")
+                      or (name.startswith("__") and name.endswith("__")
+                          and name not in construction))
+            if public:
+                roles.setdefault(stmt, set()).add(ROLE_API)
+    return roles
+
+
+def _close_roles(rc: _RaceCtx, roles: dict) -> dict:
+    """Propagate roles along bare-name and self-method call edges."""
+    ctx = rc.ctx
+    changed = True
+    while changed:
+        changed = False
+        for d in ctx.defs:
+            src = roles.get(d)
+            if not src:
+                continue
+            for node in _walk_own(d):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = None
+                if isinstance(node.func, ast.Name):
+                    callee = node.func.id
+                elif isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id == "self":
+                    callee = node.func.attr
+                if callee is None:
+                    continue
+                for cd in ctx.by_name.get(callee, ()):
+                    have = roles.setdefault(cd, set())
+                    if not src <= have:
+                        have.update(src)
+                        changed = True
+    return roles
+
+
+def _concurrent(role_set: set) -> bool:
+    """Can two threads be inside this role set at once?  Two distinct
+    roles are two threads; the ``api`` surface alone already admits
+    concurrent callers."""
+    return len(role_set) >= 2 or ROLE_API in role_set
+
+
+# ---------------------------------------------------------------------------
+# lock regions + attribute accesses
+# ---------------------------------------------------------------------------
+
+def _held_locks(rc: _RaceCtx, d, cls) -> dict:
+    """id(node) -> frozenset of lock attr names lexically held there,
+    for every node in ``d``'s own body (nested defs inherit the
+    enclosing region's holds only via their own visit)."""
+    base = frozenset(rc.guarded_by.get(d, ()))
+    held: dict = {}
+
+    def walk(node, locks):
+        held[id(node)] = locks
+        if isinstance(node, ast.With):
+            got = set()
+            for item in node.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Attribute) \
+                        and isinstance(expr.value, ast.Name) \
+                        and expr.value.id == "self" \
+                        and rc.is_lock_attr(cls, expr.attr):
+                    got.add(expr.attr)
+            inner = locks | frozenset(got)
+            for item in node.items:
+                walk(item.context_expr, locks)
+            for child in node.body:
+                walk(child, inner)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            walk(child, locks)
+
+    for child in ast.iter_child_nodes(d):
+        walk(child, base)
+    return held
+
+
+class _Access:
+    __slots__ = ("attr", "write", "rmw", "locks", "roles", "node",
+                 "method", "init")
+
+    def __init__(self, attr, write, rmw, locks, roles, node, method,
+                 init):
+        self.attr = attr
+        self.write = write
+        self.rmw = rmw
+        self.locks = locks
+        self.roles = roles
+        self.node = node
+        self.method = method
+        self.init = init
+
+
+def _is_self_attr(node):
+    return isinstance(node, ast.Attribute) \
+        and isinstance(node.value, ast.Name) and node.value.id == "self"
+
+
+def _collect_accesses(rc: _RaceCtx, cls, roles: dict) -> list:
+    """Every ``self.<attr>`` access in ``cls``'s methods, tagged with
+    held locks and the method's role set."""
+    accesses = []
+    methods = [d for d in rc.ctx.defs if rc.def_class.get(d) is cls]
+    for d in methods:
+        init = d.name in ("__init__", "__post_init__", "__init_subclass__")
+        droles = frozenset(roles.get(d, ()))
+        held = _held_locks(rc, d, cls)
+
+        def note(attr, write, rmw, node):
+            if rc.is_lock_attr(cls, attr):
+                return
+            accesses.append(_Access(
+                attr, write, rmw, held.get(id(node), frozenset()),
+                droles, node, d, init))
+
+        for node in _walk_own(d):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    for e in (t.elts if isinstance(t, ast.Tuple) else [t]):
+                        if _is_self_attr(e):
+                            note(e.attr, True, False, node)
+                        elif isinstance(e, ast.Subscript) \
+                                and _is_self_attr(e.value):
+                            note(e.value.attr, True, False, node)
+            elif isinstance(node, ast.AugAssign):
+                t = node.target
+                if _is_self_attr(t):
+                    note(t.attr, True, True, node)
+                elif isinstance(t, ast.Subscript) and _is_self_attr(t.value):
+                    note(t.value.attr, True, True, node)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) \
+                            and _is_self_attr(t.value):
+                        note(t.value.attr, True, False, node)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and _is_self_attr(node.func.value) \
+                    and node.func.attr in _MUTATORS:
+                note(node.func.value.attr, True, False, node)
+            elif _is_self_attr(node) and isinstance(node.ctx, ast.Load):
+                note(node.attr, False, False, node)
+    return accesses
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+def _lint_class(rc: _RaceCtx, cls, roles: dict, emit) -> None:
+    accesses = [a for a in _collect_accesses(rc, cls, roles) if not a.init]
+    by_attr: dict = {}
+    for a in accesses:
+        by_attr.setdefault(a.attr, []).append(a)
+
+    flagged = set()                       # nodes already carrying an ERROR
+    for attr, accs in sorted(by_attr.items()):
+        guards = set()
+        guard_roles = set()
+        for a in accs:
+            if a.write and a.locks:
+                guards.update(a.locks)
+                guard_roles.update(a.roles)
+        role_union = set()
+        for a in accs:
+            role_union.update(a.roles)
+        if guards and _concurrent(role_union):
+            for a in accs:
+                if a.locks & guards or not a.roles:
+                    continue
+                kind = "written" if a.write else "read"
+                emit("unguarded-shared-state", a.node,
+                     f"`self.{attr}` is {kind} lock-free in "
+                     f"`{rc.ctx.qualname(a.method)}` "
+                     f"(roles: {_fmt(a.roles)}) but written under "
+                     f"`self.{sorted(guards)[0]}` elsewhere "
+                     f"(roles: {_fmt(guard_roles)}) — either take the "
+                     f"lock here, or annotate the method "
+                     f"`# guarded-by: {sorted(guards)[0]}` if the "
+                     f"caller provably holds it")
+                flagged.add(id(a.node))
+        # lock-free RMW on an attr multiple roles touch
+        if _concurrent(role_union):
+            for a in accs:
+                if a.rmw and not a.locks and id(a.node) not in flagged:
+                    emit("non-atomic-shared-rmw", a.node,
+                         f"lock-free read-modify-write of `self.{attr}` "
+                         f"in `{rc.ctx.qualname(a.method)}` (roles: "
+                         f"{_fmt(a.roles)}) — `+=` is a load, an add and "
+                         f"a store; racing roles lose updates")
+
+
+def _fmt(roles) -> str:
+    return "/".join(sorted(roles)) if roles else "?"
+
+
+def _lint_callbacks_under_lock(rc: _RaceCtx, cls, emit) -> None:
+    methods = [d for d in rc.ctx.defs if rc.def_class.get(d) is cls]
+    for d in methods:
+        held = _held_locks(rc, d, cls)
+        for node in _walk_own(d):
+            if not isinstance(node, ast.Call):
+                continue
+            locks = held.get(id(node), frozenset())
+            if not locks:
+                continue
+            name = None
+            if isinstance(node.func, ast.Attribute):
+                name = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                name = node.func.id
+            if name and _CALLBACK_NAME.match(name):
+                emit("callback-under-lock", node,
+                     f"user callback `{name}(...)` invoked while holding "
+                     f"`self.{sorted(locks)[0]}` in "
+                     f"`{rc.ctx.qualname(d)}` — the callback can block "
+                     f"or re-enter this class (deadlock seed); deliver "
+                     f"outside the lock or document why the hold is "
+                     f"load-bearing")
+
+
+_DEFER_FNS = {"ensure_future", "create_task", "wait_for", "to_thread",
+              "run_in_executor", "run_coroutine_threadsafe"}
+
+
+def _deferred_or_awaited(rc: _RaceCtx, d, node) -> bool:
+    """True when ``node`` does not actually block the loop: it is inside
+    a lambda (deferred — typically handed to run_in_executor), directly
+    awaited (so a same-named asyncio API: ``asyncio.Queue.get`` returns
+    a coroutine), or an argument to ensure_future/create_task/..."""
+    prev = node
+    for anc in rc.ctx.ancestors(node):
+        if isinstance(anc, ast.Lambda):
+            return True
+        if isinstance(anc, ast.Await) and prev is node:
+            return True
+        if isinstance(anc, ast.Call) and prev in anc.args:
+            dd = _dotted(anc.func) or ()
+            if dd and dd[-1] in _DEFER_FNS:
+                return True
+        if anc is d:
+            break
+        prev = anc
+    return False
+
+
+def _lint_event_loop_blocking(rc: _RaceCtx, roles: dict, emit) -> None:
+    for d in rc.ctx.defs:
+        if ROLE_ASYNC not in roles.get(d, ()):
+            continue
+        for node in _walk_own(d):
+            if not isinstance(node, ast.Call):
+                continue
+            how = _blocking_call(node)
+            if how is not None and not _deferred_or_awaited(rc, d, node):
+                emit("blocking-call-in-event-loop", node,
+                     f"blocking `{how}` reachable from asyncio-role "
+                     f"`{rc.ctx.qualname(d)}` — it stalls the whole "
+                     f"event loop (every connection), not one request; "
+                     f"use the async equivalent or "
+                     f"run_in_executor/to_thread")
+
+
+def _blocking_call(node) -> str | None:
+    dd = _dotted(node.func) or ()
+    if dd == ("time", "sleep"):
+        return "time.sleep()"
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    attr = node.func.attr
+    recv = node.func.value
+    recv_name = recv.attr if isinstance(recv, ast.Attribute) else (
+        recv.id if isinstance(recv, ast.Name) else None)
+    # bare .join() — str.join always takes an argument, a thread join
+    # takes none (or timeout=)
+    if attr == "join" and not node.args:
+        return f"{recv_name or '<expr>'}.join()"
+    if attr == "get" and recv_name and _QUEUE_NAME.search(recv_name):
+        return f"{recv_name}.get()"
+    if attr == "acquire" and recv_name \
+            and _LOCKISH_NAME.search(recv_name) \
+            and not any(kw.arg == "blocking" for kw in node.keywords) \
+            and not (node.args
+                     and isinstance(node.args[0], ast.Constant)
+                     and node.args[0].value is False):
+        return f"{recv_name}.acquire()"
+    if attr == "step" and recv_name and _ENGINE_NAME.search(recv_name):
+        return f"{recv_name}.step()"
+    if attr in ("drain", "close") and recv_name \
+            and recv_name in ("runner", "router"):
+        return f"{recv_name}.{attr}()"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# entry points (mirror ast_rules' lint_source/lint_file/lint_paths)
+# ---------------------------------------------------------------------------
+
+_SKIP_RE = re.compile(r"#\s*graftlint:\s*skip-file")
+
+
+def race_lint_source(text: str, path: str = "<string>") -> list:
+    if _SKIP_RE.search("\n".join(text.splitlines()[:5])):
+        return []
+    ctx = _FileCtx(path, text)
+    rc = _RaceCtx(ctx)
+    roles = _close_roles(rc, _seed_roles(rc))
+    findings = []
+
+    def emit(rule, node, message):
+        if ctx.suppressed(rule, node):
+            return
+        fn = ""
+        for anc in [node] + list(ctx.ancestors(node)):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = ctx.qualname(anc)
+                break
+        findings.append(Finding(
+            rule, rule_severity(rule),
+            Location(path, getattr(node, "lineno", 0), fn), message))
+
+    for cls in rc.classes:
+        if not rc.is_shared(cls):
+            continue
+        _lint_class(rc, cls, roles, emit)
+        _lint_callbacks_under_lock(rc, cls, emit)
+    _lint_event_loop_blocking(rc, roles, emit)
+    return findings
+
+
+def race_lint_file(path: str, root: str | None = None) -> list:
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    rel = os.path.relpath(path, root) if root else path
+    try:
+        return race_lint_source(text, rel)
+    except SyntaxError as e:
+        from .findings import ERROR
+        return [Finding("parse", ERROR, Location(rel, e.lineno or 0, ""),
+                        f"syntax error: {e.msg}")]
+
+
+def race_lint_paths(paths, root: str | None = None) -> list:
+    findings = []
+    for f in collect_py_files(paths):
+        findings.extend(race_lint_file(f, root=root))
+    return findings

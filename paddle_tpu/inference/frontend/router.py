@@ -48,6 +48,7 @@ import random
 import threading
 from collections import OrderedDict
 
+from ...analysis.lock_check import install as _install_lock_check
 from ..kv_cache import prefix_chain_hashes
 from .runner import EngineRunner
 
@@ -56,6 +57,7 @@ __all__ = ["ReplicaRouter", "build_replicas"]
 _POLICIES = ("affinity", "least", "random")
 
 
+@_install_lock_check
 class ReplicaRouter:
     """EngineRunner-shaped facade over D replica runners.
 
@@ -238,7 +240,7 @@ class ReplicaRouter:
     # routing internals
     # ------------------------------------------------------------------
 
-    def _pick(self, hashes) -> tuple:
+    def _pick(self, hashes) -> tuple:  # guarded-by: _lock
         """(replica index, was-affinity-hit).  Caller holds the lock."""
         n = len(self.runners)
         if self.policy == "random":

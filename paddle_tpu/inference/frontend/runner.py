@@ -192,7 +192,8 @@ class EngineRunner:
 
     @property
     def restarts(self) -> int:
-        return self._restarts
+        with self._lock:
+            return self._restarts
 
     # ------------------------------------------------------------------
     # any-thread API
@@ -251,7 +252,8 @@ class EngineRunner:
 
     @property
     def draining(self) -> bool:
-        return self._draining
+        with self._lock:
+            return self._draining
 
     def drain(self, timeout_s: float | None = None) -> bool:
         """Graceful shutdown: stop admitting, finish (or deadline-out)

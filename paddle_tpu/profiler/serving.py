@@ -96,10 +96,12 @@ class _Reservoir:
             return list(self._sample)
 
     def mean(self) -> float:
-        return self.total / self.count if self.count else 0.0
+        with self._lock:
+            return self.total / self.count if self.count else 0.0
 
     def __len__(self) -> int:
-        return self.count
+        with self._lock:
+            return self.count
 
 
 # Prometheus-style latency bucket bounds in SECONDS — one shared ladder

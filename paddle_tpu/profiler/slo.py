@@ -52,6 +52,8 @@ import time
 from collections import deque
 from dataclasses import dataclass
 
+from ..analysis.lock_check import install as _install_lock_check
+
 __all__ = ["SLOConfig", "SLOMonitor", "WindowedTelemetry",
            "AnomalyDetector", "AnomalySpool", "evaluate_slo",
            "aggregate_windows", "SLO_STATE_NAMES",
@@ -109,6 +111,7 @@ def _frac_over(counts, threshold_s: float, bounds=_BOUNDS) -> float:
     return (total - good) / total
 
 
+@_install_lock_check
 class _Ring:
     """One rolling window over one latency channel: a fixed ring of
     time buckets, each a fixed-bound histogram.  ``n_buckets`` bounds
@@ -129,7 +132,7 @@ class _Ring:
         self._gen = [-1] * self.n_buckets     # absolute bucket index
         self._lock = threading.Lock()
 
-    def _slot(self, now: float) -> int:
+    def _slot(self, now: float) -> int:  # guarded-by: _lock
         g = int(now / self.span)
         i = g % self.n_buckets
         if self._gen[i] != g:

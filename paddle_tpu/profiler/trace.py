@@ -206,6 +206,7 @@ class Tracer:
             events = sorted(self._events, key=lambda e: e[2])
             tracks = dict(self._tracks)
             dropped = self.dropped
+            unbalanced = self.unbalanced
         te = [{"ph": "M", "name": "process_name", "pid": 1, "tid": 0,
                "args": {"name": "paddle_tpu.serving"}}]
         for name, tid in sorted(tracks.items(), key=lambda kv: kv[1]):
@@ -229,7 +230,7 @@ class Tracer:
             te.append(ev)
         return {"traceEvents": te, "displayTimeUnit": "ms",
                 "otherData": {"dropped_events": dropped,
-                              "unbalanced_spans": self.unbalanced,
+                              "unbalanced_spans": unbalanced,
                               "clock": "perf_counter_ns"}}
 
     def dump(self, path) -> int:

@@ -1,0 +1,11 @@
+"""Seeds blocking-call-in-event-loop: a synchronous queue `.get()` in
+an async handler (never awaited, never deferred to an executor)."""
+import queue
+
+
+class Bridge:
+    def __init__(self):
+        self._inbox = queue.Queue()
+
+    async def handle(self, request):
+        return self._inbox.get()    # line 11: stalls the whole loop

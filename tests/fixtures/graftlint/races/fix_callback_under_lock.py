@@ -1,0 +1,13 @@
+"""Seeds callback-under-lock: a user-supplied callback invoked while
+the instance lock is held."""
+import threading
+
+
+class Notifier:
+    def __init__(self, on_token):
+        self._lock = threading.Lock()
+        self.on_token = on_token
+
+    def push(self, tok):
+        with self._lock:
+            self.on_token(tok)    # line 13: deadlock seed

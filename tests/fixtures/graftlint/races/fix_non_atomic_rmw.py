@@ -1,0 +1,17 @@
+"""Seeds non-atomic-shared-rmw: a lock-free `+=` on an attribute both
+the pump thread and the public surface touch."""
+import threading
+
+
+class TokenMeter:
+    def __init__(self):
+        self._emitted = 0
+        self._worker = threading.Thread(target=self._pump, name="pump",
+                                        daemon=True)
+
+    def _pump(self):
+        while True:
+            self._emitted += 1    # line 14: load+add+store, no lock
+
+    def emitted(self):
+        return self._emitted

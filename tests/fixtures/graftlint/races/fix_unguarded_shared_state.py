@@ -1,0 +1,19 @@
+"""Seeds unguarded-shared-state: the stepper thread writes `_depth`
+under `_lock`; the public reader takes no lock."""
+import threading
+
+
+class StepCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._depth = 0
+        self._worker = threading.Thread(target=self._loop, name="stepper",
+                                        daemon=True)
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self._depth = self._depth + 1
+
+    def queue_depth(self):
+        return self._depth    # line 19: lock-free read of a guarded attr

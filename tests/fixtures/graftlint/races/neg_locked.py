@@ -1,0 +1,20 @@
+"""Negative control: the same shape as fix_unguarded_shared_state, but
+every access takes the lock — must stay silent."""
+import threading
+
+
+class LockedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._depth = 0
+        self._worker = threading.Thread(target=self._loop, name="stepper",
+                                        daemon=True)
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self._depth += 1
+
+    def queue_depth(self):
+        with self._lock:
+            return self._depth

@@ -1,0 +1,27 @@
+"""Negative controls for role inference — both classes must stay
+silent.  ``PrivateWorker`` spawns a thread but only that one role ever
+touches ``_steps`` (no public method reads it); ``LocalTally`` is plain
+single-threaded state with no concurrency evidence at all."""
+import threading
+
+
+class PrivateWorker:
+    def __init__(self):
+        self._steps = 0
+        self._worker = threading.Thread(target=self._run, name="worker",
+                                        daemon=True)
+
+    def _run(self):
+        self._steps += 1
+        self._note()
+
+    def _note(self):
+        self._steps += 1
+
+
+class LocalTally:
+    def __init__(self):
+        self.count = 0
+
+    def bump(self):
+        self.count += 1

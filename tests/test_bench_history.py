@@ -81,6 +81,18 @@ def test_rel_floor_guards_identical_baselines():
     assert check_record(_serve_rec(60.0), same)["verdict"] == "regression"
 
 
+def test_race_findings_gate_holds_at_zero():
+    """serve_bench stamps every record with the post-baseline race-lint
+    count; a zero-median baseline leaves zero slack, so a single new
+    finding regresses even when throughput is fine."""
+    base = [_serve_rec(100.0 + d, race_findings=0) for d in (-2, 0, 2, 1)]
+    assert check_record(_serve_rec(101.0, race_findings=0),
+                        base)["verdict"] == "pass"
+    out = check_record(_serve_rec(101.0, race_findings=1), base)
+    assert out["verdict"] == "regression"
+    assert out["regressed"] == ["race_findings"]
+
+
 def test_training_records_gate_on_tokens_per_sec():
     base = [{"tokens_per_sec": 1000.0 + d, "backend": "cpu",
              "config": "tiny"} for d in (-5, 0, 5, 2)]

@@ -264,9 +264,11 @@ def test_dead_code_and_dead_input():
 
 
 def test_every_catalog_rule_is_exercised():
-    """Each RULES entry must be covered by a firing assertion above (AST)
-    or in this file's jaxpr tests — this meta-check catches a rule added
-    to the catalog without a test."""
+    """Each RULES entry must be covered by a firing assertion — AST and
+    jaxpr rules in this file, race rules by the fixture parametrization
+    in test_race_rules.py (fixtures under tests/fixtures/graftlint/races)
+    — this meta-check catches a rule added to the catalog without a
+    test."""
     covered = {
         "numpy-in-jit", "host-sync-in-jit", "tracer-branch",
         "mutable-default-arg", "unkeyed-jit", "attention-program-budget",
@@ -278,8 +280,25 @@ def test_every_catalog_rule_is_exercised():
         "unbounded-observability-buffer",
         "undonated-buffer", "host-callback", "dtype-promotion",
         "dead-code", "dead-input", "passthrough-output",
+        # race front end — firing fixtures asserted in test_race_rules.py
+        "unguarded-shared-state", "non-atomic-shared-rmw",
+        "callback-under-lock", "blocking-call-in-event-loop",
     }
     assert covered == set(RULES)
+    # every race-tagged rule must ship a firing fixture AND an assertion
+    # naming it in test_race_rules.py
+    race_fixture = {
+        "unguarded-shared-state": "fix_unguarded_shared_state.py",
+        "non-atomic-shared-rmw": "fix_non_atomic_rmw.py",
+        "callback-under-lock": "fix_callback_under_lock.py",
+        "blocking-call-in-event-loop": "fix_blocking_in_event_loop.py",
+    }
+    race_rules = {r for r, (_s, tag, _d) in RULES.items() if tag == "race"}
+    assert race_rules == set(race_fixture)
+    race_tests = open(os.path.join(_HERE, "test_race_rules.py")).read()
+    for rule, fixture in race_fixture.items():
+        assert f'"{rule}"' in race_tests, f"{rule}: no firing assertion"
+        assert os.path.exists(os.path.join(_FIX, "races", fixture)), fixture
 
 
 # ---------------------------------------------------------------------------

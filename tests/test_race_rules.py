@@ -1,0 +1,346 @@
+"""graft-race self-tests: the thread-role/lock-discipline front end.
+
+Mirror of test_graftlint.py for the race rules: every race rule fires
+exactly once on its fixture with the right location; the negative
+controls (properly locked class, single-role class) stay silent; role
+inference, ``# guarded-by:`` handling, suppression, the runtime lock
+validator, the CLI ``--races``/``--prune-baseline`` contract, and the
+shipped-tree cleanliness guarantee all hold."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from paddle_tpu.analysis import ERROR, WARNING, filter_baseline, load_baseline
+from paddle_tpu.analysis.lock_check import GuardViolation, guards_of, install
+from paddle_tpu.analysis.race_rules import (default_race_paths,
+                                            race_lint_file, race_lint_paths,
+                                            race_lint_source)
+from paddle_tpu.core.flags import set_flags
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+_FIX = os.path.join(_HERE, "fixtures", "graftlint", "races")
+_CLI = os.path.join(_REPO, "tools", "analysis", "graftlint.py")
+
+
+def _lint_fix(name):
+    return race_lint_file(os.path.join(_FIX, name), root=_REPO)
+
+
+# ---------------------------------------------------------------------------
+# fixtures: one file, one finding, right location
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fixture,rule,line,func,severity", [
+    ("fix_unguarded_shared_state.py", "unguarded-shared-state", 19,
+     "StepCounter.queue_depth", ERROR),
+    ("fix_non_atomic_rmw.py", "non-atomic-shared-rmw", 14,
+     "TokenMeter._pump", WARNING),
+    ("fix_callback_under_lock.py", "callback-under-lock", 13,
+     "Notifier.push", WARNING),
+    ("fix_blocking_in_event_loop.py", "blocking-call-in-event-loop", 11,
+     "Bridge.handle", WARNING),
+])
+def test_race_fixture_fires_exactly_once(fixture, rule, line, func, severity):
+    findings = _lint_fix(fixture)
+    assert len(findings) == 1, [str(f.location) for f in findings]
+    f = findings[0]
+    assert f.rule == rule
+    assert f.severity == severity
+    assert f.location.line == line
+    assert f.location.func == func
+
+
+@pytest.mark.parametrize("fixture", ["neg_locked.py", "neg_single_role.py"])
+def test_negative_controls_stay_silent(fixture):
+    assert _lint_fix(fixture) == []
+
+
+# ---------------------------------------------------------------------------
+# role inference
+# ---------------------------------------------------------------------------
+
+def test_roles_propagate_through_self_method_calls():
+    """A helper only the spawned thread reaches inherits its role, so a
+    lock-free write there conflicts with the public lock-free reader."""
+    src = textwrap.dedent("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                self._t = threading.Thread(target=self._run, name="w")
+
+            def _run(self):
+                self._helper()
+
+            def _helper(self):
+                with self._lock:
+                    self._n = self._n + 1
+
+            def read(self):
+                return self._n
+    """)
+    (f,) = race_lint_source(src, "m.py")
+    assert f.rule == "unguarded-shared-state"
+    assert f.location.func == "C.read"
+    assert "roles: w" in f.message          # the thread's name= literal
+
+
+def test_async_def_and_submit_seed_roles():
+    src = textwrap.dedent("""
+        import threading
+
+        class C:
+            def __init__(self, pool):
+                self._lock = threading.Lock()
+                self._n = 0
+                pool.submit(self._work)
+
+            def _work(self):
+                with self._lock:
+                    self._n = 1
+
+            async def read(self):
+                return self._n
+    """)
+    (f,) = race_lint_source(src, "m.py")
+    assert f.rule == "unguarded-shared-state"
+    assert f.location.func == "C.read"
+
+
+def test_init_accesses_are_exempt():
+    """Construction happens-before thread start — lock-free writes in
+    __init__ never conflict with the guarded discipline."""
+    src = textwrap.dedent("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+    """)
+    assert race_lint_source(src, "m.py") == []
+
+
+def test_dunder_methods_are_public_surface():
+    src = textwrap.dedent("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def __len__(self):
+                return self._n
+    """)
+    (f,) = race_lint_source(src, "m.py")
+    assert f.location.func == "C.__len__"
+
+
+# ---------------------------------------------------------------------------
+# guarded-by + suppression
+# ---------------------------------------------------------------------------
+
+_GUARDED_SRC = textwrap.dedent("""
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+            self._t = threading.Thread(target=self._run, name="w")
+
+        def _run(self):
+            with self._lock:
+                self._n = self._pick()
+
+        def _pick(self):{anno}
+            return self._n + 1
+
+        def depth(self):
+            with self._lock:
+                return self._n
+""")
+
+
+def test_guarded_by_annotation_clears_the_finding():
+    dirty = _GUARDED_SRC.format(anno="")
+    assert any(f.rule == "unguarded-shared-state"
+               for f in race_lint_source(dirty, "m.py"))
+    clean = _GUARDED_SRC.format(anno="  # guarded-by: _lock")
+    assert race_lint_source(clean, "m.py") == []
+
+
+def test_inline_suppression_works():
+    src = textwrap.dedent("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                self._t = threading.Thread(target=self._run, name="w")
+
+            def _run(self):
+                with self._lock:
+                    self._n = 1
+
+            def read(self):
+                return self._n  # graftlint: disable=unguarded-shared-state
+    """)
+    assert race_lint_source(src, "m.py") == []
+
+
+def test_lambda_and_awaited_calls_do_not_block_the_loop():
+    """run_in_executor lambdas and awaited asyncio.Queue.get are the
+    loop-FRIENDLY idioms — the blocking rule must not flag them."""
+    src = textwrap.dedent("""
+        import asyncio
+
+        class C:
+            async def handle(self, q, loop):
+                await loop.run_in_executor(None, lambda: q.get())
+                item = await q.get()
+                task = asyncio.ensure_future(q.get())
+                return item, task
+    """)
+    assert race_lint_source(src, "m.py") == []
+
+
+# ---------------------------------------------------------------------------
+# runtime validator (lock_check)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def strict_mode():
+    set_flags({"analysis_mode": "strict"})
+    yield
+    set_flags({"analysis_mode": "off"})
+
+
+class _Guarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def _bump(self):  # guarded-by: _lock
+        self.n += 1
+
+    def bump(self):
+        with self._lock:
+            self._bump()
+
+
+def test_guards_of_reads_the_annotation():
+    assert guards_of(_Guarded) == {"_bump": {"_lock"}}
+
+
+def test_install_enforces_hold_under_strict(strict_mode):
+    install(_Guarded)
+    g = _Guarded()
+    g.bump()                               # locked caller: fine
+    assert g.n == 1
+    with pytest.raises(GuardViolation, match="guarded-by: _lock"):
+        g._bump()                          # lockless caller: violation
+
+
+def test_install_is_free_when_mode_off():
+    install(_Guarded)                      # idempotent re-install
+    g = _Guarded()
+    g._bump()                              # off mode: no check, no raise
+    assert g.n == 1
+
+
+def test_shipped_annotated_classes_are_installed():
+    from paddle_tpu.inference.frontend.router import ReplicaRouter
+    from paddle_tpu.profiler.slo import _Ring
+    assert getattr(ReplicaRouter._pick, "__pt_guarded_by__", None) \
+        == ("_lock",)
+    assert getattr(_Ring._slot, "__pt_guarded_by__", None) == ("_lock",)
+
+
+def test_ring_slot_violates_when_called_lockless_under_strict(strict_mode):
+    from paddle_tpu.profiler.slo import _Ring
+    r = _Ring(window_s=10.0, n_buckets=5)
+    r.add(0.5, 0.01)                       # locked path: fine
+    with pytest.raises(GuardViolation):
+        r._slot(0.5)
+
+
+# ---------------------------------------------------------------------------
+# shipped tree + CLI contract
+# ---------------------------------------------------------------------------
+
+def _run_cli(*args):
+    return subprocess.run([sys.executable, _CLI, *args],
+                          capture_output=True, text=True, cwd=_REPO,
+                          timeout=120)
+
+
+def test_shipped_serving_stack_races_clean():
+    """Tier-1 smoke: the real inference + profiler tiers race-lint clean
+    against the committed baseline — every remaining finding is a
+    justified suppression, not an open race."""
+    from paddle_tpu.analysis import default_baseline_path
+    findings = filter_baseline(
+        race_lint_paths(default_race_paths(_REPO), root=_REPO),
+        load_baseline(default_baseline_path()))
+    assert findings == [], [str(f.location) for f in findings]
+
+
+def test_cli_races_exit_zero_on_shipped_tree():
+    r = _run_cli("--races")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_races_nonzero_on_fixture_tree():
+    r = _run_cli(_FIX, "--races", "--format", "json",
+                 "--no-default-baseline")
+    assert r.returncode == 1, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["counts"]["ERROR"] == 1          # the unguarded fixture
+    rules = {f["rule"] for f in doc["findings"]}
+    assert {"unguarded-shared-state", "non-atomic-shared-rmw",
+            "callback-under-lock", "blocking-call-in-event-loop"} <= rules
+
+
+def test_cli_prune_baseline_drops_only_stale_exercised_families(tmp_path):
+    """A dead AST entry is pruned; a jaxpr entry survives a run that
+    never exercised the jaxpr front end; live race entries survive."""
+    from paddle_tpu.analysis import default_baseline_path
+    with open(default_baseline_path()) as fp:
+        doc = json.load(fp)
+    n_jaxpr = sum(1 for e in doc["accepted"] if e["rule"] == "dead-input")
+    n_race = sum(1 for e in doc["accepted"]
+                 if e["rule"] in ("unguarded-shared-state",
+                                  "callback-under-lock"))
+    assert n_jaxpr and n_race            # preconditions on the shipped file
+    doc["accepted"].append({
+        "fingerprint": "deadbeefdeadbeef", "rule": "host-sync-in-jit",
+        "location": "gone.py (gone)", "message": "no longer fires",
+        "reason": "stale"})
+    scratch = tmp_path / "baseline.json"
+    scratch.write_text(json.dumps(doc))
+
+    r = _run_cli("--races", "--prune-baseline", "--baseline", str(scratch))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "deadbeefdeadbeef" in r.stdout
+    after = json.loads(scratch.read_text())["accepted"]
+    assert len(after) == len(doc["accepted"]) - 1
+    assert sum(1 for e in after if e["rule"] == "dead-input") == n_jaxpr

@@ -3,7 +3,8 @@
 
 Usage:
   python tools/analysis/graftlint.py [paths...] [--format json|text]
-        [--baseline FILE] [--write-baseline] [--audit-serving] [--no-default-baseline]
+        [--baseline FILE] [--write-baseline] [--audit-serving]
+        [--races] [--prune-baseline] [--no-default-baseline]
 
 Default path is ``paddle_tpu``.  Exit status: 0 when no ERROR-severity
 finding survives the baseline, 1 otherwise (2 on usage errors).
@@ -15,8 +16,17 @@ runs the jaxpr passes over every program they compile — the
 donation/transfer/dtype/dead audit of what XLA is really handed.  This
 imports jax; plain source linting does not.
 
+``--races`` additionally runs the thread-role/lock-discipline front end
+(race_rules.py) — over the explicit paths when given, else over the
+multi-threaded host serving stack (paddle_tpu/inference + profiler).
+Stdlib-only, and its findings feed the same baseline and exit status.
+
 ``--write-baseline`` rewrites the baseline file to accept every finding
 of the current run (review the diff before committing it).
+``--prune-baseline`` does the inverse hygiene: drops baseline entries
+whose fingerprints no longer fire anywhere (only for rule families the
+current run exercised — jaxpr entries survive a run without
+--audit-serving), printing what was pruned.
 """
 from __future__ import annotations
 
@@ -121,6 +131,14 @@ def main(argv=None) -> int:
     ap.add_argument("--audit-serving", action="store_true",
                     help="also jaxpr-audit a tiny serving engine + train "
                          "step (imports jax)")
+    ap.add_argument("--races", action="store_true",
+                    help="also run the thread-role/lock-discipline front "
+                         "end (default scope: the inference + profiler "
+                         "host serving tiers)")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="drop baseline entries whose fingerprints no "
+                         "longer fire (restricted to rule families this "
+                         "run exercised); prints what was pruned")
     ap.add_argument("--report-out", default=None,
                     help="with --audit-serving: write the program report "
                          "JSON here")
@@ -132,21 +150,78 @@ def main(argv=None) -> int:
     from paddle_tpu.analysis import (default_baseline_path, filter_baseline,
                                      findings_to_json, format_text,
                                      lint_paths, load_baseline, save_baseline)
-    from paddle_tpu.analysis.findings import ERROR
+    from paddle_tpu.analysis.findings import ERROR, RULES
 
     paths = args.paths or [os.path.join(_REPO, "paddle_tpu")]
     findings = lint_paths(paths, root=_REPO)
+    baseline_path = args.baseline or default_baseline_path()
+
+    race_findings = []
+    if args.races:
+        from paddle_tpu.analysis.race_rules import (default_race_paths,
+                                                    race_lint_paths)
+        race_paths = args.paths or default_race_paths(_REPO)
+        race_findings = race_lint_paths(race_paths, root=_REPO)
+        findings = findings + race_findings
 
     report = None
     if args.audit_serving:
         jf, report = _serving_findings(args.large_bytes)
         findings = findings + jf
-        if args.report_out:
-            with open(args.report_out, "w") as fp:
-                json.dump(report, fp, indent=2)
-                fp.write("\n")
 
-    baseline_path = args.baseline or default_baseline_path()
+    if args.races and (report is not None or args.report_out):
+        baseline = set() if args.no_default_baseline else \
+            load_baseline(baseline_path)
+        new = filter_baseline(race_findings, baseline)
+        by_rule = {}
+        for f in race_findings:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        conc = {
+            "paths": sorted(os.path.relpath(p, _REPO) for p in race_paths),
+            "findings": len(race_findings),
+            "accepted": len(race_findings) - len(new),
+            "new": len(new),
+            "by_rule": dict(sorted(by_rule.items())),
+        }
+        report = report if report is not None else {}
+        report["concurrency"] = conc
+    if report is not None and args.report_out:
+        with open(args.report_out, "w") as fp:
+            json.dump(report, fp, indent=2)
+            fp.write("\n")
+
+    if args.prune_baseline:
+        # only prune entries whose rule FAMILY this run exercised: a run
+        # without --audit-serving produced no jaxpr findings, so absence
+        # there proves nothing
+        ran = {"ast"}
+        if args.races:
+            ran.add("race")
+        if args.audit_serving:
+            ran.add("jaxpr")
+        with open(baseline_path) as fp:
+            doc = json.load(fp)
+        live = {f.fingerprint for f in findings}
+        kept, pruned = [], []
+        for e in doc.get("accepted", []):
+            tag = RULES.get(e.get("rule", ""), (None, None))[1]
+            if tag in ran and e["fingerprint"] not in live:
+                pruned.append(e)
+            else:
+                kept.append(e)
+        for e in pruned:
+            print(f"pruned {e['fingerprint']}  {e.get('rule', '?'):24s} "
+                  f"{e.get('location', '')}")
+        if pruned:
+            doc["accepted"] = kept
+            with open(baseline_path, "w") as fp:
+                json.dump(doc, fp, indent=2)
+                fp.write("\n")
+        print(f"baseline: {len(pruned)} entr{'y' if len(pruned) == 1 else 'ies'} "
+              f"pruned, {len(kept)} kept "
+              f"(families checked: {'/'.join(sorted(ran))})")
+        return 0
+
     if args.write_baseline:
         save_baseline(baseline_path, findings)
         print(f"baseline written: {baseline_path} "

@@ -46,6 +46,7 @@ _SERVE_WATCH = (
     ("p99_token_ms", False),
     ("decode_window_host_round_trips_per_token", False),
     ("weight_bytes_resident", False),
+    ("race_findings", False),        # post-baseline race-lint count: 0
 )
 _TRAIN_WATCH = (("tokens_per_sec", True),)
 

@@ -1795,6 +1795,21 @@ def main(argv=None):
             record["trace_unbalanced_spans"] = tracer.unbalanced
         except Exception as e:
             record.setdefault("error", f"{type(e).__name__}: {e}")
+    try:
+        # post-baseline race-lint count over the serving stack this bench
+        # just exercised — bench_history gates on it staying 0, so a race
+        # regression fails the perf gate even when throughput is fine
+        from paddle_tpu.analysis import (default_baseline_path,
+                                         filter_baseline, load_baseline,
+                                         race_lint_paths)
+        from paddle_tpu.analysis.race_rules import default_race_paths
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        record["race_findings"] = len(filter_baseline(
+            race_lint_paths(default_race_paths(repo), root=repo),
+            load_baseline(default_baseline_path())))
+    except Exception as e:
+        record.setdefault("error", f"{type(e).__name__}: {e}")
     _emit(record)
     return 0
 
