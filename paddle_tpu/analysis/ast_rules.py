@@ -59,6 +59,17 @@ _COERCIONS = {"float", "int", "bool"}
 
 _ATTEN_RE = re.compile(r"atten", re.IGNORECASE)
 
+# real-clock reads and global-RNG calls the simulator tier must not
+# make (nondeterministic-sim); seeded random.Random instances are fine
+_WALL_CLOCK_FNS = {"time", "time_ns", "perf_counter", "perf_counter_ns",
+                   "monotonic", "monotonic_ns"}
+_GLOBAL_RNG_FNS = {"random", "randrange", "randint", "uniform", "choice",
+                   "choices", "shuffle", "sample", "gauss",
+                   "normalvariate", "lognormvariate", "expovariate",
+                   "paretovariate", "betavariate", "gammavariate",
+                   "triangular", "vonmisesvariate", "weibullvariate",
+                   "getrandbits", "randbytes"}
+
 # mesh collectives whose axis name binds only under shard_map
 _COLLECTIVES = {"psum", "all_gather", "psum_scatter", "ppermute",
                 "all_to_all", "pmean", "pmax", "pmin"}
@@ -893,6 +904,43 @@ def lint_source(text: str, path: str = "<string>") -> list:
                  "(paddle_tpu.tune.kernel_config) — hardcoded launch "
                  "geometry freezes one device's tradeoffs; resolve "
                  "block/grid choices through kernel_config")
+
+    # ---- nondeterministic-sim (sim tier only) ----------------------------
+    # The fleet simulator's hard invariant: virtual time + seeded
+    # randomness, nothing else.  Same seed, same workload -> byte-
+    # identical records; that is what makes sweep cells comparable and
+    # regressions bisectable.  Any real-clock read or ambient-RNG call
+    # in a sim/ directory quietly breaks it — flag them all.  Seeded
+    # ``random.Random(seed)`` instances stay legal: the rule matches
+    # the MODULE's global functions, not instance methods (an instance
+    # call's dotted prefix is the variable name, never ``random``).
+    if "sim" in re.split(r"[\\/]", path):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dd = _dotted(node.func) or ()
+            if not dd:
+                continue
+            how = None
+            if dd[0] == "time" and dd[-1] in _WALL_CLOCK_FNS \
+                    and len(dd) == 2:
+                how = "a real-clock read"
+            elif dd[-1] in ("now", "utcnow", "today") \
+                    and any(p in ("datetime", "date") for p in dd[:-1]):
+                how = "a wall-date read"
+            elif len(dd) == 2 and dd[0] == "random" \
+                    and dd[1] in _GLOBAL_RNG_FNS:
+                how = "a global unseeded RNG call"
+            elif len(dd) >= 3 and dd[0] in ctx.np_aliases \
+                    and dd[1] == "random":
+                how = "a global unseeded RNG call"
+            if how is not None:
+                emit("nondeterministic-sim", node,
+                     f"`{'.'.join(dd)}()` is {how} inside the simulator "
+                     "tier — the sim's hard invariant is virtual time "
+                     "and seeded randomness (same seed -> byte-identical "
+                     "records); thread a random.Random(seed) through and "
+                     "advance time via the event loop")
 
     # ---- wallclock-in-timing-path (inference + profiler tiers) -----------
     # Timing contract: every duration in the serving and profiling tiers

@@ -156,6 +156,17 @@ RULES = {
         "materialization inside the body's call graph forces one sync "
         "per iteration, quietly turning the K-step on-device window "
         "back into per-token round trips")),
+    "nondeterministic-sim": (WARNING, "ast", (
+        "a wall-clock read (time.time/perf_counter/monotonic), "
+        "datetime.now/utcnow/today, or a global unseeded RNG call "
+        "(random.random/randrange/... on the MODULE, not a seeded "
+        "random.Random instance) inside a sim/ directory — the fleet "
+        "simulator's hard invariant is virtual time and seeded "
+        "randomness only: the same seed and workload must produce "
+        "byte-identical records, and any real-clock or ambient-RNG "
+        "dependence silently ties results to host speed or interpreter "
+        "state; thread a random.Random(seed) through, and advance time "
+        "via the event loop")),
     # race front end (race_rules.py): thread-role + lock-discipline
     "unguarded-shared-state": (ERROR, "race", (
         "an attribute written under a lock in one thread role is "

@@ -50,6 +50,7 @@ from collections import OrderedDict
 
 from ...analysis.lock_check import install as _install_lock_check
 from ..kv_cache import prefix_chain_hashes
+from ..policy import pick_replica
 from .runner import EngineRunner
 
 __all__ = ["ReplicaRouter", "build_replicas"]
@@ -241,27 +242,12 @@ class ReplicaRouter:
     # ------------------------------------------------------------------
 
     def _pick(self, hashes) -> tuple:  # guarded-by: _lock
-        """(replica index, was-affinity-hit).  Caller holds the lock."""
-        n = len(self.runners)
-        if self.policy == "random":
-            return self._rng.randrange(n), False
-        if self.policy == "affinity" and hashes:
-            best, best_run = None, 0
-            for i in range(n):
-                reg = self._registry[i]
-                run = 0
-                for h in hashes:          # leading run: prefix pages chain
-                    if h not in reg:
-                        break
-                    run += 1
-                if run > best_run or (run == best_run and run > 0
-                                      and self._outstanding[i]
-                                      < self._outstanding[best]):
-                    best, best_run = i, run
-            if best_run > 0:
-                return best, True
-        # least-outstanding-tokens; ties -> lowest index (min is stable)
-        return min(range(n), key=lambda i: self._outstanding[i]), False
+        """(replica index, was-affinity-hit).  Caller holds the lock.
+        The decision itself is ``policy.pick_replica`` — pure, shared
+        with the fleet simulator so simulated routing uses the SAME
+        leading-run/tie-break semantics as the live router."""
+        return pick_replica(self.policy, hashes, self._registry,
+                            self._outstanding, rng=self._rng)
 
     def _owner(self, request_id: str):
         """Replica index encoded in the id ("r3-req-7" -> 3)."""

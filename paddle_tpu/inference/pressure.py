@@ -81,11 +81,22 @@ class DegradationController:
         of a synchronous step they would still be free, so counting
         them as used would skew the free-page fraction (and the
         retry-after trend) against the overlap engine for pages that
-        are not real demand yet."""
+        are not real demand yet.
+
+        Parked (refcount-0 cached) pages count as headroom too,
+        mirroring ``BlockManager.can_allocate``: the allocator evicts
+        them on demand, so they are reclaimable supply, not demand.
+        Counting them as used deadlocks a long prefix-caching run —
+        retirement parks pages instead of freeing them, the strict
+        free fraction ratchets below the ADMIT_PAUSE exit threshold,
+        and admission never resumes even though nearly the whole pool
+        is evictable on demand.  (Found by replaying sustained traffic
+        through the fleet simulator, which shares this controller.)"""
         self._step += 1
         total = blocks.num_blocks - 1  # slot 0 is the null block
         self._total = total
-        free = min(blocks.num_free + int(spec_reserved), total)
+        reclaimable = int(getattr(blocks, "num_cached", 0))
+        free = min(blocks.num_free + reclaimable + int(spec_reserved), total)
         f = free / total if total > 0 else 1.0
         self._history.append((time.monotonic(), free))
 

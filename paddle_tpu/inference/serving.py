@@ -81,6 +81,7 @@ from ..ops.pallas import quant_matmul as _qm
 from ..profiler import RecordEvent, ServingStats
 from .faults import InjectedFault
 from .kv_cache import NULL_BLOCK, BlockManager, BlockPoolExhausted
+from .policy import pack_prefill_chunks
 from .pressure import STATE_NAMES as _TIER_NAMES
 from .sampling import (advance_keys, make_samp, samp_structs,
                        sample_tokens)
@@ -1919,24 +1920,27 @@ class LLMEngine:
 
     def _schedule_prefill_chunks(self) -> list:
         """Pack at most max_prefill_tokens pending prompt tokens into this
-        step, FCFS, resuming partially-prefilled requests first.  Resolves
-        copy-on-write for each chunk's first write position (the only spot
-        a chunk can touch a shared page) before the program runs."""
-        budget = self.max_prefill_tokens
-        chunks = []
-        for req in sorted(list(self._running), key=lambda r: r.arrival):
-            if budget <= 0:
-                break
-            rem = len(req.tokens) - req.cached
-            if rem <= 0 or req not in self._running:
-                continue
+        step, FCFS, resuming partially-prefilled requests first.  The
+        budget rule itself is ``policy.pack_prefill_chunks`` (shared with
+        the fleet simulator); the engine hangs copy-on-write resolution
+        for each chunk's first write position (the only spot a chunk can
+        touch a shared page) on its admit hook, so a CoW preemption skips
+        the victim without consuming budget."""
+        chunks: list = []
+
+        def admit(req):
+            if req not in self._running:
+                return False
             if self.enable_prefix_caching:
-                if not self._resolve_cow(req, req.cached,
-                                         drop_from=chunks):
-                    continue                     # req itself was preempted
-            chunks.append((req, min(rem, budget)))
-            budget -= min(rem, budget)
-        return chunks
+                # may preempt req (False) or drop an earlier chunk's
+                # owner from the accumulator (drop_from)
+                return self._resolve_cow(req, req.cached, drop_from=chunks)
+            return True
+
+        ordered = sorted(list(self._running), key=lambda r: r.arrival)
+        return pack_prefill_chunks(
+            ((r, len(r.tokens) - r.cached) for r in ordered),
+            self.max_prefill_tokens, admit=admit, out=chunks)
 
     def _resolve_cow(self, req, pos: int, drop_from: list | None = None) \
             -> bool:

@@ -1,0 +1,10 @@
+"""Firing fixture: a wall-clock read inside the simulator tier.
+
+The fleet simulator's hard invariant is virtual time and seeded
+randomness only — same seed, same workload, byte-identical records.  A
+perf_counter() here silently ties results to host speed."""
+import time
+
+
+def step_cost(rows):
+    return time.perf_counter() * rows

@@ -63,6 +63,8 @@ def _lint_fix(name):
      "unbounded-observability-buffer", 14, "StepStatsLog.record", WARNING),
     (os.path.join("pallas", "fix_untuned_launch.py"),
      "untuned-pallas-launch", 15, "hardcoded_launch", WARNING),
+    (os.path.join("sim", "fix_nondeterministic_sim.py"),
+     "nondeterministic-sim", 10, "step_cost", WARNING),
 ])
 def test_ast_fixture_fires_exactly_once(fixture, rule, line, func, severity):
     findings = _lint_fix(fixture)
@@ -277,7 +279,7 @@ def test_every_catalog_rule_is_exercised():
         "collective-outside-shard-map", "untuned-pallas-launch",
         "wallclock-in-timing-path", "host-sync-in-dispatch-path",
         "per-token-host-sync-in-decode-window",
-        "unbounded-observability-buffer",
+        "unbounded-observability-buffer", "nondeterministic-sim",
         "undonated-buffer", "host-callback", "dtype-promotion",
         "dead-code", "dead-input", "passthrough-output",
         # race front end — firing fixtures asserted in test_race_rules.py

@@ -886,6 +886,18 @@ def run_router_bench(smoke: bool, n_requests: int, share_ways: int,
     }
 
 
+def _workload_fingerprint(payload: dict) -> str:
+    """Stable id of (seed + workload-shaping config): sha1 over the
+    canonical JSON of ``payload``.  The SAME fingerprint goes into the
+    bench record and into ``--dump-workload``'s capture, so the fleet
+    simulator's validation mode can prove it is replaying the exact
+    stream that produced the record it scores against."""
+    import hashlib
+
+    blob = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha1(blob.encode("utf-8")).hexdigest()[:16]
+
+
 def _mixed_request_stream(rng, n_requests, vocab, max_len,
                           max_prefill_tokens):
     """The whole serving zoo in one arrival-scheduled stream: every 4th
@@ -910,7 +922,8 @@ def _mixed_request_stream(rng, n_requests, vocab, max_len,
 
 def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str,
                     kv_dtype: str = "float32", tp: int = 1, tracer=None,
-                    overlap: str = "on", weight_dtype: str = "float32"):
+                    overlap: str = "on", weight_dtype: str = "float32",
+                    dump_workload: str | None = None):
     """The ISSUE's headline workload: long prefills, chunked resumes,
     plain decodes, and speculative verify rounds all riding the ONE
     ragged step program.  Reports throughput, the exact attention
@@ -962,6 +975,29 @@ def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str,
                                    engine_kw["max_model_len"],
                                    engine_kw["max_prefill_tokens"])
     total_new = sum(mn for _, _, mn in stream)
+
+    fingerprint = _workload_fingerprint({
+        "mode": "mixed", "seed": int(seed), "requests": int(n_requests),
+        "smoke": bool(smoke or backend == "cpu"), "kv_dtype": kv_dtype,
+        "weight_dtype": weight_dtype, "tp": int(tp),
+        "engine_kw": engine_kw, "spec_k": spec_k,
+        "vocab": cfg.vocab_size})
+    if dump_workload:
+        # everything the simulator needs to rebuild this run: the exact
+        # stream plus the engine config that shaped its scheduling
+        with open(dump_workload, "w", encoding="utf-8") as f:
+            json.dump({
+                "workload_fingerprint": fingerprint,
+                "mode": "mixed",
+                "seed": int(seed),
+                "requests": int(n_requests),
+                "engine_kw": engine_kw,
+                "spec_k": spec_k,
+                "vocab": cfg.vocab_size,
+                "stream": [[step, list(map(int, prompt)), int(mn)]
+                           for step, prompt, mn in stream],
+            }, f, sort_keys=True)
+            f.write("\n")
 
     _drive(engine, list(stream))         # warm pass: compile every bucket
     engine.stats.reset()
@@ -1058,13 +1094,17 @@ def run_mixed_bench(smoke: bool, n_requests: int, seed: int, backend: str,
              if v and k != "cow"]),
         "accept_rate": s["accept_rate"],
         "verify_steps": s["verify_steps"],
+        "spec_rounds": s["spec_rounds"],
+        "draft_proposed": s["draft_proposed"],
         "spec_emitted_tokens": s["spec_emitted_tokens"],
         "prefill_tokens": s["prefill_tokens"],
         "p50_token_ms": s["p50_token_ms"],
         "p99_token_ms": s["p99_token_ms"],
         "ttft_p50_ms": s["ttft_p50_ms"],
+        "ttft_p95_ms": round(engine.stats.ttft_ms(95.0), 3),
         "ttft_p99_ms": s["ttft_p99_ms"],
         "preempted": s["preemptions"],
+        "workload_fingerprint": fingerprint,
         **ab_keys,
         **_mem_keys(engine),
         **_slo_keys(engine.stats.snapshot()),
@@ -1651,6 +1691,11 @@ def main(argv=None):
                          "all four tiers appear) and write it as Chrome "
                          "trace-event JSON — open in ui.perfetto.dev or "
                          "feed tools/perf/step_timeline.py")
+    ap.add_argument("--dump-workload", default=None, metavar="OUT.json",
+                    help="with --mixed: write the exact request stream "
+                         "(step-indexed arrivals, token ids) plus the "
+                         "engine config, fingerprint-linked to the "
+                         "record, for paddle_tpu.sim validation replay")
     args = ap.parse_args(argv)
 
     if args.tp > 1 and "xla_force_host_platform_device_count" \
@@ -1758,7 +1803,8 @@ def main(argv=None):
                 args.smoke, n_requests, args.seed, backend,
                 args.kv_dtype, args.tp, tracer=tracer,
                 overlap=args.overlap,
-                weight_dtype=args.weight_dtype))
+                weight_dtype=args.weight_dtype,
+                dump_workload=args.dump_workload))
         elif args.slo:
             record.update(run_slo_bench(
                 args.smoke, n_requests, args.seed, backend,
@@ -1787,6 +1833,14 @@ def main(argv=None):
         record["weight_dtype"] = args.weight_dtype
     except Exception as e:  # the line must still print
         record["error"] = f"{type(e).__name__}: {e}"
+    # every record carries a workload fingerprint; modes that build
+    # their stream internally (mixed) stamp a richer one themselves
+    record.setdefault("workload_fingerprint", _workload_fingerprint({
+        "mode": record.get("metric", ""), "seed": args.seed,
+        "requests": n_requests, "smoke": bool(args.smoke),
+        "kv_dtype": args.kv_dtype, "weight_dtype": args.weight_dtype,
+        "tp": args.tp, "replicas": args.replicas,
+        "backend": record.get("backend", "")}))
     if tracer is not None:
         try:
             record["trace_events"] = tracer.dump(args.trace)

@@ -33,10 +33,34 @@ then three derived numbers:
 
 Usage:
   python tools/perf/step_timeline.py TRACE.json
+  python tools/perf/step_timeline.py TRACE.json --fit sim_calibration.json
 
 Last stdout line is a one-line JSON record (same contract as the other
 tools/perf benches) with metric ``step_timeline_host_bubble_frac``
 (plus ``step_timeline_overlap_achieved_frac`` as a secondary key).
+
+Two analysis details added for the fleet simulator:
+
+* **Ring-head repair.**  The tracer's ring drops OLDEST events, so a
+  long recording's surviving window can open mid-span: inner phase
+  events whose parent ``engine.step`` was dropped, and a first step
+  whose own phases were partially dropped.  Counting those orphans
+  charges host time against no step and skews every fraction, so when
+  the trace reports ``dropped_events`` the analysis clips, per engine
+  track, everything before the end of the first surviving step (and
+  that suspect step itself) — reported as ``head_clipped_events`` /
+  ``head_clipped_steps``.
+
+* **``--fit OUT.json``** fits the simulator's ``CostModel`` from the
+  trace: each ``engine.step`` span is joined with its ``engine.pack``
+  args (ragged tokens, rows), then total step wall time regresses on
+  packed tokens (base + per-token line), pure-decode steps
+  (tokens == rows) tabulate a median-by-rows refinement, and the
+  host-only share (step minus device phases) calibrates what a decode
+  window amortizes.  ``--flight FLIGHT.json`` (the ``/debug/requests``
+  flight-recorder dump) adds queue-wait/TTFT distribution summaries to
+  the calibration's meta for cross-checking.  The output is exactly
+  what ``paddle_tpu.sim.CostModel.from_json`` loads.
 """
 from __future__ import annotations
 
@@ -80,9 +104,18 @@ def load_trace(path):
     return doc, events, tracks
 
 
-def analyze(doc, events, tracks):
-    """Attribution over every engine track in the trace (a replicated
-    trace sums its engines — the phases are per step either way)."""
+def _engine_spans(doc, events, tracks):
+    """(steps, inner, inflight, head_clipped_events, head_clipped_steps)
+    over every engine track, with the ring-buffer head repaired.
+
+    When the ring dropped its oldest events, the surviving window can
+    begin mid-span: inner phase events orphaned from a dropped
+    ``engine.step`` parent, plus a first step whose own phases were
+    partially dropped.  Per engine track, clip everything before the
+    end of the first surviving step and discard that suspect step —
+    attribution then only ever charges phases against steps that are
+    whole.  A clean trace (``dropped_events == 0``) clips nothing.
+    """
     engine_tids = {tid for tid, name in tracks.items()
                    if name == "engine" or name.startswith("engine-")}
     xs = [ev for ev in events if ev.get("ph") == "X"
@@ -92,6 +125,42 @@ def analyze(doc, events, tracks):
     inner = [ev for ev in xs if ev["name"] != "engine.step"
              and ev["name"] not in _WRAPPER_SPANS]
     inflight = [ev for ev in xs if ev["name"] == "engine.device_inflight"]
+
+    dropped = doc.get("otherData", {}).get("dropped_events", 0)
+    clipped_steps = 0
+    thresh = {}                           # tid -> clip timestamp
+    by_tid = {}
+    for st in steps:
+        by_tid.setdefault(st["tid"], []).append(st)
+    kept_steps = []
+    for tid, sts in by_tid.items():
+        if dropped and len(sts) > 1:
+            first = sts.pop(0)
+            thresh[tid] = first["ts"] + first["dur"]
+            clipped_steps += 1
+        else:
+            thresh[tid] = sts[0]["ts"]
+        kept_steps.extend(sts)
+    kept_steps.sort(key=lambda e: e["ts"])
+
+    def keep(ev):
+        t = thresh.get(ev["tid"])
+        return t is None or ev["ts"] >= t - 1e-6
+
+    n_before = len(inner) + len(inflight)
+    inner = [ev for ev in inner if keep(ev)]
+    inflight = [ev for ev in inflight if keep(ev)]
+    clipped_ev = n_before - len(inner) - len(inflight)
+    if dropped:
+        clipped_ev += len(steps) - len(kept_steps)
+    return kept_steps, inner, inflight, clipped_ev, clipped_steps
+
+
+def analyze(doc, events, tracks):
+    """Attribution over every engine track in the trace (a replicated
+    trace sums its engines — the phases are per step either way)."""
+    steps, inner, inflight, clipped_ev, clipped_steps = \
+        _engine_spans(doc, events, tracks)
 
     durs = {}                             # phase -> [dur_us,...]
     for ev in inner:
@@ -184,6 +253,141 @@ def analyze(doc, events, tracks):
         "tiers": sorted(set(tracks.values())),
         "dropped_events": other.get("dropped_events", 0),
         "unbalanced_spans": other.get("unbalanced_spans", 0),
+        "head_clipped_events": clipped_ev,
+        "head_clipped_steps": clipped_steps,
+    }
+
+
+def _linfit(xs, ys):
+    """Least-squares line ``y = a + b*x``; (a, b, r2).  Degenerate x
+    (all equal) pins the slope at 0 and the intercept at the y-mean."""
+    n = len(xs)
+    if not n:
+        return 0.0, 0.0, 0.0
+    mx = sum(xs) / n
+    my = sum(ys) / n
+    sxx = sum((x - mx) ** 2 for x in xs)
+    if sxx <= 0.0:
+        return my, 0.0, 0.0
+    sxy = sum((x - mx) * (y - my) for x, y in zip(xs, ys))
+    b = sxy / sxx
+    a = my - b * mx
+    syy = sum((y - my) ** 2 for y in ys)
+    ss_res = sum((y - (a + b * x)) ** 2 for x, y in zip(xs, ys))
+    r2 = 1.0 - ss_res / syy if syy > 0 else 1.0
+    return a, b, r2
+
+
+def fit(doc, events, tracks, flight=None, trace_path=None):
+    """Fit the fleet simulator's CostModel from the trace: the dict
+    ``paddle_tpu.sim.CostModel.from_json`` loads (this tool stays
+    stdlib-only on purpose — fitting must not need a JAX install).
+
+    Per step, the joined sample is (packed tokens, rows, step wall us,
+    device-phase us inside the step).  The regression runs on total
+    step wall vs packed tokens — the ragged single-program step makes
+    that a clean line — and pure-decode steps (tokens == rows) also
+    feed an exact median-by-rows table, since those are the shapes a
+    steady fleet lives in.
+    """
+    steps, inner, _, clipped_ev, clipped_steps = \
+        _engine_spans(doc, events, tracks)
+    by_tid = {}
+    for ev in inner:
+        by_tid.setdefault(ev["tid"], []).append(ev)
+
+    samples = []                          # (tokens, rows, dur_us, dev_us)
+    empty_us = []
+    for st in steps:
+        t0, t1 = st["ts"], st["ts"] + st["dur"]
+        mine = [ev for ev in by_tid.get(st["tid"], ())
+                if t0 <= ev["ts"] and ev["ts"] + ev["dur"] <= t1 + 1e-6]
+        packs = [ev for ev in mine if ev["name"] == "engine.pack"]
+        tokens = sum(int(ev.get("args", {}).get("tokens", 0))
+                     for ev in packs)
+        rows = sum(int(ev.get("args", {}).get("rows", 0)) for ev in packs)
+        dev = sum(ev["dur"] for ev in mine
+                  if ev["name"] in _DEVICE_PHASES)
+        # engine-ACTIVE time: what the engine stamps ITL samples with
+        # (dispatch section + completion block) — every phase except
+        # the post-block commit/retire tail.  Under async overlap the
+        # untracked step remainder is device-inflight, not active.
+        act = sum(ev["dur"] for ev in mine
+                  if ev["name"] not in ("engine.sample_commit",
+                                        "engine.retire"))
+        if tokens > 0:
+            samples.append((tokens, rows, st["dur"], dev,
+                            min(act / st["dur"], 1.0) if st["dur"] else 1.0))
+        else:
+            empty_us.append(st["dur"])
+
+    # Compile steps poison the regression: a first call on a fresh pack
+    # shape spends SECONDS in device_launch where a steady step spends
+    # milliseconds, and least squares chases those points.  The steady
+    # state is what the simulator models, so trim steps beyond 20x the
+    # median wall — wide enough to keep every honest prefill burst,
+    # narrow enough to shed compiles — and say how many were dropped.
+    outliers = 0
+    if len(samples) >= 4:
+        med = _pct(sorted(s[2] for s in samples), 50)
+        cut = 20.0 * med
+        kept = [s for s in samples if s[2] <= cut]
+        outliers = len(samples) - len(kept)
+        samples = kept
+
+    xs = [s[0] for s in samples]
+    ys = [s[2] for s in samples]
+    base_us, per_tok_us, r2 = _linfit(xs, ys)
+    base_us = max(base_us, 0.0)
+    per_tok_us = max(per_tok_us, 0.0)
+
+    host_meds = sorted(max(d - dev, 0.0) for _, _, d, dev, _ in samples)
+    host_us = _pct(host_meds, 50)
+    active_frac = _pct(sorted(s[4] for s in samples), 50) \
+        if samples else 1.0
+
+    by_rows = {}
+    for tokens, rows, dur, _, _ in samples:
+        if rows > 0 and tokens == rows:   # pure decode pack
+            by_rows.setdefault(rows, []).append(dur)
+    decode_table = {str(r): round(_pct(sorted(v), 50) / 1e6, 9)
+                    for r, v in sorted(by_rows.items())}
+
+    meta = {
+        "source": "fit",
+        "trace": trace_path,
+        "steps_fit": len(samples),
+        "outlier_steps_dropped": outliers,
+        "empty_steps": len(empty_us),
+        "empty_step_p50_s": round(_pct(sorted(empty_us), 50) / 1e6, 9),
+        "r2": round(r2, 4),
+        "dropped_events": doc.get("otherData", {}).get(
+            "dropped_events", 0),
+        "head_clipped_events": clipped_ev,
+        "head_clipped_steps": clipped_steps,
+    }
+    if flight:
+        qw = sorted(r["queue_wait_s"] for r in flight
+                    if r.get("queue_wait_s") is not None)
+        tt = sorted(r["ttft_s"] for r in flight
+                    if r.get("ttft_s") is not None)
+        ch = sorted(r["prefill_chunks"] for r in flight
+                    if r.get("prefill_chunks"))
+        meta["flight"] = {
+            "records": len(flight),
+            "queue_wait_p50_s": round(_pct(qw, 50), 6),
+            "queue_wait_p95_s": round(_pct(qw, 95), 6),
+            "ttft_p50_s": round(_pct(tt, 50), 6),
+            "ttft_p95_s": round(_pct(tt, 95), 6),
+            "prefill_chunks_p50": _pct(ch, 50),
+        }
+    return {
+        "step_base_s": round(base_us / 1e6, 9),
+        "step_per_token_s": round(per_tok_us / 1e6, 9),
+        "host_per_step_s": round(host_us / 1e6, 9),
+        "active_frac": round(active_frac, 4),
+        "decode_table": decode_table,
+        "meta": meta,
     }
 
 
@@ -223,7 +427,10 @@ def print_table(rec, out=sys.stdout):
           "windows — synchronous engine or overlap off)\n")
     if rec["dropped_events"]:
         w(f"NOTE: ring dropped {rec['dropped_events']} oldest events — "
-          f"totals cover the surviving window only\n")
+          f"totals cover the surviving window only "
+          f"(head repair clipped {rec['head_clipped_events']} orphaned "
+          f"events and {rec['head_clipped_steps']} partial first "
+          f"step(s))\n")
     w("\n")
 
 
@@ -235,6 +442,13 @@ def main(argv=None):
                                   "(serve_bench --trace OUT.json)")
     ap.add_argument("--json-only", action="store_true",
                     help="skip the table; print only the record line")
+    ap.add_argument("--fit", metavar="OUT.json", default=None,
+                    help="fit the fleet simulator's cost model from the "
+                         "trace and write it here (sim_calibration.json; "
+                         "loaded by paddle_tpu.sim.CostModel.from_json)")
+    ap.add_argument("--flight", metavar="FLIGHT.json", default=None,
+                    help="flight-recorder dump (/debug/requests JSON) to "
+                         "summarize into the calibration's meta")
     args = ap.parse_args(argv)
 
     doc, events, tracks = load_trace(args.trace)
@@ -243,6 +457,30 @@ def main(argv=None):
         rec["error"] = "no engine.step spans in trace"
     elif not args.json_only:
         print_table(rec)
+    if args.fit is not None:
+        flight = None
+        if args.flight is not None:
+            with open(args.flight, "r", encoding="utf-8") as f:
+                flight = json.load(f)
+            if isinstance(flight, dict):
+                flight = flight.get("requests", [])
+        cal = fit(doc, events, tracks, flight=flight,
+                  trace_path=args.trace)
+        with open(args.fit, "w", encoding="utf-8") as f:
+            json.dump(cal, f, indent=1, sort_keys=True)
+            f.write("\n")
+        rec["fit"] = {
+            "calibration": args.fit,
+            "steps_fit": cal["meta"]["steps_fit"],
+            "r2": cal["meta"]["r2"],
+            "step_base_ms": round(cal["step_base_s"] * 1e3, 4),
+            "step_per_token_us": round(cal["step_per_token_s"] * 1e6, 4),
+            "host_per_step_ms": round(cal["host_per_step_s"] * 1e3, 4),
+            "decode_table_rows": len(cal["decode_table"]),
+        }
+        if not args.json_only and rec["steps"]:
+            print(f"cost-model fit: {cal['meta']['steps_fit']} steps, "
+                  f"r2 {cal['meta']['r2']:.3f} -> {args.fit}")
     print(json.dumps(rec))
     sys.stdout.flush()
     return 0 if rec["steps"] else 1
