@@ -853,6 +853,80 @@ def lint_source(text: str, path: str = "<string>") -> list:
                          "per-token round trips; drain committed tokens "
                          "once per launch, after the loop returns")
 
+        # ---- host-copy-in-step-path (serving tier only) --------------------
+        # Hierarchical-KV contract: spill and restore transfers — a KV
+        # page crossing the host/device boundary — happen at the STEP
+        # BOUNDARY (the tier drain), never inside the step's hot phases.
+        # dispatch/prestage/complete sit on the critical path of every
+        # token; a PCIe-sized page copy there stalls the async pipeline
+        # for milliseconds per page.  Seed: defs named like the hot
+        # phases, minus anything drain-named (the drain IS the
+        # sanctioned boundary); close over nested defs and by-name/
+        # self-method callees, the dispatch-path fixpoint — drain-named
+        # callees stay out so `self._drain_kv_tier()` never drags the
+        # drain body into the hot set.  Flag: a transfer call
+        # (np.asarray/np.array/jax.device_put/device_get) whose operand
+        # reads like a KV page pool.
+        hot_set = {d for d in ctx.defs
+                   if ("dispatch" in d.name or "prestage" in d.name
+                       or "complete" in d.name)
+                   and "drain" not in d.name}
+        changed = True
+        while changed:
+            changed = False
+            for d in list(hot_set):
+                for node in ast.walk(d):
+                    callee = None
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) \
+                            and node not in hot_set:
+                        if "drain" not in node.name:
+                            hot_set.add(node)
+                            changed = True
+                        continue
+                    if isinstance(node, ast.Call):
+                        if isinstance(node.func, ast.Name):
+                            callee = node.func.id
+                        elif isinstance(node.func, ast.Attribute) \
+                                and isinstance(node.func.value, ast.Name) \
+                                and node.func.value.id == "self":
+                            callee = node.func.attr
+                    if callee is not None and "drain" not in callee:
+                        for cd in ctx.by_name.get(callee, ()):
+                            if cd not in hot_set:
+                                hot_set.add(cd)
+                                changed = True
+
+        def _kv_page_operand(expr) -> str | None:
+            for n in ast.walk(expr):
+                name = n.id if isinstance(n, ast.Name) else (
+                    n.attr if isinstance(n, ast.Attribute) else None)
+                if name and _KV_PAGE_RE.search(name):
+                    return name
+            return None
+
+        for d in hot_set:
+            for node in _walk_own(d):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                dd = _dotted(node.func) or ()
+                if not dd:
+                    continue
+                np_copy = len(dd) >= 2 and dd[0] in ctx.np_aliases \
+                    and dd[-1] in ("asarray", "array")
+                transfer = dd[-1] in ("device_put", "device_get")
+                if not (np_copy or transfer):
+                    continue
+                hit = _kv_page_operand(node.args[0])
+                if hit is not None:
+                    emit("host-copy-in-step-path", node,
+                         f"`{'.'.join(dd)}()` moves KV page operand "
+                         f"{hit!r} across the host/device boundary "
+                         f"inside step hot phase `{d.name}` — spill and "
+                         "restore transfers belong in the step-boundary "
+                         "tier drain, where they overlap with host "
+                         "scheduling instead of stalling dispatch")
+
     # ---- untuned-pallas-launch (ops/pallas only) -------------------------
     # Autotuner contract: every Pallas launch's geometry (block sizes,
     # grid blocking, page-walk width) flows from the tuning-cache lookup

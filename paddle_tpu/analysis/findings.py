@@ -156,6 +156,14 @@ RULES = {
         "materialization inside the body's call graph forces one sync "
         "per iteration, quietly turning the K-step on-device window "
         "back into per-token round trips")),
+    "host-copy-in-step-path": (WARNING, "ast", (
+        "a KV-page transfer (np.asarray()/np.array()/jax.device_put()/"
+        "device_get() on a page-pool-like operand) inside an "
+        "inference-tier step hot phase (dispatch/prestage/complete) — "
+        "the hierarchical-KV contract is that spill and restore copies "
+        "cross the host/device boundary only in the step-boundary tier "
+        "drain; a PCIe-sized page copy on the dispatch critical path "
+        "stalls the async pipeline for milliseconds per page")),
     "nondeterministic-sim": (WARNING, "ast", (
         "a wall-clock read (time.time/perf_counter/monotonic), "
         "datetime.now/utcnow/today, or a global unseeded RNG call "

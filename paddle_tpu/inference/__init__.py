@@ -20,7 +20,8 @@ __all__ = ["Config", "Predictor", "PredictorPool", "Tensor",
            "convert_to_mixed_precision",
            "BlockManager", "BlockPoolExhausted", "LLMEngine", "Request",
            "RequestOutput", "Drafter", "NGramDrafter", "DraftModelDrafter",
-           "FaultPlan", "InjectedFault", "DegradationController"]
+           "FaultPlan", "InjectedFault", "DegradationController",
+           "HostSpillPool"]
 
 
 def __getattr__(name):
@@ -43,6 +44,9 @@ def __getattr__(name):
     if name == "DegradationController":
         from .pressure import DegradationController
         return DegradationController
+    if name == "HostSpillPool":
+        from .kv_tier import HostSpillPool
+        return HostSpillPool
     raise AttributeError(name)
 
 

@@ -62,6 +62,14 @@ def _build_engine(args):
     def make_engine():
         # shares the model (same weights!) so supervised recovery can
         # rebuild the engine and replay journals byte-identically
+        kv_tier = None
+        if args.host_kv_bytes > 0:
+            # per-engine tier: each replica spills to its own host pool
+            # (chain hashes are replica-local residency claims).  A
+            # supervised rebuild gets a fresh tier — spilled pages are
+            # a cache, not state recovery depends on.
+            from ..kv_tier import HostSpillPool
+            kv_tier = HostSpillPool(args.host_kv_bytes)
         return LLMEngine(
             model, max_num_seqs=args.max_num_seqs,
             block_size=args.block_size,
@@ -70,7 +78,7 @@ def _build_engine(args):
             enable_prefix_caching=not args.no_prefix_caching,
             drafter=drafter, spec_k=args.spec_k,
             kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype,
-            tp=args.tp, retain_outputs=False)
+            tp=args.tp, retain_outputs=False, kv_tier=kv_tier)
 
     return make_engine
 
@@ -100,6 +108,12 @@ def main(argv=None) -> int:
                     help="weight pool storage dtype; int8/int4 cut "
                          "resident weight bytes 4x/8x (per-channel "
                          "scales, fused dequant-matmul kernel)")
+    ap.add_argument("--host-kv-bytes", type=int, default=0,
+                    help="host-DRAM KV spill tier capacity per engine "
+                         "replica, in bytes: pressure-evicted parked "
+                         "pages spill there instead of dying and are "
+                         "restored HBM-side when their prefix returns "
+                         "(0 disables the tier)")
     ap.add_argument("--spec-k", type=int, default=0,
                     help="speculative draft length (0 disables; >0 enables "
                          "the n-gram drafter)")

@@ -282,6 +282,30 @@ def render_metrics(snapshot: dict, *, engine=None,
              "Fraction of speculated draft tokens accepted by verify.",
              [(None, s.get("accept_rate"))])
 
+    # -- hierarchical KV (host spill tier) ---------------------------------
+    d.metric("kv_pages_spilled_total", "counter",
+             "Pressure-evicted KV pages spilled to the host tier "
+             "instead of destroyed.",
+             [(None, s.get("kv_pages_spilled"))])
+    d.metric("kv_pages_restored_total", "counter",
+             "Spilled pages restored HBM-side for returning prefixes.",
+             [(None, s.get("kv_pages_restored"))])
+    d.metric("kv_spill_dropped_total", "counter",
+             "Spill candidates the host tier refused (tier disabled, "
+             "page oversized, or unregistered).",
+             [(None, s.get("kv_spill_dropped"))])
+    d.metric("kv_prefetch_hit_pages_total", "counter",
+             "Restored pages that went on to serve a prefix-cache hit.",
+             [(None, s.get("kv_prefetch_hit_pages"))])
+    d.metric("spill_tier_hit_rate", "gauge",
+             "Fraction of spill-tier consults that found the requested "
+             "chain hash resident.",
+             [(None, s.get("spill_tier_hit_rate"))])
+    d.metric("host_kv_bytes", "gauge",
+             "Host spill-tier bytes, by kind (resident vs capacity).",
+             [({"kind": "resident"}, s.get("host_kv_bytes_resident")),
+              ({"kind": "capacity"}, s.get("host_kv_bytes_capacity"))])
+
     # -- replica routing --------------------------------------------------
     if router is not None:
         d.metric("replicas", "gauge",
@@ -309,7 +333,9 @@ def render_metrics(snapshot: dict, *, engine=None,
                  "KV page pool occupancy, by state.",
                  [({"state": "used"}, pool.num_used),
                   ({"state": "free"}, pool.num_free),
-                  ({"state": "cached"}, pool.num_cached)])
+                  ({"state": "cached"}, pool.num_cached),
+                  ({"state": "spill_pending"},
+                   getattr(pool, "num_spill_pending", 0))])
         d.metric("engine_running_seqs", "gauge",
                  "Sequences in the decode batch.",
                  [(None, len(engine._running))])

@@ -168,6 +168,15 @@ class ReplicaRouter:
             while len(reg) > self.registry_cap:
                 reg.popitem(last=False)
 
+        if hit:
+            # a registry match means this replica served the prefix
+            # before — if pressure has since spilled those pages to its
+            # host tier, the hint lets the engine pre-stage them at the
+            # next step boundary, ahead of this request's admission
+            hint = getattr(self.runners[idx].engine, "prefetch_hint", None)
+            if hint is not None:
+                hint(hashes)
+
         settled = [False]
 
         def deliver_wrapped(ev, _deliver=deliver):

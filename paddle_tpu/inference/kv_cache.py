@@ -100,6 +100,13 @@ class BlockManager:
         # The quantized engine drains this each step and resets the scale
         # rows device-side before any new write lands.
         self._fresh: set = set()
+        # hierarchical-KV spill quarantine: when a host tier is attached
+        # (spill_on_evict=True, set by the engine), evict_parked moves
+        # registered LRU pages here instead of freeing them — the device
+        # bytes must survive until the engine's step-boundary drain
+        # copies them host-side.  block id -> tuple of chain hashes.
+        self.spill_on_evict = False
+        self._spill_pending: dict = {}
         # counters for the scheduler stats surface
         self.alloc_count = 0
         self.free_count = 0
@@ -109,6 +116,8 @@ class BlockManager:
         self.cow_count = 0
         self.eviction_count = 0
         self.parked_evicted = 0
+        self.spill_quarantined = 0    # pages routed to the spill drain
+        self.spill_restored = 0       # pages adopted back from the tier
         # fault-injection seam: a nullary callable returning True while a
         # FaultPlan simulates pool exhaustion (allocation pressure without
         # shrinking the pool); None -> zero cost
@@ -129,8 +138,17 @@ class BlockManager:
         return len(self._cached)
 
     @property
+    def num_spill_pending(self) -> int:
+        """Pages quarantined for the host-tier spill drain.  They free at
+        the next step boundary, so pressure accounting may credit them as
+        reclaimable headroom — but the allocator must NOT hand them out
+        (their device bytes are still awaited by the drain)."""
+        return len(self._spill_pending)
+
+    @property
     def num_used(self) -> int:
-        return (self.num_blocks - 1) - len(self._free) - len(self._cached)
+        return (self.num_blocks - 1) - len(self._free) \
+            - len(self._cached) - len(self._spill_pending)
 
     def can_allocate(self, n_blocks: int) -> bool:
         if self._fault_hook is not None and self._fault_hook():
@@ -553,19 +571,84 @@ class BlockManager:
 
     def evict_parked(self, n: int) -> int:
         """Proactively evict up to ``n`` LRU parked (refcount-0 cached)
-        pages back to the free list — the degradation controller's
-        tier-3 lever: trade future prefix-cache hits for immediate
-        allocation headroom.  Counted separately from demand evictions
-        (``eviction_count`` is _take_block's last-resort path).
-        Returns the number of pages actually evicted."""
+        pages — the degradation controller's tier-3 lever: trade future
+        prefix-cache hits for immediate allocation headroom.  Counted
+        separately from demand evictions (``eviction_count`` is
+        _take_block's last-resort path).
+
+        With a host spill tier attached (``spill_on_evict``) this is
+        spill-first instead of kill: a registered page is quarantined in
+        ``_spill_pending`` with its chain hashes — unregistered from the
+        hash maps (it can no longer serve HBM hits) but NOT freed, since
+        its device bytes must survive until the engine's step-boundary
+        drain copies them into the host pool and calls
+        ``take_spill_pending``.  Hashless pages free immediately either
+        way.  Returns the number of pages evicted (spilled or freed)."""
         done = 0
         while done < int(n) and self._cached:
             blk, _ = self._cached.popitem(last=False)     # oldest first
+            hashes = tuple(sorted(self._block_hashes.get(blk, ())))
             self._unregister(blk)
-            self._free.append(blk)
+            if self.spill_on_evict and hashes:
+                self._spill_pending[blk] = hashes
+                self.spill_quarantined += 1
+            else:
+                self._free.append(blk)
             done += 1
         self.parked_evicted += done
         return done
+
+    def take_spill_pending(self) -> list:
+        """Engine step-boundary drain: pop every quarantined page as
+        ``(block, chain_hashes)`` and return the blocks to the free
+        list.  The CALLER must materialize the pages' device bytes
+        host-side before issuing any new device write — freed blocks can
+        be handed out again the same step.  Sorted for determinism."""
+        if not self._spill_pending:
+            return []
+        out = sorted(self._spill_pending.items())
+        self._spill_pending.clear()
+        for blk, _ in out:
+            self._free.append(blk)
+        return out
+
+    def adopt_restored(self, hashes):
+        """Re-register one page restored from the host tier: claim a page
+        from the FREE list only (a restore is opportunistic — it must
+        never evict parked HBM content to make room), register it under
+        every chain hash in ``hashes``, and park it refcount-0 in the
+        cached LRU as most-recent, exactly as if a sequence had just
+        retired it.  From here the normal content-addressed machinery —
+        refcounted sharing, CoW, parking, eviction (or re-spill) — applies
+        untouched.  Returns the block id, or None when no free page or no
+        unclaimed hash is available (the caller keeps the host copy).
+
+        The block is explicitly discarded from the fresh set: the caller
+        restores the page's quantization scale rows along with its data
+        (int8 mode), and the engine's fresh-mask scale reset would zero
+        those freshly restored scales."""
+        if not self._free:
+            return None
+        hashes = [h for h in hashes if h not in self._hash_to_block]
+        if not hashes:
+            return None
+        blk = self._free.pop()
+        self._fresh.discard(blk)
+        for h in hashes:
+            self._register(blk, h)
+        self._cached[blk] = None          # park as most-recently-used
+        self.spill_restored += 1
+        return blk
+
+    def has_hash(self, h) -> bool:
+        """True when a chain hash is servable from the HBM prefix cache
+        (live or parked) — the spill tier need not restore it."""
+        return h in self._hash_to_block
+
+    def chain_hashes(self, seq_id) -> list:
+        """Chain hashes of seq_id's full hit/registered prefix pages, in
+        order (prefetch-hit attribution reads these)."""
+        return list(self._chain.get(seq_id, ()))
 
     def drain_fresh(self) -> list:
         """Pages handed out (via ``_take_block``) since the last drain,
@@ -642,6 +725,9 @@ class BlockManager:
             "cow_count": self.cow_count,
             "eviction_count": self.eviction_count,
             "parked_evicted": self.parked_evicted,
+            "spill_pending": self.num_spill_pending,
+            "spill_quarantined": self.spill_quarantined,
+            "spill_restored": self.spill_restored,
         }
 
     # -- invariants (test surface) ------------------------------------------
@@ -651,14 +737,23 @@ class BlockManager:
         usable = self.num_blocks - 1
         free, cached, live = set(self._free), set(self._cached), \
             set(self._ref)
+        spill = set(self._spill_pending)
         assert len(self._free) == len(free), "duplicate ids on free list"
         assert not (free & cached), "block both free and cached"
         assert not (free & live), "block both free and live"
         assert not (cached & live), "block both cached and live"
-        assert len(free) + len(cached) + len(live) == usable, (
+        assert not (spill & (free | cached | live)), \
+            "spill-pending block also free/cached/live"
+        assert len(free) + len(cached) + len(live) + len(spill) \
+            == usable, (
             f"pool accounting broken: {len(free)} free + {len(cached)} "
-            f"cached + {len(live)} live != {usable}")
-        assert NULL_BLOCK not in free | cached | live, "null page leaked"
+            f"cached + {len(live)} live + {len(spill)} spill-pending "
+            f"!= {usable}")
+        assert NULL_BLOCK not in free | cached | live | spill, \
+            "null page leaked"
+        for blk in spill:
+            assert blk not in self._block_hashes, \
+                f"spill-pending block {blk} still registered"
         counts: dict = {}
         for seq, table in self._tables.items():
             assert len(table) == len(set(table)), \

@@ -14,7 +14,12 @@ escalating tiers keyed on the live free-page fraction:
                  429 + a Retry-After derived from the free-page trend
     EVICT_PARKED proactively evict LRU parked (refcount-0 cached)
                  pages a few per step, trading future prefix-cache
-                 hits for headroom now
+                 hits for headroom now.  With a host spill tier
+                 attached (inference/kv_tier.py) this lever is
+                 SPILL-FIRST: registered pages quarantine for the
+                 engine's step-boundary drain and live on host-side
+                 instead of dying, so the trade becomes
+                 hit-latency-for-headroom rather than hits-for-headroom
 
 Escalation is immediate — a pressure spike engages the right tier the
 same step.  De-escalation is hysteretic: the controller steps *one*
@@ -96,6 +101,11 @@ class DegradationController:
         total = blocks.num_blocks - 1  # slot 0 is the null block
         self._total = total
         reclaimable = int(getattr(blocks, "num_cached", 0))
+        # spill-quarantined pages are headroom too: they free
+        # unconditionally at the next step-boundary drain, so counting
+        # them as used would double-escalate the very lever (spill-first
+        # EVICT_PARKED) that created them
+        reclaimable += int(getattr(blocks, "num_spill_pending", 0))
         free = min(blocks.num_free + reclaimable + int(spec_reserved), total)
         f = free / total if total > 0 else 1.0
         self._history.append((time.monotonic(), free))

@@ -80,7 +80,8 @@ from ..ops.pallas import paged_attention as _pa
 from ..ops.pallas import quant_matmul as _qm
 from ..profiler import RecordEvent, ServingStats
 from .faults import InjectedFault
-from .kv_cache import NULL_BLOCK, BlockManager, BlockPoolExhausted
+from .kv_cache import (NULL_BLOCK, BlockManager, BlockPoolExhausted,
+                       prefix_chain_hashes)
 from .policy import pack_prefill_chunks
 from .pressure import STATE_NAMES as _TIER_NAMES
 from .sampling import (advance_keys, make_samp, samp_structs,
@@ -113,6 +114,7 @@ class Request:
     spec_proposed: int = 0            # drafts sent to verify (lifetime)
     spec_accepted: int = 0            # drafts accepted (lifetime)
     spec_disabled: bool = False       # acceptance fell below the floor
+    tier_checked: int = -1            # spill-tier generation last consulted
     # streaming hooks (both called from the engine's stepping thread)
     on_token: object = None           # callable(rid, token) per emission
     on_finish: object = None          # callable(RequestOutput) at the end
@@ -296,7 +298,8 @@ class LLMEngine:
                  kv_dtype: str = "float32", tp: int = 1,
                  tracer=None, overlap: bool = True,
                  decode_window: int = 1,
-                 weight_dtype: str = "float32"):
+                 weight_dtype: str = "float32",
+                 kv_tier=None):
         cfg = model.config
         self.config = cfg
         self.params = model.decode_params()
@@ -354,6 +357,17 @@ class LLMEngine:
                 f"num_blocks={num_blocks} cannot hold even one "
                 f"max_model_len={self.max_model_len} sequence "
                 f"({self.nblk} pages needed)")
+        # hierarchical KV: a HostSpillPool (inference/kv_tier.py) turns
+        # EVICT_PARKED from kill into spill — pages quarantine in the
+        # pool and move host-side at the step-boundary drain, and
+        # admission gets them back as ordinary prefix-cache content
+        self.kv_tier = kv_tier
+        if kv_tier is not None:
+            self.blocks.spill_on_evict = True
+        # chain hashes restored from the tier and not yet claimed by an
+        # admission hit (prefetch-hit attribution is by hash, so block
+        # reuse can never misattribute)
+        self._staged_hashes: set = set()
 
         self._nh = cfg.num_attention_heads
         self._kvh = cfg.num_key_value_heads
@@ -383,6 +397,7 @@ class LLMEngine:
             # without resharding transfers
             self.params = self._shard_params(self.params)
             kv_sh = NamedSharding(self._mesh, P(None, None, "tp"))
+            self._kv_sharding = kv_sh
             self._kc = jax.device_put(self._kc, kv_sh)
             self._vc = jax.device_put(self._vc, kv_sh)
             if self._ks is not None:
@@ -887,6 +902,29 @@ class LLMEngine:
         return sum(1 for Tq in self._ragged_progs
                    if Tq > self.max_num_seqs)
 
+    def precompile_buckets(self) -> tuple:
+        """Register the ragged-launch program for every reachable
+        flat-token bucket, so no jit build ever lands inside the
+        serving path.  The ladder is closed-form from the launch
+        geometry: the decode-sized bucket, the speculation tier when a
+        drafter is attached, and every prefill_token_bucket multiple up
+        to the worst packable launch (a full max_prefill_tokens chunk
+        budget plus every running row's tokens).  Idempotent; returns
+        the ladder.  ``compile_counts`` lands at the ladder size and —
+        because every later launch hits a registered bucket — stays
+        there for the engine's whole life, which is what lets an A/B
+        harness assert that a code path under test (e.g. the KV spill
+        tier's restores) introduced no programs of its own."""
+        tb = self.prefill_token_bucket
+        ceiling = self.max_prefill_tokens + self._Lq
+        ladder = {self.max_num_seqs}
+        if self._with_logits and self.max_num_seqs < self._Lq < tb:
+            ladder.add(self._Lq)
+        ladder.update(range(tb, (-(-ceiling // tb) + 1) * tb, tb))
+        for Tq in sorted(ladder):
+            self._get_ragged_prog(Tq)
+        return tuple(sorted(ladder))
+
     def run(self) -> dict:
         """Drive step() until every queued request finishes.  Outputs by
         rid; the run's metrics (incl. cache hits/misses, CoW copies,
@@ -943,6 +981,8 @@ class LLMEngine:
         """One dict of serving metrics + block-pool state for this run."""
         out = self.stats.summary()
         out["block_pool"] = self.blocks.stats()
+        if self.kv_tier is not None:
+            out["kv_tier"] = self.kv_tier.stats()
         out["kv_dtype"] = self.kv_dtype
         out["tp"] = self.tp
         out["kv_bytes_resident"] = self.kv_bytes_resident()
@@ -1180,6 +1220,11 @@ class LLMEngine:
             # work first, INSIDE that window, then block on the ticket
             self._prestage(tr)
             self._complete(tr, finished)
+        if self.kv_tier is not None:
+            # step boundary: no launch is in flight (completion above
+            # materialized the pools), and restores land before this
+            # step's admission packs only the residual prefill suffix
+            self._drain_kv_tier(tr)
         self._dispatch(tr)
         if not self.overlap and self._inflight is not None:
             self._complete(tr, finished)
@@ -1869,6 +1914,136 @@ class LLMEngine:
         if req.on_finish is not None:
             req.on_finish(out)
 
+    # ------------------------------------------------------------------
+    # hierarchical KV tier (host-DRAM spill pool, inference/kv_tier.py)
+    # ------------------------------------------------------------------
+
+    def prefetch_hint(self, hashes) -> None:
+        """Pre-stage a returning user's spilled pages: queue the prefix
+        chain hashes of a prompt about to be submitted so the next
+        step-boundary drain restores them before the prefill is packed.
+        THREAD-SAFE (the tier's hint deque is locked) — the one engine
+        entry point the frontend router may call off-thread.  No-op
+        without a tier."""
+        tier = self.kv_tier
+        if tier is not None:
+            tier.hint(hashes)
+
+    def _drain_kv_tier(self, tr) -> None:
+        """Step-boundary tier drain — the ONLY place spill/restore bytes
+        cross the HBM/host boundary (graft-lint's host-copy-in-step-path
+        keeps it out of the dispatch/prestage/complete hot phases).
+        Spill: pages evict_parked quarantined copy out to the host pool
+        and their HBM blocks free.  Restore: router prefetch hints, then
+        the waiting queue's prompt chains, pull tier-resident pages back
+        into free HBM blocks, re-registered content-addressed — from
+        admission's point of view they are ordinary prefix-cache
+        content.  Everything is eager array ops on materialized pools:
+        ``compile_counts`` is untouched and restored bytes are the exact
+        spilled bytes (the A/B byte-identity pin)."""
+        tier = self.kv_tier
+        int8 = self.kv_dtype == "int8"
+        pending = self.blocks.take_spill_pending()
+        if pending:
+            blks = np.array([b for b, _ in pending], np.int32)
+            kc = np.asarray(self._kc[:, blks])
+            vc = np.asarray(self._vc[:, blks])
+            if int8:
+                ks = np.asarray(self._ks[:, blks])
+                vs = np.asarray(self._vs[:, blks])
+            stored = 0
+            for i, (blk, hashes) in enumerate(pending):
+                arrays = {"kc": kc[:, i], "vc": vc[:, i]}
+                if int8:
+                    arrays["ks"] = ks[:, i]
+                    arrays["vs"] = vs[:, i]
+                if tier.insert(hashes, arrays):
+                    stored += 1
+                # these hashes left HBM: a past restore no longer backs
+                # a future admission hit
+                self._staged_hashes.difference_update(hashes)
+            self.stats.record_kv_spill(len(pending), stored)
+            if tr is not None:
+                tr.instant("kv_tier.spill", track=self._trace_track,
+                           args={"pages": len(pending), "stored": stored})
+
+        restored = []                     # [(block, tier entry)]
+        for h in self._tier_wanted_hashes(tier):
+            if not self.blocks.num_free:
+                break                     # opportunistic: never evict
+            if self.blocks.has_hash(h):
+                continue                  # covered earlier this drain
+            entry = tier.take(h)
+            if entry is None:
+                continue
+            blk = self.blocks.adopt_restored(entry["hashes"])
+            if blk is None:               # unreachable given the guards
+                tier.insert(entry["hashes"], entry["arrays"])
+                break
+            restored.append((blk, entry))
+            self._staged_hashes.update(entry["hashes"])
+        if restored:
+            blks = np.array([b for b, _ in restored], np.int32)
+            kc = np.stack([e["arrays"]["kc"] for _, e in restored], axis=1)
+            vc = np.stack([e["arrays"]["vc"] for _, e in restored], axis=1)
+            self._kc = self._kc.at[:, blks].set(kc)
+            self._vc = self._vc.at[:, blks].set(vc)
+            if int8:
+                # scale rows travel with their pages; restored blocks are
+                # NOT fresh (adopt_restored discarded them), so the
+                # launch's fresh-mask reset cannot zero these rows
+                ks = np.stack([e["arrays"]["ks"] for _, e in restored],
+                              axis=1)
+                vs = np.stack([e["arrays"]["vs"] for _, e in restored],
+                              axis=1)
+                self._ks = self._ks.at[:, blks].set(ks)
+                self._vs = self._vs.at[:, blks].set(vs)
+            if self.tp > 1:
+                # keep the pools' mesh layout exactly as constructed so
+                # the compiled step sees identically-sharded donations
+                self._kc = jax.device_put(self._kc, self._kv_sharding)
+                self._vc = jax.device_put(self._vc, self._kv_sharding)
+                if int8:
+                    self._ks = jax.device_put(self._ks, self._kv_sharding)
+                    self._vs = jax.device_put(self._vs, self._kv_sharding)
+            self.stats.record_kv_restore(len(restored))
+            if tr is not None:
+                tr.instant("kv_tier.restore", track=self._trace_track,
+                           args={"pages": len(restored)})
+        self.stats.set_spill_tier(tier.stats())
+
+    def _tier_wanted_hashes(self, tier) -> list:
+        """Chain hashes worth restoring this drain, in chain order,
+        deduped: router prefetch hints first (pre-staging a returning
+        user), then the waiting queue's front prompts (admission's tier
+        consult on a prefix-cache miss, one-shot per waiting episode).
+        Each chain walks while its prefix stays servable — HBM-resident
+        hashes skip, tier-resident ones restore, and the walk stops at
+        the first hash neither holds (a contiguous prefix match can
+        never reach later pages)."""
+        chains = tier.drain_hints()
+        n = 0
+        for req in self._waiting:
+            if n >= self.max_num_seqs:
+                break
+            n += 1
+            if req.tier_checked == tier.gen:
+                continue          # nothing new spilled since last consult
+            req.tier_checked = tier.gen
+            chains.append(prefix_chain_hashes(req.tokens, self.block_size))
+        wanted: list = []
+        seen: set = set()
+        for chain in chains:
+            for h in chain:
+                if self.blocks.has_hash(h) or h in seen:
+                    continue
+                if tier.lookup(h):
+                    seen.add(h)
+                    wanted.append(h)
+                else:
+                    break
+        return wanted
+
     def _claim_slot(self, req) -> None:
         req.slot = self._slot_used.index(False)
         self._slot_used[req.slot] = True
@@ -1895,6 +2070,16 @@ class LLMEngine:
                     break
                 req.cached = hit
                 self.stats.record_cache_lookup(hit, len(req.tokens) - hit)
+                if hit and self._staged_hashes:
+                    # prefetch-hit attribution: hit pages whose chain
+                    # hashes a tier restore staged (by hash, so block
+                    # reuse cannot misattribute); each staged hash pays
+                    # out at most once
+                    used = [h for h in self.blocks.chain_hashes(req.rid)
+                            if h in self._staged_hashes]
+                    if used:
+                        self._staged_hashes.difference_update(used)
+                        self.stats.record_prefetch_hits(len(used))
             else:
                 if not self.blocks.allocate(req.rid, len(req.tokens)):
                     break
@@ -2014,6 +2199,8 @@ class LLMEngine:
         self._release_slot(req)
         req.tokens = list(req.prompt) + list(req.generated)
         req.cached = 0
+        # its freed pages may spill while it waits: re-consult the tier
+        req.tier_checked = -1
         self._invalidate_bt(req.rid)
         self._waiting.appendleft(req)
         if self.drafter is not None:

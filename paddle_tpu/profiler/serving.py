@@ -250,6 +250,21 @@ class ServingStats:
             self, "weight_bytes_resident", 0)
         self.weight_bytes_resident_per_shard = getattr(
             self, "weight_bytes_resident_per_shard", 0)
+        # hierarchical-KV spill tier (PR 20): counters for pages crossing
+        # the HBM<->host boundary plus tier gauges the engine pushes at
+        # each step-boundary drain.  The gauges SURVIVE reset like the
+        # weight gauges — benches reset between passes and the attached
+        # tier object (with its cumulative consult counters) doesn't move
+        self.kv_pages_spilled = 0        # pages stored into the host tier
+        self.kv_pages_restored = 0       # pages restored back into HBM
+        self.kv_spill_dropped = 0        # quarantined pages the tier refused
+        self.kv_prefetch_hit_pages = 0   # restored pages admission hits used
+        self.spill_tier_hits = getattr(self, "spill_tier_hits", 0)
+        self.spill_tier_misses = getattr(self, "spill_tier_misses", 0)
+        self.host_kv_bytes_resident = getattr(
+            self, "host_kv_bytes_resident", 0)
+        self.host_kv_bytes_capacity = getattr(
+            self, "host_kv_bytes_capacity", 0)
         # SLO-observatory surface (PR 13): queue wait (arrival ->
         # admission) joins the lifetime reservoirs, and an OPT-IN
         # windowed layer (profiler/slo.py) rides beside them — None
@@ -503,6 +518,34 @@ class ServingStats:
     def record_parked_evictions(self, n: int = 1) -> None:
         self.parked_evictions += int(n)
 
+    def record_kv_spill(self, quarantined: int, stored: int) -> None:
+        """One step-boundary spill drain: ``quarantined`` pages left the
+        HBM pool, ``stored`` of them landed in the host tier (the rest
+        were counted drops — tier full of bigger pages, or disabled)."""
+        self.kv_pages_spilled += int(stored)
+        self.kv_spill_dropped += int(quarantined) - int(stored)
+
+    def record_kv_restore(self, n: int = 1) -> None:
+        """Pages restored from the host tier into free HBM blocks and
+        re-registered in the prefix cache."""
+        self.kv_pages_restored += int(n)
+
+    def record_prefetch_hits(self, n_pages: int = 1) -> None:
+        """Restored pages a later admission's prefix-cache hit actually
+        used (attributed by chain hash) — the tier's payoff counter."""
+        self.kv_prefetch_hit_pages += int(n_pages)
+
+    def set_spill_tier(self, tier_stats: dict) -> None:
+        """Absorb the attached HostSpillPool's gauge snapshot (its
+        ``stats()`` dict): cumulative consult hits/misses and resident/
+        capacity bytes.  Pushed by the engine after every drain."""
+        self.spill_tier_hits = int(tier_stats.get("hits", 0))
+        self.spill_tier_misses = int(tier_stats.get("misses", 0))
+        self.host_kv_bytes_resident = int(
+            tier_stats.get("bytes_resident", 0))
+        self.host_kv_bytes_capacity = int(
+            tier_stats.get("capacity_bytes", 0))
+
     def record_tuning(self, kernel: str, hit: bool) -> None:
         """One tuning-cache lookup for a kernel's launch geometry (the
         engine resolves each registered kernel once at build)."""
@@ -559,6 +602,12 @@ class ServingStats:
     def accept_rate(self) -> float:
         return self.draft_accepted / self.draft_proposed \
             if self.draft_proposed else 0.0
+
+    def spill_tier_hit_rate(self) -> float:
+        """Fraction of spill-tier consults (admission chain walks +
+        router prefetch hints) that found a resident page."""
+        total = self.spill_tier_hits + self.spill_tier_misses
+        return self.spill_tier_hits / total if total else 0.0
 
     def snapshot(self, include_samples: bool = False) -> dict:
         """Point-in-time view of every counter and on-demand percentile.
@@ -632,6 +681,15 @@ class ServingStats:
             "weight_bytes_resident": self.weight_bytes_resident,
             "weight_bytes_resident_per_shard":
                 self.weight_bytes_resident_per_shard,
+            "kv_pages_spilled": self.kv_pages_spilled,
+            "kv_pages_restored": self.kv_pages_restored,
+            "kv_spill_dropped": self.kv_spill_dropped,
+            "kv_prefetch_hit_pages": self.kv_prefetch_hit_pages,
+            "spill_tier_hits": self.spill_tier_hits,
+            "spill_tier_misses": self.spill_tier_misses,
+            "spill_tier_hit_rate": round(self.spill_tier_hit_rate(), 4),
+            "host_kv_bytes_resident": self.host_kv_bytes_resident,
+            "host_kv_bytes_capacity": self.host_kv_bytes_capacity,
             "engine_steps": self.engine_steps,
             "step_time_s": round(self.step_time, 6),
             "dispatch_time_s": round(self.dispatch_time, 6),
@@ -686,7 +744,8 @@ class ServingStats:
     #             worst/oldest member
     #   _MEAN     unweighted mean across replicas (occupancy/queue depth
     #             are already per-engine means)
-    _RATE = ("prefix_hit_rate", "accept_rate", "tokens_per_launch")
+    _RATE = ("prefix_hit_rate", "accept_rate", "tokens_per_launch",
+             "spill_tier_hit_rate")
     _THROUGH = ("decode_tokens_per_s", "prefill_tokens_per_s",
                 "verify_tokens_per_s", "emitted_tokens_per_s")
     _MAX = ("p50_token_ms", "p99_token_ms", "itl_p50_ms", "itl_p99_ms",
@@ -752,6 +811,10 @@ class ServingStats:
         out["tokens_per_launch"] = round(
             (out["decode_tokens"] + out["verify_tokens"]) / trips, 3) \
             if trips else 0.0
+        consults = out.get("spill_tier_hits", 0) \
+            + out.get("spill_tier_misses", 0)
+        out["spill_tier_hit_rate"] = round(
+            out["spill_tier_hits"] / consults, 4) if consults else 0.0
         if all("_samples" in s for s in snaps):
             # honest fleet quantiles: pool every replica's reservoir
             # sample and recompute, replacing the max-of-quantiles

@@ -54,11 +54,18 @@ class CostModel:
 
     def __init__(self, *, step_base_s: float, step_per_token_s: float,
                  host_per_step_s: float, decode_table=None, meta=None,
-                 active_frac: float = 1.0):
+                 active_frac: float = 1.0,
+                 restore_page_s: float = 2e-5):
         self.step_base_s = float(step_base_s)
         self.step_per_token_s = float(step_per_token_s)
         self.host_per_step_s = float(host_per_step_s)
         self.active_frac = min(max(float(active_frac), 0.0), 1.0) or 1.0
+        # host->HBM cost of restoring ONE spilled KV page at a step
+        # boundary (the spill tier's drain): a host-side slice plus a
+        # device write, so roughly a PCIe-bandwidth term, not a compute
+        # one.  Charged per restored page by SimReplica; the A/B it
+        # feeds is restore-cost-vs-re-prefill-cost.
+        self.restore_page_s = float(restore_page_s)
         # {rows -> total step seconds} for pure-decode packs
         self.decode_table = {int(k): float(v)
                              for k, v in (decode_table or {}).items()}
@@ -84,7 +91,8 @@ class CostModel:
                    host_per_step_s=d["host_per_step_s"],
                    decode_table=d.get("decode_table", {}),
                    meta=d.get("meta", {}),
-                   active_frac=d.get("active_frac", 1.0))
+                   active_frac=d.get("active_frac", 1.0),
+                   restore_page_s=d.get("restore_page_s", 2e-5))
 
     @classmethod
     def from_json(cls, path: str) -> "CostModel":
@@ -97,6 +105,7 @@ class CostModel:
             "step_per_token_s": self.step_per_token_s,
             "host_per_step_s": self.host_per_step_s,
             "active_frac": self.active_frac,
+            "restore_page_s": self.restore_page_s,
             "decode_table": {str(k): v
                              for k, v in sorted(self.decode_table.items())},
             "meta": self.meta,

@@ -78,6 +78,15 @@ class ReplicaConfig:
     shifts while throughput (the virtual clock) is untouched.  1
     mirrors the engine default (``overlap=on``); validation sets it
     from the record's own ``overlap`` arm.
+
+    ``host_kv_pages``: capacity of the modeled host spill tier, in
+    pages (0 = no tier, the engine default).  Mirrors
+    ``inference/kv_tier.HostSpillPool`` at the simulator's granularity:
+    pressure-driven parked evictions spill their chain hash there
+    instead of dying, admission consults the tier on an HBM prefix
+    miss, and every restored page charges ``CostModel.restore_page_s``
+    to the step that admitted it — so a sweep over this axis trades
+    restore latency against re-prefill compute.
     """
     max_num_seqs: int = 8
     block_size: int = 8
@@ -89,6 +98,7 @@ class ReplicaConfig:
     spec_emit_per_row_step: float = 1.0
     spec_pack_tokens_per_row: float = 1.0
     pipeline_lag_steps: int = 1
+    host_kv_pages: int = 0
 
     def resolved_num_blocks(self) -> int:
         if self.num_blocks is not None:
@@ -178,6 +188,10 @@ class _Stats:
     cache_lookup_tokens: int = 0
     busy_s: float = 0.0
     slo_met: int = 0
+    spilled_pages: int = 0
+    restored_pages: int = 0
+    spill_hits: int = 0
+    spill_lookups: int = 0
 
     def reset(self) -> None:
         self.__init__()
@@ -203,6 +217,11 @@ class SimReplica:
         self._refs: dict = {}
         self._parked: OrderedDict = OrderedDict()
         self._anon = 0
+        # modeled host spill tier: chain-hash LRU, host_kv_pages deep.
+        # Only pressure evictions feed it (demand evictions die, like
+        # the real BlockManager); restores are charged in step()
+        self._spill: OrderedDict = OrderedDict()
+        self._restored_this_step = 0
         self.on_finish = None           # fleet hook: seq -> None
         self._idle = True               # event-mode: no step scheduled
         # SLO bounds stamped by the owner (fleet/validator) so requests
@@ -232,6 +251,18 @@ class SimReplica:
 
     def _pages(self, tokens: int) -> int:
         return -(-int(tokens) // self.bs)
+
+    def _spill_insert(self, h) -> None:
+        """Spill one pressure-evicted parked page's chain hash to the
+        modeled host tier (LRU, ``host_kv_pages`` deep)."""
+        cap = int(self.cfg.host_kv_pages)
+        if cap <= 0:
+            return
+        self._spill.pop(h, None)
+        while len(self._spill) >= cap:
+            self._spill.popitem(last=False)
+        self._spill[h] = None
+        self.stats.spilled_pages += 1
 
     # ------------------------------------------------------------------
     # request intake
@@ -275,6 +306,21 @@ class SimReplica:
             if self.cfg.enable_prefix_caching:
                 for h in s.req.chain_hashes[:hashable]:
                     if h in self._refs or h in self._parked:
+                        hit_pages += 1
+                    elif self.cfg.host_kv_pages > 0:
+                        # HBM miss: consult the spill tier (counted,
+                        # like HostSpillPool.lookup); a hit restores
+                        # the page into the parked set — it needs a
+                        # free HBM slot and charges restore_page_s in
+                        # this step's cost
+                        self.stats.spill_lookups += 1
+                        if h not in self._spill or self._free() < 1:
+                            break
+                        del self._spill[h]
+                        self._parked[h] = None
+                        self._restored_this_step += 1
+                        self.stats.restored_pages += 1
+                        self.stats.spill_hits += 1
                         hit_pages += 1
                     else:
                         break
@@ -360,12 +406,18 @@ class SimReplica:
         mid-step, so eager commit is safe."""
         self.ctrl.update(self.pool_view())
         if self.ctrl.evict_now:
-            # proactive parked eviction, the engine's per-step batch
+            # proactive parked eviction, the engine's per-step batch —
+            # spill-first when a host tier is configured
             for _ in range(self.ctrl.evict_batch):
                 if not self._parked:
                     break
-                self._parked.popitem(last=False)
+                h, _ = self._parked.popitem(last=False)
+                self._spill_insert(h)
         self._admit()
+        # restores the admit pass pulled back from the host tier are
+        # step-boundary device writes; they ride this step's wall time
+        restore_s = self._restored_this_step * self.cost.restore_page_s
+        self._restored_this_step = 0
 
         ordered = sorted(self._running, key=lambda s: s.arrival)
         chunks = pack_prefill_chunks(
@@ -377,7 +429,7 @@ class SimReplica:
             # nothing packable (idle, or waiting blocked on the pool):
             # the engine still burns a host-side step
             self.stats.empty_steps += 1
-            return self.cost.host_per_step_s
+            return self.cost.host_per_step_s + restore_s
 
         emit_eff, pack_eff = self._spec_eff()
         prefill_tokens = sum(n for _, n in chunks)
@@ -393,7 +445,7 @@ class SimReplica:
             k = window_chunks(remaining, self.cfg.decode_window)[0]
 
         if k > 1:
-            cost = self.cost.window_cost(len(decode_rows), k)
+            cost = self.cost.window_cost(len(decode_rows), k) + restore_s
             self.stats.window_launches += 1
             # the pipeline drains this launch while the next dispatches:
             # tokens become VISIBLE when the next launch's completion
@@ -413,7 +465,8 @@ class SimReplica:
         packed = prefill_tokens + int(len(decode_rows) * pack_eff + 0.5)
         cost = self.cost.step_cost(
             packed,
-            pure_decode_rows=len(decode_rows) if not chunks else 0)
+            pure_decode_rows=len(decode_rows) if not chunks else 0) \
+            + restore_s
         # emission-visibility: the async engine commits this launch's
         # tokens when the NEXT step's completion block returns — one
         # lag step's ACTIVE window past the cadence boundary
@@ -628,6 +681,14 @@ class SimFleet:
                 sum(r.stats.cache_hit_tokens for r in self.replicas)
                 / lookups, 4) if lookups else 0.0,
             "preemptions": sum(r.stats.preemptions for r in self.replicas),
+            "kv_spilled_pages": sum(
+                r.stats.spilled_pages for r in self.replicas),
+            "kv_restored_pages": sum(
+                r.stats.restored_pages for r in self.replicas),
+            "spill_tier_hit_rate": round(
+                sum(r.stats.spill_hits for r in self.replicas)
+                / max(sum(r.stats.spill_lookups
+                          for r in self.replicas), 1), 4),
             "degradation_tier_entries": sum(
                 r.ctrl.tier_entries for r in self.replicas),
             "steps": sum(r.stats.steps for r in self.replicas),

@@ -1,0 +1,24 @@
+"""Seeds host-copy-in-step-path: the dispatch hot phase restores a
+spilled KV page with jax.device_put — a PCIe-sized transfer on the
+critical path of every token.  The step-boundary drain (drain-named,
+the sanctioned seam for exactly these copies) and a non-page transfer
+in a hot phase stay silent."""
+import jax
+import numpy as np
+
+
+def dispatch_restore(engine, rid):
+    restored = jax.device_put(engine.spilled_kv_pages[rid])   # fires
+    return engine.enqueue(rid, restored)
+
+
+def drain_kv_tier(engine):
+    for blk in engine.tier.pending():
+        engine.stage(jax.device_put(engine.spilled_kv_pages[blk]))
+        engine.tier.insert(blk, np.asarray(engine.vc[blk]))
+    return engine.tier.stats()    # silent: the drain owns boundary copies
+
+
+def complete_tokens(engine, toks):
+    arr = np.asarray(toks)        # silent: token ids are not a KV page
+    return engine.retire(arr)

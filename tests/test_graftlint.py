@@ -56,6 +56,8 @@ def _lint_fix(name):
      "wallclock-in-timing-path", 8, "measure_step", WARNING),
     (os.path.join("inference", "fix_host_sync_dispatch.py"),
      "host-sync-in-dispatch-path", 12, "dispatch_step", WARNING),
+    (os.path.join("inference", "fix_host_copy_step_path.py"),
+     "host-copy-in-step-path", 11, "dispatch_restore", WARNING),
     (os.path.join("inference", "fix_host_sync_window.py"),
      "per-token-host-sync-in-decode-window", 23,
      "DecodeEngine._commit", WARNING),
@@ -278,7 +280,7 @@ def test_every_catalog_rule_is_exercised():
         "f32-weight-matmul-in-quantized-engine",
         "collective-outside-shard-map", "untuned-pallas-launch",
         "wallclock-in-timing-path", "host-sync-in-dispatch-path",
-        "per-token-host-sync-in-decode-window",
+        "per-token-host-sync-in-decode-window", "host-copy-in-step-path",
         "unbounded-observability-buffer", "nondeterministic-sim",
         "undonated-buffer", "host-callback", "dtype-promotion",
         "dead-code", "dead-input", "passthrough-output",

@@ -1,0 +1,328 @@
+"""Hierarchical KV tier (host-DRAM spill pool): HostSpillPool unit
+behaviour, BlockManager spill quarantine, engine-level spill/restore
+equivalence (byte-identical greedy output, zero new compiles), the
+router prefetch-hint path, and a 50-round interleaved
+admit/abort/evict/restore fuzz that pins pool accounting."""
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import BlockManager, LLMEngine
+from paddle_tpu.inference.kv_cache import prefix_chain_hashes
+from paddle_tpu.inference.kv_tier import HostSpillPool
+from paddle_tpu.inference.pressure import DegradationController
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+VOCAB = 97
+CFG = LlamaConfig.tiny(vocab=VOCAB, hidden=32, layers=2, heads=4, ffn=64,
+                       seq=128)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return LlamaForCausalLM(CFG)
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_num_seqs", 4)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_model_len", 128)
+    kw.setdefault("max_prefill_tokens", 64)
+    kw.setdefault("prefill_token_bucket", 32)
+    return LLMEngine(model, **kw)
+
+
+def _page(nbytes=64, seed=0):
+    """One fake spilled page: named host arrays summing to nbytes."""
+    rng = np.random.RandomState(seed)
+    half = nbytes // 2
+    return {"kc": rng.randint(-128, 127, half).astype(np.int8),
+            "vc": rng.randint(-128, 127, half).astype(np.int8)}
+
+
+# ---------------------------------------------------------------------------
+# HostSpillPool: bounded-byte LRU, chain-hash keyed
+# ---------------------------------------------------------------------------
+
+def test_insert_lookup_take_roundtrip():
+    pool = HostSpillPool(1024)
+    page = _page(64)
+    assert pool.insert([11], page)
+    assert pool.bytes_resident == 64
+    assert 11 in pool and len(pool) == 1
+    assert pool.lookup(11) and not pool.lookup(99)
+    entry = pool.take(11)
+    assert entry["hashes"] == (11,)
+    np.testing.assert_array_equal(entry["arrays"]["kc"], page["kc"])
+    np.testing.assert_array_equal(entry["arrays"]["vc"], page["vc"])
+    assert pool.bytes_resident == 0 and len(pool) == 0
+    assert pool.take(11) is None                    # gone after the take
+    s = pool.stats()
+    assert s["spilled_pages"] == 1 and s["restored_pages"] == 1
+    assert s["hits"] == 1 and s["misses"] == 1 and s["hit_rate"] == 0.5
+
+
+def test_capacity_zero_and_oversized_are_counted_drops():
+    off = HostSpillPool(0)                          # tier-off A/B arm
+    assert not off.insert([1], _page(64))
+    assert off.stats()["dropped_oversized"] == 1 and len(off) == 0
+    small = HostSpillPool(32)
+    assert not small.insert([2], _page(64))         # page > whole tier
+    assert small.stats()["dropped_oversized"] == 1
+    assert not small.insert([], _page(16))          # hashless: refused
+    assert small.bytes_resident == 0
+
+
+def test_lru_eviction_is_bounded_and_counted():
+    pool = HostSpillPool(256)                       # holds 4 x 64B pages
+    for h in range(6):
+        assert pool.insert([h], _page(64, seed=h))
+    assert pool.bytes_resident <= 256 and len(pool) == 4
+    assert 0 not in pool and 1 not in pool          # oldest two evicted
+    assert all(h in pool for h in (2, 3, 4, 5))
+    assert pool.stats()["dropped_evicted"] == 2
+
+
+def test_lookup_refreshes_lru_recency():
+    pool = HostSpillPool(128)                       # 2 pages deep
+    pool.insert([1], _page(64))
+    pool.insert([2], _page(64))
+    assert pool.lookup(1)                           # 1 is now most recent
+    pool.insert([3], _page(64))
+    assert 1 in pool and 2 not in pool and 3 in pool
+
+
+def test_reinsert_displaces_stale_entry_uncounted():
+    pool = HostSpillPool(1024)
+    pool.insert([7], _page(64, seed=1))
+    fresh = _page(64, seed=2)
+    pool.insert([7], fresh)                         # engine's copy is fresher
+    assert len(pool) == 1 and pool.bytes_resident == 64
+    np.testing.assert_array_equal(pool.take(7)["arrays"]["kc"], fresh["kc"])
+    s = pool.stats()
+    assert s["dropped_evicted"] == 0                # displacement, not LRU
+    assert s["spilled_pages"] == 2
+
+
+def test_take_removes_every_alias_of_the_entry():
+    pool = HostSpillPool(1024)
+    pool.insert([5, 6], _page(64))                  # one payload, two hashes
+    assert 5 in pool and 6 in pool and pool.bytes_resident == 64
+    assert pool.take(6)["hashes"] == (5, 6)
+    assert 5 not in pool and 6 not in pool and pool.bytes_resident == 0
+
+
+def test_gen_bumps_only_on_successful_insert():
+    pool = HostSpillPool(128)
+    g0 = pool.gen
+    assert not pool.insert([1], _page(256))         # oversized drop
+    assert pool.gen == g0
+    assert pool.insert([1], _page(64))
+    assert pool.gen == g0 + 1
+    pool.lookup(1)
+    pool.take(1)
+    assert pool.gen == g0 + 1                       # reads never bump
+
+
+def test_hints_are_fifo_and_overflow_is_counted():
+    pool = HostSpillPool(1024, max_hints=2)
+    pool.hint([1, 2])
+    pool.hint([3])
+    pool.hint([])                                   # empty: ignored
+    pool.hint([4, 5])                               # displaces oldest
+    assert pool.drain_hints() == [(3,), (4, 5)]
+    assert pool.drain_hints() == []                 # drained empty
+    s = pool.stats()
+    assert s["hints_received"] == 3 and s["hints_dropped"] == 1
+
+
+# ---------------------------------------------------------------------------
+# BlockManager: spill quarantine (the 4th accounted block class)
+# ---------------------------------------------------------------------------
+
+def _parked_bm(n_parked=3):
+    """A BlockManager with n_parked registered parked pages."""
+    bm = BlockManager(16, 4, enable_prefix_caching=True)
+    bm.spill_on_evict = True
+    ids = list(range(4 * n_parked))
+    bm.acquire("a", ids)
+    bm.commit_prefill("a", len(ids))
+    bm.release("a")                                 # full pages park
+    return bm
+
+
+def test_evict_parked_quarantines_instead_of_killing():
+    bm = _parked_bm(3)
+    cached0, free0 = bm.num_cached, bm.num_free
+    assert bm.evict_parked(2) == 2
+    assert bm.num_spill_pending == 2
+    assert bm.num_cached == cached0 - 2
+    assert bm.num_free == free0                     # NOT free until drained
+    for blk, hashes in bm.take_spill_pending():
+        assert hashes                               # chain hashes travel
+    assert bm.num_spill_pending == 0
+    assert bm.num_free == free0 + 2                 # drained blocks free
+    bm.check_invariants()
+
+
+def test_adopt_restored_reregisters_as_parked_cache():
+    bm = _parked_bm(2)
+    bm.evict_parked(1)
+    (blk, hashes), = bm.take_spill_pending()
+    assert not any(bm.has_hash(h) for h in hashes)  # left HBM entirely
+    nb = bm.adopt_restored(hashes)
+    assert nb is not None
+    assert all(bm.has_hash(h) for h in hashes)      # ordinary cache content
+    assert bm.stats()["spill_restored"] == 1
+    bm.check_invariants()
+    # a returning prompt hits the restored page like any parked page:
+    # both original pages (restored + surviving) cover tokens 0..7
+    assert bm.acquire("b", list(range(8)) + [99]) == 8
+    bm.check_invariants()
+
+
+def test_spill_disabled_evictions_still_kill():
+    bm = _parked_bm(2)
+    bm.spill_on_evict = False                       # no tier attached
+    free0 = bm.num_free
+    assert bm.evict_parked(2) == 2
+    assert bm.num_spill_pending == 0
+    assert bm.num_free == free0 + 2                 # killed, not quarantined
+    bm.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# engine integration
+# ---------------------------------------------------------------------------
+
+def _drive(engine, stream):
+    """stream: [(submit_step, prompt, max_new)] -> {rid: tokens}."""
+    outs = {}
+    step_no = 0
+    pending = list(stream)
+    while pending or engine.has_unfinished():
+        while pending and pending[0][0] <= step_no:
+            _, prompt, max_new = pending.pop(0)
+            engine.add_request(prompt, max_new_tokens=max_new,
+                               temperature=0.0)
+        for fo in engine.step():
+            outs[fo.rid] = tuple(fo.generated)
+        step_no += 1
+    return outs
+
+
+def _returning_stream(rng, n, n_users=4, plen=32, max_new=8):
+    users = [rng.randint(0, VOCAB, plen).tolist() for _ in range(n_users)]
+    return [(i, users[int(rng.randint(0, n_users))], max_new)
+            for i in range(n)]
+
+
+def test_spill_tier_ab_byte_identity_and_zero_new_compiles(model):
+    """The tentpole pin, at unit scale: the same returning-user stream
+    on the same starved pool, tier on vs off — greedy outputs byte-
+    identical (restored bytes ARE the spilled bytes), compile_counts
+    exactly equal (both arms precompile the ladder; restores introduce
+    no programs), and the on arm actually exercised spill+restore."""
+    results = {}
+    for cap in (0, 64 << 20):
+        tier = HostSpillPool(cap) if cap else None
+        engine = _engine(model, num_blocks=18,
+                         pressure=DegradationController(), kv_tier=tier)
+        ladder = engine.precompile_buckets()
+        assert ladder                               # ladder is non-trivial
+        compiles_pre = dict(engine.compile_counts)
+        rng = np.random.RandomState(7)
+        outs = _drive(engine, _returning_stream(rng, 32))
+        snap = engine.stats.snapshot()
+        results[cap] = {"outs": outs, "snap": snap,
+                        "compiles": dict(engine.compile_counts),
+                        "stream_compiled":
+                            engine.compile_counts != compiles_pre}
+    on = results[64 << 20]
+    off = results[0]
+    assert on["snap"]["kv_pages_spilled"] > 0
+    assert on["snap"]["kv_pages_restored"] > 0
+    assert on["snap"]["spill_tier_hit_rate"] > 0.0
+    assert off["snap"]["kv_pages_spilled"] == 0     # no tier, no spills
+    assert on["outs"] == off["outs"]                # byte-identical greedy
+    assert on["compiles"] == off["compiles"]
+    assert not on["stream_compiled"] and not off["stream_compiled"]
+    # the tier turned re-prefill work into restores
+    assert on["snap"]["cache_miss_tokens"] < off["snap"]["cache_miss_tokens"]
+
+
+def test_prefetch_hint_prestages_spilled_chain(model):
+    """The router's affinity hint: spill a finished request's pages,
+    hint its chain, and the next step's drain restores them BEFORE the
+    request is resubmitted — admission then hits the prefix cache and
+    the prefetch-hit attribution counter pays out."""
+    tier = HostSpillPool(64 << 20)
+    engine = _engine(model, num_blocks=24, kv_tier=tier)
+    prompt = list(range(32))
+    outs = _drive(engine, [(0, prompt, 4)])
+    assert len(outs) == 1
+    chain = prefix_chain_hashes(prompt, engine.block_size)
+    assert any(engine.blocks.has_hash(h) for h in chain)   # parked now
+    # force the pressure action without a controller: quarantine every
+    # parked page, then let the step-boundary drain spill them host-side
+    evicted = engine.blocks.evict_parked(engine.blocks.num_cached)
+    assert evicted >= len(chain)
+    engine.step()
+    assert not any(engine.blocks.has_hash(h) for h in chain)
+    assert all(h in tier for h in chain)
+    # the hint pre-stages the chain at the next step boundary
+    engine.prefetch_hint(chain)
+    engine.step()
+    assert all(engine.blocks.has_hash(h) for h in chain)
+    # the returning request rides the restored pages: a prefix hit with
+    # no tier content left behind, attributed to the prefetch
+    outs2 = _drive(engine, [(0, prompt, 4)])
+    snap = engine.stats.snapshot()
+    assert snap["kv_prefetch_hit_pages"] > 0
+    assert outs2.popitem()[1] == outs.popitem()[1]  # same greedy tokens
+    engine.blocks.check_invariants()
+
+
+def test_fuzz_interleaved_admit_abort_evict_restore(model):
+    """50 seeded rounds of interleaved admit / step / abort / forced
+    parked-eviction with the tier attached, then a full drain: the pool
+    must return to a free+parked-only state (zero leaked pages, no
+    stuck spill quarantine), invariants must hold at every round, and
+    the tier must have both spilled and restored along the way —
+    restored chains serving later prefix hits."""
+    tier = HostSpillPool(64 << 20)
+    engine = _engine(model, num_blocks=28, kv_tier=tier)
+    rng = np.random.RandomState(3)
+    templates = [rng.randint(0, VOCAB, int(n)).tolist()
+                 for n in rng.randint(16, 33, 6)]
+    live = []
+    for _ in range(50):
+        op = rng.rand()
+        if op < 0.55:                               # admit a returning user
+            t = templates[int(rng.randint(0, len(templates)))]
+            live.append(engine.add_request(t, max_new_tokens=4,
+                                           temperature=0.0))
+        elif op < 0.70 and live:                    # abort one in flight
+            engine.abort(int(live.pop(int(rng.randint(0, len(live))))))
+        elif op < 0.85:                             # pressure's evict batch
+            engine.blocks.evict_parked(2)
+        for fo in engine.step():
+            if fo.rid in live:
+                live.remove(fo.rid)
+        engine.blocks.check_invariants()
+    while engine.has_unfinished():
+        engine.step()
+    engine.step()                                   # flush the final drain
+    engine.blocks.check_invariants()
+    bm = engine.blocks
+    assert bm.num_spill_pending == 0                # nothing stuck in
+    assert bm.num_used == 0                         # quarantine, zero leaks
+    assert bm.num_free + bm.num_cached == bm.num_blocks - 1
+    snap = engine.stats.snapshot()
+    assert snap["kv_pages_spilled"] > 0
+    assert snap["kv_pages_restored"] > 0
+    assert snap["spill_tier_hit_rate"] > 0.0        # restores were consults
+    assert snap["prefix_hit_rate"] > 0.0            # ...that served hits
+    # every page is accounted exactly once across the four classes
+    s = bm.stats()
+    assert s["spill_quarantined"] == snap["kv_pages_spilled"] \
+        + snap["kv_spill_dropped"]

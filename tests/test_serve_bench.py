@@ -344,6 +344,23 @@ def test_serve_bench_memory_pressure_emits_residency_surface():
     # matched traffic: both pools completed the identical stream
     assert record["retired"] == record["baseline_retired"] \
         == record["requests"]
+    # hierarchical KV: the spill-tier A/B rides the same record.  Tier
+    # on must beat tier off on the returning-user stream (fewer tokens
+    # re-prefilled, more served from cache) WITHOUT numeric or program
+    # drift: outputs byte-identical, compile_counts exactly unchanged,
+    # and no jit build anywhere in either arm's serving path
+    assert record["kv_spilled_pages"] > 0
+    assert record["kv_restored_pages"] > 0
+    assert record["spill_tier_hit_rate"] > 0
+    assert record["host_kv_bytes_resident"] > 0
+    assert record["kv_prefetch_hit_pages"] >= 0
+    assert record["spill_prefix_hit_rate"] \
+        > record["baseline_spill_prefix_hit_rate"]
+    assert record["spill_re_prefill_tokens"] \
+        < record["baseline_spill_re_prefill_tokens"]
+    assert record["spill_outputs_match"] is True
+    assert record["spill_compile_counts_equal"] is True
+    assert record["spill_stream_compiled"] is False
 
 
 def test_serve_bench_weight_pressure_emits_quantization_surface():

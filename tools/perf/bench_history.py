@@ -47,6 +47,7 @@ _SERVE_WATCH = (
     ("decode_window_host_round_trips_per_token", False),
     ("weight_bytes_resident", False),
     ("race_findings", False),        # post-baseline race-lint count: 0
+    ("spill_tier_hit_rate", True),   # host KV tier must keep earning hits
 )
 _TRAIN_WATCH = (("tokens_per_sec", True),)
 

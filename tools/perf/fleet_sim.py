@@ -75,12 +75,19 @@ def _floats_or_none(spec: str) -> list:
 
 
 def run_cell(workload, *, policy: str, admission_ttft_ms, replicas: int,
-             window_k: int, cost: CostModel, args) -> dict:
+             window_k: int, host_kv_bytes: int, cost: CostModel,
+             args) -> dict:
+    # the host spill tier is sized in BYTES at the CLI (matching the
+    # engine's --host-kv-bytes) but the simulator tracks pages; the
+    # conversion estimate is a knob because the sim carries no model
+    # dims of its own
+    host_kv_pages = int(host_kv_bytes) // max(int(args.kv_page_bytes), 1)
     rep_cfg = ReplicaConfig(
         max_num_seqs=args.max_num_seqs, block_size=args.block_size,
         max_model_len=args.max_model_len,
         max_prefill_tokens=args.max_prefill_tokens,
-        decode_window=window_k)
+        num_blocks=args.num_blocks,
+        decode_window=window_k, host_kv_pages=host_kv_pages)
     fleet_cfg = FleetConfig(
         replicas=replicas, policy=policy, seed=args.seed,
         admission_ttft_ms=admission_ttft_ms,
@@ -97,6 +104,8 @@ def run_cell(workload, *, policy: str, admission_ttft_ms, replicas: int,
         "policy": policy,
         "admission_ttft_ms": admission_ttft_ms,
         "decode_window_k": window_k,
+        "host_kv_bytes": int(host_kv_bytes),
+        "host_kv_pages": host_kv_pages,
         "profile": args.profile,
         "n_requests": args.requests,
         "seed": args.seed,
@@ -107,8 +116,9 @@ def run_cell(workload, *, policy: str, admission_ttft_ms, replicas: int,
     }
     cell["sim_config_fingerprint"] = _fingerprint(
         {k: cell[k] for k in ("replicas", "policy", "admission_ttft_ms",
-                              "decode_window_k", "profile", "n_requests",
-                              "seed", "rate_rps")})
+                              "decode_window_k", "host_kv_pages",
+                              "profile", "n_requests", "seed",
+                              "rate_rps")})
     cell.update(report)
     return cell
 
@@ -128,6 +138,12 @@ def main(argv=None) -> int:
                     help="replica counts to sweep (e.g. '1,2,4,8')")
     ap.add_argument("--window-k", default="1",
                     help="decode-window K values to sweep (e.g. '1,4,8')")
+    ap.add_argument("--host-kv-bytes", default="0",
+                    help="host KV spill-tier capacities in bytes to sweep "
+                         "(e.g. '0,268435456'); 0 = no tier.  Bytes are "
+                         "converted to simulator pages via "
+                         "--kv-page-bytes, mirroring the engine's "
+                         "--host-kv-bytes knob")
     # workload
     ap.add_argument("--requests", type=int, default=2000)
     ap.add_argument("--profile", default="bursty", choices=PROFILES)
@@ -135,11 +151,28 @@ def main(argv=None) -> int:
     ap.add_argument("--rate-rps", type=float, default=64.0)
     ap.add_argument("--mean-prompt", type=int, default=96)
     ap.add_argument("--mean-new", type=int, default=48)
+    ap.add_argument("--tenants", type=int, default=4,
+                    help="multi_tenant profile: distinct shared prefixes")
+    ap.add_argument("--prefix-pages", type=int, default=4,
+                    help="multi_tenant profile: shared prefix depth, pages")
+    ap.add_argument("--prefix-share", type=float, default=0.7,
+                    help="multi_tenant profile: P(request opens with its "
+                         "tenant prefix)")
     # replica shape
     ap.add_argument("--max-num-seqs", type=int, default=8)
     ap.add_argument("--block-size", type=int, default=16)
     ap.add_argument("--max-model-len", type=int, default=1024)
     ap.add_argument("--max-prefill-tokens", type=int, default=256)
+    ap.add_argument("--num-blocks", type=int, default=None,
+                    help="HBM KV pool size in pages (default: the "
+                         "engine's derived sizing).  Shrink it to put "
+                         "the pool under pressure — the regime where a "
+                         "--host-kv-bytes sweep is informative")
+    ap.add_argument("--kv-page-bytes", type=int, default=1 << 18,
+                    help="estimated bytes of ONE KV page on the real "
+                         "model, used only to convert --host-kv-bytes "
+                         "to simulator pages (2 * layers * kv_heads * "
+                         "head_dim * block_size * dtype_bytes)")
     # scoring
     ap.add_argument("--slo-ttft-ms", type=float, default=500.0)
     ap.add_argument("--slo-itl-ms", type=float, default=100.0)
@@ -189,34 +222,41 @@ def main(argv=None) -> int:
         admissions = [None]
         replica_counts = [2]
         ks = [4]
+        host_kv = [0]
     else:
         policies = [p.strip() for p in args.policies.split(",") if p.strip()]
         admissions = _floats_or_none(args.admission)
         replica_counts = [int(r) for r in args.replicas.split(",")]
         ks = [int(k) for k in args.window_k.split(",")]
+        host_kv = [int(b) for b in args.host_kv_bytes.split(",")]
 
     cost = _cost_model(args.calibration)
     workload = synthesize_workload(
         args.requests, seed=args.seed, profile=args.profile,
         rate_rps=args.rate_rps, mean_prompt=args.mean_prompt,
         mean_new=args.mean_new, max_model_len=args.max_model_len,
-        block_size=args.block_size)
+        block_size=args.block_size, tenants=args.tenants,
+        prefix_pages=args.prefix_pages, prefix_share=args.prefix_share)
 
     sink = open(args.out, "w") if args.out else sys.stdout
     t0 = time.perf_counter()
     cells = 0
     try:
-        for policy, adm, n_rep, k in itertools.product(
-                policies, admissions, replica_counts, ks):
+        for policy, adm, n_rep, k, hkv in itertools.product(
+                policies, admissions, replica_counts, ks, host_kv):
             cell = run_cell(workload, policy=policy, admission_ttft_ms=adm,
-                            replicas=n_rep, window_k=k, cost=cost,
-                            args=args)
+                            replicas=n_rep, window_k=k,
+                            host_kv_bytes=hkv, cost=cost, args=args)
             sink.write(json.dumps(cell) + "\n")
             cells += 1
+            spill = (f" spill={cell['kv_spilled_pages']}/"
+                     f"{cell['kv_restored_pages']} "
+                     f"hit={cell['spill_tier_hit_rate']:.3f}"
+                     if cell["host_kv_pages"] else "")
             print(f"[fleet_sim] {policy} adm={adm} replicas={n_rep} "
-                  f"K={k}: attainment={cell['value']:.4f} "
+                  f"K={k} hostkv={hkv}: attainment={cell['value']:.4f} "
                   f"shed={cell['shed']} "
-                  f"ttft_p95={cell['ttft_p95_ms']:.1f}ms",
+                  f"ttft_p95={cell['ttft_p95_ms']:.1f}ms{spill}",
                   file=sys.stderr)
     finally:
         if args.out:

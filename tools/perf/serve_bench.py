@@ -1225,6 +1225,37 @@ def _pressure_stream(rng, n_requests, vocab):
     return stream
 
 
+def _returning_stream(rng, n_requests, vocab, n_users=8):
+    """Returning-user traffic for the spill-tier A/B: every prompt is
+    one of ``n_users`` fixed 48-token prefixes, so a user whose parked
+    pages were pressure-evicted comes BACK — which is the only traffic
+    where a spill tier can matter.  Paced one arrival per two steps so
+    revisits land after the evictions they need to profit from."""
+    users = [rng.randint(0, vocab, 48).tolist() for _ in range(n_users)]
+    stream, step = [], 0
+    for _ in range(n_requests):
+        step += 2
+        stream.append((step, users[int(rng.randint(0, n_users))], 16))
+    return stream
+
+
+def _drive_outputs(engine, stream):
+    """_drive, collecting every finished request's generated tokens in
+    a deterministic (rid-sorted) order for byte-identity checks."""
+    outs = {}
+    step_no = 0
+    pending = list(stream)
+    while pending or engine.has_unfinished():
+        while pending and pending[0][0] <= step_no:
+            _, prompt, max_new = pending.pop(0)
+            engine.add_request(prompt, max_new_tokens=max_new,
+                               temperature=0.0)
+        for fo in engine.step():
+            outs[fo.rid] = tuple(fo.generated)
+        step_no += 1
+    return [outs[rid] for rid in sorted(outs)]
+
+
 def _page_bytes(cfg, block_size, kv_dtype):
     """Per-page HBM cost for a dtype BEFORE building an engine — the
     pressure bench sizes pools from a byte budget, so both dtypes get
@@ -1257,16 +1288,25 @@ def _drive_peak(engine, stream):
 
 def run_pressure_bench(smoke: bool, n_requests: int, seed: int,
                        backend: str, kv_dtype: str, tp: int = 1,
-                       weight_dtype: str = "float32"):
+                       weight_dtype: str = "float32",
+                       host_kv_bytes: int = None):
     """Fixed-HBM A/B: the same burst stream runs on a float32 pool and
     a ``kv_dtype`` pool sized from the SAME byte budget, each with a
     DegradationController installed.  int8 pages are ~4x smaller, so
     the budget holds ~4x the blocks — the record shows how many more
     sequences stayed resident and how many preemptions / degradation
-    tier entries that headroom avoided at matched traffic."""
+    tier entries that headroom avoided at matched traffic.
+
+    A second matched-HBM A/B rides along: the same returning-user burst
+    stream on the SAME pool with the host spill tier on vs off.  Both
+    arms precompile the full bucket ladder, so the record's
+    ``spill_compile_counts_equal`` verdict means the tier's restores
+    introduced no programs, and ``spill_outputs_match`` pins restored
+    bytes byte-identical to recomputed ones."""
     import numpy as np
 
     from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.inference.kv_tier import HostSpillPool
     from paddle_tpu.inference.pressure import DegradationController
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 
@@ -1305,6 +1345,36 @@ def run_pressure_bench(smoke: bool, n_requests: int, seed: int,
             "retired": s["retired"],
             "wall_s": round(wall, 3),
         }
+    dtype_snap = engine.stats.snapshot()  # the kv_dtype arm's windows
+
+    # -- spill-tier A/B: same float32 pool, host tier on vs off --------
+    # 2x the requests of the dtype A/B so each of the 8 users returns
+    # often enough for pressure-evicted pages to be worth restoring
+    tier_cap = int(host_kv_bytes) if host_kv_bytes else 4 * int(budget)
+    spill = {}
+    for cap in (0, tier_cap):
+        tier = HostSpillPool(cap) if cap else None
+        nb = budget // (_page_bytes(cfg, engine_kw["block_size"],
+                                    "float32") // tp)
+        engine = LLMEngine(model, kv_dtype="float32", num_blocks=int(nb),
+                           weight_dtype=weight_dtype,
+                           pressure=DegradationController(), tp=tp,
+                           kv_tier=tier, **engine_kw)
+        engine.precompile_buckets()
+        compiles_pre = dict(engine.compile_counts)
+        rng = np.random.RandomState(seed)
+        stream = _returning_stream(rng, 2 * n_requests, cfg.vocab_size)
+        outs = _drive_outputs(engine, stream)
+        snap = engine.stats.snapshot()
+        spill["on" if cap else "off"] = {
+            "outs": outs,
+            "compiles": dict(engine.compile_counts),
+            "stream_compiled": engine.compile_counts != compiles_pre,
+            "prefix_hit_rate": snap["prefix_hit_rate"],
+            "re_prefill_tokens": snap["cache_miss_tokens"],
+            "snap": snap,
+        }
+    on, off = spill["on"], spill["off"]
     q, base = runs[kv_dtype], runs["float32"]
     return {
         "metric": "serve_pressure_resident_seqs",
@@ -1333,8 +1403,23 @@ def run_pressure_bench(smoke: bool, n_requests: int, seed: int,
         "baseline_preempted": base["preempted"],
         "retired": q["retired"],
         "baseline_retired": base["retired"],
-        **_slo_keys(engine.stats.snapshot()),
-        **_window_keys(engine.stats.snapshot()),
+        # spill-tier A/B (host tier on vs off, same pool, same stream)
+        "host_kv_bytes": tier_cap,
+        "host_kv_bytes_resident": on["snap"]["host_kv_bytes_resident"],
+        "kv_spilled_pages": on["snap"]["kv_pages_spilled"],
+        "kv_restored_pages": on["snap"]["kv_pages_restored"],
+        "spill_tier_hit_rate": on["snap"]["spill_tier_hit_rate"],
+        "kv_prefetch_hit_pages": on["snap"]["kv_prefetch_hit_pages"],
+        "spill_prefix_hit_rate": on["prefix_hit_rate"],
+        "baseline_spill_prefix_hit_rate": off["prefix_hit_rate"],
+        "spill_re_prefill_tokens": on["re_prefill_tokens"],
+        "baseline_spill_re_prefill_tokens": off["re_prefill_tokens"],
+        "spill_outputs_match": on["outs"] == off["outs"],
+        "spill_compile_counts_equal": on["compiles"] == off["compiles"],
+        "spill_stream_compiled": bool(on["stream_compiled"]
+                                      or off["stream_compiled"]),
+        **_slo_keys(dtype_snap),
+        **_window_keys(dtype_snap),
     }
 
 
@@ -1650,6 +1735,11 @@ def main(argv=None):
                          "float32 pool vs a --kv-dtype pool; report "
                          "resident sequences, preemptions and "
                          "degradation tier entries for both")
+    ap.add_argument("--host-kv-bytes", type=int, default=None,
+                    metavar="BYTES",
+                    help="with --memory-pressure: host spill-tier "
+                         "capacity for the tier-on A/B arm (default "
+                         "4x the HBM page budget)")
     ap.add_argument("--weight-dtype", choices=("float32", "int8", "int4"),
                     default="float32",
                     help="weight-pool dtype for every engine the bench "
@@ -1793,7 +1883,8 @@ def main(argv=None):
             record.update(run_pressure_bench(
                 args.smoke, n_requests, args.seed, backend,
                 args.kv_dtype, args.tp,
-                weight_dtype=args.weight_dtype))
+                weight_dtype=args.weight_dtype,
+                host_kv_bytes=args.host_kv_bytes))
         elif args.chaos:
             record.update(run_chaos_bench(
                 args.smoke, n_requests, args.seed, backend,
